@@ -1,0 +1,26 @@
+"""Repo-level pytest bootstrap.
+
+Forces JAX onto a virtual 8-device CPU platform *before* the backend
+initializes, so the whole distributed test matrix (mesh sharding, psum sync,
+multi-rank simulation) runs host-only — the TPU analog of the reference's
+CPU-only gloo CI (reference ``.github/workflows/unit_test.yaml:27-29``).
+
+Note: the session's sitecustomize imports jax at interpreter startup (axon
+TPU plugin), so env vars alone are too late — we must go through
+``jax.config.update`` for the platform, and set XLA_FLAGS before the CPU
+backend is instantiated (backends initialize lazily, so this is still in
+time).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,115 @@
+"""Class-metric protocol tests for the accuracy family — parity with
+reference ``tests/metrics/classification/test_accuracy.py``."""
+
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(1)
+
+
+class TestMulticlassAccuracy(MetricClassTester):
+    def test_accuracy_class_micro(self) -> None:
+        input = RNG.integers(0, 4, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.integers(0, 4, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        expected = float(
+            (input.reshape(-1) == target.reshape(-1)).sum()
+            / (NUM_TOTAL_UPDATES * BATCH_SIZE)
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassAccuracy(),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(expected),
+            atol=1e-6,
+        )
+
+    def test_accuracy_class_macro(self) -> None:
+        num_classes = 4
+        input = RNG.integers(0, num_classes, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.integers(0, num_classes, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        flat_i, flat_t = input.reshape(-1), target.reshape(-1)
+        accs = [
+            (flat_i[flat_t == c] == c).mean()
+            for c in range(num_classes)
+            if (flat_t == c).any()
+        ]
+        self.run_class_implementation_tests(
+            metric=MulticlassAccuracy(average="macro", num_classes=num_classes),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(np.mean(accs)),
+            atol=1e-6,
+        )
+
+    def test_accuracy_class_invalid_params(self) -> None:
+        with self.assertRaisesRegex(ValueError, "`average` was not"):
+            MulticlassAccuracy(average="weighted")
+
+
+class TestBinaryAccuracy(MetricClassTester):
+    def test_binary_accuracy_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        pred = (input >= 0.5).astype(np.int64)
+        expected = float((pred == target).mean())
+        self.run_class_implementation_tests(
+            metric=BinaryAccuracy(),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(expected),
+            atol=1e-6,
+        )
+
+
+class TestMultilabelAccuracy(MetricClassTester):
+    def test_multilabel_accuracy_class(self) -> None:
+        input = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE, 3))
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE, 3))
+        expected = float(
+            np.all(input == target, axis=-1).sum() / (NUM_TOTAL_UPDATES * BATCH_SIZE)
+        )
+        self.run_class_implementation_tests(
+            metric=MultilabelAccuracy(),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(expected),
+            atol=1e-6,
+        )
+
+
+class TestTopKMultilabelAccuracy(MetricClassTester):
+    def test_topk_multilabel_accuracy_class(self) -> None:
+        k = 2
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 4))
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE, 4))
+        # numpy oracle: top-k one-hot then exact match
+        flat_i = input.reshape(-1, 4)
+        flat_t = target.reshape(-1, 4)
+        topk_idx = np.argsort(-flat_i, axis=-1)[:, :k]
+        pred = np.zeros_like(flat_i)
+        np.put_along_axis(pred, topk_idx, 1.0, axis=-1)
+        expected = float(np.all(pred == flat_t, axis=-1).mean())
+        self.run_class_implementation_tests(
+            metric=TopKMultilabelAccuracy(k=k),
+            state_names={"num_correct", "num_total"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(expected),
+            atol=1e-6,
+        )
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

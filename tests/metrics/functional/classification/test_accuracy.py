@@ -1,0 +1,217 @@
+"""Functional accuracy tests vs sklearn oracle — parity with reference
+``tests/metrics/functional/classification/test_accuracy.py``."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import accuracy_score
+
+from torcheval_tpu.metrics.functional import (
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+    topk_multilabel_accuracy,
+)
+
+RNG = np.random.default_rng(0)
+
+
+class TestBinaryAccuracy(unittest.TestCase):
+    def test_against_sklearn(self) -> None:
+        input = RNG.integers(0, 2, (32,))
+        target = RNG.integers(0, 2, (32,))
+        np.testing.assert_allclose(
+            np.asarray(binary_accuracy(input, target)),
+            accuracy_score(target, input),
+            rtol=1e-5,
+        )
+
+    def test_threshold(self) -> None:
+        input = np.asarray([0.1, 0.6, 0.8, 0.2])
+        target = np.asarray([0, 1, 1, 1])
+        np.testing.assert_allclose(
+            np.asarray(binary_accuracy(input, target)), 0.75
+        )
+        np.testing.assert_allclose(
+            np.asarray(binary_accuracy(input, target, threshold=0.7)), 0.5
+        )
+
+    def test_input_check(self) -> None:
+        with self.assertRaisesRegex(ValueError, "same dimensions"):
+            binary_accuracy(np.zeros(3), np.zeros(4))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            binary_accuracy(np.zeros((3, 2)), np.zeros((3, 2)))
+
+
+class TestMulticlassAccuracy(unittest.TestCase):
+    def test_label_input_against_sklearn(self) -> None:
+        input = RNG.integers(0, 4, (64,))
+        target = RNG.integers(0, 4, (64,))
+        np.testing.assert_allclose(
+            np.asarray(multiclass_accuracy(input, target)),
+            accuracy_score(target, input),
+            rtol=1e-5,
+        )
+
+    def test_score_input(self) -> None:
+        input = RNG.normal(size=(64, 4))
+        target = RNG.integers(0, 4, (64,))
+        np.testing.assert_allclose(
+            np.asarray(multiclass_accuracy(input, target)),
+            accuracy_score(target, input.argmax(axis=1)),
+            rtol=1e-5,
+        )
+
+    def test_average_none(self) -> None:
+        input = np.asarray([0, 2, 1, 3])
+        target = np.asarray([0, 1, 2, 3])
+        np.testing.assert_allclose(
+            np.asarray(multiclass_accuracy(input, target, average=None, num_classes=4)),
+            [1.0, 0.0, 0.0, 1.0],
+        )
+
+    def test_average_macro(self) -> None:
+        input = np.asarray([0, 2, 1, 3, 0])
+        target = np.asarray([0, 1, 2, 3, 0])
+        # classes 0..3 seen; per-class acc [1, 0, 0, 1] -> macro 0.5
+        np.testing.assert_allclose(
+            np.asarray(
+                multiclass_accuracy(input, target, average="macro", num_classes=5)
+            ),
+            0.5,
+        )
+
+    def test_average_none_unseen_class_nan(self) -> None:
+        input = np.asarray([0, 1])
+        target = np.asarray([0, 1])
+        result = np.asarray(
+            multiclass_accuracy(input, target, average=None, num_classes=3)
+        )
+        np.testing.assert_allclose(result[:2], [1.0, 1.0])
+        self.assertTrue(np.isnan(result[2]))
+
+    def test_topk(self) -> None:
+        input = np.asarray(
+            [
+                [0.9, 0.1, 0.0],
+                [0.3, 0.4, 0.3],
+                [0.2, 0.1, 0.7],
+                [0.4, 0.3, 0.3],
+            ]
+        )
+        target = np.asarray([1, 0, 2, 0])
+        # top-2 hits: rank(target-score) < 2
+        np.testing.assert_allclose(
+            np.asarray(multiclass_accuracy(input, target, k=2)), 1.0
+        )
+        np.testing.assert_allclose(
+            np.asarray(multiclass_accuracy(input, target, k=1)), 0.5
+        )
+
+    def test_param_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "`average` was not in the allowed"):
+            multiclass_accuracy(np.zeros(2), np.zeros(2), average="weighted")
+        with self.assertRaisesRegex(ValueError, "num_classes should be a positive"):
+            multiclass_accuracy(np.zeros(2), np.zeros(2), average="macro")
+        with self.assertRaisesRegex(ValueError, "greater than 0"):
+            multiclass_accuracy(np.zeros(2), np.zeros(2), k=0)
+        with self.assertRaisesRegex(TypeError, "to be an integer"):
+            multiclass_accuracy(np.zeros(2), np.zeros(2), k=1.5)
+
+    def test_input_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "same first dimension"):
+            multiclass_accuracy(np.zeros((3, 2)), np.zeros(4))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            multiclass_accuracy(np.zeros((3, 2)), np.zeros((3, 2)))
+        with self.assertRaisesRegex(ValueError, "for k > 1"):
+            multiclass_accuracy(np.zeros(3), np.zeros(3), k=2)
+
+
+class TestMultilabelAccuracy(unittest.TestCase):
+    INPUT = np.asarray([[0, 1], [1, 1], [0, 0], [0, 1]])
+    TARGET = np.asarray([[0, 1], [1, 0], [0, 0], [1, 1]])
+
+    def test_criteria(self) -> None:
+        cases = {
+            "exact_match": 0.5,
+            "hamming": 0.75,
+            "overlap": 1.0,
+            "contain": 0.75,
+            "belong": 0.75,
+        }
+        for criteria, expected in cases.items():
+            np.testing.assert_allclose(
+                np.asarray(
+                    multilabel_accuracy(self.INPUT, self.TARGET, criteria=criteria)
+                ),
+                expected,
+                err_msg=criteria,
+            )
+
+    def test_sklearn_exact_match(self) -> None:
+        from sklearn.metrics import accuracy_score as sk_acc
+
+        input = RNG.integers(0, 2, (32, 5))
+        target = RNG.integers(0, 2, (32, 5))
+        np.testing.assert_allclose(
+            np.asarray(multilabel_accuracy(input, target)),
+            sk_acc(target, input),
+            rtol=1e-5,
+        )
+
+    def test_param_and_input_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "`criteria` was not"):
+            multilabel_accuracy(self.INPUT, self.TARGET, criteria="bogus")
+        with self.assertRaisesRegex(ValueError, "same dimensions"):
+            multilabel_accuracy(np.zeros((3, 2)), np.zeros((3, 3)))
+
+
+class TestTopKMultilabelAccuracy(unittest.TestCase):
+    INPUT = np.asarray(
+        [[0.1, 0.5, 0.2], [0.3, 0.2, 0.1], [0.2, 0.4, 0.5], [0, 0.1, 0.9]]
+    )
+    TARGET = np.asarray([[1, 1, 0], [0, 1, 0], [1, 1, 1], [0, 1, 0]])
+
+    def test_criteria_k2(self) -> None:
+        # Expected values from the reference docstring examples
+        # (reference accuracy.py:208-236)
+        cases = {
+            "exact_match": 0.0,
+            "hamming": 7 / 12,
+            "overlap": 1.0,
+            "contain": 0.5,
+            "belong": 0.25,
+        }
+        for criteria, expected in cases.items():
+            np.testing.assert_allclose(
+                np.asarray(
+                    topk_multilabel_accuracy(
+                        self.INPUT, self.TARGET, criteria=criteria, k=2
+                    )
+                ),
+                expected,
+                rtol=1e-5,
+                err_msg=criteria,
+            )
+
+    def test_k_is_honored(self) -> None:
+        # Divergence from the reference's hardcoded topk(k=2) bug: with k=3
+        # every label is predicted, so "contain" is always satisfied.
+        np.testing.assert_allclose(
+            np.asarray(
+                topk_multilabel_accuracy(
+                    self.INPUT, self.TARGET, criteria="contain", k=3
+                )
+            ),
+            1.0,
+        )
+
+    def test_param_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "greater than 1"):
+            topk_multilabel_accuracy(self.INPUT, self.TARGET, k=1)
+        with self.assertRaisesRegex(ValueError, "`criteria` was not"):
+            topk_multilabel_accuracy(self.INPUT, self.TARGET, criteria="x", k=2)
+
+
+if __name__ == "__main__":
+    unittest.main()

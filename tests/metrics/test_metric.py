@@ -1,0 +1,149 @@
+"""Base-class contract tests — parity with reference
+``tests/metrics/test_metric.py`` (473 LoC): drives the four state-container
+variants through add/update/reset/state_dict/to-device using the dummy
+metrics, and asserts strict-mode load errors."""
+
+import pickle
+import unittest
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.utils.test_utils.dummy_metric import (
+    DummySumDequeStateMetric,
+    DummySumDictStateMetric,
+    DummySumListStateMetric,
+    DummySumMetric,
+)
+
+
+class MetricBaseClassTest(unittest.TestCase):
+    def test_add_state_invalid_type(self) -> None:
+        class BadMetric(DummySumMetric):
+            def __init__(self):
+                super().__init__()
+                self._add_state("bad", "not-an-array")
+
+        with self.assertRaisesRegex(TypeError, "value of state variable"):
+            BadMetric()
+
+    def test_add_state_mixed_list_invalid(self) -> None:
+        class BadMetric(DummySumMetric):
+            def __init__(self):
+                super().__init__()
+                self._add_state("bad", [jnp.asarray(1.0), "x"])
+
+        with self.assertRaisesRegex(TypeError, "value of state variable"):
+            BadMetric()
+
+    def test_tensor_state_update_compute_reset(self) -> None:
+        metric = DummySumMetric()
+        np.testing.assert_allclose(np.asarray(metric.compute()), 0.0)
+        metric.update(1.0).update(2.0)
+        np.testing.assert_allclose(np.asarray(metric.compute()), 3.0)
+        metric.reset()
+        np.testing.assert_allclose(np.asarray(metric.compute()), 0.0)
+
+    def test_list_state_update_compute_reset(self) -> None:
+        metric = DummySumListStateMetric()
+        metric.update(jnp.asarray([1.0, 2.0])).update(jnp.asarray(3.0))
+        np.testing.assert_allclose(np.asarray(metric.compute()), 6.0)
+        metric.reset()
+        self.assertEqual(metric.x, [])
+        # reset state is independent of the default registry
+        metric.update(jnp.asarray(5.0))
+        metric.reset()
+        self.assertEqual(metric.x, [])
+
+    def test_dict_state_update_compute_reset(self) -> None:
+        metric = DummySumDictStateMetric()
+        metric.update("a", 1.0).update("a", 2.0).update("b", 5.0)
+        result = metric.compute()
+        np.testing.assert_allclose(np.asarray(result["a"]), 3.0)
+        np.testing.assert_allclose(np.asarray(result["b"]), 5.0)
+        metric.reset()
+        # dict states reset to a defaultdict of scalar zeros
+        np.testing.assert_allclose(np.asarray(metric.x["zzz"]), 0.0)
+
+    def test_deque_state_update_compute_maxlen(self) -> None:
+        metric = DummySumDequeStateMetric()
+        for i in range(12):
+            metric.update(jnp.asarray(float(i)))
+        self.assertEqual(len(metric.x), 10)  # maxlen=10 ring semantics
+        np.testing.assert_allclose(np.asarray(metric.compute()), sum(range(2, 12)))
+        metric.reset()
+        self.assertEqual(len(metric.x), 0)
+        self.assertEqual(metric.x.maxlen, 10)
+
+    def test_state_dict_round_trip(self) -> None:
+        metric = DummySumMetric()
+        metric.update(4.0)
+        sd = metric.state_dict()
+        np.testing.assert_allclose(np.asarray(sd["sum"]), 4.0)
+        fresh = DummySumMetric()
+        fresh.load_state_dict(sd)
+        np.testing.assert_allclose(np.asarray(fresh.compute()), 4.0)
+
+    def test_load_state_dict_strict_errors(self) -> None:
+        metric = DummySumMetric()
+        with self.assertRaisesRegex(RuntimeError, "missing keys"):
+            metric.load_state_dict({}, strict=True)
+        with self.assertRaisesRegex(RuntimeError, "unexpected keys"):
+            metric.load_state_dict(
+                {"sum": jnp.asarray(1.0), "bogus": jnp.asarray(2.0)}, strict=True
+            )
+        # non-strict ignores both
+        metric.load_state_dict({"bogus": jnp.asarray(2.0)}, strict=False)
+
+    def test_load_state_dict_deque_restores_maxlen(self) -> None:
+        metric = DummySumDequeStateMetric()
+        metric.update(jnp.asarray(1.0))
+        sd = metric.state_dict()
+        self.assertIsInstance(sd["x"], list)
+        fresh = DummySumDequeStateMetric()
+        fresh.load_state_dict(sd)
+        self.assertIsInstance(fresh.x, deque)
+        self.assertEqual(fresh.x.maxlen, 10)
+
+    def test_to_device(self) -> None:
+        devices = jax.devices()
+        self.assertGreaterEqual(len(devices), 8, "conftest must force 8 cpu devices")
+        metric = DummySumMetric()
+        metric.update(2.0)
+        metric.to(devices[1])
+        self.assertEqual(metric.device, devices[1])
+        self.assertEqual(list(metric.sum.devices())[0], devices[1])
+        np.testing.assert_allclose(np.asarray(metric.compute()), 2.0)
+        metric.to("cpu:0")
+        self.assertEqual(metric.device, devices[0])
+
+    def test_pickle_round_trip(self) -> None:
+        for metric in (
+            DummySumMetric().update(1.0),
+            DummySumListStateMetric().update(jnp.asarray([1.0, 2.0])),
+            DummySumDequeStateMetric().update(jnp.asarray(3.0)),
+        ):
+            loaded = pickle.loads(pickle.dumps(metric))
+            np.testing.assert_allclose(
+                np.asarray(loaded.compute()), np.asarray(metric.compute())
+            )
+
+    def test_merge_state(self) -> None:
+        a = DummySumMetric().update(1.0)
+        b = DummySumMetric().update(2.0)
+        c = DummySumMetric().update(3.0)
+        a.merge_state([b, c])
+        np.testing.assert_allclose(np.asarray(a.compute()), 6.0)
+        # sources unchanged
+        np.testing.assert_allclose(np.asarray(b.compute()), 2.0)
+
+    def test_abstract_instantiation(self) -> None:
+        with self.assertRaises(TypeError):
+            Metric()  # type: ignore[abstract]
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,11 @@
+"""torcheval_tpu — a TPU-native (JAX/XLA/Pallas) model-metrics framework.
+
+Capability parity target: torcheval v0.0.4 (see /root/reference, SURVEY.md).
+Top-level exports mirror the reference's `torcheval/__init__.py:10-16`:
+only ``metrics``, ``tools`` and ``__version__``.
+"""
+
+from torcheval_tpu import metrics, tools
+from torcheval_tpu.version import __version__
+
+__all__ = ["metrics", "tools", "__version__"]

@@ -1,0 +1,20 @@
+"""Stateful class metrics (reference ``torcheval/metrics/__init__.py:38-76``
+— 30 classes + ``Metric`` + the ``functional`` namespace)."""
+
+from torcheval_tpu.metrics import functional
+from torcheval_tpu.metrics.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+__all__ = [
+    "functional",
+    "Metric",
+    "BinaryAccuracy",
+    "MulticlassAccuracy",
+    "MultilabelAccuracy",
+    "TopKMultilabelAccuracy",
+]

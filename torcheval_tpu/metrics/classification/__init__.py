@@ -1,0 +1,13 @@
+from torcheval_tpu.metrics.classification.accuracy import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+    TopKMultilabelAccuracy,
+)
+
+__all__ = [
+    "BinaryAccuracy",
+    "MulticlassAccuracy",
+    "MultilabelAccuracy",
+    "TopKMultilabelAccuracy",
+]

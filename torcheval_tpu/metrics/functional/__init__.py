@@ -1,0 +1,16 @@
+"""Stateless functional metrics (reference
+``torcheval/metrics/functional/__init__.py:38-68`` — 28 public functions)."""
+
+from torcheval_tpu.metrics.functional.classification import (
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+    topk_multilabel_accuracy,
+)
+
+__all__ = [
+    "binary_accuracy",
+    "multiclass_accuracy",
+    "multilabel_accuracy",
+    "topk_multilabel_accuracy",
+]

@@ -1,0 +1,13 @@
+from torcheval_tpu.metrics.functional.classification.accuracy import (
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+    topk_multilabel_accuracy,
+)
+
+__all__ = [
+    "binary_accuracy",
+    "multiclass_accuracy",
+    "multilabel_accuracy",
+    "topk_multilabel_accuracy",
+]

@@ -1,0 +1,4 @@
+"""Model-evaluation tools (reference ``torcheval/tools/__init__.py:7-19``):
+module summaries and FLOP counting, re-based on XLA cost analysis."""
+
+__all__ = []

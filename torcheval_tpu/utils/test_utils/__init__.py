@@ -1,0 +1,15 @@
+from torcheval_tpu.utils.test_utils.dummy_metric import (
+    DummySumMetric,
+    DummySumListStateMetric,
+    DummySumDictStateMetric,
+    DummySumDequeStateMetric,
+)
+from torcheval_tpu.utils.test_utils.metric_class_tester import MetricClassTester
+
+__all__ = [
+    "DummySumMetric",
+    "DummySumListStateMetric",
+    "DummySumDictStateMetric",
+    "DummySumDequeStateMetric",
+    "MetricClassTester",
+]

@@ -1,0 +1,99 @@
+"""Dummy metrics — one per legal state-container type, used by the base-class
+tests (reference ``torcheval/utils/test_utils/dummy_metric.py:19-141``)."""
+
+from collections import defaultdict, deque
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+
+
+class DummySumMetric(Metric[jax.Array]):
+    """Array-state summer (reference ``dummy_metric.py:19-42``)."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("sum", jnp.asarray(0.0))
+
+    def update(self, x) -> "DummySumMetric":
+        self.sum = self.sum + jnp.asarray(x)
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.sum
+
+    def merge_state(self, metrics: Iterable["DummySumMetric"]) -> "DummySumMetric":
+        for metric in metrics:
+            self.sum = self.sum + jax.device_put(metric.sum, self.device)
+        return self
+
+
+class DummySumListStateMetric(Metric[jax.Array]):
+    """List-state summer (reference ``dummy_metric.py:48-74``)."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("x", [])
+
+    def update(self, x) -> "DummySumListStateMetric":
+        self.x.append(jax.device_put(jnp.asarray(x), self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        return sum(array.sum() for array in self.x)
+
+    def merge_state(
+        self, metrics: Iterable["DummySumListStateMetric"]
+    ) -> "DummySumListStateMetric":
+        for metric in metrics:
+            self.x.extend(jax.device_put(element, self.device) for element in metric.x)
+        return self
+
+
+class DummySumDictStateMetric(Metric[jax.Array]):
+    """Dict-state summer (reference ``dummy_metric.py:80-109``)."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("x", defaultdict(lambda: jnp.asarray(0.0)))
+
+    def update(self, k: str, v) -> "DummySumDictStateMetric":
+        current = self.x[k] if k in self.x else jnp.asarray(0.0)
+        self.x[k] = current + jnp.asarray(v)
+        return self
+
+    def compute(self):
+        return self.x
+
+    def merge_state(
+        self, metrics: Iterable["DummySumDictStateMetric"]
+    ) -> "DummySumDictStateMetric":
+        for metric in metrics:
+            for k in metric.x.keys():
+                current = self.x[k] if k in self.x else jnp.asarray(0.0)
+                self.x[k] = current + jax.device_put(metric.x[k], self.device)
+        return self
+
+
+class DummySumDequeStateMetric(Metric[jax.Array]):
+    """Deque-state summer with maxlen=10 (reference ``dummy_metric.py:115-141``)."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("x", deque(maxlen=10))
+
+    def update(self, x) -> "DummySumDequeStateMetric":
+        self.x.append(jax.device_put(jnp.asarray(x), self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        return sum(array.sum() for array in self.x)
+
+    def merge_state(
+        self, metrics: Iterable["DummySumDequeStateMetric"]
+    ) -> "DummySumDequeStateMetric":
+        for metric in metrics:
+            self.x.extend(jax.device_put(element, self.device) for element in metric.x)
+        return self
