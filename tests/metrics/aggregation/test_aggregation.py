@@ -1,0 +1,119 @@
+"""Class-metric protocol tests for aggregation metrics."""
+
+import numpy as np
+
+from torcheval_tpu.metrics import Cat, Max, Mean, Min, Sum, Throughput
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(5)
+
+
+class TestSum(MetricClassTester):
+    def test_sum_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        self.run_class_implementation_tests(
+            metric=Sum(),
+            state_names={"weighted_sum"},
+            update_kwargs={"input": list(input)},
+            compute_result=np.float32(input.sum()),
+            atol=1e-5,
+        )
+
+    def test_sum_class_weighted(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        weight = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        self.run_class_implementation_tests(
+            metric=Sum(),
+            state_names={"weighted_sum"},
+            update_kwargs={"input": list(input), "weight": list(weight)},
+            compute_result=np.float32((input * weight).sum()),
+            atol=1e-5,
+        )
+
+
+class TestMean(MetricClassTester):
+    def test_mean_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        self.run_class_implementation_tests(
+            metric=Mean(),
+            state_names={"weighted_sum", "weights"},
+            update_kwargs={"input": list(input)},
+            compute_result=np.float32(input.mean()),
+            atol=1e-6,
+        )
+
+
+class TestMinMax(MetricClassTester):
+    def test_min_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        self.run_class_implementation_tests(
+            metric=Min(),
+            state_names={"min"},
+            update_kwargs={"input": list(input)},
+            compute_result=np.float32(input.min()),
+        )
+
+    def test_max_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        self.run_class_implementation_tests(
+            metric=Max(),
+            state_names={"max"},
+            update_kwargs={"input": list(input)},
+            compute_result=np.float32(input.max()),
+        )
+
+
+class TestCat(MetricClassTester):
+    def test_cat_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=Cat(),
+            state_names={"inputs"},
+            update_kwargs={"input": list(input)},
+            compute_result=input.reshape(-1),
+            # one-update merge tests compare a 1-batch result against a
+            # 2-metric merge, which for Cat changes the length
+            test_merge_with_one_update=True,
+        )
+
+    def test_cat_empty(self) -> None:
+        metric = Cat()
+        self.assertEqual(np.asarray(metric.compute()).shape, (0,))
+
+
+class TestThroughput(MetricClassTester):
+    def test_throughput_class(self) -> None:
+        num_processed = [32, 16, 8, 4, 32, 16, 8, 4]
+        elapsed = [1.0, 0.5, 0.25, 0.25, 1.0, 0.5, 0.25, 0.25]
+        total = sum(num_processed)
+        # sequential: total / sum(elapsed); merged (4 ranks × 2 updates):
+        # total / max(per-rank elapsed sum) — slowest-rank gating
+        per_rank_elapsed = [1.5, 0.5, 1.5, 0.5]
+        self.run_class_implementation_tests(
+            metric=Throughput(),
+            state_names={"num_total", "elapsed_time_sec"},
+            update_kwargs={
+                "num_processed": num_processed,
+                "elapsed_time_sec": elapsed,
+            },
+            compute_result=np.float32(total / sum(elapsed)),
+            merge_and_compute_result=np.float32(total / max(per_rank_elapsed)),
+            test_merge_with_one_update=True,
+            atol=1e-4,
+        )
+
+    def test_throughput_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "non-negative"):
+            Throughput().update(-1, 1.0)
+        with self.assertRaisesRegex(ValueError, "positive number"):
+            Throughput().update(1, 0.0)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,115 @@
+"""Class-metric protocol tests for binned PR curves and NE."""
+
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryBinnedPrecisionRecallCurve,
+    BinaryNormalizedEntropy,
+    MulticlassBinnedPrecisionRecallCurve,
+)
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(23)
+
+
+def _binary_binned_oracle(input, target, thresholds):
+    pred = input[None, :] >= thresholds[:, None]
+    tp = (pred & (target[None, :] == 1)).sum(1)
+    fp = pred.sum(1) - tp
+    fn = target.sum() - tp
+    with np.errstate(invalid="ignore"):
+        precision = np.nan_to_num(tp / (tp + fp), nan=1.0)
+    recall = tp / (tp + fn)
+    return (
+        np.concatenate([precision, [1.0]]).astype(np.float32),
+        np.concatenate([recall, [0.0]]).astype(np.float32),
+        thresholds.astype(np.float32),
+    )
+
+
+class TestBinaryBinnedPRCurve(MetricClassTester):
+    def test_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        thresholds = np.linspace(0, 1, 10)
+        expected = _binary_binned_oracle(
+            input.reshape(-1), target.reshape(-1), thresholds
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryBinnedPrecisionRecallCurve(threshold=10),
+            state_names={"threshold", "num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=expected,
+            atol=1e-5,
+            test_merge_with_one_update=False,
+        )
+
+
+class TestMulticlassBinnedPRCurve(MetricClassTester):
+    def test_class(self) -> None:
+        num_classes = 3
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, num_classes))
+        target = RNG.integers(0, num_classes, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        metric = MulticlassBinnedPrecisionRecallCurve(
+            num_classes=num_classes, threshold=5
+        )
+        # oracle via the (separately tested) functional form
+        from torcheval_tpu.metrics.functional import (
+            multiclass_binned_precision_recall_curve,
+        )
+
+        p, r, t = multiclass_binned_precision_recall_curve(
+            input.reshape(-1, num_classes),
+            target.reshape(-1),
+            num_classes=num_classes,
+            threshold=5,
+        )
+        expected = (
+            [np.asarray(x) for x in p],
+            [np.asarray(x) for x in r],
+            np.asarray(t),
+        )
+        self.run_class_implementation_tests(
+            metric=metric,
+            state_names={"threshold", "num_tp", "num_fp", "num_fn"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=expected,
+            atol=1e-5,
+            test_merge_with_one_update=False,
+        )
+
+
+class TestBinaryNormalizedEntropyClass(MetricClassTester):
+    def test_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(float)
+        flat_i, flat_t = input.reshape(-1), target.reshape(-1)
+        ce = -(flat_t * np.log(flat_i) + (1 - flat_t) * np.log(1 - flat_i)).mean()
+        p = flat_t.mean()
+        baseline = -p * np.log(p) - (1 - p) * np.log(1 - p)
+        self.run_class_implementation_tests(
+            metric=BinaryNormalizedEntropy(),
+            state_names={"total_entropy", "num_examples", "num_positive"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.asarray([ce / baseline], dtype=np.float32),
+            atol=1e-4,
+            rtol=1e-3,
+            test_merge_with_one_update=False,
+        )
+
+    def test_empty_compute(self) -> None:
+        self.assertEqual(np.asarray(BinaryNormalizedEntropy().compute()).shape, (0,))
+
+    def test_num_tasks_check(self) -> None:
+        with self.assertRaisesRegex(ValueError, "num_tasks"):
+            BinaryNormalizedEntropy(num_tasks=0)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,66 @@
+"""Class-metric protocol tests for confusion matrices."""
+
+import numpy as np
+from sklearn.metrics import confusion_matrix as sk_cm
+
+from torcheval_tpu.metrics import BinaryConfusionMatrix, MulticlassConfusionMatrix
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(17)
+NUM_CLASSES = 4
+INPUT = RNG.integers(0, NUM_CLASSES, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+TARGET = RNG.integers(0, NUM_CLASSES, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+
+
+class TestMulticlassConfusionMatrix(MetricClassTester):
+    def test_confusion_matrix_class(self) -> None:
+        expected = sk_cm(
+            TARGET.reshape(-1), INPUT.reshape(-1), labels=range(NUM_CLASSES)
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassConfusionMatrix(NUM_CLASSES),
+            state_names={"confusion_matrix"},
+            update_kwargs={"input": list(INPUT), "target": list(TARGET)},
+            compute_result=expected.astype(np.int32),
+        )
+
+    def test_normalized_method(self) -> None:
+        metric = MulticlassConfusionMatrix(NUM_CLASSES)
+        metric.update(INPUT[0], TARGET[0])
+        np.testing.assert_allclose(
+            np.asarray(metric.normalized("all")),
+            sk_cm(
+                TARGET[0], INPUT[0], labels=range(NUM_CLASSES), normalize="all"
+            ),
+            rtol=1e-5,
+        )
+        # state unchanged
+        np.testing.assert_array_equal(
+            np.asarray(metric.compute()),
+            sk_cm(TARGET[0], INPUT[0], labels=range(NUM_CLASSES)),
+        )
+
+
+class TestBinaryConfusionMatrix(MetricClassTester):
+    def test_binary_confusion_matrix_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        expected = sk_cm(
+            target.reshape(-1), (input >= 0.5).astype(int).reshape(-1), labels=[0, 1]
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryConfusionMatrix(),
+            state_names={"confusion_matrix"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=expected.astype(np.int32),
+        )
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

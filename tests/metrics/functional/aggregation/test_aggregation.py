@@ -1,0 +1,49 @@
+"""Functional sum / mean / throughput — reference docstring examples."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics.functional import mean, sum as te_sum, throughput
+
+
+class TestSum(unittest.TestCase):
+    def test_values(self) -> None:
+        np.testing.assert_allclose(np.asarray(te_sum(np.asarray([2, 3]))), 5.0)
+        np.testing.assert_allclose(
+            np.asarray(te_sum(np.asarray([2, 3]), np.asarray([0.1, 0.6]))), 2.0
+        )
+        np.testing.assert_allclose(np.asarray(te_sum(np.asarray([2, 3]), 0.5)), 2.5)
+        np.testing.assert_allclose(np.asarray(te_sum(np.asarray([2, 3]), 2)), 10.0)
+
+    def test_bad_weight(self) -> None:
+        with self.assertRaisesRegex(ValueError, "Weight must be"):
+            te_sum(np.asarray([2, 3]), np.asarray([1.0, 2.0, 3.0]))
+
+
+class TestMean(unittest.TestCase):
+    def test_values(self) -> None:
+        np.testing.assert_allclose(np.asarray(mean(np.asarray([2, 3]))), 2.5)
+        np.testing.assert_allclose(
+            np.asarray(mean(np.asarray([2.0, 3.0]), np.asarray([0.2, 0.8]))), 2.8
+        )
+        np.testing.assert_allclose(np.asarray(mean(np.asarray([2, 3]), 0.5)), 2.5)
+
+    def test_bad_weight(self) -> None:
+        with self.assertRaisesRegex(ValueError, "Weight must be"):
+            mean(np.asarray([2, 3]), np.asarray([1.0, 2.0, 3.0]))
+
+
+class TestThroughput(unittest.TestCase):
+    def test_values(self) -> None:
+        np.testing.assert_allclose(np.asarray(throughput(64, 2.0)), 32.0)
+
+    def test_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "non-negative"):
+            throughput(-1, 1.0)
+        with self.assertRaisesRegex(ValueError, "positive number"):
+            throughput(1, 0.0)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,59 @@
+"""Functional binary normalized entropy — reference docstring examples
+(reference ``binary_normalized_entropy.py:29-52``)."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics.functional import binary_normalized_entropy
+
+
+class TestBinaryNormalizedEntropy(unittest.TestCase):
+    def test_prob_input(self) -> None:
+        input = np.asarray([0.2, 0.3])
+        target = np.asarray([1.0, 0.0])
+        np.testing.assert_allclose(
+            np.asarray(binary_normalized_entropy(input, target)), 1.4183, atol=1e-4
+        )
+
+    def test_weighted(self) -> None:
+        input = np.asarray([0.2, 0.3])
+        target = np.asarray([1.0, 0.0])
+        weight = np.asarray([5.0, 1.0])
+        np.testing.assert_allclose(
+            np.asarray(binary_normalized_entropy(input, target, weight=weight)),
+            3.1087,
+            atol=1e-4,
+        )
+
+    def test_logit_input(self) -> None:
+        input = np.asarray([-1.3863, -0.8473])
+        target = np.asarray([1.0, 0.0])
+        np.testing.assert_allclose(
+            np.asarray(binary_normalized_entropy(input, target, from_logits=True)),
+            1.4183,
+            atol=1e-4,
+        )
+
+    def test_multitask(self) -> None:
+        input = np.asarray([[0.2, 0.3], [0.5, 0.1]])
+        target = np.asarray([[1.0, 0.0], [0.0, 1.0]])
+        np.testing.assert_allclose(
+            np.asarray(
+                binary_normalized_entropy(input, target, num_tasks=2)
+            ),
+            [1.4183, 2.1610],
+            atol=1e-4,
+        )
+
+    def test_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "different from `target`"):
+            binary_normalized_entropy(np.zeros(3), np.zeros(4))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            binary_normalized_entropy(np.zeros((2, 2)), np.zeros((2, 2)))
+        with self.assertRaisesRegex(ValueError, "should be probability"):
+            binary_normalized_entropy(np.asarray([1.5, 0.2]), np.asarray([1.0, 0.0]))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,80 @@
+"""Functional binned PR curves — reference docstring examples
+(reference ``binned_precision_recall_curve.py``)."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics.functional import (
+    binary_binned_precision_recall_curve,
+    multiclass_binned_precision_recall_curve,
+)
+
+
+class TestBinaryBinned(unittest.TestCase):
+    def test_list_threshold(self) -> None:
+        input = np.asarray([0.2, 0.8, 0.5, 0.9])
+        target = np.asarray([0, 1, 0, 1])
+        precision, recall, thresh = binary_binned_precision_recall_curve(
+            input, target, threshold=[0.0, 0.5, 1.0]
+        )
+        # t=0: TP=2 FP=2; t=0.5: TP=2 FP=1; t=1.0: TP=0 FP=0 (precision->1.0)
+        np.testing.assert_allclose(
+            np.asarray(precision), [0.5, 2 / 3, 1.0, 1.0], rtol=1e-5
+        )
+        np.testing.assert_allclose(np.asarray(recall), [1.0, 1.0, 0.0, 0.0], rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(thresh), [0.0, 0.5, 1.0])
+
+    def test_int_threshold_is_linspace(self) -> None:
+        input = np.asarray([0.2, 0.8, 0.5, 0.9])
+        target = np.asarray([0, 1, 0, 1])
+        _, _, thresh = binary_binned_precision_recall_curve(input, target, threshold=5)
+        np.testing.assert_allclose(np.asarray(thresh), np.linspace(0, 1, 5))
+
+    def test_param_checks(self) -> None:
+        i, t = np.asarray([0.5]), np.asarray([1])
+        with self.assertRaisesRegex(ValueError, "sorted"):
+            binary_binned_precision_recall_curve(i, t, threshold=[0.5, 0.2])
+        with self.assertRaisesRegex(ValueError, "range of \\[0, 1\\]"):
+            binary_binned_precision_recall_curve(i, t, threshold=[0.5, 1.7])
+
+
+class TestMulticlassBinned(unittest.TestCase):
+    def test_reference_example(self) -> None:
+        # Reference docstring (binned_precision_recall_curve.py:~75-95)
+        input = np.asarray(
+            [
+                [0.1, 0.1, 0.1, 0.1],
+                [0.5, 0.5, 0.5, 0.5],
+                [0.7, 0.7, 0.7, 0.7],
+                [0.8, 0.8, 0.8, 0.8],
+            ]
+        )
+        target = np.asarray([0, 1, 2, 3])
+        precision, recall, thresh = multiclass_binned_precision_recall_curve(
+            input, target, num_classes=4, threshold=5
+        )
+        expected_precision = [
+            [0.25, 0.0, 0.0, 0.0, 1.0, 1.0],
+            [0.25, 1 / 3, 1 / 3, 0.0, 1.0, 1.0],
+            [0.25, 1 / 3, 1 / 3, 0.0, 1.0, 1.0],
+            [0.25, 1 / 3, 1 / 3, 1.0, 1.0, 1.0],
+        ]
+        expected_recall = [
+            [1.0, 0.0, 0.0, 0.0, 0.0, 0.0],
+            [1.0, 1.0, 1.0, 0.0, 0.0, 0.0],
+            [1.0, 1.0, 1.0, 0.0, 0.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0, 0.0],
+        ]
+        for c in range(4):
+            np.testing.assert_allclose(
+                np.asarray(precision[c]), expected_precision[c], rtol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(recall[c]), expected_recall[c], rtol=1e-5
+            )
+        np.testing.assert_allclose(np.asarray(thresh), np.linspace(0, 1, 5))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,66 @@
+"""Functional confusion matrix vs sklearn oracle."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import confusion_matrix as sk_cm
+
+from torcheval_tpu.metrics.functional import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+)
+
+RNG = np.random.default_rng(11)
+NUM_CLASSES = 5
+INPUT = RNG.integers(0, NUM_CLASSES, (200,))
+TARGET = RNG.integers(0, NUM_CLASSES, (200,))
+
+
+class TestConfusionMatrix(unittest.TestCase):
+    def test_multiclass(self) -> None:
+        np.testing.assert_array_equal(
+            np.asarray(multiclass_confusion_matrix(INPUT, TARGET, NUM_CLASSES)),
+            sk_cm(TARGET, INPUT, labels=range(NUM_CLASSES)),
+        )
+
+    def test_multiclass_normalize(self) -> None:
+        for normalize in ("pred", "true", "all"):
+            got = np.asarray(
+                multiclass_confusion_matrix(
+                    INPUT, TARGET, NUM_CLASSES, normalize=normalize
+                )
+            )
+            want = sk_cm(
+                TARGET, INPUT, labels=range(NUM_CLASSES), normalize=normalize
+            )
+            np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=normalize)
+
+    def test_score_input(self) -> None:
+        scores = RNG.normal(size=(64, NUM_CLASSES))
+        target = RNG.integers(0, NUM_CLASSES, (64,))
+        np.testing.assert_array_equal(
+            np.asarray(multiclass_confusion_matrix(scores, target, NUM_CLASSES)),
+            sk_cm(target, scores.argmax(1), labels=range(NUM_CLASSES)),
+        )
+
+    def test_binary(self) -> None:
+        input = RNG.random(64)
+        target = RNG.integers(0, 2, (64,))
+        np.testing.assert_array_equal(
+            np.asarray(binary_confusion_matrix(input, target)),
+            sk_cm(target, (input >= 0.5).astype(int), labels=[0, 1]),
+        )
+
+    def test_param_and_value_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "at least two classes"):
+            multiclass_confusion_matrix(INPUT, TARGET, 1)
+        with self.assertRaisesRegex(ValueError, "normalize must be one of"):
+            multiclass_confusion_matrix(INPUT, TARGET, NUM_CLASSES, normalize="x")
+        with self.assertRaisesRegex(ValueError, "strictly greater than max target"):
+            multiclass_confusion_matrix(np.asarray([0, 1]), np.asarray([0, 9]), 5)
+        with self.assertRaisesRegex(ValueError, "strictly greater than max"):
+            multiclass_confusion_matrix(np.asarray([0, 9]), np.asarray([0, 1]), 5)
+
+
+if __name__ == "__main__":
+    unittest.main()

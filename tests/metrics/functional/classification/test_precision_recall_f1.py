@@ -1,0 +1,140 @@
+"""Functional precision/recall/F1 vs sklearn oracle — parity with reference
+``tests/metrics/functional/classification/test_{precision,recall,f1_score}.py``."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import f1_score as sk_f1
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from torcheval_tpu.metrics.functional import (
+    binary_f1_score,
+    binary_precision,
+    binary_recall,
+    multiclass_f1_score,
+    multiclass_precision,
+    multiclass_recall,
+)
+
+RNG = np.random.default_rng(7)
+NUM_CLASSES = 4
+INPUT = RNG.integers(0, NUM_CLASSES, (128,))
+TARGET = RNG.integers(0, NUM_CLASSES, (128,))
+BIN_INPUT = RNG.random(128)
+BIN_TARGET = RNG.integers(0, 2, (128,))
+
+
+class TestPrecision(unittest.TestCase):
+    def test_binary(self) -> None:
+        pred = (BIN_INPUT >= 0.5).astype(int)
+        np.testing.assert_allclose(
+            np.asarray(binary_precision(BIN_INPUT, BIN_TARGET)),
+            sk_precision(BIN_TARGET, pred),
+            rtol=1e-5,
+        )
+
+    def test_multiclass_averages(self) -> None:
+        for average in ("micro", "macro", "weighted", None):
+            got = np.asarray(
+                multiclass_precision(
+                    INPUT, TARGET, average=average, num_classes=NUM_CLASSES
+                )
+            )
+            want = sk_precision(
+                TARGET, INPUT, average=average, labels=range(NUM_CLASSES)
+            )
+            np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=str(average))
+
+    def test_score_input(self) -> None:
+        scores = RNG.normal(size=(64, NUM_CLASSES))
+        target = RNG.integers(0, NUM_CLASSES, (64,))
+        np.testing.assert_allclose(
+            np.asarray(
+                multiclass_precision(
+                    scores, target, average="macro", num_classes=NUM_CLASSES
+                )
+            ),
+            sk_precision(
+                target,
+                scores.argmax(1),
+                average="macro",
+                labels=range(NUM_CLASSES),
+            ),
+            rtol=1e-5,
+        )
+
+    def test_param_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "`average` was not"):
+            multiclass_precision(INPUT, TARGET, average="bogus")
+        with self.assertRaisesRegex(ValueError, "num_classes should be"):
+            multiclass_precision(INPUT, TARGET, average="macro")
+
+
+class TestRecall(unittest.TestCase):
+    def test_binary(self) -> None:
+        pred = (BIN_INPUT >= 0.5).astype(int)
+        np.testing.assert_allclose(
+            np.asarray(binary_recall(BIN_INPUT, BIN_TARGET)),
+            sk_recall(BIN_TARGET, pred),
+            rtol=1e-5,
+        )
+
+    def test_binary_no_positives_warns_zero(self) -> None:
+        np.testing.assert_allclose(
+            np.asarray(binary_recall(np.ones(4), np.zeros(4, dtype=int))), 0.0
+        )
+
+    def test_multiclass_averages(self) -> None:
+        for average in ("micro", "macro", "weighted", None):
+            got = np.asarray(
+                multiclass_recall(
+                    INPUT, TARGET, average=average, num_classes=NUM_CLASSES
+                )
+            )
+            want = sk_recall(TARGET, INPUT, average=average, labels=range(NUM_CLASSES))
+            np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=str(average))
+
+    def test_macro_with_absent_class(self) -> None:
+        # Reference crashes here (unmasked num_labels, recall.py:169-180);
+        # we compute the intended statistic.
+        input = np.asarray([0, 1, 1, 0])
+        target = np.asarray([0, 1, 0, 1])
+        got = np.asarray(
+            multiclass_recall(input, target, average="macro", num_classes=3)
+        )
+        np.testing.assert_allclose(
+            got, sk_recall(target, input, average="macro", labels=[0, 1]), rtol=1e-5
+        )
+
+
+class TestF1(unittest.TestCase):
+    def test_binary(self) -> None:
+        pred = (BIN_INPUT >= 0.5).astype(int)
+        np.testing.assert_allclose(
+            np.asarray(binary_f1_score(BIN_INPUT, BIN_TARGET)),
+            sk_f1(BIN_TARGET, pred),
+            rtol=1e-5,
+        )
+
+    def test_multiclass_averages(self) -> None:
+        for average in ("micro", "macro", "weighted", None):
+            got = np.asarray(
+                multiclass_f1_score(
+                    INPUT, TARGET, average=average, num_classes=NUM_CLASSES
+                )
+            )
+            want = sk_f1(TARGET, INPUT, average=average, labels=range(NUM_CLASSES))
+            np.testing.assert_allclose(
+                got, want, rtol=1e-5, atol=1e-7, err_msg=str(average)
+            )
+
+    def test_input_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "same first dimension"):
+            multiclass_f1_score(np.zeros(3), np.zeros(4))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            binary_f1_score(np.zeros((2, 2)), np.zeros((2, 2)))
+
+
+if __name__ == "__main__":
+    unittest.main()

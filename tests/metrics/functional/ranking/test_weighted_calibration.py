@@ -1,0 +1,47 @@
+"""Functional weighted calibration."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics.functional import weighted_calibration
+
+
+class TestWeightedCalibration(unittest.TestCase):
+    def test_unweighted(self) -> None:
+        input = np.asarray([0.8, 0.4, 0.3, 0.8, 0.7, 0.6])
+        target = np.asarray([1, 1, 0, 0, 1, 0])
+        np.testing.assert_allclose(
+            np.asarray(weighted_calibration(input, target)),
+            input.sum() / target.sum(),
+            rtol=1e-5,
+        )
+
+    def test_weighted(self) -> None:
+        input = np.asarray([0.8, 0.4])
+        target = np.asarray([1.0, 1.0])
+        weight = np.asarray([0.5, 1.0])
+        np.testing.assert_allclose(
+            np.asarray(weighted_calibration(input, target, weight)),
+            (0.4 + 0.4) / 1.5,
+            rtol=1e-5,
+        )
+
+    def test_multitask(self) -> None:
+        input = np.asarray([[0.8, 0.4], [0.8, 0.7]])
+        target = np.asarray([[1.0, 1.0], [0.0, 1.0]])
+        np.testing.assert_allclose(
+            np.asarray(weighted_calibration(input, target, num_tasks=2)),
+            [1.2 / 2.0, 1.5 / 1.0],
+            rtol=1e-5,
+        )
+
+    def test_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "different from `target`"):
+            weighted_calibration(np.zeros(3), np.zeros(4))
+        with self.assertRaisesRegex(ValueError, "Weight must be"):
+            weighted_calibration(np.zeros(3), np.zeros(3), np.ones(4))
+
+
+if __name__ == "__main__":
+    unittest.main()

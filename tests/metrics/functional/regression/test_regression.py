@@ -1,0 +1,96 @@
+"""Functional MSE / R² vs sklearn oracle."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import mean_squared_error as sk_mse
+from sklearn.metrics import r2_score as sk_r2
+
+from torcheval_tpu.metrics.functional import mean_squared_error, r2_score
+
+RNG = np.random.default_rng(3)
+
+
+class TestMeanSquaredError(unittest.TestCase):
+    def test_1d(self) -> None:
+        input, target = RNG.random(50), RNG.random(50)
+        np.testing.assert_allclose(
+            np.asarray(mean_squared_error(input, target)),
+            sk_mse(target, input),
+            rtol=1e-5,
+        )
+
+    def test_2d_multioutput(self) -> None:
+        input, target = RNG.random((50, 3)), RNG.random((50, 3))
+        np.testing.assert_allclose(
+            np.asarray(mean_squared_error(input, target, multioutput="raw_values")),
+            sk_mse(target, input, multioutput="raw_values"),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(mean_squared_error(input, target)),
+            sk_mse(target, input),
+            rtol=1e-5,
+        )
+
+    def test_sample_weight(self) -> None:
+        input, target, w = RNG.random(50), RNG.random(50), RNG.random(50)
+        np.testing.assert_allclose(
+            np.asarray(mean_squared_error(input, target, sample_weight=w)),
+            sk_mse(target, input, sample_weight=w),
+            rtol=1e-5,
+        )
+
+    def test_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "`multioutput` must be"):
+            mean_squared_error(np.zeros(3), np.zeros(3), multioutput="x")
+        with self.assertRaisesRegex(ValueError, "same size"):
+            mean_squared_error(np.zeros(3), np.zeros(4))
+        with self.assertRaisesRegex(ValueError, "should be 1D or 2D"):
+            mean_squared_error(np.zeros((2, 2, 2)), np.zeros((2, 2, 2)))
+        with self.assertRaisesRegex(ValueError, "sample_weight"):
+            mean_squared_error(np.zeros(3), np.zeros(3), sample_weight=np.ones(4))
+
+
+class TestR2Score(unittest.TestCase):
+    def test_1d(self) -> None:
+        input, target = RNG.random(50), RNG.random(50)
+        np.testing.assert_allclose(
+            np.asarray(r2_score(input, target)), sk_r2(target, input), rtol=1e-4
+        )
+
+    def test_multioutput(self) -> None:
+        input, target = RNG.random((50, 3)), RNG.random((50, 3))
+        for mo in ("raw_values", "uniform_average", "variance_weighted"):
+            np.testing.assert_allclose(
+                np.asarray(r2_score(input, target, multioutput=mo)),
+                sk_r2(target, input, multioutput=mo),
+                rtol=1e-4,
+                err_msg=mo,
+            )
+
+    def test_adjusted(self) -> None:
+        # Reference docstring example (r2_score.py:63-66)
+        input = np.asarray([1.2, 2.5, 3.6, 4.5, 6])
+        target = np.asarray([1, 2, 3, 4, 5])
+        np.testing.assert_allclose(
+            np.asarray(
+                r2_score(input, target, multioutput="raw_values", num_regressors=2)
+            ),
+            0.62,
+            atol=1e-3,
+        )
+
+    def test_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "`multioutput` must be"):
+            r2_score(np.zeros(3), np.zeros(3), multioutput="x")
+        with self.assertRaisesRegex(ValueError, "num_regressors"):
+            r2_score(np.zeros(3), np.zeros(3), num_regressors=-1)
+        with self.assertRaisesRegex(ValueError, "at least two samples"):
+            r2_score(np.zeros(1), np.zeros(1))
+        with self.assertRaisesRegex(ValueError, "smaller than n_samples"):
+            r2_score(np.zeros(3), np.zeros(3), num_regressors=2)
+
+
+if __name__ == "__main__":
+    unittest.main()

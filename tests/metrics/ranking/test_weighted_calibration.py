@@ -1,0 +1,47 @@
+"""Class-metric protocol tests for WeightedCalibration."""
+
+import numpy as np
+
+from torcheval_tpu.metrics import WeightedCalibration
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(29)
+
+
+class TestWeightedCalibration(MetricClassTester):
+    def test_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(float)
+        self.run_class_implementation_tests(
+            metric=WeightedCalibration(),
+            state_names={"weighted_input_sum", "weighted_target_sum"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.asarray(
+                [input.sum() / target.sum()], dtype=np.float32
+            ),
+            atol=1e-5,
+            rtol=1e-4,
+        )
+
+    def test_multitask(self) -> None:
+        metric = WeightedCalibration(num_tasks=2)
+        input = np.asarray([[0.8, 0.4], [0.8, 0.7]])
+        target = np.asarray([[1.0, 1.0], [0.0, 1.0]])
+        metric.update(input, target)
+        np.testing.assert_allclose(
+            np.asarray(metric.compute()), [0.6, 1.5], rtol=1e-5
+        )
+
+    def test_num_tasks_check(self) -> None:
+        with self.assertRaisesRegex(ValueError, "num_tasks"):
+            WeightedCalibration(num_tasks=0)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -1,0 +1,74 @@
+"""Class-metric protocol tests for MSE / R²."""
+
+import numpy as np
+from sklearn.metrics import mean_squared_error as sk_mse
+from sklearn.metrics import r2_score as sk_r2
+
+from torcheval_tpu.metrics import MeanSquaredError, R2Score
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(19)
+
+
+class TestMeanSquaredError(MetricClassTester):
+    def test_mse_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        self.run_class_implementation_tests(
+            metric=MeanSquaredError(),
+            state_names={"sum_squared_error", "sum_weight"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(
+                sk_mse(target.reshape(-1), input.reshape(-1))
+            ),
+            atol=1e-6,
+        )
+
+    def test_mse_class_multioutput(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 2))
+        target = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, 2))
+        self.run_class_implementation_tests(
+            metric=MeanSquaredError(multioutput="raw_values"),
+            state_names={"sum_squared_error", "sum_weight"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=sk_mse(
+                target.reshape(-1, 2), input.reshape(-1, 2), multioutput="raw_values"
+            ).astype(np.float32),
+            atol=1e-6,
+        )
+
+
+class TestR2Score(MetricClassTester):
+    def test_r2_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        target = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE))
+        self.run_class_implementation_tests(
+            metric=R2Score(),
+            state_names={
+                "sum_squared_obs",
+                "sum_obs",
+                "sum_squared_residual",
+                "num_obs",
+            },
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(
+                sk_r2(target.reshape(-1), input.reshape(-1))
+            ),
+            atol=1e-4,
+        )
+
+    def test_r2_compute_guard(self) -> None:
+        metric = R2Score()
+        metric.update(np.asarray([1.0]), np.asarray([1.0]))
+        with self.assertRaisesRegex(ValueError, "at least two samples"):
+            metric.compute()
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -2,19 +2,53 @@
 — 30 classes + ``Metric`` + the ``functional`` namespace)."""
 
 from torcheval_tpu.metrics import functional
+from torcheval_tpu.metrics.aggregation import Cat, Max, Mean, Min, Sum, Throughput
 from torcheval_tpu.metrics.classification import (
     BinaryAccuracy,
+    BinaryBinnedPrecisionRecallCurve,
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryNormalizedEntropy,
+    BinaryPrecision,
+    BinaryRecall,
     MulticlassAccuracy,
+    MulticlassBinnedPrecisionRecallCurve,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
 )
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.metrics.ranking import WeightedCalibration
+from torcheval_tpu.metrics.regression import MeanSquaredError, R2Score
 
 __all__ = [
-    "functional",
-    "Metric",
     "BinaryAccuracy",
+    "BinaryBinnedPrecisionRecallCurve",
+    "BinaryConfusionMatrix",
+    "BinaryF1Score",
+    "BinaryNormalizedEntropy",
+    "BinaryPrecision",
+    "BinaryRecall",
+    "Cat",
+    "functional",
+    "Max",
+    "Mean",
+    "MeanSquaredError",
+    "Metric",
+    "Min",
     "MulticlassAccuracy",
+    "MulticlassBinnedPrecisionRecallCurve",
+    "MulticlassConfusionMatrix",
+    "MulticlassF1Score",
+    "MulticlassPrecision",
+    "MulticlassRecall",
     "MultilabelAccuracy",
+    "R2Score",
+    "Sum",
+    "Throughput",
     "TopKMultilabelAccuracy",
+    "WeightedCalibration",
 ]

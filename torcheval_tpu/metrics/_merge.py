@@ -1,0 +1,23 @@
+"""Shared merge helpers for counter-state metrics.
+
+Counter states merge by elementwise addition — the property that lets the
+sync toolkit reduce them with a single fused ``psum`` over the mesh axis
+instead of gathering buffers."""
+
+from typing import Iterable
+
+import jax
+
+from torcheval_tpu.metrics.metric import Metric
+
+
+def merge_add(metric: Metric, metrics: Iterable[Metric], *state_names: str) -> None:
+    """Add each named counter state of ``metrics`` into ``metric``."""
+    for other in metrics:
+        for name in state_names:
+            setattr(
+                metric,
+                name,
+                getattr(metric, name)
+                + jax.device_put(getattr(other, name), metric.device),
+            )

@@ -1,0 +1,46 @@
+"""Cat metric — parity with reference ``torcheval/metrics/aggregation/cat.py``
+(96 LoC). Buffer state: list of arrays concatenated along ``dim`` at compute;
+``_prepare_for_merge_state`` pre-concatenates so the sync wire carries one
+buffer (reference ``cat.py:93-96``)."""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+
+
+class Cat(Metric[jax.Array]):
+    """Concatenate all input arrays. Functional version is ``jnp.concatenate``
+    (reference ``cat.py:21-22``)."""
+
+    def __init__(self, *, dim: int = 0, device=None) -> None:
+        super().__init__(device=device)
+        self.dim = dim
+        self._add_state("inputs", [])
+
+    def update(self, input) -> "Cat":
+        self.inputs.append(jax.device_put(jnp.asarray(input), self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        """Concatenated inputs; ``jnp.zeros(0)`` when no update has been made
+        (reference ``cat.py:77-82``)."""
+        if not self.inputs:
+            return jnp.zeros(0)
+        return jnp.concatenate(self.inputs, axis=self.dim)
+
+    def merge_state(self, metrics: Iterable["Cat"]) -> "Cat":
+        for metric in metrics:
+            if metric.inputs:
+                self.inputs.append(
+                    jax.device_put(
+                        jnp.concatenate(metric.inputs, axis=metric.dim), self.device
+                    )
+                )
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        if self.inputs:
+            self.inputs = [jnp.concatenate(self.inputs, axis=self.dim)]

@@ -1,0 +1,27 @@
+"""Max metric — parity with reference ``torcheval/metrics/aggregation/max.py``
+(63 LoC). State: scalar initialized to -inf; merge: pairwise max."""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+
+
+class Max(Metric[jax.Array]):
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("max", jnp.asarray(float("-inf")))
+
+    def update(self, input) -> "Max":
+        self.max = jnp.maximum(self.max, jnp.max(jnp.asarray(input)))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.max
+
+    def merge_state(self, metrics: Iterable["Max"]) -> "Max":
+        for metric in metrics:
+            self.max = jnp.maximum(self.max, jax.device_put(metric.max, self.device))
+        return self

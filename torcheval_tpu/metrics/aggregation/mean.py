@@ -1,0 +1,42 @@
+"""Mean metric — parity with reference ``torcheval/metrics/aggregation/mean.py``
+(102 LoC). State: ``weighted_sum`` + ``weights``; merge: add."""
+
+import logging
+from typing import Iterable, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.aggregation.mean import _mean_update
+from torcheval_tpu.metrics.metric import Metric
+
+_logger: logging.Logger = logging.getLogger(__name__)
+
+
+class Mean(Metric[jax.Array]):
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("weighted_sum", jnp.asarray(0.0))
+        self._add_state("weights", jnp.asarray(0.0))
+
+    def update(self, input, weight: Union[float, int, "jax.Array"] = 1.0) -> "Mean":
+        weighted_sum, weights = _mean_update(jnp.asarray(input), weight)
+        self.weighted_sum = self.weighted_sum + weighted_sum
+        self.weights = self.weights + weights
+        return self
+
+    def compute(self) -> jax.Array:
+        """Weighted mean; warns and returns 0.0 when no update has
+        contributed (reference ``mean.py:63-71``)."""
+        if not float(self.weighted_sum):
+            _logger.warning("No calls to update() have been made - returning 0.0")
+            return jnp.asarray(0.0)
+        return self.weighted_sum / self.weights
+
+    def merge_state(self, metrics: Iterable["Mean"]) -> "Mean":
+        for metric in metrics:
+            self.weighted_sum = self.weighted_sum + jax.device_put(
+                metric.weighted_sum, self.device
+            )
+            self.weights = self.weights + jax.device_put(metric.weights, self.device)
+        return self

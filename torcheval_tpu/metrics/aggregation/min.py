@@ -1,0 +1,27 @@
+"""Min metric — parity with reference ``torcheval/metrics/aggregation/min.py``
+(63 LoC). State: scalar initialized to +inf; merge: pairwise min."""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+
+
+class Min(Metric[jax.Array]):
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("min", jnp.asarray(float("inf")))
+
+    def update(self, input) -> "Min":
+        self.min = jnp.minimum(self.min, jnp.min(jnp.asarray(input)))
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.min
+
+    def merge_state(self, metrics: Iterable["Min"]) -> "Min":
+        for metric in metrics:
+            self.min = jnp.minimum(self.min, jax.device_put(metric.min, self.device))
+        return self

@@ -1,0 +1,32 @@
+"""Sum metric — parity with reference ``torcheval/metrics/aggregation/sum.py``
+(86 LoC). State: scalar ``weighted_sum``; merge: add (→ ``psum`` on a mesh)."""
+
+from typing import Iterable, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.aggregation.sum import _sum_update
+from torcheval_tpu.metrics.metric import Metric
+
+
+class Sum(Metric[jax.Array]):
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("weighted_sum", jnp.asarray(0.0))
+
+    def update(self, input, weight: Union[float, int, "jax.Array"] = 1.0) -> "Sum":
+        self.weighted_sum = self.weighted_sum + _sum_update(
+            jnp.asarray(input), weight
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.weighted_sum
+
+    def merge_state(self, metrics: Iterable["Sum"]) -> "Sum":
+        for metric in metrics:
+            self.weighted_sum = self.weighted_sum + jax.device_put(
+                metric.weighted_sum, self.device
+            )
+        return self

@@ -1,0 +1,59 @@
+"""Throughput metric — parity with reference
+``torcheval/metrics/aggregation/throughput.py`` (108 LoC).
+
+States: ``num_total`` + ``elapsed_time_sec``; merge adds counts but takes the
+**max** elapsed time — in distributed synchronous training the slowest rank
+gates the pipeline (reference ``throughput.py:97-107``; distributed caveat
+documented at ``throughput.py:25-28``).  Update takes Python numbers
+(host wall-clock), not arrays (reference ``throughput.py:59-87``)."""
+
+import logging
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+
+_logger: logging.Logger = logging.getLogger(__name__)
+
+
+class Throughput(Metric[jax.Array]):
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("num_total", jnp.asarray(0.0))
+        self._add_state("elapsed_time_sec", jnp.asarray(0.0))
+
+    def update(self, num_processed: int, elapsed_time_sec: float) -> "Throughput":
+        if num_processed < 0:
+            raise ValueError(
+                "Expected num_processed to be a non-negative number, but "
+                f"received {num_processed}."
+            )
+        if elapsed_time_sec <= 0:
+            raise ValueError(
+                "Expected elapsed_time_sec to be a positive number, but "
+                f"received {elapsed_time_sec}."
+            )
+        self.elapsed_time_sec = self.elapsed_time_sec + elapsed_time_sec
+        self.num_total = self.num_total + num_processed
+        return self
+
+    def compute(self) -> jax.Array:
+        """Items/sec; warns and returns 0.0 before any update
+        (reference ``throughput.py:90-95``)."""
+        if not float(self.elapsed_time_sec):
+            _logger.warning("No calls to update() have been made - returning 0.0")
+            return jnp.asarray(0.0)
+        return self.num_total / self.elapsed_time_sec
+
+    def merge_state(self, metrics: Iterable["Throughput"]) -> "Throughput":
+        for metric in metrics:
+            self.num_total = self.num_total + jax.device_put(
+                metric.num_total, self.device
+            )
+            self.elapsed_time_sec = jnp.maximum(
+                self.elapsed_time_sec,
+                jax.device_put(metric.elapsed_time_sec, self.device),
+            )
+        return self

@@ -4,10 +4,44 @@ from torcheval_tpu.metrics.classification.accuracy import (
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
 )
+from torcheval_tpu.metrics.classification.binary_normalized_entropy import (
+    BinaryNormalizedEntropy,
+)
+from torcheval_tpu.metrics.classification.binned_precision_recall_curve import (
+    BinaryBinnedPrecisionRecallCurve,
+    MulticlassBinnedPrecisionRecallCurve,
+)
+from torcheval_tpu.metrics.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+)
+from torcheval_tpu.metrics.classification.f1_score import (
+    BinaryF1Score,
+    MulticlassF1Score,
+)
+from torcheval_tpu.metrics.classification.precision import (
+    BinaryPrecision,
+    MulticlassPrecision,
+)
+from torcheval_tpu.metrics.classification.recall import (
+    BinaryRecall,
+    MulticlassRecall,
+)
 
 __all__ = [
     "BinaryAccuracy",
+    "BinaryBinnedPrecisionRecallCurve",
+    "BinaryConfusionMatrix",
+    "BinaryF1Score",
+    "BinaryNormalizedEntropy",
+    "BinaryPrecision",
+    "BinaryRecall",
     "MulticlassAccuracy",
+    "MulticlassBinnedPrecisionRecallCurve",
+    "MulticlassConfusionMatrix",
+    "MulticlassF1Score",
+    "MulticlassPrecision",
+    "MulticlassRecall",
     "MultilabelAccuracy",
     "TopKMultilabelAccuracy",
 ]

@@ -1,0 +1,66 @@
+"""BinaryNormalizedEntropy metric — parity with reference
+``torcheval/metrics/classification/binary_normalized_entropy.py`` (147 LoC).
+
+States: per-task ``total_entropy`` / ``num_examples`` / ``num_positive``
+(reference ``:76-87``, float64 there — see the dtype note in the functional
+module); merge: add (reference ``:134``)."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    _accum_dtype,
+    _baseline_update,
+    _binary_normalized_entropy_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+_STATES = ("total_entropy", "num_examples", "num_positive")
+
+
+class BinaryNormalizedEntropy(Metric[jax.Array]):
+    def __init__(
+        self,
+        *,
+        from_logits: bool = False,
+        num_tasks: int = 1,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.from_logits = from_logits
+        self.num_tasks = num_tasks
+        for name in _STATES:
+            self._add_state(name, jnp.zeros(num_tasks, dtype=_accum_dtype()))
+
+    def update(self, input, target, *, weight=None) -> "BinaryNormalizedEntropy":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        if weight is not None:
+            weight = jnp.asarray(weight)
+        cross_entropy, num_positive, num_examples = _binary_normalized_entropy_update(
+            input, target, self.from_logits, self.num_tasks, weight
+        )
+        self.total_entropy = self.total_entropy + cross_entropy
+        self.num_examples = self.num_examples + num_examples
+        self.num_positive = self.num_positive + num_positive
+        return self
+
+    def compute(self) -> jax.Array:
+        """Per-task NE, or an empty array when any task saw no examples
+        (reference ``binary_normalized_entropy.py:~115-130``)."""
+        if bool(jnp.any(self.num_examples == 0.0)):
+            return jnp.zeros(0)
+        baseline_entropy = _baseline_update(self.num_positive, self.num_examples)
+        cross_entropy = self.total_entropy / self.num_examples
+        return cross_entropy / baseline_entropy
+
+    def merge_state(self, metrics: Iterable["BinaryNormalizedEntropy"]):
+        merge_add(self, metrics, *_STATES)
+        return self

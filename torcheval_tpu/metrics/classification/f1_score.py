@@ -1,0 +1,80 @@
+"""F1 metrics — parity with reference
+``torcheval/metrics/classification/f1_score.py`` (218 LoC)."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.f1_score import (
+    _binary_f1_score_update,
+    _f1_score_compute,
+    _f1_score_param_check,
+    _f1_score_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+_STATES = ("num_tp", "num_label", "num_prediction")
+
+
+class MulticlassF1Score(Metric[jax.Array]):
+    """States: ``num_tp`` / ``num_label`` / ``num_prediction`` — scalars for
+    micro, per-class vectors otherwise (reference ``f1_score.py:91-114``);
+    merge: add (reference ``:149``)."""
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _f1_score_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        if average == "micro":
+            for name in _STATES:
+                self._add_state(name, jnp.asarray(0.0))
+        else:
+            for name in _STATES:
+                self._add_state(name, jnp.zeros(num_classes))
+
+    def update(self, input, target) -> "MulticlassF1Score":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        num_tp, num_label, num_prediction = _f1_score_update(
+            input, target, self.num_classes, self.average
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_label = self.num_label + num_label
+        self.num_prediction = self.num_prediction + num_prediction
+        return self
+
+    def compute(self) -> jax.Array:
+        return _f1_score_compute(
+            self.num_tp, self.num_label, self.num_prediction, self.average
+        )
+
+    def merge_state(self, metrics: Iterable["MulticlassF1Score"]):
+        merge_add(self, metrics, *_STATES)
+        return self
+
+
+class BinaryF1Score(MulticlassF1Score):
+    """Binary F1 over thresholded predictions
+    (reference ``f1_score.py:157-218``)."""
+
+    def __init__(self, *, threshold: float = 0.5, device=None) -> None:
+        super().__init__(average="micro", device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryF1Score":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        num_tp, num_label, num_prediction = _binary_f1_score_update(
+            input, target, self.threshold
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_label = self.num_label + num_label
+        self.num_prediction = self.num_prediction + num_prediction
+        return self

@@ -1,0 +1,80 @@
+"""Precision metrics — parity with reference
+``torcheval/metrics/classification/precision.py`` (214 LoC)."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.precision import (
+    _binary_precision_update,
+    _precision_compute,
+    _precision_param_check,
+    _precision_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+_STATES = ("num_tp", "num_fp", "num_label")
+
+
+class MulticlassPrecision(Metric[jax.Array]):
+    """States: ``num_tp`` / ``num_fp`` / ``num_label`` — scalars for micro,
+    per-class vectors otherwise (reference ``precision.py:89-110``); merge:
+    add (reference ``:147``)."""
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _precision_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        if average == "micro":
+            for name in _STATES:
+                self._add_state(name, jnp.asarray(0.0))
+        else:
+            for name in _STATES:
+                self._add_state(name, jnp.zeros(num_classes))
+
+    def update(self, input, target) -> "MulticlassPrecision":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        num_tp, num_fp, num_label = _precision_update(
+            input, target, self.num_classes, self.average
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_fp = self.num_fp + num_fp
+        self.num_label = self.num_label + num_label
+        return self
+
+    def compute(self) -> jax.Array:
+        return _precision_compute(
+            self.num_tp, self.num_fp, self.num_label, self.average
+        )
+
+    def merge_state(self, metrics: Iterable["MulticlassPrecision"]):
+        merge_add(self, metrics, *_STATES)
+        return self
+
+
+class BinaryPrecision(MulticlassPrecision):
+    """Binary precision over thresholded predictions
+    (reference ``precision.py:155-214``)."""
+
+    def __init__(self, *, threshold: float = 0.5, device=None) -> None:
+        super().__init__(num_classes=2, device=device)
+        self.threshold = threshold
+
+    def update(self, input, target) -> "BinaryPrecision":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        num_tp, num_fp, num_label = _binary_precision_update(
+            input, target, self.threshold
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_fp = self.num_fp + num_fp
+        self.num_label = self.num_label + num_label
+        return self

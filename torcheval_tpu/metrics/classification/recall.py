@@ -1,0 +1,86 @@
+"""Recall metrics — parity with reference
+``torcheval/metrics/classification/recall.py`` (245 LoC)."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.recall import (
+    _binary_recall_compute,
+    _binary_recall_update,
+    _recall_compute,
+    _recall_param_check,
+    _recall_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class BinaryRecall(Metric[jax.Array]):
+    """States: ``num_tp`` / ``num_true_labels``
+    (reference ``recall.py:26-110``); merge: add."""
+
+    def __init__(self, *, threshold: float = 0.5, device=None) -> None:
+        super().__init__(device=device)
+        self.threshold = threshold
+        self._add_state("num_tp", jnp.asarray(0.0))
+        self._add_state("num_true_labels", jnp.asarray(0.0))
+
+    def update(self, input, target) -> "BinaryRecall":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        num_tp, num_true_labels = _binary_recall_update(input, target, self.threshold)
+        self.num_tp = self.num_tp + num_tp
+        self.num_true_labels = self.num_true_labels + num_true_labels
+        return self
+
+    def compute(self) -> jax.Array:
+        return _binary_recall_compute(self.num_tp, self.num_true_labels)
+
+    def merge_state(self, metrics: Iterable["BinaryRecall"]):
+        merge_add(self, metrics, "num_tp", "num_true_labels")
+        return self
+
+
+class MulticlassRecall(Metric[jax.Array]):
+    """States: ``num_tp`` / ``num_labels`` / ``num_predictions``
+    (reference ``recall.py:113-245``); merge: add (reference ``:240``)."""
+
+    _STATES = ("num_tp", "num_labels", "num_predictions")
+
+    def __init__(
+        self,
+        *,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "micro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _recall_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        if average == "micro":
+            for name in self._STATES:
+                self._add_state(name, jnp.asarray(0.0))
+        else:
+            for name in self._STATES:
+                self._add_state(name, jnp.zeros(num_classes))
+
+    def update(self, input, target) -> "MulticlassRecall":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        num_tp, num_labels, num_predictions = _recall_update(
+            input, target, self.num_classes, self.average
+        )
+        self.num_tp = self.num_tp + num_tp
+        self.num_labels = self.num_labels + num_labels
+        self.num_predictions = self.num_predictions + num_predictions
+        return self
+
+    def compute(self) -> jax.Array:
+        return _recall_compute(
+            self.num_tp, self.num_labels, self.num_predictions, self.average
+        )
+
+    def merge_state(self, metrics: Iterable["MulticlassRecall"]):
+        merge_add(self, metrics, *self._STATES)
+        return self

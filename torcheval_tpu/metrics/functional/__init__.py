@@ -1,16 +1,54 @@
 """Stateless functional metrics (reference
 ``torcheval/metrics/functional/__init__.py:38-68`` — 28 public functions)."""
 
+from torcheval_tpu.metrics.functional.aggregation import (  # noqa: A004
+    mean,
+    sum,
+    throughput,
+)
 from torcheval_tpu.metrics.functional.classification import (
     binary_accuracy,
+    binary_binned_precision_recall_curve,
+    binary_confusion_matrix,
+    binary_f1_score,
+    binary_normalized_entropy,
+    binary_precision,
+    binary_recall,
     multiclass_accuracy,
+    multiclass_binned_precision_recall_curve,
+    multiclass_confusion_matrix,
+    multiclass_f1_score,
+    multiclass_precision,
+    multiclass_recall,
     multilabel_accuracy,
     topk_multilabel_accuracy,
+)
+from torcheval_tpu.metrics.functional.ranking import weighted_calibration
+from torcheval_tpu.metrics.functional.regression import (
+    mean_squared_error,
+    r2_score,
 )
 
 __all__ = [
     "binary_accuracy",
+    "binary_binned_precision_recall_curve",
+    "binary_confusion_matrix",
+    "binary_f1_score",
+    "binary_normalized_entropy",
+    "binary_precision",
+    "binary_recall",
+    "mean",
+    "mean_squared_error",
     "multiclass_accuracy",
+    "multiclass_binned_precision_recall_curve",
+    "multiclass_confusion_matrix",
+    "multiclass_f1_score",
+    "multiclass_precision",
+    "multiclass_recall",
     "multilabel_accuracy",
+    "r2_score",
+    "sum",
+    "throughput",
     "topk_multilabel_accuracy",
+    "weighted_calibration",
 ]

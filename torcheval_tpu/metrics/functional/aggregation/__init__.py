@@ -1,0 +1,5 @@
+from torcheval_tpu.metrics.functional.aggregation.mean import mean
+from torcheval_tpu.metrics.functional.aggregation.sum import sum  # noqa: A004
+from torcheval_tpu.metrics.functional.aggregation.throughput import throughput
+
+__all__ = ["mean", "sum", "throughput"]

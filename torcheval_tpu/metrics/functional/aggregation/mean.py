@@ -1,0 +1,37 @@
+"""Weighted mean — parity with reference
+``torcheval/metrics/functional/aggregation/mean.py`` (65 LoC)."""
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mean(input, weight: Union[float, int, "jax.Array"] = 1.0) -> jax.Array:
+    """``sum(weight * input) / sum(weight)`` (reference ``mean.py:44-58``)."""
+    weighted_sum, weights = _mean_update(jnp.asarray(input), weight)
+    return weighted_sum / weights
+
+
+def _mean_update(input: jax.Array, weight) -> Tuple[jax.Array, jax.Array]:
+    if isinstance(weight, (float, int)):
+        return _scalar_weighted(input, float(weight))
+    if isinstance(weight, (jax.Array, jnp.ndarray, np.ndarray)) and input.shape == jnp.shape(
+        weight
+    ):
+        return _array_weighted(input, weight)
+    raise ValueError(
+        "Weight must be either a float value or a tensor that matches the "
+        f"input tensor size. Got {weight} instead."
+    )
+
+
+@jax.jit
+def _scalar_weighted(input: jax.Array, weight: float) -> Tuple[jax.Array, jax.Array]:
+    return weight * jnp.sum(input), jnp.asarray(weight * input.size)
+
+
+@jax.jit
+def _array_weighted(input: jax.Array, weight: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    return jnp.sum(weight * input), jnp.sum(weight)

@@ -1,0 +1,31 @@
+"""Weighted sum — parity with reference
+``torcheval/metrics/functional/aggregation/sum.py`` (56 LoC)."""
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sum(input, weight: Union[float, int, "jax.Array"] = 1.0) -> jax.Array:  # noqa: A001
+    """Weighted sum of ``input``; scalar or same-size array ``weight``
+    (reference ``sum.py:43-56``)."""
+    return _sum_update(jnp.asarray(input), weight)
+
+
+def _sum_update(input: jax.Array, weight) -> jax.Array:
+    if isinstance(weight, (float, int)) or (
+        isinstance(weight, (jax.Array, jnp.ndarray, np.ndarray))
+        and input.shape == jnp.shape(weight)
+    ):
+        return _weighted_sum(input, weight)
+    raise ValueError(
+        "Weight must be either a float value or an int value or a tensor "
+        f"that matches the input tensor size. Got {weight} instead."
+    )
+
+
+@jax.jit
+def _weighted_sum(input: jax.Array, weight) -> jax.Array:
+    return (input * weight).sum()

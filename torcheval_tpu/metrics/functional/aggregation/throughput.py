@@ -1,0 +1,28 @@
+"""Throughput — parity with reference
+``torcheval/metrics/functional/aggregation/throughput.py`` (47 LoC).
+
+Host-time semantics: inputs are Python numbers, not arrays — elapsed time is
+wall-clock measured outside the device (reference ``throughput.py:24-47``;
+SURVEY §7 hard part 6)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def throughput(num_processed: int = 0, elapsed_time_sec: float = 0.0) -> jax.Array:
+    """Items processed per second (reference ``throughput.py:24-47``)."""
+    return _throughput_compute(num_processed, elapsed_time_sec)
+
+
+def _throughput_compute(num_processed: int, elapsed_time_sec: float) -> jax.Array:
+    if num_processed < 0:
+        raise ValueError(
+            "Expected num_processed to be a non-negative number, but "
+            f"received {num_processed}."
+        )
+    if elapsed_time_sec <= 0:
+        raise ValueError(
+            "Expected elapsed_time_sec to be a positive number, but "
+            f"received {elapsed_time_sec}."
+        )
+    return jnp.asarray(num_processed / elapsed_time_sec)

@@ -1,0 +1,180 @@
+"""F1 score — parity with reference
+``torcheval/metrics/functional/classification/f1_score.py`` (271 LoC).
+
+Sufficient statistics: ``num_tp`` / ``num_label`` / ``num_prediction``
+(scalars for micro, per-class scatter-add vectors otherwise; reference jit
+kernel at ``f1_score.py:164-230``).  Macro/weighted masking is computed
+shape-stably (masked arithmetic instead of boolean indexing)."""
+
+import logging
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.precision import (
+    _check_index_range,
+)
+
+_logger = logging.getLogger(__name__)
+
+
+def binary_f1_score(input, target, *, threshold: float = 0.5) -> jax.Array:
+    """Binary F1 = 2·TP / (#labels + #predictions) after thresholding
+    (reference ``f1_score.py:15-48,118-132``)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    num_tp, num_label, num_prediction = _binary_f1_score_update(
+        input, target, threshold
+    )
+    return _f1_score_compute(num_tp, num_label, num_prediction, "micro")
+
+
+def multiclass_f1_score(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "micro",
+) -> jax.Array:
+    """Multiclass F1 with micro/macro/weighted/None averaging
+    (reference ``f1_score.py:51-115``)."""
+    _f1_score_param_check(num_classes, average)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    num_tp, num_label, num_prediction = _f1_score_update(
+        input, target, num_classes, average
+    )
+    return _f1_score_compute(num_tp, num_label, num_prediction, average)
+
+
+def _f1_score_update(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _f1_score_update_input_check(input, target, num_classes)
+    if average != "micro":
+        _check_index_range(target, num_classes, "target")
+        if input.ndim == 1:
+            _check_index_range(input, num_classes, "input")
+    return _f1_score_update_kernel(input, target, num_classes, average)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _f1_score_update_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    if input.ndim == 2:
+        input = jnp.argmax(input, axis=1)
+    if average == "micro":
+        num_tp = (input == target).sum()
+        num_label = jnp.asarray(target.shape[0])
+        return num_tp, num_label, num_label
+    correct = (input == target).astype(jnp.int32)
+    num_label = jnp.zeros(num_classes, jnp.int32).at[target].add(1)
+    num_prediction = jnp.zeros(num_classes, jnp.int32).at[input].add(1)
+    num_tp = jnp.zeros(num_classes, jnp.int32).at[target].add(correct)
+    return num_tp, num_label, num_prediction
+
+
+def _f1_score_compute(
+    num_tp: jax.Array,
+    num_label: jax.Array,
+    num_prediction: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    if num_label.ndim and bool(jnp.any(num_label == 0)):
+        _logger.warning(
+            "Warning: Some classes do not exist in the target. F1 scores for "
+            "these classes will be cast to zeros."
+        )
+    return _f1_score_compute_kernel(num_tp, num_label, num_prediction, average)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def _f1_score_compute_kernel(
+    num_tp: jax.Array,
+    num_label: jax.Array,
+    num_prediction: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    precision = num_tp / num_prediction
+    recall = num_tp / num_label
+    f1 = jnp.nan_to_num(2 * precision * recall / (precision + recall))
+    if average == "micro" or average is None:
+        return f1
+    mask = (num_label != 0) | (num_prediction != 0)
+    if average == "macro":
+        return jnp.sum(jnp.where(mask, f1, 0.0)) / jnp.sum(mask)
+    # weighted
+    return jnp.sum(f1 * num_label) / jnp.sum(num_label)
+
+
+def _binary_f1_score_update(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _binary_f1_score_update_input_check(input, target)
+    return _binary_f1_score_update_kernel(input, target, threshold)
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_f1_score_update_kernel(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    pred = jnp.where(input < threshold, 0, 1)
+    num_tp = jnp.sum(pred * target)
+    num_label = jnp.sum(target)
+    num_prediction = jnp.sum(pred)
+    return num_tp, num_label, num_prediction
+
+
+def _f1_score_param_check(
+    num_classes: Optional[int], average: Optional[str]
+) -> None:
+    average_options = ("micro", "macro", "weighted", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, got {average}."
+        )
+    if average != "micro" and (num_classes is None or num_classes <= 0):
+        raise ValueError(
+            f"num_classes should be a positive number when average={average}, "
+            f"got num_classes={num_classes}."
+        )
+
+
+def _f1_score_update_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not input.ndim == 1 and not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "input should have shape of (num_sample,) or (num_sample, num_classes), "
+            f"got {input.shape}."
+        )
+
+
+def _binary_f1_score_update_input_check(input: jax.Array, target: jax.Array) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same dimensions, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )

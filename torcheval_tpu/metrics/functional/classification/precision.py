@@ -1,0 +1,195 @@
+"""Precision — parity with reference
+``torcheval/metrics/functional/classification/precision.py`` (248 LoC).
+
+Sufficient statistics: ``num_tp`` / ``num_fp`` / ``num_label`` counters
+(scalars for micro, per-class vectors otherwise — scatter-add via
+``zeros(C).at[idx].add(...)``, the XLA analog of ``Tensor.scatter_``).
+
+Shape-stable divergence note: the reference masks classes absent from both
+input and target via boolean indexing (``precision.py:140-175``); here the
+same mean/weighting is computed with masked arithmetic so the kernel has a
+static shape — results are identical.
+"""
+
+import logging
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_logger = logging.getLogger(__name__)
+
+
+def binary_precision(input, target, *, threshold: float = 0.5) -> jax.Array:
+    """TP / (TP + FP) after thresholding (reference ``precision.py:16-51``)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    num_tp, num_fp, num_label = _binary_precision_update(input, target, threshold)
+    return _precision_compute(num_tp, num_fp, num_label, "micro")
+
+
+def multiclass_precision(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "micro",
+) -> jax.Array:
+    """Multiclass precision with micro/macro/weighted/None averaging
+    (reference ``precision.py:54-110``)."""
+    _precision_param_check(num_classes, average)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    num_tp, num_fp, num_label = _precision_update(input, target, num_classes, average)
+    return _precision_compute(num_tp, num_fp, num_label, average)
+
+
+def _precision_update(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _precision_update_input_check(input, target, num_classes)
+    if average != "micro":
+        _check_index_range(target, num_classes, "target")
+        if input.ndim == 1:
+            _check_index_range(input, num_classes, "input")
+    return _precision_update_kernel(input, target, num_classes, average)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _precision_update_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    if input.ndim == 2:
+        input = jnp.argmax(input, axis=1)
+    if average == "micro":
+        num_tp = (input == target).sum()
+        num_fp = (input != target).sum()
+        return num_tp, num_fp, jnp.asarray(0.0)
+    correct = (input == target).astype(jnp.int32)
+    num_label = jnp.zeros(num_classes, jnp.int32).at[target].add(1)
+    num_tp = jnp.zeros(num_classes, jnp.int32).at[target].add(correct)
+    num_fp = jnp.zeros(num_classes, jnp.int32).at[input].add(1 - correct)
+    return num_tp, num_fp, num_label
+
+
+def _precision_compute(
+    num_tp: jax.Array,
+    num_fp: jax.Array,
+    num_label: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    if average in (None, "None") and num_tp.ndim:
+        nan_mask = (num_tp + num_fp) == 0
+        if bool(jnp.any(nan_mask)):
+            bad_class = jnp.nonzero(nan_mask)[0]
+            _logger.warning(
+                f"{bad_class} classes have zero instances in both the "
+                "predictions and the ground truth labels. Precision is still "
+                "logged as zero."
+            )
+    return _precision_compute_kernel(num_tp, num_fp, num_label, average)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def _precision_compute_kernel(
+    num_tp: jax.Array,
+    num_fp: jax.Array,
+    num_label: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    precision = jnp.nan_to_num(num_tp / (num_tp + num_fp))
+    if average == "micro" or average in (None, "None"):
+        return precision
+    # macro / weighted: ignore classes absent from both input and target
+    # (reference ``precision.py:140-147``), computed shape-stably.
+    mask = (num_label != 0) | ((num_tp + num_fp) != 0)
+    if average == "macro":
+        return jnp.sum(jnp.where(mask, precision, 0.0)) / jnp.sum(mask)
+    # weighted
+    return jnp.sum(precision * num_label) / jnp.sum(num_label)
+
+
+def _precision_param_check(
+    num_classes: Optional[int], average: Optional[str]
+) -> None:
+    average_options = ("micro", "macro", "weighted", "None", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, got {average}."
+        )
+    if average != "micro" and (num_classes is None or num_classes <= 0):
+        raise ValueError(
+            f"num_classes should be a positive number when average={average}."
+            f" Got num_classes={num_classes}."
+        )
+
+
+def _precision_update_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not input.ndim == 1 and not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "input should have shape of (num_sample,) or (num_sample, num_classes), "
+            f"got {input.shape}."
+        )
+
+
+def _check_index_range(values: jax.Array, upper: Optional[int], name: str) -> None:
+    """OOB class indices must raise (XLA scatter silently drops them where
+    torch ``scatter_`` errors)."""
+    if upper is None or not values.size:
+        return
+    if int(jnp.min(values)) < 0 or int(jnp.max(values)) >= upper:
+        raise ValueError(
+            f"{name} values should be in [0, {upper}), got min "
+            f"{int(jnp.min(values))} max {int(jnp.max(values))}."
+        )
+
+
+def _binary_precision_update(
+    input: jax.Array, target: jax.Array, threshold: float = 0.5
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _binary_precision_update_input_check(input, target)
+    return _binary_precision_update_kernel(input, target, threshold)
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_precision_update_kernel(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    pred = jnp.where(input < threshold, 0, 1)
+    target_b = target.astype(jnp.bool_)
+    pred_b = pred.astype(jnp.bool_)
+    num_tp = (pred_b & target_b).sum()
+    num_fp = (pred_b & ~target_b).sum()
+    return num_tp, num_fp, jnp.asarray(0.0)
+
+
+def _binary_precision_update_input_check(
+    input: jax.Array, target: jax.Array
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same dimensions, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )

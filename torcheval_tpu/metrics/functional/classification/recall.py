@@ -1,0 +1,196 @@
+"""Recall — parity with reference
+``torcheval/metrics/functional/classification/recall.py`` (247 LoC).
+
+Sufficient statistics: ``num_tp`` / ``num_labels`` / ``num_predictions``.
+
+Divergence (documented): for macro/weighted averages with classes absent
+from both input and target, the reference masks ``num_tp`` by boolean
+indexing but forgets to mask ``num_labels`` (reference ``recall.py:169-180``),
+which crashes on a shape mismatch whenever any class is actually masked.
+This implementation computes the intended statistic shape-stably (identical
+result when no class is masked, working result instead of a crash otherwise).
+"""
+
+import logging
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.precision import (
+    _check_index_range,
+)
+
+_logger = logging.getLogger(__name__)
+
+
+def binary_recall(input, target, *, threshold: float = 0.5) -> jax.Array:
+    """TP / #positive-labels after thresholding (reference ``recall.py:13-46``)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    num_tp, num_true_labels = _binary_recall_update(input, target, threshold)
+    return _binary_recall_compute(num_tp, num_true_labels)
+
+
+def _binary_recall_compute(num_tp: jax.Array, num_true_labels: jax.Array) -> jax.Array:
+    """NaN (no positive labels) → 0 with a warning
+    (reference ``recall.py:64-77``)."""
+    recall = num_tp / num_true_labels
+    if bool(jnp.isnan(recall)):
+        _logger.warning(
+            "No positive instances have been seen in target. Recall is "
+            "converted from NaN to 0s."
+        )
+        return jnp.nan_to_num(recall)
+    return recall
+
+
+def multiclass_recall(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+    average: Optional[str] = "micro",
+) -> jax.Array:
+    """Multiclass recall with micro/macro/weighted/None averaging
+    (reference ``recall.py:95-151``)."""
+    _recall_param_check(num_classes, average)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    num_tp, num_labels, num_predictions = _recall_update(
+        input, target, num_classes, average
+    )
+    return _recall_compute(num_tp, num_labels, num_predictions, average)
+
+
+def _recall_update(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _recall_update_input_check(input, target, num_classes)
+    if average != "micro":
+        _check_index_range(target, num_classes, "target")
+        if input.ndim == 1:
+            _check_index_range(input, num_classes, "input")
+    return _recall_update_kernel(input, target, num_classes, average)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _recall_update_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    if input.ndim == 2:
+        input = jnp.argmax(input, axis=1)
+    if average == "micro":
+        num_tp = (input == target).sum()
+        num_labels = jnp.asarray(target.size)
+        return num_tp, num_labels, num_labels
+    correct = (input == target).astype(jnp.int32)
+    num_labels = jnp.zeros(num_classes, jnp.int32).at[target].add(1)
+    num_predictions = jnp.zeros(num_classes, jnp.int32).at[input].add(1)
+    num_tp = jnp.zeros(num_classes, jnp.int32).at[target].add(correct)
+    return num_tp, num_labels, num_predictions
+
+
+def _recall_compute(
+    num_tp: jax.Array,
+    num_labels: jax.Array,
+    num_predictions: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    if num_tp.ndim:
+        nan_mask = num_labels == 0
+        if bool(jnp.any(nan_mask)):
+            nan_classes = [int(i) for i in jnp.nonzero(nan_mask)[0]]
+            _logger.warning(
+                f"One or more NaNs identified, as no ground-truth instances of "
+                f"{nan_classes} have been seen. These have been converted to zero."
+            )
+    return _recall_compute_kernel(num_tp, num_labels, num_predictions, average)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def _recall_compute_kernel(
+    num_tp: jax.Array,
+    num_labels: jax.Array,
+    num_predictions: jax.Array,
+    average: Optional[str],
+) -> jax.Array:
+    recall = jnp.nan_to_num(num_tp / num_labels)
+    if average == "micro" or average is None:
+        return recall
+    # macro/weighted ignore classes with no samples in target and input
+    mask = (num_labels != 0) | (num_predictions != 0)
+    if average == "macro":
+        return jnp.sum(jnp.where(mask, recall, 0.0)) / jnp.sum(mask)
+    # weighted
+    return jnp.sum(recall * num_labels) / jnp.sum(num_labels)
+
+
+def _recall_param_check(num_classes: Optional[int], average: Optional[str]) -> None:
+    average_options = ("micro", "macro", "weighted", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed values of {average_options}, "
+            f"got {average}."
+        )
+    if average != "micro" and (num_classes is None or num_classes <= 0):
+        raise ValueError(
+            f"`num_classes` should be a positive number when average={average}, "
+            f"got num_classes={num_classes}."
+        )
+
+
+def _recall_update_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"`target` should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.ndim != 1 and not (
+        input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)
+    ):
+        raise ValueError(
+            "`input` should have shape (num_samples,) or (num_samples, num_classes), "
+            f"got {input.shape}."
+        )
+
+
+def _binary_recall_update(
+    input: jax.Array, target: jax.Array, threshold: float = 0.5
+) -> Tuple[jax.Array, jax.Array]:
+    _binary_recall_update_input_check(input, target)
+    return _binary_recall_update_kernel(input, target, threshold)
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_recall_update_kernel(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> Tuple[jax.Array, jax.Array]:
+    pred = jnp.where(input < threshold, 0, 1)
+    target_b = target.astype(jnp.bool_)
+    num_tp = (pred.astype(jnp.bool_) & target_b).sum()
+    num_true_labels = target_b.sum()
+    return num_tp, num_true_labels
+
+
+def _binary_recall_update_input_check(input: jax.Array, target: jax.Array) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same dimensions, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )

@@ -1,0 +1,5 @@
+from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
+    weighted_calibration,
+)
+
+__all__ = ["weighted_calibration"]

@@ -1,0 +1,72 @@
+"""Weighted calibration — parity with reference
+``torcheval/metrics/functional/ranking/weighted_calibration.py`` (112 LoC).
+
+``Σ w·input / Σ w·target`` per task (reference
+``weighted_calibration.py:62-93``); sufficient statistics are two per-task
+sums, mergeable by addition."""
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def weighted_calibration(
+    input,
+    target,
+    weight: Union[float, int, "jax.Array"] = 1.0,
+    *,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """Weighted calibration Σw·input / Σw·target
+    (reference ``weighted_calibration.py:13-59``)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    weighted_input_sum, weighted_target_sum = _weighted_calibration_update(
+        input, target, weight, num_tasks=num_tasks
+    )
+    return weighted_input_sum / weighted_target_sum
+
+
+def _weighted_calibration_update(
+    input: jax.Array,
+    target: jax.Array,
+    weight: Union[float, int, "jax.Array"],
+    *,
+    num_tasks: int,
+) -> Tuple[jax.Array, jax.Array]:
+    _weighted_calibration_input_check(input, target, weight, num_tasks=num_tasks)
+    if isinstance(weight, (float, int)):
+        return weight * jnp.sum(input, axis=-1), weight * jnp.sum(target, axis=-1)
+    if isinstance(weight, (jax.Array, jnp.ndarray, np.ndarray)) and input.shape == jnp.shape(
+        weight
+    ):
+        return jnp.sum(weight * input, axis=-1), jnp.sum(weight * target, axis=-1)
+    raise ValueError(
+        "Weight must be either a float value or a tensor that matches the "
+        f"input tensor size. Got {weight} instead."
+    )
+
+
+def _weighted_calibration_input_check(
+    input: jax.Array,
+    target: jax.Array,
+    weight: Union[float, int, "jax.Array"],
+    num_tasks: int,
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            f"`input` shape ({input.shape}) is different from `target` shape "
+            f"({target.shape})"
+        )
+    if num_tasks == 1:
+        if input.ndim > 1:
+            raise ValueError(
+                "`num_tasks = 1`, `input` is expected to be one-dimensional "
+                f"tensor, but got shape ({input.shape})."
+            )
+    elif input.ndim == 1 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
+            f"({num_tasks}, num_samples), but got shape ({input.shape})."
+        )

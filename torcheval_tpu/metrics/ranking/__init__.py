@@ -1,0 +1,3 @@
+from torcheval_tpu.metrics.ranking.weighted_calibration import WeightedCalibration
+
+__all__ = ["WeightedCalibration"]

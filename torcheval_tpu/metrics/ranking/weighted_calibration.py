@@ -1,0 +1,54 @@
+"""WeightedCalibration metric — parity with reference
+``torcheval/metrics/ranking/weighted_calibration.py`` (129 LoC).
+
+States: per-task ``weighted_input_sum`` / ``weighted_target_sum``
+(reference ``:67-74``); merge: add (reference ``:117``)."""
+
+from typing import Iterable, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    _accum_dtype,
+)
+from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
+    _weighted_calibration_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class WeightedCalibration(Metric[jax.Array]):
+    def __init__(self, *, num_tasks: int = 1, device=None) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.num_tasks = num_tasks
+        self._add_state("weighted_input_sum", jnp.zeros(num_tasks, dtype=_accum_dtype()))
+        self._add_state(
+            "weighted_target_sum", jnp.zeros(num_tasks, dtype=_accum_dtype())
+        )
+
+    def update(
+        self, input, target, weight: Union[float, int, "jax.Array"] = 1.0
+    ) -> "WeightedCalibration":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        weighted_input_sum, weighted_target_sum = _weighted_calibration_update(
+            input, target, weight, num_tasks=self.num_tasks
+        )
+        self.weighted_input_sum = self.weighted_input_sum + weighted_input_sum
+        self.weighted_target_sum = self.weighted_target_sum + weighted_target_sum
+        return self
+
+    def compute(self) -> jax.Array:
+        """Σw·input / Σw·target per task; NaN where no target weight has been
+        seen (0/0)."""
+        return self.weighted_input_sum / self.weighted_target_sum
+
+    def merge_state(self, metrics: Iterable["WeightedCalibration"]):
+        merge_add(self, metrics, "weighted_input_sum", "weighted_target_sum")
+        return self

@@ -1,0 +1,69 @@
+"""MeanSquaredError metric — parity with reference
+``torcheval/metrics/regression/mean_squared_error.py`` (138 LoC).
+
+States: ``sum_squared_error`` + ``sum_weight``; per-output state grows from
+scalar to vector on the first 2-D update (reference behavior); merge: add."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
+    _mean_squared_error_compute,
+    _mean_squared_error_param_check,
+    _mean_squared_error_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class MeanSquaredError(Metric[jax.Array]):
+    def __init__(
+        self,
+        *,
+        multioutput: str = "uniform_average",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _mean_squared_error_param_check(multioutput)
+        self.multioutput = multioutput
+        self._add_state("sum_squared_error", jnp.asarray(0.0))
+        self._add_state("sum_weight", jnp.asarray(0.0))
+
+    def update(
+        self,
+        input,
+        target,
+        *,
+        sample_weight=None,
+    ) -> "MeanSquaredError":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        if sample_weight is not None:
+            sample_weight = jnp.asarray(sample_weight)
+        sum_squared_error, sum_weight = _mean_squared_error_update(
+            input, target, sample_weight
+        )
+        if self.sum_squared_error.ndim == 0 and sum_squared_error.ndim == 1:
+            self.sum_squared_error = sum_squared_error
+        else:
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_weight = self.sum_weight + sum_weight
+        return self
+
+    def compute(self) -> jax.Array:
+        """MSE; NaN before any update (0/0)."""
+        return _mean_squared_error_compute(
+            self.sum_squared_error, self.multioutput, self.sum_weight
+        )
+
+    def merge_state(self, metrics: Iterable["MeanSquaredError"]) -> "MeanSquaredError":
+        for metric in metrics:
+            other = jax.device_put(metric.sum_squared_error, self.device)
+            if self.sum_squared_error.ndim == 0 and other.ndim == 1:
+                self.sum_squared_error = other
+            else:
+                self.sum_squared_error = self.sum_squared_error + other
+            self.sum_weight = self.sum_weight + jax.device_put(
+                metric.sum_weight, self.device
+            )
+        return self

@@ -1,0 +1,86 @@
+"""R2Score metric — parity with reference
+``torcheval/metrics/regression/r2_score.py`` (162 LoC).
+
+States: ``sum_squared_obs`` / ``sum_obs`` / ``sum_squared_residual`` /
+``num_obs`` (streaming TSS/RSS); per-output states grow from scalar to
+vector on the first 2-D update; merge: add."""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.regression.r2_score import (
+    _r2_score_compute,
+    _r2_score_param_check,
+    _r2_score_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+_GROWABLE = ("sum_squared_obs", "sum_obs", "sum_squared_residual")
+
+
+class R2Score(Metric[jax.Array]):
+    def __init__(
+        self,
+        *,
+        multioutput: str = "uniform_average",
+        num_regressors: int = 0,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _r2_score_param_check(multioutput, num_regressors)
+        self.multioutput = multioutput
+        self.num_regressors = num_regressors
+        self._add_state("sum_squared_obs", jnp.asarray(0.0))
+        self._add_state("sum_obs", jnp.asarray(0.0))
+        self._add_state("sum_squared_residual", jnp.asarray(0.0))
+        self._add_state("num_obs", jnp.asarray(0.0))
+
+    def update(self, input, target) -> "R2Score":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        sum_squared_obs, sum_obs, sum_squared_residual, num_obs = _r2_score_update(
+            input, target
+        )
+        if self.sum_squared_obs.ndim == 0 and sum_squared_obs.ndim == 1:
+            self.sum_squared_obs = sum_squared_obs
+            self.sum_obs = sum_obs
+            self.sum_squared_residual = sum_squared_residual
+        else:
+            self.sum_squared_obs = self.sum_squared_obs + sum_squared_obs
+            self.sum_obs = self.sum_obs + sum_obs
+            self.sum_squared_residual = (
+                self.sum_squared_residual + sum_squared_residual
+            )
+        self.num_obs = self.num_obs + num_obs
+        return self
+
+    def compute(self) -> jax.Array:
+        """R²; raises before enough data (n < 2) like the reference
+        (``r2_score.py:117-125``)."""
+        return _r2_score_compute(
+            self.sum_squared_obs,
+            self.sum_obs,
+            self.sum_squared_residual,
+            self.num_obs,
+            self.multioutput,
+            self.num_regressors,
+        )
+
+    def merge_state(self, metrics: Iterable["R2Score"]) -> "R2Score":
+        for metric in metrics:
+            if self.sum_squared_obs.ndim == 0 and metric.sum_squared_obs.ndim == 1:
+                for name in _GROWABLE:
+                    setattr(
+                        self, name, jax.device_put(getattr(metric, name), self.device)
+                    )
+            else:
+                for name in _GROWABLE:
+                    setattr(
+                        self,
+                        name,
+                        getattr(self, name)
+                        + jax.device_put(getattr(metric, name), self.device),
+                    )
+            self.num_obs = self.num_obs + jax.device_put(metric.num_obs, self.device)
+        return self
