@@ -1,0 +1,123 @@
+"""Class-metric protocol tests for AUROC and PR curves."""
+
+import numpy as np
+from sklearn.metrics import roc_auc_score
+
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    BinaryPrecisionRecallCurve,
+    MulticlassAUROC,
+    MulticlassPrecisionRecallCurve,
+)
+from torcheval_tpu.metrics.functional import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+)
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(41)
+
+
+class TestBinaryAUROC(MetricClassTester):
+    def test_binary_auroc_class(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        expected = roc_auc_score(target.reshape(-1), input.reshape(-1))
+        self.run_class_implementation_tests(
+            metric=BinaryAUROC(),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(expected),
+            atol=1e-5,
+            rtol=1e-4,
+            test_merge_with_one_update=False,
+        )
+
+    def test_empty_compute(self) -> None:
+        self.assertEqual(np.asarray(BinaryAUROC().compute()).shape, (0,))
+
+    def test_num_tasks_check(self) -> None:
+        with self.assertRaisesRegex(ValueError, "num_tasks"):
+            BinaryAUROC(num_tasks=0)
+
+
+class TestMulticlassAUROC(MetricClassTester):
+    def test_multiclass_auroc_class(self) -> None:
+        num_classes = 4
+        logits = RNG.normal(size=(NUM_TOTAL_UPDATES, BATCH_SIZE, num_classes))
+        e = np.exp(logits - logits.max(axis=-1, keepdims=True))
+        input = (e / e.sum(axis=-1, keepdims=True)).astype(np.float32)
+        target = RNG.integers(0, num_classes, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        expected = roc_auc_score(
+            target.reshape(-1),
+            input.reshape(-1, num_classes),
+            multi_class="ovr",
+            average="macro",
+            labels=range(num_classes),
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassAUROC(num_classes=num_classes),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(expected),
+            atol=1e-5,
+            rtol=1e-4,
+            test_merge_with_one_update=False,
+        )
+
+
+class TestPRCurveClasses(MetricClassTester):
+    def test_binary_pr_curve_class(self) -> None:
+        input = (RNG.permutation(NUM_TOTAL_UPDATES * BATCH_SIZE).reshape(
+            NUM_TOTAL_UPDATES, BATCH_SIZE
+        ) / (NUM_TOTAL_UPDATES * BATCH_SIZE)).astype(np.float32)
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        expected = tuple(
+            np.asarray(x)
+            for x in binary_precision_recall_curve(
+                input.reshape(-1), target.reshape(-1)
+            )
+        )
+        self.run_class_implementation_tests(
+            metric=BinaryPrecisionRecallCurve(),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=expected,
+            atol=1e-5,
+            test_merge_with_one_update=False,
+        )
+
+    def test_multiclass_pr_curve_class(self) -> None:
+        num_classes = 3
+        input = RNG.random(
+            (NUM_TOTAL_UPDATES, BATCH_SIZE, num_classes)
+        ).astype(np.float32)
+        target = RNG.integers(0, num_classes, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        p, r, t = multiclass_precision_recall_curve(
+            input.reshape(-1, num_classes),
+            target.reshape(-1),
+            num_classes=num_classes,
+        )
+        expected = (
+            [np.asarray(x) for x in p],
+            [np.asarray(x) for x in r],
+            [np.asarray(x) for x in t],
+        )
+        self.run_class_implementation_tests(
+            metric=MulticlassPrecisionRecallCurve(num_classes=num_classes),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=expected,
+            atol=1e-5,
+            test_merge_with_one_update=False,
+        )
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

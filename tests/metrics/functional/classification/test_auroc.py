@@ -1,0 +1,120 @@
+"""Functional AUROC vs sklearn oracle — tie handling is the key case."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import roc_auc_score
+
+from torcheval_tpu.metrics.functional import binary_auroc, multiclass_auroc
+
+RNG = np.random.default_rng(31)
+
+
+class TestBinaryAUROC(unittest.TestCase):
+    def test_reference_examples(self) -> None:
+        np.testing.assert_allclose(
+            np.asarray(
+                binary_auroc(np.asarray([0.1, 0.5, 0.7, 0.8]), np.asarray([1, 0, 1, 1]))
+            ),
+            2 / 3,
+            rtol=1e-5,
+        )
+        # tied scores (reference docstring: tensor(0.7500))
+        np.testing.assert_allclose(
+            np.asarray(
+                binary_auroc(np.asarray([1, 1, 1, 0]), np.asarray([1, 0, 1, 0]))
+            ),
+            0.75,
+            rtol=1e-5,
+        )
+
+    def test_multi_task(self) -> None:
+        input = np.asarray([[1, 1, 1, 0], [0.1, 0.5, 0.7, 0.8]])
+        target = np.asarray([[1, 0, 1, 0], [1, 0, 1, 1]])
+        np.testing.assert_allclose(
+            np.asarray(binary_auroc(input, target, num_tasks=2)),
+            [0.75, 2 / 3],
+            rtol=1e-5,
+        )
+
+    def test_vs_sklearn_continuous(self) -> None:
+        input = RNG.random(500)
+        target = RNG.integers(0, 2, 500)
+        np.testing.assert_allclose(
+            np.asarray(binary_auroc(input, target)),
+            roc_auc_score(target, input),
+            rtol=1e-5,
+        )
+
+    def test_vs_sklearn_heavy_ties(self) -> None:
+        # quantized scores produce many tie groups — exercises the dedup scan
+        input = np.round(RNG.random(500), 1)
+        target = RNG.integers(0, 2, 500)
+        np.testing.assert_allclose(
+            np.asarray(binary_auroc(input, target)),
+            roc_auc_score(target, input),
+            rtol=1e-5,
+        )
+
+    def test_degenerate_is_half(self) -> None:
+        np.testing.assert_allclose(
+            np.asarray(binary_auroc(np.asarray([0.3, 0.7]), np.asarray([1, 1]))), 0.5
+        )
+
+    def test_fused_approx_on_unique_scores(self) -> None:
+        # with no ties the approximation is exact
+        input = RNG.permutation(200) / 200.0
+        target = RNG.integers(0, 2, 200)
+        np.testing.assert_allclose(
+            np.asarray(binary_auroc(input, target, use_fused=True)),
+            roc_auc_score(target, input),
+            rtol=1e-5,
+        )
+
+    def test_input_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            binary_auroc(np.zeros(3), np.zeros(4))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            binary_auroc(np.zeros((2, 3)), np.zeros((2, 3)))
+        with self.assertRaisesRegex(ValueError, "num_tasks = 2"):
+            binary_auroc(np.zeros(3), np.zeros(3), num_tasks=2)
+
+
+class TestMulticlassAUROC(unittest.TestCase):
+    def test_reference_example(self) -> None:
+        input = np.tile(np.asarray([[0.1], [0.5], [0.7], [0.8]]), (1, 4))
+        target = np.asarray([0, 1, 2, 3])
+        np.testing.assert_allclose(
+            np.asarray(multiclass_auroc(input, target, num_classes=4)), 0.5, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(multiclass_auroc(input, target, num_classes=4, average=None)),
+            [0.0, 1 / 3, 2 / 3, 1.0],
+            rtol=1e-5,
+        )
+
+    def test_vs_sklearn_ovr(self) -> None:
+        num_classes = 5
+        logits = RNG.normal(size=(300, num_classes))
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        probs = e / e.sum(axis=1, keepdims=True)
+        target = RNG.integers(0, num_classes, 300)
+        np.testing.assert_allclose(
+            np.asarray(
+                multiclass_auroc(probs, target, num_classes=num_classes, average=None)
+            ),
+            roc_auc_score(
+                target, probs, multi_class="ovr", average=None, labels=range(num_classes)
+            ),
+            rtol=1e-4,
+        )
+
+    def test_param_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "`average` was not"):
+            multiclass_auroc(np.zeros((2, 2)), np.zeros(2), num_classes=2, average="x")
+        with self.assertRaisesRegex(ValueError, "at least 2"):
+            multiclass_auroc(np.zeros((2, 1)), np.zeros(2), num_classes=1)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,103 @@
+"""Functional PR curves — reference docstring examples + sklearn spot checks."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import precision_recall_curve as sk_prc
+
+from torcheval_tpu.metrics.functional import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+)
+
+RNG = np.random.default_rng(37)
+
+
+class TestBinaryPRCurve(unittest.TestCase):
+    def test_vs_sklearn(self) -> None:
+        # sklearn trims the curve after full recall is reached; with strictly
+        # positive minimum score + all-distinct scores both agree end-to-end.
+        input = RNG.permutation(50) / 50.0 + 0.01
+        target = RNG.integers(0, 2, 50)
+        precision, recall, thresholds = binary_precision_recall_curve(input, target)
+        sk_p, sk_r, sk_t = sk_prc(target, input)
+        np.testing.assert_allclose(np.asarray(precision), sk_p, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(recall), sk_r, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(thresholds), sk_t, rtol=1e-5)
+
+    def test_ties(self) -> None:
+        input = np.asarray([0.5, 0.5, 0.9, 0.1])
+        target = np.asarray([0, 1, 1, 0])
+        precision, recall, thresholds = binary_precision_recall_curve(input, target)
+        sk_p, sk_r, sk_t = sk_prc(target, input)
+        np.testing.assert_allclose(np.asarray(precision), sk_p, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(recall), sk_r, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(thresholds), sk_t, rtol=1e-5)
+
+    def test_no_positive_recall_is_one(self) -> None:
+        input = np.asarray([0.2, 0.8])
+        target = np.asarray([0, 0])
+        precision, recall, _ = binary_precision_recall_curve(input, target)
+        np.testing.assert_allclose(np.asarray(recall)[:-1], [1.0, 1.0])
+
+    def test_input_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            binary_precision_recall_curve(np.zeros((2, 2)), np.zeros((2, 2)))
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            binary_precision_recall_curve(np.zeros(2), np.zeros(3))
+
+
+class TestMulticlassPRCurve(unittest.TestCase):
+    def test_reference_example(self) -> None:
+        input = np.tile(np.asarray([[0.1], [0.5], [0.7], [0.8]]), (1, 4))
+        target = np.asarray([0, 1, 2, 3])
+        precision, recall, thresholds = multiclass_precision_recall_curve(
+            input, target, num_classes=4
+        )
+        expected_p = [
+            [0.25, 0.0, 0.0, 0.0, 1.0],
+            [0.25, 1 / 3, 0.0, 0.0, 1.0],
+            [0.25, 1 / 3, 0.5, 0.0, 1.0],
+            [0.25, 1 / 3, 0.5, 1.0, 1.0],
+        ]
+        expected_r = [
+            [1.0, 0.0, 0.0, 0.0, 0.0],
+            [1.0, 1.0, 0.0, 0.0, 0.0],
+            [1.0, 1.0, 1.0, 0.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+        ]
+        for c in range(4):
+            np.testing.assert_allclose(
+                np.asarray(precision[c]), expected_p[c], rtol=1e-5, err_msg=f"p{c}"
+            )
+            np.testing.assert_allclose(
+                np.asarray(recall[c]), expected_r[c], rtol=1e-5, err_msg=f"r{c}"
+            )
+            np.testing.assert_allclose(
+                np.asarray(thresholds[c]), [0.1, 0.5, 0.7, 0.8], rtol=1e-5
+            )
+
+    def test_vs_sklearn_per_class(self) -> None:
+        num_classes = 3
+        probs = RNG.random((60, num_classes)) + 0.01
+        # make scores unique to sidestep sklearn's trimming differences
+        probs = probs + np.arange(60 * num_classes).reshape(60, num_classes) * 1e-6
+        target = RNG.integers(0, num_classes, 60)
+        precision, recall, thresholds = multiclass_precision_recall_curve(
+            probs, target, num_classes=num_classes
+        )
+        for c in range(num_classes):
+            sk_p, sk_r, sk_t = sk_prc((target == c).astype(int), probs[:, c])
+            np.testing.assert_allclose(
+                np.asarray(precision[c]), sk_p, rtol=1e-5, err_msg=f"p{c}"
+            )
+            np.testing.assert_allclose(
+                np.asarray(recall[c]), sk_r, rtol=1e-5, err_msg=f"r{c}"
+            )
+            np.testing.assert_allclose(
+                np.asarray(thresholds[c]), sk_t, rtol=1e-5, err_msg=f"t{c}"
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,73 @@
+"""Functional ranking metrics — reference docstring examples."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics.functional import (
+    frequency_at_k,
+    hit_rate,
+    num_collisions,
+    reciprocal_rank,
+)
+
+INPUT = np.asarray([[0.3, 0.1, 0.6], [0.5, 0.2, 0.3], [0.2, 0.1, 0.7], [0.3, 0.3, 0.4]])
+TARGET = np.asarray([2, 1, 1, 0])
+
+
+class TestHitRate(unittest.TestCase):
+    def test_values(self) -> None:
+        np.testing.assert_allclose(
+            np.asarray(hit_rate(INPUT, TARGET, k=2)), [1.0, 0.0, 0.0, 1.0]
+        )
+        np.testing.assert_allclose(
+            np.asarray(hit_rate(INPUT, TARGET)), [1.0, 1.0, 1.0, 1.0]
+        )
+
+    def test_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "two-dimensional"):
+            hit_rate(np.zeros(3), np.zeros(3))
+        with self.assertRaisesRegex(ValueError, "positive"):
+            hit_rate(INPUT, TARGET, k=0)
+
+
+class TestReciprocalRank(unittest.TestCase):
+    def test_values(self) -> None:
+        np.testing.assert_allclose(
+            np.asarray(reciprocal_rank(INPUT, TARGET)),
+            [1.0, 1 / 3, 1 / 3, 0.5],
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(reciprocal_rank(INPUT, TARGET, k=2)),
+            [1.0, 0.0, 0.0, 0.5],
+            rtol=1e-5,
+        )
+
+
+class TestFrequencyAtK(unittest.TestCase):
+    def test_values(self) -> None:
+        np.testing.assert_allclose(
+            np.asarray(frequency_at_k(np.asarray([0.3, 0.1, 0.6]), k=0.5)),
+            [1.0, 1.0, 0.0],
+        )
+
+    def test_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "not be negative"):
+            frequency_at_k(np.asarray([0.3]), k=-1.0)
+
+
+class TestNumCollisions(unittest.TestCase):
+    def test_values(self) -> None:
+        np.testing.assert_array_equal(
+            np.asarray(num_collisions(np.asarray([1, 2, 1, 3, 1]))),
+            [2, 0, 2, 0, 2],
+        )
+
+    def test_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "integer tensor"):
+            num_collisions(np.asarray([0.5, 0.2]))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,56 @@
+"""Class-metric protocol tests for HitRate / ReciprocalRank."""
+
+import numpy as np
+
+from torcheval_tpu.metrics import HitRate, ReciprocalRank
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(43)
+NUM_CLASSES = 5
+INPUT = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+TARGET = RNG.integers(0, NUM_CLASSES, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+
+
+def _ranks() -> np.ndarray:
+    flat_i = INPUT.reshape(-1, NUM_CLASSES)
+    flat_t = TARGET.reshape(-1)
+    y = np.take_along_axis(flat_i, flat_t[:, None], axis=-1)
+    return (flat_i > y).sum(axis=-1)
+
+
+class TestHitRate(MetricClassTester):
+    def test_hit_rate_class(self) -> None:
+        k = 2
+        expected = (_ranks() < k).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=HitRate(k=k),
+            state_names={"scores"},
+            update_kwargs={"input": list(INPUT), "target": list(TARGET)},
+            compute_result=expected,
+            test_merge_with_one_update=False,
+        )
+
+    def test_empty(self) -> None:
+        self.assertEqual(np.asarray(HitRate().compute()).shape, (0,))
+
+
+class TestReciprocalRank(MetricClassTester):
+    def test_reciprocal_rank_class(self) -> None:
+        expected = (1.0 / (_ranks() + 1.0)).astype(np.float32)
+        self.run_class_implementation_tests(
+            metric=ReciprocalRank(),
+            state_names={"scores"},
+            update_kwargs={"input": list(INPUT), "target": list(TARGET)},
+            compute_result=expected,
+            test_merge_with_one_update=False,
+        )
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

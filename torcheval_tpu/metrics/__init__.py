@@ -5,6 +5,10 @@ from torcheval_tpu.metrics import functional
 from torcheval_tpu.metrics.aggregation import Cat, Max, Mean, Min, Sum, Throughput
 from torcheval_tpu.metrics.classification import (
     BinaryAccuracy,
+    BinaryAUROC,
+    BinaryPrecisionRecallCurve,
+    MulticlassAUROC,
+    MulticlassPrecisionRecallCurve,
     BinaryBinnedPrecisionRecallCurve,
     BinaryConfusionMatrix,
     BinaryF1Score,
@@ -21,11 +25,17 @@ from torcheval_tpu.metrics.classification import (
     TopKMultilabelAccuracy,
 )
 from torcheval_tpu.metrics.metric import Metric
-from torcheval_tpu.metrics.ranking import WeightedCalibration
+from torcheval_tpu.metrics.ranking import HitRate, ReciprocalRank, WeightedCalibration
 from torcheval_tpu.metrics.regression import MeanSquaredError, R2Score
 
 __all__ = [
     "BinaryAccuracy",
+    "BinaryAUROC",
+    "BinaryPrecisionRecallCurve",
+    "HitRate",
+    "MulticlassAUROC",
+    "MulticlassPrecisionRecallCurve",
+    "ReciprocalRank",
     "BinaryBinnedPrecisionRecallCurve",
     "BinaryConfusionMatrix",
     "BinaryF1Score",

@@ -1,0 +1,38 @@
+"""Shared helpers for sample-buffer metrics (AUROC, PR curves, HitRate,
+ReciprocalRank, Cat).
+
+Buffer states are Python lists of device arrays; all math is deferred to
+``compute()``, where one concatenation feeds a jit kernel.  Merge
+concatenates; ``_prepare_for_merge_state`` pre-concatenates each buffer so
+the sync wire ships a single array per state (reference
+``classification/auroc.py:130-134``)."""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import Metric
+
+
+def merge_concat_buffers(
+    metric: Metric, metrics: Iterable[Metric], *state_names: str, dim: int = -1
+) -> None:
+    """Append each source metric's concatenated buffer (reference merge
+    semantics: one pre-concatenated array per source,
+    ``classification/auroc.py:121-128``)."""
+    for other in metrics:
+        first = getattr(other, state_names[0])
+        if first:
+            for name in state_names:
+                buf = getattr(other, name)
+                getattr(metric, name).append(
+                    jax.device_put(jnp.concatenate(buf, axis=dim), metric.device)
+                )
+
+
+def prepare_concat_buffers(metric: Metric, *state_names: str, dim: int = -1) -> None:
+    for name in state_names:
+        buf = getattr(metric, name)
+        if buf:
+            setattr(metric, name, [jnp.concatenate(buf, axis=dim)])

@@ -8,6 +8,7 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
 from torcheval_tpu.metrics.metric import Metric
 
 
@@ -33,14 +34,8 @@ class Cat(Metric[jax.Array]):
 
     def merge_state(self, metrics: Iterable["Cat"]) -> "Cat":
         for metric in metrics:
-            if metric.inputs:
-                self.inputs.append(
-                    jax.device_put(
-                        jnp.concatenate(metric.inputs, axis=metric.dim), self.device
-                    )
-                )
+            merge_concat_buffers(self, [metric], "inputs", dim=metric.dim)
         return self
 
     def _prepare_for_merge_state(self) -> None:
-        if self.inputs:
-            self.inputs = [jnp.concatenate(self.inputs, axis=self.dim)]
+        prepare_concat_buffers(self, "inputs", dim=self.dim)

@@ -1,3 +1,11 @@
+from torcheval_tpu.metrics.classification.auroc import (
+    BinaryAUROC,
+    MulticlassAUROC,
+)
+from torcheval_tpu.metrics.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+)
 from torcheval_tpu.metrics.classification.accuracy import (
     BinaryAccuracy,
     MulticlassAccuracy,
@@ -30,17 +38,21 @@ from torcheval_tpu.metrics.classification.recall import (
 
 __all__ = [
     "BinaryAccuracy",
+    "BinaryAUROC",
     "BinaryBinnedPrecisionRecallCurve",
     "BinaryConfusionMatrix",
     "BinaryF1Score",
     "BinaryNormalizedEntropy",
     "BinaryPrecision",
+    "BinaryPrecisionRecallCurve",
     "BinaryRecall",
     "MulticlassAccuracy",
+    "MulticlassAUROC",
     "MulticlassBinnedPrecisionRecallCurve",
     "MulticlassConfusionMatrix",
     "MulticlassF1Score",
     "MulticlassPrecision",
+    "MulticlassPrecisionRecallCurve",
     "MulticlassRecall",
     "MultilabelAccuracy",
     "TopKMultilabelAccuracy",

@@ -1,0 +1,88 @@
+"""PR-curve metrics — parity with reference
+``torcheval/metrics/classification/precision_recall_curve.py`` (221 LoC).
+
+Sample-buffer states; all curve math happens at compute
+(reference ``precision_recall_curve.py:27-221``)."""
+
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_update_input_check,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_update_input_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class BinaryPrecisionRecallCurve(Metric[Tuple[jax.Array, jax.Array, jax.Array]]):
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        self._add_state("inputs", [])
+        self._add_state("targets", [])
+
+    def update(self, input, target) -> "BinaryPrecisionRecallCurve":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _binary_precision_recall_curve_update_input_check(input, target)
+        self.inputs.append(jax.device_put(input, self.device))
+        self.targets.append(jax.device_put(target, self.device))
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        if not self.inputs:
+            return (jnp.zeros(0), jnp.zeros(0), jnp.zeros(0))
+        return _binary_precision_recall_curve_compute(
+            jnp.concatenate(self.inputs), jnp.concatenate(self.targets)
+        )
+
+    def merge_state(
+        self, metrics: Iterable["BinaryPrecisionRecallCurve"]
+    ) -> "BinaryPrecisionRecallCurve":
+        merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "inputs", "targets", dim=0)
+
+
+class MulticlassPrecisionRecallCurve(
+    Metric[Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]]
+):
+    def __init__(self, *, num_classes: Optional[int] = None, device=None) -> None:
+        super().__init__(device=device)
+        self.num_classes = num_classes
+        self._add_state("inputs", [])
+        self._add_state("targets", [])
+
+    def update(self, input, target) -> "MulticlassPrecisionRecallCurve":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _multiclass_precision_recall_curve_update_input_check(
+            input, target, self.num_classes
+        )
+        self.inputs.append(jax.device_put(input, self.device))
+        self.targets.append(jax.device_put(target, self.device))
+        return self
+
+    def compute(
+        self,
+    ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+        if not self.inputs:
+            return ([], [], [])
+        return _multiclass_precision_recall_curve_compute(
+            jnp.concatenate(self.inputs, axis=0),
+            jnp.concatenate(self.targets, axis=0),
+            self.num_classes,
+        )
+
+    def merge_state(
+        self, metrics: Iterable["MulticlassPrecisionRecallCurve"]
+    ) -> "MulticlassPrecisionRecallCurve":
+        merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "inputs", "targets", dim=0)

@@ -8,6 +8,10 @@ from torcheval_tpu.metrics.functional.aggregation import (  # noqa: A004
 )
 from torcheval_tpu.metrics.functional.classification import (
     binary_accuracy,
+    binary_auroc,
+    binary_precision_recall_curve,
+    multiclass_auroc,
+    multiclass_precision_recall_curve,
     binary_binned_precision_recall_curve,
     binary_confusion_matrix,
     binary_f1_score,
@@ -23,7 +27,13 @@ from torcheval_tpu.metrics.functional.classification import (
     multilabel_accuracy,
     topk_multilabel_accuracy,
 )
-from torcheval_tpu.metrics.functional.ranking import weighted_calibration
+from torcheval_tpu.metrics.functional.ranking import (
+    frequency_at_k,
+    hit_rate,
+    num_collisions,
+    reciprocal_rank,
+    weighted_calibration,
+)
 from torcheval_tpu.metrics.functional.regression import (
     mean_squared_error,
     r2_score,
@@ -31,6 +41,14 @@ from torcheval_tpu.metrics.functional.regression import (
 
 __all__ = [
     "binary_accuracy",
+    "binary_auroc",
+    "binary_precision_recall_curve",
+    "frequency_at_k",
+    "hit_rate",
+    "multiclass_auroc",
+    "multiclass_precision_recall_curve",
+    "num_collisions",
+    "reciprocal_rank",
     "binary_binned_precision_recall_curve",
     "binary_confusion_matrix",
     "binary_f1_score",

@@ -1,3 +1,11 @@
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    binary_auroc,
+    multiclass_auroc,
+)
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+)
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     binary_accuracy,
     multiclass_accuracy,
@@ -30,17 +38,21 @@ from torcheval_tpu.metrics.functional.classification.recall import (
 
 __all__ = [
     "binary_accuracy",
+    "binary_auroc",
     "binary_binned_precision_recall_curve",
     "binary_confusion_matrix",
     "binary_f1_score",
     "binary_normalized_entropy",
     "binary_precision",
+    "binary_precision_recall_curve",
     "binary_recall",
     "multiclass_accuracy",
+    "multiclass_auroc",
     "multiclass_binned_precision_recall_curve",
     "multiclass_confusion_matrix",
     "multiclass_f1_score",
     "multiclass_precision",
+    "multiclass_precision_recall_curve",
     "multiclass_recall",
     "multilabel_accuracy",
     "topk_multilabel_accuracy",

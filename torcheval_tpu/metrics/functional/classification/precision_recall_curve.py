@@ -1,0 +1,172 @@
+"""Precision-recall curves — parity with reference
+``torcheval/metrics/functional/classification/precision_recall_curve.py``
+(229 LoC).
+
+Ragged outputs under static shapes (SURVEY §7 hard part 1): the jit kernel
+computes fixed-shape sorted thresholds, tie-group masks and cumulative
+TP/FP on device; the ragged per-class curves are materialized on the host
+at the compute boundary by boolean-compacting the mask — the only
+data-dependent-shape step, deliberately outside XLA."""
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def binary_precision_recall_curve(
+    input,
+    target,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(precision, recall, thresholds) over descending score thresholds
+    (reference ``precision_recall_curve.py:18-90``)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _binary_precision_recall_curve_update_input_check(input, target)
+    return _binary_precision_recall_curve_compute(input, target)
+
+
+def multiclass_precision_recall_curve(
+    input,
+    target,
+    *,
+    num_classes: Optional[int] = None,
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    """Per-class PR curves; classes missing from target get recall 1.0
+    (reference ``precision_recall_curve.py:93-203``)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    if num_classes is None and input.ndim == 2:
+        num_classes = input.shape[1]
+    _multiclass_precision_recall_curve_update_input_check(input, target, num_classes)
+    return _multiclass_precision_recall_curve_compute(input, target, num_classes)
+
+
+@jax.jit
+def _prc_device_kernel(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fixed-shape part: sort + tie mask + cumsums (binary, 1-D)."""
+    indices = jnp.argsort(-input)
+    threshold = input[indices]
+    is_last = jnp.concatenate(
+        [jnp.diff(threshold) != 0, jnp.ones(1, dtype=jnp.bool_)]
+    )
+    hit = target[indices] == 1
+    num_tp = jnp.cumsum(hit, dtype=jnp.int32)
+    num_fp = jnp.cumsum(~hit, dtype=jnp.int32)
+    return threshold, is_last, num_tp, num_fp
+
+
+def _binary_precision_recall_curve_compute(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    return _compute_for_each_class(input, target, 1)
+
+
+def _materialize_curve(
+    tp: np.ndarray, fp: np.ndarray, thresholds_masked: np.ndarray
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared host-side ragged materialization: flip to ascending thresholds,
+    append the (1.0, 0.0) sentinel, NaN recall (no positives) → 1.0
+    (reference jit kernel ``precision_recall_curve.py:206-229``)."""
+    with np.errstate(invalid="ignore"):
+        precision = (tp / (tp + fp))[::-1]
+        total = tp[-1] if tp.size else 0
+        recall = (tp / total)[::-1] if tp.size else tp.astype(np.float64)
+    precision = np.concatenate([precision, np.ones(1)])
+    recall = np.concatenate([recall, np.zeros(1)])
+    if recall.size and np.isnan(recall[0]):
+        recall = np.nan_to_num(recall, nan=1.0)
+    return (
+        jnp.asarray(precision.astype(np.float32)),
+        jnp.asarray(recall.astype(np.float32)),
+        jnp.asarray(thresholds_masked[::-1]),
+    )
+
+
+def _compute_for_each_class(
+    input: jax.Array, target: jax.Array, pos_label: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    threshold, is_last, num_tp, num_fp = jax.device_get(
+        _prc_device_kernel(input, jnp.asarray(target == pos_label, dtype=jnp.int32))
+    )
+    mask = np.asarray(is_last)
+    return _materialize_curve(num_tp[mask], num_fp[mask], threshold[mask])
+
+
+@jax.jit
+def _prc_multiclass_device_kernel(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fixed-shape part, vectorized over classes: (C, N) sorts + cumsums."""
+    num_classes = input.shape[1]
+    scores = input.T
+    indices = jnp.argsort(-scores, axis=1)
+    thresholds = jnp.take_along_axis(scores, indices, axis=1)
+    is_last = jnp.concatenate(
+        [jnp.diff(thresholds, axis=1) != 0, jnp.ones((num_classes, 1), jnp.bool_)],
+        axis=1,
+    )
+    cmp = target[indices] == jnp.arange(num_classes)[:, None]
+    num_tp = jnp.cumsum(cmp, axis=1, dtype=jnp.int32)
+    num_fp = jnp.cumsum(~cmp, axis=1, dtype=jnp.int32)
+    return thresholds, is_last, num_tp, num_fp
+
+
+def _multiclass_precision_recall_curve_compute(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    if num_classes is None:
+        num_classes = input.shape[1]
+    thresholds, is_last, num_tp, num_fp = jax.device_get(
+        _prc_multiclass_device_kernel(input, target)
+    )
+    precisions, recalls, thresh_list = [], [], []
+    for c in range(num_classes):
+        mask = is_last[c]
+        p, r, t = _materialize_curve(
+            num_tp[c][mask], num_fp[c][mask], thresholds[c][mask]
+        )
+        precisions.append(p)
+        recalls.append(r)
+        thresh_list.append(t)
+    return precisions, recalls, thresh_list
+
+
+def _binary_precision_recall_curve_update_input_check(
+    input: jax.Array, target: jax.Array
+) -> None:
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same shape, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+
+
+def _multiclass_precision_recall_curve_update_input_check(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "The `input` and `target` should have the same first dimension, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if not (input.ndim == 2 and (num_classes is None or input.shape[1] == num_classes)):
+        raise ValueError(
+            "input should have shape of (num_sample, num_classes), "
+            f"got {input.shape} and num_classes={num_classes}."
+        )

@@ -1,0 +1,22 @@
+"""frequency_at_k — parity with reference
+``torcheval/metrics/functional/ranking/frequency.py`` (42 LoC)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def frequency_at_k(input, k: float) -> jax.Array:
+    """Binary indicator of frequencies below ``k``
+    (reference ``frequency.py:33``)."""
+    input = jnp.asarray(input)
+    _frequency_input_check(input, k)
+    return (input < k).astype(jnp.float32)
+
+
+def _frequency_input_check(input: jax.Array, k: float) -> None:
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+    if k < 0:
+        raise ValueError(f"k should not be negative, got {k}.")

@@ -1,0 +1,46 @@
+"""Hit rate — parity with reference
+``torcheval/metrics/functional/ranking/hit_rate.py`` (65 LoC)."""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def hit_rate(input, target, *, k: Optional[int] = None) -> jax.Array:
+    """Per-sample hit indicator of the target class among the top-k
+    predictions; rank = #(scores strictly above target's score)
+    (reference ``hit_rate.py:40-46``)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _hit_rate_input_check(input, target, k)
+    if k is None or k >= input.shape[-1]:
+        return jnp.ones(target.shape, dtype=jnp.float32)
+    return _hit_rate_kernel(input, target, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _hit_rate_kernel(input: jax.Array, target: jax.Array, k: int) -> jax.Array:
+    y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
+    rank = jnp.sum(input > y_score, axis=-1)
+    return (rank < k).astype(jnp.float32)
+
+
+def _hit_rate_input_check(
+    input: jax.Array, target: jax.Array, k: Optional[int] = None
+) -> None:
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "`input` and `target` should have the same minibatch dimension, "
+            f"got shapes {input.shape} and {target.shape}, respectively."
+        )
+    if k is not None and k <= 0:
+        raise ValueError(f"k should be None or positive, got {k}.")

@@ -1,0 +1,28 @@
+"""num_collisions — parity with reference
+``torcheval/metrics/functional/ranking/num_collisions.py`` (52 LoC).
+
+O(N²) broadcast equality minus self (reference ``num_collisions.py:31-35``)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def num_collisions(input) -> jax.Array:
+    """Per-id count of other ids equal to it."""
+    input = jnp.asarray(input)
+    _num_collisions_input_check(input)
+    return _num_collisions_kernel(input)
+
+
+@jax.jit
+def _num_collisions_kernel(input: jax.Array) -> jax.Array:
+    return (input[None, :] == input[:, None]).sum(axis=1) - 1
+
+
+def _num_collisions_input_check(input: jax.Array) -> None:
+    if input.ndim != 1:
+        raise ValueError(
+            f"input should be a one-dimensional tensor, got shape {input.shape}."
+        )
+    if not jnp.issubdtype(input.dtype, jnp.integer):
+        raise ValueError(f"input should be an integer tensor, got {input.dtype}.")

@@ -1,0 +1,44 @@
+"""Reciprocal rank — parity with reference
+``torcheval/metrics/functional/ranking/reciprocal_rank.py`` (63 LoC)."""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def reciprocal_rank(input, target, *, k: Optional[int] = None) -> jax.Array:
+    """Per-sample 1/(rank+1) of the target class, zeroed past k
+    (reference ``reciprocal_rank.py:41-47``)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _reciprocal_rank_input_check(input, target)
+    return _reciprocal_rank_kernel(input, target, k)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _reciprocal_rank_kernel(
+    input: jax.Array, target: jax.Array, k: Optional[int]
+) -> jax.Array:
+    y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
+    rank = jnp.sum(input > y_score, axis=-1)
+    score = 1.0 / (rank + 1.0)
+    if k is not None:
+        score = jnp.where(rank >= k, 0.0, score)
+    return score
+
+
+def _reciprocal_rank_input_check(input: jax.Array, target: jax.Array) -> None:
+    if target.ndim != 1:
+        raise ValueError(
+            f"target should be a one-dimensional tensor, got shape {target.shape}."
+        )
+    if input.ndim != 2:
+        raise ValueError(
+            f"input should be a two-dimensional tensor, got shape {input.shape}."
+        )
+    if input.shape[0] != target.shape[0]:
+        raise ValueError(
+            "`input` and `target` should have the same minibatch dimension, "
+            f"got shapes {input.shape} and {target.shape}, respectively."
+        )

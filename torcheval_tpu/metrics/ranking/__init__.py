@@ -1,3 +1,5 @@
+from torcheval_tpu.metrics.ranking.hit_rate import HitRate
+from torcheval_tpu.metrics.ranking.reciprocal_rank import ReciprocalRank
 from torcheval_tpu.metrics.ranking.weighted_calibration import WeightedCalibration
 
-__all__ = ["WeightedCalibration"]
+__all__ = ["HitRate", "ReciprocalRank", "WeightedCalibration"]

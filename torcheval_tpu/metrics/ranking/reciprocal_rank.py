@@ -1,0 +1,38 @@
+"""ReciprocalRank metric — parity with reference
+``torcheval/metrics/ranking/reciprocal_rank.py`` (100 LoC)."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
+from torcheval_tpu.metrics.functional.ranking.reciprocal_rank import reciprocal_rank
+from torcheval_tpu.metrics.metric import Metric
+
+
+class ReciprocalRank(Metric[jax.Array]):
+    def __init__(self, *, k: Optional[int] = None, device=None) -> None:
+        super().__init__(device=device)
+        self.k = k
+        self._add_state("scores", [])
+
+    def update(self, input, target) -> "ReciprocalRank":
+        self.scores.append(
+            jax.device_put(reciprocal_rank(input, target, k=self.k), self.device)
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """Concatenated per-sample reciprocal ranks; empty array before any
+        update."""
+        if not self.scores:
+            return jnp.zeros(0)
+        return jnp.concatenate(self.scores, axis=0)
+
+    def merge_state(self, metrics: Iterable["ReciprocalRank"]) -> "ReciprocalRank":
+        merge_concat_buffers(self, metrics, "scores", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "scores", dim=0)

@@ -1,0 +1,43 @@
+"""Fused approximate AUC — the TPU analog of the reference's optional
+``fbgemm_gpu.metrics.auc`` hand-fused CUDA kernel (reference
+``torcheval/metrics/functional/classification/auroc.py:12-21,145-164``).
+
+Like fbgemm's kernel, this path is an *approximation*: it skips the
+redundant-value (tied-threshold) masking, trading exactness on highly
+redundant inputs for a shorter fused program — one sort + two cumsums +
+one trapezoid, no tie-group scan.  The exact path lives in
+``functional/classification/auroc.py``.
+
+This is pure-XLA today (sort + cumsum + dot fuse into a few TPU kernels);
+``torcheval_tpu.ops.pallas_auc`` holds the hand-written Pallas variant of
+the post-sort scan when available.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def has_fused() -> bool:
+    """Availability flag (the analog of the reference's ``has_fbgemm``,
+    reference ``classification/auroc.py:22-27``)."""
+    return True
+
+
+@jax.jit
+def fused_auc(input: jax.Array, target: jax.Array) -> jax.Array:
+    """Approximate AUC over the last axis; supports a leading task axis.
+
+    No tie masking: every sample is its own ROC point (matches
+    ``fbgemm_gpu.metrics.auc`` semantics).
+    """
+    squeeze = input.ndim == 1
+    if squeeze:
+        input, target = input[None], target[None]
+    order = jnp.argsort(-input, axis=-1)
+    sorted_target = jnp.take_along_axis(target, order, axis=-1)
+    cum_tp = jnp.cumsum(sorted_target, axis=-1).astype(jnp.float32)
+    cum_fp = jnp.cumsum(1 - sorted_target, axis=-1).astype(jnp.float32)
+    factor = cum_tp[:, -1] * cum_fp[:, -1]
+    area = jnp.trapezoid(cum_tp, cum_fp, axis=-1)
+    auc = jnp.where(factor == 0, 0.5, area / factor)
+    return auc[0] if squeeze else auc
