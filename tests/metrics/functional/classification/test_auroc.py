@@ -116,5 +116,29 @@ class TestMulticlassAUROC(unittest.TestCase):
             multiclass_auroc(np.zeros((2, 1)), np.zeros(2), num_classes=1)
 
 
+class TestEmptyInput(unittest.TestCase):
+    def test_empty_input_degenerate(self) -> None:
+        """Zero samples -> degenerate 0.5 per task/class, not an IndexError."""
+        self.assertEqual(
+            float(np.asarray(binary_auroc(np.zeros(0), np.zeros(0)))), 0.5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(
+                binary_auroc(np.zeros((3, 0)), np.zeros((3, 0)), num_tasks=3)
+            ),
+            np.full(3, 0.5),
+        )
+        self.assertEqual(
+            float(
+                np.asarray(
+                    multiclass_auroc(
+                        np.zeros((0, 4)), np.zeros(0, dtype=np.int32), num_classes=4
+                    )
+                )
+            ),
+            0.5,
+        )
+
+
 if __name__ == "__main__":
     unittest.main()

@@ -99,5 +99,19 @@ class TestMulticlassPRCurve(unittest.TestCase):
             )
 
 
+class TestEmptyInput(unittest.TestCase):
+    def test_empty_input_graceful(self) -> None:
+        """Zero samples -> sentinel-only curve, not an IndexError."""
+        p, r, t = binary_precision_recall_curve(np.zeros(0), np.zeros(0))
+        np.testing.assert_array_equal(np.asarray(p), [1.0])
+        np.testing.assert_array_equal(np.asarray(r), [0.0])
+        self.assertEqual(np.asarray(t).shape, (0,))
+        ps, rs, ts = multiclass_precision_recall_curve(
+            np.zeros((0, 3)), np.zeros(0, dtype=np.int32), num_classes=3
+        )
+        self.assertEqual((len(ps), len(rs), len(ts)), (3, 3, 3))
+        np.testing.assert_array_equal(np.asarray(ps[0]), [1.0])
+
+
 if __name__ == "__main__":
     unittest.main()

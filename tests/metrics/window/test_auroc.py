@@ -1,0 +1,190 @@
+"""WindowedBinaryAUROC protocol tests (mirrors reference
+``tests/metrics/window/test_auroc.py``)."""
+
+import numpy as np
+from sklearn.metrics import roc_auc_score
+
+from torcheval_tpu.metrics import WindowedBinaryAUROC
+from torcheval_tpu.metrics.functional import binary_auroc
+from torcheval_tpu.utils.test_utils.metric_class_tester import (
+    BATCH_SIZE,
+    NUM_TOTAL_UPDATES,
+    MetricClassTester,
+)
+
+RNG = np.random.default_rng(43)
+
+
+class TestWindowedBinaryAUROC(MetricClassTester):
+    def _run_with_input(self, input, target, max_num_samples=10) -> None:
+        # After 8 updates of 16 samples with window 10, the window is the
+        # last 10 samples.
+        flat_in, flat_tg = input.reshape(-1), target.reshape(-1)
+        compute_result = np.float32(
+            roc_auc_score(flat_tg[-max_num_samples:], flat_in[-max_num_samples:])
+        )
+        # Merge over 4 ranks × 2 updates: each rank's window is the last 10
+        # samples of its second batch.
+        merge_idx = np.concatenate(
+            [
+                np.arange(r * 2 * BATCH_SIZE + 2 * BATCH_SIZE - max_num_samples,
+                          (r + 1) * 2 * BATCH_SIZE)
+                for r in range(4)
+            ]
+        )
+        merge_compute_result = np.float32(
+            roc_auc_score(flat_tg[merge_idx], flat_in[merge_idx])
+        )
+        self.run_class_implementation_tests(
+            metric=WindowedBinaryAUROC(max_num_samples=max_num_samples),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=compute_result,
+            merge_and_compute_result=merge_compute_result,
+            atol=1e-5,
+            rtol=1e-4,
+            # merge changes the window size, so merge+update differs from
+            # update-only (reference test comment).
+            test_merge_with_one_update=False,
+        )
+
+    def test_auroc_class_base(self) -> None:
+        input = RNG.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        self._run_with_input(input, target)
+
+    def test_auroc_class_multiple_tasks(self) -> None:
+        num_tasks, w = 2, 10
+        input = RNG.random((NUM_TOTAL_UPDATES, num_tasks, BATCH_SIZE)).astype(
+            np.float32
+        )
+        target = RNG.integers(0, 2, (NUM_TOTAL_UPDATES, num_tasks, BATCH_SIZE))
+        flat_in = input.transpose(1, 0, 2).reshape(num_tasks, -1)
+        flat_tg = target.transpose(1, 0, 2).reshape(num_tasks, -1)
+        compute_result = binary_auroc(
+            flat_in[:, -w:], flat_tg[:, -w:], num_tasks=num_tasks
+        )
+        merge_idx = np.concatenate(
+            [
+                np.arange(r * 2 * BATCH_SIZE + 2 * BATCH_SIZE - w,
+                          (r + 1) * 2 * BATCH_SIZE)
+                for r in range(4)
+            ]
+        )
+        merge_compute_result = binary_auroc(
+            flat_in[:, merge_idx], flat_tg[:, merge_idx], num_tasks=num_tasks
+        )
+        self.run_class_implementation_tests(
+            metric=WindowedBinaryAUROC(num_tasks=num_tasks, max_num_samples=w),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=compute_result,
+            merge_and_compute_result=merge_compute_result,
+            atol=1e-5,
+            rtol=1e-4,
+            test_merge_with_one_update=False,
+        )
+
+    def test_small_batches_wrap_around(self) -> None:
+        """Window smaller than total but batches smaller than window: the
+        ring buffer wraps; result equals AUROC over the last w samples."""
+        w = 7
+        input = RNG.random((12, 3)).astype(np.float32)
+        target = RNG.integers(0, 2, (12, 3))
+        metric = WindowedBinaryAUROC(max_num_samples=w)
+        for i in range(12):
+            metric.update(input[i], target[i])
+        expected = roc_auc_score(target.reshape(-1)[-w:], input.reshape(-1)[-w:])
+        np.testing.assert_allclose(
+            np.asarray(metric.compute()), expected, atol=1e-5, rtol=1e-4
+        )
+
+    def test_partial_fill_with_zero_scores(self) -> None:
+        """Explicit fill tracking: genuine 0.0 scores in a full window must
+        not trigger the partial-fill path (divergence from the reference's
+        zero-suffix heuristic, reference ``window/auroc.py:158``)."""
+        metric = WindowedBinaryAUROC(max_num_samples=4)
+        metric.update(np.asarray([0.9, 0.8, 0.0, 0.0]), np.asarray([1, 0, 0, 1]))
+        expected = roc_auc_score([1, 0, 0, 1], [0.9, 0.8, 0.0, 0.0])
+        np.testing.assert_allclose(
+            np.asarray(metric.compute()), expected, atol=1e-5, rtol=1e-4
+        )
+
+    def test_empty_compute(self) -> None:
+        self.assertEqual(np.asarray(WindowedBinaryAUROC().compute()).shape, (0,))
+
+    def test_merge_partial_fill_excludes_padding(self) -> None:
+        """Padding columns left by merging a partially-filled window must not
+        count as samples (regression: total_samples is a lifetime counter,
+        not a fill level)."""
+        m1 = WindowedBinaryAUROC(max_num_samples=4)
+        m2 = WindowedBinaryAUROC(max_num_samples=4)
+        in1 = np.asarray([0.1, 0.9, 0.4, 0.6, 0.2, 0.8, 0.3, 0.7], np.float32)
+        tg1 = np.asarray([0, 1, 0, 1, 0, 1, 1, 0], np.float32)
+        m1.update(in1[:4], tg1[:4]).update(in1[4:], tg1[4:])
+        m2.update(np.asarray([0.5], np.float32), np.asarray([1.0], np.float32))
+        m1.merge_state([m2])
+        valid_in = np.concatenate([in1[4:], [0.5]])
+        valid_tg = np.concatenate([tg1[4:], [1.0]])
+        np.testing.assert_allclose(
+            np.asarray(m1.compute()),
+            roc_auc_score(valid_tg, valid_in),
+            atol=1e-5,
+            rtol=1e-4,
+        )
+
+    def test_reset_after_merge_restores_window(self) -> None:
+        """reset() must restore the pre-merge window size so the buffer and
+        the ring arithmetic agree (regression)."""
+        m1 = WindowedBinaryAUROC(max_num_samples=4)
+        m2 = WindowedBinaryAUROC(max_num_samples=4)
+        m1.update(np.asarray([0.2, 0.7]), np.asarray([0, 1]))
+        m2.update(np.asarray([0.3, 0.6]), np.asarray([1, 0]))
+        m1.merge_state([m2]).reset()
+        self.assertEqual(m1.max_num_samples, 4)
+        self.assertEqual(np.asarray(m1.inputs).shape, (1, 4))
+        scores = np.asarray([0.1, 0.8, 0.4, 0.9, 0.2, 0.6], np.float32)
+        labels = np.asarray([0, 1, 0, 1, 0, 1], np.float32)
+        for i in range(0, 6, 3):
+            m1.update(scores[i : i + 3], labels[i : i + 3])
+        np.testing.assert_allclose(
+            np.asarray(m1.compute()),
+            roc_auc_score(labels[-4:], scores[-4:]),
+            atol=1e-5,
+            rtol=1e-4,
+        )
+
+    def test_reset_clears_counters(self) -> None:
+        metric = WindowedBinaryAUROC(max_num_samples=4)
+        metric.update(np.asarray([0.2, 0.7]), np.asarray([0, 1])).reset()
+        self.assertEqual(metric.total_samples, 0)
+        self.assertEqual(metric.next_inserted, 0)
+        self.assertEqual(np.asarray(metric.compute()).shape, (0,))
+
+    def test_param_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "num_tasks"):
+            WindowedBinaryAUROC(num_tasks=0)
+        with self.assertRaisesRegex(ValueError, "max_num_samples"):
+            WindowedBinaryAUROC(max_num_samples=0)
+
+    def test_state_dict_round_trip_preserves_fill(self) -> None:
+        """Checkpoint restore must carry the ring bookkeeping, not just the
+        buffers (regression: _num_valid was lost, making compute empty)."""
+        m = WindowedBinaryAUROC(max_num_samples=4)
+        m.update(np.asarray([0.9, 0.1, 0.8]), np.asarray([1, 0, 1]))
+        m2 = WindowedBinaryAUROC(max_num_samples=4)
+        m2.load_state_dict(m.state_dict())
+        np.testing.assert_allclose(
+            np.asarray(m2.compute()), np.asarray(m.compute())
+        )
+        self.assertEqual(m2.total_samples, 3)
+        # restore of a merge-grown window keeps capacity consistent
+        o = WindowedBinaryAUROC(max_num_samples=4)
+        o.update(np.asarray([0.5]), np.asarray([1]))
+        m.merge_state([o])
+        m3 = WindowedBinaryAUROC(max_num_samples=4)
+        m3.load_state_dict(m.state_dict())
+        self.assertEqual(m3.max_num_samples, 8)
+        np.testing.assert_allclose(
+            np.asarray(m3.compute()), np.asarray(m.compute())
+        )

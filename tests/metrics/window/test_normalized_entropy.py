@@ -1,0 +1,214 @@
+"""WindowedBinaryNormalizedEntropy protocol tests (mirrors reference
+``tests/metrics/window/test_normalized_entropy.py``)."""
+
+import numpy as np
+
+from torcheval_tpu.metrics import WindowedBinaryNormalizedEntropy
+from torcheval_tpu.metrics.functional import binary_normalized_entropy
+from torcheval_tpu.utils.test_utils.metric_class_tester import MetricClassTester
+
+RNG = np.random.default_rng(44)
+
+_WINDOW_STATES = {
+    "windowed_total_entropy",
+    "windowed_num_examples",
+    "windowed_num_positive",
+}
+_ALL_STATES = _WINDOW_STATES | {"total_entropy", "num_examples", "num_positive"}
+
+
+def _logit(p):
+    return np.log(p) - np.log1p(-p)
+
+
+class TestWindowedBinaryNormalizedEntropy(MetricClassTester):
+    def test_ne_with_valid_input(self) -> None:
+        input = RNG.random((8, 16)).astype(np.float32)
+        target = RNG.integers(0, 2, (8, 16)).astype(np.float32)
+        weight = RNG.random((8, 16)).astype(np.float32)
+
+        # lifetime oracle: all samples
+        lifetime = binary_normalized_entropy(input.reshape(-1), target.reshape(-1))
+        weighted_lifetime = binary_normalized_entropy(
+            input.reshape(-1), target.reshape(-1), weight=weight.reshape(-1)
+        )
+        # windowed oracle: last max_num_updates=2 update calls
+        windowed = binary_normalized_entropy(
+            input[-2:].reshape(-1), target[-2:].reshape(-1)
+        )
+        weighted_windowed = binary_normalized_entropy(
+            input[-2:].reshape(-1),
+            target[-2:].reshape(-1),
+            weight=weight[-2:].reshape(-1),
+        )
+        # merged-window oracle (2 ranks × 4 updates, window keeps the last
+        # 2 updates of each rank): updates {2,3} and {6,7}
+        m_in = np.concatenate([input[2:4], input[6:]]).reshape(-1)
+        m_tg = np.concatenate([target[2:4], target[6:]]).reshape(-1)
+        m_wt = np.concatenate([weight[2:4], weight[6:]]).reshape(-1)
+        merged_windowed = binary_normalized_entropy(m_in, m_tg)
+        weighted_merged_windowed = binary_normalized_entropy(m_in, m_tg, weight=m_wt)
+
+        # lifetime disabled
+        self.run_class_implementation_tests(
+            metric=WindowedBinaryNormalizedEntropy(
+                max_num_updates=2, enable_lifetime=False
+            ),
+            state_names=set(_WINDOW_STATES),
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=windowed.reshape(-1),
+            merge_and_compute_result=merged_windowed.reshape(-1),
+            num_processes=2,
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+        # unweighted, probabilities
+        self.run_class_implementation_tests(
+            metric=WindowedBinaryNormalizedEntropy(max_num_updates=2),
+            state_names=set(_ALL_STATES),
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=(lifetime.reshape(-1), windowed.reshape(-1)),
+            merge_and_compute_result=(
+                lifetime.reshape(-1),
+                merged_windowed.reshape(-1),
+            ),
+            num_processes=2,
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+        # weighted, probabilities
+        self.run_class_implementation_tests(
+            metric=WindowedBinaryNormalizedEntropy(max_num_updates=2),
+            state_names=set(_ALL_STATES),
+            update_kwargs={
+                "input": list(input),
+                "target": list(target),
+                "weight": list(weight),
+            },
+            compute_result=(
+                weighted_lifetime.reshape(-1),
+                weighted_windowed.reshape(-1),
+            ),
+            merge_and_compute_result=(
+                weighted_lifetime.reshape(-1),
+                weighted_merged_windowed.reshape(-1),
+            ),
+            num_processes=2,
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+    def test_ne_from_logits(self) -> None:
+        input = RNG.random((8, 16)).astype(np.float32)
+        target = RNG.integers(0, 2, (8, 16)).astype(np.float32)
+        logits = _logit(np.clip(input, 1e-6, 1 - 1e-6)).astype(np.float32)
+        lifetime = binary_normalized_entropy(
+            logits.reshape(-1), target.reshape(-1), from_logits=True
+        )
+        windowed = binary_normalized_entropy(
+            logits[-2:].reshape(-1), target[-2:].reshape(-1), from_logits=True
+        )
+        merged_windowed = binary_normalized_entropy(
+            np.concatenate([logits[2:4], logits[6:]]).reshape(-1),
+            np.concatenate([target[2:4], target[6:]]).reshape(-1),
+            from_logits=True,
+        )
+        self.run_class_implementation_tests(
+            metric=WindowedBinaryNormalizedEntropy(
+                max_num_updates=2, from_logits=True
+            ),
+            state_names=set(_ALL_STATES),
+            update_kwargs={"input": list(logits), "target": list(target)},
+            compute_result=(lifetime.reshape(-1), windowed.reshape(-1)),
+            merge_and_compute_result=(
+                lifetime.reshape(-1),
+                merged_windowed.reshape(-1),
+            ),
+            num_processes=2,
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+    def test_ne_multi_task(self) -> None:
+        num_tasks = 2
+        input = RNG.random((8, num_tasks, 16)).astype(np.float32)
+        target = RNG.integers(0, 2, (8, num_tasks, 16)).astype(np.float32)
+        per_task = lambda sel: np.stack(  # noqa: E731
+            [
+                binary_normalized_entropy(
+                    sel(input)[:, t].reshape(-1), sel(target)[:, t].reshape(-1)
+                )
+                for t in range(num_tasks)
+            ]
+        )
+        lifetime = per_task(lambda x: x)
+        windowed = per_task(lambda x: x[-2:])
+        merged_windowed = per_task(
+            lambda x: np.concatenate([x[2:4], x[6:]])
+        )
+        self.run_class_implementation_tests(
+            metric=WindowedBinaryNormalizedEntropy(
+                max_num_updates=2, num_tasks=num_tasks
+            ),
+            state_names=set(_ALL_STATES),
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=(lifetime, windowed),
+            merge_and_compute_result=(lifetime, merged_windowed),
+            num_processes=2,
+            atol=1e-4,
+            rtol=1e-4,
+        )
+
+    def test_empty_compute(self) -> None:
+        metric = WindowedBinaryNormalizedEntropy(max_num_updates=2)
+        lifetime, windowed = metric.compute()
+        self.assertEqual(np.asarray(lifetime).shape, (0,))
+        self.assertEqual(np.asarray(windowed).shape, (0,))
+        metric = WindowedBinaryNormalizedEntropy(
+            max_num_updates=2, enable_lifetime=False
+        )
+        self.assertEqual(np.asarray(metric.compute()).shape, (0,))
+
+    def test_merge_grows_window(self) -> None:
+        """Divergence test: after merge, ``max_num_updates`` reflects the
+        enlarged window (the reference forgets to update it,
+        ``window/normalized_entropy.py:245-295``)."""
+        a = WindowedBinaryNormalizedEntropy(max_num_updates=2)
+        b = WindowedBinaryNormalizedEntropy(max_num_updates=3)
+        a.update(np.asarray([0.2, 0.8]), np.asarray([0.0, 1.0]))
+        b.update(np.asarray([0.4, 0.6]), np.asarray([1.0, 0.0]))
+        a.merge_state([b])
+        self.assertEqual(a.max_num_updates, 5)
+        self.assertEqual(a.next_inserted, 2)
+        self.assertEqual(a.total_updates, 2)
+
+    def test_reset_after_merge_restores_window(self) -> None:
+        """reset() must restore the pre-merge window size so the buffer and
+        the ring arithmetic agree (regression)."""
+        a = WindowedBinaryNormalizedEntropy(max_num_updates=2)
+        b = WindowedBinaryNormalizedEntropy(max_num_updates=3)
+        a.update(np.asarray([0.2, 0.8]), np.asarray([0.0, 1.0]))
+        b.update(np.asarray([0.4, 0.6]), np.asarray([1.0, 0.0]))
+        a.merge_state([b]).reset()
+        self.assertEqual(a.max_num_updates, 2)
+        self.assertEqual(np.asarray(a.windowed_total_entropy).shape, (1, 2))
+        input = RNG.random((3, 8)).astype(np.float32)
+        target = RNG.integers(0, 2, (3, 8)).astype(np.float32)
+        for i in range(3):
+            a.update(input[i], target[i])
+        _, windowed = a.compute()
+        expected = binary_normalized_entropy(
+            input[-2:].reshape(-1), target[-2:].reshape(-1)
+        )
+        np.testing.assert_allclose(
+            np.asarray(windowed), np.asarray(expected).reshape(-1),
+            atol=1e-4, rtol=1e-4,
+        )
+
+    def test_param_checks(self) -> None:
+        with self.assertRaisesRegex(ValueError, "num_tasks"):
+            WindowedBinaryNormalizedEntropy(num_tasks=0)
+        with self.assertRaisesRegex(ValueError, "max_num_updates"):
+            WindowedBinaryNormalizedEntropy(max_num_updates=0)

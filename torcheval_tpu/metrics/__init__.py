@@ -27,6 +27,10 @@ from torcheval_tpu.metrics.classification import (
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.ranking import HitRate, ReciprocalRank, WeightedCalibration
 from torcheval_tpu.metrics.regression import MeanSquaredError, R2Score
+from torcheval_tpu.metrics.window import (
+    WindowedBinaryAUROC,
+    WindowedBinaryNormalizedEntropy,
+)
 
 __all__ = [
     "BinaryAccuracy",
@@ -61,4 +65,6 @@ __all__ = [
     "Throughput",
     "TopKMultilabelAccuracy",
     "WeightedCalibration",
+    "WindowedBinaryAUROC",
+    "WindowedBinaryNormalizedEntropy",
 ]

@@ -11,6 +11,7 @@ from typing import Iterable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torcheval_tpu.metrics.metric import Metric
 
@@ -36,3 +37,94 @@ def prepare_concat_buffers(metric: Metric, *state_names: str, dim: int = -1) -> 
         buf = getattr(metric, name)
         if buf:
             setattr(metric, name, [jnp.concatenate(buf, axis=dim)])
+
+
+class RingWindowMixin:
+    """Shared machinery for windowed metrics whose state is a
+    ``(num_tasks, capacity)`` ring buffer per state name (WindowedBinaryAUROC,
+    WindowedBinaryNormalizedEntropy).
+
+    Invariant: valid columns always form the prefix ``[:, :_num_valid]`` —
+    in-order inserts extend it, wrapped inserts overwrite inside it, and
+    merge re-packs into it — so compute never needs the reference's
+    zero-suffix fill guess (reference ``window/auroc.py:158-164``).
+
+    Subclasses set ``_window_states`` (the ring-buffer state names) and call
+    ``_init_window`` from ``__init__``; the window capacity lives in
+    ``_window_capacity`` (exposed under the reference attribute names via
+    properties on each class).
+    """
+
+    _window_states: tuple = ()
+    # Host-side lifetime counters each subclass also wants checkpointed
+    # (e.g. "total_samples" / "total_updates").
+    _window_counters: tuple = ()
+
+    def _init_window(self, capacity: int) -> None:
+        self._window_capacity = capacity
+        self._init_window_capacity = capacity
+        self.next_inserted = 0
+        self._num_valid = 0
+
+    # ----------------------------------------------------------- checkpoint
+    # The ring bookkeeping is host-side Python ints, not registered array
+    # state, so it must ride state_dict explicitly or a checkpoint restore
+    # would silently drop the window fill level.  (The reference gets away
+    # without this because its compute *guesses* fill from the buffer.)
+    _WINDOW_META_KEY = "window_bookkeeping"
+
+    def state_dict(self):
+        out = super().state_dict()
+        meta = [self._window_capacity, self.next_inserted, self._num_valid]
+        meta += [getattr(self, name) for name in self._window_counters]
+        out[self._WINDOW_META_KEY] = np.asarray(meta, dtype=np.int64)
+        return out
+
+    def load_state_dict(self, state_dict, strict: bool = True) -> None:
+        state_dict = dict(state_dict)
+        meta = state_dict.pop(self._WINDOW_META_KEY, None)
+        if meta is not None:
+            values = [int(v) for v in jax.device_get(meta)]
+            self._window_capacity, self.next_inserted, self._num_valid = values[:3]
+            for name, value in zip(self._window_counters, values[3:]):
+                setattr(self, name, value)
+        super().load_state_dict(state_dict, strict=strict)
+
+    def _window_advance(self, n: int) -> None:
+        """Host-side bookkeeping after inserting ``n`` columns at
+        ``next_inserted`` (mod capacity)."""
+        self.next_inserted = (self.next_inserted + n) % self._window_capacity
+        self._num_valid = min(self._num_valid + n, self._window_capacity)
+
+    @staticmethod
+    def _valid_window(metric: "RingWindowMixin", name: str) -> jax.Array:
+        return getattr(metric, name)[:, : metric._num_valid]
+
+    def _window_merge(self, metrics) -> None:
+        """Pack every metric's valid columns into an enlarged window whose
+        capacity is the sum of all capacities (reference merge semantics,
+        ``window/auroc.py:166-207`` / ``window/normalized_entropy.py:232-296``
+        — with the capacity actually updated, which the reference's NE merge
+        forgets to do)."""
+        merged_w = self._window_capacity + sum(
+            m._window_capacity for m in metrics
+        )
+        idx = 0
+        for name in self._window_states:
+            pieces = [self._valid_window(self, name)] + [
+                jax.device_put(self._valid_window(m, name), self.device)
+                for m in metrics
+            ]
+            valid = jnp.concatenate(pieces, axis=1)
+            idx = valid.shape[1]
+            setattr(self, name, jnp.pad(valid, ((0, 0), (0, merged_w - idx))))
+        self._window_capacity = merged_w
+        self.next_inserted = idx % merged_w
+        self._num_valid = idx
+
+    def _window_reset(self) -> None:
+        """Restore the pre-merge capacity and zero the host counters
+        (divergence: the reference base-class reset leaves them stale)."""
+        self._window_capacity = self._init_window_capacity
+        self.next_inserted = 0
+        self._num_valid = 0

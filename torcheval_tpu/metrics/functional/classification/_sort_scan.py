@@ -1,0 +1,43 @@
+"""Shared descending-sort + tie-group-mask + cumulative-count core used by
+every threshold-curve kernel (AUROC and PR curves, binary and multiclass).
+
+The reference implements this block separately inside each TorchScript
+kernel (``auroc.py:111-142,188-217``, ``precision_recall_curve.py:154-180,
+206-229``); here it is one jit-traceable helper so tie-handling semantics
+can never drift between the exact and curve paths.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sorted_tie_cumsums(
+    scores: jax.Array, hits: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Row-wise threshold scan over ``(R, N)`` score/hit pairs.
+
+    Sorts each row by descending score and returns
+    ``(thresholds, is_last, cum_tp, cum_fp)``, all shaped ``(R, N)``:
+    ``thresholds`` the sorted scores, ``is_last`` flagging the last element
+    of each tie group, and the int32 cumulative true/false-positive counts.
+    """
+    indices = jnp.argsort(-scores, axis=-1)
+    thresholds = jnp.take_along_axis(scores, indices, axis=-1)
+    sorted_hits = jnp.take_along_axis(hits.astype(jnp.bool_), indices, axis=-1)
+    is_last = jnp.concatenate(
+        [
+            jnp.diff(thresholds, axis=-1) != 0,
+            jnp.ones((*thresholds.shape[:-1], 1), dtype=jnp.bool_),
+        ],
+        axis=-1,
+    )
+    cum_tp = jnp.cumsum(sorted_hits, axis=-1, dtype=jnp.int32)
+    cum_fp = jnp.cumsum(~sorted_hits, axis=-1, dtype=jnp.int32)
+    return thresholds, is_last, cum_tp, cum_fp
+
+
+def class_hits(target: jax.Array, num_classes: int) -> jax.Array:
+    """One-vs-rest hit matrix ``(C, N)``: row ``c`` flags ``target == c``."""
+    return target[None, :] == jnp.arange(num_classes)[:, None]

@@ -26,6 +26,10 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.classification._sort_scan import (
+    class_hits,
+    sorted_tie_cumsums,
+)
 from torcheval_tpu.ops.fused_auc import fused_auc
 
 
@@ -75,18 +79,7 @@ def _binary_auroc_compute_kernel(input: jax.Array, target: jax.Array) -> jax.Arr
     squeeze = input.ndim == 1
     if squeeze:
         input, target = input[None], target[None]
-    indices = jnp.argsort(-input, axis=-1)
-    threshold = jnp.take_along_axis(input, indices, axis=-1)
-    sorted_target = jnp.take_along_axis(target, indices, axis=-1)
-    is_last = jnp.concatenate(
-        [
-            jnp.diff(threshold, axis=-1) != 0,
-            jnp.ones((*threshold.shape[:-1], 1), dtype=jnp.bool_),
-        ],
-        axis=-1,
-    )
-    cum_tp = jnp.cumsum(sorted_target, axis=-1, dtype=jnp.int32)
-    cum_fp = jnp.cumsum(1 - sorted_target, axis=-1, dtype=jnp.int32)
+    _, is_last, cum_tp, cum_fp = sorted_tie_cumsums(input, target)
     tp_end = _group_end_values(cum_tp, is_last)
     fp_end = _group_end_values(cum_fp, is_last)
     zero = jnp.zeros((*cum_tp.shape[:-1], 1), dtype=cum_tp.dtype)
@@ -103,32 +96,40 @@ def _binary_auroc_compute(
     target: jax.Array,
     use_fused: Optional[bool] = False,
 ) -> jax.Array:
+    if input.shape[-1] == 0:
+        # Degenerate (no samples) → 0.5, the same convention the kernel
+        # applies when a task has no positives or no negatives.
+        return jnp.full(input.shape[:-1], 0.5, dtype=jnp.float32)
     if use_fused:
         return fused_auc(input, target)
     return _binary_auroc_compute_kernel(input, target)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "average"))
 def _multiclass_auroc_compute(
     input: jax.Array,
     target: jax.Array,
     num_classes: int,
     average: Optional[str] = "macro",
 ) -> jax.Array:
+    if input.shape[0] == 0:
+        # Degenerate (no samples) → 0.5 per class, matching the kernel's
+        # no-positives/no-negatives convention.
+        degenerate = jnp.full(num_classes, 0.5, dtype=jnp.float32)
+        return degenerate.mean() if average == "macro" else degenerate
+    return _multiclass_auroc_compute_kernel(input, target, num_classes, average)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _multiclass_auroc_compute_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+) -> jax.Array:
     # One-vs-rest: per-class column sort (reference ``auroc.py:188-217``)
-    scores = input.T  # (C, N)
-    indices = jnp.argsort(-scores, axis=1)
-    thresholds = jnp.take_along_axis(scores, indices, axis=1)
-    is_last = jnp.concatenate(
-        [
-            jnp.diff(thresholds, axis=1) != 0,
-            jnp.ones((num_classes, 1), dtype=jnp.bool_),
-        ],
-        axis=1,
+    _, is_last, cum_tp, cum_fp = sorted_tie_cumsums(
+        input.T, class_hits(target, num_classes)
     )
-    cmp = target[indices] == jnp.arange(num_classes)[:, None]
-    cum_tp = jnp.cumsum(cmp, axis=1, dtype=jnp.int32)
-    cum_fp = jnp.cumsum(~cmp, axis=1, dtype=jnp.int32)
     tp_end = _group_end_values(cum_tp, is_last)
     fp_end = _group_end_values(cum_fp, is_last)
     zero = jnp.zeros((num_classes, 1), dtype=cum_tp.dtype)

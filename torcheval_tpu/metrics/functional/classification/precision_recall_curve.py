@@ -14,6 +14,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu.metrics.functional.classification._sort_scan import (
+    class_hits,
+    sorted_tie_cumsums,
+)
+
 
 def binary_precision_recall_curve(
     input,
@@ -46,15 +51,10 @@ def _prc_device_kernel(
     input: jax.Array, target: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fixed-shape part: sort + tie mask + cumsums (binary, 1-D)."""
-    indices = jnp.argsort(-input)
-    threshold = input[indices]
-    is_last = jnp.concatenate(
-        [jnp.diff(threshold) != 0, jnp.ones(1, dtype=jnp.bool_)]
+    threshold, is_last, num_tp, num_fp = sorted_tie_cumsums(
+        input[None], (target == 1)[None]
     )
-    hit = target[indices] == 1
-    num_tp = jnp.cumsum(hit, dtype=jnp.int32)
-    num_fp = jnp.cumsum(~hit, dtype=jnp.int32)
-    return threshold, is_last, num_tp, num_fp
+    return threshold[0], is_last[0], num_tp[0], num_fp[0]
 
 
 def _binary_precision_recall_curve_compute(
@@ -84,9 +84,17 @@ def _materialize_curve(
     )
 
 
+def _empty_curve() -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Zero-sample curve: just the (1.0, 0.0) sentinel point, no thresholds."""
+    empty = np.zeros(0, dtype=np.int64)
+    return _materialize_curve(empty, empty, np.zeros(0, dtype=np.float32))
+
+
 def _compute_for_each_class(
     input: jax.Array, target: jax.Array, pos_label: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    if input.shape[-1] == 0:
+        return _empty_curve()
     threshold, is_last, num_tp, num_fp = jax.device_get(
         _prc_device_kernel(input, jnp.asarray(target == pos_label, dtype=jnp.int32))
     )
@@ -99,18 +107,7 @@ def _prc_multiclass_device_kernel(
     input: jax.Array, target: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Fixed-shape part, vectorized over classes: (C, N) sorts + cumsums."""
-    num_classes = input.shape[1]
-    scores = input.T
-    indices = jnp.argsort(-scores, axis=1)
-    thresholds = jnp.take_along_axis(scores, indices, axis=1)
-    is_last = jnp.concatenate(
-        [jnp.diff(thresholds, axis=1) != 0, jnp.ones((num_classes, 1), jnp.bool_)],
-        axis=1,
-    )
-    cmp = target[indices] == jnp.arange(num_classes)[:, None]
-    num_tp = jnp.cumsum(cmp, axis=1, dtype=jnp.int32)
-    num_fp = jnp.cumsum(~cmp, axis=1, dtype=jnp.int32)
-    return thresholds, is_last, num_tp, num_fp
+    return sorted_tie_cumsums(input.T, class_hits(target, input.shape[1]))
 
 
 def _multiclass_precision_recall_curve_compute(
@@ -120,6 +117,9 @@ def _multiclass_precision_recall_curve_compute(
 ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     if num_classes is None:
         num_classes = input.shape[1]
+    if input.shape[0] == 0:
+        curves = [_empty_curve() for _ in range(num_classes)]
+        return tuple(list(xs) for xs in zip(*curves))
     thresholds, is_last, num_tp, num_fp = jax.device_get(
         _prc_multiclass_device_kernel(input, target)
     )

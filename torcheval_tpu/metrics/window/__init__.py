@@ -1,0 +1,12 @@
+"""Windowed class metrics (reference ``torcheval/metrics/window/``):
+ring-buffer states over the last N samples / update calls."""
+
+from torcheval_tpu.metrics.window.auroc import WindowedBinaryAUROC
+from torcheval_tpu.metrics.window.normalized_entropy import (
+    WindowedBinaryNormalizedEntropy,
+)
+
+__all__ = [
+    "WindowedBinaryAUROC",
+    "WindowedBinaryNormalizedEntropy",
+]

@@ -1,0 +1,136 @@
+"""WindowedBinaryAUROC — parity with reference
+``torcheval/metrics/window/auroc.py`` (207 LoC).
+
+AUROC over the last ``max_num_samples`` samples.  State is a pre-allocated
+``(num_tasks, max_num_samples)`` ring buffer pair; the ring bookkeeping is
+host-side ints kept outside jit (SURVEY §7 hard part 6), shared with the
+windowed NE metric via :class:`~torcheval_tpu.metrics._buffer.RingWindowMixin`.
+
+TPU-first design notes
+----------------------
+* Insertion: the reference's three-branch wrap-around copy (reference
+  ``window/auroc.py:102-144``) collapses into ONE scatter with mod indices —
+  ``buf.at[:, (start + arange(n)) % W].set(batch)`` — which produces the
+  identical buffer layout and is a single fused XLA program.
+* Partial-fill detection: the reference guesses fill level from a zero
+  suffix (``window/auroc.py:158-164``), which misfires when genuine 0.0
+  scores land past the insertion point.  Here the valid-prefix length is
+  tracked explicitly (``_num_valid`` — documented divergence; observable
+  behavior matches whenever the heuristic is right).
+* Merge concatenates the valid samples of each window and **grows**
+  ``max_num_samples`` to the summed window size (reference
+  ``window/auroc.py:166-207``).  AUROC is order-invariant, so copying the
+  (possibly rotated) valid buffer region without unrotating is exact.
+"""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._buffer import RingWindowMixin
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_compute,
+    _binary_auroc_update_input_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class WindowedBinaryAUROC(RingWindowMixin, Metric[jax.Array]):
+    """The windowed version of BinaryAUROC: computed from the input and
+    target of the last ``max_num_samples`` samples
+    (reference ``window/auroc.py:23-54``)."""
+
+    _window_states = ("inputs", "targets")
+    _window_counters = ("total_samples",)
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        max_num_samples: int = 100,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        if max_num_samples < 1:
+            raise ValueError(
+                "`max_num_samples` value should be greater than and equal to 1, "
+                f"but received {max_num_samples}. "
+            )
+        self.num_tasks = num_tasks
+        self._init_window(max_num_samples)
+        self.total_samples = 0
+        self._add_state("inputs", jnp.zeros((num_tasks, max_num_samples)))
+        self._add_state("targets", jnp.zeros((num_tasks, max_num_samples)))
+
+    @property
+    def max_num_samples(self) -> int:
+        """Window capacity (grows on merge, reference attribute name)."""
+        return self._window_capacity
+
+    def update(self, input, target) -> "WindowedBinaryAUROC":
+        """Insert a batch of predictions/labels into the ring buffer
+        (reference ``window/auroc.py:85-144``)."""
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _binary_auroc_update_input_check(input, target, self.num_tasks)
+        if input.ndim == 1:
+            input = input.reshape(1, -1)
+            target = target.reshape(1, -1)
+        n = input.shape[1]
+        w = self.max_num_samples
+        if n >= w:
+            # Oversized batch: the window is exactly its last w samples.
+            self.inputs = jax.device_put(
+                jnp.asarray(input[:, -w:], dtype=self.inputs.dtype), self.device
+            )
+            self.targets = jax.device_put(
+                jnp.asarray(target[:, -w:], dtype=self.targets.dtype), self.device
+            )
+            self.next_inserted = 0
+            self._num_valid = w
+        else:
+            idx = (self.next_inserted + jnp.arange(n)) % w
+            self.inputs = self.inputs.at[:, idx].set(input.astype(self.inputs.dtype))
+            self.targets = self.targets.at[:, idx].set(
+                target.astype(self.targets.dtype)
+            )
+            self._window_advance(n)
+        self.total_samples += n
+        return self
+
+    def compute(self) -> jax.Array:
+        """AUROC of the current window; empty array before any update
+        (reference ``window/auroc.py:146-164``)."""
+        if self._num_valid == 0:
+            return jnp.zeros(0)
+        inputs = self.inputs[:, : self._num_valid]
+        targets = self.targets[:, : self._num_valid]
+        if self.num_tasks == 1:
+            inputs, targets = inputs[0], targets[0]
+        return _binary_auroc_compute(inputs, targets)
+
+    def merge_state(
+        self, metrics: Iterable["WindowedBinaryAUROC"]
+    ) -> "WindowedBinaryAUROC":
+        """Concatenate each window's valid samples into an enlarged window
+        whose size is the sum of all window sizes
+        (reference ``window/auroc.py:166-207``)."""
+        metrics = list(metrics)
+        self._window_merge(metrics)
+        for m in metrics:
+            self.total_samples += m.total_samples
+        return self
+
+    def reset(self) -> "WindowedBinaryAUROC":
+        """Reset states AND the host-side ring bookkeeping, including the
+        window size a previous merge may have grown (divergence: the
+        reference base-class reset leaves all of these stale)."""
+        super().reset()
+        self._window_reset()
+        self.total_samples = 0
+        return self

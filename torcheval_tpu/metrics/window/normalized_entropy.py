@@ -1,0 +1,166 @@
+"""WindowedBinaryNormalizedEntropy — parity with reference
+``torcheval/metrics/window/normalized_entropy.py`` (296 LoC).
+
+NE over the last ``max_num_updates`` *update calls* (the window counts
+updates, not samples — reference ``window/normalized_entropy.py:27``), plus
+optional lifetime values.  State: per-update windowed sufficient statistics
+``(num_tasks, max_num_updates)`` ×3 and, when ``enable_lifetime``, lifetime
+vectors ×3 (reference ``:104-144``; float64 there — see the accumulator
+dtype note in the functional NE module).  Ring bookkeeping is shared via
+:class:`~torcheval_tpu.metrics._buffer.RingWindowMixin`.
+
+Divergences (documented, both in favor of correctness):
+
+* merge updates ``max_num_updates`` to the enlarged size — the reference
+  forgets to (``window/normalized_entropy.py:245-295`` never assigns it),
+  leaving the modulo on the *old* size; the compute result is unaffected
+  (both branches of compute sum exactly the valid columns) but subsequent
+  updates would clobber merged columns mid-buffer.
+* ``reset()`` also restores the capacity and zeroes the host-side counters.
+"""
+
+from typing import Iterable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._buffer import RingWindowMixin
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    _accum_dtype,
+    _baseline_update,
+    _binary_normalized_entropy_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+_LIFETIME_STATES = ("total_entropy", "num_examples", "num_positive")
+
+
+class WindowedBinaryNormalizedEntropy(
+    RingWindowMixin, Metric[Union[jax.Array, Tuple[jax.Array, jax.Array]]]
+):
+    """Windowed (and optionally lifetime) normalized binary cross entropy
+    (reference ``window/normalized_entropy.py:22-77``)."""
+
+    _window_states = (
+        "windowed_total_entropy",
+        "windowed_num_examples",
+        "windowed_num_positive",
+    )
+    _window_counters = ("total_updates",)
+
+    def __init__(
+        self,
+        *,
+        from_logits: bool = False,
+        num_tasks: int = 1,
+        max_num_updates: int = 100,
+        enable_lifetime: bool = True,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        if max_num_updates < 1:
+            raise ValueError(
+                "`max_num_updates` value should be greater than and equal to 1, "
+                f"but received {max_num_updates}. "
+            )
+        self.from_logits = from_logits
+        self.num_tasks = num_tasks
+        self.enable_lifetime = enable_lifetime
+        self._init_window(max_num_updates)
+        self.total_updates = 0
+        dtype = _accum_dtype()
+        if enable_lifetime:
+            for name in _LIFETIME_STATES:
+                self._add_state(name, jnp.zeros(num_tasks, dtype=dtype))
+        for name in self._window_states:
+            self._add_state(name, jnp.zeros((num_tasks, max_num_updates), dtype=dtype))
+
+    @property
+    def max_num_updates(self) -> int:
+        """Window capacity (grows on merge, reference attribute name)."""
+        return self._window_capacity
+
+    def update(
+        self, input, target, *, weight=None
+    ) -> "WindowedBinaryNormalizedEntropy":
+        """Write this update's sufficient statistics into the next window
+        column (reference ``window/normalized_entropy.py:146-179``)."""
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        if weight is not None:
+            weight = jnp.asarray(weight)
+        cross_entropy, num_positive, num_examples = _binary_normalized_entropy_update(
+            input, target, self.from_logits, self.num_tasks, weight
+        )
+        if self.enable_lifetime:
+            self.total_entropy = self.total_entropy + cross_entropy
+            self.num_examples = self.num_examples + num_examples
+            self.num_positive = self.num_positive + num_positive
+        col = self.next_inserted
+        self.windowed_total_entropy = self.windowed_total_entropy.at[:, col].set(
+            cross_entropy
+        )
+        self.windowed_num_examples = self.windowed_num_examples.at[:, col].set(
+            num_examples
+        )
+        self.windowed_num_positive = self.windowed_num_positive.at[:, col].set(
+            num_positive
+        )
+        self._window_advance(1)
+        self.total_updates += 1
+        return self
+
+    def compute(self) -> Union[jax.Array, Tuple[jax.Array, jax.Array]]:
+        """``(lifetime, windowed)`` NE when ``enable_lifetime`` else the
+        windowed NE; empty array(s) before any update
+        (reference ``window/normalized_entropy.py:181-230``)."""
+        if self._num_valid == 0:
+            empty = jnp.zeros(0)
+            return (empty, empty) if self.enable_lifetime else empty
+
+        ncols = self._num_valid
+        w_entropy = self.windowed_total_entropy[:, :ncols].sum(axis=1)
+        w_examples = self.windowed_num_examples[:, :ncols].sum(axis=1)
+        w_positive = self.windowed_num_positive[:, :ncols].sum(axis=1)
+        windowed_ne = (w_entropy / w_examples) / _baseline_update(
+            w_positive, w_examples
+        )
+        if self.enable_lifetime:
+            lifetime_ne = (self.total_entropy / self.num_examples) / _baseline_update(
+                self.num_positive, self.num_examples
+            )
+            return lifetime_ne, windowed_ne
+        return windowed_ne
+
+    def merge_state(
+        self, metrics: Iterable["WindowedBinaryNormalizedEntropy"]
+    ) -> "WindowedBinaryNormalizedEntropy":
+        """Pack every metric's valid window columns into an enlarged window
+        (size = sum of window sizes) and add lifetime vectors
+        (reference ``window/normalized_entropy.py:232-296``)."""
+        metrics = list(metrics)
+        self._window_merge(metrics)
+        for m in metrics:
+            if self.enable_lifetime:
+                for name in _LIFETIME_STATES:
+                    setattr(
+                        self,
+                        name,
+                        getattr(self, name)
+                        + jax.device_put(getattr(m, name), self.device),
+                    )
+            self.total_updates += m.total_updates
+        return self
+
+    def reset(self) -> "WindowedBinaryNormalizedEntropy":
+        """Reset states AND the host-side window bookkeeping, including the
+        window size a previous merge may have grown (divergence: the
+        reference base-class reset leaves all of these stale)."""
+        super().reset()
+        self._window_reset()
+        self.total_updates = 0
+        return self
