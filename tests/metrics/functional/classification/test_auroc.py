@@ -142,3 +142,18 @@ class TestEmptyInput(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestFusedAUCLargeN(unittest.TestCase):
+    def test_fused_large_sample_count(self) -> None:
+        """>127 positives — regression for an int8 cumsum overflow in the
+        fused kernel's sort payload."""
+        rng = np.random.default_rng(3)
+        input = rng.random(5000).astype(np.float32)
+        target = rng.integers(0, 2, 5000).astype(np.float32)
+        expected = roc_auc_score(target, input)
+        np.testing.assert_allclose(
+            np.asarray(binary_auroc(input, target, use_fused=True)),
+            expected,
+            atol=1e-4,
+        )

@@ -212,3 +212,15 @@ class TestWindowedBinaryNormalizedEntropy(MetricClassTester):
             WindowedBinaryNormalizedEntropy(num_tasks=0)
         with self.assertRaisesRegex(ValueError, "max_num_updates"):
             WindowedBinaryNormalizedEntropy(max_num_updates=0)
+
+    def test_merge_lifetime_mismatch_raises_before_mutation(self) -> None:
+        """Mismatched ``enable_lifetime`` must fail fast, before the window
+        buffers are touched (regression: used to corrupt ``self`` first)."""
+        a = WindowedBinaryNormalizedEntropy(max_num_updates=2)
+        b = WindowedBinaryNormalizedEntropy(max_num_updates=2, enable_lifetime=False)
+        a.update(np.asarray([0.2, 0.8]), np.asarray([0.0, 1.0]))
+        b.update(np.asarray([0.4, 0.6]), np.asarray([1.0, 0.0]))
+        with self.assertRaisesRegex(ValueError, "enable_lifetime"):
+            a.merge_state([b])
+        self.assertEqual(a.max_num_updates, 2)
+        self.assertEqual(np.asarray(a.windowed_total_entropy).shape, (1, 2))

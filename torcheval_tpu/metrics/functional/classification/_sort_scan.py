@@ -23,9 +23,14 @@ def sorted_tie_cumsums(
     ``thresholds`` the sorted scores, ``is_last`` flagging the last element
     of each tie group, and the int32 cumulative true/false-positive counts.
     """
-    indices = jnp.argsort(-scores, axis=-1)
-    thresholds = jnp.take_along_axis(scores, indices, axis=-1)
-    sorted_hits = jnp.take_along_axis(hits.astype(jnp.bool_), indices, axis=-1)
+    # Variadic sort carries the hit payload through the sort itself; on TPU
+    # this is ~20x faster than argsort + two take_along_axis gathers (the
+    # gathers dominate at (1000, 131072): 3.95s vs 0.20s on v5e).
+    neg_thresholds, sorted_hits_i8 = jax.lax.sort(
+        (-scores, hits.astype(jnp.int8)), num_keys=1
+    )
+    thresholds = -neg_thresholds
+    sorted_hits = sorted_hits_i8.astype(jnp.bool_)
     is_last = jnp.concatenate(
         [
             jnp.diff(thresholds, axis=-1) != 0,

@@ -143,6 +143,12 @@ class WindowedBinaryNormalizedEntropy(
         (size = sum of window sizes) and add lifetime vectors
         (reference ``window/normalized_entropy.py:232-296``)."""
         metrics = list(metrics)
+        for m in metrics:
+            if m.enable_lifetime != self.enable_lifetime:
+                raise ValueError(
+                    "Merged metrics must all have the same `enable_lifetime` "
+                    f"setting; got {self.enable_lifetime} vs {m.enable_lifetime}."
+                )
         self._window_merge(metrics)
         for m in metrics:
             if self.enable_lifetime:

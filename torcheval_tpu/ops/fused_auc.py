@@ -16,6 +16,10 @@ the post-sort scan when available.
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional.classification._sort_scan import (
+    sorted_tie_cumsums,
+)
+
 
 def has_fused() -> bool:
     """Availability flag (the analog of the reference's ``has_fbgemm``,
@@ -33,10 +37,10 @@ def fused_auc(input: jax.Array, target: jax.Array) -> jax.Array:
     squeeze = input.ndim == 1
     if squeeze:
         input, target = input[None], target[None]
-    order = jnp.argsort(-input, axis=-1)
-    sorted_target = jnp.take_along_axis(target, order, axis=-1)
-    cum_tp = jnp.cumsum(sorted_target, axis=-1).astype(jnp.float32)
-    cum_fp = jnp.cumsum(1 - sorted_target, axis=-1).astype(jnp.float32)
+    # Same sort core as the exact path; only the tie mask is unused here.
+    _, _, cum_tp, cum_fp = sorted_tie_cumsums(input, target)
+    cum_tp = cum_tp.astype(jnp.float32)
+    cum_fp = cum_fp.astype(jnp.float32)
     factor = cum_tp[:, -1] * cum_fp[:, -1]
     area = jnp.trapezoid(cum_tp, cum_fp, axis=-1)
     auc = jnp.where(factor == 0, 0.5, area / factor)
