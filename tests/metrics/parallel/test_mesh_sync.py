@@ -1,0 +1,215 @@
+"""Mesh-sync tests on the virtual 8-device CPU platform (conftest.py forces
+``--xla_force_host_platform_device_count=8`` — the TPU analog of the
+reference's CPU-only 4-process gloo CI, reference
+``metric_class_tester.py:286-299``)."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import roc_auc_score
+
+from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
+from torcheval_tpu.metrics.functional.classification.accuracy import (
+    _multiclass_accuracy_update_kernel,
+)
+from torcheval_tpu.parallel import (
+    make_mesh,
+    make_synced_update,
+    mesh_merge_states,
+    replicate,
+    shard_batch,
+    sharded_auroc_histogram,
+)
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+class TestMakeMesh(unittest.TestCase):
+    def test_shapes(self):
+        self.assertEqual(make_mesh().devices.shape, (8,))
+        self.assertEqual(make_mesh(4).devices.shape, (4,))
+        mesh = make_mesh((4, 2), ("dp", "sp"))
+        self.assertEqual(mesh.devices.shape, (4, 2))
+        self.assertEqual(mesh.axis_names, ("dp", "sp"))
+
+    def test_errors(self):
+        with self.assertRaises(ValueError):
+            make_mesh(16)
+        with self.assertRaises(ValueError):
+            make_mesh((2, 2), ("dp",))
+
+
+class TestTransparentSPMD(unittest.TestCase):
+    """Class metrics accept mesh-sharded inputs with no extra code: the jitted
+    update kernels are pure, so XLA's partitioner inserts the collectives."""
+
+    def test_accuracy_sharded_equals_unsharded(self):
+        mesh = make_mesh()
+        rng = np.random.default_rng(0)
+        scores = jnp.asarray(rng.random((64, 5), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 5, 64, dtype=np.int32))
+
+        plain = MulticlassAccuracy(num_classes=5)
+        plain.update(scores, target)
+
+        # Place counter states mesh-replicated so state+delta arithmetic
+        # stays on-mesh (the metric's "device" is a Sharding under SPMD).
+        sharded = MulticlassAccuracy(
+            num_classes=5, device=NamedSharding(mesh, PartitionSpec())
+        )
+        s_scores, s_target = shard_batch(mesh, scores, target)
+        sharded.update(s_scores, s_target)
+
+        np.testing.assert_allclose(
+            np.asarray(plain.compute()), np.asarray(sharded.compute()), rtol=1e-6
+        )
+
+    def test_buffer_metric_sharded_input(self):
+        mesh = make_mesh()
+        rng = np.random.default_rng(1)
+        scores = jnp.asarray(rng.random(256, dtype=np.float32))
+        target = jnp.asarray((rng.random(256) > 0.5).astype(np.float32))
+
+        metric = BinaryAUROC()
+        metric.update(*shard_batch(mesh, scores, target))
+        expected = roc_auc_score(np.asarray(target), np.asarray(scores))
+        np.testing.assert_allclose(float(metric.compute()), expected, rtol=1e-5)
+
+
+class TestMakeSyncedUpdate(unittest.TestCase):
+    def test_accuracy_counters_psum(self):
+        mesh = make_mesh()
+        rng = np.random.default_rng(2)
+        scores = jnp.asarray(rng.random((64, 5), dtype=np.float32))
+        target = jnp.asarray(rng.integers(0, 5, 64, dtype=np.int32))
+
+        step = make_synced_update(
+            lambda s, t: _multiclass_accuracy_update_kernel(s, t, "micro", 5, 1),
+            mesh,
+        )
+        num_correct, num_total = step(*shard_batch(mesh, scores, target))
+        ref_correct, ref_total = _multiclass_accuracy_update_kernel(
+            scores, target, "micro", 5, 1
+        )
+        self.assertEqual(int(num_total), int(ref_total))
+        self.assertEqual(int(num_correct), int(ref_correct))
+        # Result is replicated — every device holds the global counters.
+        self.assertTrue(num_total.sharding.is_fully_replicated)
+
+    def test_extrema_reductions(self):
+        mesh = make_mesh()
+        data = jnp.arange(32, dtype=jnp.float32)
+        step = make_synced_update(
+            lambda x: {"max": x.max(), "min": x.min()},
+            mesh,
+            reductions={"max": "max", "min": "min"},
+        )
+        out = step(shard_batch(mesh, data))
+        self.assertEqual(float(out["max"]), 31.0)
+        self.assertEqual(float(out["min"]), 0.0)
+
+    def test_concat_reduction(self):
+        mesh = make_mesh()
+        data = jnp.arange(16, dtype=jnp.float32)
+        step = make_synced_update(lambda x: x * 2, mesh, reductions="concat")
+        out = step(shard_batch(mesh, data))
+        np.testing.assert_array_equal(np.asarray(out), np.arange(16) * 2)
+
+    def test_mesh_merge_states_inside_user_shard_map(self):
+        mesh = make_mesh()
+        data = jnp.ones(24, dtype=jnp.float32)
+
+        def local(x):
+            return mesh_merge_states({"n": x.sum()}, "dp")
+
+        fn = jax.jit(
+            jax.shard_map(
+                local,
+                mesh=mesh,
+                in_specs=PartitionSpec("dp"),
+                out_specs=PartitionSpec(),
+            )
+        )
+        self.assertEqual(float(fn(data)["n"]), 24.0)
+
+    def test_unknown_reduction_raises(self):
+        mesh = make_mesh()
+        step = make_synced_update(lambda x: x.sum(), mesh, reductions="prod")
+        with self.assertRaisesRegex(ValueError, "Unknown reduction"):
+            step(shard_batch(mesh, jnp.ones(8)))
+
+
+class TestShardedAUROCHistogram(unittest.TestCase):
+    def test_matches_exact_on_quantized_scores(self):
+        mesh = make_mesh()
+        rng = np.random.default_rng(3)
+        num_bins = 1024
+        # Scores already quantized to bin centers → histogram AUROC is exact.
+        scores = rng.integers(0, num_bins, 4096).astype(np.float32) / num_bins
+        target = (rng.random(4096) > 0.6).astype(np.float32)
+        got = sharded_auroc_histogram(
+            *shard_batch(mesh, jnp.asarray(scores), jnp.asarray(target)),
+            mesh=mesh,
+            num_bins=num_bins,
+        )
+        expected = roc_auc_score(target, scores)
+        np.testing.assert_allclose(float(got), expected, atol=1e-6)
+
+    def test_close_on_continuous_scores(self):
+        mesh = make_mesh()
+        rng = np.random.default_rng(4)
+        scores = rng.random(8192).astype(np.float32)
+        target = (rng.random(8192) > 0.5).astype(np.float32)
+        got = sharded_auroc_histogram(
+            *shard_batch(mesh, jnp.asarray(scores), jnp.asarray(target)),
+            mesh=mesh,
+            num_bins=8192,
+        )
+        expected = roc_auc_score(target, scores)
+        np.testing.assert_allclose(float(got), expected, atol=2e-3)
+
+    def test_degenerate_single_class(self):
+        mesh = make_mesh()
+        scores = jnp.linspace(0, 1, 16)
+        target = jnp.ones(16)
+        got = sharded_auroc_histogram(
+            *shard_batch(mesh, scores, target), mesh=mesh, num_bins=64
+        )
+        self.assertEqual(float(got), 0.5)
+
+    def test_weighted(self):
+        mesh = make_mesh()
+        rng = np.random.default_rng(5)
+        num_bins = 512
+        scores = rng.integers(0, num_bins, 2048).astype(np.float32) / num_bins
+        target = (rng.random(2048) > 0.5).astype(np.float32)
+        weights = rng.random(2048).astype(np.float32)
+        s_scores, s_target, s_weights = shard_batch(
+            mesh, jnp.asarray(scores), jnp.asarray(target), jnp.asarray(weights)
+        )
+        got = sharded_auroc_histogram(
+            s_scores, s_target, mesh=mesh, num_bins=num_bins, weights=s_weights
+        )
+        expected = roc_auc_score(target, scores, sample_weight=weights)
+        np.testing.assert_allclose(float(got), expected, atol=1e-5)
+
+    def test_bad_shape_raises(self):
+        mesh = make_mesh()
+        with self.assertRaisesRegex(ValueError, "1-D"):
+            sharded_auroc_histogram(
+                jnp.ones((2, 2)), jnp.ones((2, 2)), mesh=mesh
+            )
+
+
+class TestReplicate(unittest.TestCase):
+    def test_replicate_tree(self):
+        mesh = make_mesh()
+        tree = {"a": jnp.ones(4), "b": [jnp.zeros(2)]}
+        out = replicate(mesh, tree)
+        self.assertTrue(out["a"].sharding.is_fully_replicated)
+        self.assertTrue(out["b"][0].sharding.is_fully_replicated)
+
+
+if __name__ == "__main__":
+    unittest.main()

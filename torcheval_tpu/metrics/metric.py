@@ -50,14 +50,26 @@ TState = Union[
 
 TSelf = TypeVar("TSelf", bound="Metric")
 
-DeviceLike = Union[str, jax.Device, None]
+DeviceLike = Union[str, jax.Device, jax.sharding.Sharding, None]
+
+# Where a metric's states live: a single device, or a Sharding placement over
+# a mesh under SPMD.
+Placement = Union[jax.Device, jax.sharding.Sharding]
 
 
-def canonicalize_device(device: DeviceLike) -> jax.Device:
-    """Resolve ``None`` / ``"cpu"`` / ``"tpu:0"`` / ``jax.Device`` to a Device."""
+def canonicalize_device(device: DeviceLike) -> Placement:
+    """Resolve ``None`` / ``"cpu"`` / ``"tpu:0"`` / ``jax.Device`` to a Device.
+
+    A ``jax.sharding.Sharding`` passes through unchanged: under SPMD a
+    metric's "device" is a placement over the mesh (usually
+    ``NamedSharding(mesh, PartitionSpec())`` so counter states are replicated
+    and arithmetic with mesh-sharded update outputs stays on-mesh).  This is
+    the TPU generalization of the reference's single-device ``.to()``
+    (reference ``metric.py:221-266``).
+    """
     if device is None:
         return jax.devices()[0]
-    if isinstance(device, jax.Device):
+    if isinstance(device, (jax.Device, jax.sharding.Sharding)):
         return device
     if isinstance(device, str):
         if ":" in device:
@@ -93,7 +105,7 @@ def _zero_scalar() -> jax.Array:
     return jnp.asarray(0.0)
 
 
-def _move_state(value: TState, device: jax.Device) -> TState:
+def _move_state(value: TState, device: "Placement") -> TState:
     """Copy a state value onto ``device`` (containers are shallow-copied;
     defaultdict-ness is preserved)."""
     if _is_array(value):
@@ -117,7 +129,7 @@ class Metric(Generic[TComputeReturn], ABC):
     update/compute/merge lifecycle (reference ``Metric``, ``metric.py:23``)."""
 
     def __init__(self: TSelf, *, device: DeviceLike = None) -> None:
-        self._device: jax.Device = canonicalize_device(device)
+        self._device: Placement = canonicalize_device(device)
         self._state_name_to_default: Dict[str, TState] = {}
 
     # ------------------------------------------------------------------ state
@@ -251,7 +263,7 @@ class Metric(Generic[TComputeReturn], ABC):
         return self
 
     @property
-    def device(self) -> jax.Device:
+    def device(self) -> "Placement":
         """The device all state currently lives on (reference ``metric.py:268-274``)."""
         return self._device
 
@@ -259,7 +271,12 @@ class Metric(Generic[TComputeReturn], ABC):
     def __getstate__(self) -> Dict[str, Any]:
         state = self.__dict__.copy()
         # jax.Device objects are not picklable; record platform:index instead.
+        # A mesh Sharding placement degrades to its first device: the
+        # receiving process (object-sync path) has its own mesh and must
+        # re-place with ``.to(sharding)`` if it wants SPMD state.
         device = state.pop("_device")
+        if isinstance(device, jax.sharding.Sharding):
+            device = min(device.device_set, key=lambda d: d.id)
         state["_device_str"] = f"{device.platform}:{device.id}"
         return {k: _to_numpy_tree(v) for k, v in state.items()}
 

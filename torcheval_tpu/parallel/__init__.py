@@ -1,0 +1,48 @@
+"""Mesh / SPMD parallelism — the TPU-native distributed execution layer.
+
+The reference's distributed story is one process per rank, each holding its
+own ``Metric`` object, synchronized by pickling whole objects through
+``torch.distributed`` object collectives (reference ``toolkit.py:247-255``).
+A TPU pod runs the opposite model: one logical SPMD program over a
+``jax.sharding.Mesh``; arrays are sharded, and XLA inserts the collectives
+(``psum`` / ``all_gather``) that ride ICI/DCN.
+
+This package provides that layer:
+
+* :mod:`torcheval_tpu.parallel.mesh` — mesh construction and batch-sharding
+  helpers (``make_mesh``, ``shard_batch``, ``replicate``).
+* :mod:`torcheval_tpu.parallel.sync` — explicit in-jit state sync:
+  ``make_synced_update`` wraps any functional sufficient-statistic kernel in
+  ``shard_map`` so each device reduces its local batch shard and one fused
+  collective merges the partials (``psum``/``pmax``/``pmin`` chosen per state,
+  mirroring each metric's ``merge_state`` semantics); ``mesh_merge_states``
+  is the raw per-leaf collective for use inside user ``shard_map`` code.
+
+Note the *implicit* path needs no code at all: class metrics already accept
+mesh-sharded inputs — their update kernels are jitted pure functions, so
+XLA's partitioner auto-inserts the same collectives (verified by
+``tests/metrics/parallel/test_mesh_sync.py``).  Use the explicit path when
+you want guaranteed single-collective sync or per-shard control.
+"""
+
+from torcheval_tpu.parallel.mesh import (
+    device_count,
+    make_mesh,
+    replicate,
+    shard_batch,
+)
+from torcheval_tpu.parallel.sync import (
+    make_synced_update,
+    mesh_merge_states,
+    sharded_auroc_histogram,
+)
+
+__all__ = [
+    "device_count",
+    "make_mesh",
+    "make_synced_update",
+    "mesh_merge_states",
+    "replicate",
+    "shard_batch",
+    "sharded_auroc_histogram",
+]

@@ -1,0 +1,82 @@
+"""Mesh construction and sharding helpers.
+
+The mesh is the TPU-native replacement for the reference's process group
+(reference ``PGWrapper``, ``toolkit.py:16``): a named axis over the devices
+that collectives reduce along.  A 1-D ``("dp",)`` mesh is the data-parallel
+analog of the reference's world; a 2-D ``("dp", "sp")`` mesh additionally
+shards the *sample* dimension of buffer-state metrics (AUROC / PR-curve
+score buffers) — the scaling axis this library actually has (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def device_count() -> int:
+    """Global device count (addressable by this controller's program — the
+    pod size under multi-host SPMD, which is what mesh shapes are sized by).
+    Use ``jax.local_device_count()`` for the per-host count."""
+    return len(jax.devices())
+
+
+def make_mesh(
+    shape: Union[int, Sequence[int], None] = None,
+    axis_names: Tuple[str, ...] = ("dp",),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named device mesh.
+
+    ``shape`` may be an int (1-D mesh over the first N devices), a tuple
+    (multi-D mesh), or ``None`` (all devices on a 1-D mesh).  Device order
+    follows ``jax.devices()`` so a 1-D axis rides the ICI ring on real
+    hardware.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if shape is None:
+        shape = (len(devs),) if len(axis_names) == 1 else None
+    if shape is None:
+        raise ValueError("shape is required for a multi-axis mesh")
+    if isinstance(shape, int):
+        shape = (shape,)
+    shape = tuple(shape)
+    if len(shape) != len(axis_names):
+        raise ValueError(f"shape {shape} does not match axis_names {axis_names}")
+    n = int(np.prod(shape))
+    if n > len(devs):
+        raise ValueError(f"mesh of {n} devices requested, {len(devs)} available")
+    grid = np.asarray(devs[:n], dtype=object).reshape(shape)
+    return Mesh(grid, axis_names)
+
+
+def shard_batch(
+    mesh: Mesh,
+    *arrays: jax.Array,
+    axis: str = "dp",
+    dim: int = 0,
+) -> Union[jax.Array, Tuple[jax.Array, ...]]:
+    """Place arrays with dimension ``dim`` sharded over mesh axis ``axis``.
+
+    The sharded batch is the SPMD analog of the reference's per-rank data
+    shard (reference ``metric_class_tester.py:301-326`` deals update batches
+    to ranks); here a single logical array spans the mesh.
+    """
+    out = []
+    for a in arrays:
+        d = dim if dim >= 0 else dim + a.ndim
+        if not 0 <= d < a.ndim:
+            raise ValueError(f"dim {dim} out of range for array of rank {a.ndim}")
+        spec = [None] * (d + 1)
+        spec[d] = axis
+        out.append(jax.device_put(a, NamedSharding(mesh, PartitionSpec(*spec))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate every array leaf of ``tree`` across the whole mesh."""
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
