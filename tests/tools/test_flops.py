@@ -1,0 +1,68 @@
+"""FLOP-counter tests (reference ``tests/tools/test_flops.py:11-30`` asserts
+per-op counts on hand-built graphs; here the oracle is the analytic FLOP
+count of known matmul shapes, which XLA's cost model reports exactly)."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.tools import flops_of, forward_backward_flops, cost_summary
+from torcheval_tpu.tools.flops import UNKNOWN_FLOPS
+
+
+class TestFlopsOf(unittest.TestCase):
+    def test_matmul_flops_exact(self):
+        # (M,K)@(K,N) is 2*M*K*N FLOPs.
+        a = jnp.ones((16, 32))
+        b = jnp.ones((32, 8))
+        self.assertEqual(flops_of(jnp.matmul, a, b), 2 * 16 * 32 * 8)
+
+    def test_abstract_avals_no_execution(self):
+        a = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+        b = jax.ShapeDtypeStruct((64, 64), jnp.float32)
+        self.assertEqual(flops_of(jnp.matmul, a, b), 2 * 64 * 64 * 64)
+
+    def test_elementwise_counts(self):
+        x = jnp.ones((100,))
+        self.assertEqual(flops_of(lambda v: v + v, x), 100)
+
+    def test_cost_summary_has_flops(self):
+        summary = cost_summary(jnp.matmul, jnp.ones((4, 4)), jnp.ones((4, 4)))
+        self.assertIn("flops", summary)
+
+
+class TestForwardBackwardFlops(unittest.TestCase):
+    def test_linear_forward_backward(self):
+        variables = {"params": {"w": jnp.ones((32, 8))}}
+
+        def apply_fn(v, x):
+            return x @ v["params"]["w"]
+
+        x = jnp.ones((16, 32))
+        fwd, bwd = forward_backward_flops(apply_fn, variables, x)
+        self.assertEqual(fwd, 2 * 16 * 32 * 8)
+        # Backward of a matmul computes dW = x^T @ dy (same FLOPs as forward)
+        # plus the loss scaffolding; it must be at least the dW matmul.
+        self.assertGreaterEqual(bwd, 2 * 16 * 32 * 8)
+
+    def test_no_params_collection(self):
+        fwd, bwd = forward_backward_flops(
+            lambda v, x: x * 2.0, {}, jnp.ones((10,))
+        )
+        self.assertEqual(fwd, 10)
+        self.assertEqual(bwd, UNKNOWN_FLOPS)
+
+    def test_integer_output_degrades(self):
+        variables = {"params": {"w": jnp.ones((4, 4))}}
+        fwd, bwd = forward_backward_flops(
+            lambda v, x: jnp.argmax(x @ v["params"]["w"], -1),
+            variables,
+            jnp.ones((4, 4)),
+        )
+        self.assertGreaterEqual(fwd, 0)
+        self.assertEqual(bwd, UNKNOWN_FLOPS)
+
+
+if __name__ == "__main__":
+    unittest.main()

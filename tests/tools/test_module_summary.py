@@ -1,0 +1,159 @@
+"""Module-summary tests (reference ``tests/tools/test_module_summary.py``:
+parameter counts, FLOPs, table rendering, pruning — on flax models)."""
+
+import unittest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.tools import (
+    get_module_summary,
+    get_summary_table,
+    prune_module_summary,
+)
+from torcheval_tpu.tools.module_summary import _get_human_readable_count
+
+
+class Block(nn.Module):
+    feat: int
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.relu(nn.Dense(self.feat)(x))
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = Block(64)(x)
+        return nn.Dense(10)(x)
+
+
+class TestGetModuleSummary(unittest.TestCase):
+    def summary(self, **kwargs):
+        return get_module_summary(MLP(), (jnp.ones((4, 32)),), **kwargs)
+
+    def test_parameter_counts(self):
+        s = self.summary(compute_flops=False)
+        # 32*64+64 (Block Dense) + 64*10+10 (head) = 2112 + 650
+        self.assertEqual(s.num_parameters, 2762)
+        self.assertEqual(s.num_trainable_parameters, 2762)
+        self.assertEqual(s.size_bytes, 2762 * 4)
+        self.assertEqual(s.module_type, "MLP")
+        self.assertFalse(s.has_uninitialized_param)
+
+        block = s.submodule_summaries["Block_0"]
+        self.assertEqual(block.num_parameters, 2112)
+        self.assertEqual(block.module_type, "Block")
+        inner = block.submodule_summaries["Block_0.Dense_0"]
+        self.assertEqual(inner.num_parameters, 2112)
+        self.assertEqual(inner.module_type, "Dense")
+
+        head = s.submodule_summaries["Dense_0"]
+        self.assertEqual(head.num_parameters, 650)
+
+    def test_flops(self):
+        s = self.summary()
+        # Root forward: Dense(32→64) + relu + Dense(64→10) on batch 4:
+        # 2*4*32*64 + 4*64 + 2*4*64*10 = 16384 + 256 + 5120 = 21760, and
+        # bias adds 4*64 + 4*10. Allow the cost model its exact total but
+        # pin the dominant matmul terms as a lower bound and 2x as an upper.
+        self.assertGreaterEqual(s.flops_forward, 21760)
+        self.assertLess(s.flops_forward, 2 * 21760)
+        self.assertGreater(s.flops_backward, 0)
+        block = s.submodule_summaries["Block_0"]
+        self.assertGreaterEqual(block.flops_forward, 2 * 4 * 32 * 64)
+
+    def test_non_trainable_collections_counted(self):
+        class WithStats(nn.Module):
+            @nn.compact
+            def __call__(self, x, train: bool = False):
+                return nn.BatchNorm(use_running_average=not train)(x)
+
+        m = WithStats()
+        x = jnp.ones((4, 8))
+        s = get_module_summary(m, (x,), compute_flops=False)
+        # scale+bias trainable (16), running mean/var not (16).
+        self.assertEqual(s.num_trainable_parameters, 16)
+        self.assertEqual(s.num_parameters, 32)
+
+    def test_precomputed_variables_and_avals(self):
+        m = MLP()
+        variables = m.init(jax.random.PRNGKey(0), jnp.ones((4, 32)))
+        s = get_module_summary(
+            m,
+            (jax.ShapeDtypeStruct((4, 32), jnp.float32),),
+            variables=variables,
+        )
+        self.assertEqual(s.num_parameters, 2762)
+        self.assertGreater(s.flops_forward, 0)
+
+
+class TestSummaryTable(unittest.TestCase):
+    def test_table_contains_rows_and_remark(self):
+        s = get_module_summary(MLP(), (jnp.ones((4, 32)),))
+        table = get_summary_table(s)
+        self.assertIn("Name", table)
+        self.assertIn("Block_0.Dense_0", table)
+        self.assertIn("Forward FLOPs", table)
+        self.assertIn("Remark for FLOPs calculation", table)
+
+    def test_table_without_flops_drops_columns(self):
+        s = get_module_summary(MLP(), (jnp.ones((4, 32)),), compute_flops=False)
+        table = get_summary_table(s)
+        self.assertNotIn("Forward FLOPs", table)
+        self.assertNotIn("Remark", table)
+
+    def test_exact_numbers(self):
+        s = get_module_summary(MLP(), (jnp.ones((4, 32)),), compute_flops=False)
+        table = get_summary_table(s, human_readable_nums=False)
+        self.assertIn("2762", table)
+
+    def test_str_is_table(self):
+        s = get_module_summary(MLP(), (jnp.ones((4, 32)),), compute_flops=False)
+        self.assertEqual(str(s), get_summary_table(s))
+
+
+class TestPrune(unittest.TestCase):
+    def test_prune_depth(self):
+        s = get_module_summary(MLP(), (jnp.ones((4, 32)),), compute_flops=False)
+        prune_module_summary(s, max_depth=2)
+        block = s.submodule_summaries["Block_0"]
+        self.assertEqual(block.submodule_summaries, {})
+
+    def test_prune_to_root(self):
+        s = get_module_summary(MLP(), (jnp.ones((4, 32)),), compute_flops=False)
+        prune_module_summary(s, max_depth=1)
+        self.assertEqual(s.submodule_summaries, {})
+
+    def test_invalid_depth(self):
+        s = get_module_summary(MLP(), (jnp.ones((4, 32)),), compute_flops=False)
+        with self.assertRaisesRegex(ValueError, "max_depth"):
+            prune_module_summary(s, max_depth=0)
+
+
+class TestHumanReadableCount(unittest.TestCase):
+    def test_values(self):
+        self.assertEqual(_get_human_readable_count(123).strip(), "123")
+        self.assertEqual(_get_human_readable_count(1234), "1.2 K")
+        self.assertEqual(_get_human_readable_count(2 * 10**6), "2.0 M")
+        self.assertEqual(_get_human_readable_count(3 * 10**9), "3.0 B")
+        self.assertEqual(
+            _get_human_readable_count(3 * 10**9, labels=[" ", "K", "M", "G", "T"]),
+            "3.0 G",
+        )
+        self.assertEqual(_get_human_readable_count(4 * 10**14), "400 T")
+        self.assertEqual(_get_human_readable_count(5 * 10**15), "5,000 T")
+
+    def test_errors(self):
+        with self.assertRaises(TypeError):
+            _get_human_readable_count(0.5)
+        with self.assertRaises(ValueError):
+            _get_human_readable_count(-1)
+        with self.assertRaises(ValueError):
+            _get_human_readable_count(1, labels=[])
+
+
+if __name__ == "__main__":
+    unittest.main()

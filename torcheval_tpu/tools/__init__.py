@@ -1,4 +1,25 @@
 """Model-evaluation tools (reference ``torcheval/tools/__init__.py:7-19``):
-module summaries and FLOP counting, re-based on XLA cost analysis."""
+module summaries and FLOP counting, re-based on flax module trees and XLA
+cost analysis instead of torch hooks and a dispatcher interposer."""
 
-__all__ = []
+from torcheval_tpu.tools.flops import (
+    cost_summary,
+    flops_of,
+    forward_backward_flops,
+)
+from torcheval_tpu.tools.module_summary import (
+    get_module_summary,
+    get_summary_table,
+    ModuleSummary,
+    prune_module_summary,
+)
+
+__all__ = [
+    "cost_summary",
+    "flops_of",
+    "forward_backward_flops",
+    "get_module_summary",
+    "get_summary_table",
+    "ModuleSummary",
+    "prune_module_summary",
+]
