@@ -1,0 +1,108 @@
+"""Single-host training example — the TPU-native port of the reference
+``examples/simple_example.py:43-93``: a small MLP trained with optax while a
+``MulticlassAccuracy`` metric tracks the run (update per batch, compute every
+4 batches, reset per epoch).
+
+Run: ``python examples/simple_example.py`` (any JAX backend — the one real
+TPU chip, or CPU).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from torcheval_tpu.metrics import MulticlassAccuracy
+
+NUM_EPOCHS = 4
+NUM_BATCHES = 16
+BATCH_SIZE = 8
+FEATURES = 128
+HIDDEN = (64, 32)
+NUM_CLASSES = 2
+COMPUTE_FREQUENCY = 4
+
+
+def init_params(key):
+    sizes = (FEATURES, *HIDDEN, NUM_CLASSES)
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes, sizes[1:])):
+        key, sub = jax.random.split(key)
+        params.append(
+            {
+                "w": jax.random.normal(sub, (fan_in, fan_out), jnp.float32)
+                / jnp.sqrt(fan_in),
+                "b": jnp.zeros((fan_out,), jnp.float32),
+            }
+        )
+    return params
+
+
+def forward(params, x):
+    for layer in params[:-1]:
+        x = jax.nn.relu(x @ layer["w"] + layer["b"])
+    return x @ params[-1]["w"] + params[-1]["b"]
+
+
+@jax.jit
+def train_step(params, opt_state, x, target):
+    def loss_fn(p):
+        logits = forward(p, x)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, target
+        ).mean()
+        return loss, logits
+
+    (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = OPTIMIZER.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss, logits
+
+
+OPTIMIZER = optax.adagrad(learning_rate=1e-3)
+
+
+def prepare_data(key):
+    num_samples = NUM_BATCHES * BATCH_SIZE
+    k1, k2 = jax.random.split(key)
+    data = jax.random.normal(k1, (num_samples, FEATURES), jnp.float32)
+    labels = jax.random.randint(k2, (num_samples,), 0, NUM_CLASSES, jnp.int32)
+    return data.reshape(NUM_BATCHES, BATCH_SIZE, FEATURES), labels.reshape(
+        NUM_BATCHES, BATCH_SIZE
+    )
+
+
+def main() -> None:
+    key = jax.random.PRNGKey(42)
+    params = init_params(key)
+    opt_state = OPTIMIZER.init(params)
+    data, labels = prepare_data(jax.random.PRNGKey(7))
+
+    metric = MulticlassAccuracy()
+
+    for epoch in range(NUM_EPOCHS):
+        for batch_idx in range(NUM_BATCHES):
+            x, target = data[batch_idx], labels[batch_idx]
+            params, opt_state, loss, logits = train_step(
+                params, opt_state, x, target
+            )
+
+            # metric.update() absorbs the batch into the sufficient stats.
+            metric.update(logits, target)
+
+            if (batch_idx + 1) % COMPUTE_FREQUENCY == 0:
+                print(
+                    "Epoch {}/{}, Batch {}/{} --- loss: {:.4f}, acc: {:.4f}".format(
+                        epoch + 1,
+                        NUM_EPOCHS,
+                        batch_idx + 1,
+                        NUM_BATCHES,
+                        float(loss),
+                        float(metric.compute()),
+                    )
+                )
+
+        # metric.reset() clears all seen data for the next epoch.
+        metric.reset()
+
+
+if __name__ == "__main__":
+    main()

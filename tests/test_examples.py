@@ -1,0 +1,29 @@
+"""Smoke tests for the shipped examples (the reference exercises its
+examples only in docs; here the cheap rank-world path is kept green in CI)."""
+
+import sys
+import unittest
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1] / "examples"))
+
+
+class TestExamples(unittest.TestCase):
+    def test_rank_world_sync_path(self):
+        import distributed_example
+
+        distributed_example.train_rank_world()
+
+    def test_simple_example_one_epoch(self):
+        import simple_example
+
+        old = simple_example.NUM_EPOCHS
+        try:
+            simple_example.NUM_EPOCHS = 1
+            simple_example.main()
+        finally:
+            simple_example.NUM_EPOCHS = old
+
+
+if __name__ == "__main__":
+    unittest.main()
