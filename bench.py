@@ -51,7 +51,9 @@ def bench_tpu() -> float:
         metric.reset()
         for s, t in zip(d_scores, d_target):
             metric.update(s, t)
-        return jax.block_until_ready(metric.compute())
+        # float() forces device→host completion; on the tunneled axon
+        # backend ``block_until_ready`` returns before execution finishes.
+        return float(metric.compute())
 
     out = step()  # compile + warm caches
     print(f"tpu warm value: {out}", file=sys.stderr)

@@ -126,10 +126,11 @@ def train_spmd() -> None:
                         float(metric.compute()),
                     )
                 )
-            jax.block_until_ready(loss)
-            throughput.update(
-                (batch_idx + 1) * batch_size, time.monotonic() - t0
-            )
+            # Throughput.update accumulates its arguments, so feed it the
+            # per-batch delta, not running totals.
+            t1 = time.monotonic()
+            throughput.update(batch_size, t1 - t0)
+            t0 = t1
         metric.reset()
 
     print(f"SPMD global throughput: {float(throughput.compute()):.1f} items/sec")

@@ -102,7 +102,34 @@ def _binary_auroc_compute(
         return jnp.full(input.shape[:-1], 0.5, dtype=jnp.float32)
     if use_fused:
         return fused_auc(input, target)
+    if _use_pallas(input.shape[-1]):
+        from torcheval_tpu.ops.pallas_auc import pallas_binary_auroc
+
+        return pallas_binary_auroc(input, target)
     return _binary_auroc_compute_kernel(input, target)
+
+
+def _use_pallas(num_samples: int) -> bool:
+    """Route exact AUROC through the fused Pallas scan on TPU (identical
+    math, single HBM pass; see ``torcheval_tpu/ops/pallas_auc.py``).  Set
+    ``TORCHEVAL_TPU_DISABLE_PALLAS=1`` to force the pure-XLA path.
+
+    Rows of ≥ 2^24 samples stay on the XLA path: the kernel carries counts
+    in float32, which is exact only below 2^24."""
+    import os
+
+    if os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    ):
+        return False
+    if num_samples >= 2**24:
+        return False
+    from torcheval_tpu.ops.pallas_auc import has_pallas
+
+    return has_pallas()
 
 
 def _multiclass_auroc_compute(
@@ -116,7 +143,24 @@ def _multiclass_auroc_compute(
         # no-positives/no-negatives convention.
         degenerate = jnp.full(num_classes, 0.5, dtype=jnp.float32)
         return degenerate.mean() if average == "macro" else degenerate
+    if _use_pallas(input.shape[0]):
+        return _multiclass_auroc_pallas_kernel(input, target, num_classes, average)
     return _multiclass_auroc_compute_kernel(input, target, num_classes, average)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _multiclass_auroc_pallas_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    average: Optional[str],
+) -> jax.Array:
+    """One-vs-rest AUROC through the fused Pallas scan — one (C, N)
+    multi-task call of the shared sort + kernel path."""
+    from torcheval_tpu.ops.pallas_auc import pallas_binary_auroc
+
+    aurocs = pallas_binary_auroc(input.T, class_hits(target, num_classes))
+    return aurocs.mean() if average == "macro" else aurocs
 
 
 @partial(jax.jit, static_argnames=("num_classes", "average"))

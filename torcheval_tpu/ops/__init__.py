@@ -1,0 +1,33 @@
+"""Native accelerator kernels: the fused approximate AUC (fbgemm analog)
+and the hand-written Pallas exact AUC scan.
+
+Submodules are loaded lazily (PEP 562): ``pallas_auc`` pulls in
+``jax.experimental.pallas.tpu``, and importing the metrics API must not
+depend on that import succeeding (the dispatch in ``auroc.py`` gates on
+``has_pallas()`` at call time for the same reason).
+"""
+
+from typing import Any
+
+__all__ = [
+    "auc_from_sorted",
+    "fused_auc",
+    "has_fused",
+    "has_pallas",
+    "pallas_binary_auroc",
+]
+
+_FUSED = {"fused_auc", "has_fused"}
+_PALLAS = {"auc_from_sorted", "has_pallas", "pallas_binary_auroc"}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _FUSED:
+        from torcheval_tpu.ops import fused_auc as _m
+
+        return getattr(_m, name)
+    if name in _PALLAS:
+        from torcheval_tpu.ops import pallas_auc as _m
+
+        return getattr(_m, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
