@@ -1,0 +1,32 @@
+# Sphinx configuration for torcheval_tpu (mirrors the reference's autodoc
+# of its three public namespaces, reference ``docs/source/conf.py``).
+
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath("../.."))
+
+project = "torcheval_tpu"
+copyright = "2026"
+author = "torcheval_tpu contributors"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.autosummary",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+    "sphinx.ext.intersphinx",
+]
+
+autosummary_generate = True
+autodoc_typehints = "description"
+
+templates_path = ["_templates"]
+exclude_patterns = []
+
+html_theme = "alabaster"
+
+intersphinx_mapping = {
+    "jax": ("https://docs.jax.dev/en/latest/", None),
+    "python": ("https://docs.python.org/3", None),
+}
