@@ -125,5 +125,27 @@ def main() -> None:
     print(json.dumps(result))
 
 
+def main_all() -> None:
+    """``--all``: the full BASELINE.json workload suite, one JSON line per
+    workload (the bare invocation keeps the one-headline-line contract)."""
+    from benchmarks.workloads import ALL_WORKLOADS
+
+    for workload in ALL_WORKLOADS:
+        name, ours, ref = workload()
+        print(
+            json.dumps(
+                {
+                    "metric": name,
+                    "value": round(ours, 1),
+                    "unit": "samples/sec",
+                    "vs_baseline": round(ours / ref, 2) if ref else None,
+                }
+            )
+        )
+
+
 if __name__ == "__main__":
-    main()
+    if "--all" in sys.argv[1:]:
+        main_all()
+    else:
+        main()

@@ -1,0 +1,304 @@
+"""The BASELINE.json workload suite, measured live against the reference.
+
+Each workload returns ``(ours_per_sec, ref_per_sec)`` throughput on the
+identical metric lifecycle (8 buffered updates + one compute); ours runs on
+the session's JAX backend (TPU when available), the reference on torch CPU —
+the only hardware it has here.  ``python bench.py --all`` prints one JSON
+line per workload; the bare ``python bench.py`` contract (exactly one
+headline line) is unchanged.
+
+Timing note: results are forced with ``float()``/``np.asarray`` — on the
+tunneled axon backend ``jax.block_until_ready`` can return before execution
+finishes, so device→host transfer is the only trustworthy fence.
+"""
+
+import sys
+import time
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+NUM_UPDATES = 8
+REPEATS = 3
+
+
+def _time_steps(step: Callable[[], object], repeats: int = REPEATS) -> float:
+    step()  # warm: compile + caches
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        step()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def _force(value) -> None:
+    """Device→host fence over arbitrary metric results."""
+    import jax
+
+    for leaf in jax.tree.leaves(value):
+        np.asarray(leaf)
+
+
+# --------------------------------------------------------------------------
+# Workload definitions.  Each returns (metric_name, ours/sec, ref/sec|None).
+# --------------------------------------------------------------------------
+
+
+def _lifecycle_ours(metric, batches) -> float:
+    def step():
+        metric.reset()
+        for args in batches:
+            metric.update(*args)
+        _force(metric.compute())
+
+    n = sum(int(np.asarray(a[0]).shape[0]) for a in batches)
+    return n / _time_steps(step)
+
+
+def _lifecycle_ref(metric, batches) -> Optional[float]:
+    def step():
+        metric.reset()
+        for args in batches:
+            metric.update(*args)
+        return metric.compute()
+
+    n = sum(int(a[0].shape[0]) for a in batches)
+    return n / _time_steps(step, repeats=2)
+
+
+def _split(rng_arrays, n_updates=NUM_UPDATES):
+    import jax.numpy as jnp
+
+    return list(
+        zip(*(map(jnp.asarray, np.split(a, n_updates)) for a in rng_arrays))
+    )
+
+
+def _split_torch(rng_arrays, n_updates=NUM_UPDATES):
+    import torch
+
+    return list(
+        zip(
+            *(
+                [torch.from_numpy(c.copy()) for c in np.split(a, n_updates)]
+                for a in rng_arrays
+            )
+        )
+    )
+
+
+def bench_accuracy() -> Tuple[str, float, Optional[float]]:
+    """BASELINE configs[0]: MulticlassAccuracy, 5 classes."""
+    from torcheval_tpu.metrics import MulticlassAccuracy
+
+    rng = np.random.default_rng(0)
+    n = 2**20
+    scores = rng.random((n, 5), dtype=np.float32)
+    target = rng.integers(0, 5, n).astype(np.int32)
+    ours = _lifecycle_ours(MulticlassAccuracy(num_classes=5), _split((scores, target)))
+
+    ref = None
+    try:
+        sys.path.insert(0, "/root/reference")
+        import torch
+        from torcheval.metrics import MulticlassAccuracy as Ref
+
+        batches = _split_torch((scores, target.astype(np.int64)))
+        ref = _lifecycle_ref(Ref(num_classes=5), batches)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "multiclass_accuracy_5c", ours, ref
+
+
+def bench_binary_auroc() -> Tuple[str, float, Optional[float]]:
+    """BASELINE configs[1]: BinaryAUROC sort + scan."""
+    from torcheval_tpu.metrics import BinaryAUROC
+
+    rng = np.random.default_rng(1)
+    n = 2**22
+    scores = rng.random(n, dtype=np.float32)
+    target = (rng.random(n) > 0.5).astype(np.float32)
+    ours = _lifecycle_ours(BinaryAUROC(), _split((scores, target)))
+
+    ref = None
+    try:
+        sys.path.insert(0, "/root/reference")
+        from torcheval.metrics import BinaryAUROC as Ref
+
+        n_ref = 2**18  # reference CPU needs a smaller instance
+        batches = _split_torch((scores[:n_ref], target[:n_ref].astype(np.int64)))
+        ref = _lifecycle_ref(Ref(), batches)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "binary_auroc_sort_scan", ours, ref
+
+
+def bench_binary_auprc() -> Tuple[str, float, Optional[float]]:
+    """BASELINE configs[1] (AUPRC side): BinaryPrecisionRecallCurve."""
+    from torcheval_tpu.metrics import BinaryPrecisionRecallCurve
+
+    rng = np.random.default_rng(2)
+    n = 2**20
+    scores = rng.random(n, dtype=np.float32)
+    target = (rng.random(n) > 0.5).astype(np.float32)
+    ours = _lifecycle_ours(BinaryPrecisionRecallCurve(), _split((scores, target)))
+
+    ref = None
+    try:
+        sys.path.insert(0, "/root/reference")
+        from torcheval.metrics import BinaryPrecisionRecallCurve as Ref
+
+        n_ref = 2**17
+        batches = _split_torch((scores[:n_ref], target[:n_ref].astype(np.int64)))
+        ref = _lifecycle_ref(Ref(), batches)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "binary_auprc_curve", ours, ref
+
+
+def bench_confusion_f1() -> Tuple[str, float, Optional[float]]:
+    """BASELINE configs[2]: 1000-class confusion matrix + F1 scatter-adds."""
+    from torcheval_tpu.metrics import MulticlassConfusionMatrix, MulticlassF1Score
+
+    rng = np.random.default_rng(3)
+    n = 2**20
+    c = 1000
+    pred = rng.integers(0, c, n).astype(np.int32)
+    target = rng.integers(0, c, n).astype(np.int32)
+    cm = MulticlassConfusionMatrix(num_classes=c)
+    f1 = MulticlassF1Score(num_classes=c, average="macro")
+    batches = _split((pred, target))
+
+    def step():
+        cm.reset()
+        f1.reset()
+        for p, t in batches:
+            cm.update(p, t)
+            f1.update(p, t)
+        _force((cm.compute(), f1.compute()))
+
+    ours = n / _time_steps(step)
+
+    ref = None
+    try:
+        sys.path.insert(0, "/root/reference")
+        from torcheval.metrics import (
+            MulticlassConfusionMatrix as RefCM,
+            MulticlassF1Score as RefF1,
+        )
+
+        rcm = RefCM(num_classes=c)
+        rf1 = RefF1(num_classes=c, average="macro")
+        tb = _split_torch((pred.astype(np.int64), target.astype(np.int64)))
+
+        def rstep():
+            rcm.reset()
+            rf1.reset()
+            for p, t in tb:
+                rcm.update(p, t)
+                rf1.update(p, t)
+            rcm.compute(), rf1.compute()
+
+        ref = n / _time_steps(rstep, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "confusion_matrix_f1_1000c", ours, ref
+
+
+def bench_regression() -> Tuple[str, float, Optional[float]]:
+    """BASELINE configs[3]: R2Score + MeanSquaredError streaming reductions."""
+    from torcheval_tpu.metrics import MeanSquaredError, R2Score
+
+    rng = np.random.default_rng(4)
+    n = 2**22
+    pred = rng.random(n, dtype=np.float32)
+    target = rng.random(n, dtype=np.float32)
+    mse = MeanSquaredError()
+    r2 = R2Score()
+    batches = _split((pred, target))
+
+    def step():
+        mse.reset()
+        r2.reset()
+        for p, t in batches:
+            mse.update(p, t)
+            r2.update(p, t)
+        _force((mse.compute(), r2.compute()))
+
+    ours = n / _time_steps(step)
+
+    ref = None
+    try:
+        sys.path.insert(0, "/root/reference")
+        from torcheval.metrics import (
+            MeanSquaredError as RefMSE,
+            R2Score as RefR2,
+        )
+
+        rmse, rr2 = RefMSE(), RefR2()
+        tb = _split_torch((pred, target))
+
+        def rstep():
+            rmse.reset()
+            rr2.reset()
+            for p, t in tb:
+                rmse.update(p, t)
+                rr2.update(p, t)
+            rmse.compute(), rr2.compute()
+
+        ref = n / _time_steps(rstep, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "r2_mse_streaming", ours, ref
+
+
+def bench_sharded_auroc_sync() -> Tuple[str, float, Optional[float]]:
+    """BASELINE configs[4]: pod-wide AUROC sync.  On a single chip this
+    exercises the O(bins)-communication histogram path over a 1-device mesh;
+    the reference equivalent is its gather-everything object sync, measured
+    as its exact AUROC on the same stream (the wire cost is not simulable on
+    torch CPU, so this is generous to the reference)."""
+    import jax.numpy as jnp
+
+    from torcheval_tpu.parallel import make_mesh, shard_batch, sharded_auroc_histogram
+
+    rng = np.random.default_rng(5)
+    n = 2**22
+    scores = rng.random(n, dtype=np.float32)
+    target = (rng.random(n) > 0.5).astype(np.float32)
+    mesh = make_mesh()
+    s, t = shard_batch(mesh, jnp.asarray(scores), jnp.asarray(target))
+
+    def step():
+        _force(sharded_auroc_histogram(s, t, mesh=mesh, num_bins=16384))
+
+    ours = n / _time_steps(step)
+
+    ref = None
+    try:
+        sys.path.insert(0, "/root/reference")
+        import torch
+        from torcheval.metrics.functional import binary_auroc as ref_auroc
+
+        n_ref = 2**19
+        ts = torch.from_numpy(scores[:n_ref].copy())
+        tt = torch.from_numpy(target[:n_ref].astype(np.int64))
+
+        def rstep():
+            ref_auroc(ts, tt)
+
+        ref = n_ref / _time_steps(rstep, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "sharded_auroc_histogram_sync", ours, ref
+
+
+ALL_WORKLOADS = [
+    bench_accuracy,
+    bench_binary_auroc,
+    bench_binary_auprc,
+    bench_confusion_f1,
+    bench_regression,
+    bench_sharded_auroc_sync,
+]
