@@ -1,0 +1,156 @@
+"""Toolkit tests (reference ``tests/metrics/test_toolkit.py:33-174``):
+multi-rank sync for recipient_rank ∈ {0, 1, "all"}, world-size-1 fallback,
+invalid-rank error, synced state dicts, clone/reset/to_device helpers —
+over the in-process rank world instead of 4 gloo processes."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.distributed import LocalWorld, NullGroup, SingleProcessGroup
+from torcheval_tpu.metrics import BinaryAUROC, Max, MulticlassAccuracy, Sum
+from torcheval_tpu.metrics.toolkit import (
+    clone_metric,
+    clone_metrics,
+    get_synced_metric,
+    get_synced_state_dict,
+    reset_metrics,
+    sync_and_compute,
+    to_device,
+)
+
+NUM_RANKS = 4
+
+
+def _rank_metric(rank: int) -> Sum:
+    return Sum().update(jnp.asarray(float(rank + 1)))
+
+
+class TestSyncAndCompute(unittest.TestCase):
+    def test_recipient_rank_0(self):
+        def fn(group, rank):
+            return sync_and_compute(_rank_metric(rank), process_group=group)
+
+        results = LocalWorld(NUM_RANKS).run(fn)
+        self.assertEqual(float(results[0]), 10.0)  # 1+2+3+4
+        for r in results[1:]:
+            self.assertIsNone(r)
+
+    def test_recipient_rank_1(self):
+        def fn(group, rank):
+            return sync_and_compute(
+                _rank_metric(rank), process_group=group, recipient_rank=1
+            )
+
+        results = LocalWorld(NUM_RANKS).run(fn)
+        self.assertEqual(float(results[1]), 10.0)
+        self.assertIsNone(results[0])
+
+    def test_recipient_rank_all(self):
+        def fn(group, rank):
+            return sync_and_compute(
+                _rank_metric(rank), process_group=group, recipient_rank="all"
+            )
+
+        results = LocalWorld(NUM_RANKS).run(fn)
+        for r in results:
+            self.assertEqual(float(r), 10.0)
+
+    def test_buffer_metric_sync(self):
+        rng = np.random.default_rng(0)
+        scores = rng.random((NUM_RANKS, 128)).astype(np.float32)
+        target = (rng.random((NUM_RANKS, 128)) > 0.5).astype(np.float32)
+
+        def fn(group, rank):
+            metric = BinaryAUROC()
+            metric.update(jnp.asarray(scores[rank]), jnp.asarray(target[rank]))
+            return sync_and_compute(metric, process_group=group, recipient_rank="all")
+
+        results = LocalWorld(NUM_RANKS).run(fn)
+        single = BinaryAUROC()
+        single.update(
+            jnp.asarray(scores.reshape(-1)), jnp.asarray(target.reshape(-1))
+        )
+        expected = float(single.compute())
+        for r in results:
+            np.testing.assert_allclose(float(r), expected, rtol=1e-6)
+
+    def test_world_size_1_returns_local(self):
+        metric = _rank_metric(0)
+        with self.assertLogs(level="WARNING"):
+            result = sync_and_compute(metric, process_group=SingleProcessGroup())
+        self.assertEqual(float(result), 1.0)
+
+    def test_not_in_group_returns_none(self):
+        with self.assertLogs(level="WARNING"):
+            self.assertIsNone(
+                get_synced_metric(_rank_metric(0), process_group=NullGroup())
+            )
+
+    def test_invalid_recipient_rank(self):
+        with self.assertRaisesRegex(ValueError, "recipient_rank"):
+            sync_and_compute(_rank_metric(0), recipient_rank="some")  # type: ignore[arg-type]
+
+    def test_inputs_unchanged_by_sync(self):
+        def fn(group, rank):
+            metric = _rank_metric(rank)
+            sync_and_compute(metric, process_group=group)
+            return float(metric.compute())
+
+        results = LocalWorld(NUM_RANKS).run(fn)
+        self.assertEqual(results, [1.0, 2.0, 3.0, 4.0])
+
+
+class TestGetSyncedStateDict(unittest.TestCase):
+    def test_recipient_only(self):
+        def fn(group, rank):
+            return get_synced_state_dict(_rank_metric(rank), process_group=group)
+
+        results = LocalWorld(NUM_RANKS).run(fn)
+        self.assertEqual(float(results[0]["weighted_sum"]), 10.0)
+        for r in results[1:]:
+            self.assertEqual(r, {})
+
+    def test_all(self):
+        def fn(group, rank):
+            return get_synced_state_dict(
+                _rank_metric(rank), process_group=group, recipient_rank="all"
+            )
+
+        for r in LocalWorld(NUM_RANKS).run(fn):
+            self.assertEqual(float(r["weighted_sum"]), 10.0)
+
+
+class TestHelpers(unittest.TestCase):
+    def test_clone_metric_independent(self):
+        m = _rank_metric(0)
+        c = clone_metric(m)
+        c.update(jnp.asarray(5.0))
+        self.assertEqual(float(m.compute()), 1.0)
+        self.assertEqual(float(c.compute()), 6.0)
+
+    def test_clone_metrics(self):
+        ms = [Sum(), Max()]
+        cs = clone_metrics(ms)
+        self.assertEqual(len(cs), 2)
+        self.assertIsNot(cs[0], ms[0])
+
+    def test_reset_metrics(self):
+        m1 = _rank_metric(2)
+        m2 = MulticlassAccuracy().update(
+            jnp.asarray([[0.9, 0.1]]), jnp.asarray([0])
+        )
+        reset_metrics([m1, m2])
+        self.assertEqual(float(m1.compute()), 0.0)
+        self.assertTrue(np.isnan(float(m2.compute())))
+
+    def test_to_device(self):
+        m = _rank_metric(1)
+        (moved,) = to_device([m], "cpu")
+        self.assertEqual(moved.device.platform, "cpu")
+        self.assertEqual(float(moved.compute()), 2.0)
+
+
+if __name__ == "__main__":
+    unittest.main()

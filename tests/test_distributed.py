@@ -1,0 +1,88 @@
+"""Distributed-backend tests: the process-group abstraction and the
+in-process rank world (the layer under the toolkit — reference analog is
+torchtnt's PGWrapper + the 4-process gloo rig it is tested with)."""
+
+import threading
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.distributed import (
+    LocalWorld,
+    NullGroup,
+    SingleProcessGroup,
+    default_group,
+)
+
+
+class TestSingleProcessGroup(unittest.TestCase):
+    def test_semantics(self):
+        g = SingleProcessGroup()
+        self.assertEqual(g.rank, 0)
+        self.assertEqual(g.world_size, 1)
+        self.assertEqual(g.all_gather_object("x"), ["x"])
+        self.assertEqual(g.broadcast_object("y", src=0), "y")
+
+
+class TestNullGroup(unittest.TestCase):
+    def test_semantics(self):
+        g = NullGroup()
+        self.assertEqual(g.world_size, -1)
+        with self.assertRaises(RuntimeError):
+            g.all_gather_object(1)
+        with self.assertRaises(RuntimeError):
+            g.broadcast_object(1, src=0)
+
+
+class TestDefaultGroup(unittest.TestCase):
+    def test_single_process_world(self):
+        self.assertIsInstance(default_group(), SingleProcessGroup)
+
+
+class TestLocalWorld(unittest.TestCase):
+    def test_all_gather_object_ordering(self):
+        def fn(group, rank):
+            return group.all_gather_object({"rank": rank, "data": np.ones(rank + 1)})
+
+        results = LocalWorld(4).run(fn)
+        for gathered in results:
+            self.assertEqual([g["rank"] for g in gathered], [0, 1, 2, 3])
+            self.assertEqual(gathered[2]["data"].shape, (3,))
+
+    def test_broadcast_object(self):
+        def fn(group, rank):
+            return group.broadcast_object(f"from-{rank}" if rank == 2 else None, src=2)
+
+        self.assertEqual(LocalWorld(4).run(fn), ["from-2"] * 4)
+
+    def test_sequential_collectives_stay_aligned(self):
+        def fn(group, rank):
+            first = group.all_gather_object(rank)
+            second = group.all_gather_object(rank * 10)
+            return first, second
+
+        for first, second in LocalWorld(3).run(fn):
+            self.assertEqual(first, [0, 1, 2])
+            self.assertEqual(second, [0, 10, 20])
+
+    def test_rank_error_propagates(self):
+        def fn(group, rank):
+            if rank == 1:
+                raise RuntimeError("rank 1 boom")
+            return group.all_gather_object(rank)
+
+        with self.assertRaisesRegex(RuntimeError, "rank 1 boom"):
+            LocalWorld(3).run(fn)
+
+    def test_invalid_world_size(self):
+        with self.assertRaises(ValueError):
+            LocalWorld(0)
+
+    def test_threads_do_not_leak(self):
+        before = threading.active_count()
+        LocalWorld(4).run(lambda group, rank: group.all_gather_object(rank))
+        self.assertLessEqual(threading.active_count(), before + 1)
+
+
+if __name__ == "__main__":
+    unittest.main()
