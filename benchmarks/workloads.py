@@ -45,7 +45,10 @@ def _force(value) -> None:
 # --------------------------------------------------------------------------
 
 
-def _lifecycle_ours(metric, batches) -> float:
+def _lifecycle(metric, batches, repeats: int = REPEATS) -> float:
+    """update×K + compute throughput for one metric object (ours or the
+    reference's — ``_force`` is a no-op fence for eager torch tensors)."""
+
     def step():
         metric.reset()
         for args in batches:
@@ -53,18 +56,16 @@ def _lifecycle_ours(metric, batches) -> float:
         _force(metric.compute())
 
     n = sum(int(np.asarray(a[0]).shape[0]) for a in batches)
-    return n / _time_steps(step)
+    return n / _time_steps(step, repeats)
 
 
-def _lifecycle_ref(metric, batches) -> Optional[float]:
-    def step():
-        metric.reset()
-        for args in batches:
-            metric.update(*args)
-        return metric.compute()
+def _reference():
+    """Import the reference torcheval exactly once."""
+    if "/root/reference" not in sys.path:
+        sys.path.insert(0, "/root/reference")
+    import torcheval.metrics as ref_metrics
 
-    n = sum(int(a[0].shape[0]) for a in batches)
-    return n / _time_steps(step, repeats=2)
+    return ref_metrics
 
 
 def _split(rng_arrays, n_updates=NUM_UPDATES):
@@ -96,16 +97,13 @@ def bench_accuracy() -> Tuple[str, float, Optional[float]]:
     n = 2**20
     scores = rng.random((n, 5), dtype=np.float32)
     target = rng.integers(0, 5, n).astype(np.int32)
-    ours = _lifecycle_ours(MulticlassAccuracy(num_classes=5), _split((scores, target)))
+    ours = _lifecycle(MulticlassAccuracy(num_classes=5), _split((scores, target)))
 
     ref = None
     try:
-        sys.path.insert(0, "/root/reference")
-        import torch
-        from torcheval.metrics import MulticlassAccuracy as Ref
-
+        Ref = _reference().MulticlassAccuracy
         batches = _split_torch((scores, target.astype(np.int64)))
-        ref = _lifecycle_ref(Ref(num_classes=5), batches)
+        ref = _lifecycle(Ref(num_classes=5), batches, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
     return "multiclass_accuracy_5c", ours, ref
@@ -119,16 +117,14 @@ def bench_binary_auroc() -> Tuple[str, float, Optional[float]]:
     n = 2**22
     scores = rng.random(n, dtype=np.float32)
     target = (rng.random(n) > 0.5).astype(np.float32)
-    ours = _lifecycle_ours(BinaryAUROC(), _split((scores, target)))
+    ours = _lifecycle(BinaryAUROC(), _split((scores, target)))
 
     ref = None
     try:
-        sys.path.insert(0, "/root/reference")
-        from torcheval.metrics import BinaryAUROC as Ref
-
+        Ref = _reference().BinaryAUROC
         n_ref = 2**18  # reference CPU needs a smaller instance
         batches = _split_torch((scores[:n_ref], target[:n_ref].astype(np.int64)))
-        ref = _lifecycle_ref(Ref(), batches)
+        ref = _lifecycle(Ref(), batches, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
     return "binary_auroc_sort_scan", ours, ref
@@ -142,16 +138,14 @@ def bench_binary_auprc() -> Tuple[str, float, Optional[float]]:
     n = 2**20
     scores = rng.random(n, dtype=np.float32)
     target = (rng.random(n) > 0.5).astype(np.float32)
-    ours = _lifecycle_ours(BinaryPrecisionRecallCurve(), _split((scores, target)))
+    ours = _lifecycle(BinaryPrecisionRecallCurve(), _split((scores, target)))
 
     ref = None
     try:
-        sys.path.insert(0, "/root/reference")
-        from torcheval.metrics import BinaryPrecisionRecallCurve as Ref
-
+        Ref = _reference().BinaryPrecisionRecallCurve
         n_ref = 2**17
         batches = _split_torch((scores[:n_ref], target[:n_ref].astype(np.int64)))
-        ref = _lifecycle_ref(Ref(), batches)
+        ref = _lifecycle(Ref(), batches, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
     return "binary_auprc_curve", ours, ref
@@ -182,14 +176,9 @@ def bench_confusion_f1() -> Tuple[str, float, Optional[float]]:
 
     ref = None
     try:
-        sys.path.insert(0, "/root/reference")
-        from torcheval.metrics import (
-            MulticlassConfusionMatrix as RefCM,
-            MulticlassF1Score as RefF1,
-        )
-
-        rcm = RefCM(num_classes=c)
-        rf1 = RefF1(num_classes=c, average="macro")
+        ref_m = _reference()
+        rcm = ref_m.MulticlassConfusionMatrix(num_classes=c)
+        rf1 = ref_m.MulticlassF1Score(num_classes=c, average="macro")
         tb = _split_torch((pred.astype(np.int64), target.astype(np.int64)))
 
         def rstep():
@@ -230,13 +219,8 @@ def bench_regression() -> Tuple[str, float, Optional[float]]:
 
     ref = None
     try:
-        sys.path.insert(0, "/root/reference")
-        from torcheval.metrics import (
-            MeanSquaredError as RefMSE,
-            R2Score as RefR2,
-        )
-
-        rmse, rr2 = RefMSE(), RefR2()
+        ref_m = _reference()
+        rmse, rr2 = ref_m.MeanSquaredError(), ref_m.R2Score()
         tb = _split_torch((pred, target))
 
         def rstep():
@@ -277,8 +261,9 @@ def bench_sharded_auroc_sync() -> Tuple[str, float, Optional[float]]:
 
     ref = None
     try:
-        sys.path.insert(0, "/root/reference")
         import torch
+
+        _reference()
         from torcheval.metrics.functional import binary_auroc as ref_auroc
 
         n_ref = 2**19

@@ -20,6 +20,7 @@ The reference's opt-in ``use_fbgemm`` CUDA kernel becomes ``use_fused``
 skips tie masking (reference ``auroc.py:34-39,145-164``).
 """
 
+import os
 from functools import partial
 from typing import Optional
 
@@ -116,8 +117,6 @@ def _use_pallas(num_samples: int) -> bool:
 
     Rows of ≥ 2^24 samples stay on the XLA path: the kernel carries counts
     in float32, which is exact only below 2^24."""
-    import os
-
     if os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in (
         "1",
         "true",

@@ -221,7 +221,7 @@ def get_module_summary(
 
     # ---- assemble the tree from the variables pytree --------------------
     paths = _collect_module_paths(variables)
-    all_paths = sorted(set(paths) | set(records) - {()})
+    all_paths = sorted(set(paths) | (set(records) - {()}))
 
     def make_node(path: Tuple[str, ...]) -> ModuleSummary:
         s = ModuleSummary()
