@@ -36,8 +36,12 @@ from typing import (
     Union,
 )
 
+import logging
+
 import jax
 import jax.numpy as jnp
+
+_telemetry = logging.getLogger("torcheval_tpu.telemetry")
 
 TComputeReturn = TypeVar("TComputeReturn")
 
@@ -129,6 +133,11 @@ class Metric(Generic[TComputeReturn], ABC):
     update/compute/merge lifecycle (reference ``Metric``, ``metric.py:23``)."""
 
     def __init__(self: TSelf, *, device: DeviceLike = None) -> None:
+        # Usage telemetry analog of the reference's
+        # ``torch._C._log_api_usage_once`` (reference ``metric.py:44``):
+        # one debug record per construction on a dedicated logger, for
+        # deployments that want adoption counts without a torch runtime.
+        _telemetry.debug("torcheval_tpu.metrics.%s", type(self).__name__)
         self._device: Placement = canonicalize_device(device)
         self._state_name_to_default: Dict[str, TState] = {}
 
