@@ -92,6 +92,42 @@ class TestSyncAndCompute(unittest.TestCase):
         with self.assertRaisesRegex(ValueError, "recipient_rank"):
             sync_and_compute(_rank_metric(0), recipient_rank="some")  # type: ignore[arg-type]
 
+    def test_all_state_shapes_sync(self):
+        """Every legal TState container survives the pickle wire format
+        (reference drives the four variants via its dummy metrics,
+        ``dummy_metric.py:19-141``)."""
+        from torcheval_tpu.utils.test_utils.dummy_metric import (
+            DummySumDequeStateMetric,
+            DummySumDictStateMetric,
+            DummySumListStateMetric,
+            DummySumMetric,
+        )
+
+        for cls in (
+            DummySumMetric,
+            DummySumListStateMetric,
+            DummySumDequeStateMetric,
+        ):
+            def fn(group, rank, cls=cls):
+                metric = cls()
+                metric.update(jnp.asarray(float(rank + 1)))
+                return sync_and_compute(
+                    metric, process_group=group, recipient_rank="all"
+                )
+
+            for r in LocalWorld(NUM_RANKS).run(fn):
+                self.assertEqual(float(r), 10.0, cls.__name__)
+
+        def dict_fn(group, rank):
+            metric = DummySumDictStateMetric()
+            metric.update("k", float(rank + 1))
+            return sync_and_compute(
+                metric, process_group=group, recipient_rank="all"
+            )
+
+        for r in LocalWorld(NUM_RANKS).run(dict_fn):
+            self.assertEqual(float(r["k"]), 10.0)
+
     def test_inputs_unchanged_by_sync(self):
         def fn(group, rank):
             metric = _rank_metric(rank)
