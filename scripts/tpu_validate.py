@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""One-shot TPU validation + benchmark run.
+
+Run this on the real chip (never timeout-kill it — see
+.claude/skills/verify/SKILL.md): validates the Pallas kernel against
+sklearn on-device, then runs the headline benchmark and the full workload
+suite, printing the JSON lines at the end.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+
+
+def validate_pallas() -> None:
+    import jax
+    import jax.numpy as jnp
+    from sklearn.metrics import roc_auc_score
+
+    from torcheval_tpu.ops.pallas_auc import has_pallas, pallas_binary_auroc
+
+    print(f"backend={jax.default_backend()} has_pallas={has_pallas()}", flush=True)
+    rng = np.random.default_rng(0)
+    s = rng.random(100_000).astype(np.float32)
+    t = (rng.random(100_000) > 0.4).astype(np.float32)
+    got = float(pallas_binary_auroc(jnp.asarray(s), jnp.asarray(t)))
+    want = roc_auc_score(t, s)
+    assert abs(got - want) < 1e-5, (got, want)
+    s2 = rng.integers(0, 1000, 200_000).astype(np.float32) / 1000
+    t2 = (rng.random(200_000) > 0.5).astype(np.float32)
+    got2 = float(pallas_binary_auroc(jnp.asarray(s2), jnp.asarray(t2)))
+    want2 = roc_auc_score(t2, s2)
+    assert abs(got2 - want2) < 1e-5, (got2, want2)
+    print(f"pallas exact on TPU: cont={got:.6f} ties={got2:.6f} OK", flush=True)
+
+
+def main() -> None:
+    validate_pallas()
+    for args in ([], ["--all"]):
+        print(f"=== bench.py {' '.join(args)} ===", flush=True)
+        proc = subprocess.run(
+            [sys.executable, "bench.py", *args],
+            capture_output=True,
+            text=True,
+            cwd="/root/repo",
+        )
+        sys.stderr.write(proc.stderr[-2000:])
+        print(proc.stdout, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -269,6 +269,49 @@ class TestFunctionalParity(unittest.TestCase):
 
 
 @unittest.skipUnless(HAVE_REF, "reference torcheval not available")
+class TestClassParityMergeFlows(unittest.TestCase):
+    """Class lifecycle parity including merge_state: the multi-update +
+    merge flow both frameworks use for distributed sync."""
+
+    def test_binary_auroc_update_merge_compute(self):
+        from torcheval.metrics import BinaryAUROC as Ref
+
+        from torcheval_tpu.metrics import BinaryAUROC
+
+        rng = np.random.default_rng(7)
+        shards = [
+            (
+                rng.random(64).astype(np.float32),
+                (rng.random(64) > 0.5).astype(np.int64),
+            )
+            for _ in range(3)
+        ]
+        ours = [BinaryAUROC() for _ in shards]
+        refs = [Ref() for _ in shards]
+        for (s, t), o, r in zip(shards, ours, refs):
+            o.update(jnp.asarray(s), jnp.asarray(t.astype(np.float32)))
+            r.update(_t(s), _t(t))
+        ours[0].merge_state(ours[1:])
+        refs[0].merge_state(refs[1:])
+        _close(float(ours[0].compute()), float(refs[0].compute()), rtol=1e-5)
+
+    def test_throughput_merge_semantics(self):
+        from torcheval.metrics import Throughput as Ref
+
+        from torcheval_tpu.metrics import Throughput
+
+        ours = [Throughput() for _ in range(2)]
+        refs = [Ref() for _ in range(2)]
+        for i, (o, r) in enumerate(zip(ours, refs)):
+            o.update(128 * (i + 1), 2.0 + i)
+            r.update(128 * (i + 1), 2.0 + i)
+        ours[0].merge_state(ours[1:])
+        refs[0].merge_state(refs[1:])
+        # Merge adds counts but takes max elapsed (slowest-rank gating).
+        _close(float(ours[0].compute()), float(refs[0].compute()), rtol=1e-6)
+
+
+@unittest.skipUnless(HAVE_REF, "reference torcheval not available")
 class TestClassParityWindowed(unittest.TestCase):
     """Windowed metrics: ring-buffer semantics vs the reference classes."""
 
