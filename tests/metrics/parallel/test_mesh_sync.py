@@ -202,6 +202,53 @@ class TestShardedAUROCHistogram(unittest.TestCase):
             )
 
 
+class TestShardedMulticlassAUROCHistogram(unittest.TestCase):
+    def test_matches_sklearn_macro_on_quantized_scores(self):
+        from sklearn.metrics import roc_auc_score as sk_auc
+
+        from torcheval_tpu.parallel import sharded_multiclass_auroc_histogram
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(0)
+        num_bins, c, n = 512, 6, 4096
+        # Bin-aligned scores make the histogram AUROC exact.
+        scores = rng.integers(0, num_bins, (n, c)).astype(np.float32) / num_bins
+        target = rng.integers(0, c, n)
+        s, t = shard_batch(
+            mesh, jnp.asarray(scores), jnp.asarray(target.astype(np.int32))
+        )
+        got = sharded_multiclass_auroc_histogram(
+            s, t, mesh=mesh, num_bins=num_bins
+        )
+        want = np.mean(
+            [sk_auc((target == k).astype(int), scores[:, k]) for k in range(c)]
+        )
+        np.testing.assert_allclose(float(got), want, atol=1e-6)
+
+    def test_per_class_output(self):
+        from torcheval_tpu.parallel import sharded_multiclass_auroc_histogram
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(1)
+        scores = rng.random((512, 4)).astype(np.float32)
+        target = rng.integers(0, 4, 512).astype(np.int32)
+        out = sharded_multiclass_auroc_histogram(
+            *shard_batch(mesh, jnp.asarray(scores), jnp.asarray(target)),
+            mesh=mesh,
+            average=None,
+        )
+        self.assertEqual(out.shape, (4,))
+
+    def test_bad_shapes_raise(self):
+        from torcheval_tpu.parallel import sharded_multiclass_auroc_histogram
+
+        mesh = make_mesh()
+        with self.assertRaisesRegex(ValueError, "scores should be"):
+            sharded_multiclass_auroc_histogram(
+                jnp.ones(8), jnp.ones(8), mesh=mesh
+            )
+
+
 class TestReplicate(unittest.TestCase):
     def test_replicate_tree(self):
         mesh = make_mesh()

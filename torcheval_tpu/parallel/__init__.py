@@ -35,6 +35,7 @@ from torcheval_tpu.parallel.sync import (
     make_synced_update,
     mesh_merge_states,
     sharded_auroc_histogram,
+    sharded_multiclass_auroc_histogram,
 )
 
 __all__ = [
@@ -45,4 +46,5 @@ __all__ = [
     "replicate",
     "shard_batch",
     "sharded_auroc_histogram",
+    "sharded_multiclass_auroc_histogram",
 ]

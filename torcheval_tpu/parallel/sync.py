@@ -170,3 +170,70 @@ def sharded_auroc_histogram(
         )
     )
     return fn(scores, targets, weights)
+
+
+def sharded_multiclass_auroc_histogram(
+    scores: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    axis: str = "dp",
+    num_bins: int = 2048,
+    average: Optional[str] = "macro",
+) -> jax.Array:
+    """Pod-scale one-vs-rest multiclass AUROC — the BASELINE north-star
+    workload shape (1000-class, samples sharded over the pod) with
+    O(C × num_bins) communication instead of gathering every raw sample.
+
+    Each device scatters its local ``(n_local, C)`` scores (assumed in
+    [0, 1], clipped) into per-class positive/total histograms, ONE ``psum``
+    merges the ``(C, 2 × num_bins)`` statistics across the mesh, and every
+    device integrates the binned ROC curves — all classes vectorized.
+    Quantization caveat as :func:`sharded_auroc_histogram`.
+    """
+    if scores.ndim != 2 or targets.ndim != 1:
+        raise ValueError(
+            "scores should be (N, C) and targets (N,), got "
+            f"{scores.shape} / {targets.shape}."
+        )
+    num_classes = scores.shape[1]
+
+    def local(s, t):
+        idx = jnp.clip((s * num_bins).astype(jnp.int32), 0, num_bins - 1)
+        class_grid = jnp.broadcast_to(
+            jnp.arange(num_classes, dtype=jnp.int32)[None, :], idx.shape
+        )
+        hit = (t[:, None] == class_grid).astype(jnp.float32)
+        pos = (
+            jnp.zeros((num_classes, num_bins), jnp.float32)
+            .at[class_grid.reshape(-1), idx.reshape(-1)]
+            .add(hit.reshape(-1))
+        )
+        tot = (
+            jnp.zeros((num_classes, num_bins), jnp.float32)
+            .at[class_grid.reshape(-1), idx.reshape(-1)]
+            .add(1.0)
+        )
+        pos = lax.psum(pos, axis)
+        tot = lax.psum(tot, axis)
+        neg = tot - pos
+        zero = jnp.zeros((num_classes, 1), jnp.float32)
+        cum_tp = jnp.concatenate(
+            [zero, jnp.cumsum(pos[:, ::-1], axis=-1)], axis=-1
+        )
+        cum_fp = jnp.concatenate(
+            [zero, jnp.cumsum(neg[:, ::-1], axis=-1)], axis=-1
+        )
+        factor = cum_tp[:, -1] * cum_fp[:, -1]
+        area = jnp.trapezoid(cum_tp, cum_fp, axis=-1)
+        aurocs = jnp.where(factor == 0, 0.5, area / factor)
+        return aurocs.mean() if average == "macro" else aurocs
+
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(PartitionSpec(axis), PartitionSpec(axis)),
+            out_specs=PartitionSpec(),
+        )
+    )
+    return fn(scores, targets)
