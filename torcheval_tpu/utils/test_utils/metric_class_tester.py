@@ -179,6 +179,25 @@ class MetricClassTester(unittest.TestCase):
         self._test_metric_picklable_hashable(test_metrics[0])
         self._test_state_dict_load_state_dict(test_metrics[0])
 
+        # cross-device merge (reference merges cpu↔cuda metrics,
+        # ``metric_class_tester.py:265-277``; here the virtual CPU mesh
+        # provides the extra devices)
+        devices = jax.devices()
+        if len(devices) > 1:
+            cross: List[Metric] = [
+                deepcopy(self._metric).to(devices[i % len(devices)])
+                for i in range(num_processes)
+            ]
+            for i in range(num_processes):
+                for j in range(per_rank):
+                    cross[i].update(**self._update_args(i * per_rank + j))
+            assert_result_close(
+                cross[0].merge_state(cross[1:]).compute(),
+                self._merge_and_compute_result,
+                atol=self._atol,
+                rtol=self._rtol,
+            )
+
         # metric still usable after merge
         test_metrics[0].update(**self._update_args(0)).compute()
 
