@@ -90,6 +90,36 @@ class TestGetModuleSummary(unittest.TestCase):
         self.assertGreater(s.flops_forward, 0)
 
 
+class TransformerBlock(nn.Module):
+    dim: int = 32
+    heads: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.LayerNorm()(x)
+        y = nn.SelfAttention(num_heads=self.heads, qkv_features=self.dim)(y)
+        x = x + y
+        y = nn.LayerNorm()(x)
+        y = nn.Dense(4 * self.dim)(y)
+        y = nn.gelu(y)
+        return x + nn.Dense(self.dim)(y)
+
+
+class TestTransformerSummary(unittest.TestCase):
+    def test_attention_model_flops_and_tree(self):
+        m = TransformerBlock()
+        x = jnp.ones((2, 16, 32))
+        s = get_module_summary(m, (x,))
+        names = set(s.submodule_summaries)
+        self.assertIn("SelfAttention_0", names)
+        self.assertIn("Dense_0", names)
+        attn = s.submodule_summaries["SelfAttention_0"]
+        # QKV + output projections: 4 * dim*dim (+biases) parameters.
+        self.assertEqual(attn.num_parameters, 4 * (32 * 32 + 32))
+        self.assertGreater(attn.flops_forward, 0)
+        self.assertGreater(s.flops_backward, s.flops_forward // 2)
+
+
 class TestSummaryTable(unittest.TestCase):
     def test_table_contains_rows_and_remark(self):
         s = get_module_summary(MLP(), (jnp.ones((4, 32)),))

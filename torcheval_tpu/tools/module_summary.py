@@ -193,11 +193,17 @@ def get_module_summary(
     # reference ``flops.py:313-326``) -----------------------------------
     records: Dict[Tuple[str, ...], List[Tuple[Any, Tuple, Dict]]] = {}
     type_by_path: Dict[Tuple[str, ...], str] = {(): type(module).__name__}
+    # Re-entrant __call__ on the SAME path is internal self-delegation
+    # (e.g. flax SelfAttention.__call__ → MultiHeadDotProductAttention
+    # .__call__) — record only the outermost call so FLOPs aren't doubled.
+    active: Dict[Tuple[str, ...], int] = {}
 
     def interceptor(next_fun, args, kwargs, context):
         path = tuple(context.module.path)
         type_by_path.setdefault(path, type(context.module).__name__)
-        if context.method_name == "__call__":
+        if context.method_name != "__call__":
+            return next_fun(*args, **kwargs)
+        if not active.get(path):
             avals = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
                 if hasattr(a, "shape")
@@ -206,7 +212,11 @@ def get_module_summary(
             )
             clone = context.module.clone(parent=None)
             records.setdefault(path, []).append((clone, avals[0], avals[1]))
-        return next_fun(*args, **kwargs)
+        active[path] = active.get(path, 0) + 1
+        try:
+            return next_fun(*args, **kwargs)
+        finally:
+            active[path] -= 1
 
     def run(v, *a, **kw):
         with nn.intercept_methods(interceptor):
