@@ -330,6 +330,31 @@ class TestClassParityWindowed(unittest.TestCase):
             ref.update(_t(s), _t(t))
         _close(float(ours.compute()), float(ref.compute()), rtol=1e-5)
 
+    def test_windowed_auroc_merge_grows_window(self):
+        from torcheval.metrics import WindowedBinaryAUROC as Ref
+
+        from torcheval_tpu.metrics import WindowedBinaryAUROC
+
+        def build(cls, seeds, max_num_samples=60):
+            metrics = []
+            for seed in seeds:
+                r = np.random.default_rng(seed)
+                m = cls(max_num_samples=max_num_samples)
+                for chunk in range(2):
+                    s = r.random(40).astype(np.float32)
+                    t = (r.random(40) > 0.5).astype(np.int64)
+                    if cls is Ref:
+                        m.update(_t(s), _t(t))
+                    else:
+                        m.update(jnp.asarray(s), jnp.asarray(t.astype(np.float32)))
+                metrics.append(m)
+            metrics[0].merge_state(metrics[1:])
+            return metrics[0]
+
+        ours = build(WindowedBinaryAUROC, (0, 1, 2))
+        ref = build(Ref, (0, 1, 2))
+        _close(float(ours.compute()), float(ref.compute()), rtol=1e-5)
+
     def test_windowed_normalized_entropy(self):
         from torcheval.metrics import WindowedBinaryNormalizedEntropy as Ref
 
