@@ -28,6 +28,7 @@ lookahead, and rows of any length stream through fixed-size tiles.
 """
 
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -208,12 +209,16 @@ def auc_from_sorted(
 
 
 def pallas_binary_auroc(
-    scores: jax.Array, targets: jax.Array, *, interpret: bool = False
+    scores: jax.Array, targets: jax.Array, *, interpret: Optional[bool] = None
 ) -> jax.Array:
     """Exact binary AUROC via variadic sort + the fused Pallas scan.
 
     Accepts ``(N,)`` or multi-task ``(R, N)`` inputs like ``binary_auroc``.
+    ``interpret`` defaults to the backend's capability: the compiled Mosaic
+    kernel on TPU, the Pallas interpreter elsewhere (slow but correct).
     """
+    if interpret is None:
+        interpret = not has_pallas()
     scores = jnp.asarray(scores)
     targets = jnp.asarray(targets)
     squeeze = scores.ndim == 1
