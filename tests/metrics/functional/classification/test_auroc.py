@@ -114,6 +114,12 @@ class TestMulticlassAUROC(unittest.TestCase):
             multiclass_auroc(np.zeros((2, 2)), np.zeros(2), num_classes=2, average="x")
         with self.assertRaisesRegex(ValueError, "at least 2"):
             multiclass_auroc(np.zeros((2, 1)), np.zeros(2), num_classes=1)
+        with self.assertRaisesRegex(ValueError, "same first dimension"):
+            multiclass_auroc(np.zeros((4, 2)), np.zeros(3), num_classes=2)
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            multiclass_auroc(np.zeros((3, 2)), np.zeros((3, 2)), num_classes=2)
+        with self.assertRaisesRegex(ValueError, r"\(num_sample, num_classes\)"):
+            multiclass_auroc(np.zeros((3, 4)), np.zeros(3), num_classes=2)
 
 
 class TestEmptyInput(unittest.TestCase):
