@@ -1,0 +1,137 @@
+"""Error-path parity battery: the validation errors the reference asserts by
+regex across its functional classification tests (e.g. reference
+``test_confusion_matrix.py:70-235``, ``test_binned_precision_recall_curve
+.py:95-180``, ``test_precision_recall_curve.py``) — asserted here against
+this framework's kernels."""
+
+import unittest
+
+import numpy as np
+
+from torcheval_tpu.metrics.functional import (
+    binary_binned_precision_recall_curve,
+    binary_confusion_matrix,
+    binary_precision_recall_curve,
+    multiclass_binned_precision_recall_curve,
+    multiclass_confusion_matrix,
+    multiclass_f1_score,
+    multiclass_precision,
+    multiclass_recall,
+)
+
+
+class TestConfusionMatrixErrors(unittest.TestCase):
+    def test_binary_shape_errors(self):
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            binary_confusion_matrix(np.zeros((3, 2)), np.zeros((3, 2)))
+        with self.assertRaisesRegex(ValueError, "same"):
+            binary_confusion_matrix(np.zeros(4), np.zeros(3))
+
+    def test_normalize_validation(self):
+        with self.assertRaisesRegex(ValueError, "normalize must be one of"):
+            multiclass_confusion_matrix(
+                np.zeros(3, dtype=np.int32),
+                np.zeros(3, dtype=np.int32),
+                num_classes=2,
+                normalize="bogus",
+            )
+
+    def test_num_classes_minimum(self):
+        with self.assertRaisesRegex(ValueError, "at least two classes"):
+            multiclass_confusion_matrix(
+                np.zeros(3, dtype=np.int32),
+                np.zeros(3, dtype=np.int32),
+                num_classes=1,
+            )
+
+    def test_multiclass_shape_errors(self):
+        with self.assertRaisesRegex(ValueError, "same first dimension"):
+            multiclass_confusion_matrix(
+                np.zeros(4, dtype=np.int32),
+                np.zeros(3, dtype=np.int32),
+                num_classes=2,
+            )
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            multiclass_confusion_matrix(
+                np.zeros(3, dtype=np.int32),
+                np.zeros((3, 2), dtype=np.int32),
+                num_classes=2,
+            )
+        with self.assertRaisesRegex(ValueError, "input should have shape"):
+            multiclass_confusion_matrix(
+                np.zeros((3, 4), dtype=np.float32),
+                np.zeros(3, dtype=np.int32),
+                num_classes=2,
+            )
+
+    def test_out_of_range_classes(self):
+        with self.assertRaisesRegex(ValueError, "too large"):
+            multiclass_confusion_matrix(
+                np.asarray([0, 1, 5], dtype=np.int32),
+                np.asarray([0, 1, 1], dtype=np.int32),
+                num_classes=2,
+            )
+        with self.assertRaisesRegex(ValueError, "larger than the number"):
+            multiclass_confusion_matrix(
+                np.asarray([0, 1, 1], dtype=np.int32),
+                np.asarray([0, 1, 5], dtype=np.int32),
+                num_classes=2,
+            )
+
+
+class TestBinnedCurveErrors(unittest.TestCase):
+    def test_threshold_sorted(self):
+        with self.assertRaisesRegex(ValueError, "sorted"):
+            binary_binned_precision_recall_curve(
+                np.zeros(4),
+                np.zeros(4),
+                threshold=np.asarray([0.1, 0.2, 0.5, 0.7, 0.6]),
+            )
+
+    def test_threshold_range(self):
+        for bad in ([-0.1, 0.2, 0.5, 0.7], [0.1, 0.2, 0.5, 1.7]):
+            with self.assertRaisesRegex(ValueError, r"range of \[0, 1\]"):
+                binary_binned_precision_recall_curve(
+                    np.zeros(4), np.zeros(4), threshold=np.asarray(bad)
+                )
+
+    def test_shape_errors(self):
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            binary_binned_precision_recall_curve(np.zeros(4), np.zeros(3))
+        with self.assertRaisesRegex(ValueError, "same first dimension"):
+            multiclass_binned_precision_recall_curve(
+                np.zeros((4, 2)), np.zeros(3, dtype=np.int32), num_classes=2
+            )
+
+
+class TestCurveErrors(unittest.TestCase):
+    def test_binary_shape_errors(self):
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            binary_precision_recall_curve(np.zeros(4), np.zeros(3))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            binary_precision_recall_curve(np.zeros((3, 2)), np.zeros((3, 2)))
+
+
+class TestPRFErrors(unittest.TestCase):
+    def test_average_validation(self):
+        for fn in (multiclass_f1_score, multiclass_precision, multiclass_recall):
+            with self.assertRaisesRegex(ValueError, "`average` was not in"):
+                fn(
+                    np.zeros(3, dtype=np.int32),
+                    np.zeros(3, dtype=np.int32),
+                    num_classes=2,
+                    average="bogus",
+                )
+
+    def test_num_classes_required_for_macro(self):
+        with self.assertRaisesRegex(ValueError, "num_classes"):
+            multiclass_f1_score(
+                np.zeros(3, dtype=np.int32),
+                np.zeros(3, dtype=np.int32),
+                num_classes=None,
+                average="macro",
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()
