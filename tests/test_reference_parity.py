@@ -236,6 +236,34 @@ class TestFunctionalParity(unittest.TestCase):
             ref_f.num_collisions(_t(ids)),
         )
 
+    def test_topk_multilabel_documented_divergence(self):
+        """The reference hardcodes ``topk(k=2)`` regardless of ``k``
+        (reference ``accuracy.py:393-395`` — a bug, SURVEY §7.7).  At k=2 the
+        implementations must agree; at k=3 this framework must honor k,
+        i.e. agree with a correct k=3 oracle, not with the reference."""
+        labels = (RNG.random((N, C)) > 0.6).astype(np.float32)
+        preds = RNG.random((N, C)).astype(np.float32)
+
+        ours_k2 = our_f.topk_multilabel_accuracy(
+            jnp.asarray(preds), jnp.asarray(labels), criteria="hamming", k=2
+        )
+        ref_k2 = ref_f.topk_multilabel_accuracy(
+            _t(preds), _t(labels), criteria="hamming", k=2
+        )
+        _close(ours_k2, ref_k2)
+
+        # Correct k=3 oracle: scatter ones at the top-3 indices, hamming.
+        top3 = np.argsort(-preds, axis=1)[:, :3]
+        pred3 = np.zeros_like(preds)
+        np.put_along_axis(pred3, top3, 1.0, axis=1)
+        oracle_k3 = (pred3 == labels).mean()
+        ours_k3 = float(
+            our_f.topk_multilabel_accuracy(
+                jnp.asarray(preds), jnp.asarray(labels), criteria="hamming", k=3
+            )
+        )
+        np.testing.assert_allclose(ours_k3, oracle_k3, rtol=1e-6)
+
     def test_weighted_calibration(self):
         w = RNG.random(N).astype(np.float64)
         _close(
