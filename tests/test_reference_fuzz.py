@@ -1,0 +1,131 @@
+"""Seeded fuzz sweep vs the live reference: random shapes, class counts,
+averages, and degenerate label distributions across the counter-metric
+families.  Complements the fixed-case parity matrix with edge shapes
+(tiny N, unseen classes, constant targets)."""
+
+import sys
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/reference")
+
+try:
+    from torcheval.metrics import functional as ref_f
+
+    HAVE_REF = True
+except Exception:  # pragma: no cover
+    HAVE_REF = False
+
+from torcheval_tpu.metrics import functional as our_f
+
+
+def _t(a):
+    import torch
+
+    return torch.from_numpy(np.asarray(a).copy())
+
+
+@unittest.skipUnless(HAVE_REF, "reference torcheval not available")
+class TestFuzzCounterMetrics(unittest.TestCase):
+    def test_multiclass_family_random_configs(self):
+        rng = np.random.default_rng(123)
+        pairs = [
+            (our_f.multiclass_accuracy, ref_f.multiclass_accuracy),
+            (our_f.multiclass_f1_score, ref_f.multiclass_f1_score),
+            (our_f.multiclass_precision, ref_f.multiclass_precision),
+            (our_f.multiclass_recall, ref_f.multiclass_recall),
+        ]
+        for trial in range(12):
+            n = int(rng.integers(1, 65))
+            c = int(rng.integers(2, 9))
+            average = ["micro", "macro", "weighted"][trial % 3]
+            scores = rng.random((n, c)).astype(np.float32)
+            # Degenerate distributions every third trial: constant target.
+            if trial % 3 == 0:
+                target = np.full(n, int(rng.integers(0, c)), dtype=np.int64)
+            else:
+                target = rng.integers(0, c, n).astype(np.int64)
+            for ours, ref in pairs:
+                if ours is our_f.multiclass_accuracy and average == "weighted":
+                    continue  # "weighted" is not an accuracy average
+                kwargs = {"average": average, "num_classes": c}
+                try:
+                    want = ref(_t(scores), _t(target), **kwargs)
+                except Exception:
+                    continue  # config invalid for the reference → skip
+                got = ours(
+                    jnp.asarray(scores),
+                    jnp.asarray(target.astype(np.int32)),
+                    **kwargs,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got),
+                    np.asarray(want),
+                    rtol=1e-4,
+                    atol=1e-6,
+                    equal_nan=True,
+                    err_msg=f"{ours.__name__} trial={trial} n={n} c={c} avg={average}",
+                )
+
+    def test_binary_family_random_configs(self):
+        rng = np.random.default_rng(321)
+        for trial in range(10):
+            n = int(rng.integers(1, 129))
+            scores = rng.random(n).astype(np.float32)
+            if trial % 4 == 0:
+                target = np.full(n, trial % 2, dtype=np.int64)  # single class
+            else:
+                target = (rng.random(n) > rng.random()).astype(np.int64)
+            threshold = float(rng.random())
+            pairs = [
+                (our_f.binary_accuracy, ref_f.binary_accuracy, {"threshold": threshold}),
+                (our_f.binary_f1_score, ref_f.binary_f1_score, {"threshold": threshold}),
+                (our_f.binary_precision, ref_f.binary_precision, {"threshold": threshold}),
+                (our_f.binary_recall, ref_f.binary_recall, {"threshold": threshold}),
+                (our_f.binary_auroc, ref_f.binary_auroc, {}),
+            ]
+            for ours, ref, kwargs in pairs:
+                want = ref(_t(scores), _t(target), **kwargs)
+                got = ours(
+                    jnp.asarray(scores),
+                    jnp.asarray(target.astype(np.float32)),
+                    **kwargs,
+                )
+                np.testing.assert_allclose(
+                    float(got),
+                    float(want),
+                    rtol=1e-4,
+                    atol=1e-6,
+                    equal_nan=True,
+                    err_msg=f"{ours.__name__} trial={trial} n={n}",
+                )
+
+    def test_regression_random_configs(self):
+        rng = np.random.default_rng(777)
+        for trial in range(8):
+            n = int(rng.integers(2, 257))
+            outputs = int(rng.integers(1, 4))
+            shape = (n,) if outputs == 1 else (n, outputs)
+            pred = rng.standard_normal(shape).astype(np.float32)
+            true = rng.standard_normal(shape).astype(np.float32)
+            for mo in ("uniform_average", "raw_values"):
+                got = our_f.mean_squared_error(
+                    jnp.asarray(pred), jnp.asarray(true), multioutput=mo
+                )
+                want = ref_f.mean_squared_error(_t(pred), _t(true), multioutput=mo)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6
+                )
+                got = our_f.r2_score(
+                    jnp.asarray(pred), jnp.asarray(true), multioutput=mo
+                )
+                want = ref_f.r2_score(_t(pred), _t(true), multioutput=mo)
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=1e-3, atol=1e-5
+                )
+
+
+if __name__ == "__main__":
+    unittest.main()
