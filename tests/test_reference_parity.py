@@ -172,6 +172,21 @@ class TestFunctionalParity(unittest.TestCase):
         _close(orc, rr)
         _close(ot, rt)
 
+    def test_multiclass_precision_recall_curve_ragged(self):
+        op, orc, ot = our_f.multiclass_precision_recall_curve(
+            jnp.asarray(self.scores),
+            jnp.asarray(self.target.astype(np.int32)),
+            num_classes=C,
+        )
+        rp, rr, rt = ref_f.multiclass_precision_recall_curve(
+            _t(self.scores), _t(self.target), num_classes=C
+        )
+        self.assertEqual(len(op), C)
+        for k in range(C):
+            _close(op[k], rp[k])
+            _close(orc[k], rr[k])
+            _close(ot[k], rt[k])
+
     def test_binned_precision_recall_curve(self):
         op, orc, ot = our_f.binary_binned_precision_recall_curve(
             jnp.asarray(self.bscores),
