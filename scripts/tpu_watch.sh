@@ -1,0 +1,21 @@
+#!/bin/bash
+# Retry TPU init every 5 minutes; on success immediately run the
+# validation + benchmark suite. Never SIGTERM the probe mid-flight —
+# each probe either succeeds or errors out on its own.
+cd /root/repo
+for i in $(seq 1 40); do
+  echo "=== probe $i $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+  if python -u -c "
+import jax, jax.numpy as jnp
+print('devices', jax.devices())
+print('ok', float(jnp.ones(8).sum()))
+" >> /tmp/tpu_watch.log 2>&1; then
+    echo "=== TPU BACK — running validation $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+    python -u scripts/tpu_validate.py >> /tmp/tpu_watch.log 2>&1
+    echo "=== validation done $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+    exit 0
+  fi
+  sleep 300
+done
+echo "=== gave up after 40 probes ===" >> /tmp/tpu_watch.log
+exit 1
