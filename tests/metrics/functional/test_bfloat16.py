@@ -1,0 +1,49 @@
+"""bfloat16 inputs — the TPU-native activation dtype — must flow through
+the metric kernels, agreeing with float32 results to bf16 precision."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import functional as f
+
+
+class TestBfloat16Inputs(unittest.TestCase):
+    def setUp(self):
+        rng = np.random.default_rng(0)
+        self.scores32 = rng.random((128, 5)).astype(np.float32)
+        self.target = jnp.asarray(rng.integers(0, 5, 128, dtype=np.int32))
+        self.scores16 = jnp.asarray(self.scores32, dtype=jnp.bfloat16)
+        self.b32 = rng.random(512).astype(np.float32)
+        self.bt = jnp.asarray((rng.random(512) > 0.5).astype(np.float32))
+        self.b16 = jnp.asarray(self.b32, dtype=jnp.bfloat16)
+
+    def test_accuracy_f1(self):
+        # argmax/count metrics: bf16 rounding may flip near-tied argmaxes,
+        # so compare against the f32 view of the SAME bf16 values.
+        as32 = jnp.asarray(self.scores16, dtype=jnp.float32)
+        for fn in (f.multiclass_accuracy, f.multiclass_f1_score):
+            got = float(fn(self.scores16, self.target, num_classes=5))
+            want = float(fn(as32, self.target, num_classes=5))
+            self.assertAlmostEqual(got, want, places=6, msg=fn.__name__)
+
+    def test_auroc(self):
+        as32 = jnp.asarray(self.b16, dtype=jnp.float32)
+        got = float(f.binary_auroc(self.b16, self.bt))
+        want = float(f.binary_auroc(as32, self.bt))
+        self.assertAlmostEqual(got, want, places=5)
+
+    def test_regression(self):
+        got = float(f.mean_squared_error(self.b16, self.bt))
+        want = float(f.mean_squared_error(jnp.asarray(self.b32), self.bt))
+        self.assertAlmostEqual(got, want, places=2)  # bf16 has ~3 digits
+
+    def test_curves_run(self):
+        p, r, t = f.binary_precision_recall_curve(self.b16, self.bt)
+        self.assertEqual(p.shape[0], r.shape[0])
+        self.assertEqual(p.shape[0], t.shape[0] + 1)
+
+
+if __name__ == "__main__":
+    unittest.main()
