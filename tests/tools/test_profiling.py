@@ -1,0 +1,36 @@
+"""Profiling facade: traces must capture jitted metric work and the
+annotations must nest without error."""
+
+import glob
+import tempfile
+import unittest
+
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics import MulticlassAccuracy
+from torcheval_tpu.tools import profiling
+
+
+class TestTrace(unittest.TestCase):
+    def test_trace_writes_events(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with profiling.trace(tmp):
+                with profiling.annotate("metric-update"):
+                    m = MulticlassAccuracy()
+                    with profiling.step_marker("eval", 0):
+                        m.update(
+                            jnp.asarray([[0.9, 0.1], [0.2, 0.8]]),
+                            jnp.asarray([0, 1]),
+                        )
+                    float(m.compute())
+            traces = glob.glob(f"{tmp}/**/*.xplane.pb", recursive=True)
+            self.assertTrue(traces, "no trace files written")
+
+    def test_device_memory_profile(self):
+        profile = profiling.device_memory_profile()
+        self.assertIsInstance(profile, bytes)
+        self.assertGreater(len(profile), 0)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -13,6 +13,7 @@ from torcheval_tpu.tools.module_summary import (
     ModuleSummary,
     prune_module_summary,
 )
+from torcheval_tpu.tools import profiling
 
 __all__ = [
     "cost_summary",
@@ -21,5 +22,6 @@ __all__ = [
     "get_module_summary",
     "get_summary_table",
     "ModuleSummary",
+    "profiling",
     "prune_module_summary",
 ]

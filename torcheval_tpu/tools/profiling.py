@@ -1,0 +1,50 @@
+"""Runtime tracing — the TPU-side observability counterpart to the
+reference's instrumentation subsystem.
+
+The reference's closest facilities are its dispatcher-interposing FLOP
+counter and per-construction usage telemetry (reference
+``tools/flops.py:170-233``, ``metric.py:44``); it has no runtime tracer.
+On TPU the platform one is ``jax.profiler`` — traces carry XLA op timings,
+HBM traffic, and fusion boundaries, viewable in TensorBoard/Perfetto.
+This module is the thin, stable entry point so eval loops don't import
+``jax.profiler`` directly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, *, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture a device trace of the enclosed block into ``log_dir``.
+
+    Wraps ``jax.profiler.trace``; the output is a TensorBoard/Perfetto
+    trace of every XLA program launched inside the block (metric updates,
+    computes, collectives).
+    """
+    with jax.profiler.trace(log_dir, create_perfetto_link=create_perfetto_link):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Label the enclosed host span in the trace (``TraceAnnotation``), so
+    per-metric phases are attributable in the timeline."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def step_marker(name: str, step: int) -> "jax.profiler.StepTraceAnnotation":
+    """Mark one eval step in the trace timeline (use as a context manager:
+    ``with step_marker("eval", i): ...``)."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def device_memory_profile(backend: Optional[str] = None) -> bytes:
+    """Current device memory profile (pprof format) — the allocator-level
+    view of metric buffer residency."""
+    return jax.profiler.device_memory_profile(backend=backend)
