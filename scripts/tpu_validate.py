@@ -7,10 +7,15 @@ sklearn on-device, then runs the headline benchmark and the full workload
 suite, printing the JSON lines at the end.
 """
 
+import os
 import subprocess
 import sys
 
 import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
 
 
 def validate_pallas() -> None:
@@ -43,7 +48,7 @@ def main() -> None:
             [sys.executable, "bench.py", *args],
             capture_output=True,
             text=True,
-            cwd="/root/repo",
+            cwd=REPO_ROOT,
         )
         sys.stderr.write(proc.stderr[-2000:])
         print(proc.stdout, flush=True)

@@ -1,0 +1,60 @@
+"""Unit tests for the fused single-round-trip host-side check helpers.
+
+These helpers exist so update-path validation costs exactly one device
+round trip (see ``torcheval_tpu/metrics/functional/_host_checks.py``);
+correctness of the packed layout is what every range check relies on.
+"""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.functional._host_checks import any_flags, bounds
+
+
+class TestBounds(unittest.TestCase):
+    def test_single_array(self):
+        out = bounds(jnp.asarray([3, -1, 7], dtype=jnp.int32))
+        np.testing.assert_array_equal(out, [-1.0, 7.0])
+
+    def test_two_arrays_packed_order(self):
+        a = jnp.asarray([5, 2], dtype=jnp.int32)
+        b = jnp.asarray([0.25, 0.75], dtype=jnp.float32)
+        out = bounds(a, b)
+        np.testing.assert_allclose(out, [2.0, 5.0, 0.25, 0.75])
+
+    def test_int_bounds_exact_at_class_scale(self):
+        # Largest realistic class index: exact in float32 (< 2**24).
+        a = jnp.asarray([0, 2**23], dtype=jnp.int32)
+        lo, hi = bounds(a)
+        self.assertEqual(int(lo), 0)
+        self.assertEqual(int(hi), 2**23)
+
+    def test_returns_numpy_host_values(self):
+        out = bounds(jnp.arange(4))
+        self.assertIsInstance(out, np.ndarray)
+
+
+class TestAnyFlags(unittest.TestCase):
+    def test_flag_order_preserved(self):
+        t = jnp.asarray([0.1, 0.5, 0.9])
+        unsorted, below, above = any_flags(
+            jnp.diff(t) < 0.0, t < 0.0, t > 1.0
+        )
+        self.assertFalse(bool(unsorted))
+        self.assertFalse(bool(below))
+        self.assertFalse(bool(above))
+
+    def test_detects_violations(self):
+        t = jnp.asarray([0.9, 0.5, 1.5])
+        unsorted, below, above = any_flags(
+            jnp.diff(t) < 0.0, t < 0.0, t > 1.0
+        )
+        self.assertTrue(bool(unsorted))
+        self.assertFalse(bool(below))
+        self.assertTrue(bool(above))
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -25,6 +25,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional._host_checks import check_index_ranges
+
 
 # ---------------------------------------------------------------- public API
 
@@ -153,13 +155,7 @@ def _multiclass_accuracy_update(
     # XLA silently drops/clamps OOB indices where torch scatter_/gather error.
     if average != "micro" or k > 1:
         upper = num_classes if num_classes is not None else input.shape[-1]
-        if target.size and (
-            int(jnp.min(target)) < 0 or int(jnp.max(target)) >= upper
-        ):
-            raise ValueError(
-                f"target values should be in [0, {upper}), "
-                f"got min {int(jnp.min(target))} max {int(jnp.max(target))}."
-            )
+        check_index_ranges([(target, "target")], upper)
     return _multiclass_accuracy_update_kernel(input, target, average, num_classes, k)
 
 

@@ -17,6 +17,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional._host_checks import bounds
+
 
 def _accum_dtype() -> jnp.dtype:
     return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -127,7 +129,8 @@ def _ne_input_check(
             f"({num_tasks}, num_samples), but got shape ({input.shape})."
         )
     if not from_logits and input.size:
-        input_max, input_min = float(jnp.max(input)), float(jnp.min(input))
+        lo, hi = bounds(input)
+        input_min, input_max = float(lo), float(hi)
         if input_max > 1.0 or input_min < 0.0:
             raise ValueError(
                 f"`from_logits`={from_logits}, `input` should be probability "

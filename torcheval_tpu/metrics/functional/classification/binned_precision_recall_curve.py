@@ -13,6 +13,7 @@ from typing import List, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional._host_checks import any_flags
 from torcheval_tpu.metrics.functional.classification.precision import (
     _check_index_range,
 )
@@ -160,9 +161,12 @@ def _create_threshold_tensor(
 def _binned_precision_recall_curve_param_check(threshold: jax.Array) -> None:
     """Thresholds must be sorted and within [0, 1]
     (reference ``binned_precision_recall_curve.py:235-242``)."""
-    if bool(jnp.any(jnp.diff(threshold) < 0.0)):
+    unsorted, below, above = any_flags(
+        jnp.diff(threshold) < 0.0, threshold < 0.0, threshold > 1.0
+    )
+    if unsorted:
         raise ValueError("The `threshold` should be a sorted array.")
-    if bool(jnp.any(threshold < 0.0)) or bool(jnp.any(threshold > 1.0)):
+    if below or above:
         raise ValueError("The values in `threshold` should be in the range of [0, 1].")
 
 

@@ -14,6 +14,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional._host_checks import bounds
+
 
 def binary_confusion_matrix(
     input,
@@ -71,12 +73,14 @@ def _binary_confusion_matrix_update(
     _binary_confusion_matrix_input_check(input, target)
     # OOB targets must raise — the XLA scatter would silently drop them
     # where torch ``scatter_`` errors.
-    if target.size and (int(jnp.min(target)) < 0 or int(jnp.max(target)) >= 2):
-        raise ValueError(
-            "Got `target` class which is larger than the number of classes, "
-            "num_classes: 2 must be strictly greater than max target: "
-            f"{int(jnp.max(target))}."
-        )
+    if target.size:
+        t_min, t_max = bounds(target)
+        if t_min < 0 or t_max >= 2:
+            raise ValueError(
+                "Got `target` class which is larger than the number of classes, "
+                "num_classes: 2 must be strictly greater than max target: "
+                f"{int(t_max)}."
+            )
     pred = jnp.where(input < threshold, 0, 1)
     return _confusion_matrix_update_kernel(pred, target.astype(jnp.int32), 2)
 
@@ -132,25 +136,29 @@ def _confusion_matrix_update_input_check(
                 "input should have shape of (num_sample,) or (num_sample, num_classes), "
                 f"got {input.shape}."
             )
+        t_min, t_max = bounds(target)
     else:
-        if int(jnp.max(input)) >= num_classes:
+        # All four bounds in one fused dispatch — a range check is one
+        # device round trip, not four.
+        i_min, i_max, t_min, t_max = bounds(input, target)
+        if i_max >= num_classes:
             raise ValueError(
                 "Got `input` prediction class which is too large for the number of classes, "
                 f"num_classes: {num_classes} must be strictly greater than max "
-                f"class predicted: {int(jnp.max(input))}."
+                f"class predicted: {int(i_max)}."
             )
-        if int(jnp.min(input)) < 0:
+        if i_min < 0:
             raise ValueError(
-                f"Got negative `input` prediction class {int(jnp.min(input))}."
+                f"Got negative `input` prediction class {int(i_min)}."
             )
-    if int(jnp.max(target)) >= num_classes:
+    if t_max >= num_classes:
         raise ValueError(
             "Got `target` class which is larger than the number of classes, "
             f"num_classes: {num_classes} must be strictly greater than max "
-            f"target: {int(jnp.max(target))}."
+            f"target: {int(t_max)}."
         )
-    if int(jnp.min(target)) < 0:
-        raise ValueError(f"Got negative `target` class {int(jnp.min(target))}.")
+    if t_min < 0:
+        raise ValueError(f"Got negative `target` class {int(t_min)}.")
 
 
 def _binary_confusion_matrix_input_check(input: jax.Array, target: jax.Array) -> None:

@@ -18,6 +18,10 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional._host_checks import (
+    check_index_ranges as _check_index_ranges,
+)
+
 _logger = logging.getLogger(__name__)
 
 
@@ -51,9 +55,10 @@ def _precision_update(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     _precision_update_input_check(input, target, num_classes)
     if average != "micro":
-        _check_index_range(target, num_classes, "target")
+        pairs = [(target, "target")]
         if input.ndim == 1:
-            _check_index_range(input, num_classes, "input")
+            pairs.append((input, "input"))
+        _check_index_ranges(pairs, num_classes)
     return _precision_update_kernel(input, target, num_classes, average)
 
 
@@ -153,13 +158,7 @@ def _precision_update_input_check(
 def _check_index_range(values: jax.Array, upper: Optional[int], name: str) -> None:
     """OOB class indices must raise (XLA scatter silently drops them where
     torch ``scatter_`` errors)."""
-    if upper is None or not values.size:
-        return
-    if int(jnp.min(values)) < 0 or int(jnp.max(values)) >= upper:
-        raise ValueError(
-            f"{name} values should be in [0, {upper}), got min "
-            f"{int(jnp.min(values))} max {int(jnp.max(values))}."
-        )
+    _check_index_ranges([(values, name)], upper)
 
 
 def _binary_precision_update(

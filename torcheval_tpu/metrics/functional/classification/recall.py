@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics.functional.classification.precision import (
-    _check_index_range,
+    _check_index_ranges,
 )
 
 _logger = logging.getLogger(__name__)
@@ -70,9 +70,10 @@ def _recall_update(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     _recall_update_input_check(input, target, num_classes)
     if average != "micro":
-        _check_index_range(target, num_classes, "target")
+        pairs = [(target, "target")]
         if input.ndim == 1:
-            _check_index_range(input, num_classes, "input")
+            pairs.append((input, "input"))
+        _check_index_ranges(pairs, num_classes)
     return _recall_update_kernel(input, target, num_classes, average)
 
 
