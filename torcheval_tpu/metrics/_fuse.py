@@ -1,0 +1,55 @@
+"""Fused update-accumulate dispatch for counter-state metrics.
+
+A class-metric ``update()`` used to launch one device program for the
+sufficient-statistic kernel and one more per state for the ``state + delta``
+add — three or more dispatches per batch.  Each dispatch costs host→device
+round-trip overhead (microseconds on a local PCIe host, milliseconds through
+a tunneled backend), which dominates the microsecond-scale counter kernels.
+
+``accumulate`` folds the kernel and every state add into ONE jitted program:
+the per-update cost becomes a single dispatch regardless of how many states
+the metric owns.  Input validation stays on the host, before the call (it
+must raise eagerly — reference semantics, e.g. reference
+``torcheval/metrics/functional/classification/confusion_matrix.py:245-280``).
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("kernel", "statics", "grow"))
+def _accumulate_jit(states, args, kernel, statics, grow):
+    deltas = kernel(*args, *statics)
+    if not isinstance(deltas, tuple):
+        deltas = (deltas,)
+    out = []
+    for s, d in zip(states, deltas):
+        if grow and s.ndim == 0 and d.ndim == 1:
+            # Per-output regression states replace the scalar default on the
+            # first 2-D update instead of broadcasting into it (reference
+            # ``regression/mean_squared_error.py`` state-growth behavior).
+            out.append(d)
+        else:
+            out.append(s + d)
+    return tuple(out)
+
+
+def accumulate(
+    kernel,
+    states: Tuple[jax.Array, ...],
+    *args,
+    statics: tuple = (),
+    grow: bool = False,
+) -> Tuple[jax.Array, ...]:
+    """Run ``kernel(*args, *statics)`` and add its delta(s) onto ``states``
+    in one fused dispatch.
+
+    ``kernel`` must be a module-level (jitted or plain) pure function — its
+    identity is part of the jit cache key.  ``statics`` are hashable
+    trace-time constants appended positionally after ``args``.  ``grow=True``
+    replicates the scalar→vector replace-on-first-2-D-update semantics of
+    per-output regression states.  Returns the new state tuple.
+    """
+    return _accumulate_jit(tuple(states), tuple(args), kernel, tuple(statics), grow)

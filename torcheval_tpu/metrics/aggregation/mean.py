@@ -7,7 +7,8 @@ from typing import Iterable, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional.aggregation.mean import _mean_update
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics.functional.aggregation.mean import _mean_select_kernel
 from torcheval_tpu.metrics.metric import Metric
 
 _logger: logging.Logger = logging.getLogger(__name__)
@@ -20,9 +21,11 @@ class Mean(Metric[jax.Array]):
         self._add_state("weights", jnp.asarray(0.0))
 
     def update(self, input, weight: Union[float, int, "jax.Array"] = 1.0) -> "Mean":
-        weighted_sum, weights = _mean_update(jnp.asarray(input), weight)
-        self.weighted_sum = self.weighted_sum + weighted_sum
-        self.weights = self.weights + weights
+        kernel, args = _mean_select_kernel(jnp.asarray(input), weight)
+        # Kernel + both state adds fused into one dispatch (_fuse.py).
+        self.weighted_sum, self.weights = accumulate(
+            kernel, (self.weighted_sum, self.weights), *args
+        )
         return self
 
     def compute(self) -> jax.Array:

@@ -6,7 +6,11 @@ from typing import Iterable, Union
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional.aggregation.sum import _sum_update
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics.functional.aggregation.sum import (
+    _sum_validate,
+    _weighted_sum,
+)
 from torcheval_tpu.metrics.metric import Metric
 
 
@@ -16,8 +20,11 @@ class Sum(Metric[jax.Array]):
         self._add_state("weighted_sum", jnp.asarray(0.0))
 
     def update(self, input, weight: Union[float, int, "jax.Array"] = 1.0) -> "Sum":
-        self.weighted_sum = self.weighted_sum + _sum_update(
-            jnp.asarray(input), weight
+        input = jnp.asarray(input)
+        _sum_validate(input, weight)
+        # Kernel + state add fused into one dispatch (_fuse.py).
+        (self.weighted_sum,) = accumulate(
+            _weighted_sum, (self.weighted_sum,), input, weight
         )
         return self
 

@@ -12,15 +12,20 @@ from typing import Iterable, Optional, TypeVar
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _accuracy_compute,
     _accuracy_param_check,
-    _binary_accuracy_update,
-    _multiclass_accuracy_update,
+    _binary_accuracy_update_input_check,
+    _binary_accuracy_update_kernel,
+    _multiclass_accuracy_update_kernel,
+    _multiclass_accuracy_validate,
     _multilabel_accuracy_param_check,
-    _multilabel_accuracy_update,
+    _multilabel_accuracy_update_input_check,
+    _multilabel_accuracy_update_kernel,
     _topk_multilabel_accuracy_param_check,
-    _topk_multilabel_accuracy_update,
+    _topk_multilabel_accuracy_update_input_check,
+    _topk_multilabel_accuracy_update_kernel,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -57,11 +62,17 @@ class MulticlassAccuracy(Metric[jax.Array]):
 
     def update(self, input, target):
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_correct, num_total = _multiclass_accuracy_update(
+        _multiclass_accuracy_validate(
             input, target, self.average, self.num_classes, self.k
         )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
+        # Kernel + both state adds fused into one dispatch (_fuse.py).
+        self.num_correct, self.num_total = accumulate(
+            _multiclass_accuracy_update_kernel,
+            (self.num_correct, self.num_total),
+            input,
+            target,
+            statics=(self.average, self.num_classes, self.k),
+        )
         return self
 
     def compute(self) -> jax.Array:
@@ -95,9 +106,14 @@ class BinaryAccuracy(MulticlassAccuracy):
 
     def update(self, input, target):
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_correct, num_total = _binary_accuracy_update(input, target, self.threshold)
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
+        _binary_accuracy_update_input_check(input, target)
+        self.num_correct, self.num_total = accumulate(
+            _binary_accuracy_update_kernel,
+            (self.num_correct, self.num_total),
+            input,
+            target,
+            statics=(self.threshold,),
+        )
         return self
 
 
@@ -119,11 +135,14 @@ class MultilabelAccuracy(MulticlassAccuracy):
 
     def update(self, input, target):
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_correct, num_total = _multilabel_accuracy_update(
-            input, target, self.threshold, self.criteria
+        _multilabel_accuracy_update_input_check(input, target)
+        self.num_correct, self.num_total = accumulate(
+            _multilabel_accuracy_update_kernel,
+            (self.num_correct, self.num_total),
+            input,
+            target,
+            statics=(self.threshold, self.criteria),
         )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
         return self
 
 
@@ -149,9 +168,12 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
 
     def update(self, input, target):
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_correct, num_total = _topk_multilabel_accuracy_update(
-            input, target, self.criteria, self.k
+        _topk_multilabel_accuracy_update_input_check(input, target, self.k)
+        self.num_correct, self.num_total = accumulate(
+            _topk_multilabel_accuracy_update_kernel,
+            (self.num_correct, self.num_total),
+            input,
+            target,
+            statics=(self.criteria, self.k),
         )
-        self.num_correct = self.num_correct + num_correct
-        self.num_total = self.num_total + num_total
         return self

@@ -10,11 +10,14 @@ from typing import Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _accum_dtype,
     _baseline_update,
-    _binary_normalized_entropy_update,
+    _ne_input_check,
+    _ne_update_kernel,
+    _ne_update_kernel_unweighted,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -44,12 +47,19 @@ class BinaryNormalizedEntropy(Metric[jax.Array]):
         input, target = jnp.asarray(input), jnp.asarray(target)
         if weight is not None:
             weight = jnp.asarray(weight)
-        cross_entropy, num_positive, num_examples = _binary_normalized_entropy_update(
-            input, target, self.from_logits, self.num_tasks, weight
+        _ne_input_check(input, target, self.from_logits, self.num_tasks, weight)
+        # Kernel + all three state adds fused into one dispatch (_fuse.py);
+        # state order follows the kernel's (entropy, positive, examples).
+        if weight is None:
+            kernel, args = _ne_update_kernel_unweighted, (input, target)
+        else:
+            kernel, args = _ne_update_kernel, (input, target, weight)
+        self.total_entropy, self.num_positive, self.num_examples = accumulate(
+            kernel,
+            (self.total_entropy, self.num_positive, self.num_examples),
+            *args,
+            statics=(self.from_logits,),
         )
-        self.total_entropy = self.total_entropy + cross_entropy
-        self.num_examples = self.num_examples + num_examples
-        self.num_positive = self.num_positive + num_positive
         return self
 
     def compute(self) -> jax.Array:

@@ -9,14 +9,17 @@ from typing import Iterable, List, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     _binary_binned_precision_recall_curve_compute,
-    _binary_binned_precision_recall_curve_update,
+    _binary_binned_update_input_check,
+    _binary_binned_update_kernel,
     _binned_precision_recall_curve_param_check,
     _create_threshold_tensor,
     _multiclass_binned_precision_recall_curve_compute,
-    _multiclass_binned_precision_recall_curve_update,
+    _multiclass_binned_update_kernel,
+    _multiclass_binned_validate,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -46,12 +49,15 @@ class BinaryBinnedPrecisionRecallCurve(
 
     def update(self, input, target) -> "BinaryBinnedPrecisionRecallCurve":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_tp, num_fp, num_fn = _binary_binned_precision_recall_curve_update(
-            input, target, self.threshold
+        _binary_binned_update_input_check(input, target)
+        # Kernel + all three state adds fused into one dispatch (_fuse.py).
+        self.num_tp, self.num_fp, self.num_fn = accumulate(
+            _binary_binned_update_kernel,
+            (self.num_tp, self.num_fp, self.num_fn),
+            input,
+            target,
+            self.threshold,
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_fp = self.num_fp + num_fp
-        self.num_fn = self.num_fn + num_fn
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -94,12 +100,15 @@ class MulticlassBinnedPrecisionRecallCurve(
 
     def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_tp, num_fp, num_fn = _multiclass_binned_precision_recall_curve_update(
-            input, target, self.num_classes, self.threshold
+        _multiclass_binned_validate(input, target, self.num_classes)
+        self.num_tp, self.num_fp, self.num_fn = accumulate(
+            _multiclass_binned_update_kernel,
+            (self.num_tp, self.num_fp, self.num_fn),
+            input,
+            target,
+            self.threshold,
+            statics=(self.num_classes,),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_fp = self.num_fp + num_fp
-        self.num_fn = self.num_fn + num_fn
         return self
 
     def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:

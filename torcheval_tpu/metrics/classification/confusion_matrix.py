@@ -6,12 +6,15 @@ from typing import Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
-    _binary_confusion_matrix_update,
+    _binary_confusion_matrix_update_kernel,
+    _binary_confusion_matrix_validate,
     _confusion_matrix_compute,
     _confusion_matrix_param_check,
-    _confusion_matrix_update,
+    _confusion_matrix_update_input_check,
+    _confusion_matrix_update_kernel,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -38,8 +41,14 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
 
     def update(self, input, target) -> "MulticlassConfusionMatrix":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        self.confusion_matrix = self.confusion_matrix + _confusion_matrix_update(
-            input, target, self.num_classes
+        _confusion_matrix_update_input_check(input, target, self.num_classes)
+        # Scatter kernel + state add fused into one dispatch (_fuse.py).
+        (self.confusion_matrix,) = accumulate(
+            _confusion_matrix_update_kernel,
+            (self.confusion_matrix,),
+            input,
+            target,
+            statics=(self.num_classes,),
         )
         return self
 
@@ -73,7 +82,12 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
 
     def update(self, input, target) -> "BinaryConfusionMatrix":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        self.confusion_matrix = self.confusion_matrix + _binary_confusion_matrix_update(
-            input, target, self.threshold
+        _binary_confusion_matrix_validate(input, target)
+        (self.confusion_matrix,) = accumulate(
+            _binary_confusion_matrix_update_kernel,
+            (self.confusion_matrix,),
+            input,
+            target,
+            statics=(self.threshold,),
         )
         return self

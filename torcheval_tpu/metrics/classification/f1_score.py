@@ -6,12 +6,15 @@ from typing import Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.f1_score import (
-    _binary_f1_score_update,
+    _binary_f1_score_update_input_check,
+    _binary_f1_score_update_kernel,
     _f1_score_compute,
     _f1_score_param_check,
-    _f1_score_update,
+    _f1_score_update_kernel,
+    _f1_score_validate,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -43,12 +46,15 @@ class MulticlassF1Score(Metric[jax.Array]):
 
     def update(self, input, target) -> "MulticlassF1Score":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_tp, num_label, num_prediction = _f1_score_update(
-            input, target, self.num_classes, self.average
+        _f1_score_validate(input, target, self.num_classes, self.average)
+        # Kernel + all three state adds fused into one dispatch (_fuse.py).
+        self.num_tp, self.num_label, self.num_prediction = accumulate(
+            _f1_score_update_kernel,
+            (self.num_tp, self.num_label, self.num_prediction),
+            input,
+            target,
+            statics=(self.num_classes, self.average),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_label = self.num_label + num_label
-        self.num_prediction = self.num_prediction + num_prediction
         return self
 
     def compute(self) -> jax.Array:
@@ -71,10 +77,12 @@ class BinaryF1Score(MulticlassF1Score):
 
     def update(self, input, target) -> "BinaryF1Score":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_tp, num_label, num_prediction = _binary_f1_score_update(
-            input, target, self.threshold
+        _binary_f1_score_update_input_check(input, target)
+        self.num_tp, self.num_label, self.num_prediction = accumulate(
+            _binary_f1_score_update_kernel,
+            (self.num_tp, self.num_label, self.num_prediction),
+            input,
+            target,
+            statics=(self.threshold,),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_label = self.num_label + num_label
-        self.num_prediction = self.num_prediction + num_prediction
         return self

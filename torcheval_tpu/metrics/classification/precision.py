@@ -6,12 +6,15 @@ from typing import Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.precision import (
-    _binary_precision_update,
+    _binary_precision_update_input_check,
+    _binary_precision_update_kernel,
     _precision_compute,
     _precision_param_check,
-    _precision_update,
+    _precision_update_kernel,
+    _precision_validate,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -43,12 +46,15 @@ class MulticlassPrecision(Metric[jax.Array]):
 
     def update(self, input, target) -> "MulticlassPrecision":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_tp, num_fp, num_label = _precision_update(
-            input, target, self.num_classes, self.average
+        _precision_validate(input, target, self.num_classes, self.average)
+        # Kernel + all three state adds fused into one dispatch (_fuse.py).
+        self.num_tp, self.num_fp, self.num_label = accumulate(
+            _precision_update_kernel,
+            (self.num_tp, self.num_fp, self.num_label),
+            input,
+            target,
+            statics=(self.num_classes, self.average),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_fp = self.num_fp + num_fp
-        self.num_label = self.num_label + num_label
         return self
 
     def compute(self) -> jax.Array:
@@ -71,10 +77,12 @@ class BinaryPrecision(MulticlassPrecision):
 
     def update(self, input, target) -> "BinaryPrecision":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_tp, num_fp, num_label = _binary_precision_update(
-            input, target, self.threshold
+        _binary_precision_update_input_check(input, target)
+        self.num_tp, self.num_fp, self.num_label = accumulate(
+            _binary_precision_update_kernel,
+            (self.num_tp, self.num_fp, self.num_label),
+            input,
+            target,
+            statics=(self.threshold,),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_fp = self.num_fp + num_fp
-        self.num_label = self.num_label + num_label
         return self

@@ -6,13 +6,16 @@ from typing import Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.recall import (
     _binary_recall_compute,
-    _binary_recall_update,
+    _binary_recall_update_input_check,
+    _binary_recall_update_kernel,
     _recall_compute,
     _recall_param_check,
-    _recall_update,
+    _recall_update_kernel,
+    _recall_validate,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -29,9 +32,15 @@ class BinaryRecall(Metric[jax.Array]):
 
     def update(self, input, target) -> "BinaryRecall":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_tp, num_true_labels = _binary_recall_update(input, target, self.threshold)
-        self.num_tp = self.num_tp + num_tp
-        self.num_true_labels = self.num_true_labels + num_true_labels
+        _binary_recall_update_input_check(input, target)
+        # Kernel + state adds fused into one dispatch (_fuse.py).
+        self.num_tp, self.num_true_labels = accumulate(
+            _binary_recall_update_kernel,
+            (self.num_tp, self.num_true_labels),
+            input,
+            target,
+            statics=(self.threshold,),
+        )
         return self
 
     def compute(self) -> jax.Array:
@@ -68,12 +77,14 @@ class MulticlassRecall(Metric[jax.Array]):
 
     def update(self, input, target) -> "MulticlassRecall":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        num_tp, num_labels, num_predictions = _recall_update(
-            input, target, self.num_classes, self.average
+        _recall_validate(input, target, self.num_classes, self.average)
+        self.num_tp, self.num_labels, self.num_predictions = accumulate(
+            _recall_update_kernel,
+            (self.num_tp, self.num_labels, self.num_predictions),
+            input,
+            target,
+            statics=(self.num_classes, self.average),
         )
-        self.num_tp = self.num_tp + num_tp
-        self.num_labels = self.num_labels + num_labels
-        self.num_predictions = self.num_predictions + num_predictions
         return self
 
     def compute(self) -> jax.Array:

@@ -14,17 +14,24 @@ def mean(input, weight: Union[float, int, "jax.Array"] = 1.0) -> jax.Array:
     return weighted_sum / weights
 
 
-def _mean_update(input: jax.Array, weight) -> Tuple[jax.Array, jax.Array]:
+def _mean_select_kernel(input: jax.Array, weight):
+    """Validate ``weight`` and pick the matching jitted kernel; returns
+    ``(kernel, args)`` so callers can dispatch it directly or fused."""
     if isinstance(weight, (float, int)):
-        return _scalar_weighted(input, float(weight))
+        return _scalar_weighted, (input, float(weight))
     if isinstance(weight, (jax.Array, jnp.ndarray, np.ndarray)) and input.shape == jnp.shape(
         weight
     ):
-        return _array_weighted(input, weight)
+        return _array_weighted, (input, weight)
     raise ValueError(
         "Weight must be either a float value or a tensor that matches the "
         f"input tensor size. Got {weight} instead."
     )
+
+
+def _mean_update(input: jax.Array, weight) -> Tuple[jax.Array, jax.Array]:
+    kernel, args = _mean_select_kernel(input, weight)
+    return kernel(*args)
 
 
 @jax.jit

@@ -14,16 +14,21 @@ def sum(input, weight: Union[float, int, "jax.Array"] = 1.0) -> jax.Array:  # no
     return _sum_update(jnp.asarray(input), weight)
 
 
-def _sum_update(input: jax.Array, weight) -> jax.Array:
+def _sum_validate(input: jax.Array, weight) -> None:
     if isinstance(weight, (float, int)) or (
         isinstance(weight, (jax.Array, jnp.ndarray, np.ndarray))
         and input.shape == jnp.shape(weight)
     ):
-        return _weighted_sum(input, weight)
+        return
     raise ValueError(
         "Weight must be either a float value or an int value or a tensor "
         f"that matches the input tensor size. Got {weight} instead."
     )
+
+
+def _sum_update(input: jax.Array, weight) -> jax.Array:
+    _sum_validate(input, weight)
+    return _weighted_sum(input, weight)
 
 
 @jax.jit

@@ -142,13 +142,14 @@ def _multiclass_accuracy_update_kernel(
     return num_correct, num_total
 
 
-def _multiclass_accuracy_update(
+def _multiclass_accuracy_validate(
     input: jax.Array,
     target: jax.Array,
     average: Optional[str],
     num_classes: Optional[int],
     k: int,
-) -> Tuple[jax.Array, jax.Array]:
+) -> None:
+    """Host-side update validation shared by the functional and class paths."""
     _accuracy_update_input_check(input, target, num_classes, k)
     # Whenever target is used as an index (per-class scatter for
     # average!="micro", gather for k>1) an out-of-range value must raise:
@@ -156,6 +157,16 @@ def _multiclass_accuracy_update(
     if average != "micro" or k > 1:
         upper = num_classes if num_classes is not None else input.shape[-1]
         check_index_ranges([(target, "target")], upper)
+
+
+def _multiclass_accuracy_update(
+    input: jax.Array,
+    target: jax.Array,
+    average: Optional[str],
+    num_classes: Optional[int],
+    k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    _multiclass_accuracy_validate(input, target, average, num_classes, k)
     return _multiclass_accuracy_update_kernel(input, target, average, num_classes, k)
 
 
@@ -220,6 +231,17 @@ def _multilabel_update(
     return jnp.all((input - target) <= 0, axis=1).sum(), n
 
 
+@partial(jax.jit, static_argnames=("threshold", "criteria"))
+def _multilabel_accuracy_update_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    criteria: str,
+) -> Tuple[jax.Array, jax.Array]:
+    input_label = jnp.where(input < threshold, 0, 1)
+    return _multilabel_update(input_label, target, criteria)
+
+
 def _multilabel_accuracy_update(
     input: jax.Array,
     target: jax.Array,
@@ -227,8 +249,7 @@ def _multilabel_accuracy_update(
     criteria: str = "exact_match",
 ) -> Tuple[jax.Array, jax.Array]:
     _multilabel_accuracy_update_input_check(input, target)
-    input_label = jnp.where(input < threshold, 0, 1)
-    return _multilabel_update(input_label, target, criteria)
+    return _multilabel_accuracy_update_kernel(input, target, threshold, criteria)
 
 
 @partial(jax.jit, static_argnames=("criteria", "k"))

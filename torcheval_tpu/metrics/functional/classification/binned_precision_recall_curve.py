@@ -97,25 +97,32 @@ def _binary_binned_precision_recall_curve_compute(
     return precision, recall, threshold
 
 
+def _multiclass_binned_validate(
+    input: jax.Array, target: jax.Array, num_classes: Optional[int]
+) -> None:
+    """Host-side update validation shared by the functional and class paths."""
+    _multiclass_binned_update_input_check(input, target, num_classes)
+    # OOB targets must raise — jax.nn.one_hot silently yields an all-zero
+    # row where torch F.one_hot errors.
+    _check_index_range(target, num_classes, "target")
+
+
 def _multiclass_binned_precision_recall_curve_update(
     input: jax.Array,
     target: jax.Array,
     num_classes: Optional[int],
     threshold: jax.Array,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    _multiclass_binned_update_input_check(input, target, num_classes)
-    # OOB targets must raise — jax.nn.one_hot silently yields an all-zero
-    # row where torch F.one_hot errors.
-    _check_index_range(target, num_classes, "target")
-    return _multiclass_binned_update_kernel(input, target, num_classes, threshold)
+    _multiclass_binned_validate(input, target, num_classes)
+    return _multiclass_binned_update_kernel(input, target, threshold, num_classes)
 
 
 @partial(jax.jit, static_argnames=("num_classes",))
 def _multiclass_binned_update_kernel(
     input: jax.Array,
     target: jax.Array,
-    num_classes: int,
     threshold: jax.Array,
+    num_classes: int,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     labels = input >= threshold[:, None, None]
     target_onehot = jax.nn.one_hot(target, num_classes, dtype=jnp.bool_)

@@ -67,9 +67,7 @@ def _confusion_matrix_update_kernel(
     )
 
 
-def _binary_confusion_matrix_update(
-    input: jax.Array, target: jax.Array, threshold: float
-) -> jax.Array:
+def _binary_confusion_matrix_validate(input: jax.Array, target: jax.Array) -> None:
     _binary_confusion_matrix_input_check(input, target)
     # OOB targets must raise — the XLA scatter would silently drop them
     # where torch ``scatter_`` errors.
@@ -81,8 +79,21 @@ def _binary_confusion_matrix_update(
                 "num_classes: 2 must be strictly greater than max target: "
                 f"{int(t_max)}."
             )
+
+
+@partial(jax.jit, static_argnames=("threshold",))
+def _binary_confusion_matrix_update_kernel(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> jax.Array:
     pred = jnp.where(input < threshold, 0, 1)
     return _confusion_matrix_update_kernel(pred, target.astype(jnp.int32), 2)
+
+
+def _binary_confusion_matrix_update(
+    input: jax.Array, target: jax.Array, threshold: float
+) -> jax.Array:
+    _binary_confusion_matrix_validate(input, target)
+    return _binary_confusion_matrix_update_kernel(input, target, threshold)
 
 
 def _confusion_matrix_compute(

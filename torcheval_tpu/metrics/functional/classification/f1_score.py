@@ -53,13 +53,23 @@ def _f1_score_update(
     num_classes: Optional[int],
     average: Optional[str],
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    _f1_score_validate(input, target, num_classes, average)
+    return _f1_score_update_kernel(input, target, num_classes, average)
+
+
+def _f1_score_validate(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int],
+    average: Optional[str],
+) -> None:
+    """Host-side update validation shared by the functional and class paths."""
     _f1_score_update_input_check(input, target, num_classes)
     if average != "micro":
         pairs = [(target, "target")]
         if input.ndim == 1:
             pairs.append((input, "input"))
         _check_index_ranges(pairs, num_classes)
-    return _f1_score_update_kernel(input, target, num_classes, average)
 
 
 @partial(jax.jit, static_argnames=("num_classes", "average"))

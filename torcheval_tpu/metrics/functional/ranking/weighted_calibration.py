@@ -28,6 +28,42 @@ def weighted_calibration(
     return weighted_input_sum / weighted_target_sum
 
 
+@jax.jit
+def _wc_scalar_kernel(
+    input: jax.Array, target: jax.Array, weight
+) -> Tuple[jax.Array, jax.Array]:
+    return weight * jnp.sum(input, axis=-1), weight * jnp.sum(target, axis=-1)
+
+
+@jax.jit
+def _wc_array_kernel(
+    input: jax.Array, target: jax.Array, weight: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    return jnp.sum(weight * input, axis=-1), jnp.sum(weight * target, axis=-1)
+
+
+def _weighted_calibration_select_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    weight: Union[float, int, "jax.Array"],
+    *,
+    num_tasks: int,
+):
+    """Validate and pick the matching jitted kernel; returns
+    ``(kernel, args)`` so callers can dispatch it directly or fused."""
+    _weighted_calibration_input_check(input, target, weight, num_tasks=num_tasks)
+    if isinstance(weight, (float, int)):
+        return _wc_scalar_kernel, (input, target, float(weight))
+    if isinstance(weight, (jax.Array, jnp.ndarray, np.ndarray)) and input.shape == jnp.shape(
+        weight
+    ):
+        return _wc_array_kernel, (input, target, weight)
+    raise ValueError(
+        "Weight must be either a float value or a tensor that matches the "
+        f"input tensor size. Got {weight} instead."
+    )
+
+
 def _weighted_calibration_update(
     input: jax.Array,
     target: jax.Array,
@@ -35,17 +71,10 @@ def _weighted_calibration_update(
     *,
     num_tasks: int,
 ) -> Tuple[jax.Array, jax.Array]:
-    _weighted_calibration_input_check(input, target, weight, num_tasks=num_tasks)
-    if isinstance(weight, (float, int)):
-        return weight * jnp.sum(input, axis=-1), weight * jnp.sum(target, axis=-1)
-    if isinstance(weight, (jax.Array, jnp.ndarray, np.ndarray)) and input.shape == jnp.shape(
-        weight
-    ):
-        return jnp.sum(weight * input, axis=-1), jnp.sum(weight * target, axis=-1)
-    raise ValueError(
-        "Weight must be either a float value or a tensor that matches the "
-        f"input tensor size. Got {weight} instead."
+    kernel, args = _weighted_calibration_select_kernel(
+        input, target, weight, num_tasks=num_tasks
     )
+    return kernel(*args)
 
 
 def _weighted_calibration_input_check(

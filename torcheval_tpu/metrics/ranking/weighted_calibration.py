@@ -9,12 +9,13 @@ from typing import Iterable, Union
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _accum_dtype,
 )
 from torcheval_tpu.metrics.functional.ranking.weighted_calibration import (
-    _weighted_calibration_update,
+    _weighted_calibration_select_kernel,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -37,11 +38,13 @@ class WeightedCalibration(Metric[jax.Array]):
         self, input, target, weight: Union[float, int, "jax.Array"] = 1.0
     ) -> "WeightedCalibration":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        weighted_input_sum, weighted_target_sum = _weighted_calibration_update(
+        kernel, args = _weighted_calibration_select_kernel(
             input, target, weight, num_tasks=self.num_tasks
         )
-        self.weighted_input_sum = self.weighted_input_sum + weighted_input_sum
-        self.weighted_target_sum = self.weighted_target_sum + weighted_target_sum
+        # Kernel + both state adds fused into one dispatch (_fuse.py).
+        self.weighted_input_sum, self.weighted_target_sum = accumulate(
+            kernel, (self.weighted_input_sum, self.weighted_target_sum), *args
+        )
         return self
 
     def compute(self) -> jax.Array:

@@ -9,10 +9,13 @@ from typing import Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics.functional.regression.mean_squared_error import (
     _mean_squared_error_compute,
     _mean_squared_error_param_check,
-    _mean_squared_error_update,
+    _mean_squared_error_update_input_check,
+    _update_unweighted,
+    _update_weighted,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -40,14 +43,19 @@ class MeanSquaredError(Metric[jax.Array]):
         input, target = jnp.asarray(input), jnp.asarray(target)
         if sample_weight is not None:
             sample_weight = jnp.asarray(sample_weight)
-        sum_squared_error, sum_weight = _mean_squared_error_update(
-            input, target, sample_weight
-        )
-        if self.sum_squared_error.ndim == 0 and sum_squared_error.ndim == 1:
-            self.sum_squared_error = sum_squared_error
+        _mean_squared_error_update_input_check(input, target, sample_weight)
+        # Kernel + state adds fused into one dispatch; ``grow`` replicates
+        # the scalar→vector replace-on-first-2-D-update state semantics.
+        if sample_weight is None:
+            kernel, args = _update_unweighted, (input, target)
         else:
-            self.sum_squared_error = self.sum_squared_error + sum_squared_error
-        self.sum_weight = self.sum_weight + sum_weight
+            kernel, args = _update_weighted, (input, target, sample_weight)
+        self.sum_squared_error, self.sum_weight = accumulate(
+            kernel,
+            (self.sum_squared_error, self.sum_weight),
+            *args,
+            grow=True,
+        )
         return self
 
     def compute(self) -> jax.Array:

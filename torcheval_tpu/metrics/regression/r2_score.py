@@ -10,10 +10,12 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics.functional.regression.r2_score import (
     _r2_score_compute,
     _r2_score_param_check,
-    _r2_score_update,
+    _r2_score_update_input_check,
+    _update as _r2_update_kernel,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -39,20 +41,27 @@ class R2Score(Metric[jax.Array]):
 
     def update(self, input, target) -> "R2Score":
         input, target = jnp.asarray(input), jnp.asarray(target)
-        sum_squared_obs, sum_obs, sum_squared_residual, num_obs = _r2_score_update(
-            input, target
+        _r2_score_update_input_check(input, target)
+        # Kernel + all four state adds fused into one dispatch; ``grow``
+        # replicates the scalar→vector replace-on-first-2-D-update state
+        # semantics (``num_obs`` stays scalar, so it always adds).
+        (
+            self.sum_squared_obs,
+            self.sum_obs,
+            self.sum_squared_residual,
+            self.num_obs,
+        ) = accumulate(
+            _r2_update_kernel,
+            (
+                self.sum_squared_obs,
+                self.sum_obs,
+                self.sum_squared_residual,
+                self.num_obs,
+            ),
+            input,
+            target,
+            grow=True,
         )
-        if self.sum_squared_obs.ndim == 0 and sum_squared_obs.ndim == 1:
-            self.sum_squared_obs = sum_squared_obs
-            self.sum_obs = sum_obs
-            self.sum_squared_residual = sum_squared_residual
-        else:
-            self.sum_squared_obs = self.sum_squared_obs + sum_squared_obs
-            self.sum_obs = self.sum_obs + sum_obs
-            self.sum_squared_residual = (
-                self.sum_squared_residual + sum_squared_residual
-            )
-        self.num_obs = self.num_obs + num_obs
         return self
 
     def compute(self) -> jax.Array:
