@@ -69,6 +69,88 @@ class TestFuzzCounterMetrics(unittest.TestCase):
                     err_msg=f"{ours.__name__} trial={trial} n={n} c={c} avg={average}",
                 )
 
+    def test_multiclass_average_none_and_topk(self):
+        """Per-class (average=None) outputs and k>1 accuracy vs the
+        reference on random shapes (the configs the main sweep skips)."""
+        rng = np.random.default_rng(456)
+        pairs = [
+            (our_f.multiclass_accuracy, ref_f.multiclass_accuracy),
+            (our_f.multiclass_f1_score, ref_f.multiclass_f1_score),
+            (our_f.multiclass_precision, ref_f.multiclass_precision),
+            (our_f.multiclass_recall, ref_f.multiclass_recall),
+        ]
+        for trial in range(8):
+            n = int(rng.integers(2, 65))
+            c = int(rng.integers(2, 9))
+            scores = rng.random((n, c)).astype(np.float32)
+            target = rng.integers(0, c, n).astype(np.int64)
+            for ours, ref in pairs:
+                # average=None with num_classes is valid for every reference
+                # metric here — no skip path, a raise is a real divergence.
+                kwargs = {"average": None, "num_classes": c}
+                want = ref(_t(scores), _t(target), **kwargs)
+                got = ours(
+                    jnp.asarray(scores),
+                    jnp.asarray(target.astype(np.int32)),
+                    **kwargs,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got),
+                    np.asarray(want),
+                    rtol=1e-4,
+                    atol=1e-6,
+                    equal_nan=True,
+                    err_msg=f"{ours.__name__} avg=None trial={trial} n={n} c={c}",
+                )
+            # k>1 accuracy (rank-based hit: reference accuracy.py:256-263).
+            k = int(rng.integers(2, c + 1))
+            want = ref_f.multiclass_accuracy(
+                _t(scores), _t(target), num_classes=c, k=k
+            )
+            got = our_f.multiclass_accuracy(
+                jnp.asarray(scores),
+                jnp.asarray(target.astype(np.int32)),
+                num_classes=c,
+                k=k,
+            )
+            np.testing.assert_allclose(
+                float(got), float(want), rtol=1e-5,
+                err_msg=f"topk accuracy trial={trial} n={n} c={c} k={k}",
+            )
+
+    def test_multilabel_criteria_sweep(self):
+        """All five multilabel-accuracy criteria vs the reference."""
+        rng = np.random.default_rng(654)
+        for trial in range(6):
+            n = int(rng.integers(1, 33))
+            c = int(rng.integers(2, 7))
+            scores = rng.random((n, c)).astype(np.float32)
+            target = (rng.random((n, c)) > 0.5).astype(np.int64)
+            threshold = float(rng.random())
+            for criteria in (
+                "exact_match",
+                "hamming",
+                "overlap",
+                "contain",
+                "belong",
+            ):
+                want = ref_f.multilabel_accuracy(
+                    _t(scores), _t(target), threshold=threshold, criteria=criteria
+                )
+                got = our_f.multilabel_accuracy(
+                    jnp.asarray(scores),
+                    jnp.asarray(target.astype(np.float32)),
+                    threshold=threshold,
+                    criteria=criteria,
+                )
+                np.testing.assert_allclose(
+                    float(got),
+                    float(want),
+                    rtol=1e-5,
+                    equal_nan=True,
+                    err_msg=f"criteria={criteria} trial={trial} n={n} c={c}",
+                )
+
     def test_binary_family_random_configs(self):
         rng = np.random.default_rng(321)
         for trial in range(10):

@@ -19,8 +19,8 @@ from typing import Tuple
 import jax
 
 
-@partial(jax.jit, static_argnames=("kernel", "statics", "grow"))
-def _accumulate_jit(states, args, kernel, statics, grow):
+@partial(jax.jit, static_argnames=("kernel", "statics", "grow", "fold"))
+def _accumulate_jit(states, args, kernel, statics, grow, fold):
     deltas = kernel(*args, *statics)
     if not isinstance(deltas, tuple):
         deltas = (deltas,)
@@ -32,7 +32,7 @@ def _accumulate_jit(states, args, kernel, statics, grow):
             # ``regression/mean_squared_error.py`` state-growth behavior).
             out.append(d)
         else:
-            out.append(s + d)
+            out.append(s + d if fold is None else fold(s, d))
     return tuple(out)
 
 
@@ -42,14 +42,19 @@ def accumulate(
     *args,
     statics: tuple = (),
     grow: bool = False,
+    fold=None,
 ) -> Tuple[jax.Array, ...]:
-    """Run ``kernel(*args, *statics)`` and add its delta(s) onto ``states``
+    """Run ``kernel(*args, *statics)`` and fold its delta(s) onto ``states``
     in one fused dispatch.
 
     ``kernel`` must be a module-level (jitted or plain) pure function — its
     identity is part of the jit cache key.  ``statics`` are hashable
-    trace-time constants appended positionally after ``args``.  ``grow=True``
+    trace-time constants appended positionally after ``args``.  ``fold``
+    combines ``(state, delta)`` and defaults to addition; pass e.g.
+    ``jnp.minimum`` for extremum states (Min/Max).  ``grow=True``
     replicates the scalar→vector replace-on-first-2-D-update semantics of
     per-output regression states.  Returns the new state tuple.
     """
-    return _accumulate_jit(tuple(states), tuple(args), kernel, tuple(statics), grow)
+    return _accumulate_jit(
+        tuple(states), tuple(args), kernel, tuple(statics), grow, fold
+    )

@@ -6,6 +6,7 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics.metric import Metric
 
 
@@ -15,7 +16,10 @@ class Max(Metric[jax.Array]):
         self._add_state("max", jnp.asarray(float("-inf")))
 
     def update(self, input) -> "Max":
-        self.max = jnp.maximum(self.max, jnp.max(jnp.asarray(input)))
+        # Reduction + state fold in one dispatch (_fuse.py).
+        (self.max,) = accumulate(
+            jnp.max, (self.max,), jnp.asarray(input), fold=jnp.maximum
+        )
         return self
 
     def compute(self) -> jax.Array:

@@ -6,6 +6,7 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics.metric import Metric
 
 
@@ -15,7 +16,10 @@ class Min(Metric[jax.Array]):
         self._add_state("min", jnp.asarray(float("inf")))
 
     def update(self, input) -> "Min":
-        self.min = jnp.minimum(self.min, jnp.min(jnp.asarray(input)))
+        # Reduction + state fold in one dispatch (_fuse.py).
+        (self.min,) = accumulate(
+            jnp.min, (self.min,), jnp.asarray(input), fold=jnp.minimum
+        )
         return self
 
     def compute(self) -> jax.Array:
