@@ -27,6 +27,11 @@ def _t(a):
     return torch.from_numpy(np.asarray(a).copy())
 
 
+def _quantize(scores: np.ndarray, levels: int = 5) -> np.ndarray:
+    """Snap scores onto a few distinct values so tie groups are dense."""
+    return (np.round(scores * (levels - 1)) / (levels - 1)).astype(np.float32)
+
+
 @unittest.skipUnless(HAVE_REF, "reference torcheval not available")
 class TestFuzzCounterMetrics(unittest.TestCase):
     def test_multiclass_family_random_configs(self):
@@ -183,6 +188,99 @@ class TestFuzzCounterMetrics(unittest.TestCase):
                     equal_nan=True,
                     err_msg=f"{ours.__name__} trial={trial} n={n}",
                 )
+
+    def test_auroc_random_configs(self):
+        """AUROC sweeps the parity matrix fixes at single shapes: multi-task
+        binary, heavy score ties (quantized scores), and multiclass
+        macro/None averages, all vs the reference."""
+        rng = np.random.default_rng(135)
+        for trial in range(8):
+            n = int(rng.integers(2, 129))
+            num_tasks = int(rng.integers(1, 4))
+            shape = (n,) if num_tasks == 1 else (num_tasks, n)
+            # Every other trial quantizes scores into few distinct values to
+            # exercise the tie-group scan (reference dedup masking,
+            # auroc.py:111-142).
+            scores = rng.random(shape).astype(np.float32)
+            if trial % 2:
+                scores = _quantize(scores)
+            target = (rng.random(shape) > 0.5).astype(np.float32)
+            want = ref_f.binary_auroc(
+                _t(scores), _t(target.astype(np.int64)), num_tasks=num_tasks
+            )
+            got = our_f.binary_auroc(
+                jnp.asarray(scores), jnp.asarray(target), num_tasks=num_tasks
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6,
+                err_msg=f"binary_auroc trial={trial} n={n} tasks={num_tasks}",
+            )
+            c = int(rng.integers(2, 7))
+            mc_scores = rng.random((n, c)).astype(np.float32)
+            if trial % 2:
+                mc_scores = _quantize(mc_scores)
+            mc_target = rng.integers(0, c, n).astype(np.int64)
+            for average in ("macro", None):
+                want = ref_f.multiclass_auroc(
+                    _t(mc_scores), _t(mc_target), num_classes=c, average=average
+                )
+                got = our_f.multiclass_auroc(
+                    jnp.asarray(mc_scores),
+                    jnp.asarray(mc_target.astype(np.int32)),
+                    num_classes=c,
+                    average=average,
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6,
+                    err_msg=f"multiclass_auroc trial={trial} c={c} avg={average}",
+                )
+
+    def test_ranking_random_configs(self):
+        """hit_rate / reciprocal_rank (random k incl. None) and multi-task
+        weighted_calibration vs the reference."""
+        rng = np.random.default_rng(987)
+        for trial in range(8):
+            n = int(rng.integers(1, 33))
+            c = int(rng.integers(2, 9))
+            scores = rng.random((n, c)).astype(np.float32)
+            target = rng.integers(0, c, n).astype(np.int64)
+            k = None if trial % 3 == 0 else int(rng.integers(1, c + 1))
+            for ours, ref in (
+                (our_f.hit_rate, ref_f.hit_rate),
+                (our_f.reciprocal_rank, ref_f.reciprocal_rank),
+            ):
+                want = ref(_t(scores), _t(target), k=k)
+                got = ours(
+                    jnp.asarray(scores), jnp.asarray(target.astype(np.int32)), k=k
+                )
+                np.testing.assert_allclose(
+                    np.asarray(got),
+                    np.asarray(want),
+                    rtol=1e-5,
+                    err_msg=f"{ours.__name__} trial={trial} n={n} c={c} k={k}",
+                )
+            num_tasks = int(rng.integers(1, 4))
+            shape = (n,) if num_tasks == 1 else (num_tasks, n)
+            wc_in = rng.random(shape).astype(np.float32)
+            wc_tg = (rng.random(shape) > 0.4).astype(np.float32)
+            weight = float(rng.random() + 0.1) if trial % 2 else rng.random(
+                shape
+            ).astype(np.float32)
+            want = ref_f.weighted_calibration(
+                _t(wc_in), _t(wc_tg), _t(weight) if not np.isscalar(weight) else weight,
+                num_tasks=num_tasks,
+            )
+            got = our_f.weighted_calibration(
+                jnp.asarray(wc_in),
+                jnp.asarray(wc_tg),
+                jnp.asarray(weight) if not np.isscalar(weight) else weight,
+                num_tasks=num_tasks,
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6,
+                equal_nan=True,
+                err_msg=f"weighted_calibration trial={trial} tasks={num_tasks}",
+            )
 
     def test_regression_random_configs(self):
         rng = np.random.default_rng(777)
