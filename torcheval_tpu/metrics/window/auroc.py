@@ -36,6 +36,19 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
 from torcheval_tpu.metrics.metric import Metric
 
 
+@jax.jit
+def _ring_insert(inputs_buf, targets_buf, input, target, start):
+    """Insert both batches at ring position ``start`` in ONE dispatch.
+    ``start`` is traced, so successive updates reuse one compiled program
+    per batch shape instead of recompiling per insert position."""
+    w = inputs_buf.shape[1]
+    idx = (start + jnp.arange(input.shape[1])) % w
+    return (
+        inputs_buf.at[:, idx].set(input.astype(inputs_buf.dtype)),
+        targets_buf.at[:, idx].set(target.astype(targets_buf.dtype)),
+    )
+
+
 class WindowedBinaryAUROC(RingWindowMixin, Metric[jax.Array]):
     """The windowed version of BinaryAUROC: computed from the input and
     target of the last ``max_num_samples`` samples
@@ -94,10 +107,8 @@ class WindowedBinaryAUROC(RingWindowMixin, Metric[jax.Array]):
             self.next_inserted = 0
             self._num_valid = w
         else:
-            idx = (self.next_inserted + jnp.arange(n)) % w
-            self.inputs = self.inputs.at[:, idx].set(input.astype(self.inputs.dtype))
-            self.targets = self.targets.at[:, idx].set(
-                target.astype(self.targets.dtype)
+            self.inputs, self.targets = _ring_insert(
+                self.inputs, self.targets, input, target, self.next_inserted
             )
             self._window_advance(n)
         self.total_samples += n

@@ -19,20 +19,60 @@ Divergences (documented, both in favor of correctness):
 * ``reset()`` also restores the capacity and zeroes the host-side counters.
 """
 
+from functools import partial
 from typing import Iterable, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torcheval_tpu.metrics._buffer import RingWindowMixin
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _accum_dtype,
     _baseline_update,
-    _binary_normalized_entropy_update,
+    _ne_input_check,
+    _ne_update_kernel,
+    _ne_update_kernel_unweighted,
 )
 from torcheval_tpu.metrics.metric import Metric
 
 _LIFETIME_STATES = ("total_entropy", "num_examples", "num_positive")
+
+# Inert stand-in for absent weight / disabled-lifetime slots in the fused
+# update: a host numpy constant costs no eager device op per update (its
+# dtype is irrelevant — every use is traced out).
+_EMPTY = np.zeros(0, dtype=np.float32)
+
+
+@partial(jax.jit, static_argnames=("from_logits", "lifetime", "weighted"))
+def _windowed_ne_update_fused(
+    w_ent,
+    w_ex,
+    w_pos,
+    ent,
+    ex,
+    pos,
+    input,
+    target,
+    weight,
+    col,
+    from_logits,
+    lifetime,
+    weighted,
+):
+    """NE sufficient statistics + window-column write (+ lifetime adds) in
+    ONE dispatch.  ``col`` is traced so inserts reuse one compiled program
+    per batch shape."""
+    if weighted:
+        ce, npos, nex = _ne_update_kernel(input, target, weight, from_logits)
+    else:
+        ce, npos, nex = _ne_update_kernel_unweighted(input, target, from_logits)
+    w_ent = w_ent.at[:, col].set(ce)
+    w_ex = w_ex.at[:, col].set(nex)
+    w_pos = w_pos.at[:, col].set(npos)
+    if lifetime:
+        ent, ex, pos = ent + ce, ex + nex, pos + npos
+    return w_ent, w_ex, w_pos, ent, ex, pos
 
 
 class WindowedBinaryNormalizedEntropy(
@@ -93,23 +133,37 @@ class WindowedBinaryNormalizedEntropy(
         input, target = jnp.asarray(input), jnp.asarray(target)
         if weight is not None:
             weight = jnp.asarray(weight)
-        cross_entropy, num_positive, num_examples = _binary_normalized_entropy_update(
-            input, target, self.from_logits, self.num_tasks, weight
+        _ne_input_check(input, target, self.from_logits, self.num_tasks, weight)
+        # Kernel + column write + lifetime adds in one dispatch.  The
+        # lifetime states only exist when enabled; the inert _EMPTY rides
+        # through the fused call otherwise (its adds are traced out).
+        lifetime_in = (
+            (self.total_entropy, self.num_examples, self.num_positive)
+            if self.enable_lifetime
+            else (_EMPTY, _EMPTY, _EMPTY)
+        )
+        (
+            self.windowed_total_entropy,
+            self.windowed_num_examples,
+            self.windowed_num_positive,
+            ent,
+            ex,
+            pos,
+        ) = _windowed_ne_update_fused(
+            self.windowed_total_entropy,
+            self.windowed_num_examples,
+            self.windowed_num_positive,
+            *lifetime_in,
+            input,
+            target,
+            weight if weight is not None else _EMPTY,
+            self.next_inserted,
+            self.from_logits,
+            self.enable_lifetime,
+            weight is not None,
         )
         if self.enable_lifetime:
-            self.total_entropy = self.total_entropy + cross_entropy
-            self.num_examples = self.num_examples + num_examples
-            self.num_positive = self.num_positive + num_positive
-        col = self.next_inserted
-        self.windowed_total_entropy = self.windowed_total_entropy.at[:, col].set(
-            cross_entropy
-        )
-        self.windowed_num_examples = self.windowed_num_examples.at[:, col].set(
-            num_examples
-        )
-        self.windowed_num_positive = self.windowed_num_positive.at[:, col].set(
-            num_positive
-        )
+            self.total_entropy, self.num_examples, self.num_positive = ent, ex, pos
         self._window_advance(1)
         self.total_updates += 1
         return self
