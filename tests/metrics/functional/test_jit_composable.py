@@ -1,0 +1,184 @@
+"""The functional API composes into larger jitted programs.
+
+The reference's functional metrics are eager torch ops; the TPU-native
+promise is stronger: every fixed-shape functional metric can be traced
+into a user's own ``jax.jit``/``vmap`` program (e.g. fused into an eval
+step, as ``__graft_entry__.entry`` does).  Data-dependent host validation
+(out-of-range indices, probability bounds) is skipped under tracing — it
+cannot run at trace time — while shape/static validation still applies.
+
+Ragged-output metrics (the unbinned PR curves, which materialize per-class
+lists on host) are inherently not jit-composable and are excluded.
+"""
+
+import unittest
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import functional as F
+
+
+def _data(seed=0, n=128, c=7):
+    rng = np.random.default_rng(seed)
+    scores = jnp.asarray(rng.random((n, c)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+    return scores, target
+
+
+class TestJitComposable(unittest.TestCase):
+    def assert_jit_matches(self, fn, *args):
+        eager = fn(*args)
+        traced = jax.jit(fn)(*args)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, equal_nan=True
+            ),
+            eager,
+            traced,
+        )
+
+    def test_classification_counters_under_jit(self):
+        scores, target = _data()
+        c = 7
+        self.assert_jit_matches(
+            partial(F.multiclass_accuracy, average="macro", num_classes=c),
+            scores,
+            target,
+        )
+        self.assert_jit_matches(
+            partial(F.multiclass_f1_score, average="macro", num_classes=c),
+            scores,
+            target,
+        )
+        self.assert_jit_matches(
+            partial(F.multiclass_precision, average=None, num_classes=c),
+            scores,
+            target,
+        )
+        self.assert_jit_matches(
+            partial(F.multiclass_recall, average="weighted", num_classes=c),
+            scores,
+            target,
+        )
+        self.assert_jit_matches(
+            partial(F.multiclass_confusion_matrix, num_classes=c),
+            jnp.argmax(scores, 1),
+            target,
+        )
+
+    def test_binary_and_regression_under_jit(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.random(256).astype(np.float32))
+        t = jnp.asarray((rng.random(256) > 0.5).astype(np.float32))
+        self.assert_jit_matches(F.binary_accuracy, x, t)
+        self.assert_jit_matches(F.binary_f1_score, x, t)
+        self.assert_jit_matches(F.binary_auroc, x, t)
+        self.assert_jit_matches(F.binary_normalized_entropy, x, t)
+        y = jnp.asarray(rng.random(256).astype(np.float32))
+        self.assert_jit_matches(F.mean_squared_error, x, y)
+        self.assert_jit_matches(F.r2_score, x, y)
+
+    def test_auroc_and_binned_curves_under_jit(self):
+        scores, target = _data(2)
+        self.assert_jit_matches(
+            partial(F.multiclass_auroc, num_classes=7, average="macro"),
+            scores,
+            target,
+        )
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.random(128).astype(np.float32))
+        t = jnp.asarray((rng.random(128) > 0.5).astype(np.float32))
+        self.assert_jit_matches(
+            partial(F.binary_binned_precision_recall_curve, threshold=10), x, t
+        )
+
+    def test_ranking_under_jit(self):
+        scores, target = _data(4)
+        self.assert_jit_matches(partial(F.hit_rate, k=3), scores, target)
+        self.assert_jit_matches(F.reciprocal_rank, scores, target)
+
+    def test_vmap_over_tasks(self):
+        rng = np.random.default_rng(5)
+        xs = jnp.asarray(rng.random((4, 64)).astype(np.float32))
+        ts = jnp.asarray((rng.random((4, 64)) > 0.5).astype(np.float32))
+        got = jax.vmap(F.binary_accuracy)(xs, ts)
+        want = jnp.stack([F.binary_accuracy(x, t) for x, t in zip(xs, ts)])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+    def test_metrics_inside_fused_eval_step(self):
+        """A user's jitted step computing loss AND metrics in one program."""
+        scores, target = _data(6)
+
+        @jax.jit
+        def eval_step(scores, target):
+            loss = -jnp.mean(
+                jnp.take_along_axis(
+                    jax.nn.log_softmax(scores), target[:, None], axis=1
+                )
+            )
+            acc = F.multiclass_accuracy(scores, target)
+            cm = F.multiclass_confusion_matrix(
+                jnp.argmax(scores, 1), target, num_classes=7
+            )
+            return loss, acc, cm
+
+        loss, acc, cm = eval_step(scores, target)
+        self.assertTrue(np.isfinite(float(loss)))
+        np.testing.assert_allclose(
+            float(acc), float(F.multiclass_accuracy(scores, target)), rtol=1e-6
+        )
+        self.assertEqual(int(np.asarray(cm).sum()), scores.shape[0])
+
+    def test_binary_recall_nan_to_zero_matches_under_jit(self):
+        """No-positives recall is 0 (not NaN) in eager AND traced modes."""
+        x = jnp.asarray([0.9, 0.8, 0.2])
+        t = jnp.zeros(3)
+        self.assertEqual(float(F.binary_recall(x, t)), 0.0)
+        self.assertEqual(float(jax.jit(F.binary_recall)(x, t)), 0.0)
+
+    def test_r2_score_size_guard_raises_even_under_jit(self):
+        """The n>=2 guard is static shape info, so it raises at trace time."""
+        with self.assertRaisesRegex(ValueError, "at least two"):
+            F.r2_score(jnp.asarray([1.0]), jnp.asarray([2.0]))
+        with self.assertRaisesRegex(ValueError, "at least two"):
+            jax.jit(F.r2_score)(jnp.asarray([1.0]), jnp.asarray([2.0]))
+
+    def test_concrete_array_still_validated_beside_tracer(self):
+        """A concrete out-of-range target raises even when the other input
+        is traced — only tracers skip validation."""
+        bad_target = jnp.asarray([0, 9], dtype=jnp.int32)
+
+        def f(preds):
+            return F.multiclass_confusion_matrix(preds, bad_target, num_classes=7)
+
+        with self.assertRaisesRegex(ValueError, "strictly greater than max"):
+            jax.jit(f)(jnp.asarray([0, 1], dtype=jnp.int32))
+
+        def g(scores):
+            return F.multiclass_f1_score(
+                scores, bad_target, num_classes=7, average="macro"
+            )
+
+        with self.assertRaisesRegex(ValueError, "values should be in"):
+            jax.jit(g)(jnp.asarray(np.random.rand(2, 7).astype(np.float32)))
+
+    def test_eager_validation_still_raises(self):
+        """Outside jit, data-dependent validation is unchanged."""
+        with self.assertRaisesRegex(ValueError, "strictly greater than max"):
+            F.multiclass_confusion_matrix(
+                jnp.asarray([0, 1]), jnp.asarray([0, 9]), num_classes=7
+            )
+        with self.assertRaisesRegex(ValueError, "values should be in"):
+            F.multiclass_f1_score(
+                jnp.asarray([0, 1]),
+                jnp.asarray([0, 9]),
+                num_classes=7,
+                average="macro",
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

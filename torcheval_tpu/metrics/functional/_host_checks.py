@@ -22,6 +22,17 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def all_concrete(*arrays) -> bool:
+    """False when any input is a JAX tracer (inside ``jit``/``vmap``/
+    ``grad``).  Data-dependent host checks cannot be evaluated at trace
+    time, so callers skip them under tracing — this is what makes the
+    functional API composable into larger jitted programs (shape and
+    static-argument validation still applies; out-of-range indices are
+    then dropped by XLA's scatter semantics instead of raising, as
+    documented)."""
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
 @jax.jit
 def _bounds_kernel(arrays):
     # One stacked (2n,) result: a single dispatch and a single tiny fetch.
@@ -41,19 +52,20 @@ def bounds(*arrays: jax.Array) -> np.ndarray:
     the promoted dtype of the inputs (float32 minimum, float64 when an
     x64 input is present).  Exact for integer class indices below 2^24
     (any real ``num_classes``).  Callers must skip empty arrays themselves
-    (``jnp.min`` of empty raises).
+    (``jnp.min`` of empty raises) and tracers (``all_concrete``).
     """
-    return np.asarray(_bounds_kernel(tuple(arrays)))
-
-
-@jax.jit
-def _flags_kernel(flags):
-    return jnp.stack([jnp.any(f) for f in flags])
-
-
-def any_flags(*flags: jax.Array) -> np.ndarray:
-    """Fused ``jnp.any`` over several boolean conditions in one round trip."""
-    return np.asarray(_flags_kernel(tuple(flags)))
+    out = _bounds_kernel(tuple(arrays))
+    if isinstance(out, jax.core.Tracer):
+        # Inside someone else's trace every jax op is staged — even on
+        # concrete inputs — so the fused kernel yields a tracer.  Pure
+        # numpy on the (concrete) host values stays outside the trace
+        # (rare path: validating a concrete closure array inside a user's
+        # jit; the device→host copy is the unavoidable cost).
+        host = [np.asarray(a) for a in arrays]
+        return np.asarray(
+            [f(h) for h in host for f in (np.min, np.max)], dtype=np.float64
+        )
+    return np.asarray(out)
 
 
 def check_index_ranges(
@@ -66,7 +78,9 @@ def check_index_ranges(
     them where torch ``scatter_``/``gather`` error)."""
     if upper is None:
         return
-    pairs = [(v, n) for v, n in pairs if v.size]
+    # Skip only the arrays that are tracers — a concrete array alongside a
+    # traced one still gets its eager raise-on-OOB behavior.
+    pairs = [(v, n) for v, n in pairs if v.size and all_concrete(v)]
     if not pairs:
         return
     vals = bounds(*(v for v, _ in pairs))

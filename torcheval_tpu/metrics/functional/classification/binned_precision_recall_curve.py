@@ -12,8 +12,9 @@ from typing import List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from torcheval_tpu.metrics.functional._host_checks import any_flags
+from torcheval_tpu.metrics.functional._host_checks import all_concrete
 from torcheval_tpu.metrics.functional.classification.precision import (
     _check_index_range,
 )
@@ -168,12 +169,14 @@ def _create_threshold_tensor(
 def _binned_precision_recall_curve_param_check(threshold: jax.Array) -> None:
     """Thresholds must be sorted and within [0, 1]
     (reference ``binned_precision_recall_curve.py:235-242``)."""
-    unsorted, below, above = any_flags(
-        jnp.diff(threshold) < 0.0, threshold < 0.0, threshold > 1.0
-    )
-    if unsorted:
+    if not all_concrete(threshold):
+        return  # tracing: data-dependent checks cannot run
+    # Constructor-time check: pure numpy so it also works on concrete
+    # arrays under an ambient trace (one host fetch, no dispatch at all).
+    t = np.asarray(threshold)
+    if bool(np.any(np.diff(t) < 0.0)):
         raise ValueError("The `threshold` should be a sorted array.")
-    if below or above:
+    if bool(np.any(t < 0.0)) or bool(np.any(t > 1.0)):
         raise ValueError("The values in `threshold` should be in the range of [0, 1].")
 
 

@@ -14,7 +14,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional._host_checks import bounds
+from torcheval_tpu.metrics.functional._host_checks import all_concrete, bounds
 
 
 def binary_confusion_matrix(
@@ -70,8 +70,9 @@ def _confusion_matrix_update_kernel(
 def _binary_confusion_matrix_validate(input: jax.Array, target: jax.Array) -> None:
     _binary_confusion_matrix_input_check(input, target)
     # OOB targets must raise — the XLA scatter would silently drop them
-    # where torch ``scatter_`` errors.
-    if target.size:
+    # where torch ``scatter_`` errors.  (Skipped when tracing: data-
+    # dependent checks cannot run at trace time.)
+    if target.size and all_concrete(target):
         t_min, t_max = bounds(target)
         if t_min < 0 or t_max >= 2:
             raise ValueError(
@@ -147,29 +148,41 @@ def _confusion_matrix_update_input_check(
                 "input should have shape of (num_sample,) or (num_sample, num_classes), "
                 f"got {input.shape}."
             )
-        t_min, t_max = bounds(target)
-    else:
-        # All four bounds in one fused dispatch — a range check is one
-        # device round trip, not four.
-        i_min, i_max, t_min, t_max = bounds(input, target)
-        if i_max >= num_classes:
-            raise ValueError(
-                "Got `input` prediction class which is too large for the number of classes, "
-                f"num_classes: {num_classes} must be strictly greater than max "
-                f"class predicted: {int(i_max)}."
-            )
-        if i_min < 0:
-            raise ValueError(
-                f"Got negative `input` prediction class {int(i_min)}."
-            )
-    if t_max >= num_classes:
-        raise ValueError(
-            "Got `target` class which is larger than the number of classes, "
-            f"num_classes: {num_classes} must be strictly greater than max "
-            f"target: {int(t_max)}."
-        )
-    if t_min < 0:
-        raise ValueError(f"Got negative `target` class {int(t_min)}.")
+    # Range checks: all requested bounds in one fused dispatch — a check is
+    # one device round trip, not one per bound.  Traced arrays are skipped
+    # individually (their values don't exist at trace time); a concrete
+    # array alongside a traced one keeps its eager raise behavior.  The
+    # eager check order (input first, then target) is preserved.
+    to_check = []
+    if input.ndim == 1 and all_concrete(input):
+        to_check.append(("input", input))
+    if all_concrete(target):
+        to_check.append(("target", target))
+    if not to_check:
+        return
+    vals = bounds(*(v for _, v in to_check))
+    for i, (name, _) in enumerate(to_check):
+        lo, hi = vals[2 * i], vals[2 * i + 1]
+        if name == "input":
+            if hi >= num_classes:
+                raise ValueError(
+                    "Got `input` prediction class which is too large for the number of classes, "
+                    f"num_classes: {num_classes} must be strictly greater than max "
+                    f"class predicted: {int(hi)}."
+                )
+            if lo < 0:
+                raise ValueError(
+                    f"Got negative `input` prediction class {int(lo)}."
+                )
+        else:
+            if hi >= num_classes:
+                raise ValueError(
+                    "Got `target` class which is larger than the number of classes, "
+                    f"num_classes: {num_classes} must be strictly greater than max "
+                    f"target: {int(hi)}."
+                )
+            if lo < 0:
+                raise ValueError(f"Got negative `target` class {int(lo)}.")
 
 
 def _binary_confusion_matrix_input_check(input: jax.Array, target: jax.Array) -> None:

@@ -12,7 +12,9 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from torcheval_tpu.metrics.functional._host_checks import all_concrete
 from torcheval_tpu.metrics.functional.classification.precision import (
     _check_index_ranges,
 )
@@ -98,7 +100,13 @@ def _f1_score_compute(
     num_prediction: jax.Array,
     average: Optional[str],
 ) -> jax.Array:
-    if num_label.ndim and bool(jnp.any(num_label == 0)):
+    # numpy, not jnp: under an ambient trace even ops on concrete arrays
+    # are staged, and a staged bool() would crash the trace.
+    if (
+        num_label.ndim
+        and all_concrete(num_label)
+        and bool(np.any(np.asarray(num_label) == 0))
+    ):
         _logger.warning(
             "Warning: Some classes do not exist in the target. F1 scores for "
             "these classes will be cast to zeros."

@@ -17,8 +17,10 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torcheval_tpu.metrics.functional._host_checks import (
+    all_concrete,
     check_index_ranges as _check_index_ranges,
 )
 
@@ -98,10 +100,12 @@ def _precision_compute(
     num_label: jax.Array,
     average: Optional[str],
 ) -> jax.Array:
-    if average in (None, "None") and num_tp.ndim:
-        nan_mask = (num_tp + num_fp) == 0
-        if bool(jnp.any(nan_mask)):
-            bad_class = jnp.nonzero(nan_mask)[0]
+    if average in (None, "None") and num_tp.ndim and all_concrete(num_tp, num_fp):
+        # numpy, not jnp: under an ambient trace even ops on concrete
+        # arrays are staged, and a staged bool() would crash the trace.
+        nan_mask = (np.asarray(num_tp) + np.asarray(num_fp)) == 0
+        if nan_mask.any():
+            bad_class = np.nonzero(nan_mask)[0]
             _logger.warning(
                 f"{bad_class} classes have zero instances in both the "
                 "predictions and the ground truth labels. Precision is still "

@@ -17,7 +17,9 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from torcheval_tpu.metrics.functional._host_checks import all_concrete
 from torcheval_tpu.metrics.functional.classification.precision import (
     _check_index_ranges,
 )
@@ -36,13 +38,14 @@ def _binary_recall_compute(num_tp: jax.Array, num_true_labels: jax.Array) -> jax
     """NaN (no positive labels) → 0 with a warning
     (reference ``recall.py:64-77``)."""
     recall = num_tp / num_true_labels
-    if bool(jnp.isnan(recall)):
+    if all_concrete(recall) and bool(jnp.isnan(recall)):
         _logger.warning(
             "No positive instances have been seen in target. Recall is "
             "converted from NaN to 0s."
         )
-        return jnp.nan_to_num(recall)
-    return recall
+    # NaN→0 applies in eager AND traced modes (only the warning is
+    # concrete-only); nan_to_num is the identity on non-NaN values.
+    return jnp.nan_to_num(recall)
 
 
 def multiclass_recall(
@@ -113,10 +116,12 @@ def _recall_compute(
     num_predictions: jax.Array,
     average: Optional[str],
 ) -> jax.Array:
-    if num_tp.ndim:
-        nan_mask = num_labels == 0
-        if bool(jnp.any(nan_mask)):
-            nan_classes = [int(i) for i in jnp.nonzero(nan_mask)[0]]
+    if num_tp.ndim and all_concrete(num_labels):
+        # numpy, not jnp: under an ambient trace even ops on concrete
+        # arrays are staged, and a staged bool() would crash the trace.
+        nan_mask = np.asarray(num_labels) == 0
+        if nan_mask.any():
+            nan_classes = [int(i) for i in np.nonzero(nan_mask)[0]]
             _logger.warning(
                 f"One or more NaNs identified, as no ground-truth instances of "
                 f"{nan_classes} have been seen. These have been converted to zero."

@@ -14,6 +14,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics.functional._host_checks import all_concrete
+
 
 def r2_score(
     input,
@@ -26,6 +28,12 @@ def r2_score(
     (reference ``r2_score.py:~20-80``)."""
     _r2_score_param_check(multioutput, num_regressors)
     input, target = jnp.asarray(input), jnp.asarray(target)
+    _r2_score_update_input_check(input, target)
+    # One-shot path: the sample count is static shape info, so the
+    # data-size guards raise at trace time too (the compute-side guard
+    # only covers the class path, whose num_obs is accumulated state).
+    # Runs after the shape checks so mismatched inputs get the real error.
+    _r2_score_size_check(target.shape[0] if target.ndim else 0, num_regressors)
     sum_squared_obs, sum_obs, sum_squared_residual, num_obs = _r2_score_update(
         input, target
     )
@@ -65,17 +73,26 @@ def _r2_score_compute(
     multioutput: str,
     num_regressors: int,
 ) -> jax.Array:
-    if int(num_obs) < 2:
+    # The class streaming path accumulates num_obs as device state; its
+    # guards run only on concrete values (under tracing they cannot be
+    # evaluated).  The functional one-shot path checks statically in
+    # ``r2_score`` before this point.
+    if all_concrete(num_obs):
+        _r2_score_size_check(int(num_obs), num_regressors)
+    return _compute(sum_squared_obs, sum_obs, rss, num_obs, multioutput, num_regressors)
+
+
+def _r2_score_size_check(num_obs: int, num_regressors: int) -> None:
+    if num_obs < 2:
         raise ValueError(
             "There is no enough data for computing. Needs at least two "
             "samples to calculate r2 score."
         )
-    if num_regressors >= int(num_obs) - 1:
+    if num_regressors >= num_obs - 1:
         raise ValueError(
             "The `num_regressors` must be smaller than n_samples - 1, "
-            f"got num_regressors={num_regressors}, n_samples={int(num_obs)}.",
+            f"got num_regressors={num_regressors}, n_samples={num_obs}.",
         )
-    return _compute(sum_squared_obs, sum_obs, rss, num_obs, multioutput, num_regressors)
 
 
 @partial(jax.jit, static_argnames=("multioutput", "num_regressors"))
