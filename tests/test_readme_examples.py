@@ -33,6 +33,39 @@ class GeometricMean(Metric[jax.Array]):
         return self
 
 
+class TestReadmeJitEvalStep(unittest.TestCase):
+    def test_fused_eval_step_snippet(self):
+        """The README's jitted loss+metrics eval-step example, verbatim in
+        structure (optax loss, accuracy, confusion matrix in one program)."""
+        import optax
+
+        from torcheval_tpu.metrics.functional import (
+            multiclass_accuracy,
+            multiclass_confusion_matrix,
+        )
+
+        @jax.jit
+        def eval_step(logits, labels):
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            ).mean()
+            acc = multiclass_accuracy(logits, labels)
+            cm = multiclass_confusion_matrix(
+                logits.argmax(-1), labels, num_classes=10
+            )
+            return loss, acc, cm
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((64, 10)).astype(np.float32))
+        labels = jnp.asarray(rng.integers(0, 10, 64).astype(np.int32))
+        loss, acc, cm = eval_step(logits, labels)
+        self.assertTrue(np.isfinite(float(loss)))
+        np.testing.assert_allclose(
+            float(acc), float(multiclass_accuracy(logits, labels)), rtol=1e-6
+        )
+        self.assertEqual(int(np.asarray(cm).sum()), 64)
+
+
 class TestReadmeCustomMetric(unittest.TestCase):
     def test_lifecycle(self):
         values = np.asarray([1.0, 2.0, 4.0], dtype=np.float32)
