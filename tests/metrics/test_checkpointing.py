@@ -11,7 +11,15 @@ import numpy as np
 
 from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
 
+try:
+    import orbax.checkpoint  # noqa: F401
 
+    HAVE_ORBAX = True
+except Exception:  # pragma: no cover
+    HAVE_ORBAX = False
+
+
+@unittest.skipUnless(HAVE_ORBAX, "orbax-checkpoint not available")
 class TestOrbaxRoundTrip(unittest.TestCase):
     def _roundtrip(self, state_dict):
         import orbax.checkpoint as ocp
