@@ -1,0 +1,60 @@
+"""initialize_multihost drives a real single-process jax.distributed
+runtime (localhost coordinator) and is idempotent.
+
+Runs in a subprocess because ``jax.distributed.initialize`` mutates global
+process state that must not leak into the rest of the suite.
+"""
+
+import subprocess
+import sys
+import unittest
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+_SCRIPT = r"""
+import socket
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from torcheval_tpu.distributed import initialize_multihost
+
+with socket.socket() as s:
+    s.bind(("localhost", 0))
+    port = s.getsockname()[1]
+
+group = initialize_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=1, process_id=0
+)
+assert group.rank == 0 and group.world_size == 1, (group.rank, group.world_size)
+assert group.all_gather_object({"x": 1}) == [{"x": 1}]
+assert group.broadcast_object("payload", src=0) == "payload"
+
+# Idempotent: a second call must not raise, and still yields a live group.
+group2 = initialize_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=1, process_id=0
+)
+assert group2.world_size == 1
+print("MULTIHOST_OK")
+"""
+
+
+class TestInitializeMultihost(unittest.TestCase):
+    def test_single_process_runtime_and_idempotency(self):
+        proc = subprocess.run(
+            [sys.executable, "-c", _SCRIPT],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            cwd=REPO_ROOT,
+        )
+        self.assertEqual(
+            proc.returncode, 0, f"stderr: {proc.stderr[-1500:]}"
+        )
+        self.assertIn("MULTIHOST_OK", proc.stdout)
+
+
+if __name__ == "__main__":
+    unittest.main()

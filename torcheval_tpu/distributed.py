@@ -94,6 +94,34 @@ class NullGroup(CollectiveGroup):
         raise RuntimeError("Process is not part of this group.")
 
 
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs: Any,
+) -> "JaxProcessGroup":
+    """Initialize JAX's multi-host runtime and return the pod-wide group.
+
+    The analog of the reference's ``dist.init_process_group`` (reference
+    ``examples/distributed_example.py:54-57``): a thin, idempotent wrapper
+    over ``jax.distributed.initialize``.  On Cloud TPU pods every argument
+    is auto-detected from the runtime environment; on other clusters pass
+    the coordinator address, the world size, and this process's id.  A
+    repeat call returns a fresh group over the already-initialized runtime
+    instead of raising.
+    """
+    import jax
+
+    if not jax.distributed.is_initialized():
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    return JaxProcessGroup()
+
+
 class JaxProcessGroup(CollectiveGroup):
     """Multi-host JAX group: object collectives built on ICI/DCN array
     collectives.
