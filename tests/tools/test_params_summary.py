@@ -1,0 +1,85 @@
+"""get_params_summary works over any parameter pytree — dm-haiku models
+and plain nested dicts — with XLA-priced root FLOPs when an apply_fn is
+given."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.tools import get_params_summary, get_summary_table
+
+
+class TestPlainDictSummary(unittest.TestCase):
+    def test_counts_sizes_and_tree(self):
+        params = {
+            "encoder": {
+                "w": jnp.zeros((4, 8), jnp.float32),
+                "b": jnp.zeros((8,), jnp.float32),
+            },
+            "head": {"w": jnp.zeros((8, 2), jnp.float32)},
+        }
+        s = get_params_summary(params, name="net")
+        self.assertEqual(s.module_name, "net")
+        self.assertEqual(s.num_parameters, 4 * 8 + 8 + 8 * 2)
+        self.assertEqual(s.size_bytes, (4 * 8 + 8 + 8 * 2) * 4)
+        self.assertEqual(
+            sorted(s.submodule_summaries), ["encoder", "head"]
+        )
+        self.assertEqual(s.submodule_summaries["head"].num_parameters, 16)
+
+    def test_root_flops_from_apply_fn(self):
+        params = {"w": jnp.ones((16, 16), jnp.float32)}
+
+        def apply_fn(p, x):
+            return x @ p["w"]
+
+        x = jnp.ones((32, 16), jnp.float32)
+        s = get_params_summary(params, apply_fn=apply_fn, example_args=(x,))
+        # 2*M*N*K forward matmul FLOPs when the backend has a cost model;
+        # UNKNOWN (-1) is acceptable where it does not.
+        if s.flops_forward != -1:
+            self.assertGreaterEqual(s.flops_forward, 2 * 32 * 16 * 16)
+        if s.flops_backward != -1:
+            # dL/dW = x^T @ g is at least another matmul's worth.
+            self.assertGreaterEqual(s.flops_backward, 2 * 32 * 16 * 16)
+
+    def test_table_renders(self):
+        params = {"layer": {"w": jnp.zeros((3, 3))}}
+        table = get_summary_table(get_params_summary(params))
+        self.assertIn("layer", table)
+
+
+class TestHaikuSummary(unittest.TestCase):
+    def test_haiku_mlp(self):
+        try:
+            import haiku as hk
+        except Exception:  # pragma: no cover
+            self.skipTest("dm-haiku not available")
+
+        def forward(x):
+            mlp = hk.nets.MLP([32, 10], name="mlp")
+            return mlp(x)
+
+        fn = hk.without_apply_rng(hk.transform(forward))
+        x = jnp.ones((8, 16), jnp.float32)
+        params = fn.init(jax.random.PRNGKey(0), x)
+
+        s = get_params_summary(
+            params, apply_fn=fn.apply, example_args=(x,), name="mlp"
+        )
+        want = 16 * 32 + 32 + 32 * 10 + 10
+        self.assertEqual(s.num_parameters, want)
+        # One node per haiku module scope.
+        self.assertEqual(len(s.submodule_summaries), 2)
+        total_sub = sum(
+            sub.num_parameters for sub in s.submodule_summaries.values()
+        )
+        self.assertEqual(total_sub, want)
+        out = fn.apply(params, x)
+        self.assertEqual(out.shape, (8, 10))
+
+
+if __name__ == "__main__":
+    unittest.main()

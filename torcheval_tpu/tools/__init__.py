@@ -9,6 +9,7 @@ from torcheval_tpu.tools.flops import (
 )
 from torcheval_tpu.tools.module_summary import (
     get_module_summary,
+    get_params_summary,
     get_summary_table,
     ModuleSummary,
     prune_module_summary,
@@ -20,6 +21,7 @@ __all__ = [
     "flops_of",
     "forward_backward_flops",
     "get_module_summary",
+    "get_params_summary",
     "get_summary_table",
     "ModuleSummary",
     "profiling",

@@ -279,6 +279,59 @@ def get_module_summary(
     return root
 
 
+def get_params_summary(
+    params: Any,
+    *,
+    apply_fn: Optional[Any] = None,
+    example_args: Sequence[Any] = (),
+    example_kwargs: Optional[Mapping[str, Any]] = None,
+    name: str = "model",
+) -> ModuleSummary:
+    """Summary tree for ANY parameter pytree — haiku, equinox, raw dicts.
+
+    ``get_module_summary`` is flax-specific (per-submodule FLOP attribution
+    needs flax's ``intercept_methods`` hook point); this walks the pytree
+    structure instead: every mapping level becomes a tree node with
+    parameter counts and byte sizes (haiku's ``"scope/~/linear_0"`` keys
+    come out as one node each).  When ``apply_fn`` is given, the total
+    forward/backward FLOPs of ``apply_fn(params, *example_args)`` are
+    priced with XLA cost analysis and attached to the root.
+    """
+    def make_node(node: Any, path: Tuple[str, ...]) -> ModuleSummary:
+        s = ModuleSummary()
+        s._module_name = ".".join(path) if path else name
+        s._module_type = type(node).__name__
+        count, size = _leaf_stats(node)
+        s._num_parameters = count
+        s._num_trainable_parameters = count
+        s._size_bytes = size
+        if isinstance(node, Mapping):
+            for key, child in node.items():
+                if isinstance(child, Mapping):
+                    child_path = path + (str(key),)
+                    s._submodule_summaries[".".join(child_path)] = make_node(
+                        child, child_path
+                    )
+        return s
+
+    root = make_node(params, ())
+    if apply_fn is not None:
+        try:
+            # forward_backward_flops differentiates variables["params"], so
+            # wrap the raw pytree under that key to get real backward costs.
+            fwd, bwd = forward_backward_flops(
+                lambda v, *a, **kw: apply_fn(v["params"], *a, **kw),
+                {"params": params},
+                *example_args,
+                **(example_kwargs or {}),
+            )
+        except Exception:
+            fwd = bwd = UNKNOWN_FLOPS
+        root._flops_forward = fwd
+        root._flops_backward = bwd
+    return root
+
+
 def prune_module_summary(module_summary: ModuleSummary, *, max_depth: int) -> None:
     """Drop summaries deeper than ``max_depth``, in place
     (reference ``module_summary.py:363-383``)."""
