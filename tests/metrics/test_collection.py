@@ -1,0 +1,141 @@
+"""MetricCollection: shared-batch lifecycle over a named set of metrics."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+)
+from torcheval_tpu.metrics.toolkit import clone_metric
+
+
+def _collection(c=5):
+    return MetricCollection(
+        {
+            "accuracy": MulticlassAccuracy(num_classes=c, average="macro"),
+            "f1": MulticlassF1Score(num_classes=c, average="macro"),
+            "confusion": MulticlassConfusionMatrix(num_classes=c),
+        }
+    )
+
+
+def _data(seed=0, n=256, c=5):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.random((n, c)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, c, n).astype(np.int32)),
+    )
+
+
+class TestMetricCollection(unittest.TestCase):
+    def test_update_compute_matches_individuals(self):
+        scores, target = _data()
+        coll = _collection().update(scores, target)
+        out = coll.compute()
+        want_acc = float(
+            MulticlassAccuracy(num_classes=5, average="macro")
+            .update(scores, target)
+            .compute()
+        )
+        self.assertAlmostEqual(float(out["accuracy"]), want_acc, places=6)
+        self.assertEqual(int(np.asarray(out["confusion"]).sum()), 256)
+        self.assertEqual(set(out), {"accuracy", "f1", "confusion"})
+
+    def test_reset_and_container_protocol(self):
+        scores, target = _data()
+        coll = _collection().update(scores, target).reset()
+        self.assertEqual(len(coll), 3)
+        self.assertIn("f1", list(coll))
+        self.assertIsInstance(coll["accuracy"], MulticlassAccuracy)
+        self.assertEqual(int(np.asarray(coll.compute()["confusion"]).sum()), 0)
+
+    def test_merge_state_memberwise(self):
+        scores, target = _data()
+        half = 128
+        a, b = _collection(), _collection()
+        a.update(scores[:half], target[:half])
+        b.update(scores[half:], target[half:])
+        a.merge_state([b])
+        whole = _collection().update(scores, target)
+        np.testing.assert_allclose(
+            np.asarray(a.compute()["confusion"]),
+            np.asarray(whole.compute()["confusion"]),
+        )
+        self.assertAlmostEqual(
+            float(a.compute()["f1"]), float(whole.compute()["f1"]), places=6
+        )
+
+    def test_merge_rejects_mismatched_names(self):
+        other = MetricCollection({"only": MulticlassAccuracy()})
+        with self.assertRaisesRegex(ValueError, "same metric names"):
+            _collection().merge_state([other])
+
+    def test_state_dict_roundtrip(self):
+        scores, target = _data()
+        coll = _collection().update(scores, target)
+        snapshot = coll.state_dict()
+        self.assertIn("confusion/confusion_matrix", snapshot)
+        fresh = _collection()
+        fresh.load_state_dict(snapshot)
+        np.testing.assert_allclose(
+            np.asarray(fresh.compute()["confusion"]),
+            np.asarray(coll.compute()["confusion"]),
+        )
+
+    def test_load_strict_rejects_unexpected(self):
+        coll = _collection()
+        snapshot = coll.state_dict()
+        snapshot["bogus/key"] = jnp.zeros(1)
+        with self.assertRaisesRegex(RuntimeError, "Unexpected keys"):
+            coll.load_state_dict(snapshot)
+
+    def test_constructor_validation(self):
+        with self.assertRaisesRegex(ValueError, "at least one"):
+            MetricCollection({})
+        with self.assertRaisesRegex(TypeError, "Metric instances"):
+            MetricCollection({"x": object()})
+        with self.assertRaisesRegex(ValueError, "must not contain"):
+            MetricCollection({"train/acc": MulticlassAccuracy()})
+
+    def test_sync_through_toolkit(self):
+        """sync_and_compute works on a whole collection: gathered, merged
+        memberwise, computed — like any single metric object."""
+        from torcheval_tpu.distributed import LocalWorld
+        from torcheval_tpu.metrics.toolkit import sync_and_compute
+
+        scores, target = _data(n=256)
+        world = LocalWorld(4)
+        shard = 256 // 4
+
+        def run(group, rank):
+            coll = _collection()
+            sl = slice(rank * shard, (rank + 1) * shard)
+            coll.update(scores[sl], target[sl])
+            return sync_and_compute(
+                coll, process_group=group, recipient_rank="all"
+            )
+
+        results = world.run(run)
+        whole = _collection().update(scores, target).compute()
+        for result in results:
+            np.testing.assert_allclose(
+                np.asarray(result["confusion"]),
+                np.asarray(whole["confusion"]),
+            )
+            self.assertAlmostEqual(
+                float(result["f1"]), float(whole["f1"]), places=6
+            )
+
+    def test_clone_metric_compatible_members(self):
+        coll = _collection()
+        clone = clone_metric(coll["accuracy"])
+        self.assertIsNot(clone, coll["accuracy"])
+
+
+if __name__ == "__main__":
+    unittest.main()

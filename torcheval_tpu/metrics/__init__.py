@@ -24,6 +24,7 @@ from torcheval_tpu.metrics.classification import (
     MultilabelAccuracy,
     TopKMultilabelAccuracy,
 )
+from torcheval_tpu.metrics.collection import MetricCollection
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.metrics.ranking import HitRate, ReciprocalRank, WeightedCalibration
 from torcheval_tpu.metrics.regression import MeanSquaredError, R2Score
@@ -52,6 +53,7 @@ __all__ = [
     "Mean",
     "MeanSquaredError",
     "Metric",
+    "MetricCollection",
     "Min",
     "MulticlassAccuracy",
     "MulticlassBinnedPrecisionRecallCurve",
