@@ -75,6 +75,12 @@ class TestMetricCollection(unittest.TestCase):
         with self.assertRaisesRegex(ValueError, "same metric names"):
             _collection().merge_state([other])
 
+    def test_merge_rejects_mismatched_types(self):
+        a = MetricCollection({"m": MulticlassAccuracy()})
+        b = MetricCollection({"m": MulticlassF1Score()})
+        with self.assertRaisesRegex(ValueError, "MulticlassF1Score"):
+            a.merge_state([b])
+
     def test_state_dict_roundtrip(self):
         scores, target = _data()
         coll = _collection().update(scores, target)

@@ -15,7 +15,8 @@ class TestEntry(unittest.TestCase):
         fn, args = graft.entry()
         out = jax.jit(fn)(*args)
         self.assertEqual(out["confusion_matrix"].shape, (graft.NUM_CLASSES,) * 2)
-        self.assertEqual(int(out["num_total"]), 1024)
+        self.assertEqual(int(np.asarray(out["confusion_matrix"]).sum()), 1024)
+        self.assertTrue(0.0 <= float(out["accuracy"]) <= 1.0)
         self.assertTrue(np.isfinite(float(out["auroc"])))
 
 

@@ -66,6 +66,31 @@ class TestReadmeJitEvalStep(unittest.TestCase):
         self.assertEqual(int(np.asarray(cm).sum()), 64)
 
 
+class TestReadmeMetricCollection(unittest.TestCase):
+    def test_collection_snippet(self):
+        """The README MetricCollection example, verbatim in structure."""
+        from torcheval_tpu.metrics import (
+            MetricCollection,
+            MulticlassAccuracy,
+            MulticlassF1Score,
+        )
+
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((32, 10)).astype(np.float32))
+        labels = jnp.asarray(rng.integers(0, 10, 32).astype(np.int32))
+
+        metrics = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=10),
+                "f1": MulticlassF1Score(num_classes=10, average="macro"),
+            }
+        )
+        metrics.update(logits, labels)
+        out = metrics.compute()
+        self.assertEqual(set(out), {"acc", "f1"})
+        self.assertTrue(0.0 <= float(out["acc"]) <= 1.0)
+
+
 class TestReadmeCustomMetric(unittest.TestCase):
     def test_lifecycle(self):
         values = np.asarray([1.0, 2.0, 4.0], dtype=np.float32)

@@ -72,7 +72,12 @@ class MetricCollection:
         self, collections: Iterable["MetricCollection"]
     ) -> "MetricCollection":
         """Merge same-shaped collections memberwise (each member follows its
-        own ``merge_state`` semantics — add, concat, max, window-grow)."""
+        own ``merge_state`` semantics — add, concat, max, window-grow).
+
+        Members must be the same metric type under each name AND identically
+        configured (same ``average``/``num_classes``/...): per-metric
+        ``merge_state`` assumes identically-configured sources, here exactly
+        as in the reference (``metric.py:91-110``)."""
         collections = list(collections)
         for other in collections:
             if set(other._metrics) != set(self._metrics):
@@ -80,6 +85,13 @@ class MetricCollection:
                     "Merged collections must hold the same metric names; got "
                     f"{sorted(self._metrics)} vs {sorted(other._metrics)}."
                 )
+            for name, metric in self._metrics.items():
+                if type(other._metrics[name]) is not type(metric):
+                    raise ValueError(
+                        f"Member {name!r} is {type(metric).__name__} here but "
+                        f"{type(other._metrics[name]).__name__} in a merged "
+                        "collection."
+                    )
         for name, metric in self._metrics.items():
             metric.merge_state([other._metrics[name] for other in collections])
         return self
