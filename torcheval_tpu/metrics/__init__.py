@@ -5,8 +5,10 @@ from torcheval_tpu.metrics import functional
 from torcheval_tpu.metrics.aggregation import Cat, Max, Mean, Min, Sum, Throughput
 from torcheval_tpu.metrics.classification import (
     BinaryAccuracy,
+    BinaryAUPRC,
     BinaryAUROC,
     BinaryPrecisionRecallCurve,
+    MulticlassAUPRC,
     MulticlassAUROC,
     MulticlassPrecisionRecallCurve,
     BinaryBinnedPrecisionRecallCurve,
@@ -35,9 +37,11 @@ from torcheval_tpu.metrics.window import (
 
 __all__ = [
     "BinaryAccuracy",
+    "BinaryAUPRC",
     "BinaryAUROC",
     "BinaryPrecisionRecallCurve",
     "HitRate",
+    "MulticlassAUPRC",
     "MulticlassAUROC",
     "MulticlassPrecisionRecallCurve",
     "ReciprocalRank",

@@ -1,3 +1,7 @@
+from torcheval_tpu.metrics.classification.auprc import (
+    BinaryAUPRC,
+    MulticlassAUPRC,
+)
 from torcheval_tpu.metrics.classification.auroc import (
     BinaryAUROC,
     MulticlassAUROC,
@@ -38,6 +42,7 @@ from torcheval_tpu.metrics.classification.recall import (
 
 __all__ = [
     "BinaryAccuracy",
+    "BinaryAUPRC",
     "BinaryAUROC",
     "BinaryBinnedPrecisionRecallCurve",
     "BinaryConfusionMatrix",
@@ -47,6 +52,7 @@ __all__ = [
     "BinaryPrecisionRecallCurve",
     "BinaryRecall",
     "MulticlassAccuracy",
+    "MulticlassAUPRC",
     "MulticlassAUROC",
     "MulticlassBinnedPrecisionRecallCurve",
     "MulticlassConfusionMatrix",

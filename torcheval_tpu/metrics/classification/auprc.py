@@ -1,0 +1,104 @@
+"""AUPRC metrics — average precision over buffered samples.
+
+Beyond the reference snapshot (upstream torcheval added AUPRC after
+v0.0.4); same buffer-state design as the AUROC classes: ``inputs``/
+``targets`` lists, concat merge, pre-concat for the sync wire."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
+from torcheval_tpu.metrics.functional.classification.auprc import (
+    _binary_auprc_compute_kernel,
+    _multiclass_auprc_compute_kernel,
+    _multiclass_auprc_param_check,
+)
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_update_input_check,
+    _multiclass_auroc_update_input_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class BinaryAUPRC(Metric[jax.Array]):
+    """Binary average precision with multi-task support (buffered, exact)."""
+
+    def __init__(self, *, num_tasks: int = 1, device=None) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.num_tasks = num_tasks
+        self._add_state("inputs", [])
+        self._add_state("targets", [])
+
+    def update(self, input, target) -> "BinaryAUPRC":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _binary_auroc_update_input_check(input, target, self.num_tasks)
+        self.inputs.append(jax.device_put(input, self.device))
+        self.targets.append(jax.device_put(target, self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        """Average precision per task; empty array before any update."""
+        if not self.inputs:
+            return jnp.zeros(0)
+        return _binary_auprc_compute_kernel(
+            jnp.concatenate(self.inputs, axis=-1),
+            jnp.concatenate(self.targets, axis=-1),
+        )
+
+    def merge_state(self, metrics: Iterable["BinaryAUPRC"]) -> "BinaryAUPRC":
+        merge_concat_buffers(self, metrics, "inputs", "targets", dim=-1)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "inputs", "targets", dim=-1)
+
+
+class MulticlassAUPRC(Metric[jax.Array]):
+    """One-vs-rest average precision with macro/None averaging."""
+
+    def __init__(
+        self,
+        *,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _multiclass_auprc_param_check(num_classes, average)
+        self.num_classes = num_classes
+        self.average = average
+        self._add_state("inputs", [])
+        self._add_state("targets", [])
+
+    def update(self, input, target) -> "MulticlassAUPRC":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _multiclass_auroc_update_input_check(input, target, self.num_classes)
+        self.inputs.append(jax.device_put(input, self.device))
+        self.targets.append(jax.device_put(target, self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        """Macro or per-class average precision; empty array before any
+        update."""
+        if not self.inputs:
+            return jnp.zeros(0)
+        return _multiclass_auprc_compute_kernel(
+            jnp.concatenate(self.inputs, axis=0),
+            jnp.concatenate(self.targets, axis=0),
+            self.num_classes,
+            self.average,
+        )
+
+    def merge_state(self, metrics: Iterable["MulticlassAUPRC"]) -> "MulticlassAUPRC":
+        merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "inputs", "targets", dim=0)

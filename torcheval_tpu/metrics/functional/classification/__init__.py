@@ -1,3 +1,7 @@
+from torcheval_tpu.metrics.functional.classification.auprc import (
+    binary_auprc,
+    multiclass_auprc,
+)
 from torcheval_tpu.metrics.functional.classification.auroc import (
     binary_auroc,
     multiclass_auroc,
@@ -38,6 +42,7 @@ from torcheval_tpu.metrics.functional.classification.recall import (
 
 __all__ = [
     "binary_accuracy",
+    "binary_auprc",
     "binary_auroc",
     "binary_binned_precision_recall_curve",
     "binary_confusion_matrix",
@@ -47,6 +52,7 @@ __all__ = [
     "binary_precision_recall_curve",
     "binary_recall",
     "multiclass_accuracy",
+    "multiclass_auprc",
     "multiclass_auroc",
     "multiclass_binned_precision_recall_curve",
     "multiclass_confusion_matrix",

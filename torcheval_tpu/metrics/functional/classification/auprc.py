@@ -1,0 +1,116 @@
+"""AUPRC (average precision) — area under the precision-recall curve.
+
+Not in the reference snapshot (torcheval v0.0.4 has only the PR *curve*;
+upstream torcheval added ``binary_auprc``/``multiclass_auprc`` later), but
+the BASELINE AUPRC workload and the shared sort+tie-scan core
+(``_sort_scan.py``) make it a natural member of the threshold-curve family
+here.  Semantics follow the standard step-sum average precision
+(``sklearn.metrics.average_precision_score``):
+
+    AP = Σ_groups (R_g − R_{g−1}) · P_g
+
+evaluated at tie-group ends of the descending score sort — shape-stable,
+jit-composable, multi-task via a leading dim like ``binary_auroc``.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification._sort_scan import (
+    class_hits,
+    sorted_tie_cumsums,
+)
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_update_input_check,
+    _group_end_values,
+    _multiclass_auroc_update_input_check,
+)
+
+
+def binary_auprc(
+    input,
+    target,
+    *,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """Average precision for binary classification; multi-task via a
+    ``(num_tasks, n)`` leading dim.  Rows with no positive labels (or no
+    samples) yield 0 — sklearn returns NaN with a warning there."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _binary_auroc_update_input_check(input, target, num_tasks)
+    if input.shape[-1] == 0:
+        return jnp.zeros(input.shape[:-1])
+    return _binary_auprc_compute_kernel(input, target)
+
+
+def multiclass_auprc(
+    input,
+    target,
+    *,
+    num_classes: int,
+    average: Optional[str] = "macro",
+) -> jax.Array:
+    """One-vs-rest average precision with macro/None averaging.
+
+    Classes absent from ``target`` contribute 0 to the macro mean —
+    sklearn yields NaN with a warning for such classes."""
+    _multiclass_auprc_param_check(num_classes, average)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _multiclass_auroc_update_input_check(input, target, num_classes)
+    if input.shape[0] == 0:
+        return jnp.zeros(()) if average == "macro" else jnp.zeros(num_classes)
+    return _multiclass_auprc_compute_kernel(input, target, num_classes, average)
+
+
+@jax.jit
+def _auprc_rows(scores: jax.Array, hits: jax.Array) -> jax.Array:
+    """Row-wise AP over ``(R, N)`` scores/hits.
+
+    AP = Σ_groups (tp_g − tp_{g−1})·P_g; since every element of a tie
+    group shares the group-end precision, this equals summing each sorted
+    hit weighted by its group-end precision — the group-end propagation is
+    the shared ``_group_end_values`` used by the AUROC kernel."""
+    _, is_last, cum_tp, cum_fp = sorted_tie_cumsums(scores, hits)
+    tp_end = _group_end_values(cum_tp, is_last).astype(jnp.float32)
+    fp_end = _group_end_values(cum_fp, is_last).astype(jnp.float32)
+    precision = tp_end / jnp.maximum(tp_end + fp_end, 1.0)
+    sorted_hits = jnp.diff(cum_tp, axis=-1, prepend=0).astype(jnp.float32)
+    num_pos = cum_tp[..., -1].astype(jnp.float32)
+    ap = (sorted_hits * precision).sum(axis=-1) / jnp.maximum(num_pos, 1.0)
+    return jnp.where(num_pos == 0, 0.0, ap)
+
+
+@jax.jit
+def _binary_auprc_compute_kernel(input: jax.Array, target: jax.Array) -> jax.Array:
+    squeeze = input.ndim == 1
+    if squeeze:
+        input, target = input[None], target[None]
+    ap = _auprc_rows(input, (target == 1))
+    return ap[0] if squeeze else ap
+
+
+@partial(jax.jit, static_argnames=("num_classes", "average"))
+def _multiclass_auprc_compute_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    average: Optional[str],
+) -> jax.Array:
+    ap = _auprc_rows(input.T, class_hits(target, num_classes))
+    return ap.mean() if average == "macro" else ap
+
+
+def _multiclass_auprc_param_check(
+    num_classes: int, average: Optional[str]
+) -> None:
+    average_options = ("macro", "none", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, "
+            f"got {average}."
+        )
+    if num_classes < 2:
+        raise ValueError("`num_classes` has to be at least 2.")
