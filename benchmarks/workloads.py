@@ -151,6 +151,31 @@ def bench_binary_auprc() -> Tuple[str, float, Optional[float]]:
     return "binary_auprc_curve", ours, ref
 
 
+def bench_binary_auprc_scalar() -> Tuple[str, float, Optional[float]]:
+    """Scalar average precision (BinaryAUPRC) — the compute-bound AUPRC
+    formulation (sort+scan to ONE scalar, no O(N) curve transfer).  The
+    reference snapshot has no AUPRC; its closest capability is the full PR
+    curve, so ``vs_baseline`` compares against that lifecycle (generous to
+    the reference: it pays no device/transfer costs on torch CPU)."""
+    from torcheval_tpu.metrics import BinaryAUPRC
+
+    rng = np.random.default_rng(7)
+    n = 2**22
+    scores = rng.random(n, dtype=np.float32)
+    target = (rng.random(n) > 0.5).astype(np.float32)
+    ours = _lifecycle(BinaryAUPRC(), _split((scores, target)))
+
+    ref = None
+    try:
+        Ref = _reference().BinaryPrecisionRecallCurve
+        n_ref = 2**17
+        batches = _split_torch((scores[:n_ref], target[:n_ref].astype(np.int64)))
+        ref = _lifecycle(Ref(), batches, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "binary_auprc_scalar", ours, ref
+
+
 def bench_confusion_f1() -> Tuple[str, float, Optional[float]]:
     """BASELINE configs[2]: 1000-class confusion matrix + F1 scatter-adds."""
     from torcheval_tpu.metrics import MulticlassConfusionMatrix, MulticlassF1Score
@@ -331,6 +356,7 @@ ALL_WORKLOADS = [
     bench_accuracy,
     bench_binary_auroc,
     bench_binary_auprc,
+    bench_binary_auprc_scalar,
     bench_confusion_f1,
     bench_regression,
     bench_sharded_auroc_sync,
