@@ -1,0 +1,172 @@
+"""Multilabel AUPRC and PR-curve family vs the sklearn oracle — functional
+and class forms, averaging modes, merge, protocol, and error paths."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import average_precision_score, precision_recall_curve
+
+from torcheval_tpu.metrics import MultilabelAUPRC, MultilabelPrecisionRecallCurve
+from torcheval_tpu.metrics.functional import (
+    multilabel_auprc,
+    multilabel_precision_recall_curve,
+)
+
+
+def _random_multilabel(rng, n, num_labels):
+    scores = rng.random((n, num_labels)).astype(np.float32)
+    target = (rng.random((n, num_labels)) > 0.5).astype(np.float32)
+    # ensure every label has at least one positive so sklearn is defined
+    target[0, :] = 1.0
+    return scores, target
+
+
+class TestMultilabelAUPRC(unittest.TestCase):
+    def test_matches_sklearn(self):
+        rng = np.random.default_rng(0)
+        for trial in range(4):
+            n, num_labels = int(rng.integers(16, 129)), int(rng.integers(2, 7))
+            scores, target = _random_multilabel(rng, n, num_labels)
+            if trial % 2:
+                scores = np.round(scores * 4) / 4  # dense ties
+            per_label = np.asarray(
+                multilabel_auprc(
+                    jnp.asarray(scores),
+                    jnp.asarray(target),
+                    num_labels=num_labels,
+                    average=None,
+                )
+            )
+            want = [
+                average_precision_score(target[:, k], scores[:, k])
+                for k in range(num_labels)
+            ]
+            np.testing.assert_allclose(per_label, want, rtol=1e-5, atol=1e-6)
+            macro = float(
+                multilabel_auprc(
+                    jnp.asarray(scores), jnp.asarray(target), num_labels=num_labels
+                )
+            )
+            self.assertAlmostEqual(macro, float(np.mean(want)), places=5)
+
+    def test_param_and_input_checks(self):
+        with self.assertRaisesRegex(ValueError, "at least 2"):
+            multilabel_auprc(jnp.zeros((4, 1)), jnp.zeros((4, 1)), num_labels=1)
+        with self.assertRaisesRegex(ValueError, "allowed value"):
+            multilabel_auprc(
+                jnp.zeros((4, 3)), jnp.zeros((4, 3)), num_labels=3, average="micro"
+            )
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            multilabel_auprc(jnp.zeros((4, 3)), jnp.zeros((4, 2)), num_labels=3)
+        with self.assertRaisesRegex(ValueError, "num_sample, num_labels"):
+            multilabel_auprc(jnp.zeros((4, 2)), jnp.zeros((4, 2)), num_labels=3)
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(1)
+        scores, target = _random_multilabel(rng, 96, 4)
+        m = MultilabelAUPRC(num_labels=4)
+        for c_s, c_t in zip(np.split(scores, 3), np.split(target, 3)):
+            m.update(jnp.asarray(c_s), jnp.asarray(c_t))
+        want = np.mean(
+            [average_precision_score(target[:, k], scores[:, k]) for k in range(4)]
+        )
+        self.assertAlmostEqual(float(m.compute()), float(want), places=5)
+
+        a, b = MultilabelAUPRC(num_labels=4), MultilabelAUPRC(num_labels=4)
+        a.update(jnp.asarray(scores[:48]), jnp.asarray(target[:48]))
+        b.update(jnp.asarray(scores[48:]), jnp.asarray(target[48:]))
+        a.merge_state([b])
+        self.assertAlmostEqual(float(a.compute()), float(want), places=5)
+        self.assertEqual(MultilabelAUPRC(num_labels=4).compute().shape, (0,))
+
+    def test_class_protocol(self):
+        from torcheval_tpu.utils.test_utils.metric_class_tester import (
+            BATCH_SIZE,
+            NUM_TOTAL_UPDATES,
+            MetricClassTester,
+        )
+
+        class _T(MetricClassTester):
+            def runTest(self):  # pragma: no cover
+                pass
+
+        rng = np.random.default_rng(2)
+        num_labels = 3
+        input = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE, num_labels)).astype(
+            np.float32
+        )
+        target = rng.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE, num_labels))
+        flat_s = input.reshape(-1, num_labels)
+        flat_t = target.reshape(-1, num_labels)
+        expected = np.mean(
+            [
+                average_precision_score(flat_t[:, k], flat_s[:, k])
+                for k in range(num_labels)
+            ]
+        )
+        _T().run_class_implementation_tests(
+            metric=MultilabelAUPRC(num_labels=num_labels),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=np.float32(expected),
+            atol=1e-5,
+            rtol=1e-4,
+            test_merge_with_one_update=False,
+        )
+
+
+class TestMultilabelPrecisionRecallCurve(unittest.TestCase):
+    def test_matches_sklearn(self):
+        rng = np.random.default_rng(3)
+        n, num_labels = 64, 3
+        scores, target = _random_multilabel(rng, n, num_labels)
+        scores = np.round(scores * 8) / 8  # exercise tie groups
+        precisions, recalls, thresholds = multilabel_precision_recall_curve(
+            jnp.asarray(scores), jnp.asarray(target), num_labels=num_labels
+        )
+        for k in range(num_labels):
+            p, r, t = precision_recall_curve(target[:, k], scores[:, k])
+            np.testing.assert_allclose(np.asarray(precisions[k]), p, rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(recalls[k]), r, rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(thresholds[k]), t, rtol=1e-5)
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(4)
+        scores, target = _random_multilabel(rng, 80, 4)
+        m = MultilabelPrecisionRecallCurve(num_labels=4)
+        for c_s, c_t in zip(np.split(scores, 4), np.split(target, 4)):
+            m.update(jnp.asarray(c_s), jnp.asarray(c_t))
+        precisions, recalls, thresholds = m.compute()
+        self.assertEqual(len(precisions), 4)
+        for k in range(4):
+            p, r, t = precision_recall_curve(target[:, k], scores[:, k])
+            np.testing.assert_allclose(np.asarray(precisions[k]), p, rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(recalls[k]), r, rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(thresholds[k]), t, rtol=1e-5)
+
+        a = MultilabelPrecisionRecallCurve(num_labels=4)
+        b = MultilabelPrecisionRecallCurve(num_labels=4)
+        a.update(jnp.asarray(scores[:40]), jnp.asarray(target[:40]))
+        b.update(jnp.asarray(scores[40:]), jnp.asarray(target[40:]))
+        a.merge_state([b])
+        merged_p, _, _ = a.compute()
+        for k in range(4):
+            np.testing.assert_allclose(
+                np.asarray(merged_p[k]), np.asarray(precisions[k]), rtol=1e-5
+            )
+        self.assertEqual(MultilabelPrecisionRecallCurve(num_labels=4).compute(), ([], [], []))
+
+    def test_input_checks(self):
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            multilabel_precision_recall_curve(
+                jnp.zeros((4, 3)), jnp.zeros((4, 2)), num_labels=3
+            )
+        with self.assertRaisesRegex(ValueError, "num_sample, num_labels"):
+            multilabel_precision_recall_curve(
+                jnp.zeros((4, 2)), jnp.zeros((4, 2)), num_labels=3
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

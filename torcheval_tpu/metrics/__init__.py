@@ -24,6 +24,8 @@ from torcheval_tpu.metrics.classification import (
     MulticlassPrecision,
     MulticlassRecall,
     MultilabelAccuracy,
+    MultilabelAUPRC,
+    MultilabelPrecisionRecallCurve,
     TopKMultilabelAccuracy,
 )
 from torcheval_tpu.metrics.collection import MetricCollection
@@ -66,6 +68,8 @@ __all__ = [
     "MulticlassPrecision",
     "MulticlassRecall",
     "MultilabelAccuracy",
+    "MultilabelAUPRC",
+    "MultilabelPrecisionRecallCurve",
     "R2Score",
     "Sum",
     "Throughput",

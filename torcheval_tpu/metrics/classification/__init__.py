@@ -1,6 +1,7 @@
 from torcheval_tpu.metrics.classification.auprc import (
     BinaryAUPRC,
     MulticlassAUPRC,
+    MultilabelAUPRC,
 )
 from torcheval_tpu.metrics.classification.auroc import (
     BinaryAUROC,
@@ -9,6 +10,7 @@ from torcheval_tpu.metrics.classification.auroc import (
 from torcheval_tpu.metrics.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
 )
 from torcheval_tpu.metrics.classification.accuracy import (
     BinaryAccuracy,
@@ -61,5 +63,7 @@ __all__ = [
     "MulticlassPrecisionRecallCurve",
     "MulticlassRecall",
     "MultilabelAccuracy",
+    "MultilabelAUPRC",
+    "MultilabelPrecisionRecallCurve",
     "TopKMultilabelAccuracy",
 ]

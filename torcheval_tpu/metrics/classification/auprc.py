@@ -14,6 +14,9 @@ from torcheval_tpu.metrics.functional.classification.auprc import (
     _binary_auprc_compute_kernel,
     _multiclass_auprc_compute_kernel,
     _multiclass_auprc_param_check,
+    _multilabel_auprc_compute_kernel,
+    _multilabel_auprc_param_check,
+    _multilabel_auprc_update_input_check,
 )
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_update_input_check,
@@ -47,9 +50,11 @@ class BinaryAUPRC(Metric[jax.Array]):
         """Average precision per task; empty array before any update."""
         if not self.inputs:
             return jnp.zeros(0)
+        input = jnp.concatenate(self.inputs, axis=-1)
+        if input.shape[-1] == 0:  # only zero-length updates buffered
+            return jnp.zeros(input.shape[:-1])
         return _binary_auprc_compute_kernel(
-            jnp.concatenate(self.inputs, axis=-1),
-            jnp.concatenate(self.targets, axis=-1),
+            input, jnp.concatenate(self.targets, axis=-1)
         )
 
     def merge_state(self, metrics: Iterable["BinaryAUPRC"]) -> "BinaryAUPRC":
@@ -89,14 +94,73 @@ class MulticlassAUPRC(Metric[jax.Array]):
         update."""
         if not self.inputs:
             return jnp.zeros(0)
+        input = jnp.concatenate(self.inputs, axis=0)
+        if input.shape[0] == 0:  # only zero-length updates buffered
+            return (
+                jnp.zeros(())
+                if self.average == "macro"
+                else jnp.zeros(self.num_classes)
+            )
         return _multiclass_auprc_compute_kernel(
-            jnp.concatenate(self.inputs, axis=0),
+            input,
             jnp.concatenate(self.targets, axis=0),
             self.num_classes,
             self.average,
         )
 
     def merge_state(self, metrics: Iterable["MulticlassAUPRC"]) -> "MulticlassAUPRC":
+        merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "inputs", "targets", dim=0)
+
+
+class MultilabelAUPRC(Metric[jax.Array]):
+    """Per-label average precision over a 0/1 label matrix, macro/None
+    averaging.  Beyond the v0.0.4 snapshot (upstream torcheval added
+    ``MultilabelAUPRC`` later)."""
+
+    def __init__(
+        self,
+        *,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _multilabel_auprc_param_check(num_labels, average)
+        self.num_labels = num_labels
+        self.average = average
+        self._add_state("inputs", [])
+        self._add_state("targets", [])
+
+    def update(self, input, target) -> "MultilabelAUPRC":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _multilabel_auprc_update_input_check(input, target, self.num_labels)
+        self.inputs.append(jax.device_put(input, self.device))
+        self.targets.append(jax.device_put(target, self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        """Macro or per-label average precision; empty array before any
+        update."""
+        if not self.inputs:
+            return jnp.zeros(0)
+        input = jnp.concatenate(self.inputs, axis=0)
+        if input.shape[0] == 0:  # only zero-length updates buffered
+            return (
+                jnp.zeros(())
+                if self.average == "macro"
+                else jnp.zeros(self.num_labels)
+            )
+        return _multilabel_auprc_compute_kernel(
+            input,
+            jnp.concatenate(self.targets, axis=0),
+            self.average,
+        )
+
+    def merge_state(self, metrics: Iterable["MultilabelAUPRC"]) -> "MultilabelAUPRC":
         merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
         return self
 

@@ -15,6 +15,8 @@ from torcheval_tpu.metrics.functional.classification.precision_recall_curve impo
     _binary_precision_recall_curve_update_input_check,
     _multiclass_precision_recall_curve_compute,
     _multiclass_precision_recall_curve_update_input_check,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_update_input_check,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -81,6 +83,49 @@ class MulticlassPrecisionRecallCurve(
     def merge_state(
         self, metrics: Iterable["MulticlassPrecisionRecallCurve"]
     ) -> "MulticlassPrecisionRecallCurve":
+        merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "inputs", "targets", dim=0)
+
+
+class MultilabelPrecisionRecallCurve(
+    Metric[Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]]
+):
+    """Per-label PR curves over a 0/1 label matrix.  Beyond the v0.0.4
+    snapshot (upstream torcheval added ``MultilabelPrecisionRecallCurve``
+    later)."""
+
+    def __init__(self, *, num_labels: Optional[int] = None, device=None) -> None:
+        super().__init__(device=device)
+        self.num_labels = num_labels
+        self._add_state("inputs", [])
+        self._add_state("targets", [])
+
+    def update(self, input, target) -> "MultilabelPrecisionRecallCurve":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _multilabel_precision_recall_curve_update_input_check(
+            input, target, self.num_labels
+        )
+        self.inputs.append(jax.device_put(input, self.device))
+        self.targets.append(jax.device_put(target, self.device))
+        return self
+
+    def compute(
+        self,
+    ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+        if not self.inputs:
+            return ([], [], [])
+        return _multilabel_precision_recall_curve_compute(
+            jnp.concatenate(self.inputs, axis=0),
+            jnp.concatenate(self.targets, axis=0),
+            self.num_labels,
+        )
+
+    def merge_state(
+        self, metrics: Iterable["MultilabelPrecisionRecallCurve"]
+    ) -> "MultilabelPrecisionRecallCurve":
         merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
         return self
 

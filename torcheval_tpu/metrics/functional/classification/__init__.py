@@ -1,6 +1,7 @@
 from torcheval_tpu.metrics.functional.classification.auprc import (
     binary_auprc,
     multiclass_auprc,
+    multilabel_auprc,
 )
 from torcheval_tpu.metrics.functional.classification.auroc import (
     binary_auroc,
@@ -9,6 +10,7 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
 from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
     binary_precision_recall_curve,
     multiclass_precision_recall_curve,
+    multilabel_precision_recall_curve,
 )
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     binary_accuracy,
@@ -61,5 +63,7 @@ __all__ = [
     "multiclass_precision_recall_curve",
     "multiclass_recall",
     "multilabel_accuracy",
+    "multilabel_auprc",
+    "multilabel_precision_recall_curve",
     "topk_multilabel_accuracy",
 ]

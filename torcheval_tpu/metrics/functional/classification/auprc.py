@@ -28,6 +28,9 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _group_end_values,
     _multiclass_auroc_update_input_check,
 )
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _multilabel_precision_recall_curve_update_input_check as _multilabel_auprc_update_input_check,  # noqa: E501  (same shape contract)
+)
 
 
 def binary_auprc(
@@ -63,6 +66,48 @@ def multiclass_auprc(
     if input.shape[0] == 0:
         return jnp.zeros(()) if average == "macro" else jnp.zeros(num_classes)
     return _multiclass_auprc_compute_kernel(input, target, num_classes, average)
+
+
+def multilabel_auprc(
+    input,
+    target,
+    *,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+) -> jax.Array:
+    """Per-label average precision over a ``(n, num_labels)`` 0/1 target
+    matrix, macro-averaged by default.  Beyond the v0.0.4 snapshot
+    (upstream torcheval added ``multilabel_auprc`` later); each label
+    column is an independent binary AP through the shared tie-scan core."""
+    _multilabel_auprc_param_check(num_labels, average)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    if num_labels is None:
+        num_labels = input.shape[1] if input.ndim == 2 else None
+    _multilabel_auprc_update_input_check(input, target, num_labels)
+    if input.shape[0] == 0:
+        return jnp.zeros(()) if average == "macro" else jnp.zeros(num_labels)
+    return _multilabel_auprc_compute_kernel(input, target, average)
+
+
+@partial(jax.jit, static_argnames=("average",))
+def _multilabel_auprc_compute_kernel(
+    input: jax.Array, target: jax.Array, average: Optional[str]
+) -> jax.Array:
+    ap = _auprc_rows(input.T, (target == 1).T)
+    return ap.mean() if average == "macro" else ap
+
+
+def _multilabel_auprc_param_check(
+    num_labels: Optional[int], average: Optional[str]
+) -> None:
+    average_options = ("macro", "none", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, "
+            f"got {average}."
+        )
+    if num_labels is not None and num_labels < 2:
+        raise ValueError("`num_labels` has to be at least 2.")
 
 
 @jax.jit

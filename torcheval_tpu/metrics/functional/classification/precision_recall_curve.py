@@ -117,14 +117,28 @@ def _multiclass_precision_recall_curve_compute(
 ) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
     if num_classes is None:
         num_classes = input.shape[1]
+    return _materialize_row_curves(
+        _prc_multiclass_device_kernel, input, target, num_classes
+    )
+
+
+def _materialize_row_curves(
+    device_kernel,
+    input: jax.Array,
+    target: jax.Array,
+    num_rows: int,
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    """Shared ragged materialization for per-row (class/label) curve
+    families: run the fixed-shape device kernel once, then compact each
+    row's tie-group mask on the host."""
     if input.shape[0] == 0:
-        curves = [_empty_curve() for _ in range(num_classes)]
+        curves = [_empty_curve() for _ in range(num_rows)]
         return tuple(list(xs) for xs in zip(*curves))
     thresholds, is_last, num_tp, num_fp = jax.device_get(
-        _prc_multiclass_device_kernel(input, target)
+        device_kernel(input, target)
     )
     precisions, recalls, thresh_list = [], [], []
-    for c in range(num_classes):
+    for c in range(num_rows):
         mask = is_last[c]
         p, r, t = _materialize_curve(
             num_tp[c][mask], num_fp[c][mask], thresholds[c][mask]
@@ -133,6 +147,44 @@ def _multiclass_precision_recall_curve_compute(
         recalls.append(r)
         thresh_list.append(t)
     return precisions, recalls, thresh_list
+
+
+def multilabel_precision_recall_curve(
+    input,
+    target,
+    *,
+    num_labels: Optional[int] = None,
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    """Per-label PR curves over a ``(n_samples, num_labels)`` 0/1 target
+    matrix.  Beyond the v0.0.4 snapshot (upstream torcheval added
+    ``multilabel_precision_recall_curve`` later); each label column is an
+    independent binary curve, vectorized through the same ``(R, N)``
+    sort+tie-scan device kernel as the multiclass form."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    if num_labels is None and input.ndim == 2:
+        num_labels = input.shape[1]
+    _multilabel_precision_recall_curve_update_input_check(input, target, num_labels)
+    return _multilabel_precision_recall_curve_compute(input, target, num_labels)
+
+
+@jax.jit
+def _prc_multilabel_device_kernel(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fixed-shape part, vectorized over labels: (L, N) sorts + cumsums."""
+    return sorted_tie_cumsums(input.T, (target == 1).T)
+
+
+def _multilabel_precision_recall_curve_compute(
+    input: jax.Array,
+    target: jax.Array,
+    num_labels: Optional[int],
+) -> Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]:
+    if num_labels is None:
+        num_labels = input.shape[1]
+    return _materialize_row_curves(
+        _prc_multilabel_device_kernel, input, target, num_labels
+    )
 
 
 def _binary_precision_recall_curve_update_input_check(
@@ -169,4 +221,19 @@ def _multiclass_precision_recall_curve_update_input_check(
         raise ValueError(
             "input should have shape of (num_sample, num_classes), "
             f"got {input.shape} and num_classes={num_classes}."
+        )
+
+
+def _multilabel_precision_recall_curve_update_input_check(
+    input: jax.Array, target: jax.Array, num_labels: Optional[int]
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "Expected both input.shape and target.shape to have the same shape"
+            f" but got {input.shape} and {target.shape}."
+        )
+    if not (input.ndim == 2 and (num_labels is None or input.shape[1] == num_labels)):
+        raise ValueError(
+            "input should have shape of (num_sample, num_labels), "
+            f"got {input.shape} and num_labels={num_labels}."
         )
