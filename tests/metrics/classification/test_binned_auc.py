@@ -1,0 +1,265 @@
+"""Binned AUROC/AUPRC families: exactness on grid-valued scores vs the
+sklearn oracle, convergence on off-grid scores, class lifecycle, add-merge,
+protocol, and error paths."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import average_precision_score, roc_auc_score
+
+from torcheval_tpu.metrics import (
+    BinaryBinnedAUPRC,
+    BinaryBinnedAUROC,
+    MulticlassBinnedAUPRC,
+    MulticlassBinnedAUROC,
+    MultilabelBinnedAUPRC,
+    MultilabelBinnedPrecisionRecallCurve,
+)
+from torcheval_tpu.metrics.functional import (
+    binary_binned_auprc,
+    binary_binned_auroc,
+    binary_binned_precision_recall_curve,
+    multiclass_binned_auprc,
+    multiclass_binned_auroc,
+    multilabel_binned_auprc,
+    multilabel_binned_precision_recall_curve,
+)
+
+GRID = np.linspace(0, 1, 21).astype(np.float32)
+
+
+def _grid_scores(rng, shape):
+    """Scores drawn exactly from the threshold grid: binned == exact."""
+    return rng.choice(GRID, shape).astype(np.float32)
+
+
+class TestBinaryBinned(unittest.TestCase):
+    def test_auroc_exact_on_grid(self):
+        rng = np.random.default_rng(0)
+        s = _grid_scores(rng, 500)
+        t = (rng.random(500) > 0.45).astype(np.float32)
+        got, th = binary_binned_auroc(
+            jnp.asarray(s), jnp.asarray(t), threshold=jnp.asarray(GRID)
+        )
+        self.assertAlmostEqual(float(got), roc_auc_score(t, s), places=5)
+        np.testing.assert_allclose(np.asarray(th), GRID)
+
+    def test_auprc_exact_on_grid(self):
+        rng = np.random.default_rng(1)
+        s = _grid_scores(rng, 400)
+        t = (rng.random(400) > 0.5).astype(np.float32)
+        got, _ = binary_binned_auprc(
+            jnp.asarray(s), jnp.asarray(t), threshold=jnp.asarray(GRID)
+        )
+        self.assertAlmostEqual(float(got), average_precision_score(t, s), places=5)
+
+    def test_off_grid_converges(self):
+        rng = np.random.default_rng(2)
+        s = rng.random(4000).astype(np.float32)
+        t = (rng.random(4000) > 0.5).astype(np.float32)
+        got, _ = binary_binned_auroc(jnp.asarray(s), jnp.asarray(t), threshold=1000)
+        self.assertAlmostEqual(float(got), roc_auc_score(t, s), places=2)
+        got, _ = binary_binned_auprc(jnp.asarray(s), jnp.asarray(t), threshold=1000)
+        self.assertAlmostEqual(
+            float(got), average_precision_score(t, s), places=2
+        )
+
+    def test_degenerate(self):
+        auroc, _ = binary_binned_auroc(jnp.asarray([0.3, 0.7]), jnp.zeros(2))
+        self.assertEqual(float(auroc), 0.5)
+        auprc, _ = binary_binned_auprc(jnp.asarray([0.3, 0.7]), jnp.zeros(2))
+        self.assertEqual(float(auprc), 0.0)
+
+    def test_multitask(self):
+        rng = np.random.default_rng(3)
+        s = _grid_scores(rng, (3, 200))
+        t = (rng.random((3, 200)) > 0.5).astype(np.float32)
+        got, _ = binary_binned_auroc(
+            jnp.asarray(s), jnp.asarray(t), num_tasks=3, threshold=jnp.asarray(GRID)
+        )
+        want = [roc_auc_score(t[k], s[k]) for k in range(3)]
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+
+    def test_consistent_with_binned_prc_counts(self):
+        # The AUPRC's precision/recall points match the binned PR curve's.
+        rng = np.random.default_rng(4)
+        s = rng.random(300).astype(np.float32)
+        t = (rng.random(300) > 0.5).astype(np.float32)
+        p, r, th = binary_binned_precision_recall_curve(
+            jnp.asarray(s), jnp.asarray(t), threshold=jnp.asarray(GRID)
+        )
+        p, r = np.asarray(p)[:-1], np.asarray(r)[:-1]  # drop sentinel
+        ap = float(np.sum((r - np.append(r[1:], 0.0)) * p))
+        got, _ = binary_binned_auprc(
+            jnp.asarray(s), jnp.asarray(t), threshold=jnp.asarray(GRID)
+        )
+        self.assertAlmostEqual(float(got), ap, places=5)
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(5)
+        s = _grid_scores(rng, 240)
+        t = (rng.random(240) > 0.5).astype(np.float32)
+        m = BinaryBinnedAUROC(threshold=jnp.asarray(GRID))
+        for cs, ct in zip(np.split(s, 4), np.split(t, 4)):
+            m.update(jnp.asarray(cs), jnp.asarray(ct))
+        auroc, _ = m.compute()
+        self.assertAlmostEqual(float(auroc), roc_auc_score(t, s), places=5)
+
+        a = BinaryBinnedAUPRC(threshold=jnp.asarray(GRID))
+        b = BinaryBinnedAUPRC(threshold=jnp.asarray(GRID))
+        a.update(jnp.asarray(s[:120]), jnp.asarray(t[:120]))
+        b.update(jnp.asarray(s[120:]), jnp.asarray(t[120:]))
+        a.merge_state([b])
+        auprc, _ = a.compute()
+        self.assertAlmostEqual(
+            float(auprc), average_precision_score(t, s), places=5
+        )
+
+    def test_param_checks(self):
+        with self.assertRaisesRegex(ValueError, "sorted"):
+            binary_binned_auroc(
+                jnp.zeros(2), jnp.zeros(2), threshold=jnp.asarray([0.5, 0.2])
+            )
+        with self.assertRaisesRegex(ValueError, "greater than and equal"):
+            BinaryBinnedAUROC(num_tasks=0)
+
+
+class TestMulticlassBinned(unittest.TestCase):
+    def test_exact_on_grid(self):
+        rng = np.random.default_rng(6)
+        c = 4
+        s = _grid_scores(rng, (300, c))
+        t = rng.integers(0, c, 300)
+        auroc, _ = multiclass_binned_auroc(
+            jnp.asarray(s), jnp.asarray(t), num_classes=c, average=None,
+            threshold=jnp.asarray(GRID),
+        )
+        want = [roc_auc_score((t == k).astype(int), s[:, k]) for k in range(c)]
+        np.testing.assert_allclose(np.asarray(auroc), want, atol=1e-5)
+        auprc, _ = multiclass_binned_auprc(
+            jnp.asarray(s), jnp.asarray(t), num_classes=c, average=None,
+            threshold=jnp.asarray(GRID),
+        )
+        want = [
+            average_precision_score((t == k).astype(int), s[:, k]) for k in range(c)
+        ]
+        np.testing.assert_allclose(np.asarray(auprc), want, atol=1e-5)
+        macro, _ = multiclass_binned_auprc(
+            jnp.asarray(s), jnp.asarray(t), num_classes=c,
+            threshold=jnp.asarray(GRID),
+        )
+        self.assertAlmostEqual(float(macro), float(np.mean(want)), places=5)
+
+    def test_class_lifecycle(self):
+        rng = np.random.default_rng(7)
+        c = 3
+        s = _grid_scores(rng, (180, c))
+        t = rng.integers(0, c, 180)
+        m = MulticlassBinnedAUROC(num_classes=c, threshold=jnp.asarray(GRID))
+        for cs, ct in zip(np.split(s, 3), np.split(t, 3)):
+            m.update(jnp.asarray(cs), jnp.asarray(ct))
+        auroc, _ = m.compute()
+        want = np.mean(
+            [roc_auc_score((t == k).astype(int), s[:, k]) for k in range(c)]
+        )
+        self.assertAlmostEqual(float(auroc), float(want), places=5)
+
+    def test_param_checks(self):
+        with self.assertRaisesRegex(ValueError, "at least 2"):
+            MulticlassBinnedAUROC(num_classes=1)
+        with self.assertRaisesRegex(ValueError, "allowed value"):
+            MulticlassBinnedAUPRC(num_classes=3, average="weighted")
+
+
+class TestMultilabelBinned(unittest.TestCase):
+    def test_exact_on_grid(self):
+        rng = np.random.default_rng(8)
+        s = _grid_scores(rng, (200, 3))
+        t = (rng.random((200, 3)) > 0.5).astype(np.float32)
+        t[0] = 1.0
+        auprc, _ = multilabel_binned_auprc(
+            jnp.asarray(s), jnp.asarray(t), num_labels=3, average=None,
+            threshold=jnp.asarray(GRID),
+        )
+        want = [average_precision_score(t[:, k], s[:, k]) for k in range(3)]
+        np.testing.assert_allclose(np.asarray(auprc), want, atol=1e-5)
+
+    def test_curve_matches_unbinned_family_shape(self):
+        rng = np.random.default_rng(9)
+        s = rng.random((100, 3)).astype(np.float32)
+        t = (rng.random((100, 3)) > 0.5).astype(np.float32)
+        P, R, T = multilabel_binned_precision_recall_curve(
+            jnp.asarray(s), jnp.asarray(t), num_labels=3,
+            threshold=jnp.asarray(GRID),
+        )
+        self.assertEqual(len(P), 3)
+        self.assertEqual(np.asarray(P[0]).shape, (len(GRID) + 1,))
+        self.assertEqual(np.asarray(T).shape, (len(GRID),))
+        # recall is non-increasing over ascending thresholds
+        for k in range(3):
+            r = np.asarray(R[k])[:-1]
+            self.assertTrue(np.all(np.diff(r) <= 1e-6))
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(10)
+        s = _grid_scores(rng, (160, 4))
+        t = (rng.random((160, 4)) > 0.5).astype(np.float32)
+        t[0] = 1.0
+        a = MultilabelBinnedAUPRC(num_labels=4, threshold=jnp.asarray(GRID))
+        b = MultilabelBinnedAUPRC(num_labels=4, threshold=jnp.asarray(GRID))
+        a.update(jnp.asarray(s[:80]), jnp.asarray(t[:80]))
+        b.update(jnp.asarray(s[80:]), jnp.asarray(t[80:]))
+        a.merge_state([b])
+        auprc, _ = a.compute()
+        want = np.mean(
+            [average_precision_score(t[:, k], s[:, k]) for k in range(4)]
+        )
+        self.assertAlmostEqual(float(auprc), float(want), places=5)
+
+        mc = MultilabelBinnedPrecisionRecallCurve(
+            num_labels=4, threshold=jnp.asarray(GRID)
+        )
+        mc.update(jnp.asarray(s), jnp.asarray(t))
+        P, R, T = mc.compute()
+        self.assertEqual(len(P), 4)
+
+    def test_class_protocol(self):
+        from torcheval_tpu.utils.test_utils.metric_class_tester import (
+            BATCH_SIZE,
+            NUM_TOTAL_UPDATES,
+            MetricClassTester,
+        )
+
+        class _T(MetricClassTester):
+            def runTest(self):  # pragma: no cover
+                pass
+
+        rng = np.random.default_rng(11)
+        num_labels = 3
+        input = _grid_scores(rng, (NUM_TOTAL_UPDATES, BATCH_SIZE, num_labels))
+        target = rng.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE, num_labels))
+        flat_s = input.reshape(-1, num_labels)
+        flat_t = target.reshape(-1, num_labels)
+        expected = np.float32(
+            np.mean(
+                [
+                    average_precision_score(flat_t[:, k], flat_s[:, k])
+                    for k in range(num_labels)
+                ]
+            )
+        )
+        _T().run_class_implementation_tests(
+            metric=MultilabelBinnedAUPRC(
+                num_labels=num_labels, threshold=jnp.asarray(GRID)
+            ),
+            state_names={"threshold", "num_tp", "num_fp", "num_pos", "num_total"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=(expected, jnp.asarray(GRID)),
+            atol=1e-5,
+            rtol=1e-4,
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

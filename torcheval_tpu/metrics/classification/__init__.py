@@ -21,6 +21,14 @@ from torcheval_tpu.metrics.classification.accuracy import (
 from torcheval_tpu.metrics.classification.binary_normalized_entropy import (
     BinaryNormalizedEntropy,
 )
+from torcheval_tpu.metrics.classification.binned_auc import (
+    BinaryBinnedAUPRC,
+    BinaryBinnedAUROC,
+    MulticlassBinnedAUPRC,
+    MulticlassBinnedAUROC,
+    MultilabelBinnedAUPRC,
+    MultilabelBinnedPrecisionRecallCurve,
+)
 from torcheval_tpu.metrics.classification.binned_precision_recall_curve import (
     BinaryBinnedPrecisionRecallCurve,
     MulticlassBinnedPrecisionRecallCurve,
@@ -46,6 +54,8 @@ __all__ = [
     "BinaryAccuracy",
     "BinaryAUPRC",
     "BinaryAUROC",
+    "BinaryBinnedAUPRC",
+    "BinaryBinnedAUROC",
     "BinaryBinnedPrecisionRecallCurve",
     "BinaryConfusionMatrix",
     "BinaryF1Score",
@@ -56,6 +66,8 @@ __all__ = [
     "MulticlassAccuracy",
     "MulticlassAUPRC",
     "MulticlassAUROC",
+    "MulticlassBinnedAUPRC",
+    "MulticlassBinnedAUROC",
     "MulticlassBinnedPrecisionRecallCurve",
     "MulticlassConfusionMatrix",
     "MulticlassF1Score",
@@ -64,6 +76,8 @@ __all__ = [
     "MulticlassRecall",
     "MultilabelAccuracy",
     "MultilabelAUPRC",
+    "MultilabelBinnedAUPRC",
+    "MultilabelBinnedPrecisionRecallCurve",
     "MultilabelPrecisionRecallCurve",
     "TopKMultilabelAccuracy",
 ]

@@ -1,0 +1,261 @@
+"""Binned AUROC / AUPRC class metrics — fixed-threshold counter states.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added the binned AUC
+classes later).  Unlike the exact AUROC/AUPRC classes (unbounded sample
+buffers, concat merge), these keep O(rows × thresholds) count states —
+add-mergeable, ``psum``-syncable, constant memory over the stream.
+
+Every class shares one state machine (``_BinnedCountsBase``); the
+binary/multiclass/multilabel input flavors each specialize it once, and
+the AUROC/AUPRC twins differ only in their ``_score_fn``."""
+
+from typing import Iterable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_update_input_check,
+    _multiclass_auroc_update_input_check,
+)
+from torcheval_tpu.metrics.functional.classification.binned_auc import (
+    _binned_auc_average_param_check,
+    _binned_auprc_from_counts,
+    _binned_auroc_from_counts,
+    _binned_counts_rows,
+    _binned_curves_from_counts,
+    _multiclass_binned_counts_kernel,
+    _multilabel_binned_counts_kernel,
+)
+from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+    _binned_precision_recall_curve_param_check,
+    _create_threshold_tensor,
+)
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _multilabel_precision_recall_curve_update_input_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+_COUNTS = ("num_tp", "num_fp", "num_pos", "num_total")
+
+
+@jax.jit
+def _binary_binned_counts_kernel(
+    input: jax.Array, target: jax.Array, threshold: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    if input.ndim == 1:
+        input, target = input[None], target[None]
+    return _binned_counts_rows(input, target == 1, threshold)
+
+
+class _BinnedCountsBase(Metric):
+    """Shared state machine: ``threshold`` + the four add-mergeable count
+    arrays over (rows, thresholds).  ``_score_fn`` (set per concrete
+    class) maps the counts to the per-row AUROC/AUPRC scores."""
+
+    _score_fn = None
+
+    def __init__(self, num_rows: int, threshold, device=None) -> None:
+        super().__init__(device=device)
+        threshold = _create_threshold_tensor(threshold)
+        _binned_precision_recall_curve_param_check(threshold)
+        self._add_state("threshold", threshold)
+        num_t = threshold.shape[0]
+        self._add_state("num_tp", jnp.zeros((num_rows, num_t), jnp.int32))
+        self._add_state("num_fp", jnp.zeros((num_rows, num_t), jnp.int32))
+        self._add_state("num_pos", jnp.zeros(num_rows, jnp.int32))
+        self._add_state("num_total", jnp.zeros(num_rows, jnp.int32))
+
+    def _accumulate(self, kernel, input, target, statics=()) -> None:
+        # Kernel + all four state adds fused into one dispatch (_fuse.py).
+        self.num_tp, self.num_fp, self.num_pos, self.num_total = accumulate(
+            kernel,
+            (self.num_tp, self.num_fp, self.num_pos, self.num_total),
+            input,
+            target,
+            self.threshold,
+            statics=statics,
+        )
+
+    def _row_scores(self) -> jax.Array:
+        return type(self)._score_fn(
+            self.num_tp, self.num_fp, self.num_pos, self.num_total
+        )
+
+    def merge_state(self, metrics: Iterable["_BinnedCountsBase"]):
+        merge_add(self, metrics, *_COUNTS)
+        return self
+
+
+class _BinaryBinnedAUC(_BinnedCountsBase):
+    """Binary flavor: rows = tasks; compute returns ``(score, thresholds)``
+    with the scalar squeezed for ``num_tasks == 1``."""
+
+    def __init__(self, num_tasks: int, threshold, device=None) -> None:
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.num_tasks = num_tasks
+        super().__init__(num_tasks, threshold, device)
+
+    def update(self, input, target):
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _binary_auroc_update_input_check(input, target, self.num_tasks)
+        self._accumulate(_binary_binned_counts_kernel, input, target)
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        score = self._row_scores()
+        return (score[0] if self.num_tasks == 1 else score), self.threshold
+
+
+class _MulticlassBinnedAUC(_BinnedCountsBase):
+    """Multiclass flavor: rows = one-vs-rest classes, macro/None average."""
+
+    def __init__(
+        self, num_classes: int, average: Optional[str], threshold, device=None
+    ) -> None:
+        _binned_auc_average_param_check(num_classes, average, "num_classes")
+        self.num_classes = num_classes
+        self.average = average
+        super().__init__(num_classes, threshold, device)
+
+    def update(self, input, target):
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _multiclass_auroc_update_input_check(input, target, self.num_classes)
+        self._accumulate(
+            _multiclass_binned_counts_kernel, input, target,
+            statics=(self.num_classes,),
+        )
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        score = self._row_scores()
+        return (score.mean() if self.average == "macro" else score), self.threshold
+
+
+class _MultilabelBinned(_BinnedCountsBase):
+    """Multilabel flavor: rows = label columns of a 0/1 target matrix."""
+
+    def __init__(self, num_labels: int, threshold, device=None) -> None:
+        if num_labels < 2:
+            raise ValueError("`num_labels` has to be at least 2.")
+        self.num_labels = num_labels
+        super().__init__(num_labels, threshold, device)
+
+    def update(self, input, target):
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _multilabel_precision_recall_curve_update_input_check(
+            input, target, self.num_labels
+        )
+        self._accumulate(_multilabel_binned_counts_kernel, input, target)
+        return self
+
+
+class BinaryBinnedAUROC(_BinaryBinnedAUC):
+    """Binned AUROC with multi-task support; compute returns
+    ``(auroc, thresholds)``."""
+
+    _score_fn = staticmethod(_binned_auroc_from_counts)
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        threshold: Union[int, List[float], "jax.Array"] = 200,
+        device=None,
+    ) -> None:
+        super().__init__(num_tasks, threshold, device)
+
+
+class BinaryBinnedAUPRC(_BinaryBinnedAUC):
+    """Binned average precision with multi-task support; compute returns
+    ``(auprc, thresholds)``."""
+
+    _score_fn = staticmethod(_binned_auprc_from_counts)
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        threshold: Union[int, List[float], "jax.Array"] = 100,
+        device=None,
+    ) -> None:
+        super().__init__(num_tasks, threshold, device)
+
+
+class MulticlassBinnedAUROC(_MulticlassBinnedAUC):
+    """One-vs-rest binned AUROC with macro/None averaging."""
+
+    _score_fn = staticmethod(_binned_auroc_from_counts)
+
+    def __init__(
+        self,
+        *,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        threshold: Union[int, List[float], "jax.Array"] = 200,
+        device=None,
+    ) -> None:
+        super().__init__(num_classes, average, threshold, device)
+
+
+class MulticlassBinnedAUPRC(_MulticlassBinnedAUC):
+    """One-vs-rest binned average precision with macro/None averaging."""
+
+    _score_fn = staticmethod(_binned_auprc_from_counts)
+
+    def __init__(
+        self,
+        *,
+        num_classes: int,
+        average: Optional[str] = "macro",
+        threshold: Union[int, List[float], "jax.Array"] = 100,
+        device=None,
+    ) -> None:
+        super().__init__(num_classes, average, threshold, device)
+
+
+class MultilabelBinnedAUPRC(_MultilabelBinned):
+    """Per-label binned average precision with macro/None averaging."""
+
+    _score_fn = staticmethod(_binned_auprc_from_counts)
+
+    def __init__(
+        self,
+        *,
+        num_labels: int,
+        average: Optional[str] = "macro",
+        threshold: Union[int, List[float], "jax.Array"] = 100,
+        device=None,
+    ) -> None:
+        _binned_auc_average_param_check(num_labels, average, "num_labels")
+        self.average = average
+        super().__init__(num_labels, threshold, device)
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        score = self._row_scores()
+        return (score.mean() if self.average == "macro" else score), self.threshold
+
+
+class MultilabelBinnedPrecisionRecallCurve(_MultilabelBinned):
+    """Per-label binned PR curves; compute returns
+    ``(precisions, recalls, thresholds)`` with per-label lists."""
+
+    def __init__(
+        self,
+        *,
+        num_labels: int,
+        threshold: Union[int, List[float], "jax.Array"] = 100,
+        device=None,
+    ) -> None:
+        super().__init__(num_labels, threshold, device)
+
+    def compute(self) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+        return _binned_curves_from_counts(
+            self.num_tp, self.num_fp, self.num_pos, self.threshold
+        )

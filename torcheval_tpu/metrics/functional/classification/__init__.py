@@ -21,6 +21,14 @@ from torcheval_tpu.metrics.functional.classification.accuracy import (
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     binary_normalized_entropy,
 )
+from torcheval_tpu.metrics.functional.classification.binned_auc import (
+    binary_binned_auprc,
+    binary_binned_auroc,
+    multiclass_binned_auprc,
+    multiclass_binned_auroc,
+    multilabel_binned_auprc,
+    multilabel_binned_precision_recall_curve,
+)
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     binary_binned_precision_recall_curve,
     multiclass_binned_precision_recall_curve,
@@ -46,6 +54,8 @@ __all__ = [
     "binary_accuracy",
     "binary_auprc",
     "binary_auroc",
+    "binary_binned_auprc",
+    "binary_binned_auroc",
     "binary_binned_precision_recall_curve",
     "binary_confusion_matrix",
     "binary_f1_score",
@@ -56,6 +66,8 @@ __all__ = [
     "multiclass_accuracy",
     "multiclass_auprc",
     "multiclass_auroc",
+    "multiclass_binned_auprc",
+    "multiclass_binned_auroc",
     "multiclass_binned_precision_recall_curve",
     "multiclass_confusion_matrix",
     "multiclass_f1_score",
@@ -64,6 +76,8 @@ __all__ = [
     "multiclass_recall",
     "multilabel_accuracy",
     "multilabel_auprc",
+    "multilabel_binned_auprc",
+    "multilabel_binned_precision_recall_curve",
     "multilabel_precision_recall_curve",
     "topk_multilabel_accuracy",
 ]

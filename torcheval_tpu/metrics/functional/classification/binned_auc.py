@@ -1,0 +1,272 @@
+"""Binned AUROC / AUPRC — fixed-threshold areas under ROC and PR curves.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added the binned AUC
+families later).  Same counter-state design as the binned PR curves
+(reference ``binned_precision_recall_curve.py``): per-threshold TP/FP
+counts are the sufficient statistics — fully fixed-shape, mergeable by
+addition, syncable by ``psum`` — so the unbounded sample buffers of the
+exact AUROC/AUPRC metrics are traded for an O(T) state.
+
+The shared update kernel histograms each score into its threshold bin
+(``searchsorted`` + scatter-add) and reverse-cumsums — O(N log T) work and
+O(R·T) memory, versus the O(R·T·N) broadcast-compare a direct translation
+of the reference's binned update would cost on a ``(1000, 200, N)``
+boolean tensor.
+"""
+
+from functools import partial
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification._sort_scan import class_hits
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _binary_auroc_update_input_check,
+    _multiclass_auroc_update_input_check,
+)
+from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+    _binned_precision_recall_curve_param_check,
+    _create_threshold_tensor,
+    _multiclass_binned_compute_kernel,
+)
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _multilabel_precision_recall_curve_update_input_check,
+)
+
+
+def binary_binned_auroc(
+    input,
+    target,
+    *,
+    num_tasks: int = 1,
+    threshold: Union[int, List[float], "jax.Array"] = 200,
+) -> Tuple[jax.Array, jax.Array]:
+    """(auroc, thresholds) at fixed thresholds; multi-task via a
+    ``(num_tasks, n)`` leading dim.  Degenerate rows (no positives or no
+    negatives) yield 0.5, matching the exact ``binary_auroc``."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    threshold = _create_threshold_tensor(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
+    _binary_auroc_update_input_check(input, target, num_tasks)
+    squeeze = input.ndim == 1
+    if squeeze:
+        input, target = input[None], target[None]
+    auroc = _binned_auroc_from_counts(
+        *_binned_counts_rows(input, target == 1, threshold)
+    )
+    return (auroc[0] if squeeze else auroc), threshold
+
+
+def multiclass_binned_auroc(
+    input,
+    target,
+    *,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    threshold: Union[int, List[float], "jax.Array"] = 200,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-vs-rest binned AUROC with macro/None averaging."""
+    _binned_auc_average_param_check(num_classes, average, "num_classes")
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    threshold = _create_threshold_tensor(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
+    _multiclass_auroc_update_input_check(input, target, num_classes)
+    auroc = _binned_auroc_from_counts(
+        *_multiclass_binned_counts_kernel(input, target, threshold, num_classes)
+    )
+    return (auroc.mean() if average == "macro" else auroc), threshold
+
+
+def binary_binned_auprc(
+    input,
+    target,
+    *,
+    num_tasks: int = 1,
+    threshold: Union[int, List[float], "jax.Array"] = 100,
+) -> Tuple[jax.Array, jax.Array]:
+    """(average precision, thresholds) at fixed thresholds; multi-task via
+    a ``(num_tasks, n)`` leading dim.  Rows with no positives yield 0,
+    matching the exact ``binary_auprc``."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    threshold = _create_threshold_tensor(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
+    _binary_auroc_update_input_check(input, target, num_tasks)
+    squeeze = input.ndim == 1
+    if squeeze:
+        input, target = input[None], target[None]
+    auprc = _binned_auprc_from_counts(
+        *_binned_counts_rows(input, target == 1, threshold)
+    )
+    return (auprc[0] if squeeze else auprc), threshold
+
+
+def multiclass_binned_auprc(
+    input,
+    target,
+    *,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    threshold: Union[int, List[float], "jax.Array"] = 100,
+) -> Tuple[jax.Array, jax.Array]:
+    """One-vs-rest binned average precision with macro/None averaging."""
+    _binned_auc_average_param_check(num_classes, average, "num_classes")
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    threshold = _create_threshold_tensor(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
+    _multiclass_auroc_update_input_check(input, target, num_classes)
+    auprc = _binned_auprc_from_counts(
+        *_multiclass_binned_counts_kernel(input, target, threshold, num_classes)
+    )
+    return (auprc.mean() if average == "macro" else auprc), threshold
+
+
+def multilabel_binned_auprc(
+    input,
+    target,
+    *,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    threshold: Union[int, List[float], "jax.Array"] = 100,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-label binned average precision over a ``(n, num_labels)`` 0/1
+    target matrix with macro/None averaging."""
+    _binned_auc_average_param_check(num_labels, average, "num_labels")
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    threshold = _create_threshold_tensor(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
+    _multilabel_precision_recall_curve_update_input_check(input, target, num_labels)
+    auprc = _binned_auprc_from_counts(
+        *_multilabel_binned_counts_kernel(input, target, threshold)
+    )
+    return (auprc.mean() if average == "macro" else auprc), threshold
+
+
+def multilabel_binned_precision_recall_curve(
+    input,
+    target,
+    *,
+    num_labels: Optional[int] = None,
+    threshold: Union[int, List[float], "jax.Array"] = 100,
+) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+    """Per-label binned PR curves over a ``(n, num_labels)`` 0/1 target
+    matrix (list of per-label precision/recall vectors with the (1.0, 0.0)
+    sentinel point, plus the shared thresholds)."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    threshold = _create_threshold_tensor(threshold)
+    _binned_precision_recall_curve_param_check(threshold)
+    _multilabel_precision_recall_curve_update_input_check(input, target, num_labels)
+    tp, fp, pos, _ = _multilabel_binned_counts_kernel(input, target, threshold)
+    return _binned_curves_from_counts(tp, fp, pos, threshold)
+
+
+def _binned_curves_from_counts(
+    tp: jax.Array, fp: jax.Array, pos: jax.Array, threshold: jax.Array
+) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
+    """Row-count layout (R, T) → the reference's (T, R) binned-curve
+    compute, reusing its sentinel/NaN semantics."""
+    fn = pos[:, None] - tp
+    precision, recall = _multiclass_binned_compute_kernel(tp.T, fp.T, fn.T)
+    return list(precision.T), list(recall.T), threshold
+
+
+@jax.jit
+def _binned_counts_rows(
+    scores: jax.Array, hits: jax.Array, thresholds: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-threshold prediction counts for ``pred = score >= t`` over
+    ``(R, N)`` score/hit rows.
+
+    Histogram each score into the last threshold bin it clears, then a
+    reverse cumsum turns bin counts into >=-threshold counts.  Returns
+    ``(num_tp (R,T), num_fp (R,T), num_pos (R,), num_total (R,))`` — the
+    add-mergeable sufficient statistics of every binned AUC metric."""
+    num_rows, n = scores.shape
+    num_t = thresholds.shape[0]
+    bin_idx = jnp.searchsorted(thresholds, scores, side="right") - 1
+    valid = bin_idx >= 0  # scores below thresholds[0] clear no threshold
+    flat = (jnp.arange(num_rows)[:, None] * num_t + jnp.clip(bin_idx, 0)).reshape(-1)
+    ones = valid.reshape(-1).astype(jnp.int32)
+    hit1 = (hits & valid).reshape(-1).astype(jnp.int32)
+    hist_all = jnp.zeros(num_rows * num_t, jnp.int32).at[flat].add(ones)
+    hist_tp = jnp.zeros(num_rows * num_t, jnp.int32).at[flat].add(hit1)
+    cum_all = jnp.cumsum(hist_all.reshape(num_rows, num_t)[:, ::-1], -1)[:, ::-1]
+    num_tp = jnp.cumsum(hist_tp.reshape(num_rows, num_t)[:, ::-1], -1)[:, ::-1]
+    num_fp = cum_all - num_tp
+    num_pos = hits.sum(-1).astype(jnp.int32)
+    num_total = jnp.full((num_rows,), n, jnp.int32)
+    return num_tp, num_fp, num_pos, num_total
+
+
+@partial(jax.jit, static_argnames=("num_classes",))
+def _multiclass_binned_counts_kernel(
+    input: jax.Array, target: jax.Array, threshold: jax.Array, num_classes: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    return _binned_counts_rows(input.T, class_hits(target, num_classes), threshold)
+
+
+@jax.jit
+def _multilabel_binned_counts_kernel(
+    input: jax.Array, target: jax.Array, threshold: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    return _binned_counts_rows(input.T, (target == 1).T, threshold)
+
+
+@jax.jit
+def _binned_auroc_from_counts(
+    num_tp: jax.Array,
+    num_fp: jax.Array,
+    num_pos: jax.Array,
+    num_total: jax.Array,
+) -> jax.Array:
+    """Trapezoidal area under the binned ROC polyline.
+
+    Thresholds ascend, so (FPR, TPR) descends toward the appended (0, 0)
+    anchor; with thresholds starting at 0 and scores in [0, 1] the first
+    point is (1, 1).  Degenerate rows (single class present) → 0.5."""
+    num_rows = num_tp.shape[0]
+    pos = num_pos.astype(jnp.float32)
+    neg = (num_total - num_pos).astype(jnp.float32)
+    tpr = num_tp / jnp.maximum(pos, 1.0)[:, None]
+    fpr = num_fp / jnp.maximum(neg, 1.0)[:, None]
+    zero = jnp.zeros((num_rows, 1))
+    tpr = jnp.concatenate([tpr, zero], axis=-1)[:, ::-1]
+    fpr = jnp.concatenate([fpr, zero], axis=-1)[:, ::-1]
+    auroc = jnp.trapezoid(tpr, fpr, axis=-1)
+    return jnp.where((num_pos == 0) | (num_pos == num_total), 0.5, auroc)
+
+
+@jax.jit
+def _binned_auprc_from_counts(
+    num_tp: jax.Array,
+    num_fp: jax.Array,
+    num_pos: jax.Array,
+    num_total: jax.Array,
+) -> jax.Array:
+    """Step-sum average precision over the binned PR points: with
+    thresholds ascending (recall non-increasing),
+    AP = Σ_t (R_t − R_{t+1}) · P_t with R fading to 0 past the last
+    threshold — the same pairing as sklearn's step rule.  Rows with no
+    positives → 0 (matching the exact AUPRC)."""
+    del num_total
+    pos = jnp.maximum(num_pos.astype(jnp.float32), 1.0)[:, None]
+    precision = jnp.nan_to_num(num_tp / (num_tp + num_fp), nan=1.0)
+    recall = num_tp / pos
+    recall_next = jnp.concatenate(
+        [recall[:, 1:], jnp.zeros((recall.shape[0], 1))], axis=-1
+    )
+    ap = ((recall - recall_next) * precision).sum(axis=-1)
+    return jnp.where(num_pos == 0, 0.0, ap)
+
+
+def _binned_auc_average_param_check(
+    num_rows: Optional[int], average: Optional[str], name: str
+) -> None:
+    average_options = ("macro", "none", None)
+    if average not in average_options:
+        raise ValueError(
+            f"`average` was not in the allowed value of {average_options}, "
+            f"got {average}."
+        )
+    if num_rows is not None and num_rows < 2:
+        raise ValueError(f"`{name}` has to be at least 2.")
