@@ -1,0 +1,151 @@
+"""Recall-at-fixed-precision vs a numpy selection over the sklearn PR
+curve — functional and class, feasibility sentinel, merge, protocol."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import precision_recall_curve
+
+from torcheval_tpu.metrics import (
+    BinaryRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+)
+from torcheval_tpu.metrics.functional import (
+    binary_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+)
+
+
+def _oracle(scores, target, min_precision):
+    p, r, t = precision_recall_curve(target, scores)
+    p, r = p[:-1], r[:-1]  # drop the sentinel point (no threshold)
+    ok = p >= min_precision
+    if not ok.any() or r[ok].max() == 0.0:
+        return 0.0, 1e6
+    max_recall = r[ok].max()
+    return float(max_recall), float(t[ok & (r == max_recall)].max())
+
+
+class TestBinaryRecallAtFixedPrecision(unittest.TestCase):
+    def test_matches_oracle(self):
+        rng = np.random.default_rng(0)
+        for trial in range(5):
+            n = int(rng.integers(32, 257))
+            scores = rng.random(n).astype(np.float32)
+            if trial % 2:
+                scores = np.round(scores * 4) / 4  # ties
+            target = (rng.random(n) > 0.4).astype(np.float32)
+            target[0] = 1.0
+            for min_precision in (0.2, 0.5, 0.8):
+                got_r, got_t = binary_recall_at_fixed_precision(
+                    jnp.asarray(scores), jnp.asarray(target),
+                    min_precision=min_precision,
+                )
+                want_r, want_t = _oracle(scores, target, min_precision)
+                self.assertAlmostEqual(float(got_r), want_r, places=5)
+                self.assertAlmostEqual(float(got_t), want_t, places=5)
+
+    def test_infeasible_returns_sentinel(self):
+        # all-negative targets: precision is 0 everywhere
+        got_r, got_t = binary_recall_at_fixed_precision(
+            jnp.asarray([0.1, 0.9]), jnp.zeros(2), min_precision=0.5
+        )
+        self.assertEqual(float(got_r), 0.0)
+        self.assertEqual(float(got_t), 1e6)
+
+    def test_param_check(self):
+        with self.assertRaisesRegex(ValueError, r"\[0, 1\] range"):
+            binary_recall_at_fixed_precision(
+                jnp.zeros(2), jnp.zeros(2), min_precision=1.5
+            )
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(1)
+        scores = rng.random(128).astype(np.float32)
+        target = (rng.random(128) > 0.5).astype(np.float32)
+        target[0] = 1.0
+        want = _oracle(scores, target, 0.5)
+        m = BinaryRecallAtFixedPrecision(min_precision=0.5)
+        for cs, ct in zip(np.split(scores, 4), np.split(target, 4)):
+            m.update(jnp.asarray(cs), jnp.asarray(ct))
+        got = m.compute()
+        self.assertAlmostEqual(float(got[0]), want[0], places=5)
+        self.assertAlmostEqual(float(got[1]), want[1], places=5)
+
+        a = BinaryRecallAtFixedPrecision(min_precision=0.5)
+        b = BinaryRecallAtFixedPrecision(min_precision=0.5)
+        a.update(jnp.asarray(scores[:64]), jnp.asarray(target[:64]))
+        b.update(jnp.asarray(scores[64:]), jnp.asarray(target[64:]))
+        a.merge_state([b])
+        got = a.compute()
+        self.assertAlmostEqual(float(got[0]), want[0], places=5)
+
+        empty = BinaryRecallAtFixedPrecision(min_precision=0.5).compute()
+        self.assertEqual(float(empty[0]), 0.0)
+
+    def test_class_protocol(self):
+        from torcheval_tpu.utils.test_utils.metric_class_tester import (
+            BATCH_SIZE,
+            NUM_TOTAL_UPDATES,
+            MetricClassTester,
+        )
+
+        class _T(MetricClassTester):
+            def runTest(self):  # pragma: no cover
+                pass
+
+        rng = np.random.default_rng(2)
+        input = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        target = rng.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE))
+        want_r, want_t = _oracle(input.reshape(-1), target.reshape(-1), 0.4)
+        _T().run_class_implementation_tests(
+            metric=BinaryRecallAtFixedPrecision(min_precision=0.4),
+            state_names={"inputs", "targets"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=(np.float32(want_r), np.float32(want_t)),
+            atol=1e-5,
+            rtol=1e-4,
+            test_merge_with_one_update=False,
+        )
+
+
+class TestMultilabelRecallAtFixedPrecision(unittest.TestCase):
+    def test_matches_oracle(self):
+        rng = np.random.default_rng(3)
+        n, num_labels = 120, 4
+        scores = rng.random((n, num_labels)).astype(np.float32)
+        target = (rng.random((n, num_labels)) > 0.5).astype(np.float32)
+        target[0] = 1.0
+        got_r, got_t = multilabel_recall_at_fixed_precision(
+            jnp.asarray(scores), jnp.asarray(target),
+            num_labels=num_labels, min_precision=0.5,
+        )
+        for k in range(num_labels):
+            want_r, want_t = _oracle(scores[:, k], target[:, k], 0.5)
+            self.assertAlmostEqual(float(got_r[k]), want_r, places=5)
+            self.assertAlmostEqual(float(got_t[k]), want_t, places=5)
+
+    def test_class_lifecycle(self):
+        rng = np.random.default_rng(4)
+        scores = rng.random((80, 3)).astype(np.float32)
+        target = (rng.random((80, 3)) > 0.5).astype(np.float32)
+        target[0] = 1.0
+        m = MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.3)
+        for cs, ct in zip(np.split(scores, 4), np.split(target, 4)):
+            m.update(jnp.asarray(cs), jnp.asarray(ct))
+        got_r, got_t = m.compute()
+        for k in range(3):
+            want_r, want_t = _oracle(scores[:, k], target[:, k], 0.3)
+            self.assertAlmostEqual(float(got_r[k]), want_r, places=5)
+            self.assertAlmostEqual(float(got_t[k]), want_t, places=5)
+        self.assertEqual(
+            MultilabelRecallAtFixedPrecision(
+                num_labels=3, min_precision=0.3
+            ).compute(),
+            ([], []),
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -49,6 +49,10 @@ from torcheval_tpu.metrics.classification.recall import (
     BinaryRecall,
     MulticlassRecall,
 )
+from torcheval_tpu.metrics.classification.recall_at_fixed_precision import (
+    BinaryRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+)
 
 __all__ = [
     "BinaryAccuracy",
@@ -63,6 +67,7 @@ __all__ = [
     "BinaryPrecision",
     "BinaryPrecisionRecallCurve",
     "BinaryRecall",
+    "BinaryRecallAtFixedPrecision",
     "MulticlassAccuracy",
     "MulticlassAUPRC",
     "MulticlassAUROC",
@@ -79,5 +84,6 @@ __all__ = [
     "MultilabelBinnedAUPRC",
     "MultilabelBinnedPrecisionRecallCurve",
     "MultilabelPrecisionRecallCurve",
+    "MultilabelRecallAtFixedPrecision",
     "TopKMultilabelAccuracy",
 ]

@@ -18,7 +18,6 @@ from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_update_input_check,
-    _multiclass_auroc_update_input_check,
 )
 from torcheval_tpu.metrics.functional.classification.binned_auc import (
     _binned_auc_average_param_check,
@@ -26,6 +25,7 @@ from torcheval_tpu.metrics.functional.classification.binned_auc import (
     _binned_auroc_from_counts,
     _binned_counts_rows,
     _binned_curves_from_counts,
+    _multiclass_binned_auc_validate,
     _multiclass_binned_counts_kernel,
     _multilabel_binned_counts_kernel,
 )
@@ -126,7 +126,7 @@ class _MulticlassBinnedAUC(_BinnedCountsBase):
 
     def update(self, input, target):
         input, target = jnp.asarray(input), jnp.asarray(target)
-        _multiclass_auroc_update_input_check(input, target, self.num_classes)
+        _multiclass_binned_auc_validate(input, target, self.num_classes)
         self._accumulate(
             _multiclass_binned_counts_kernel, input, target,
             statics=(self.num_classes,),
@@ -233,7 +233,8 @@ class MultilabelBinnedAUPRC(_MultilabelBinned):
         threshold: Union[int, List[float], "jax.Array"] = 100,
         device=None,
     ) -> None:
-        _binned_auc_average_param_check(num_labels, average, "num_labels")
+        # num_labels itself is validated once, by _MultilabelBinned below.
+        _binned_auc_average_param_check(None, average, "num_labels")
         self.average = average
         super().__init__(num_labels, threshold, device)
 

@@ -1,0 +1,108 @@
+"""Recall-at-fixed-precision class metrics — buffered samples, like the
+PR-curve classes they are built on.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added
+``BinaryRecallAtFixedPrecision`` / ``MultilabelRecallAtFixedPrecision``
+later)."""
+
+from typing import Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_update_input_check,
+    _multilabel_precision_recall_curve_update_input_check,
+)
+from torcheval_tpu.metrics.functional.classification.recall_at_fixed_precision import (
+    _binary_recall_at_fixed_precision_compute,
+    _multilabel_recall_at_fixed_precision_compute,
+    _recall_at_fixed_precision_param_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class BinaryRecallAtFixedPrecision(Metric[Tuple[jax.Array, jax.Array]]):
+    """Best recall (and its threshold) with precision >= ``min_precision``."""
+
+    def __init__(self, *, min_precision: float, device=None) -> None:
+        super().__init__(device=device)
+        _recall_at_fixed_precision_param_check(min_precision)
+        self.min_precision = min_precision
+        self._add_state("inputs", [])
+        self._add_state("targets", [])
+
+    def update(self, input, target) -> "BinaryRecallAtFixedPrecision":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _binary_precision_recall_curve_update_input_check(input, target)
+        self.inputs.append(jax.device_put(input, self.device))
+        self.targets.append(jax.device_put(target, self.device))
+        return self
+
+    def compute(self) -> Tuple[jax.Array, jax.Array]:
+        if not self.inputs:
+            return (jnp.asarray(0.0), jnp.asarray(1e6))
+        return _binary_recall_at_fixed_precision_compute(
+            jnp.concatenate(self.inputs),
+            jnp.concatenate(self.targets),
+            self.min_precision,
+        )
+
+    def merge_state(
+        self, metrics: Iterable["BinaryRecallAtFixedPrecision"]
+    ) -> "BinaryRecallAtFixedPrecision":
+        merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "inputs", "targets", dim=0)
+
+
+class MultilabelRecallAtFixedPrecision(
+    Metric[Tuple[List[jax.Array], List[jax.Array]]]
+):
+    """Per-label best recalls (and thresholds) with precision >=
+    ``min_precision``."""
+
+    def __init__(
+        self,
+        *,
+        num_labels: Optional[int] = None,
+        min_precision: float,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        _recall_at_fixed_precision_param_check(min_precision)
+        self.num_labels = num_labels
+        self.min_precision = min_precision
+        self._add_state("inputs", [])
+        self._add_state("targets", [])
+
+    def update(self, input, target) -> "MultilabelRecallAtFixedPrecision":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _multilabel_precision_recall_curve_update_input_check(
+            input, target, self.num_labels
+        )
+        self.inputs.append(jax.device_put(input, self.device))
+        self.targets.append(jax.device_put(target, self.device))
+        return self
+
+    def compute(self) -> Tuple[List[jax.Array], List[jax.Array]]:
+        if not self.inputs:
+            return ([], [])
+        return _multilabel_recall_at_fixed_precision_compute(
+            jnp.concatenate(self.inputs, axis=0),
+            jnp.concatenate(self.targets, axis=0),
+            self.num_labels,
+            self.min_precision,
+        )
+
+    def merge_state(
+        self, metrics: Iterable["MultilabelRecallAtFixedPrecision"]
+    ) -> "MultilabelRecallAtFixedPrecision":
+        merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "inputs", "targets", dim=0)

@@ -49,6 +49,10 @@ from torcheval_tpu.metrics.functional.classification.recall import (
     binary_recall,
     multiclass_recall,
 )
+from torcheval_tpu.metrics.functional.classification.recall_at_fixed_precision import (
+    binary_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+)
 
 __all__ = [
     "binary_accuracy",
@@ -63,6 +67,7 @@ __all__ = [
     "binary_precision",
     "binary_precision_recall_curve",
     "binary_recall",
+    "binary_recall_at_fixed_precision",
     "multiclass_accuracy",
     "multiclass_auprc",
     "multiclass_auroc",
@@ -79,5 +84,6 @@ __all__ = [
     "multilabel_binned_auprc",
     "multilabel_binned_precision_recall_curve",
     "multilabel_precision_recall_curve",
+    "multilabel_recall_at_fixed_precision",
     "topk_multilabel_accuracy",
 ]

@@ -30,6 +30,9 @@ from torcheval_tpu.metrics.functional.classification.binned_precision_recall_cur
     _create_threshold_tensor,
     _multiclass_binned_compute_kernel,
 )
+from torcheval_tpu.metrics.functional.classification.precision import (
+    _check_index_range,
+)
 from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_update_input_check,
 )
@@ -71,7 +74,7 @@ def multiclass_binned_auroc(
     input, target = jnp.asarray(input), jnp.asarray(target)
     threshold = _create_threshold_tensor(threshold)
     _binned_precision_recall_curve_param_check(threshold)
-    _multiclass_auroc_update_input_check(input, target, num_classes)
+    _multiclass_binned_auc_validate(input, target, num_classes)
     auroc = _binned_auroc_from_counts(
         *_multiclass_binned_counts_kernel(input, target, threshold, num_classes)
     )
@@ -114,7 +117,7 @@ def multiclass_binned_auprc(
     input, target = jnp.asarray(input), jnp.asarray(target)
     threshold = _create_threshold_tensor(threshold)
     _binned_precision_recall_curve_param_check(threshold)
-    _multiclass_auroc_update_input_check(input, target, num_classes)
+    _multiclass_binned_auc_validate(input, target, num_classes)
     auprc = _binned_auprc_from_counts(
         *_multiclass_binned_counts_kernel(input, target, threshold, num_classes)
     )
@@ -168,6 +171,16 @@ def _binned_curves_from_counts(
     fn = pos[:, None] - tp
     precision, recall = _multiclass_binned_compute_kernel(tp.T, fp.T, fn.T)
     return list(precision.T), list(recall.T), threshold
+
+
+def _multiclass_binned_auc_validate(
+    input: jax.Array, target: jax.Array, num_classes: int
+) -> None:
+    """Shape check + OOB-target raise shared by the functional and class
+    paths — ``class_hits`` would otherwise silently count an out-of-range
+    target as a negative for every class."""
+    _multiclass_auroc_update_input_check(input, target, num_classes)
+    _check_index_range(target, num_classes, "target")
 
 
 @jax.jit

@@ -1,0 +1,121 @@
+"""Recall at fixed precision — best reachable recall under a precision
+floor, and the decision threshold that reaches it.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added
+``binary_recall_at_fixed_precision`` / ``multilabel_recall_at_fixed_precision``
+later).  Built on the exact PR-curve cores: the device kernel produces the
+fixed-shape sorted tie-group counts; the arg-selection over curve points is
+a host-side epilogue at the compute boundary (like the ragged curve
+materialization it shares).
+
+Semantics: over all PR-curve points with ``precision >= min_precision``,
+return the maximum recall and the *largest* threshold attaining it (the
+most conservative operating point at that recall).  When no threshold
+satisfies the floor, returns ``(0.0, 1e6)`` — the sentinel upstream
+torcheval uses for "no feasible threshold".
+"""
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_update_input_check,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_update_input_check,
+)
+
+_NO_THRESHOLD = 1e6
+
+
+def binary_recall_at_fixed_precision(
+    input,
+    target,
+    *,
+    min_precision: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """(max recall, threshold) such that precision >= ``min_precision``."""
+    _recall_at_fixed_precision_param_check(min_precision)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _binary_precision_recall_curve_update_input_check(input, target)
+    return _binary_recall_at_fixed_precision_compute(input, target, min_precision)
+
+
+def multilabel_recall_at_fixed_precision(
+    input,
+    target,
+    *,
+    num_labels: Optional[int] = None,
+    min_precision: float,
+) -> Tuple[List[jax.Array], List[jax.Array]]:
+    """Per-label ``(max recalls, thresholds)`` lists such that each label's
+    precision >= ``min_precision``."""
+    _recall_at_fixed_precision_param_check(min_precision)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    if num_labels is None and input.ndim == 2:
+        num_labels = input.shape[1]
+    _multilabel_precision_recall_curve_update_input_check(input, target, num_labels)
+    return _multilabel_recall_at_fixed_precision_compute(
+        input, target, num_labels, min_precision
+    )
+
+
+def _best_point(
+    precision: np.ndarray,
+    recall: np.ndarray,
+    thresholds: np.ndarray,
+    min_precision: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Select max recall under the precision floor from one curve.  The
+    curve arrays carry the (1.0, 0.0) sentinel as their last point, which
+    has no threshold — it only matters when nothing else qualifies, and
+    then the sentinel result (0.0, _NO_THRESHOLD) is returned anyway."""
+    precision, recall = precision[:-1], recall[:-1]
+    ok = precision >= min_precision
+    if not ok.any() or float(recall[ok].max()) == 0.0:
+        return jnp.asarray(0.0), jnp.asarray(_NO_THRESHOLD)
+    max_recall = recall[ok].max()
+    at_max = ok & (recall == max_recall)
+    return (
+        jnp.asarray(np.float32(max_recall)),
+        jnp.asarray(np.float32(thresholds[at_max].max())),
+    )
+
+
+def _binary_recall_at_fixed_precision_compute(
+    input: jax.Array, target: jax.Array, min_precision: float
+) -> Tuple[jax.Array, jax.Array]:
+    precision, recall, thresholds = _binary_precision_recall_curve_compute(
+        input, target
+    )
+    return _best_point(
+        np.asarray(precision), np.asarray(recall), np.asarray(thresholds),
+        min_precision,
+    )
+
+
+def _multilabel_recall_at_fixed_precision_compute(
+    input: jax.Array,
+    target: jax.Array,
+    num_labels: Optional[int],
+    min_precision: float,
+) -> Tuple[List[jax.Array], List[jax.Array]]:
+    precisions, recalls, thresholds = _multilabel_precision_recall_curve_compute(
+        input, target, num_labels
+    )
+    best = [
+        _best_point(np.asarray(p), np.asarray(r), np.asarray(t), min_precision)
+        for p, r, t in zip(precisions, recalls, thresholds)
+    ]
+    return [b[0] for b in best], [b[1] for b in best]
+
+
+def _recall_at_fixed_precision_param_check(min_precision: float) -> None:
+    if not isinstance(min_precision, float) or not 0.0 <= min_precision <= 1.0:
+        raise ValueError(
+            "Expected min_precision to be a float in the [0, 1] range, but got "
+            f"{min_precision}."
+        )
