@@ -1,0 +1,226 @@
+"""ClickThroughRate and the windowed CTR/MSE/WeightedCalibration metrics:
+numpy oracles, window semantics (capacity, wrap, lifetime), merge-grows-
+window, checkpoint round trip, and the full class protocol."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    ClickThroughRate,
+    WindowedClickThroughRate,
+    WindowedMeanSquaredError,
+    WindowedWeightedCalibration,
+)
+from torcheval_tpu.metrics.functional import click_through_rate
+
+
+class TestClickThroughRate(unittest.TestCase):
+    def test_functional(self):
+        rng = np.random.default_rng(0)
+        clicks = (rng.random(200) > 0.7).astype(np.float32)
+        self.assertAlmostEqual(
+            float(click_through_rate(jnp.asarray(clicks))),
+            float(clicks.mean()),
+            places=6,
+        )
+        w = rng.random(200).astype(np.float32)
+        self.assertAlmostEqual(
+            float(click_through_rate(jnp.asarray(clicks), jnp.asarray(w))),
+            float((clicks * w).sum() / w.sum()),
+            places=5,
+        )
+        # multi-task
+        c2 = (rng.random((3, 50)) > 0.5).astype(np.float32)
+        got = np.asarray(click_through_rate(jnp.asarray(c2), num_tasks=3))
+        np.testing.assert_allclose(got, c2.mean(axis=1), rtol=1e-6)
+        # a 0-dim array weight behaves like the equivalent Python float
+        self.assertAlmostEqual(
+            float(click_through_rate(jnp.asarray(clicks), jnp.asarray(2.0))),
+            float(clicks.mean()),
+            places=6,
+        )
+
+    def test_input_checks(self):
+        with self.assertRaisesRegex(ValueError, "binary tensor"):
+            click_through_rate(jnp.asarray([0.0, 0.5, 1.0]))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            click_through_rate(jnp.zeros((2, 3)))
+        with self.assertRaisesRegex(ValueError, "num_samples"):
+            click_through_rate(jnp.zeros(3), num_tasks=2)
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(1)
+        clicks = (rng.random(120) > 0.6).astype(np.float32)
+        w = rng.random(120).astype(np.float32)
+        m = ClickThroughRate()
+        for cc, cw in zip(np.split(clicks, 4), np.split(w, 4)):
+            m.update(jnp.asarray(cc), jnp.asarray(cw))
+        want = (clicks * w).sum() / w.sum()
+        self.assertAlmostEqual(float(m.compute()), float(want), places=5)
+
+        a, b = ClickThroughRate(), ClickThroughRate()
+        a.update(jnp.asarray(clicks[:60]), jnp.asarray(w[:60]))
+        b.update(jnp.asarray(clicks[60:]), jnp.asarray(w[60:]))
+        a.merge_state([b])
+        self.assertAlmostEqual(float(a.compute()), float(want), places=5)
+
+    def test_class_protocol(self):
+        from torcheval_tpu.utils.test_utils.metric_class_tester import (
+            BATCH_SIZE,
+            NUM_TOTAL_UPDATES,
+            MetricClassTester,
+        )
+
+        class _T(MetricClassTester):
+            def runTest(self):  # pragma: no cover
+                pass
+
+        rng = np.random.default_rng(2)
+        input = rng.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(
+            np.float32
+        )
+        expected = np.float32(input.mean())
+        _T().run_class_implementation_tests(
+            metric=ClickThroughRate(),
+            state_names={"click_total", "weight_total"},
+            update_kwargs={"input": list(input)},
+            compute_result=expected,
+            atol=1e-6,
+            rtol=1e-5,
+        )
+
+
+class TestWindowedClickThroughRate(unittest.TestCase):
+    def test_window_and_lifetime(self):
+        rng = np.random.default_rng(3)
+        batches = [
+            (rng.random(16) > 0.5).astype(np.float32) for _ in range(5)
+        ]
+        m = WindowedClickThroughRate(max_num_updates=3)
+        for b in batches:
+            m.update(jnp.asarray(b))
+        lifetime, windowed = m.compute()
+        all_clicks = np.concatenate(batches)
+        last3 = np.concatenate(batches[-3:])
+        self.assertAlmostEqual(float(lifetime[0]), float(all_clicks.mean()), places=6)
+        self.assertAlmostEqual(float(windowed[0]), float(last3.mean()), places=6)
+
+    def test_no_lifetime_and_empty(self):
+        m = WindowedClickThroughRate(max_num_updates=2, enable_lifetime=False)
+        self.assertEqual(m.compute().shape, (0,))
+        m.update(jnp.asarray([1.0, 0.0]))
+        self.assertAlmostEqual(float(m.compute()[0]), 0.5, places=6)
+
+    def test_merge_grows_window(self):
+        rng = np.random.default_rng(4)
+        a = WindowedClickThroughRate(max_num_updates=2)
+        b = WindowedClickThroughRate(max_num_updates=2)
+        batches = [(rng.random(8) > 0.5).astype(np.float32) for _ in range(4)]
+        a.update(jnp.asarray(batches[0])).update(jnp.asarray(batches[1]))
+        b.update(jnp.asarray(batches[2])).update(jnp.asarray(batches[3]))
+        a.merge_state([b])
+        self.assertEqual(a.max_num_updates, 4)
+        lifetime, windowed = a.compute()
+        allc = np.concatenate(batches)
+        self.assertAlmostEqual(float(windowed[0]), float(allc.mean()), places=6)
+        self.assertAlmostEqual(float(lifetime[0]), float(allc.mean()), places=6)
+        # reset restores the original capacity
+        a.reset()
+        self.assertEqual(a.max_num_updates, 2)
+        self.assertEqual(a.total_updates, 0)
+
+    def test_checkpoint_roundtrip(self):
+        m = WindowedClickThroughRate(max_num_updates=3)
+        m.update(jnp.asarray([1.0, 0.0, 1.0]))
+        sd = m.state_dict()
+        fresh = WindowedClickThroughRate(max_num_updates=3)
+        fresh.load_state_dict(sd)
+        lifetime, windowed = fresh.compute()
+        self.assertAlmostEqual(float(windowed[0]), 2 / 3, places=6)
+
+
+class TestWindowedMeanSquaredError(unittest.TestCase):
+    def test_window_and_lifetime(self):
+        rng = np.random.default_rng(5)
+        ins = [rng.random(16).astype(np.float32) for _ in range(5)]
+        tgts = [rng.random(16).astype(np.float32) for _ in range(5)]
+        m = WindowedMeanSquaredError(max_num_updates=2)
+        for i, t in zip(ins, tgts):
+            m.update(jnp.asarray(i), jnp.asarray(t))
+        lifetime, windowed = m.compute()
+        all_se = np.concatenate([(i - t) ** 2 for i, t in zip(ins, tgts)])
+        last2 = np.concatenate([(i - t) ** 2 for i, t in zip(ins[-2:], tgts[-2:])])
+        self.assertAlmostEqual(float(lifetime), float(all_se.mean()), places=6)
+        self.assertAlmostEqual(float(windowed), float(last2.mean()), places=6)
+
+    def test_multioutput_raw_values(self):
+        rng = np.random.default_rng(6)
+        ins = [rng.random((8, 3)).astype(np.float32) for _ in range(3)]
+        tgts = [rng.random((8, 3)).astype(np.float32) for _ in range(3)]
+        m = WindowedMeanSquaredError(multioutput="raw_values", max_num_updates=2)
+        for i, t in zip(ins, tgts):
+            m.update(jnp.asarray(i), jnp.asarray(t))
+        lifetime, windowed = m.compute()
+        all_se = np.concatenate([(i - t) ** 2 for i, t in zip(ins, tgts)])
+        last2 = np.concatenate([(i - t) ** 2 for i, t in zip(ins[-2:], tgts[-2:])])
+        np.testing.assert_allclose(np.asarray(lifetime), all_se.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(windowed), last2.mean(0), rtol=1e-5)
+
+    def test_merge_with_never_updated(self):
+        # A rank that received no data must still merge cleanly even after
+        # the sized metric's window grew vector rows.
+        a = WindowedMeanSquaredError(multioutput="raw_values")
+        a.update(jnp.ones((4, 2)), jnp.zeros((4, 2)))
+        a.merge_state([WindowedMeanSquaredError(multioutput="raw_values")])
+        lifetime, windowed = a.compute()
+        np.testing.assert_allclose(np.asarray(windowed), [1.0, 1.0])
+        np.testing.assert_allclose(np.asarray(lifetime), [1.0, 1.0])
+
+    def test_output_dim_mismatch_raises(self):
+        m = WindowedMeanSquaredError()
+        m.update(jnp.zeros((4, 2)), jnp.zeros((4, 2)))
+        with self.assertRaisesRegex(ValueError, "stay fixed"):
+            m.update(jnp.zeros((4, 3)), jnp.zeros((4, 3)))
+
+    def test_weighted_merge(self):
+        rng = np.random.default_rng(7)
+        i1, t1 = rng.random(10).astype(np.float32), rng.random(10).astype(np.float32)
+        i2, t2 = rng.random(10).astype(np.float32), rng.random(10).astype(np.float32)
+        w1, w2 = rng.random(10).astype(np.float32), rng.random(10).astype(np.float32)
+        a = WindowedMeanSquaredError(max_num_updates=4)
+        b = WindowedMeanSquaredError(max_num_updates=4)
+        a.update(jnp.asarray(i1), jnp.asarray(t1), sample_weight=jnp.asarray(w1))
+        b.update(jnp.asarray(i2), jnp.asarray(t2), sample_weight=jnp.asarray(w2))
+        a.merge_state([b])
+        lifetime, windowed = a.compute()
+        se = np.concatenate([(i1 - t1) ** 2 * w1, (i2 - t2) ** 2 * w2])
+        w = np.concatenate([w1, w2])
+        self.assertAlmostEqual(float(windowed), float(se.sum() / w.sum()), places=5)
+        self.assertAlmostEqual(float(lifetime), float(se.sum() / w.sum()), places=5)
+
+
+class TestWindowedWeightedCalibration(unittest.TestCase):
+    def test_window_and_lifetime(self):
+        rng = np.random.default_rng(8)
+        ins = [rng.random(16).astype(np.float32) for _ in range(4)]
+        tgts = [(rng.random(16) > 0.5).astype(np.float32) for _ in range(4)]
+        m = WindowedWeightedCalibration(max_num_updates=2)
+        for i, t in zip(ins, tgts):
+            m.update(jnp.asarray(i), jnp.asarray(t))
+        lifetime, windowed = m.compute()
+        want_life = np.concatenate(ins).sum() / np.concatenate(tgts).sum()
+        want_win = np.concatenate(ins[-2:]).sum() / np.concatenate(tgts[-2:]).sum()
+        self.assertAlmostEqual(float(lifetime[0]), float(want_life), places=5)
+        self.assertAlmostEqual(float(windowed[0]), float(want_win), places=5)
+
+    def test_merge_lifetime_mismatch_raises(self):
+        a = WindowedWeightedCalibration(enable_lifetime=True)
+        b = WindowedWeightedCalibration(enable_lifetime=False)
+        with self.assertRaisesRegex(ValueError, "enable_lifetime"):
+            a.merge_state([b])
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -115,6 +115,17 @@ class RingWindowMixin:
                 jax.device_put(self._valid_window(m, name), self.device)
                 for m in metrics
             ]
+            # A never-updated metric may still hold its initial row count
+            # (e.g. WindowedMeanSquaredError before its output dim is
+            # known); its zero-column slice carries no data, so conform it
+            # to the sized metrics' rows instead of failing the concat.
+            rows = max(p.shape[0] for p in pieces)
+            pieces = [
+                p
+                if p.shape[1] or p.shape[0] == rows
+                else jnp.zeros((rows, 0), p.dtype)
+                for p in pieces
+            ]
             valid = jnp.concatenate(pieces, axis=1)
             idx = valid.shape[1]
             setattr(self, name, jnp.pad(valid, ((0, 0), (0, merged_w - idx))))
@@ -128,3 +139,155 @@ class RingWindowMixin:
         self._window_capacity = self._init_window_capacity
         self.next_inserted = 0
         self._num_valid = 0
+
+
+_EMPTY = np.zeros(0, dtype=np.float32)
+
+
+def _windowed_pair_update_fused_impl(
+    w_a, w_b, life_a, life_b, col, kernel, lifetime, *args
+):
+    """Two-statistic kernel + window-column writes (+ lifetime adds) in ONE
+    dispatch — the fused update shared by every two-sum windowed metric
+    (CTR, weighted calibration, MSE)."""
+    a, b = kernel(*args)
+    w_a = w_a.at[:, col].set(jnp.atleast_1d(a))
+    w_b = w_b.at[:, col].set(b)
+    if lifetime:
+        life_a, life_b = life_a + a, life_b + b
+    return w_a, w_b, life_a, life_b
+
+
+_windowed_pair_update_fused = jax.jit(
+    _windowed_pair_update_fused_impl, static_argnames=("kernel", "lifetime")
+)
+
+
+class WindowedLifetimeMixin(RingWindowMixin):
+    """RingWindowMixin plus the shared lifecycle of every windowed metric
+    that also keeps optional lifetime sums (`enable_lifetime`): merge packs
+    window columns AND adds the lifetime states; reset restores the window
+    bookkeeping and the update counter.
+
+    Subclasses set ``_lifetime_states`` (added on merge when lifetime is
+    enabled) in addition to the RingWindowMixin attributes, keep an
+    ``enable_lifetime`` flag and a ``total_updates`` counter, and call
+    ``_merge_windowed`` from ``merge_state``.  Two-sum metrics get their
+    whole update/compute path from ``_update_windowed_pair`` /
+    ``_ratio_compute``."""
+
+    _lifetime_states: tuple = ()
+    # Lifetime names fed through the fused pair update, when they differ
+    # from the merge-added ``_lifetime_states`` (WindowedMeanSquaredError
+    # adds one of its lifetime states grow-aware, outside the mixin).
+    @property
+    def _fused_lifetime(self) -> tuple:
+        return self._lifetime_states
+
+    @property
+    def max_num_updates(self) -> int:
+        """Window capacity (grows on merge)."""
+        return self._window_capacity
+
+    def _init_task_window(
+        self,
+        num_tasks: int,
+        max_num_updates: int,
+        enable_lifetime: bool,
+        dtype,
+    ) -> None:
+        """Validate and allocate the standard per-task window layout:
+        lifetime vectors ``(num_tasks,)`` and window rings
+        ``(num_tasks, max_num_updates)``."""
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        if max_num_updates < 1:
+            raise ValueError(
+                "`max_num_updates` value should be greater than and equal to 1, "
+                f"but received {max_num_updates}. "
+            )
+        self.num_tasks = num_tasks
+        self.enable_lifetime = enable_lifetime
+        self._init_window(max_num_updates)
+        self.total_updates = 0
+        if enable_lifetime:
+            for name in self._lifetime_states:
+                self._add_state(name, jnp.zeros(num_tasks, dtype=dtype))
+        for name in self._window_states:
+            self._add_state(
+                name, jnp.zeros((num_tasks, max_num_updates), dtype=dtype)
+            )
+
+    def _update_windowed_pair(self, kernel, args) -> None:
+        """Run the fused two-statistic update and advance the window."""
+        wa, wb = self._window_states
+        la, lb = self._fused_lifetime
+        lifetime_in = (
+            (getattr(self, la), getattr(self, lb))
+            if self.enable_lifetime
+            else (_EMPTY, _EMPTY)
+        )
+        new_wa, new_wb, a, b = _windowed_pair_update_fused(
+            getattr(self, wa),
+            getattr(self, wb),
+            *lifetime_in,
+            self.next_inserted,
+            kernel,
+            self.enable_lifetime,
+            *args,
+        )
+        setattr(self, wa, new_wa)
+        setattr(self, wb, new_wb)
+        if self.enable_lifetime:
+            setattr(self, la, a)
+            setattr(self, lb, b)
+        self._window_advance(1)
+        self.total_updates += 1
+
+    def _ratio_compute(self):
+        """``windowed = Σa / Σb`` over the valid columns, plus the lifetime
+        ratio when enabled; empty array(s) before any update."""
+        if self._num_valid == 0:
+            empty = jnp.zeros(0)
+            return (empty, empty) if self.enable_lifetime else empty
+        wa, wb = self._window_states
+        n = self._num_valid
+        windowed = getattr(self, wa)[:, :n].sum(axis=1) / getattr(self, wb)[
+            :, :n
+        ].sum(axis=1)
+        if self.enable_lifetime:
+            la, lb = self._lifetime_states
+            return getattr(self, la) / getattr(self, lb), windowed
+        return windowed
+
+    def _merge_windowed(self, metrics):
+        metrics = list(metrics)
+        for m in metrics:
+            if m.enable_lifetime != self.enable_lifetime:
+                raise ValueError(
+                    "Merged metrics must all have the same `enable_lifetime` "
+                    f"setting; got {self.enable_lifetime} vs {m.enable_lifetime}."
+                )
+        self._window_merge(metrics)
+        for m in metrics:
+            if self.enable_lifetime:
+                for name in self._lifetime_states:
+                    setattr(
+                        self,
+                        name,
+                        getattr(self, name)
+                        + jax.device_put(getattr(m, name), self.device),
+                    )
+            self.total_updates += m.total_updates
+        return self
+
+    def reset(self):
+        """Reset states AND the host-side window bookkeeping, including the
+        window size a previous merge may have grown."""
+        super().reset()
+        self._window_reset()
+        self.total_updates = 0
+        return self

@@ -1,0 +1,56 @@
+"""ClickThroughRate metric — per-task counter states.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added ``ClickThroughRate``
+later).  Same counter-state shape as ``WeightedCalibration``: two per-task
+sums, add-mergeable, ``psum``-syncable."""
+
+from typing import Iterable, Union
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.aggregation.click_through_rate import (
+    _ctr_select_kernel,
+)
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    _accum_dtype,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class ClickThroughRate(Metric[jax.Array]):
+    """Weighted click fraction Σw·click / Σw per task; NaN before any
+    weighted update (0/0), like ``WeightedCalibration``."""
+
+    def __init__(self, *, num_tasks: int = 1, device=None) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.num_tasks = num_tasks
+        self._add_state("click_total", jnp.zeros(num_tasks, dtype=_accum_dtype()))
+        self._add_state("weight_total", jnp.zeros(num_tasks, dtype=_accum_dtype()))
+
+    def update(
+        self, input, weights: Union[float, int, "jax.Array"] = 1.0
+    ) -> "ClickThroughRate":
+        input = jnp.asarray(input)
+        kernel, args = _ctr_select_kernel(input, weights, num_tasks=self.num_tasks)
+        # Kernel + both state adds fused into one dispatch (_fuse.py).
+        self.click_total, self.weight_total = accumulate(
+            kernel, (self.click_total, self.weight_total), *args
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """CTR per task (scalar when ``num_tasks == 1``)."""
+        ctr = self.click_total / self.weight_total
+        return ctr[0] if self.num_tasks == 1 else ctr
+
+    def merge_state(self, metrics: Iterable["ClickThroughRate"]):
+        merge_add(self, metrics, "click_total", "weight_total")
+        return self

@@ -1,5 +1,8 @@
+from torcheval_tpu.metrics.functional.aggregation.click_through_rate import (
+    click_through_rate,
+)
 from torcheval_tpu.metrics.functional.aggregation.mean import mean
 from torcheval_tpu.metrics.functional.aggregation.sum import sum  # noqa: A004
 from torcheval_tpu.metrics.functional.aggregation.throughput import throughput
 
-__all__ = ["mean", "sum", "throughput"]
+__all__ = ["click_through_rate", "mean", "sum", "throughput"]

@@ -1,0 +1,102 @@
+"""Click-through rate — weighted click fraction Σw·click / Σw per task.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added
+``click_through_rate`` later).  Same per-task sufficient-statistic shape
+as weighted calibration: two add-mergeable sums."""
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.functional._host_checks import all_concrete
+
+
+def click_through_rate(
+    input,
+    weights: Union[float, int, "jax.Array"] = 1.0,
+    *,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """CTR per task over 0/1 click events; ``weights`` is a scalar or a
+    per-event array of impression weights."""
+    input = jnp.asarray(input)
+    kernel, args = _ctr_select_kernel(input, weights, num_tasks=num_tasks)
+    click_total, weight_total = kernel(*args)
+    return click_total / weight_total
+
+
+@jax.jit
+def _ctr_scalar_kernel(
+    input: jax.Array, weights
+) -> Tuple[jax.Array, jax.Array]:
+    n = input.shape[-1]
+    return weights * jnp.sum(input, axis=-1), weights * jnp.full(
+        input.shape[:-1], n, dtype=input.dtype
+    )
+
+
+@jax.jit
+def _ctr_array_kernel(
+    input: jax.Array, weights: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    return jnp.sum(weights * input, axis=-1), jnp.sum(
+        jnp.broadcast_to(weights, input.shape), axis=-1
+    )
+
+
+def _ctr_select_kernel(
+    input: jax.Array,
+    weights: Union[float, int, "jax.Array"],
+    *,
+    num_tasks: int,
+):
+    """Validate and pick the matching jitted kernel; returns
+    ``(kernel, args)`` so callers can dispatch it directly or fused."""
+    _ctr_input_check(input, weights, num_tasks=num_tasks)
+    if isinstance(weights, (float, int)):
+        return _ctr_scalar_kernel, (input, float(weights))
+    weights = jnp.asarray(weights)
+    if weights.ndim == 0:  # scalar array: same path as a Python float
+        return _ctr_scalar_kernel, (input, weights)
+    return _ctr_array_kernel, (input, weights)
+
+
+def _ctr_input_check(
+    input: jax.Array,
+    weights: Union[float, int, "jax.Array"],
+    *,
+    num_tasks: int,
+) -> None:
+    if num_tasks == 1:
+        if input.ndim != 1:
+            raise ValueError(
+                "`input` should be a one-dimensional tensor for num_tasks = 1, "
+                f"got shape {input.shape}."
+            )
+    elif input.ndim != 2 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`input` should have shape ({num_tasks}, num_samples) for "
+            f"num_tasks = {num_tasks}, got shape {input.shape}."
+        )
+    if not isinstance(weights, (float, int)):
+        wshape = jnp.shape(weights)
+        if wshape not in ((), input.shape, input.shape[-1:]):
+            raise ValueError(
+                "`weights` must be a float, or a tensor broadcastable to the "
+                f"input shape {input.shape}, got shape {wshape}."
+            )
+    # Click events must be 0/1 — a data-dependent check, skipped under
+    # tracing like every host-side value check (_host_checks.py).
+    if input.size and all_concrete(input):
+        vals = np.asarray(jax.device_get(_ctr_binary_probe(input)))
+        if not bool(vals):
+            raise ValueError(
+                "`input` should be a binary tensor of 0/1 click events."
+            )
+
+
+@jax.jit
+def _ctr_binary_probe(input: jax.Array) -> jax.Array:
+    return jnp.all((input == 0) | (input == 1))

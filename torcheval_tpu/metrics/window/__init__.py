@@ -2,11 +2,19 @@
 ring-buffer states over the last N samples / update calls."""
 
 from torcheval_tpu.metrics.window.auroc import WindowedBinaryAUROC
+from torcheval_tpu.metrics.window.click_through_rate import WindowedClickThroughRate
+from torcheval_tpu.metrics.window.mean_squared_error import WindowedMeanSquaredError
 from torcheval_tpu.metrics.window.normalized_entropy import (
     WindowedBinaryNormalizedEntropy,
+)
+from torcheval_tpu.metrics.window.weighted_calibration import (
+    WindowedWeightedCalibration,
 )
 
 __all__ = [
     "WindowedBinaryAUROC",
     "WindowedBinaryNormalizedEntropy",
+    "WindowedClickThroughRate",
+    "WindowedMeanSquaredError",
+    "WindowedWeightedCalibration",
 ]

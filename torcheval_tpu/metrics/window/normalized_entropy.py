@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torcheval_tpu.metrics._buffer import RingWindowMixin
+from torcheval_tpu.metrics._buffer import WindowedLifetimeMixin
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _accum_dtype,
     _baseline_update,
@@ -76,7 +76,7 @@ def _windowed_ne_update_fused(
 
 
 class WindowedBinaryNormalizedEntropy(
-    RingWindowMixin, Metric[Union[jax.Array, Tuple[jax.Array, jax.Array]]]
+    WindowedLifetimeMixin, Metric[Union[jax.Array, Tuple[jax.Array, jax.Array]]]
 ):
     """Windowed (and optionally lifetime) normalized binary cross entropy
     (reference ``window/normalized_entropy.py:22-77``)."""
@@ -87,6 +87,7 @@ class WindowedBinaryNormalizedEntropy(
         "windowed_num_positive",
     )
     _window_counters = ("total_updates",)
+    _lifetime_states = _LIFETIME_STATES
 
     def __init__(
         self,
@@ -98,32 +99,10 @@ class WindowedBinaryNormalizedEntropy(
         device=None,
     ) -> None:
         super().__init__(device=device)
-        if num_tasks < 1:
-            raise ValueError(
-                "`num_tasks` value should be greater than and equal to 1, "
-                f"but received {num_tasks}. "
-            )
-        if max_num_updates < 1:
-            raise ValueError(
-                "`max_num_updates` value should be greater than and equal to 1, "
-                f"but received {max_num_updates}. "
-            )
         self.from_logits = from_logits
-        self.num_tasks = num_tasks
-        self.enable_lifetime = enable_lifetime
-        self._init_window(max_num_updates)
-        self.total_updates = 0
-        dtype = _accum_dtype()
-        if enable_lifetime:
-            for name in _LIFETIME_STATES:
-                self._add_state(name, jnp.zeros(num_tasks, dtype=dtype))
-        for name in self._window_states:
-            self._add_state(name, jnp.zeros((num_tasks, max_num_updates), dtype=dtype))
-
-    @property
-    def max_num_updates(self) -> int:
-        """Window capacity (grows on merge, reference attribute name)."""
-        return self._window_capacity
+        self._init_task_window(
+            num_tasks, max_num_updates, enable_lifetime, _accum_dtype()
+        )
 
     def update(
         self, input, target, *, weight=None
@@ -195,32 +174,6 @@ class WindowedBinaryNormalizedEntropy(
     ) -> "WindowedBinaryNormalizedEntropy":
         """Pack every metric's valid window columns into an enlarged window
         (size = sum of window sizes) and add lifetime vectors
-        (reference ``window/normalized_entropy.py:232-296``)."""
-        metrics = list(metrics)
-        for m in metrics:
-            if m.enable_lifetime != self.enable_lifetime:
-                raise ValueError(
-                    "Merged metrics must all have the same `enable_lifetime` "
-                    f"setting; got {self.enable_lifetime} vs {m.enable_lifetime}."
-                )
-        self._window_merge(metrics)
-        for m in metrics:
-            if self.enable_lifetime:
-                for name in _LIFETIME_STATES:
-                    setattr(
-                        self,
-                        name,
-                        getattr(self, name)
-                        + jax.device_put(getattr(m, name), self.device),
-                    )
-            self.total_updates += m.total_updates
-        return self
-
-    def reset(self) -> "WindowedBinaryNormalizedEntropy":
-        """Reset states AND the host-side window bookkeeping, including the
-        window size a previous merge may have grown (divergence: the
-        reference base-class reset leaves all of these stale)."""
-        super().reset()
-        self._window_reset()
-        self.total_updates = 0
-        return self
+        (reference ``window/normalized_entropy.py:232-296``;
+        WindowedLifetimeMixin)."""
+        return self._merge_windowed(metrics)
