@@ -1,0 +1,293 @@
+"""Text metrics: WER/WIP/WIL vs an independent python oracle, perplexity
+vs a numpy oracle, BLEU vs hand-checked values and an independent
+implementation, class lifecycle/merge, and the native kernel's fallback
+equivalence."""
+
+import math
+import unittest
+from collections import Counter
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BLEUScore,
+    Perplexity,
+    WordErrorRate,
+    WordInformationLost,
+    WordInformationPreserved,
+)
+from torcheval_tpu.metrics.functional import (
+    bleu_score,
+    perplexity,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+
+
+def _edit(a, b):
+    a, b = a.split(), b.split()
+    dp = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        prev, dp[0] = dp[0], i
+        for j, cb in enumerate(b, 1):
+            cur = dp[j]
+            dp[j] = min(prev + (ca != cb), dp[j] + 1, dp[j - 1] + 1)
+            prev = cur
+    return dp[-1]
+
+
+PAIRS = [
+    ("hello world", "hello there world"),
+    ("the quick brown fox", "the quick brown fox"),
+    ("a b c d", "x y z"),
+    ("this is the best metric", "this is metric"),
+]
+
+
+class TestWordErrorRate(unittest.TestCase):
+    def test_single_pair(self):
+        self.assertAlmostEqual(
+            float(word_error_rate("hello world", "hello there world")),
+            1 / 3,
+            places=6,
+        )
+
+    def test_batch_matches_oracle(self):
+        hyps = [p[0] for p in PAIRS]
+        refs = [p[1] for p in PAIRS]
+        errors = sum(_edit(h, r) for h, r in zip(hyps, refs))
+        total = sum(len(r.split()) for r in refs)
+        self.assertAlmostEqual(
+            float(word_error_rate(hyps, refs)), errors / total, places=6
+        )
+
+    def test_wip_wil(self):
+        hyps = [p[0] for p in PAIRS]
+        refs = [p[1] for p in PAIRS]
+        errors = sum(_edit(h, r) for h, r in zip(hyps, refs))
+        nt = sum(len(r.split()) for r in refs)
+        ni = sum(len(h.split()) for h in hyps)
+        # canonical Morris et al. hit proxy: H = N_ref - E in both numerators
+        want_wip = (nt - errors) / nt * (nt - errors) / ni
+        self.assertAlmostEqual(
+            float(word_information_preserved(hyps, refs)), want_wip, places=6
+        )
+        self.assertAlmostEqual(
+            float(word_information_lost(hyps, refs)), 1 - want_wip, places=6
+        )
+
+    def test_wip_hand_checked(self):
+        # 'a x' vs 'a b c': E=2, H=1 -> WIP = (1/3)*(1/2) = 1/6
+        self.assertAlmostEqual(
+            float(word_information_preserved("a x", "a b c")), 1 / 6, places=6
+        )
+
+    def test_input_checks(self):
+        with self.assertRaisesRegex(ValueError, "same number"):
+            word_error_rate(["a", "b"], ["a"])
+        with self.assertRaisesRegex(ValueError, "string"):
+            word_error_rate(3, "a")
+
+    def test_class_lifecycle_and_merge(self):
+        m = WordErrorRate()
+        for h, r in PAIRS:
+            m.update(h, r)
+        errors = sum(_edit(h, r) for h, r in PAIRS)
+        total = sum(len(r.split()) for _, r in PAIRS)
+        self.assertAlmostEqual(float(m.compute()), errors / total, places=6)
+
+        a, b = WordErrorRate(), WordErrorRate()
+        a.update([p[0] for p in PAIRS[:2]], [p[1] for p in PAIRS[:2]])
+        b.update([p[0] for p in PAIRS[2:]], [p[1] for p in PAIRS[2:]])
+        a.merge_state([b])
+        self.assertAlmostEqual(float(a.compute()), errors / total, places=6)
+
+        wip = WordInformationPreserved()
+        wil = WordInformationLost()
+        for h, r in PAIRS:
+            wip.update(h, r)
+            wil.update(h, r)
+        self.assertAlmostEqual(
+            float(wip.compute()) + float(wil.compute()), 1.0, places=6
+        )
+
+    def test_state_dict_roundtrip(self):
+        m = WordErrorRate().update("a b", "a c")
+        fresh = WordErrorRate()
+        fresh.load_state_dict(m.state_dict())
+        self.assertAlmostEqual(float(fresh.compute()), 0.5, places=6)
+
+
+class TestPerplexity(unittest.TestCase):
+    def test_uniform_logits(self):
+        vocab = 7
+        logits = jnp.zeros((2, 5, vocab))
+        target = jnp.zeros((2, 5), jnp.int32)
+        self.assertAlmostEqual(
+            float(perplexity(logits, target)), vocab, places=4
+        )
+
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        logits = rng.normal(size=(3, 8, 11)).astype(np.float32)
+        target = rng.integers(0, 11, (3, 8))
+        lse = np.log(np.exp(logits).sum(-1))
+        ll = np.take_along_axis(logits, target[..., None], -1)[..., 0] - lse
+        want = math.exp(-ll.mean())
+        self.assertAlmostEqual(
+            float(perplexity(jnp.asarray(logits), jnp.asarray(target))),
+            want,
+            places=3,
+        )
+
+    def test_ignore_index(self):
+        rng = np.random.default_rng(1)
+        logits = rng.normal(size=(2, 6, 5)).astype(np.float32)
+        target = rng.integers(0, 5, (2, 6))
+        target[0, :3] = -100
+        lse = np.log(np.exp(logits).sum(-1))
+        ll = np.take_along_axis(
+            logits, np.clip(target, 0, None)[..., None], -1
+        )[..., 0] - lse
+        mask = target != -100
+        want = math.exp(-(ll * mask).sum() / mask.sum())
+        got = perplexity(
+            jnp.asarray(logits), jnp.asarray(target), ignore_index=-100
+        )
+        self.assertAlmostEqual(float(got), want, places=3)
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(2)
+        logits = rng.normal(size=(4, 6, 9)).astype(np.float32)
+        target = rng.integers(0, 9, (4, 6))
+        m = Perplexity()
+        for k in range(4):
+            m.update(jnp.asarray(logits[k : k + 1]), jnp.asarray(target[k : k + 1]))
+        want = float(perplexity(jnp.asarray(logits), jnp.asarray(target)))
+        self.assertAlmostEqual(float(m.compute()), want, places=3)
+
+        a, b = Perplexity(), Perplexity()
+        a.update(jnp.asarray(logits[:2]), jnp.asarray(target[:2]))
+        b.update(jnp.asarray(logits[2:]), jnp.asarray(target[2:]))
+        a.merge_state([b])
+        self.assertAlmostEqual(float(a.compute()), want, places=3)
+
+    def test_input_checks(self):
+        with self.assertRaisesRegex(ValueError, "vocab_size"):
+            perplexity(jnp.zeros((2, 3)), jnp.zeros((2, 3), jnp.int32))
+        with self.assertRaisesRegex(ValueError, "leading dimensions"):
+            perplexity(jnp.zeros((2, 3, 4)), jnp.zeros((2, 5), jnp.int32))
+
+
+def _bleu_oracle(candidates, references, n_gram=4, weights=None):
+    weights = weights or [1 / n_gram] * n_gram
+    matches = [0] * n_gram
+    possible = [0] * n_gram
+    c_len = r_len = 0
+    for cand, refs in zip(candidates, references):
+        ct = cand.split()
+        rts = [r.split() for r in refs]
+        c_len += len(ct)
+        r_len += min((len(r) for r in rts), key=lambda L: (abs(L - len(ct)), L))
+        for n in range(1, n_gram + 1):
+            cc = Counter(tuple(ct[i : i + n]) for i in range(len(ct) - n + 1))
+            mr = Counter()
+            for rt in rts:
+                rc = Counter(
+                    tuple(rt[i : i + n]) for i in range(len(rt) - n + 1)
+                )
+                for g, v in rc.items():
+                    mr[g] = max(mr[g], v)
+            matches[n - 1] += sum(min(v, mr[g]) for g, v in cc.items())
+            possible[n - 1] += max(0, len(ct) - n + 1)
+    if any(m == 0 for m in matches) or c_len == 0:
+        return 0.0
+    log_p = sum(
+        w * math.log(m / p) for w, m, p in zip(weights, matches, possible)
+    )
+    bp = 1.0 if c_len > r_len else math.exp(1 - r_len / c_len)
+    return bp * math.exp(log_p)
+
+
+class TestBLEUScore(unittest.TestCase):
+    def test_identical_is_one(self):
+        self.assertAlmostEqual(
+            float(bleu_score("the cat sat on the mat", "the cat sat on the mat")),
+            1.0,
+            places=5,
+        )
+
+    def test_clipped_unigram(self):
+        # classic clipping example: p1 = 2/7, c > r so BP = 1
+        got = bleu_score(
+            "the the the the the the the",
+            "the cat is on the mat",
+            n_gram=1,
+        )
+        self.assertAlmostEqual(float(got), 2 / 7, places=5)
+
+    def test_matches_oracle_corpus(self):
+        cands = [
+            "the cat is on the mat",
+            "there is a cat here",
+            "completely different words",
+        ]
+        refs = [
+            ["the cat sat on the mat", "a cat is on the mat"],
+            ["there is a cat over here"],
+            ["nothing shared at all"],
+        ]
+        for n_gram in (1, 2, 4):
+            want = _bleu_oracle(cands, refs, n_gram=n_gram)
+            got = float(bleu_score(cands, refs, n_gram=n_gram))
+            self.assertAlmostEqual(got, want, places=5, msg=f"n_gram={n_gram}")
+
+    def test_no_match_is_zero(self):
+        self.assertEqual(float(bleu_score("a b c", "x y z")), 0.0)
+
+    def test_param_checks(self):
+        with self.assertRaisesRegex(ValueError, "at least 1"):
+            bleu_score("a", "a", n_gram=0)
+        with self.assertRaisesRegex(ValueError, "length of `weights`"):
+            bleu_score("a", "a", n_gram=2, weights=[1.0])
+        with self.assertRaisesRegex(ValueError, "same number"):
+            bleu_score(["a", "b"], ["a"])
+        with self.assertRaisesRegex(ValueError, "bare string"):
+            bleu_score(["hi there", "ok"], "ab")
+
+    def test_class_lifecycle_and_merge(self):
+        cands = ["the cat is on the mat", "there is a cat here"]
+        refs = [["the cat sat on the mat"], ["there is a cat over here"]]
+        want = _bleu_oracle(cands, refs, n_gram=2)
+        m = BLEUScore(n_gram=2)
+        for c, r in zip(cands, refs):
+            m.update(c, r)
+        self.assertAlmostEqual(float(m.compute()), want, places=5)
+
+        a, b = BLEUScore(n_gram=2), BLEUScore(n_gram=2)
+        a.update(cands[0], refs[0])
+        b.update(cands[1], refs[1])
+        a.merge_state([b])
+        self.assertAlmostEqual(float(a.compute()), want, places=5)
+        self.assertEqual(float(BLEUScore().compute()), 0.0)
+
+
+class TestNativeFallback(unittest.TestCase):
+    def test_python_fallback_matches_native(self):
+        from torcheval_tpu.native.edit_distance import (
+            _edit_distance_py,
+            edit_distance_batch,
+        )
+
+        rng = np.random.default_rng(3)
+        a = [list(rng.integers(0, 6, rng.integers(0, 25))) for _ in range(40)]
+        b = [list(rng.integers(0, 6, rng.integers(0, 25))) for _ in range(40)]
+        native = edit_distance_batch(a, b)
+        py = [_edit_distance_py(x, y) for x, y in zip(a, b)]
+        self.assertEqual(list(native), py)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,145 @@
+"""BLEU score.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added ``bleu_score``
+later).  Host-side n-gram counting (strings never touch the device); the
+sufficient statistics are four add-mergeable counters — candidate/
+reference lengths and per-order clipped/possible n-gram match counts —
+so the class metric merges and syncs like every counter metric."""
+
+from collections import Counter
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TBleuInput = Union[str, Sequence[str]]
+TBleuTarget = Union[str, Sequence[str], Sequence[Sequence[str]]]
+
+
+def bleu_score(
+    input: TBleuInput,
+    target: TBleuTarget,
+    *,
+    n_gram: int = 4,
+    weights: Optional[Sequence[float]] = None,
+) -> jax.Array:
+    """Corpus BLEU of candidate sentence(s) against their reference set(s),
+    with modified n-gram precision up to ``n_gram`` and the standard
+    brevity penalty.  ``weights`` defaults to uniform ``1/n_gram``."""
+    weights_arr = _bleu_param_check(n_gram, weights)
+    input_len, target_len, matches, possible = _bleu_update(input, target, n_gram)
+    return _bleu_compute(
+        jnp.asarray(float(input_len)),
+        jnp.asarray(float(target_len)),
+        jnp.asarray(matches, dtype=jnp.float32),
+        jnp.asarray(possible, dtype=jnp.float32),
+        weights_arr,
+    )
+
+
+def _bleu_param_check(
+    n_gram: int, weights: Optional[Sequence[float]]
+) -> jax.Array:
+    if n_gram < 1:
+        raise ValueError(f"`n_gram` should be at least 1, got {n_gram}.")
+    if weights is None:
+        return jnp.full(n_gram, 1.0 / n_gram)
+    if len(weights) != n_gram:
+        raise ValueError(
+            f"the length of `weights` should equal `n_gram`, got "
+            f"{len(weights)} and {n_gram}."
+        )
+    return jnp.asarray(weights, dtype=jnp.float32)
+
+
+def _normalize_pairs(
+    input: TBleuInput, target: TBleuTarget
+) -> Tuple[List[str], List[List[str]]]:
+    """Canonicalize to (candidates, per-candidate reference lists)."""
+    if isinstance(input, str):
+        candidates = [input]
+        if isinstance(target, str):
+            references: List[List[str]] = [[target]]
+        else:
+            references = [list(target)]
+    else:
+        candidates = list(input)
+        if isinstance(target, str):
+            raise ValueError(
+                "When `input` is a sequence of candidates, `target` must be "
+                "a sequence of references (one str or list of str per "
+                "candidate), got a bare string."
+            )
+        references = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(candidates) != len(references):
+        raise ValueError(
+            "`input` and `target` should have the same number of sentences, "
+            f"got {len(candidates)} and {len(references)}."
+        )
+    for refs in references:
+        if not refs:
+            raise ValueError("Every candidate needs at least one reference.")
+    return candidates, references
+
+
+def _ngram_counts(tokens: List[str], n_gram: int) -> List[Counter]:
+    return [
+        Counter(
+            tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)
+        )
+        for n in range(1, n_gram + 1)
+    ]
+
+
+def _bleu_update(
+    input: TBleuInput, target: TBleuTarget, n_gram: int
+) -> Tuple[int, int, np.ndarray, np.ndarray]:
+    """Sufficient statistics: candidate length, closest-reference length,
+    clipped matches and possible matches per n-gram order."""
+    candidates, references = _normalize_pairs(input, target)
+    input_len = 0
+    target_len = 0
+    matches = np.zeros(n_gram, dtype=np.int64)
+    possible = np.zeros(n_gram, dtype=np.int64)
+    for cand, refs in zip(candidates, references):
+        cand_tokens = cand.split()
+        ref_tokens = [r.split() for r in refs]
+        input_len += len(cand_tokens)
+        # closest reference length; ties break toward the shorter reference
+        target_len += min(
+            (len(r) for r in ref_tokens),
+            key=lambda L: (abs(L - len(cand_tokens)), L),
+        )
+        cand_counts = _ngram_counts(cand_tokens, n_gram)
+        ref_counts = [_ngram_counts(r, n_gram) for r in ref_tokens]
+        for n in range(n_gram):
+            max_ref: Counter = Counter()
+            for rc in ref_counts:
+                for gram, count in rc[n].items():
+                    max_ref[gram] = max(max_ref[gram], count)
+            matches[n] += sum(
+                min(count, max_ref[gram])
+                for gram, count in cand_counts[n].items()
+            )
+            possible[n] += max(0, len(cand_tokens) - n)
+    return input_len, target_len, matches, possible
+
+
+@jax.jit
+def _bleu_compute(
+    input_len: jax.Array,
+    target_len: jax.Array,
+    matches: jax.Array,
+    possible: jax.Array,
+    weights: jax.Array,
+) -> jax.Array:
+    """Brevity penalty × exp(Σ wₙ log pₙ); 0 when any order has no match
+    (log undefined — standard corpus-BLEU convention)."""
+    precisions = matches / jnp.maximum(possible, 1.0)
+    log_p = jnp.log(jnp.maximum(precisions, 1e-30))
+    geo = jnp.exp((weights * log_p).sum())
+    bp = jnp.where(
+        input_len > target_len, 1.0, jnp.exp(1.0 - target_len / input_len)
+    )
+    return jnp.where((matches == 0).any() | (input_len == 0), 0.0, bp * geo)

@@ -1,0 +1,62 @@
+"""Perplexity from next-token logits.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added ``perplexity``
+later).  The one genuinely-device text metric: sufficient statistics are
+the summed token negative log-likelihood and the token count, produced by
+a single fused ``log_softmax`` + gather kernel — add-mergeable,
+``psum``-syncable."""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def perplexity(
+    input,
+    target,
+    ignore_index: Optional[int] = None,
+) -> jax.Array:
+    """``exp(mean NLL)`` over ``(n, seq_len, vocab)`` logits and
+    ``(n, seq_len)`` target token ids; ``ignore_index`` tokens are
+    excluded from the mean."""
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _perplexity_input_check(input, target)
+    sum_nll, count = _perplexity_update_kernel(input, target, ignore_index)
+    return _perplexity_compute(sum_nll, count)
+
+
+@partial(jax.jit, static_argnames=("ignore_index",))
+def _perplexity_update_kernel(
+    input: jax.Array, target: jax.Array, ignore_index: Optional[int]
+) -> Tuple[jax.Array, jax.Array]:
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
+    token_ll = jnp.take_along_axis(logp, target[..., None], axis=-1)[..., 0]
+    if ignore_index is None:
+        return -token_ll.sum(), jnp.asarray(token_ll.size, jnp.float32)
+    mask = target != ignore_index
+    return -(token_ll * mask).sum(), mask.sum().astype(jnp.float32)
+
+
+@jax.jit
+def _perplexity_compute(sum_nll: jax.Array, count: jax.Array) -> jax.Array:
+    return jnp.exp(sum_nll / count)
+
+
+def _perplexity_input_check(input: jax.Array, target: jax.Array) -> None:
+    if input.ndim != 3:
+        raise ValueError(
+            "input should have shape (num_sequences, num_tokens, vocab_size), "
+            f"got {input.shape}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            "target should have shape (num_sequences, num_tokens), "
+            f"got {target.shape}."
+        )
+    if input.shape[:2] != target.shape:
+        raise ValueError(
+            "The leading dimensions of input and target should match, got "
+            f"{input.shape} and {target.shape}."
+        )

@@ -1,0 +1,87 @@
+"""Word error rate and word information preserved/lost.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added the text metrics
+later).  These are host-side string metrics — no device tensor exists
+until the sufficient statistics are formed — so the hot kernel is the
+native batched Levenshtein in ``torcheval_tpu/native`` (C++ via ctypes,
+pure-Python fallback).  Sufficient statistics are scalar counters,
+add-mergeable like every counter metric here.
+
+WER  = edit_errors / target_words
+WIP  = (target_words − errors)/target_words · (target_words − errors)/input_words
+       (the Morris et al. hit proxy H ≈ N_ref − E in both numerators)
+WIL  = 1 − WIP
+"""
+
+from typing import List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.native import edit_distance_batch
+
+TText = Union[str, Sequence[str]]
+
+
+def word_error_rate(input: TText, target: TText) -> jax.Array:
+    """WER over one or more (hypothesis, reference) string pairs."""
+    errors, target_total, _ = _word_stats_update(input, target)
+    return jnp.asarray(errors / target_total if target_total else float("nan"))
+
+
+def word_information_preserved(input: TText, target: TText) -> jax.Array:
+    """Word information preserved over (hypothesis, reference) pairs."""
+    errors, target_total, input_total = _word_stats_update(input, target)
+    return _wip_compute(
+        jnp.asarray(float(errors)),
+        jnp.asarray(float(target_total)),
+        jnp.asarray(float(input_total)),
+    )
+
+
+def word_information_lost(input: TText, target: TText) -> jax.Array:
+    """Word information lost: ``1 − WIP``."""
+    return 1.0 - word_information_preserved(input, target)
+
+
+@jax.jit
+def _wip_compute(
+    errors: jax.Array, target_total: jax.Array, input_total: jax.Array
+) -> jax.Array:
+    hits = target_total - errors
+    return (hits / target_total) * (hits / input_total)
+
+
+def _as_list(text: TText, name: str) -> List[str]:
+    if isinstance(text, str):
+        return [text]
+    if isinstance(text, Sequence) and all(isinstance(t, str) for t in text):
+        return list(text)
+    raise ValueError(
+        f"`{name}` should be a string or a sequence of strings, got {type(text)}."
+    )
+
+
+def _word_stats_update(input: TText, target: TText) -> Tuple[int, int, int]:
+    """(edit errors, target word count, input word count) over the batch —
+    the shared sufficient statistics of WER/WIP/WIL."""
+    input, target = _as_list(input, "input"), _as_list(target, "target")
+    if len(input) != len(target):
+        raise ValueError(
+            "`input` and `target` should have the same number of sequences, "
+            f"got {len(input)} and {len(target)}."
+        )
+    vocab: dict = {}
+
+    def ids(sentence: str) -> List[int]:
+        return [vocab.setdefault(w, len(vocab)) for w in sentence.split()]
+
+    input_ids = [ids(s) for s in input]
+    target_ids = [ids(s) for s in target]
+    errors = int(np.sum(edit_distance_batch(input_ids, target_ids))) if input else 0
+    return (
+        errors,
+        sum(len(s) for s in target_ids),
+        sum(len(s) for s in input_ids),
+    )

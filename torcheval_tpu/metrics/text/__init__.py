@@ -1,0 +1,15 @@
+from torcheval_tpu.metrics.text.bleu import BLEUScore
+from torcheval_tpu.metrics.text.perplexity import Perplexity
+from torcheval_tpu.metrics.text.word_error_rate import (
+    WordErrorRate,
+    WordInformationLost,
+    WordInformationPreserved,
+)
+
+__all__ = [
+    "BLEUScore",
+    "Perplexity",
+    "WordErrorRate",
+    "WordInformationLost",
+    "WordInformationPreserved",
+]

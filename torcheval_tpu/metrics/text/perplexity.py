@@ -1,0 +1,56 @@
+"""Perplexity class metric — two scalar counters (summed NLL + token
+count), add-mergeable, ``psum``-syncable.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added ``Perplexity``
+later)."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    _accum_dtype,
+)
+from torcheval_tpu.metrics.functional.text.perplexity import (
+    _perplexity_compute,
+    _perplexity_input_check,
+    _perplexity_update_kernel,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class Perplexity(Metric[jax.Array]):
+    """``exp(mean NLL)`` over all tokens seen, excluding ``ignore_index``."""
+
+    def __init__(self, *, ignore_index: Optional[int] = None, device=None) -> None:
+        super().__init__(device=device)
+        self.ignore_index = ignore_index
+        # Accumulator dtype: token counts past 2^24 would stop advancing in
+        # float32 — exactly the corpus sizes a streaming LM eval reaches.
+        dtype = _accum_dtype()
+        self._add_state("sum_log_probs", jnp.asarray(0.0, dtype=dtype))
+        self._add_state("num_total", jnp.asarray(0.0, dtype=dtype))
+
+    def update(self, input, target) -> "Perplexity":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _perplexity_input_check(input, target)
+        # Kernel + both state adds fused into one dispatch (_fuse.py).
+        self.sum_log_probs, self.num_total = accumulate(
+            _perplexity_update_kernel,
+            (self.sum_log_probs, self.num_total),
+            input,
+            target,
+            statics=(self.ignore_index,),
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """Perplexity; NaN before any update (exp(0/0))."""
+        return _perplexity_compute(self.sum_log_probs, self.num_total)
+
+    def merge_state(self, metrics: Iterable["Perplexity"]) -> "Perplexity":
+        merge_add(self, metrics, "sum_log_probs", "num_total")
+        return self

@@ -1,0 +1,67 @@
+"""Word error rate / word information class metrics — scalar counter
+states fed by the native batched edit-distance kernel.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added the text metrics
+later)."""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
+    _accum_dtype,
+)
+from torcheval_tpu.metrics.functional.text.word_error_rate import (
+    TText,
+    _wip_compute,
+    _word_stats_update,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+_STATES = ("errors", "target_total", "input_total")
+
+
+class _WordStatsMetric(Metric[jax.Array]):
+    """Shared state machine: the three word-alignment counters."""
+
+    def __init__(self, *, device=None) -> None:
+        super().__init__(device=device)
+        for name in _STATES:
+            self._add_state(name, jnp.asarray(0.0, dtype=_accum_dtype()))
+
+    def update(self, input: TText, target: TText):
+        errors, target_total, input_total = _word_stats_update(input, target)
+        # Host-computed scalars fold into the states in one tiny dispatch.
+        self.errors = self.errors + errors
+        self.target_total = self.target_total + target_total
+        self.input_total = self.input_total + input_total
+        return self
+
+    def merge_state(self, metrics: Iterable["_WordStatsMetric"]):
+        merge_add(self, metrics, *_STATES)
+        return self
+
+
+class WordErrorRate(_WordStatsMetric):
+    """WER = edit errors / reference words; NaN before any update (0/0)."""
+
+    def compute(self) -> jax.Array:
+        return self.errors / self.target_total
+
+
+class WordInformationPreserved(_WordStatsMetric):
+    """WIP over all pairs seen; NaN before any update (0/0)."""
+
+    def compute(self) -> jax.Array:
+        return _wip_compute(self.errors, self.target_total, self.input_total)
+
+
+class WordInformationLost(_WordStatsMetric):
+    """WIL = 1 − WIP; NaN before any update (0/0)."""
+
+    def compute(self) -> jax.Array:
+        return 1.0 - _wip_compute(
+            self.errors, self.target_total, self.input_total
+        )

@@ -1,0 +1,12 @@
+"""Native (C++) host-side kernels.
+
+The compute path of this framework is JAX/XLA/Pallas on TPU; the kernels
+here cover host-side work with no device tensor involved (tokenized edit
+distance for the text metrics).  Each module compiles its C++ lazily with
+the system toolchain and falls back to a pure-Python implementation when
+no compiler is available, so the package never hard-requires a build
+step."""
+
+from torcheval_tpu.native.edit_distance import edit_distance_batch
+
+__all__ = ["edit_distance_batch"]

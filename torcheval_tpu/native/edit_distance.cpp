@@ -1,0 +1,57 @@
+// Batched Levenshtein distance over tokenized sequences.
+//
+// The text metrics (word error rate, word information preserved/lost) are
+// host-side string work — there is no TPU tensor in sight — so their hot
+// kernel is native C++ rather than XLA, mirroring how the reference family
+// of libraries backs text metrics with native edit-distance kernels.
+// Tokens arrive as int32 ids (the Python side interns words); distances
+// use the classic two-row dynamic program, O(len_a * len_b) time and
+// O(min_len) space per pair.
+//
+// Exposed via a plain C ABI for ctypes: no pybind11 dependency.
+
+#include <algorithm>
+#include <cstdint>
+#include <vector>
+
+extern "C" {
+
+// Edit distance between a[0:na] and b[0:nb].
+int64_t tvt_levenshtein(const int32_t* a, int64_t na, const int32_t* b,
+                        int64_t nb) {
+  if (na == 0) return nb;
+  if (nb == 0) return na;
+  // Iterate over the longer sequence, keep rows over the shorter one.
+  if (nb > na) {
+    std::swap(a, b);
+    std::swap(na, nb);
+  }
+  std::vector<int64_t> row(static_cast<size_t>(nb) + 1);
+  for (int64_t j = 0; j <= nb; ++j) row[static_cast<size_t>(j)] = j;
+  for (int64_t i = 1; i <= na; ++i) {
+    int64_t diag = row[0];
+    row[0] = i;
+    for (int64_t j = 1; j <= nb; ++j) {
+      int64_t up = row[static_cast<size_t>(j)];
+      int64_t cost = (a[i - 1] == b[j - 1]) ? diag : diag + 1;
+      row[static_cast<size_t>(j)] =
+          std::min({cost, up + 1, row[static_cast<size_t>(j - 1)] + 1});
+      diag = up;
+    }
+  }
+  return row[static_cast<size_t>(nb)];
+}
+
+// Batched form: pair i spans a[a_offsets[i]:a_offsets[i+1]] vs
+// b[b_offsets[i]:b_offsets[i+1]]; writes out[i].  One ctypes crossing for
+// the whole batch.
+void tvt_levenshtein_batch(const int32_t* a, const int64_t* a_offsets,
+                           const int32_t* b, const int64_t* b_offsets,
+                           int64_t n_pairs, int64_t* out) {
+  for (int64_t i = 0; i < n_pairs; ++i) {
+    out[i] = tvt_levenshtein(a + a_offsets[i], a_offsets[i + 1] - a_offsets[i],
+                             b + b_offsets[i], b_offsets[i + 1] - b_offsets[i]);
+  }
+}
+
+}  // extern "C"
