@@ -1,0 +1,164 @@
+"""Retrieval precision/recall at k vs a numpy oracle — functional and
+class forms, k/limit_k_to_size semantics, multi-task, merge, protocol."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import RetrievalPrecision, RetrievalRecall
+from torcheval_tpu.metrics.functional import retrieval_precision, retrieval_recall
+
+
+def _oracle(scores, target, k=None, limit_k_to_size=False):
+    n = len(scores)
+    k_eff = n if k is None else (min(k, n) if limit_k_to_size else k)
+    top = np.argsort(-scores, kind="stable")[: min(k_eff, n)]
+    hits = target[top].sum()
+    return hits / k_eff, hits / target.sum()
+
+
+class TestRetrievalFunctional(unittest.TestCase):
+    def test_matches_oracle(self):
+        rng = np.random.default_rng(0)
+        for trial in range(5):
+            n = int(rng.integers(5, 40))
+            scores = rng.random(n).astype(np.float32)
+            target = (rng.random(n) > 0.5).astype(np.float32)
+            target[0] = 1.0
+            for k in (None, 1, 3, n, n + 5):
+                for limit in (False, True):
+                    if limit and k is None:
+                        continue
+                    want_p, want_r = _oracle(scores, target, k, limit)
+                    got_p = retrieval_precision(
+                        jnp.asarray(scores), jnp.asarray(target), k,
+                        limit_k_to_size=limit,
+                    )
+                    got_r = retrieval_recall(
+                        jnp.asarray(scores), jnp.asarray(target), k,
+                        limit_k_to_size=limit,
+                    )
+                    self.assertAlmostEqual(
+                        float(got_p), float(want_p), places=5,
+                        msg=f"p@{k} limit={limit}",
+                    )
+                    self.assertAlmostEqual(
+                        float(got_r), float(want_r), places=5,
+                        msg=f"r@{k} limit={limit}",
+                    )
+
+    def test_hand_checked(self):
+        scores = jnp.asarray([0.9, 0.1, 0.8, 0.7])
+        target = jnp.asarray([1.0, 1.0, 0.0, 1.0])
+        # top-2 = items 0, 2 -> 1 relevant of 2; recall 1/3
+        self.assertAlmostEqual(
+            float(retrieval_precision(scores, target, 2)), 0.5, places=6
+        )
+        self.assertAlmostEqual(
+            float(retrieval_recall(scores, target, 2)), 1 / 3, places=6
+        )
+        # k beyond size without limit penalizes precision
+        self.assertAlmostEqual(
+            float(retrieval_precision(scores, target, 8)), 3 / 8, places=6
+        )
+        self.assertAlmostEqual(
+            float(
+                retrieval_precision(scores, target, 8, limit_k_to_size=True)
+            ),
+            3 / 4,
+            places=6,
+        )
+
+    def test_multitask(self):
+        rng = np.random.default_rng(1)
+        scores = rng.random((3, 10)).astype(np.float32)
+        target = (rng.random((3, 10)) > 0.4).astype(np.float32)
+        got = np.asarray(
+            retrieval_precision(
+                jnp.asarray(scores), jnp.asarray(target), 4, num_tasks=3
+            )
+        )
+        want = [_oracle(scores[i], target[i], 4)[0] for i in range(3)]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_param_and_input_checks(self):
+        with self.assertRaisesRegex(ValueError, "positive integer"):
+            retrieval_precision(jnp.zeros(3), jnp.zeros(3), 0)
+        with self.assertRaisesRegex(ValueError, "must not be None"):
+            retrieval_precision(
+                jnp.zeros(3), jnp.zeros(3), None, limit_k_to_size=True
+            )
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            retrieval_precision(jnp.zeros(3), jnp.zeros(4))
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            retrieval_precision(jnp.zeros((2, 3)), jnp.zeros((2, 3)))
+        with self.assertRaisesRegex(ValueError, "binary tensor"):
+            retrieval_recall(
+                jnp.asarray([0.9, 0.8]), jnp.asarray([0.5, 1.0]), 2
+            )
+
+
+class TestRetrievalClasses(unittest.TestCase):
+    def test_per_query_buffer_and_merge(self):
+        rng = np.random.default_rng(2)
+        queries = []
+        for _ in range(6):
+            n = int(rng.integers(4, 12))
+            s = rng.random(n).astype(np.float32)
+            t = (rng.random(n) > 0.5).astype(np.float32)
+            t[0] = 1.0
+            queries.append((s, t))
+        m = RetrievalPrecision(k=3)
+        for s, t in queries:
+            m.update(jnp.asarray(s), jnp.asarray(t))
+        got = np.asarray(m.compute())
+        want = [_oracle(s, t, 3)[0] for s, t in queries]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+        a, b = RetrievalRecall(k=2), RetrievalRecall(k=2)
+        for s, t in queries[:3]:
+            a.update(jnp.asarray(s), jnp.asarray(t))
+        for s, t in queries[3:]:
+            b.update(jnp.asarray(s), jnp.asarray(t))
+        a.merge_state([b])
+        got = np.asarray(a.compute())
+        want = [_oracle(s, t, 2)[1] for s, t in queries]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+        self.assertEqual(RetrievalPrecision().compute().shape, (0,))
+
+    def test_class_protocol(self):
+        from torcheval_tpu.utils.test_utils.metric_class_tester import (
+            BATCH_SIZE,
+            NUM_TOTAL_UPDATES,
+            MetricClassTester,
+        )
+
+        class _T(MetricClassTester):
+            def runTest(self):  # pragma: no cover
+                pass
+
+        rng = np.random.default_rng(3)
+        input = rng.random((NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(np.float32)
+        target = rng.integers(0, 2, (NUM_TOTAL_UPDATES, BATCH_SIZE)).astype(
+            np.float32
+        )
+        target[:, 0] = 1.0
+        expected = np.asarray(
+            [_oracle(s, t, 4)[0] for s, t in zip(input, target)],
+            dtype=np.float32,
+        )
+        _T().run_class_implementation_tests(
+            metric=RetrievalPrecision(k=4),
+            state_names={"scores"},
+            update_kwargs={"input": list(input), "target": list(target)},
+            compute_result=expected,
+            atol=1e-6,
+            rtol=1e-5,
+            test_merge_with_one_update=False,
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

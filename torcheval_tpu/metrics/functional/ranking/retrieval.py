@@ -1,0 +1,127 @@
+"""Retrieval precision / recall at k.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added the retrieval
+metrics later).  One call scores one query's candidate list (or
+``num_tasks`` of them via a leading dim):
+
+precision@k = relevant-in-top-k / k_eff
+recall@k    = relevant-in-top-k / total-relevant
+
+``k=None`` uses every candidate; ``limit_k_to_size`` clamps ``k`` to the
+candidate count (so precision is not penalized for short lists).  The
+top-k selection is a single ``lax.top_k`` — MXU-free, fused with the
+gather and reductions under jit."""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.functional._host_checks import all_concrete
+
+
+def retrieval_precision(
+    input,
+    target,
+    k: Optional[int] = None,
+    *,
+    limit_k_to_size: bool = False,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """Fraction of the top-``k`` scored candidates that are relevant."""
+    input, target, k_eff, k_sel = _retrieval_prepare(
+        input, target, k, limit_k_to_size, num_tasks
+    )
+    hits = _topk_hits(input, target, k_sel)
+    out = hits / k_eff
+    return out[0] if num_tasks == 1 else out
+
+
+def retrieval_recall(
+    input,
+    target,
+    k: Optional[int] = None,
+    *,
+    limit_k_to_size: bool = False,
+    num_tasks: int = 1,
+) -> jax.Array:
+    """Fraction of all relevant candidates found in the top ``k``."""
+    input, target, _, k_sel = _retrieval_prepare(
+        input, target, k, limit_k_to_size, num_tasks
+    )
+    hits = _topk_hits(input, target, k_sel)
+    total = (target == 1).sum(axis=-1)
+    out = hits / total
+    return out[0] if num_tasks == 1 else out
+
+
+def _retrieval_prepare(
+    input,
+    target,
+    k: Optional[int],
+    limit_k_to_size: bool,
+    num_tasks: int,
+) -> Tuple[jax.Array, jax.Array, int, int]:
+    """Validate, lift to (num_tasks, n), and resolve the effective k
+    (the precision denominator) and the selection k (``<= n``)."""
+    _retrieval_param_check(k, limit_k_to_size)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _retrieval_input_check(input, target, num_tasks)
+    if input.ndim == 1:
+        input, target = input[None], target[None]
+    n = input.shape[-1]
+    k_eff = n if k is None else (min(k, n) if limit_k_to_size else k)
+    return input, target, k_eff, min(k_eff, n)
+
+
+@partial(jax.jit, static_argnames=("k_sel",))
+def _topk_hits(input: jax.Array, target: jax.Array, k_sel: int) -> jax.Array:
+    """Relevant count among each row's top ``k_sel`` scored candidates."""
+    _, idx = jax.lax.top_k(input, k_sel)
+    return jnp.take_along_axis(target, idx, axis=-1).sum(axis=-1)
+
+
+def _retrieval_param_check(k: Optional[int], limit_k_to_size: bool) -> None:
+    if k is not None and k < 1:
+        raise ValueError(f"`k` should be a positive integer, got k={k}.")
+    if limit_k_to_size and k is None:
+        raise ValueError(
+            "when `limit_k_to_size` is True, `k` must not be None."
+        )
+
+
+def _retrieval_input_check(
+    input: jax.Array, target: jax.Array, num_tasks: int
+) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same shape, "
+            f"got shapes {input.shape} and {target.shape}."
+        )
+    if num_tasks == 1:
+        if input.ndim != 1:
+            raise ValueError(
+                "`input` should be a one-dimensional tensor for num_tasks = 1, "
+                f"got shape {input.shape}."
+            )
+    elif input.ndim != 2 or input.shape[0] != num_tasks:
+        raise ValueError(
+            f"`input` should have shape ({num_tasks}, num_candidates) for "
+            f"num_tasks = {num_tasks}, got shape {input.shape}."
+        )
+    # Relevance must be 0/1 — graded targets would inflate the top-k hit
+    # sum against the exact-1 relevant count.  Data-dependent, so skipped
+    # under tracing like every host-side value check (_host_checks.py).
+    if target.size and all_concrete(target):
+        ok = np.asarray(jax.device_get(_binary_target_probe(target)))
+        if not bool(ok):
+            raise ValueError(
+                "`target` should be a binary tensor of 0/1 relevance labels."
+            )
+
+
+@jax.jit
+def _binary_target_probe(target: jax.Array) -> jax.Array:
+    return jnp.all((target == 0) | (target == 1))

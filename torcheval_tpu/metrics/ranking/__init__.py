@@ -1,5 +1,12 @@
 from torcheval_tpu.metrics.ranking.hit_rate import HitRate
 from torcheval_tpu.metrics.ranking.reciprocal_rank import ReciprocalRank
+from torcheval_tpu.metrics.ranking.retrieval import RetrievalPrecision, RetrievalRecall
 from torcheval_tpu.metrics.ranking.weighted_calibration import WeightedCalibration
 
-__all__ = ["HitRate", "ReciprocalRank", "WeightedCalibration"]
+__all__ = [
+    "HitRate",
+    "ReciprocalRank",
+    "RetrievalPrecision",
+    "RetrievalRecall",
+    "WeightedCalibration",
+]

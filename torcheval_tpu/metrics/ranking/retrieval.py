@@ -1,0 +1,84 @@
+"""Retrieval precision / recall class metrics — per-query score buffers,
+like HitRate/ReciprocalRank: each update scores one query (or
+``num_tasks`` of them) and appends; compute concatenates the per-query
+values.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added the retrieval
+metrics later)."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
+from torcheval_tpu.metrics.functional.ranking.retrieval import (
+    retrieval_precision,
+    retrieval_recall,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class _RetrievalMetric(Metric[jax.Array]):
+    """Shared buffer machinery; subclasses pick the per-query scorer."""
+
+    _scorer = None
+
+    def __init__(
+        self,
+        *,
+        k: Optional[int] = None,
+        limit_k_to_size: bool = False,
+        num_tasks: int = 1,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.k = k
+        self.limit_k_to_size = limit_k_to_size
+        self.num_tasks = num_tasks
+        self._add_state("scores", [])
+
+    def update(self, input, target):
+        value = type(self)._scorer(
+            input,
+            target,
+            self.k,
+            limit_k_to_size=self.limit_k_to_size,
+            num_tasks=self.num_tasks,
+        )
+        self.scores.append(
+            jax.device_put(jnp.atleast_1d(value), self.device)
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """Per-query values, concatenated over updates (shape
+        ``(num_queries,)``, or ``(num_queries * num_tasks,)`` for
+        multi-task); empty array before any update."""
+        if not self.scores:
+            return jnp.zeros(0)
+        return jnp.concatenate(self.scores, axis=0)
+
+    def merge_state(self, metrics: Iterable["_RetrievalMetric"]):
+        merge_concat_buffers(self, metrics, "scores", dim=0)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "scores", dim=0)
+
+
+class RetrievalPrecision(_RetrievalMetric):
+    """precision@k per query seen."""
+
+    _scorer = staticmethod(retrieval_precision)
+
+
+class RetrievalRecall(_RetrievalMetric):
+    """recall@k per query seen."""
+
+    _scorer = staticmethod(retrieval_recall)
