@@ -1,0 +1,241 @@
+"""Image metrics: PSNR vs a numpy oracle, SSIM vs an independent numpy
+implementation and known identities, Fréchet distance vs analytic
+gaussian cases, class lifecycle and merge."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    FrechetInceptionDistance,
+    PeakSignalNoiseRatio,
+    StructuralSimilarity,
+)
+from torcheval_tpu.metrics.functional import (
+    gaussian_frechet_distance,
+    peak_signal_noise_ratio,
+    structural_similarity,
+)
+
+
+class TestPSNR(unittest.TestCase):
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        a = rng.random((2, 3, 16, 16)).astype(np.float32)
+        b = rng.random((2, 3, 16, 16)).astype(np.float32)
+        mse = np.mean((a - b) ** 2)
+        want = 10 * np.log10(1.0 / mse)
+        got = peak_signal_noise_ratio(jnp.asarray(a), jnp.asarray(b), data_range=1.0)
+        self.assertAlmostEqual(float(got), float(want), places=4)
+
+    def test_default_data_range_from_target(self):
+        rng = np.random.default_rng(1)
+        a = rng.random(100).astype(np.float32) * 50
+        b = rng.random(100).astype(np.float32) * 50
+        dr = b.max() - b.min()
+        want = 10 * np.log10(dr**2 / np.mean((a - b) ** 2))
+        got = peak_signal_noise_ratio(jnp.asarray(a), jnp.asarray(b))
+        self.assertAlmostEqual(float(got), float(want), places=3)
+
+    def test_param_checks(self):
+        with self.assertRaisesRegex(ValueError, "positive"):
+            peak_signal_noise_ratio(jnp.zeros(3), jnp.zeros(3), data_range=-1.0)
+        with self.assertRaisesRegex(ValueError, "same shape"):
+            peak_signal_noise_ratio(jnp.zeros(3), jnp.zeros(4))
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(2)
+        a = rng.random((8, 1, 8, 8)).astype(np.float32)
+        b = rng.random((8, 1, 8, 8)).astype(np.float32)
+        want = 10 * np.log10(1.0 / np.mean((a - b) ** 2))
+        m = PeakSignalNoiseRatio(data_range=1.0)
+        for k in range(4):
+            m.update(jnp.asarray(a[2 * k : 2 * k + 2]), jnp.asarray(b[2 * k : 2 * k + 2]))
+        self.assertAlmostEqual(float(m.compute()), float(want), places=4)
+
+        # default data range merges min/max across shards
+        x, y = PeakSignalNoiseRatio(), PeakSignalNoiseRatio()
+        x.update(jnp.asarray(a[:4]), jnp.asarray(b[:4]))
+        y.update(jnp.asarray(a[4:]), jnp.asarray(b[4:]))
+        x.merge_state([y])
+        dr = b.max() - b.min()
+        want_d = 10 * np.log10(dr**2 / np.mean((a - b) ** 2))
+        self.assertAlmostEqual(float(x.compute()), float(want_d), places=3)
+
+
+def _ssim_numpy(a, b, data_range=1.0, ks=11, sigma=1.5, k1=0.01, k2=0.03):
+    """Independent per-image SSIM: explicit gaussian window sums."""
+    half = (ks - 1) / 2.0
+    g = np.exp(-((np.arange(ks) - half) ** 2) / (2 * sigma**2))
+    g /= g.sum()
+    w = np.outer(g, g)
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+
+    def blur(img):  # (H, W) valid windowed weighted mean
+        H, W = img.shape
+        out = np.zeros((H - ks + 1, W - ks + 1))
+        for i in range(out.shape[0]):
+            for j in range(out.shape[1]):
+                out[i, j] = (img[i : i + ks, j : j + ks] * w).sum()
+        return out
+
+    vals = []
+    for n in range(a.shape[0]):
+        ch_vals = []
+        for c in range(a.shape[1]):
+            x, y = a[n, c], b[n, c]
+            mx, my = blur(x), blur(y)
+            sx = blur(x * x) - mx * mx
+            sy = blur(y * y) - my * my
+            sxy = blur(x * y) - mx * my
+            s = ((2 * mx * my + c1) * (2 * sxy + c2)) / (
+                (mx * mx + my * my + c1) * (sx + sy + c2)
+            )
+            ch_vals.append(s.mean())
+        vals.append(np.mean(ch_vals))
+    return np.mean(vals)
+
+
+class TestSSIM(unittest.TestCase):
+    def test_identical_is_one(self):
+        rng = np.random.default_rng(3)
+        a = rng.random((2, 3, 16, 16)).astype(np.float32)
+        got = structural_similarity(jnp.asarray(a), jnp.asarray(a))
+        self.assertAlmostEqual(float(got), 1.0, places=5)
+
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(4)
+        a = rng.random((2, 2, 14, 14)).astype(np.float32)
+        b = np.clip(a + rng.normal(0, 0.1, a.shape), 0, 1).astype(np.float32)
+        want = _ssim_numpy(a, b)
+        got = structural_similarity(jnp.asarray(a), jnp.asarray(b))
+        self.assertAlmostEqual(float(got), float(want), places=4)
+
+    def test_noise_lowers_ssim(self):
+        rng = np.random.default_rng(5)
+        a = rng.random((1, 1, 32, 32)).astype(np.float32)
+        small = np.clip(a + rng.normal(0, 0.02, a.shape), 0, 1).astype(np.float32)
+        big = np.clip(a + rng.normal(0, 0.3, a.shape), 0, 1).astype(np.float32)
+        s_small = float(structural_similarity(jnp.asarray(a), jnp.asarray(small)))
+        s_big = float(structural_similarity(jnp.asarray(a), jnp.asarray(big)))
+        self.assertGreater(s_small, s_big)
+
+    def test_input_checks(self):
+        with self.assertRaisesRegex(ValueError, "num_images"):
+            structural_similarity(jnp.zeros((16, 16)), jnp.zeros((16, 16)))
+        with self.assertRaisesRegex(ValueError, "kernel size"):
+            structural_similarity(jnp.zeros((1, 1, 8, 8)), jnp.zeros((1, 1, 8, 8)))
+
+    def test_class_lifecycle_and_merge(self):
+        rng = np.random.default_rng(6)
+        a = rng.random((4, 1, 16, 16)).astype(np.float32)
+        b = np.clip(a + rng.normal(0, 0.05, a.shape), 0, 1).astype(np.float32)
+        want = float(structural_similarity(jnp.asarray(a), jnp.asarray(b)))
+        x, y = StructuralSimilarity(), StructuralSimilarity()
+        x.update(jnp.asarray(a[:2]), jnp.asarray(b[:2]))
+        y.update(jnp.asarray(a[2:]), jnp.asarray(b[2:]))
+        x.merge_state([y])
+        self.assertAlmostEqual(float(x.compute()), want, places=5)
+
+
+class TestFrechet(unittest.TestCase):
+    def test_identical_gaussians_zero(self):
+        rng = np.random.default_rng(7)
+        d = 5
+        A = rng.normal(size=(d, d))
+        cov = A @ A.T + np.eye(d)
+        mu = rng.normal(size=d)
+        got = gaussian_frechet_distance(mu, cov, mu, cov)
+        self.assertAlmostEqual(float(got), 0.0, places=3)
+
+    def test_mean_shift_only(self):
+        # same covariance: distance = |mu1 - mu2|^2
+        d = 4
+        cov = np.eye(d) * 2.0
+        mu1, mu2 = np.zeros(d), np.full(d, 3.0)
+        got = gaussian_frechet_distance(mu1, cov, mu2, cov)
+        self.assertAlmostEqual(float(got), 9.0 * d, places=3)
+
+    def test_isotropic_scale(self):
+        # diagonal covariances: tr(C1 + C2 - 2 sqrt(C1 C2)) elementwise
+        d = 3
+        c1, c2 = np.eye(d) * 4.0, np.eye(d) * 9.0
+        want = d * (4 + 9 - 2 * 6)  # sqrt(36) = 6
+        got = gaussian_frechet_distance(np.zeros(d), c1, np.zeros(d), c2)
+        self.assertAlmostEqual(float(got), float(want), places=3)
+
+    def test_input_checks(self):
+        with self.assertRaisesRegex(ValueError, "one-dimensional"):
+            gaussian_frechet_distance(np.zeros((2, 2)), np.eye(2), np.zeros(2), np.eye(2))
+        with self.assertRaisesRegex(ValueError, "covariances"):
+            gaussian_frechet_distance(np.zeros(2), np.eye(3), np.zeros(2), np.eye(2))
+
+
+class TestFID(unittest.TestCase):
+    def _extractor(self):
+        rng = np.random.default_rng(8)
+        proj = jnp.asarray(rng.normal(size=(3 * 8 * 8, 6)).astype(np.float32))
+
+        def model(images):
+            flat = jnp.reshape(jnp.asarray(images), (images.shape[0], -1))
+            return flat @ proj
+
+        return model
+
+    def test_matches_scipy_sqrtm_oracle(self):
+        import scipy.linalg as sla
+
+        rng = np.random.default_rng(9)
+        model = self._extractor()
+        m = FrechetInceptionDistance(model, feature_dim=6)
+        data = rng.random((256, 3, 8, 8)).astype(np.float32)
+        m.update(jnp.asarray(data[:128]), is_real=True)
+        m.update(jnp.asarray(data[128:]), is_real=False)
+        got = float(m.compute())
+        feats = np.asarray(model(jnp.asarray(data)))
+        fr, ff = feats[:128], feats[128:]
+        c1 = np.cov(fr, rowvar=False)
+        c2 = np.cov(ff, rowvar=False)
+        want = float(
+            ((fr.mean(0) - ff.mean(0)) ** 2).sum()
+            + np.trace(c1 + c2 - 2 * sla.sqrtm(c1 @ c2).real)
+        )
+        # float32 streaming covariance vs float64 scipy: a few percent
+        self.assertLess(abs(got - want) / want, 0.05)
+
+    def test_shifted_distribution_positive_and_merge(self):
+        rng = np.random.default_rng(10)
+        model = self._extractor()
+        real = rng.random((64, 3, 8, 8)).astype(np.float32)
+        fake = rng.random((64, 3, 8, 8)).astype(np.float32) + 1.0
+        m = FrechetInceptionDistance(model, feature_dim=6)
+        m.update(jnp.asarray(real), is_real=True)
+        m.update(jnp.asarray(fake), is_real=False)
+        single = float(m.compute())
+        self.assertGreater(single, 1.0)
+
+        a = FrechetInceptionDistance(model, feature_dim=6)
+        b = FrechetInceptionDistance(model, feature_dim=6)
+        a.update(jnp.asarray(real[:32]), is_real=True)
+        a.update(jnp.asarray(fake[:32]), is_real=False)
+        b.update(jnp.asarray(real[32:]), is_real=True)
+        b.update(jnp.asarray(fake[32:]), is_real=False)
+        a.merge_state([b])
+        self.assertAlmostEqual(float(a.compute()), single, places=2)
+
+    def test_guards(self):
+        model = self._extractor()
+        with self.assertRaisesRegex(ValueError, "callable"):
+            FrechetInceptionDistance("inception", feature_dim=6)
+        m = FrechetInceptionDistance(model, feature_dim=6)
+        with self.assertRaisesRegex(RuntimeError, "at least 2 real"):
+            m.compute()
+        with self.assertRaisesRegex(ValueError, "feature extractor"):
+            FrechetInceptionDistance(lambda x: jnp.zeros((2, 3)), feature_dim=6).update(
+                jnp.zeros((2, 3, 8, 8)), is_real=True
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

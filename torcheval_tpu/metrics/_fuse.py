@@ -25,14 +25,15 @@ def _accumulate_jit(states, args, kernel, statics, grow, fold):
     if not isinstance(deltas, tuple):
         deltas = (deltas,)
     out = []
-    for s, d in zip(states, deltas):
+    for i, (s, d) in enumerate(zip(states, deltas)):
         if grow and s.ndim == 0 and d.ndim == 1:
             # Per-output regression states replace the scalar default on the
             # first 2-D update instead of broadcasting into it (reference
             # ``regression/mean_squared_error.py`` state-growth behavior).
             out.append(d)
         else:
-            out.append(s + d if fold is None else fold(s, d))
+            f = fold[i] if isinstance(fold, tuple) else fold
+            out.append(s + d if f is None else f(s, d))
     return tuple(out)
 
 
@@ -51,7 +52,9 @@ def accumulate(
     identity is part of the jit cache key.  ``statics`` are hashable
     trace-time constants appended positionally after ``args``.  ``fold``
     combines ``(state, delta)`` and defaults to addition; pass e.g.
-    ``jnp.minimum`` for extremum states (Min/Max).  ``grow=True``
+    ``jnp.minimum`` for extremum states (Min/Max), or a per-state tuple
+    (``None`` entries mean addition) — give the tuple a stable module-level
+    identity, since ``fold`` is part of the jit cache key.  ``grow=True``
     replicates the scalar→vector replace-on-first-2-D-update semantics of
     per-output regression states.  Returns the new state tuple.
     """

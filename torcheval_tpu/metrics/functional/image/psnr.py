@@ -1,0 +1,68 @@
+"""Peak signal-to-noise ratio.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added image metrics
+later).  PSNR = 10·log10(data_range² / MSE); sufficient statistics are
+the summed squared error and element count — add-mergeable counters,
+one fused reduction per batch."""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def peak_signal_noise_ratio(
+    input,
+    target,
+    data_range: Optional[float] = None,
+) -> jax.Array:
+    """PSNR between two images or batches of images of the same shape.
+    ``data_range`` defaults to ``max(target) − min(target)`` of the data
+    seen (the convention upstream uses when unset)."""
+    _psnr_param_check(data_range)
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _psnr_input_check(input, target)
+    sum_se, n, observed_range = _psnr_update_kernel(input, target)
+    if data_range is not None:
+        observed_range = jnp.asarray(float(data_range))
+    return _psnr_compute(sum_se, n, observed_range)
+
+
+@jax.jit
+def _psnr_update_kernel(
+    input: jax.Array, target: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    err = (input - target).astype(jnp.float32)
+    return (
+        jnp.sum(jnp.square(err)),
+        jnp.asarray(input.size, jnp.float32),
+        (target.max() - target.min()).astype(jnp.float32),
+    )
+
+
+@jax.jit
+def _psnr_compute(
+    sum_se: jax.Array, n: jax.Array, data_range: jax.Array
+) -> jax.Array:
+    mse = sum_se / n
+    return 10.0 * jnp.log10(jnp.square(data_range) / mse)
+
+
+def _psnr_param_check(data_range: Optional[float]) -> None:
+    if data_range is not None:
+        if not isinstance(data_range, (int, float)):
+            raise ValueError(
+                f"`data_range` should be a float, got {type(data_range)}."
+            )
+        if data_range <= 0:
+            raise ValueError(
+                f"`data_range` should be positive, got {data_range}."
+            )
+
+
+def _psnr_input_check(input: jax.Array, target: jax.Array) -> None:
+    if input.shape != target.shape:
+        raise ValueError(
+            "The `input` and `target` should have the same shape, "
+            f"got shapes {input.shape} and {target.shape}."
+        )

@@ -1,0 +1,9 @@
+from torcheval_tpu.metrics.image.fid import FrechetInceptionDistance
+from torcheval_tpu.metrics.image.psnr import PeakSignalNoiseRatio
+from torcheval_tpu.metrics.image.ssim import StructuralSimilarity
+
+__all__ = [
+    "FrechetInceptionDistance",
+    "PeakSignalNoiseRatio",
+    "StructuralSimilarity",
+]

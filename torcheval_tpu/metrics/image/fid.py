@@ -1,0 +1,128 @@
+"""FrechetInceptionDistance — streaming feature mean/covariance per
+distribution + the eigendecomposition Fréchet distance.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added FID later).
+
+Documented divergence: upstream downloads InceptionV3 weights on first
+use.  This environment is offline, so the feature extractor is an
+explicit constructor argument — any callable mapping an image batch to
+``(N, feature_dim)`` embeddings (a flax/haiku apply fn, a jitted
+function, anything).  The streaming statistics are add-mergeable
+(per-distribution sum, outer-product sum, count), so the metric syncs
+like every counter metric."""
+
+from typing import Callable, Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.image.fid import (
+    _gaussian_frechet_distance_kernel,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+_STATES = (
+    "real_sum",
+    "real_cov_sum",
+    "num_real_images",
+    "fake_sum",
+    "fake_cov_sum",
+    "num_fake_images",
+)
+
+
+@jax.jit
+def _feature_stats(feats: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    feats = feats.astype(jnp.float32)
+    return feats.sum(axis=0), feats.T @ feats, jnp.asarray(
+        feats.shape[0], jnp.float32
+    )
+
+
+@jax.jit
+def _mean_cov(
+    total: jax.Array, cov_sum: jax.Array, n: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    mu = total / n
+    cov = (cov_sum - n * jnp.outer(mu, mu)) / (n - 1.0)
+    return mu, cov
+
+
+class FrechetInceptionDistance(Metric[jax.Array]):
+    """FID between the real and generated feature distributions seen."""
+
+    def __init__(
+        self,
+        model: Callable[[jax.Array], jax.Array],
+        *,
+        feature_dim: int,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        if not callable(model):
+            raise ValueError(
+                "`model` must be a callable mapping an image batch to "
+                "(N, feature_dim) embeddings; this offline build has no "
+                "downloadable InceptionV3 default."
+            )
+        if feature_dim < 1:
+            raise ValueError(
+                f"`feature_dim` should be positive, got {feature_dim}."
+            )
+        self.model = model
+        self.feature_dim = feature_dim
+        for prefix in ("real", "fake"):
+            self._add_state(f"{prefix}_sum", jnp.zeros(feature_dim))
+            self._add_state(
+                f"{prefix}_cov_sum", jnp.zeros((feature_dim, feature_dim))
+            )
+            self._add_state(f"num_{prefix}_images", jnp.asarray(0.0))
+
+    def update(self, images, *, is_real: bool) -> "FrechetInceptionDistance":
+        feats = jnp.asarray(self.model(images))
+        if feats.ndim != 2 or feats.shape[1] != self.feature_dim:
+            raise ValueError(
+                "the feature extractor should return shape "
+                f"(num_images, {self.feature_dim}), got {feats.shape}."
+            )
+        total, cov_sum, n = _feature_stats(feats)
+        prefix = "real" if is_real else "fake"
+        setattr(self, f"{prefix}_sum", getattr(self, f"{prefix}_sum") + total)
+        setattr(
+            self,
+            f"{prefix}_cov_sum",
+            getattr(self, f"{prefix}_cov_sum") + cov_sum,
+        )
+        setattr(
+            self,
+            f"num_{prefix}_images",
+            getattr(self, f"num_{prefix}_images") + n,
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """FID over everything seen.  Each side needs at least two images
+        for an unbiased covariance."""
+        for name, n in (
+            ("real", self.num_real_images),
+            ("fake", self.num_fake_images),
+        ):
+            if float(n) < 2:
+                raise RuntimeError(
+                    f"computing FID requires at least 2 {name} images, got "
+                    f"{int(float(n))}."
+                )
+        mu_r, cov_r = _mean_cov(
+            self.real_sum, self.real_cov_sum, self.num_real_images
+        )
+        mu_f, cov_f = _mean_cov(
+            self.fake_sum, self.fake_cov_sum, self.num_fake_images
+        )
+        return _gaussian_frechet_distance_kernel(mu_r, cov_r, mu_f, cov_f)
+
+    def merge_state(
+        self, metrics: Iterable["FrechetInceptionDistance"]
+    ) -> "FrechetInceptionDistance":
+        merge_add(self, metrics, *_STATES)
+        return self

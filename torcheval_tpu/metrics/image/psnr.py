@@ -1,0 +1,91 @@
+"""PeakSignalNoiseRatio metric — counter states.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added image metrics
+later).  States: summed squared error + element count (add merge) and,
+when ``data_range`` is unset, the observed target min/max (extremum
+merge, like Min/Max)."""
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.image.psnr import (
+    _psnr_compute,
+    _psnr_input_check,
+    _psnr_param_check,
+    _psnr_update_kernel,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+@jax.jit
+def _psnr_class_update_kernel(input: jax.Array, target: jax.Array):
+    sum_se, n, _ = _psnr_update_kernel(input, target)
+    return sum_se, n, target.min(), target.max()
+
+
+# Module-level identity: part of the fused-update jit cache key.
+_PSNR_FOLDS = (None, None, jnp.minimum, jnp.maximum)
+
+
+class PeakSignalNoiseRatio(Metric[jax.Array]):
+    """PSNR over everything seen; NaN before any update (0/0)."""
+
+    def __init__(self, *, data_range: Optional[float] = None, device=None) -> None:
+        super().__init__(device=device)
+        _psnr_param_check(data_range)
+        self.data_range = data_range
+        self._add_state("sum_squared_error", jnp.asarray(0.0))
+        self._add_state("num_observations", jnp.asarray(0.0))
+        self._add_state("target_min", jnp.asarray(jnp.inf))
+        self._add_state("target_max", jnp.asarray(-jnp.inf))
+
+    def update(self, input, target) -> "PeakSignalNoiseRatio":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _psnr_input_check(input, target)
+        # Kernel + all four state folds in one dispatch; the extremum
+        # states fold with min/max instead of addition.
+        (
+            self.sum_squared_error,
+            self.num_observations,
+            self.target_min,
+            self.target_max,
+        ) = accumulate(
+            _psnr_class_update_kernel,
+            (
+                self.sum_squared_error,
+                self.num_observations,
+                self.target_min,
+                self.target_max,
+            ),
+            input,
+            target,
+            fold=_PSNR_FOLDS,
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        data_range = (
+            jnp.asarray(float(self.data_range))
+            if self.data_range is not None
+            else self.target_max - self.target_min
+        )
+        return _psnr_compute(
+            self.sum_squared_error, self.num_observations, data_range
+        )
+
+    def merge_state(
+        self, metrics: Iterable["PeakSignalNoiseRatio"]
+    ) -> "PeakSignalNoiseRatio":
+        merge_add(self, metrics, "sum_squared_error", "num_observations")
+        for other in metrics:
+            self.target_min = jnp.minimum(
+                self.target_min, jax.device_put(other.target_min, self.device)
+            )
+            self.target_max = jnp.maximum(
+                self.target_max, jax.device_put(other.target_max, self.device)
+            )
+        return self

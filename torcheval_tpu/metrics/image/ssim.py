@@ -1,0 +1,83 @@
+"""StructuralSimilarity metric — counter states over per-image SSIM.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added image metrics
+later)."""
+
+from typing import Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.image.ssim import (
+    _ssim_input_check,
+    _ssim_per_image,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+def _ssim_class_update_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    data_range: float,
+    kernel_size: int,
+    sigma: float,
+    k1: float,
+    k2: float,
+) -> Tuple[jax.Array, jax.Array]:
+    per_image = _ssim_per_image(
+        input, target, data_range, kernel_size, sigma, k1, k2
+    )
+    return per_image.sum(), jnp.asarray(per_image.shape[0], jnp.float32)
+
+
+class StructuralSimilarity(Metric[jax.Array]):
+    """Mean SSIM over all images seen; NaN before any update (0/0)."""
+
+    def __init__(
+        self,
+        *,
+        data_range: float = 1.0,
+        kernel_size: int = 11,
+        sigma: float = 1.5,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        self.data_range = float(data_range)
+        self.kernel_size = kernel_size
+        self.sigma = float(sigma)
+        self.k1 = float(k1)
+        self.k2 = float(k2)
+        self._add_state("mssim_sum", jnp.asarray(0.0))
+        self._add_state("num_images", jnp.asarray(0.0))
+
+    def update(self, input, target) -> "StructuralSimilarity":
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _ssim_input_check(input, target, self.kernel_size)
+        # Kernel + both state adds fused into one dispatch (_fuse.py).
+        self.mssim_sum, self.num_images = accumulate(
+            _ssim_class_update_kernel,
+            (self.mssim_sum, self.num_images),
+            input,
+            target,
+            statics=(
+                self.data_range,
+                self.kernel_size,
+                self.sigma,
+                self.k1,
+                self.k2,
+            ),
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        return self.mssim_sum / self.num_images
+
+    def merge_state(
+        self, metrics: Iterable["StructuralSimilarity"]
+    ) -> "StructuralSimilarity":
+        merge_add(self, metrics, "mssim_sum", "num_images")
+        return self
