@@ -2,6 +2,7 @@
 
 import unittest
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -145,3 +146,79 @@ class TestMetricCollection(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestFusedUpdate(unittest.TestCase):
+    def test_matches_unfused(self):
+        fused, plain = _collection(), _collection()
+        for seed in range(3):
+            scores, labels = _data(seed)
+            fused.fused_update(scores, labels)
+            plain.update(scores, labels)
+        for name in plain:
+            np.testing.assert_allclose(
+                np.asarray(fused[name].compute()),
+                np.asarray(plain[name].compute()),
+                rtol=1e-6,
+                err_msg=name,
+            )
+
+    def test_single_compiled_program_reused(self):
+        col = _collection()
+        col.fused_update(*_data(0))
+        traces_after_first = col._fused_apply._cache_size()
+        col.fused_update(*_data(1))  # same shapes: must hit the jit cache
+        self.assertEqual(col._fused_apply._cache_size(), traces_after_first)
+
+    def test_picklable_after_fused_update(self):
+        import pickle
+
+        col = _collection()
+        col.fused_update(*_data(0))
+        clone = pickle.loads(pickle.dumps(col))
+        np.testing.assert_allclose(
+            np.asarray(clone["confusion"].compute()),
+            np.asarray(col["confusion"].compute()),
+        )
+        clone.fused_update(*_data(1))  # program rebuilt lazily
+
+    def test_mixed_shapes_retrace(self):
+        col = _collection()
+        col.fused_update(*_data(0, n=64))
+        col.fused_update(*_data(1, n=128))  # retrace, same program object
+        plain = _collection()
+        plain.update(*_data(0, n=64)).update(*_data(1, n=128))
+        np.testing.assert_allclose(
+            np.asarray(col["confusion"].compute()),
+            np.asarray(plain["confusion"].compute()),
+        )
+
+    def test_buffer_member_rejected(self):
+        from torcheval_tpu.metrics import BinaryAUROC
+
+        col = MetricCollection({"auroc": BinaryAUROC()})
+        with self.assertRaisesRegex(ValueError, "array states"):
+            col.fused_update(jnp.zeros(4), jnp.zeros(4))
+
+    def test_windowed_member_rejected(self):
+        from torcheval_tpu.metrics import WindowedBinaryNormalizedEntropy
+
+        col = MetricCollection({"ne": WindowedBinaryNormalizedEntropy()})
+        with self.assertRaisesRegex(ValueError, "windowed member"):
+            col.fused_update(jnp.asarray([0.5]), jnp.asarray([1.0]))
+
+    def test_failed_trace_leaves_states_concrete(self):
+        col = _collection()
+        col.fused_update(*_data(0))
+        with self.assertRaises(Exception):
+            # wrong rank input fails at trace time inside the program
+            col.fused_update(jnp.zeros((2, 2, 2)), jnp.zeros(2))
+        # states restored to concrete arrays; further updates still work
+        col.fused_update(*_data(1))
+        self.assertTrue(
+            all(
+                isinstance(getattr(col[n], s), jax.Array)
+                for n in col
+                for s in col[n]._state_name_to_default
+            )
+        )

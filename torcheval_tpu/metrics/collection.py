@@ -12,7 +12,9 @@ State-dict keys are namespaced ``"{name}/{state}"`` so a collection
 checkpoints like any single metric (orbax-compatible flat mapping).
 """
 
-from typing import Any, Dict, Iterable, Iterator, Mapping, Tuple
+from typing import Any, Dict, Iterable, Iterator, Mapping, Optional, Tuple
+
+import jax
 
 from torcheval_tpu.metrics.metric import Metric
 
@@ -40,6 +42,7 @@ class MetricCollection:
                     f"Metric names must not contain '/', got {name!r}."
                 )
         self._metrics: Dict[str, Metric] = dict(metrics)
+        self._fused_apply: Optional[Any] = None
 
     # ------------------------------------------------------------- container
     def __getitem__(self, name: str) -> Metric:
@@ -59,6 +62,75 @@ class MetricCollection:
         for metric in self._metrics.values():
             metric.update(*args, **kwargs)
         return self
+
+    def fused_update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
+        """Update every member in ONE XLA program.
+
+        ``update`` already costs one fused dispatch per member
+        (``_fuse.py``); this goes one further and traces all members'
+        updates into a single jitted program, so a five-metric collection
+        pays one program launch per batch instead of five.  Member updates
+        are pure state transitions, which is exactly what makes them
+        traceable together.
+
+        Restrictions (checked up front): every member state must be a
+        ``jax.Array`` — sample-buffer members (Python-list states) would
+        leak tracers, and ring-window members would bake their host-side
+        column cursor into the trace as a constant.  Data-dependent value
+        validation is skipped inside the trace (exactly as when composing
+        the functional metrics into a user jit program); shape/parameter
+        validation still applies."""
+        self._check_fusable()
+        if self._fused_apply is None:
+            metrics = self._metrics
+
+            def apply(states, a, kw):
+                for name, m in metrics.items():
+                    for s, v in states[name].items():
+                        setattr(m, s, v)
+                for m in metrics.values():
+                    m.update(*a, **kw)
+                return self._read_states()
+
+            self._fused_apply = jax.jit(apply)
+        before = self._read_states()
+        try:
+            new_states = self._fused_apply(before, args, kwargs)
+        except Exception:
+            # A failed trace leaves tracer attrs on members; restore.
+            self._install_states(before)
+            raise
+        self._install_states(new_states)
+        return self
+
+    def _check_fusable(self) -> None:
+        from torcheval_tpu.metrics._buffer import RingWindowMixin
+
+        for name, m in self._metrics.items():
+            if isinstance(m, RingWindowMixin):
+                raise ValueError(
+                    f"fused_update does not support windowed member {name!r}: "
+                    "its host-side ring cursor would become a trace constant."
+                )
+            for s in m._state_name_to_default:
+                if not isinstance(getattr(m, s), jax.Array):
+                    raise ValueError(
+                        f"fused_update requires array states; member {name!r} "
+                        f"state {s!r} is {type(getattr(m, s)).__name__}. "
+                        "Use update() for buffer-state metrics."
+                    )
+
+    def _read_states(self) -> Dict[str, Dict[str, Any]]:
+        return {
+            name: {s: getattr(m, s) for s in m._state_name_to_default}
+            for name, m in self._metrics.items()
+        }
+
+    def _install_states(self, states: Dict[str, Dict[str, Any]]) -> None:
+        for name, per_state in states.items():
+            m = self._metrics[name]
+            for s, v in per_state.items():
+                setattr(m, s, v)
 
     def compute(self) -> Dict[str, Any]:
         return {name: m.compute() for name, m in self._metrics.items()}
@@ -138,6 +210,17 @@ class MetricCollection:
         for metric in self._metrics.values():
             metric.to(device)
         return self
+
+    # The jitted fused-update program is a local closure — unpicklable, and
+    # meaningless in another process anyway.  Drop it from the pickle the
+    # sync toolkit ships and rebuild lazily on next fused_update.
+    def __getstate__(self) -> Dict[str, Any]:
+        state = self.__dict__.copy()
+        state["_fused_apply"] = None
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
 
     def __repr__(self) -> str:
         inner = ", ".join(
