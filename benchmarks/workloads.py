@@ -45,14 +45,16 @@ def _force(value) -> None:
 # --------------------------------------------------------------------------
 
 
-def _lifecycle(metric, batches, repeats: int = REPEATS) -> float:
+def _lifecycle(metric, batches, repeats: int = REPEATS, update: str = "update") -> float:
     """update×K + compute throughput for one metric object (ours or the
-    reference's — ``_force`` is a no-op fence for eager torch tensors)."""
+    reference's — ``_force`` is a no-op fence for eager torch tensors).
+    ``update`` names the update method (e.g. ``"fused_update"``)."""
+    update_fn = getattr(metric, update)
 
     def step():
         metric.reset()
         for args in batches:
-            metric.update(*args)
+            update_fn(*args)
         _force(metric.compute())
 
     n = sum(int(np.asarray(a[0]).shape[0]) for a in batches)
@@ -352,6 +354,102 @@ def bench_sharded_multiclass_auroc() -> Tuple[str, float, Optional[float]]:
     return "sharded_multiclass_auroc_1000c", ours, ref
 
 
+def bench_binned_auroc() -> Tuple[str, float, Optional[float]]:
+    """Binned AUROC (10k fixed thresholds, O(T) counter state) on 2^22
+    samples.  The reference snapshot has no binned AUROC; its exact
+    BinaryAUROC (sample buffers + sort) is the only way it can produce the
+    same number, so that is the baseline lifecycle here."""
+    from torcheval_tpu.metrics import BinaryBinnedAUROC
+
+    rng = np.random.default_rng(5)
+    n = 2**22
+    scores = rng.random(n, dtype=np.float32)
+    target = (rng.random(n) > 0.5).astype(np.float32)
+    ours = _lifecycle(
+        BinaryBinnedAUROC(threshold=10_000), _split((scores, target))
+    )
+
+    ref = None
+    try:
+        Ref = _reference().BinaryAUROC
+        batches = _split_torch((scores, target))
+        ref = _lifecycle(Ref(), batches, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "binary_binned_auroc_10kbins", ours, ref
+
+
+def bench_collection_fused() -> Tuple[str, float, Optional[float]]:
+    """Five 100-class counter metrics over one batch stream:
+    ``MetricCollection.fused_update`` (ONE XLA program per batch) versus
+    the reference's only option — looping five metric objects per batch."""
+    from torcheval_tpu.metrics import (
+        MetricCollection,
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+
+    c = 100
+    rng = np.random.default_rng(6)
+    n = 2**19
+    scores = rng.random((n, c), dtype=np.float32)
+    target = rng.integers(0, c, n).astype(np.int32)
+    col = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=c, average="macro"),
+            "f1": MulticlassF1Score(num_classes=c, average="macro"),
+            "cm": MulticlassConfusionMatrix(num_classes=c),
+            "prec": MulticlassPrecision(num_classes=c, average="macro"),
+            "rec": MulticlassRecall(num_classes=c, average="macro"),
+        }
+    )
+    ours = _lifecycle(col, _split((scores, target)), update="fused_update")
+
+    ref = None
+    try:
+        ref_metrics = _reference()
+        refs = [
+            ref_metrics.MulticlassAccuracy(num_classes=c, average="macro"),
+            ref_metrics.MulticlassF1Score(num_classes=c, average="macro"),
+            ref_metrics.MulticlassConfusionMatrix(num_classes=c),
+            ref_metrics.MulticlassPrecision(num_classes=c, average="macro"),
+            ref_metrics.MulticlassRecall(num_classes=c, average="macro"),
+        ]
+        rbatches = _split_torch((scores, target.astype(np.int64)))
+
+        def rstep():
+            for m in refs:
+                m.reset()
+            for args in rbatches:
+                for m in refs:
+                    m.update(*args)
+            for m in refs:
+                _force(m.compute())
+
+        ref = n / _time_steps(rstep, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "collection_5metrics_fused", ours, ref
+
+
+def bench_perplexity() -> Tuple[str, float, Optional[float]]:
+    """LM-eval perplexity over (seqs, 256, 8192) logit batches — fused
+    log_softmax+gather counters.  No reference counterpart (the snapshot
+    has no text metrics); throughput is tokens/sec."""
+    from torcheval_tpu.metrics import Perplexity
+
+    rng = np.random.default_rng(7)
+    seqs, tokens, vocab = 16, 256, 8192
+    logits = rng.normal(size=(seqs, tokens, vocab)).astype(np.float32)
+    target = rng.integers(0, vocab, (seqs, tokens))
+    # _lifecycle counts leading-dim sequences; scale to tokens/sec.
+    ours = _lifecycle(Perplexity(), _split((logits, target))) * tokens
+    return "perplexity_tokens", ours, None
+
+
 ALL_WORKLOADS = [
     bench_accuracy,
     bench_binary_auroc,
@@ -361,4 +459,7 @@ ALL_WORKLOADS = [
     bench_regression,
     bench_sharded_auroc_sync,
     bench_sharded_multiclass_auroc,
+    bench_binned_auroc,
+    bench_collection_fused,
+    bench_perplexity,
 ]

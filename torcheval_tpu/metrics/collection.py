@@ -96,8 +96,9 @@ class MetricCollection:
         before = self._read_states()
         try:
             new_states = self._fused_apply(before, args, kwargs)
-        except Exception:
-            # A failed trace leaves tracer attrs on members; restore.
+        except BaseException:
+            # An aborted trace (including KeyboardInterrupt mid-compile)
+            # leaves tracer attrs on members; restore the concrete states.
             self._install_states(before)
             raise
         self._install_states(new_states)
