@@ -202,6 +202,79 @@ class TestShardedAUROCHistogram(unittest.TestCase):
             )
 
 
+class TestShardedAUPRCHistogram(unittest.TestCase):
+    def test_matches_sklearn_on_quantized_scores(self):
+        from sklearn.metrics import average_precision_score
+
+        from torcheval_tpu.parallel import sharded_auprc_histogram
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(6)
+        num_bins = 1024
+        scores = rng.integers(0, num_bins, 4096).astype(np.float32) / num_bins
+        target = (rng.random(4096) > 0.6).astype(np.float32)
+        got = sharded_auprc_histogram(
+            *shard_batch(mesh, jnp.asarray(scores), jnp.asarray(target)),
+            mesh=mesh,
+            num_bins=num_bins,
+        )
+        expected = average_precision_score(target, scores)
+        np.testing.assert_allclose(float(got), expected, atol=1e-6)
+
+    def test_close_on_continuous_and_weighted(self):
+        from sklearn.metrics import average_precision_score
+
+        from torcheval_tpu.parallel import sharded_auprc_histogram
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(7)
+        scores = rng.random(8192).astype(np.float32)
+        target = (rng.random(8192) > 0.5).astype(np.float32)
+        weights = rng.random(8192).astype(np.float32)
+        s_s, s_t, s_w = shard_batch(
+            mesh, jnp.asarray(scores), jnp.asarray(target), jnp.asarray(weights)
+        )
+        got = sharded_auprc_histogram(
+            s_s, s_t, mesh=mesh, num_bins=8192, weights=s_w
+        )
+        expected = average_precision_score(target, scores, sample_weight=weights)
+        np.testing.assert_allclose(float(got), expected, atol=2e-3)
+
+    def test_weight_scale_invariance(self):
+        from sklearn.metrics import average_precision_score
+
+        from torcheval_tpu.parallel import sharded_auprc_histogram
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(8)
+        num_bins = 512
+        n = 2048
+        scores = rng.integers(0, num_bins, n).astype(np.float32) / num_bins
+        target = (rng.random(n) > 0.5).astype(np.float32)
+        tiny = np.full(n, 1.0 / n, dtype=np.float32)  # weights summing to 1
+        s_s, s_t, s_w = shard_batch(
+            mesh, jnp.asarray(scores), jnp.asarray(target), jnp.asarray(tiny)
+        )
+        got = sharded_auprc_histogram(
+            s_s, s_t, mesh=mesh, num_bins=num_bins, weights=s_w
+        )
+        expected = average_precision_score(target, scores)
+        np.testing.assert_allclose(float(got), expected, atol=1e-5)
+
+    def test_no_positives_zero_and_bad_shape(self):
+        from torcheval_tpu.parallel import sharded_auprc_histogram
+
+        mesh = make_mesh()
+        got = sharded_auprc_histogram(
+            *shard_batch(mesh, jnp.linspace(0, 1, 16), jnp.zeros(16)),
+            mesh=mesh,
+            num_bins=64,
+        )
+        self.assertEqual(float(got), 0.0)
+        with self.assertRaisesRegex(ValueError, "1-D"):
+            sharded_auprc_histogram(jnp.ones((2, 2)), jnp.ones((2, 2)), mesh=mesh)
+
+
 class TestShardedMulticlassAUROCHistogram(unittest.TestCase):
     def test_matches_sklearn_macro_on_quantized_scores(self):
         from sklearn.metrics import roc_auc_score as sk_auc

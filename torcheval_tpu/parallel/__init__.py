@@ -34,6 +34,7 @@ from torcheval_tpu.parallel.mesh import (
 from torcheval_tpu.parallel.sync import (
     make_synced_update,
     mesh_merge_states,
+    sharded_auprc_histogram,
     sharded_auroc_histogram,
     sharded_multiclass_auroc_histogram,
 )
@@ -45,6 +46,7 @@ __all__ = [
     "mesh_merge_states",
     "replicate",
     "shard_batch",
+    "sharded_auprc_histogram",
     "sharded_auroc_histogram",
     "sharded_multiclass_auroc_histogram",
 ]

@@ -137,20 +137,8 @@ def sharded_auroc_histogram(
     Use the exact ``binary_auroc`` on gathered buffers when bit-exactness
     matters more than wire cost.
     """
-    if scores.ndim != 1 or targets.ndim != 1:
-        raise ValueError(
-            f"scores and targets should be 1-D, got {scores.shape} / {targets.shape}."
-        )
-
     def local(s, t, w):
-        idx = jnp.clip((s * num_bins).astype(jnp.int32), 0, num_bins - 1)
-        wt = w.astype(jnp.float32)
-        pos = jnp.zeros(num_bins, jnp.float32).at[idx].add(
-            wt * t.astype(jnp.float32)
-        )
-        tot = jnp.zeros(num_bins, jnp.float32).at[idx].add(wt)
-        pos = lax.psum(pos, axis)
-        tot = lax.psum(tot, axis)
+        pos, tot = _local_binned_counts(s, t, w, num_bins, axis)
         neg = tot - pos
         # Descending-threshold cumulative curves, from the (0, 0) origin.
         cum_tp = jnp.concatenate([jnp.zeros(1), jnp.cumsum(pos[::-1])])
@@ -159,6 +147,28 @@ def sharded_auroc_histogram(
         area = jnp.trapezoid(cum_tp, cum_fp)
         return jnp.where(factor == 0, 0.5, area / factor)
 
+    return _run_sharded_binary(local, mesh, axis, scores, targets, weights)
+
+
+def _local_binned_counts(s, t, w, num_bins: int, axis: str):
+    """Per-device positive/total weighted histograms over the [0, 1] score
+    grid, psum-merged across the mesh axis — the shared first stage of
+    every O(num_bins)-communication curve metric here."""
+    idx = jnp.clip((s * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    wt = w.astype(jnp.float32)
+    pos = jnp.zeros(num_bins, jnp.float32).at[idx].add(
+        wt * t.astype(jnp.float32)
+    )
+    tot = jnp.zeros(num_bins, jnp.float32).at[idx].add(wt)
+    return lax.psum(pos, axis), lax.psum(tot, axis)
+
+
+def _run_sharded_binary(local, mesh: Mesh, axis: str, scores, targets, weights):
+    """Shared shape check + shard_map wrapper for the 1-D histogram metrics."""
+    if scores.ndim != 1 or targets.ndim != 1:
+        raise ValueError(
+            f"scores and targets should be 1-D, got {scores.shape} / {targets.shape}."
+        )
     if weights is None:
         weights = jnp.ones_like(scores, dtype=jnp.float32)
     fn = jax.jit(
@@ -170,6 +180,48 @@ def sharded_auroc_histogram(
         )
     )
     return fn(scores, targets, weights)
+
+
+def sharded_auprc_histogram(
+    scores: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    axis: str = "dp",
+    num_bins: int = 8192,
+    weights: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Pod-scale binary average precision with O(num_bins) communication.
+
+    Same histogram scheme as :func:`sharded_auroc_histogram` — each device
+    bins its local scores (assumed in [0, 1], clipped), ONE ``psum`` merges
+    the ``2 × num_bins`` histogram, and the step-rule AP
+
+        AP = Σ_bins ΔR_bin · P_bin
+
+    is evaluated over descending-threshold bins on every device.  Exact
+    for scores already quantized to the bin grid; error ``O(1/num_bins)``
+    otherwise.  No positives → 0 (matching ``binary_auprc``).  Invariant
+    to the scale of ``weights`` (like sklearn's ``sample_weight``)."""
+
+    def local(s, t, w):
+        pos, tot = _local_binned_counts(s, t, w, num_bins, axis)
+        # Descending-threshold bins: cumulative TP / predicted-positive
+        # counts at each bin end, precision there, weighted by the bin's
+        # recall increment.  0/0 guards must not clamp small weighted
+        # counts — AP is invariant to weight scale.
+        delta_tp = pos[::-1]
+        cum_tp = jnp.cumsum(delta_tp)
+        cum_all = jnp.cumsum(tot[::-1])
+        precision = jnp.where(
+            cum_all > 0, cum_tp / jnp.where(cum_all > 0, cum_all, 1.0), 1.0
+        )
+        total_pos = cum_tp[-1]
+        ap = (delta_tp * precision).sum() / jnp.where(
+            total_pos > 0, total_pos, 1.0
+        )
+        return jnp.where(total_pos == 0, 0.0, ap)
+
+    return _run_sharded_binary(local, mesh, axis, scores, targets, weights)
 
 
 def sharded_multiclass_auroc_histogram(
