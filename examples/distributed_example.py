@@ -23,18 +23,25 @@ set ``XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu``
 to try the mesh path host-only).
 """
 
-import time
+import os
+import sys
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
+# Allow running the example file directly from a checkout (the package is
+# importable from the repo root without installation).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from torcheval_tpu.distributed import LocalWorld
-from torcheval_tpu.metrics import MulticlassAccuracy, Throughput
-from torcheval_tpu.metrics.toolkit import sync_and_compute
-from torcheval_tpu.parallel import make_mesh, shard_batch
+import time  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from torcheval_tpu.distributed import LocalWorld  # noqa: E402
+from torcheval_tpu.metrics import MulticlassAccuracy, Throughput  # noqa: E402
+from torcheval_tpu.metrics.toolkit import sync_and_compute  # noqa: E402
+from torcheval_tpu.parallel import make_mesh, shard_batch  # noqa: E402
 
 NUM_EPOCHS = 4
 NUM_BATCHES = 16

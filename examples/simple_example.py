@@ -7,11 +7,18 @@ Run: ``python examples/simple_example.py`` (any JAX backend — the one real
 TPU chip, or CPU).
 """
 
-import jax
-import jax.numpy as jnp
-import optax
+import os
+import sys
 
-from torcheval_tpu.metrics import MulticlassAccuracy
+# Allow running the example file directly from a checkout (the package is
+# importable from the repo root without installation).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+from torcheval_tpu.metrics import MulticlassAccuracy  # noqa: E402
 
 NUM_EPOCHS = 4
 NUM_BATCHES = 16

@@ -14,6 +14,11 @@ class TestExamples(unittest.TestCase):
 
         distributed_example.train_rank_world()
 
+    def test_eval_example(self):
+        import eval_example
+
+        eval_example.main()
+
     def test_simple_example_one_epoch(self):
         import simple_example
 
