@@ -224,6 +224,41 @@ class TestFID(unittest.TestCase):
         a.merge_state([b])
         self.assertAlmostEqual(float(a.compute()), single, places=2)
 
+    def test_pickle_drops_model_but_syncs(self):
+        import pickle
+
+        model = self._extractor()  # a closure — unpicklable by itself
+        rng = np.random.default_rng(11)
+        m = FrechetInceptionDistance(model, feature_dim=6)
+        m.update(jnp.asarray(rng.random((8, 3, 8, 8), np.float32)), is_real=True)
+        m.update(jnp.asarray(rng.random((8, 3, 8, 8), np.float32)), is_real=False)
+        clone = pickle.loads(pickle.dumps(m))
+        self.assertIsNone(clone.model)
+        self.assertAlmostEqual(float(clone.compute()), float(m.compute()), places=5)
+        with self.assertRaisesRegex(RuntimeError, "feature extractor"):
+            clone.update(jnp.zeros((2, 3, 8, 8)), is_real=True)
+        clone.model = model  # reattach and it updates again
+        clone.update(jnp.asarray(rng.random((2, 3, 8, 8), np.float32)), is_real=True)
+
+    def test_deepcopy_keeps_model(self):
+        import copy
+
+        from torcheval_tpu.metrics.toolkit import clone_metric
+
+        rng = np.random.default_rng(12)
+        m = FrechetInceptionDistance(self._extractor(), feature_dim=6)
+        m.update(jnp.asarray(rng.random((4, 3, 8, 8), np.float32)), is_real=True)
+        for clone in (copy.deepcopy(m), clone_metric(m)):
+            self.assertIs(clone.model, m.model)
+            clone.update(
+                jnp.asarray(rng.random((4, 3, 8, 8), np.float32)), is_real=False
+            )
+            self.assertAlmostEqual(
+                float(clone.num_real_images), 4.0, places=6
+            )
+        # the original's states were not shared with the clone
+        self.assertEqual(float(m.num_fake_images), 0.0)
+
     def test_guards(self):
         model = self._extractor()
         with self.assertRaisesRegex(ValueError, "callable"):

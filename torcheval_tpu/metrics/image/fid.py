@@ -79,7 +79,37 @@ class FrechetInceptionDistance(Metric[jax.Array]):
             )
             self._add_state(f"num_{prefix}_images", jnp.asarray(0.0))
 
+    # The feature extractor is only needed by update(); compute/merge work
+    # from the accumulated statistics alone.  Dropping it from pickles lets
+    # the object-sync toolkit ship FID metrics regardless of whether the
+    # extractor itself is picklable (closures, bound apply fns, ...).
+    def __getstate__(self):
+        state = super().__getstate__()
+        state["model"] = None
+        return state
+
+    # In-process cloning (clone_metric / deepcopy-per-rank test patterns)
+    # must keep the extractor: share the callable, deep-copy everything
+    # else.  Only the cross-process pickle drops it.
+    def __deepcopy__(self, memo):
+        import copy
+
+        clone = self.__class__.__new__(self.__class__)
+        memo[id(self)] = clone
+        for key, value in self.__dict__.items():
+            if key == "model":
+                clone.model = value
+            else:
+                clone.__dict__[key] = copy.deepcopy(value, memo)
+        return clone
+
     def update(self, images, *, is_real: bool) -> "FrechetInceptionDistance":
+        if self.model is None:
+            raise RuntimeError(
+                "This FrechetInceptionDistance was deserialized without its "
+                "feature extractor (extractors do not ride pickles); assign "
+                "`metric.model` before calling update()."
+            )
         feats = jnp.asarray(self.model(images))
         if feats.ndim != 2 or feats.shape[1] != self.feature_dim:
             raise ValueError(
