@@ -258,6 +258,14 @@ class TestFID(unittest.TestCase):
             )
         # the original's states were not shared with the clone
         self.assertEqual(float(m.num_fake_images), 0.0)
+        # shallow copy keeps the model too
+        shallow = copy.copy(m)
+        self.assertIs(shallow.model, m.model)
+        shallow.update(
+            jnp.asarray(np.random.default_rng(13).random((2, 3, 8, 8), np.float32)
+                        .astype(np.float32)),
+            is_real=True,
+        )
 
     def test_guards(self):
         model = self._extractor()

@@ -89,16 +89,22 @@ class FrechetInceptionDistance(Metric[jax.Array]):
         return state
 
     # In-process cloning (clone_metric / deepcopy-per-rank test patterns)
-    # must keep the extractor: share the callable, deep-copy everything
-    # else.  Only the cross-process pickle drops it.
+    # must keep the extractor: share the callable, the device handle, and
+    # the immutable array buffers; deep-copy the rest.  Only the
+    # cross-process pickle drops the model.
+    def __copy__(self):
+        clone = self.__class__.__new__(self.__class__)
+        clone.__dict__.update(self.__dict__)
+        return clone
+
     def __deepcopy__(self, memo):
         import copy
 
         clone = self.__class__.__new__(self.__class__)
         memo[id(self)] = clone
         for key, value in self.__dict__.items():
-            if key == "model":
-                clone.model = value
+            if key in ("model", "_device") or isinstance(value, jax.Array):
+                clone.__dict__[key] = value
             else:
                 clone.__dict__[key] = copy.deepcopy(value, memo)
         return clone
