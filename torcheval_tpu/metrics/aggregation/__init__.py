@@ -1,3 +1,4 @@
+from torcheval_tpu.metrics.aggregation.auc import AUC
 from torcheval_tpu.metrics.aggregation.cat import Cat
 from torcheval_tpu.metrics.aggregation.click_through_rate import ClickThroughRate
 from torcheval_tpu.metrics.aggregation.max import Max
@@ -6,4 +7,4 @@ from torcheval_tpu.metrics.aggregation.min import Min
 from torcheval_tpu.metrics.aggregation.sum import Sum
 from torcheval_tpu.metrics.aggregation.throughput import Throughput
 
-__all__ = ["Cat", "ClickThroughRate", "Max", "Mean", "Min", "Sum", "Throughput"]
+__all__ = ["AUC", "Cat", "ClickThroughRate", "Max", "Mean", "Min", "Sum", "Throughput"]

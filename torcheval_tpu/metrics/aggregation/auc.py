@@ -1,0 +1,62 @@
+"""AUC metric — buffered (x, y) curve samples, trapezoid at compute.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added ``AUC`` later).
+Buffer states like the exact curve metrics: points accumulate across
+updates (and across ranks via concat merge) and the area is integrated
+once over the full, optionally re-sorted, curve."""
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
+from torcheval_tpu.metrics.functional.aggregation.auc import (
+    _auc_compute_kernel,
+    _auc_input_check,
+)
+from torcheval_tpu.metrics.metric import Metric
+
+
+class AUC(Metric[jax.Array]):
+    """Area under the curve sampled by all (x, y) updates so far."""
+
+    def __init__(
+        self, *, reorder: bool = True, num_tasks: int = 1, device=None
+    ) -> None:
+        super().__init__(device=device)
+        if num_tasks < 1:
+            raise ValueError(
+                "`num_tasks` value should be greater than and equal to 1, "
+                f"but received {num_tasks}. "
+            )
+        self.reorder = reorder
+        self.num_tasks = num_tasks
+        self._add_state("x", [])
+        self._add_state("y", [])
+
+    def update(self, x, y) -> "AUC":
+        x, y = jnp.asarray(x), jnp.asarray(y)
+        _auc_input_check(x, y, self.num_tasks)
+        self.x.append(jax.device_put(x, self.device))
+        self.y.append(jax.device_put(y, self.device))
+        return self
+
+    def compute(self) -> jax.Array:
+        """Trapezoidal area per task; zeros before any update."""
+        if not self.x:
+            return jnp.zeros(()) if self.num_tasks == 1 else jnp.zeros(
+                self.num_tasks
+            )
+        return _auc_compute_kernel(
+            jnp.concatenate(self.x, axis=-1),
+            jnp.concatenate(self.y, axis=-1),
+            self.reorder,
+        )
+
+    def merge_state(self, metrics: Iterable["AUC"]) -> "AUC":
+        merge_concat_buffers(self, metrics, "x", "y", dim=-1)
+        return self
+
+    def _prepare_for_merge_state(self) -> None:
+        prepare_concat_buffers(self, "x", "y", dim=-1)

@@ -1,3 +1,4 @@
+from torcheval_tpu.metrics.functional.aggregation.auc import auc
 from torcheval_tpu.metrics.functional.aggregation.click_through_rate import (
     click_through_rate,
 )
@@ -5,4 +6,4 @@ from torcheval_tpu.metrics.functional.aggregation.mean import mean
 from torcheval_tpu.metrics.functional.aggregation.sum import sum  # noqa: A004
 from torcheval_tpu.metrics.functional.aggregation.throughput import throughput
 
-__all__ = ["click_through_rate", "mean", "sum", "throughput"]
+__all__ = ["auc", "click_through_rate", "mean", "sum", "throughput"]

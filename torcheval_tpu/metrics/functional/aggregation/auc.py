@@ -1,0 +1,51 @@
+"""AUC — trapezoidal area under an arbitrary sampled (x, y) curve.
+
+Beyond the v0.0.4 snapshot (upstream torcheval added the aggregation
+``auc`` later).  One fused sort (when ``reorder``) + trapezoid kernel;
+multi-task via a leading dim like the other aggregation metrics."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def auc(x, y, *, reorder: bool = True, num_tasks: int = 1) -> jax.Array:
+    """Area under the piecewise-linear curve through the ``(x, y)`` points;
+    ``reorder`` sorts the points by x first (needed whenever the x samples
+    are not already monotonic)."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    _auc_input_check(x, y, num_tasks)
+    return _auc_compute_kernel(x, y, reorder)
+
+
+@partial(jax.jit, static_argnames=("reorder",))
+def _auc_compute_kernel(x: jax.Array, y: jax.Array, reorder: bool) -> jax.Array:
+    squeeze = x.ndim == 1
+    if squeeze:
+        x, y = x[None], y[None]
+    if reorder:
+        order = jnp.argsort(x, axis=-1)
+        x = jnp.take_along_axis(x, order, axis=-1)
+        y = jnp.take_along_axis(y, order, axis=-1)
+    area = jnp.trapezoid(y, x, axis=-1)
+    return area[0] if squeeze else area
+
+
+def _auc_input_check(x: jax.Array, y: jax.Array, num_tasks: int) -> None:
+    if x.shape != y.shape:
+        raise ValueError(
+            f"`x` and `y` should have the same shape, got {x.shape} and "
+            f"{y.shape}."
+        )
+    if num_tasks == 1:
+        if x.ndim != 1:
+            raise ValueError(
+                "`x` should be a one-dimensional tensor for num_tasks = 1, "
+                f"got shape {x.shape}."
+            )
+    elif x.ndim != 2 or x.shape[0] != num_tasks:
+        raise ValueError(
+            f"`x` should have shape ({num_tasks}, num_samples) for "
+            f"num_tasks = {num_tasks}, got shape {x.shape}."
+        )
