@@ -32,9 +32,11 @@ class TestAUCFunctional(unittest.TestCase):
         self.assertAlmostEqual(
             float(auc(jnp.asarray(x), jnp.asarray(y))), float(want), places=6
         )
-        # reorder=False integrates the points as given
+        # reorder=False integrates the points as given (manual trapezoid —
+        # np.trapezoid is numpy>=2-only and np.trapz is deprecated there)
+        want_raw = float(np.sum((y[1:] + y[:-1]) / 2 * np.diff(x)))
         got = float(auc(jnp.asarray(x), jnp.asarray(y), reorder=False))
-        self.assertAlmostEqual(got, float(np.trapezoid(y, x)), places=6)
+        self.assertAlmostEqual(got, want_raw, places=6)
 
     def test_multitask(self):
         rng = np.random.default_rng(1)

@@ -1,0 +1,198 @@
+"""Randomized fuzz sweep over the beyond-snapshot families against
+independent oracles: random shapes, tie densities, class/label counts,
+and chunked-update-vs-one-shot equivalence.  The snapshot families have
+their own fuzz suite against the live reference
+(``test_reference_fuzz.py``); these families have no reference
+implementation, so sklearn/numpy oracles stand in."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+from sklearn.metrics import (
+    auc as sk_auc,
+    average_precision_score,
+    roc_auc_score,
+)
+
+from torcheval_tpu.metrics import (
+    AUC,
+    BinaryBinnedAUPRC,
+    BinaryBinnedAUROC,
+    ClickThroughRate,
+    MultilabelAUPRC,
+    Perplexity,
+    RetrievalRecall,
+    WordErrorRate,
+)
+from torcheval_tpu.metrics.functional import (
+    binary_recall_at_fixed_precision,
+    multilabel_binned_auprc,
+    peak_signal_noise_ratio,
+)
+
+TRIALS = 8
+
+
+class TestBeyondSnapshotFuzz(unittest.TestCase):
+    def test_binned_auc_grid_scores(self):
+        rng = np.random.default_rng(100)
+        for trial in range(TRIALS):
+            bins = int(rng.integers(8, 200))
+            grid = np.linspace(0, 1, bins).astype(np.float32)
+            n = int(rng.integers(16, 600))
+            s = rng.choice(grid, n).astype(np.float32)
+            t = (rng.random(n) > rng.uniform(0.2, 0.8)).astype(np.float32)
+            if 0 < t.sum() < n:
+                auroc, _ = BinaryBinnedAUROC(threshold=jnp.asarray(grid)).update(
+                    jnp.asarray(s), jnp.asarray(t)
+                ).compute()
+                self.assertAlmostEqual(
+                    float(auroc), roc_auc_score(t, s), places=4, msg=f"trial={trial}"
+                )
+            auprc, _ = BinaryBinnedAUPRC(threshold=jnp.asarray(grid)).update(
+                jnp.asarray(s), jnp.asarray(t)
+            ).compute()
+            want = average_precision_score(t, s) if t.sum() else 0.0
+            self.assertAlmostEqual(float(auprc), float(want), places=4)
+
+    def test_multilabel_auprc_chunked_equals_oneshot(self):
+        rng = np.random.default_rng(101)
+        for _ in range(TRIALS):
+            n = int(rng.integers(8, 200)) * 2
+            num_labels = int(rng.integers(2, 8))
+            s = np.round(rng.random((n, num_labels)) * 8).astype(np.float32) / 8
+            t = (rng.random((n, num_labels)) > 0.5).astype(np.float32)
+            m = MultilabelAUPRC(num_labels=num_labels, average=None)
+            for cs, ct in zip(np.array_split(s, 3), np.array_split(t, 3)):
+                m.update(jnp.asarray(cs), jnp.asarray(ct))
+            got = np.asarray(m.compute())
+            # scores live on the 1/8 grid, so the 9-bin binned form agrees
+            binned, _ = multilabel_binned_auprc(
+                jnp.asarray(s), jnp.asarray(t), num_labels=num_labels,
+                average=None,
+                threshold=jnp.asarray(np.linspace(0, 1, 9).astype(np.float32)),
+            )
+            for k in range(num_labels):
+                want = (
+                    average_precision_score(t[:, k], s[:, k])
+                    if t[:, k].sum()
+                    else 0.0
+                )
+                self.assertAlmostEqual(float(got[k]), float(want), places=4)
+                self.assertAlmostEqual(float(binned[k]), float(want), places=4)
+
+    def test_recall_at_fixed_precision_feasibility(self):
+        rng = np.random.default_rng(102)
+        for _ in range(TRIALS):
+            n = int(rng.integers(8, 300))
+            s = rng.random(n).astype(np.float32)
+            t = (rng.random(n) > 0.5).astype(np.float32)
+            floor = float(rng.uniform(0.05, 0.95))
+            recall, threshold = binary_recall_at_fixed_precision(
+                jnp.asarray(s), jnp.asarray(t), min_precision=floor
+            )
+            recall, threshold = float(recall), float(threshold)
+            if recall > 0:
+                # the returned threshold must actually achieve the contract
+                pred = s >= threshold
+                tp = float((pred * t).sum())
+                self.assertGreaterEqual(tp / max(pred.sum(), 1), floor - 1e-6)
+                self.assertAlmostEqual(tp / max(t.sum(), 1), recall, places=5)
+            else:
+                self.assertEqual(threshold, 1e6)
+
+    def test_ctr_and_auc_random_weights(self):
+        rng = np.random.default_rng(103)
+        for _ in range(TRIALS):
+            n = int(rng.integers(4, 200))
+            clicks = (rng.random(n) > rng.uniform(0.1, 0.9)).astype(np.float32)
+            w = rng.random(n).astype(np.float32) + 0.01
+            got = float(
+                ClickThroughRate().update(jnp.asarray(clicks), jnp.asarray(w)).compute()
+            )
+            self.assertAlmostEqual(got, float((clicks * w).sum() / w.sum()), places=4)
+
+            x = rng.random(n).astype(np.float32)
+            y = rng.random(n).astype(np.float32)
+            order = np.argsort(x, kind="stable")
+            self.assertAlmostEqual(
+                float(AUC().update(jnp.asarray(x), jnp.asarray(y)).compute()),
+                float(sk_auc(x[order], y[order])),
+                places=4,
+            )
+
+    def test_retrieval_recall_random_k(self):
+        rng = np.random.default_rng(104)
+        for _ in range(TRIALS):
+            n = int(rng.integers(3, 80))
+            k = int(rng.integers(1, n + 4))
+            s = rng.random(n).astype(np.float32)
+            t = (rng.random(n) > 0.5).astype(np.float32)
+            t[int(rng.integers(0, n))] = 1.0
+            got = np.asarray(
+                RetrievalRecall(k=k).update(jnp.asarray(s), jnp.asarray(t)).compute()
+            )
+            top = np.argsort(-s, kind="stable")[: min(k, n)]
+            want = t[top].sum() / t.sum()
+            self.assertAlmostEqual(float(got[0]), float(want), places=5)
+
+    def test_wer_random_token_streams(self):
+        rng = np.random.default_rng(105)
+        vocab = [f"w{i}" for i in range(12)]
+        for _ in range(TRIALS):
+            pairs = []
+            for _ in range(int(rng.integers(1, 6))):
+                hyp = " ".join(rng.choice(vocab, rng.integers(1, 20)))
+                ref = " ".join(rng.choice(vocab, rng.integers(1, 20)))
+                pairs.append((hyp, ref))
+            m = WordErrorRate()
+            for h, r in pairs:
+                m.update(h, r)
+
+            def edit(a, b):
+                a, b = a.split(), b.split()
+                dp = list(range(len(b) + 1))
+                for i, ca in enumerate(a, 1):
+                    prev, dp[0] = dp[0], i
+                    for j, cb in enumerate(b, 1):
+                        cur = dp[j]
+                        dp[j] = min(prev + (ca != cb), dp[j] + 1, dp[j - 1] + 1)
+                        prev = cur
+                return dp[-1]
+
+            errors = sum(edit(h, r) for h, r in pairs)
+            total = sum(len(r.split()) for _, r in pairs)
+            self.assertAlmostEqual(float(m.compute()), errors / total, places=5)
+
+    def test_perplexity_merge_invariance(self):
+        rng = np.random.default_rng(106)
+        for _ in range(4):
+            n, L, V = int(rng.integers(2, 6)) * 2, int(rng.integers(3, 12)), 17
+            logits = rng.normal(size=(n, L, V)).astype(np.float32)
+            target = rng.integers(0, V, (n, L))
+            whole = Perplexity().update(jnp.asarray(logits), jnp.asarray(target))
+            a = Perplexity().update(jnp.asarray(logits[: n // 2]), jnp.asarray(target[: n // 2]))
+            b = Perplexity().update(jnp.asarray(logits[n // 2 :]), jnp.asarray(target[n // 2 :]))
+            a.merge_state([b])
+            self.assertAlmostEqual(
+                float(a.compute()), float(whole.compute()), places=3
+            )
+
+    def test_psnr_scale_relation(self):
+        rng = np.random.default_rng(107)
+        for _ in range(4):
+            a = rng.random((2, 8, 8)).astype(np.float32)
+            b = rng.random((2, 8, 8)).astype(np.float32)
+            p1 = float(peak_signal_noise_ratio(jnp.asarray(a), jnp.asarray(b), data_range=1.0))
+            # scaling both images and the range leaves PSNR unchanged
+            p2 = float(
+                peak_signal_noise_ratio(
+                    jnp.asarray(a * 7), jnp.asarray(b * 7), data_range=7.0
+                )
+            )
+            self.assertAlmostEqual(p1, p2, places=4)
+
+
+if __name__ == "__main__":
+    unittest.main()
