@@ -1,0 +1,51 @@
+"""skip_value_checks: the opt-out for data-dependent validation round
+trips — results unchanged, eager raises suppressed inside the block and
+restored after it."""
+
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import MulticlassConfusionMatrix
+from torcheval_tpu.metrics.functional import (
+    multiclass_confusion_matrix,
+    skip_value_checks,
+)
+
+
+class TestSkipValueChecks(unittest.TestCase):
+    def test_results_identical_on_valid_data(self):
+        rng = np.random.default_rng(0)
+        pred = jnp.asarray(rng.integers(0, 5, 64))
+        tgt = jnp.asarray(rng.integers(0, 5, 64))
+        base = np.asarray(multiclass_confusion_matrix(pred, tgt, num_classes=5))
+        with skip_value_checks():
+            fast = np.asarray(
+                multiclass_confusion_matrix(pred, tgt, num_classes=5)
+            )
+        np.testing.assert_array_equal(base, fast)
+
+    def test_raise_suppressed_inside_and_restored_after(self):
+        m = MulticlassConfusionMatrix(num_classes=3)
+        with skip_value_checks():
+            # OOB indices are dropped by XLA scatter semantics, not raised
+            m.update(jnp.asarray([5]), jnp.asarray([1]))
+        self.assertEqual(int(m.compute().sum()), 0)
+        with self.assertRaises(ValueError):
+            m.update(jnp.asarray([5]), jnp.asarray([1]))
+
+    def test_restored_after_exception(self):
+        try:
+            with skip_value_checks():
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        with self.assertRaises(ValueError):
+            MulticlassConfusionMatrix(num_classes=3).update(
+                jnp.asarray([5]), jnp.asarray([1])
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -15,11 +15,53 @@ one round trip for validation instead of one per bound (the previous
 1000-class confusion-matrix update and dominated the benchmark end-to-end).
 """
 
+import os
+from contextlib import contextmanager
+from contextvars import ContextVar
 from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# Even one fused validation round trip costs a full host sync — ~10 µs on
+# a PCIe host, tens of ms through a tunneled backend, where it can
+# dominate µs-scale update kernels.  Both switches put the update path in
+# the same skip-value-checks mode it already runs in under jit tracing.
+_SKIP_CHECKS: ContextVar = ContextVar("torcheval_tpu_skip_value_checks", default=False)
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+@contextmanager
+def skip_value_checks():
+    """Disable data-dependent (value) validation of update inputs inside
+    the block.
+
+    Shape and parameter validation still applies; out-of-range indices
+    are then dropped by XLA's scatter semantics instead of raising —
+    exactly the documented behavior when composing the functional metrics
+    into a user jit program.  Use for throughput-critical update loops on
+    pre-validated data (or set ``TORCHEVAL_TPU_SKIP_VALUE_CHECKS=1`` to
+    disable process-wide)."""
+    token = _SKIP_CHECKS.set(True)
+    try:
+        yield
+    finally:
+        _SKIP_CHECKS.reset(token)
+
+
+def value_checks_enabled() -> bool:
+    """False inside :func:`skip_value_checks` or when the
+    ``TORCHEVAL_TPU_SKIP_VALUE_CHECKS`` env var is truthy (read at call
+    time, so harnesses may set it after import).  Gates only the
+    update-path *data* validations; parameter checks and compute-time
+    guards key on :func:`all_concrete` alone."""
+    if _SKIP_CHECKS.get():
+        return False
+    return (
+        os.environ.get("TORCHEVAL_TPU_SKIP_VALUE_CHECKS", "").lower()
+        not in _TRUTHY
+    )
 
 
 def all_concrete(*arrays) -> bool:
@@ -76,7 +118,7 @@ def check_index_ranges(
     many arrays it covers.  Raises for the first violating array in order
     (OOB indices must raise: XLA scatters/gathers silently drop or clamp
     them where torch ``scatter_``/``gather`` error)."""
-    if upper is None:
+    if upper is None or not value_checks_enabled():
         return
     # Skip only the arrays that are tracers — a concrete array alongside a
     # traced one still gets its eager raise-on-OOB behavior.

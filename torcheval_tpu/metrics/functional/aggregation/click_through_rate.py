@@ -10,7 +10,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torcheval_tpu.metrics.functional._host_checks import all_concrete
+from torcheval_tpu.metrics.functional._host_checks import (
+    all_concrete,
+    value_checks_enabled,
+)
 
 
 def click_through_rate(
@@ -89,7 +92,7 @@ def _ctr_input_check(
             )
     # Click events must be 0/1 — a data-dependent check, skipped under
     # tracing like every host-side value check (_host_checks.py).
-    if input.size and all_concrete(input):
+    if input.size and all_concrete(input) and value_checks_enabled():
         vals = np.asarray(jax.device_get(_ctr_binary_probe(input)))
         if not bool(vals):
             raise ValueError(

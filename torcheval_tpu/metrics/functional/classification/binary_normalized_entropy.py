@@ -17,7 +17,11 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional._host_checks import all_concrete, bounds
+from torcheval_tpu.metrics.functional._host_checks import (
+    all_concrete,
+    bounds,
+    value_checks_enabled,
+)
 
 
 def _accum_dtype() -> jnp.dtype:
@@ -128,7 +132,12 @@ def _ne_input_check(
             f"`num_tasks = {num_tasks}`, `input`'s shape is expected to be "
             f"({num_tasks}, num_samples), but got shape ({input.shape})."
         )
-    if not from_logits and input.size and all_concrete(input):
+    if (
+        not from_logits
+        and input.size
+        and all_concrete(input)
+        and value_checks_enabled()
+    ):
         lo, hi = bounds(input)
         input_min, input_max = float(lo), float(hi)
         if input_max > 1.0 or input_min < 0.0:

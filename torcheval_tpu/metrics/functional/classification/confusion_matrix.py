@@ -14,7 +14,11 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional._host_checks import all_concrete, bounds
+from torcheval_tpu.metrics.functional._host_checks import (
+    all_concrete,
+    bounds,
+    value_checks_enabled,
+)
 
 
 def binary_confusion_matrix(
@@ -72,7 +76,7 @@ def _binary_confusion_matrix_validate(input: jax.Array, target: jax.Array) -> No
     # OOB targets must raise — the XLA scatter would silently drop them
     # where torch ``scatter_`` errors.  (Skipped when tracing: data-
     # dependent checks cannot run at trace time.)
-    if target.size and all_concrete(target):
+    if target.size and all_concrete(target) and value_checks_enabled():
         t_min, t_max = bounds(target)
         if t_min < 0 or t_max >= 2:
             raise ValueError(
@@ -153,6 +157,8 @@ def _confusion_matrix_update_input_check(
     # individually (their values don't exist at trace time); a concrete
     # array alongside a traced one keeps its eager raise behavior.  The
     # eager check order (input first, then target) is preserved.
+    if not value_checks_enabled():
+        return
     to_check = []
     if input.ndim == 1 and all_concrete(input):
         to_check.append(("input", input))

@@ -19,7 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torcheval_tpu.metrics.functional._host_checks import all_concrete
+from torcheval_tpu.metrics.functional._host_checks import (
+    all_concrete,
+    value_checks_enabled,
+)
 
 
 def retrieval_precision(
@@ -114,7 +117,7 @@ def _retrieval_input_check(
     # Relevance must be 0/1 — graded targets would inflate the top-k hit
     # sum against the exact-1 relevant count.  Data-dependent, so skipped
     # under tracing like every host-side value check (_host_checks.py).
-    if target.size and all_concrete(target):
+    if target.size and all_concrete(target) and value_checks_enabled():
         ok = np.asarray(jax.device_get(_binary_target_probe(target)))
         if not bool(ok):
             raise ValueError(
