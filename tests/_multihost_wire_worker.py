@@ -1,0 +1,133 @@
+"""One rank of the real multi-process wire-path test.
+
+Spawned N times by ``tests/test_multihost_wire.py`` (the analog of the
+reference's 4-process gloo harness, reference
+``torcheval/utils/test_utils/metric_class_tester.py:286-326``).  Each process
+initializes ``jax.distributed`` on CPU, builds metrics with *ragged* per-rank
+states, and drives ``sync_and_compute`` through ``JaxProcessGroup`` — so the
+padded-uint8 byte all-gather in ``distributed.py`` (lengths side-channel +
+per-rank trim) executes with a real ``world_size > 1``.
+
+Every rank regenerates every rank's data deterministically, so the oracle is
+computed locally without extra communication.
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _rank_data(rank: int):
+    """Deterministic ragged per-rank batch: rank r holds 17 + 13*r samples."""
+    rng = np.random.default_rng(1234 + rank)
+    n = 17 + 13 * rank
+    scores = rng.random(n).astype(np.float32)
+    targets = (rng.random(n) > 0.5).astype(np.int32)
+    return scores, targets
+
+
+def main(pid: int, nprocs: int, port: int) -> None:
+    from torcheval_tpu.distributed import initialize_multihost
+
+    group = initialize_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert group.rank == pid, (group.rank, pid)
+    assert group.world_size == nprocs, (group.world_size, nprocs)
+
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics import BinaryAUROC, Max
+    from torcheval_tpu.metrics.functional import binary_auroc
+    from torcheval_tpu.metrics.toolkit import (
+        get_synced_state_dict,
+        sync_and_compute,
+    )
+    from torcheval_tpu.utils.test_utils.dummy_metric import (
+        DummySumDequeStateMetric,
+        DummySumDictStateMetric,
+        DummySumListStateMetric,
+        DummySumMetric,
+    )
+
+    # --- raw byte layer: ragged payloads exercise the padded trim directly.
+    payloads = group.all_gather_bytes(bytes(range(pid + 1)))
+    assert payloads == [bytes(range(r + 1)) for r in range(nprocs)], payloads
+
+    # --- buffer-state metric (concat merge) with ragged per-rank lengths;
+    # exercises _prepare_for_merge_state + pickle over the wire.
+    auroc = BinaryAUROC()
+    s, t = _rank_data(pid)
+    auroc.update(jnp.asarray(s), jnp.asarray(t))
+    all_s = np.concatenate([_rank_data(r)[0] for r in range(nprocs)])
+    all_t = np.concatenate([_rank_data(r)[1] for r in range(nprocs)])
+    oracle = float(binary_auroc(jnp.asarray(all_s), jnp.asarray(all_t)))
+
+    res0 = sync_and_compute(auroc, group, recipient_rank=0)
+    if pid == 0:
+        np.testing.assert_allclose(float(res0), oracle, rtol=1e-6)
+    else:
+        assert res0 is None, res0
+
+    res_last = sync_and_compute(auroc, group, recipient_rank=nprocs - 1)
+    if pid == nprocs - 1:
+        np.testing.assert_allclose(float(res_last), oracle, rtol=1e-6)
+    else:
+        assert res_last is None, res_last
+
+    res_all = sync_and_compute(auroc, group, recipient_rank="all")
+    np.testing.assert_allclose(float(res_all), oracle, rtol=1e-6)
+
+    # Source metric must be untouched by the sync (reference contract,
+    # ``metric.py:96-97``) and still updatable.
+    assert len(auroc.inputs) == 1
+    auroc.update(jnp.asarray(s), jnp.asarray(t))
+
+    # --- synced state_dict on the recipient only.
+    sd = get_synced_state_dict(auroc, group, recipient_rank=0)
+    if pid == 0:
+        assert "inputs" in sd and "targets" in sd, sorted(sd)
+    else:
+        assert sd == {}, sd
+
+    # --- the four TState container shapes through the same wire.
+    m = DummySumMetric().update(float(pid + 1))
+    out = sync_and_compute(m, group, recipient_rank="all")
+    assert float(out) == sum(range(1, nprocs + 1)), float(out)
+
+    lm = DummySumListStateMetric()
+    for i in range(pid + 1):  # ragged list length per rank
+        lm.update(float(i + 1))
+    out = sync_and_compute(lm, group, recipient_rank="all")
+    expect = sum(sum(range(1, r + 2)) for r in range(nprocs))
+    assert float(out) == expect, (float(out), expect)
+
+    dm = DummySumDictStateMetric().update(f"k{pid % 2}", float(pid + 1))
+    out = sync_and_compute(dm, group, recipient_rank="all")
+    expect_dict = {}
+    for r in range(nprocs):
+        key = f"k{r % 2}"
+        expect_dict[key] = expect_dict.get(key, 0.0) + float(r + 1)
+    got = {k: float(v) for k, v in out.items()}
+    assert got == expect_dict, (got, expect_dict)
+
+    qm = DummySumDequeStateMetric().update(float(pid + 1))
+    out = sync_and_compute(qm, group, recipient_rank="all")
+    assert float(out) == sum(range(1, nprocs + 1)), float(out)
+
+    # --- max-merge archetype.
+    mx = Max().update(jnp.asarray(float(pid * 10 + 1)))
+    out = sync_and_compute(mx, group, recipient_rank="all")
+    assert float(out) == float((nprocs - 1) * 10 + 1), float(out)
+
+    print(f"WIRE_OK rank={pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]))

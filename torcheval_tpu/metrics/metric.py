@@ -72,14 +72,24 @@ def canonicalize_device(device: DeviceLike) -> Placement:
     (reference ``metric.py:221-266``).
     """
     if device is None:
-        return jax.devices()[0]
+        # local_devices, not devices: under multi-host SPMD jax.devices()[0]
+        # is process 0's device, non-addressable from other ranks.
+        return jax.local_devices()[0]
     if isinstance(device, (jax.Device, jax.sharding.Sharding)):
         return device
     if isinstance(device, str):
         if ":" in device:
             platform, _, idx = device.partition(":")
-            return jax.devices(platform)[int(idx)]
-        return jax.devices(device)[0]
+            local = jax.local_devices(backend=platform)
+            i = int(idx)
+            # "tpu:5" names a global device id (what __getstate__ records);
+            # match it among this process's devices first, falling back to a
+            # local positional index (they coincide on a single host).
+            for d in local:
+                if d.id == i:
+                    return d
+            return local[i]
+        return jax.local_devices(backend=device)[0]
     raise ValueError(f"Invalid device {device!r}.")
 
 
@@ -294,7 +304,9 @@ class Metric(Generic[TComputeReturn], ABC):
         try:
             device = canonicalize_device(device_str)
         except (RuntimeError, IndexError, ValueError):
-            device = jax.devices()[0]
+            # E.g. a metric pickled on another host recorded a device id this
+            # process cannot address; land on the local default instead.
+            device = jax.local_devices()[0]
         self.__dict__.update(
             {k: _from_numpy_tree(v, device) for k, v in state.items()}
         )
