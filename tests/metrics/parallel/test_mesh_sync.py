@@ -201,6 +201,35 @@ class TestShardedAUROCHistogram(unittest.TestCase):
                 jnp.ones((2, 2)), jnp.ones((2, 2)), mesh=mesh
             )
 
+    def test_out_of_range_scores_raise(self):
+        # Logits passed by mistake must raise, not silently clip
+        # (reference validates its binned grid the same way,
+        # ``binned_precision_recall_curve.py:235-242``).
+        from torcheval_tpu.metrics.functional import skip_value_checks
+
+        mesh = make_mesh()
+        logits = jnp.asarray([-2.0, 0.5, 3.0, 0.25] * 4)
+        target = jnp.asarray([0.0, 1.0, 0.0, 1.0] * 4)
+        with self.assertRaisesRegex(ValueError, r"range of \[0, 1\]"):
+            sharded_auroc_histogram(logits, target, mesh=mesh)
+        # The opt-out keeps the old clipping behavior for hot loops.
+        with skip_value_checks():
+            float(sharded_auroc_histogram(logits, target, mesh=mesh))
+
+    def test_multiclass_out_of_range_scores_raise(self):
+        from torcheval_tpu.parallel import (
+            sharded_multiclass_auroc_histogram,
+        )
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(
+            rng.normal(size=(16, 4)).astype(np.float32) * 4
+        )
+        target = jnp.asarray(rng.integers(0, 4, 16))
+        with self.assertRaisesRegex(ValueError, r"range of \[0, 1\]"):
+            sharded_multiclass_auroc_histogram(logits, target, mesh=mesh)
+
 
 class TestShardedAUPRCHistogram(unittest.TestCase):
     def test_matches_sklearn_on_quantized_scores(self):

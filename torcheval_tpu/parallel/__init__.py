@@ -16,7 +16,12 @@ This package provides that layer:
   ``shard_map`` so each device reduces its local batch shard and one fused
   collective merges the partials (``psum``/``pmax``/``pmin`` chosen per state,
   mirroring each metric's ``merge_state`` semantics); ``mesh_merge_states``
-  is the raw per-leaf collective for use inside user ``shard_map`` code.
+  is the raw per-leaf collective for use inside user ``shard_map`` code;
+  plus the O(bins)-wire quantized ``sharded_*_histogram`` curve metrics.
+* :mod:`torcheval_tpu.parallel.exact` — pod-scale *exact* curve metrics:
+  the gather-exact family (bit-for-bit equal to the single-device
+  kernels) and the Mann-Whitney ustat family (ships only the minority
+  class — O(min(#pos, #neg)) wire).
 
 Note the *implicit* path needs no code at all: class metrics already accept
 mesh-sharded inputs — their update kernels are jitted pure functions, so
@@ -30,6 +35,13 @@ from torcheval_tpu.parallel.mesh import (
     make_mesh,
     replicate,
     shard_batch,
+)
+from torcheval_tpu.parallel.exact import (
+    sharded_binary_auprc_exact,
+    sharded_binary_auroc_exact,
+    sharded_binary_auroc_ustat,
+    sharded_multiclass_auroc_exact,
+    sharded_multiclass_auroc_ustat,
 )
 from torcheval_tpu.parallel.sync import (
     make_synced_update,
@@ -48,5 +60,10 @@ __all__ = [
     "shard_batch",
     "sharded_auprc_histogram",
     "sharded_auroc_histogram",
+    "sharded_binary_auprc_exact",
+    "sharded_binary_auroc_exact",
+    "sharded_binary_auroc_ustat",
+    "sharded_multiclass_auroc_exact",
     "sharded_multiclass_auroc_histogram",
+    "sharded_multiclass_auroc_ustat",
 ]

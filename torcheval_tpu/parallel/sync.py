@@ -125,7 +125,7 @@ def sharded_auroc_histogram(
     The reference's only distributed AUROC story is gathering every raw
     sample to one rank (reference ``classification/auroc.py:121-134`` +
     ``toolkit.py:247-255``) — O(total samples) over the wire.  Here each
-    device histograms its local scores (assumed in [0, 1], clipped) into
+    device histograms its local scores (validated in [0, 1]; see `_check_scores_in_unit_interval`) into
     ``num_bins`` threshold bins for positives/negatives, ONE ``psum`` merges
     the ``2 × num_bins`` histogram across the mesh, and the ROC integral is
     computed from the binned cumulative TP/FP curves on every device.
@@ -150,6 +150,32 @@ def sharded_auroc_histogram(
     return _run_sharded_binary(local, mesh, axis, scores, targets, weights)
 
 
+def _check_scores_in_unit_interval(scores) -> None:
+    """Raise when histogram-binned scores fall outside [0, 1] — silent
+    clipping would distort the curve if logits are passed by mistake (the
+    reference's binned family validates its grid the same way, reference
+    ``binned_precision_recall_curve.py:235-242``).  Host check: one fused
+    round trip, skipped under tracing or ``skip_value_checks``."""
+    from torcheval_tpu.metrics.functional._host_checks import (
+        all_concrete,
+        bounds,
+        value_checks_enabled,
+    )
+
+    if not value_checks_enabled() or not all_concrete(scores):
+        return
+    if scores.size == 0:
+        return
+    lo, hi = bounds(scores)
+    if lo < 0 or hi > 1:
+        raise ValueError(
+            "The values in `scores` should be in the range of [0, 1] for "
+            f"histogram-binned curve metrics, got min {lo} max {hi} "
+            "(apply a sigmoid/softmax first, or use the exact sharded "
+            "variants in torcheval_tpu.parallel.exact)."
+        )
+
+
 def _local_binned_counts(s, t, w, num_bins: int, axis: str):
     """Per-device positive/total weighted histograms over the [0, 1] score
     grid, psum-merged across the mesh axis — the shared first stage of
@@ -169,6 +195,7 @@ def _run_sharded_binary(local, mesh: Mesh, axis: str, scores, targets, weights):
         raise ValueError(
             f"scores and targets should be 1-D, got {scores.shape} / {targets.shape}."
         )
+    _check_scores_in_unit_interval(scores)
     if weights is None:
         weights = jnp.ones_like(scores, dtype=jnp.float32)
     fn = jax.jit(
@@ -193,7 +220,7 @@ def sharded_auprc_histogram(
     """Pod-scale binary average precision with O(num_bins) communication.
 
     Same histogram scheme as :func:`sharded_auroc_histogram` — each device
-    bins its local scores (assumed in [0, 1], clipped), ONE ``psum`` merges
+    bins its local scores (validated in [0, 1]; see `_check_scores_in_unit_interval`), ONE ``psum`` merges
     the ``2 × num_bins`` histogram, and the step-rule AP
 
         AP = Σ_bins ΔR_bin · P_bin
@@ -236,8 +263,9 @@ def sharded_multiclass_auroc_histogram(
     workload shape (1000-class, samples sharded over the pod) with
     O(C × num_bins) communication instead of gathering every raw sample.
 
-    Each device scatters its local ``(n_local, C)`` scores (assumed in
-    [0, 1], clipped) into per-class positive/total histograms, ONE ``psum``
+    Each device scatters its local ``(n_local, C)`` scores (validated in
+    [0, 1]; see `_check_scores_in_unit_interval`) into per-class
+    positive/total histograms, ONE ``psum``
     merges the ``(C, 2 × num_bins)`` statistics across the mesh, and every
     device integrates the binned ROC curves — all classes vectorized.
     Quantization caveat as :func:`sharded_auroc_histogram`.
@@ -247,6 +275,7 @@ def sharded_multiclass_auroc_histogram(
             "scores should be (N, C) and targets (N,), got "
             f"{scores.shape} / {targets.shape}."
         )
+    _check_scores_in_unit_interval(scores)
     num_classes = scores.shape[1]
 
     def local(s, t):
