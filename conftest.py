@@ -10,17 +10,45 @@ TPU plugin), so env vars alone are too late — we must go through
 ``jax.config.update`` for the platform, and set XLA_FLAGS before the CPU
 backend is instantiated (backends initialize lazily, so this is still in
 time).
+
+``TORCHEVAL_TPU_ON_CHIP=1`` flips the suite into on-chip mode: the real
+TPU backend is kept and ONLY ``-m tpu``-marked tests run (compiled Mosaic
+kernel validation; see ``tests/ops/test_pallas_tpu.py``).
 """
 
 import os
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+_ON_CHIP = os.environ.get("TORCHEVAL_TPU_ON_CHIP", "") == "1"
 
-import jax  # noqa: E402
+if not _ON_CHIP:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
-jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_collection_modifyitems(config, items):
+    """`-m tpu` tests need the real chip: auto-skip them on the CPU mesh
+    (the default run), and skip everything ELSE in on-chip mode so
+    ``TORCHEVAL_TPU_ON_CHIP=1 pytest -m tpu`` never drags the whole CPU
+    matrix onto the tunneled chip."""
+    import pytest
+
+    if _ON_CHIP:
+        skip = pytest.mark.skip(reason="on-chip run executes only -m tpu tests")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+    else:
+        skip = pytest.mark.skip(
+            reason="needs the real TPU chip (TORCHEVAL_TPU_ON_CHIP=1)"
+        )
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip)

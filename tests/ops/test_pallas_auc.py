@@ -73,6 +73,41 @@ class TestPallasBinaryAUROC(unittest.TestCase):
             0.5,
         )
 
+    def test_beyond_2pow24_exactness(self):
+        # N = 2^25: beyond the old float32-count limit.  int32 count
+        # carries keep tie-group boundaries and totals exact; the result
+        # must match a float64 numpy Mann-Whitney oracle to f32 precision.
+        n = 2**25
+        rng = np.random.default_rng(7)
+        # 4096 distinct levels → ~8k-sample tie groups spanning many tiles.
+        s = (rng.random(n) * 4096).astype(np.int32).astype(np.float32) / 4096
+        t = (rng.random(n) > 0.25).astype(np.float32)
+
+        order = np.argsort(-s, kind="stable")
+        ss, hh = s[order], t[order].astype(np.float64)
+        # Exact U via per-level counts in float64.
+        levels, idx = np.unique(-ss, return_index=True)
+        counts = np.diff(np.append(idx, n))
+        pos_per = np.add.reduceat(hh, idx)
+        neg_per = counts - pos_per
+        num_pos, num_neg = pos_per.sum(), neg_per.sum()
+        cum_neg_before = np.cumsum(neg_per) - neg_per
+        u = (pos_per * (cum_neg_before + 0.5 * neg_per)).sum()
+        want = 1.0 - u / (num_pos * num_neg)  # descending orientation
+
+        # tile=2^20 keeps the interpreter's per-grid-step overhead sane (32
+        # steps) while still crossing 31 tile-carry boundaries; the on-chip
+        # `-m tpu` suite runs this size with the production tile.
+        got = float(
+            auc_from_sorted(
+                jnp.asarray(ss)[None],
+                jnp.asarray(t[order])[None],
+                interpret=True,
+                tile=2**20,
+            )[0]
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
     def test_unpadded_lane_counts(self):
         rng = np.random.default_rng(6)
         for n in (1, 7, 127, 128, 129, 1000):

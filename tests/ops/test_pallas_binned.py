@@ -1,0 +1,98 @@
+"""Pallas MXU binned-count kernel — interpret-mode parity with the sort
+formulation (the two must be bit-identical int32 counts; the compiled
+Mosaic kernel is asserted on-chip in ``test_pallas_tpu.py``)."""
+
+import unittest
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.binned_auc import (
+    _binned_counts_rows_sort,
+)
+from torcheval_tpu.ops.pallas_binned import pallas_binned_counts
+
+
+def _assert_counts_equal(testcase, a, b, msg=""):
+    for x, y, name in zip(a, b, ("num_tp", "num_fp", "num_pos", "num_total")):
+        testcase.assertTrue(
+            np.array_equal(np.asarray(x), np.asarray(y)),
+            f"{msg} {name}: {np.asarray(x)} != {np.asarray(y)}",
+        )
+
+
+class TestPallasBinnedCounts(unittest.TestCase):
+    def test_matches_sort_formulation(self):
+        rng = np.random.default_rng(0)
+        for r, n, t_count in [
+            (1, 5000, 200),
+            (3, 2048, 100),
+            (1, 10000, 1000),
+            (2, 777, 300),
+            (16, 555, 33),
+        ]:
+            s = jnp.asarray(rng.random((r, n)).astype(np.float32))
+            h = jnp.asarray(rng.random((r, n)) > 0.4)
+            th = jnp.linspace(0, 1.0, t_count)
+            _assert_counts_equal(
+                self,
+                pallas_binned_counts(s, h, th, interpret=True),
+                _binned_counts_rows_sort(s, h, th),
+                msg=f"r={r} n={n} T={t_count}",
+            )
+
+    def test_single_block_grid(self):
+        # T <= 128 (Bc == 1) exercises the zero-shift special case.
+        rng = np.random.default_rng(1)
+        s = jnp.asarray(rng.random((1, 4096)).astype(np.float32))
+        h = jnp.asarray(rng.random((1, 4096)) > 0.5)
+        for t_count in (1, 4, 100, 128):
+            th = jnp.linspace(0, 1.0, t_count) if t_count > 1 else jnp.asarray([0.5])
+            _assert_counts_equal(
+                self,
+                pallas_binned_counts(s, h, th, interpret=True),
+                _binned_counts_rows_sort(s, h, th),
+                msg=f"T={t_count}",
+            )
+
+    def test_arbitrary_grid_ties_and_out_of_range(self):
+        rng = np.random.default_rng(2)
+        s = jnp.asarray(
+            (rng.random((1, 4096)) * 20 - 5).round().astype(np.float32)
+        )
+        h = jnp.asarray(rng.random((1, 4096)) > 0.5)
+        th = jnp.asarray(
+            np.sort(
+                rng.choice(np.arange(-6, 18.0), 17, replace=False)
+            ).astype(np.float32)
+        )
+        _assert_counts_equal(
+            self,
+            pallas_binned_counts(s, h, th, interpret=True),
+            _binned_counts_rows_sort(s, h, th),
+        )
+
+    def test_thresholds_equal_to_scores(self):
+        # Grid values that exactly equal scores: >= must include equality,
+        # and the f32 gather matmul must reproduce thresholds bit-exactly.
+        s = jnp.asarray([[0.0, 0.25, 0.25, 0.5, 0.75, 1.0, 0.125, 0.625]])
+        h = jnp.asarray([[1, 0, 1, 1, 0, 1, 0, 1]], dtype=bool)
+        th = jnp.asarray([0.0, 0.125, 0.25, 0.5, 0.625, 0.75, 1.0])
+        _assert_counts_equal(
+            self,
+            pallas_binned_counts(s, h, th, interpret=True),
+            _binned_counts_rows_sort(s, h, th),
+        )
+
+    def test_empty_input(self):
+        s = jnp.zeros((2, 0), jnp.float32)
+        h = jnp.zeros((2, 0), bool)
+        th = jnp.linspace(0, 1.0, 5)
+        tp, fp, pos, tot = pallas_binned_counts(s, h, th, interpret=True)
+        self.assertEqual(tp.shape, (2, 5))
+        self.assertEqual(int(jnp.sum(tp) + jnp.sum(fp) + jnp.sum(tot)), 0)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -115,8 +115,10 @@ def _use_pallas(num_samples: int) -> bool:
     math, single HBM pass; see ``torcheval_tpu/ops/pallas_auc.py``).  Set
     ``TORCHEVAL_TPU_DISABLE_PALLAS=1`` to force the pure-XLA path.
 
-    Rows of ≥ 2^24 samples stay on the XLA path: the kernel carries counts
-    in float32, which is exact only below 2^24."""
+    The kernel carries counts in int32 (exact to 2^31 samples per row,
+    with Kahan-compensated f32 area accumulation — the same precision
+    class as the XLA trapezoid), so the headline path needs no fallback;
+    only the int32 ceiling itself routes to the XLA path."""
     if os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in (
         "1",
         "true",
@@ -124,7 +126,7 @@ def _use_pallas(num_samples: int) -> bool:
         "on",
     ):
         return False
-    if num_samples >= 2**24:
+    if num_samples >= 2**31:
         return False
     from torcheval_tpu.ops.pallas_auc import has_pallas
 

@@ -17,6 +17,7 @@ translation of the reference's binned update would cost on a
 ``(1000, 200, N)`` boolean tensor.
 """
 
+import os
 from functools import partial
 from typing import List, Optional, Tuple, Union
 
@@ -187,16 +188,48 @@ def _multiclass_binned_auc_validate(
     _check_index_range(target, num_classes, "target")
 
 
-@jax.jit
+def _use_pallas_binned(num_samples: int, num_thresholds: int) -> bool:
+    """Route the binned-count stage through the Pallas MXU histogram
+    kernel on TPU (``ops/pallas_binned.py``) — bit-identical counts, no
+    sort.  Stays on the sort path when: the env kill-switch is set; rows
+    exceed 2^24 samples (the kernel's per-bin f32 accumulation limit —
+    the sort path is int32-exact); or the grid exceeds 2^15 thresholds
+    (VMEM budget for the one-hot tiles)."""
+    if os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    ):
+        return False
+    if num_samples >= 2**24 or num_thresholds > 2**15:
+        return False
+    from torcheval_tpu.ops.pallas_binned import has_pallas
+
+    return has_pallas()
+
+
 def _binned_counts_rows(
     scores: jax.Array, hits: jax.Array, thresholds: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Per-threshold prediction counts for ``pred = score >= t`` over
-    ``(R, N)`` score/hit rows.
+    ``(R, N)`` score/hit rows — dispatches between the Pallas MXU
+    histogram kernel (TPU) and the sort formulation below; both return
+    bit-identical int32 counts."""
+    if _use_pallas_binned(scores.shape[-1], thresholds.shape[0]):
+        from torcheval_tpu.ops.pallas_binned import pallas_binned_counts
 
-    One variadic sort co-sorts hits with scores, an inclusive cumsum
-    gives hits-below-any-point, and ``searchsorted`` reads each
-    threshold's boundary off the sorted row:
+        return pallas_binned_counts(scores, hits, thresholds)
+    return _binned_counts_rows_sort(scores, hits, thresholds)
+
+
+@jax.jit
+def _binned_counts_rows_sort(
+    scores: jax.Array, hits: jax.Array, thresholds: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sort-formulation binned counts: one variadic sort co-sorts hits
+    with scores, an inclusive cumsum gives hits-below-any-point, and
+    ``searchsorted`` reads each threshold's boundary off the sorted row:
     ``num_tp(t) = total_hits − hits_below(t)``.  Scatter-free (TPU
     scatters serialize; sorting the row is several times faster).
     Returns ``(num_tp (R,T), num_fp (R,T), num_pos (R,), num_total (R,))``

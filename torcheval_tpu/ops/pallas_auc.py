@@ -38,8 +38,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 _BIG = 3.4e38
-_ROWS = 8  # sublane tile: 8 rows per grid step (f32 min tile is (8, 128))
-_TILE = 8192  # lane tile; ~10 (8, 8192) f32 temporaries ≈ 2.6 MB VMEM
+_INT_MIN = jnp.iinfo(jnp.int32).min + 1
+_ROWS = 8  # sublane tile: 8 rows per grid step (f32/i32 min tile is (8, 128))
+_TILE = 8192  # lane tile; ~10 (8, 8192) temporaries ≈ 2.6 MB VMEM
 
 
 def _shift_right(x: jax.Array, d: int, fill) -> jax.Array:
@@ -55,110 +56,138 @@ def _tile_cumsum(x: jax.Array) -> jax.Array:
     n = x.shape[-1]
     d = 1
     while d < n:
-        x = x + _shift_right(x, d, 0.0)
+        x = x + _shift_right(x, d, jnp.zeros((), x.dtype))
         d *= 2
     return x
 
 
-def _tile_cummax(x: jax.Array) -> jax.Array:
+def _tile_cummax(x: jax.Array, floor) -> jax.Array:
     n = x.shape[-1]
     d = 1
     while d < n:
-        x = jnp.maximum(x, _shift_right(x, d, -_BIG))
+        x = jnp.maximum(x, _shift_right(x, d, floor))
         d *= 2
     return x
 
 
-# Carry columns in the (ROWS, 128) VMEM scratch, one value per row.
-_C_CUM_TP = 0  # running Σ hits (cumulative positives)
-_C_CUM_FP = 1  # running Σ (1 - hits) (cumulative negatives)
-_C_PE_TP = 2  # cum_tp at the most recent processed group end
-_C_PE_FP = 3  # cum_fp at the most recent processed group end
-_C_PREV_T = 4  # threshold of the last valid lane seen so far
-_C_ACC = 5  # Σ_groups P_g * (end_fp + prevend_fp)
+# Carry columns, one value per row.  Integer counts live in the int32
+# scratch (exact to 2^31, which is what lifts the old float32 2^24 sample
+# limit); the float scratch carries the last-seen threshold and the
+# Kahan-compensated area accumulator.
+_C_CUM_TP = 0  # i32: running Σ hits (cumulative positives)
+_C_CUM_FP = 1  # i32: running Σ (1 - hits) (cumulative negatives)
+_C_PE_TP = 2  # i32: cum_tp at the most recent processed group end
+_C_PE_FP = 3  # i32: cum_fp at the most recent processed group end
+_F_PREV_T = 0  # f32: threshold of the last valid lane seen so far
+_F_ACC = 1  # f32: Σ_groups P_g * (end_fp + prevend_fp)
+_F_COMP = 2  # f32: Kahan compensation for the accumulator
 
 
 def _col(carry, idx: int) -> jax.Array:
     return carry[:, idx : idx + 1]  # (ROWS, 1)
 
 
-def _auc_scan_kernel(t_ref, h_ref, out_ref, carry, *, n_valid: int, tile: int):
+def _auc_scan_kernel(
+    t_ref, h_ref, out_ref, icarry, fcarry, *, n_valid: int, tile: int
+):
     """Grid = (row_blocks, col_tiles); one (ROWS, tile) block per step."""
     j = pl.program_id(1)
     num_j = pl.num_programs(1)
 
     @pl.when(j == 0)
     def _init():
-        col = lax.broadcasted_iota(jnp.int32, carry.shape, 1)
-        carry[:, :] = jnp.where(col == _C_PREV_T, _BIG, 0.0)
+        icarry[:, :] = jnp.zeros(icarry.shape, jnp.int32)
+        col = lax.broadcasted_iota(jnp.int32, fcarry.shape, 1)
+        fcarry[:, :] = jnp.where(col == _F_PREV_T, _BIG, 0.0)
 
     t = t_ref[:]  # (ROWS, tile) float32, sorted descending, pads = -inf
     h = h_ref[:]  # (ROWS, tile) float32 hits in {0, 1}, pads = 0
 
     lane = lax.broadcasted_iota(jnp.int32, t.shape, 1)
     valid = (j * tile + lane) < n_valid
-    h = jnp.where(valid, h, 0.0)
-    neg = jnp.where(valid, 1.0 - h, 0.0)
+    hi = jnp.where(valid, h.astype(jnp.int32), 0)
+    neg = jnp.where(valid, 1 - h.astype(jnp.int32), 0)
 
-    cum_tp = _tile_cumsum(h) + _col(carry, _C_CUM_TP)
-    cum_fp = _tile_cumsum(neg) + _col(carry, _C_CUM_FP)
+    cum_tp = _tile_cumsum(hi) + _col(icarry, _C_CUM_TP)
+    cum_fp = _tile_cumsum(neg) + _col(icarry, _C_CUM_FP)
     # Cumulatives at the *previous* lane (group-end values live at i-1).
-    tp_m1 = cum_tp - h
+    tp_m1 = cum_tp - hi
     fp_m1 = cum_fp - neg
 
     # First lane of a new tie group: threshold differs from the previous
     # lane (carried across tiles).  The group that just ended at lane i-1 is
     # processed here; each row's final group is settled in the epilogue.
     prev_t = _shift_right(t, 1, 0.0)
-    prev_t = jnp.where(lane == 0, _col(carry, _C_PREV_T), prev_t)
+    prev_t = jnp.where(lane == 0, _col(fcarry, _F_PREV_T), prev_t)
     flag = jnp.logical_and(t != prev_t, valid)
 
     # Per-flag "previous group end" = nearest flagged lane to the left
     # (forward cummax works: cumulatives are nondecreasing), seeded by the
     # cross-tile carry.
-    a_fp = jnp.where(flag, fp_m1, -_BIG)
-    a_tp = jnp.where(flag, tp_m1, -_BIG)
+    a_fp = jnp.where(flag, fp_m1, _INT_MIN)
+    a_tp = jnp.where(flag, tp_m1, _INT_MIN)
     prev_fp = jnp.maximum(
-        _tile_cummax(_shift_right(a_fp, 1, -_BIG)), _col(carry, _C_PE_FP)
+        _tile_cummax(_shift_right(a_fp, 1, _INT_MIN), _INT_MIN),
+        _col(icarry, _C_PE_FP),
     )
     prev_tp = jnp.maximum(
-        _tile_cummax(_shift_right(a_tp, 1, -_BIG)), _col(carry, _C_PE_TP)
+        _tile_cummax(_shift_right(a_tp, 1, _INT_MIN), _INT_MIN),
+        _col(icarry, _C_PE_TP),
     )
 
-    contrib = jnp.where(flag, (tp_m1 - prev_tp) * (fp_m1 + prev_fp), 0.0)
+    # Pair counts are exact int32; the product can exceed 2^24, so it is
+    # formed in float32 (same precision class as the pure-XLA trapezoid,
+    # which also multiplies f32-cast counts) and Kahan-compensated across
+    # tiles below.
+    contrib = jnp.where(
+        flag,
+        (tp_m1 - prev_tp).astype(jnp.float32)
+        * (fp_m1 + prev_fp).astype(jnp.float32),
+        0.0,
+    )
 
-    # Advance the carries (per-row scalars, one VMEM scratch column each).
-    new_acc = _col(carry, _C_ACC) + jnp.sum(contrib, axis=1, keepdims=True)
-    new_tp = _col(carry, _C_CUM_TP) + jnp.sum(h, axis=1, keepdims=True)
-    new_fp = _col(carry, _C_CUM_FP) + jnp.sum(neg, axis=1, keepdims=True)
+    # Advance the carries (per-row scalars, one scratch column each).
+    tile_sum = jnp.sum(contrib, axis=1, keepdims=True)
+    acc = _col(fcarry, _F_ACC)
+    comp = _col(fcarry, _F_COMP)
+    y = tile_sum - comp
+    new_acc = acc + y
+    new_comp = (new_acc - acc) - y
+    new_tp = _col(icarry, _C_CUM_TP) + jnp.sum(hi, axis=1, keepdims=True)
+    new_fp = _col(icarry, _C_CUM_FP) + jnp.sum(neg, axis=1, keepdims=True)
     new_pe_fp = jnp.maximum(
-        _col(carry, _C_PE_FP), jnp.max(a_fp, axis=1, keepdims=True)
+        _col(icarry, _C_PE_FP), jnp.max(a_fp, axis=1, keepdims=True)
     )
     new_pe_tp = jnp.maximum(
-        _col(carry, _C_PE_TP), jnp.max(a_tp, axis=1, keepdims=True)
+        _col(icarry, _C_PE_TP), jnp.max(a_tp, axis=1, keepdims=True)
     )
-    any_valid = jnp.max(valid.astype(jnp.float32), axis=1, keepdims=True) > 0
+    any_valid = jnp.max(valid.astype(jnp.int32), axis=1, keepdims=True) > 0
     last_valid_t = jnp.min(
         jnp.where(valid, t, _BIG), axis=1, keepdims=True
     )  # descending ⇒ min over valid lanes
-    new_prev_t = jnp.where(any_valid, last_valid_t, _col(carry, _C_PREV_T))
+    new_prev_t = jnp.where(any_valid, last_valid_t, _col(fcarry, _F_PREV_T))
 
-    carry[:, _C_CUM_TP : _C_CUM_TP + 1] = new_tp
-    carry[:, _C_CUM_FP : _C_CUM_FP + 1] = new_fp
-    carry[:, _C_PE_TP : _C_PE_TP + 1] = new_pe_tp
-    carry[:, _C_PE_FP : _C_PE_FP + 1] = new_pe_fp
-    carry[:, _C_PREV_T : _C_PREV_T + 1] = new_prev_t
-    carry[:, _C_ACC : _C_ACC + 1] = new_acc
+    icarry[:, _C_CUM_TP : _C_CUM_TP + 1] = new_tp
+    icarry[:, _C_CUM_FP : _C_CUM_FP + 1] = new_fp
+    icarry[:, _C_PE_TP : _C_PE_TP + 1] = new_pe_tp
+    icarry[:, _C_PE_FP : _C_PE_FP + 1] = new_pe_fp
+    fcarry[:, _F_PREV_T : _F_PREV_T + 1] = new_prev_t
+    fcarry[:, _F_ACC : _F_ACC + 1] = new_acc
+    fcarry[:, _F_COMP : _F_COMP + 1] = new_comp
 
     @pl.when(j == num_j - 1)
     def _epilogue():
-        num_pos = new_tp
-        num_neg = new_fp
+        num_pos = new_tp.astype(jnp.float32)
+        num_neg = new_fp.astype(jnp.float32)
         # Each row's final group ends at its last valid lane: its end values
         # are the row totals.
-        acc = new_acc + (num_pos - new_pe_tp) * (num_neg + new_pe_fp)
+        acc_total = (
+            (new_acc - new_comp)
+            + (new_tp - new_pe_tp).astype(jnp.float32)
+            * (new_fp + new_pe_fp).astype(jnp.float32)
+        )
         factor = num_pos * num_neg
-        area = factor - 0.5 * acc
+        area = factor - 0.5 * acc_total
         out_ref[:, :] = jnp.where(factor == 0, 0.5, area / factor)
 
 
@@ -178,8 +207,11 @@ def auc_from_sorted(
 
     Rows stream through ``(8, tile)`` VMEM blocks with carried per-row
     scalars, so VMEM use is O(tile), not O(N).  Counts are carried in
-    float32, which is exact only for rows of < 2^24 samples — the AUROC
-    dispatch routes longer rows to the int32 pure-XLA path.
+    int32 — exact to 2^31 samples per row; the area accumulation forms
+    count products in float32 with Kahan compensation across tiles, the
+    same precision class as the pure-XLA trapezoid path (which also
+    multiplies f32-cast counts), so no fallback is needed at any
+    practical row length.
     """
     r, n = thresholds.shape
     tile = min(tile, _pad_to(n, 128))
@@ -202,7 +234,10 @@ def auc_from_sorted(
         ],
         out_specs=pl.BlockSpec((_ROWS, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((r_pad, 1), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((_ROWS, 128), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((_ROWS, 128), jnp.int32),
+            pltpu.VMEM((_ROWS, 128), jnp.float32),
+        ],
         interpret=interpret,
     )(t, h)
     return out[:r, 0]

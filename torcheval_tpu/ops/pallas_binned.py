@@ -1,0 +1,228 @@
+"""Pallas TPU kernel: per-threshold prediction counts via MXU one-hot
+matmuls — the binned-AUC family's histogram stage without sort or scatter.
+
+The pure-XLA formulation (``functional/classification/binned_auc.py``)
+sorts each row and reads counts off with ``searchsorted`` because TPU
+scatters serialize (one element per cycle).  This kernel replaces the
+O(N log N) sort with an O(N·T/MXU) streaming pass:
+
+1. Stream ``(1, tile)`` score/hit blocks through VMEM (one HBM read of the
+   inputs, zero intermediate HBM traffic).
+2. Coarse stage: compare the tile against the ``Bc = ceil(T/128)`` coarse
+   block boundaries (every 128th threshold) — a ``(Bc, tile)``
+   nonincreasing 0/1 matrix whose vertical difference is the one-hot
+   coarse-block selector.  Elements below the first threshold select no
+   block and contribute nothing (correct: they fall in no ``score >= t``
+   count).
+3. Gather-matmul: ``(128, Bc) @ (Bc, tile)`` with the one-hot selector
+   pulls each element's 128 candidate thresholds out of the VMEM-resident
+   ``(128, Bc)`` threshold table — an exact f32 MXU matmul standing in for
+   the per-element row gather Mosaic has no primitive for (a one-hot f32
+   dot reproduces the threshold values bit-exactly).
+4. Fine stage: compare, difference into a per-bin one-hot, stack
+   ``[one_hot, one_hot * hit]``, and accumulate the ``(Bc, 256)``
+   histogram pair with ONE bf16 MXU matmul per tile (0/1 values are exact
+   in bf16; f32 accumulation is exact below 2^24 per bin).
+5. Epilogue: suffix-sum outside the kernel turns per-bin counts into the
+   per-threshold ``num_tp`` / ``num_fp`` the binned family consumes —
+   bit-identical integers to the sort formulation's.
+
+Works for any ascending threshold grid (the comparisons use the exact
+grid values, not a linspace reconstruction).  FLOP cost is O(N·T) on the
+MXU, which beats the sort's O(N log N) VPU/permute work up to tens of
+thousands of thresholds.  Measured on a v5e chip (device-side fori_loop
+timing, bit-equal counts in every config):
+
+    (R, N, T)            this kernel        sort formulation
+    (1, 4M, 10000)       6.1 ms  686 M/s    66.7 ms  63 M/s   10.9x
+    (1, 4M, 200)         5.6 ms  752 M/s    65.1 ms  64 M/s   11.7x
+    (1000, 4096, 200)    5.4 ms  758 M/s    30.1 ms 136 M/s    5.6x
+    (32, 131072, 200)    5.6 ms  748 M/s     7.1 ms 594 M/s    1.3x
+    (1, 4M, 32768)      13.0 ms  322 M/s    70.7 ms  59 M/s    5.4x
+
+The dispatch in ``binned_auc.py`` routes TPU calls here (see
+``TORCHEVAL_TPU_DISABLE_PALLAS`` and the limits in
+``_use_pallas_binned``).
+"""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANE = 128  # fine-stage width: thresholds per coarse block
+_SENTINEL = 3.0e38  # finite "never <= any score" pad for the threshold table
+_TILE = 2048  # samples per grid step; ~(Bc+384, 2048) VMEM temporaries
+
+
+def _suffix_cumsum(x: jax.Array) -> jax.Array:
+    return jnp.cumsum(x[..., ::-1], axis=-1)[..., ::-1]
+
+
+def _binned_count_kernel(
+    s_ref, h_ref, ttab_ref, out_ref, hist, *, n_valid: int, tile: int,
+    tiles_per_row: int,
+):
+    """1-D grid over (row, tile) pairs flattened in row-major order (rows
+    are padded to a whole number of tiles, so no tile crosses a row
+    boundary — Mosaic's block rules then only ever see (1, tile) blocks).
+    ``ttab`` is the (128, Bc) threshold table (column c holds thresholds
+    [c*128, (c+1)*128), finite sentinel pads); ``hist`` the (Bc, 256) f32
+    scratch accumulator ([:, :128] totals, [:, 128:] hits)."""
+    j = pl.program_id(0) % tiles_per_row  # tile index within the row
+
+    @pl.when(j == 0)
+    def _init():
+        hist[:, :] = jnp.zeros(hist.shape, jnp.float32)
+
+    s = s_ref[:]  # (1, tile) f32 scores
+    h = h_ref[:]  # (1, tile) f32 hits in {0, 1}
+    ttab = ttab_ref[:]  # (128, Bc) f32
+
+    lane = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = (j * tile + lane) < n_valid  # (1, tile)
+
+    # Coarse: block boundaries are the table's first row.  ge is 0/1 and
+    # nonincreasing down the block axis; its vertical difference is the
+    # one-hot block selector (all-zero for scores below every boundary,
+    # and for sentinel pad blocks).
+    bounds = ttab[0:1, :].T  # (Bc, 1)
+    ge_c = jnp.logical_and(s >= bounds, valid).astype(jnp.float32)
+    if ge_c.shape[0] > 1:
+        oc = ge_c - jnp.concatenate(
+            [ge_c[1:, :], jnp.zeros((1, ge_c.shape[1]), jnp.float32)], axis=0
+        )  # (Bc, tile) one-hot
+    else:
+        # Bc == 1: the shifted term is all zeros, and Mosaic cannot lower
+        # the zero-sized ge_c[1:, :] slice.
+        oc = ge_c
+
+    # Gather-matmul: pull each element's candidate block of thresholds.
+    # Precision HIGHEST is load-bearing: the TPU's default bf16 matmul
+    # passes would round the gathered thresholds and mis-bin every score
+    # that falls between a threshold and its bf16 image.
+    gathered = lax.dot_general(
+        ttab,
+        oc,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
+    )  # (128, tile)
+
+    # Fine: one-hot of the largest in-block threshold <= score.
+    ge_f = (gathered <= s).astype(jnp.float32)  # nonincreasing down axis 0
+    of = ge_f - jnp.concatenate(
+        [ge_f[1:, :], jnp.zeros((1, ge_f.shape[1]), jnp.float32)], axis=0
+    )
+    of2 = jnp.concatenate([of, of * h], axis=0)  # (256, tile)
+
+    # Histogram accumulation: ONE MXU matmul per tile.
+    hist[:, :] += lax.dot_general(
+        oc.astype(jnp.bfloat16),
+        of2.astype(jnp.bfloat16),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (Bc, 256)
+
+    @pl.when(j == tiles_per_row - 1)
+    def _epilogue():
+        out_ref[0, :, :] = hist[:, :]
+
+
+def _pad_to(n: int, m: int) -> int:
+    return max(m, -(-n // m) * m)
+
+
+@partial(jax.jit, static_argnames=("interpret", "tile"))
+def _pallas_binned_hist(
+    scores: jax.Array,
+    hits: jax.Array,
+    thresholds: jax.Array,
+    *,
+    interpret: bool = False,
+    tile: int = _TILE,
+) -> jax.Array:
+    """(R, Bc, 256) per-bin histogram pair for ``(R, N)`` rows."""
+    r, n = scores.shape
+    t = thresholds.shape[0]
+    bc = -(-t // _LANE)
+    n_pad = _pad_to(n, tile)
+    tile = min(tile, n_pad)
+    tiles_per_row = n_pad // tile
+    # Finite sentinel, not +inf: pad entries ride through the gather
+    # matmul as sentinel*0 and inf*0 would poison it with NaNs.
+    ttab = jnp.full((bc * _LANE,), _SENTINEL, jnp.float32).at[:t].set(
+        thresholds.astype(jnp.float32)
+    )
+    ttab = ttab.reshape(bc, _LANE).T  # (128, Bc)
+    s = scores.astype(jnp.float32)
+    h = hits.astype(jnp.float32)
+    if n_pad != n:
+        s = jnp.pad(s, ((0, 0), (0, n_pad - n)))
+        h = jnp.pad(h, ((0, 0), (0, n_pad - n)))
+    # Row-major flatten: grid step k handles row k // tiles_per_row, tile
+    # k % tiles_per_row — every block is (1, tile) regardless of R.
+    s = s.reshape(1, r * n_pad)
+    h = h.reshape(1, r * n_pad)
+
+    return pl.pallas_call(
+        partial(
+            _binned_count_kernel,
+            n_valid=n,
+            tile=tile,
+            tiles_per_row=tiles_per_row,
+        ),
+        grid=(r * tiles_per_row,),
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda k: (0, k)),
+            pl.BlockSpec((1, tile), lambda k: (0, k)),
+            pl.BlockSpec((_LANE, bc), lambda k: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bc, 256), lambda k, _tpr=tiles_per_row: (k // _tpr, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((r, bc, 256), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bc, 256), jnp.float32)],
+        interpret=interpret,
+    )(s, h, ttab)
+
+
+def pallas_binned_counts(
+    scores: jax.Array,
+    hits: jax.Array,
+    thresholds: jax.Array,
+    *,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Drop-in replacement for the sort-based ``_binned_counts_rows``:
+    returns ``(num_tp (R,T), num_fp (R,T), num_pos (R,), num_total (R,))``
+    as int32, bit-identical to the sort formulation (both are exact
+    integer counts)."""
+    if interpret is None:
+        interpret = not has_pallas()
+    r, n = scores.shape
+    t = thresholds.shape[0]
+    if n == 0:
+        zero_t = jnp.zeros((r, t), jnp.int32)
+        zero_r = jnp.zeros((r,), jnp.int32)
+        return zero_t, zero_t, zero_r, zero_r
+    hist = _pallas_binned_hist(scores, hits, thresholds, interpret=interpret)
+    bc = hist.shape[1]
+    per_bin_total = hist[:, :, :_LANE].reshape(r, bc * _LANE)[:, :t]
+    per_bin_tp = hist[:, :, _LANE:].reshape(r, bc * _LANE)[:, :t]
+    num_ge = _suffix_cumsum(per_bin_total).astype(jnp.int32)
+    num_tp = _suffix_cumsum(per_bin_tp).astype(jnp.int32)
+    num_fp = num_ge - num_tp
+    num_pos = jnp.sum(hits.astype(jnp.int32), axis=-1)
+    num_total = jnp.full((r,), n, jnp.int32)
+    return num_tp, num_fp, num_pos, num_total
+
+
+def has_pallas() -> bool:
+    """True when the Mosaic TPU compiler is available for the real kernel
+    (interpret mode works everywhere)."""
+    return jax.default_backend() == "tpu"
