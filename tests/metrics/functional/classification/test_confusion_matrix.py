@@ -64,3 +64,27 @@ class TestConfusionMatrix(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestMatmulFormulation(unittest.TestCase):
+    def test_matmul_equals_scatter(self):
+        # The MXU one-hot formulation must be bit-identical to the scatter
+        # within its dispatch bounds (C <= 512, n < 2^24).
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+            _matmul_cm,
+        )
+
+        rng = np.random.default_rng(0)
+        for c, n in [(2, 100), (17, 4096), (128, 20000), (256, 65536)]:
+            pred = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+            target = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+            scatter = (
+                jnp.zeros((c, c), dtype=jnp.int32).at[target, pred].add(1)
+            )
+            matmul = _matmul_cm(pred, target, c)
+            self.assertTrue(
+                bool(jnp.array_equal(scatter, matmul)), f"c={c} n={n}"
+            )

@@ -20,7 +20,6 @@ The reference's opt-in ``use_fbgemm`` CUDA kernel becomes ``use_fused``
 skips tie masking (reference ``auroc.py:34-39,145-164``).
 """
 
-import os
 from functools import partial
 from typing import Optional
 
@@ -119,12 +118,9 @@ def _use_pallas(num_samples: int) -> bool:
     with Kahan-compensated f32 area accumulation — the same precision
     class as the XLA trapezoid), so the headline path needs no fallback;
     only the int32 ceiling itself routes to the XLA path."""
-    if os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in (
-        "1",
-        "true",
-        "yes",
-        "on",
-    ):
+    from torcheval_tpu.ops._flags import pallas_disabled
+
+    if pallas_disabled():
         return False
     if num_samples >= 2**31:
         return False

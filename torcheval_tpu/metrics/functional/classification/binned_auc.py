@@ -17,7 +17,6 @@ translation of the reference's binned update would cost on a
 ``(1000, 200, N)`` boolean tensor.
 """
 
-import os
 from functools import partial
 from typing import List, Optional, Tuple, Union
 
@@ -195,12 +194,9 @@ def _use_pallas_binned(num_samples: int, num_thresholds: int) -> bool:
     exceed 2^24 samples (the kernel's per-bin f32 accumulation limit —
     the sort path is int32-exact); or the grid exceeds 2^15 thresholds
     (VMEM budget for the one-hot tiles)."""
-    if os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in (
-        "1",
-        "true",
-        "yes",
-        "on",
-    ):
+    from torcheval_tpu.ops._flags import pallas_disabled
+
+    if pallas_disabled():
         return False
     if num_samples >= 2**24 or num_thresholds > 2**15:
         return False

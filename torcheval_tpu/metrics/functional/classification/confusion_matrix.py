@@ -2,11 +2,14 @@
 ``torcheval/metrics/functional/classification/confusion_matrix.py`` (280 LoC).
 
 TPU-first: where the reference builds a sparse COO tensor and densifies it
-(reference ``confusion_matrix.py:217-232``), the update here is a single
-scatter-add ``zeros((C, C)).at[target, pred].add(1)``, which XLA lowers to a
-one-pass fused scatter.  The dead ``_binary_confusion_matrix_compute`` with
-swapped normalization dims (reference ``confusion_matrix.py:150-160``) is
-intentionally not reproduced (SURVEY §7 hard part 7)."""
+(reference ``confusion_matrix.py:217-232``), the update here dispatches
+between ONE MXU matmul of one-hot encodings (``cm = onehot(target)ᵀ @
+onehot(pred)``, up to 207× the scatter at small C — see ``_use_matmul_cm``
+for the measured crossover) and a single scatter-add ``zeros((C,
+C)).at[target, pred].add(1)`` for large C.  The dead
+``_binary_confusion_matrix_compute`` with swapped normalization dims
+(reference ``confusion_matrix.py:150-160``) is intentionally not
+reproduced (SURVEY §7 hard part 7)."""
 
 from functools import partial
 from typing import Optional
@@ -58,16 +61,71 @@ def _confusion_matrix_update(
     return _confusion_matrix_update_kernel(input, target, num_classes)
 
 
+def _use_matmul_cm(num_classes: int, num_samples: int) -> bool:
+    """Route the (C, C) accumulation through one MXU matmul of one-hot
+    encodings on TPU for small/medium C.  TPU scatters serialize (~1
+    element/cycle: flat ~7 ms for 2^20 samples at ANY C) while the matmul
+    costs n·C² MACs.  Measured on v5e (2^20 samples, device-loop clock):
+
+        C=16   scatter 9.3 ms   matmul 0.045 ms   207x
+        C=64   scatter 7.1 ms   matmul 0.12 ms     59x
+        C=128  scatter 7.1 ms   matmul 3.4 ms     2.1x
+        C=512  scatter 7.1 ms   matmul 4.4 ms     1.6x
+        C=1000 scatter 7.1 ms   matmul 11.1 ms   0.64x
+
+    f32 accumulation bounds the exact count range to 2^24 per cell, and
+    the two (n, C) bf16 one-hots bound memory — n·C over 2^28 (≈1 GiB of
+    one-hots) keeps the O(n)-memory scatter."""
+    from torcheval_tpu.ops._flags import pallas_disabled
+
+    if pallas_disabled():
+        # Same kill-switch as the kernels: force the reference formulation.
+        return False
+    if num_classes > 512 or num_samples >= 2**24:
+        return False
+    if num_samples * num_classes > 2**28:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _matmul_cm(
+    input: jax.Array, target: jax.Array, num_classes: int
+) -> jax.Array:
+    """(C, C) counts as ONE MXU matmul of one-hot encodings: cm =
+    onehot(target)ᵀ @ onehot(pred).  0/1 one-hots are exact in bf16 and
+    the f32 accumulation is exact below 2^24 per cell, so the result is
+    bit-identical to the scatter formulation within the dispatch
+    bounds."""
+    classes = jnp.arange(num_classes)
+    oh_true = (target[:, None] == classes[None, :]).astype(jnp.bfloat16)
+    oh_pred = (input[:, None] == classes[None, :]).astype(jnp.bfloat16)
+    cm = jax.lax.dot_general(
+        oh_true,
+        oh_pred,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return cm.astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("num_classes",))
 def _confusion_matrix_update_kernel(
     input: jax.Array, target: jax.Array, num_classes: int
 ) -> jax.Array:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
+    # Normalize numpy-style negative wrap-around up front so the matmul
+    # and scatter formulations agree bit-for-bit even on out-of-range
+    # labels under skip_value_checks: [-C, 0) wraps (what .at[] would do),
+    # anything still out of range is dropped by both paths.
+    input = jnp.where(input < 0, input + num_classes, input)
+    target = jnp.where(target < 0, target + num_classes, target)
+    if _use_matmul_cm(num_classes, input.shape[0]):
+        return _matmul_cm(input, target, num_classes)
     return (
         jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
         .at[target, input]
-        .add(1)
+        .add(1, mode="drop")
     )
 
 

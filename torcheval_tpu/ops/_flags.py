@@ -1,0 +1,15 @@
+"""Shared dispatch flags for the native-kernel routes."""
+
+import os
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def pallas_disabled() -> bool:
+    """True when ``TORCHEVAL_TPU_DISABLE_PALLAS`` is set truthy — the
+    kill-switch forcing every kernel dispatch back to the pure-XLA
+    formulation (read at call time, so harnesses may toggle it after
+    import)."""
+    return (
+        os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in _TRUTHY
+    )

@@ -138,11 +138,13 @@ def _auc_scan_kernel(
     # Pair counts are exact int32; the product can exceed 2^24, so it is
     # formed in float32 (same precision class as the pure-XLA trapezoid,
     # which also multiplies f32-cast counts) and Kahan-compensated across
-    # tiles below.
+    # tiles below.  The fp sum is formed AFTER the f32 casts: fp_m1 +
+    # prev_fp can reach 2^32 for near-all-negative rows near the 2^31
+    # sample bound, which would wrap in int32.
     contrib = jnp.where(
         flag,
         (tp_m1 - prev_tp).astype(jnp.float32)
-        * (fp_m1 + prev_fp).astype(jnp.float32),
+        * (fp_m1.astype(jnp.float32) + prev_fp.astype(jnp.float32)),
         0.0,
     )
 
@@ -184,7 +186,7 @@ def _auc_scan_kernel(
         acc_total = (
             (new_acc - new_comp)
             + (new_tp - new_pe_tp).astype(jnp.float32)
-            * (new_fp + new_pe_fp).astype(jnp.float32)
+            * (new_fp.astype(jnp.float32) + new_pe_fp.astype(jnp.float32))
         )
         factor = num_pos * num_neg
         area = factor - 0.5 * acc_total
