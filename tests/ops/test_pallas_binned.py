@@ -22,6 +22,25 @@ def _assert_counts_equal(testcase, a, b, msg=""):
         )
 
 
+class TestBroadcastFormulation(unittest.TestCase):
+    def test_broadcast_matches_sort(self):
+        from torcheval_tpu.metrics.functional.classification.binned_auc import (
+            _binned_counts_rows_broadcast,
+        )
+
+        rng = np.random.default_rng(3)
+        for r, n, t_count in [(1, 5000, 200), (3, 2048, 100), (2, 0, 7)]:
+            s = jnp.asarray(rng.random((r, n)).astype(np.float32))
+            h = jnp.asarray(rng.random((r, n)) > 0.4)
+            th = jnp.linspace(0, 1.0, t_count)
+            _assert_counts_equal(
+                self,
+                _binned_counts_rows_broadcast(s, h, th),
+                _binned_counts_rows_sort(s, h, th),
+                msg=f"r={r} n={n} T={t_count}",
+            )
+
+
 class TestPallasBinnedCounts(unittest.TestCase):
     def test_matches_sort_formulation(self):
         rng = np.random.default_rng(0)

@@ -7,14 +7,15 @@ counts are the sufficient statistics — fully fixed-shape, mergeable by
 addition, syncable by ``psum`` — so the unbounded sample buffers of the
 exact AUROC/AUPRC metrics are traded for an O(T) state.
 
-The shared update kernel sorts each row once (variadic ``lax.sort``, the
-same core the exact AUROC family uses), cumsums the co-sorted hits, and
-reads the per-threshold counts off the sorted row with ``searchsorted`` —
-no scatter at all.  Measured 4.3–4.7× faster than the scatter-add
-histogram formulation on a v5e chip (TPU scatters serialize), and still
-O(N log N + T log N) work versus the O(R·T·N) broadcast-compare a direct
-translation of the reference's binned update would cost on a
-``(1000, 200, N)`` boolean tensor.
+The shared update stage ``_binned_counts_rows`` dispatches between three
+formulations returning bit-identical int32 counts, chosen by measured
+regime (v5e device-loop clocks, BASELINE.md): a fused VPU
+broadcast-compare for small work products (R·N·T ≤ 2^32; 1.24 ms at
+4M×200 — 52× the sort), the Pallas MXU one-hot histogram kernel for
+large ones (``ops/pallas_binned.py``; 6.1 ms at 4M×10k — 10.9× the
+sort), and a scatter-free sort + ``searchsorted`` fallback (CPU /
+kill-switch / out-of-bounds; itself measured 4.3-4.7× over scatter-add,
+which serializes on TPU).
 """
 
 from functools import partial
@@ -187,36 +188,83 @@ def _multiclass_binned_auc_validate(
     _check_index_range(target, num_classes, "target")
 
 
-def _use_pallas_binned(num_samples: int, num_thresholds: int) -> bool:
-    """Route the binned-count stage through the Pallas MXU histogram
-    kernel on TPU (``ops/pallas_binned.py``) — bit-identical counts, no
-    sort.  Stays on the sort path when: the env kill-switch is set; rows
-    exceed 2^24 samples (the kernel's per-bin f32 accumulation limit —
-    the sort path is int32-exact); or the grid exceeds 2^15 thresholds
-    (VMEM budget for the one-hot tiles)."""
+# Work-product bound for the fused broadcast-compare formulation:
+# measured ~680G compare-ops/s on v5e (1.3e9 ops in 1.9 ms), so 2^32 ops
+# is ~6 ms — the Pallas histogram's fixed grid cost.  Above it the MXU
+# kernel wins; below it the VPU broadcast does.
+_BROADCAST_MAX_WORK = 2**32
+
+
+def _select_binned_route(
+    num_rows: int, num_samples: int, num_thresholds: int
+) -> str:
+    """Call-time formulation choice for the binned-counts stage.
+
+    Evaluated OUTSIDE jit (the result rides into the jitted kernels as a
+    static argument), so the ``TORCHEVAL_TPU_DISABLE_PALLAS`` kill-switch
+    is honored per call even for already-compiled shapes, and the Pallas
+    module is never imported while the switch is set.
+
+    * ``"broadcast"`` — TPU, work = R·N·T ≤ 2^32: XLA fuses the
+      ``(R, N, T)`` comparison straight into its two reductions (no
+      materialization; ~680G compare-ops/s on the VPU).
+    * ``"pallas"`` — TPU, larger work, within the MXU kernel's bounds
+      (rows < 2^24 samples for exact f32 per-bin accumulation — the sort
+      is int32-exact — and ≤ 2^15 thresholds for the VMEM one-hot tiles).
+    * ``"sort"`` — CPU, kill-switch, or out-of-bounds fallback.
+    """
     from torcheval_tpu.ops._flags import pallas_disabled
 
-    if pallas_disabled():
-        return False
-    if num_samples >= 2**24 or num_thresholds > 2**15:
-        return False
-    from torcheval_tpu.ops.pallas_binned import has_pallas
-
-    return has_pallas()
+    if pallas_disabled() or jax.default_backend() != "tpu":
+        return "sort"
+    if num_rows * num_samples * num_thresholds <= _BROADCAST_MAX_WORK:
+        return "broadcast"
+    if num_samples < 2**24 and num_thresholds <= 2**15:
+        return "pallas"
+    return "sort"
 
 
 def _binned_counts_rows(
-    scores: jax.Array, hits: jax.Array, thresholds: jax.Array
+    scores: jax.Array,
+    hits: jax.Array,
+    thresholds: jax.Array,
+    route: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Per-threshold prediction counts for ``pred = score >= t`` over
-    ``(R, N)`` score/hit rows — dispatches between the Pallas MXU
-    histogram kernel (TPU) and the sort formulation below; both return
-    bit-identical int32 counts."""
-    if _use_pallas_binned(scores.shape[-1], thresholds.shape[0]):
+    ``(R, N)`` score/hit rows — three formulations returning
+    bit-identical int32 counts, chosen by :func:`_select_binned_route`
+    (measured regimes in BASELINE.md).  Pass ``route`` when calling from
+    inside jit (it must be selected at call time, outside the trace)."""
+    if route is None:
+        route = _select_binned_route(
+            scores.shape[0], scores.shape[-1], thresholds.shape[0]
+        )
+    if route == "broadcast":
+        return _binned_counts_rows_broadcast(scores, hits, thresholds)
+    if route == "pallas":
         from torcheval_tpu.ops.pallas_binned import pallas_binned_counts
 
         return pallas_binned_counts(scores, hits, thresholds)
     return _binned_counts_rows_sort(scores, hits, thresholds)
+
+
+@jax.jit
+def _binned_counts_rows_broadcast(
+    scores: jax.Array, hits: jax.Array, thresholds: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Fused broadcast-compare formulation (small-work TPU regime)."""
+    num_rows, n = scores.shape
+    ge = scores[:, :, None] >= thresholds[None, None, :]  # (R, N, T), fused
+    hits_b = hits.astype(jnp.bool_)
+    num_ge = ge.sum(axis=1, dtype=jnp.int32)
+    num_tp = (ge & hits_b[:, :, None]).sum(axis=1, dtype=jnp.int32)
+    num_pos = hits_b.sum(axis=-1, dtype=jnp.int32)
+    return (
+        num_tp,
+        num_ge - num_tp,
+        num_pos,
+        jnp.full((num_rows,), n, jnp.int32),
+    )
 
 
 @jax.jit
@@ -259,18 +307,45 @@ def _binned_counts_rows_sort(
     return num_tp, num_fp, total_hits[:, 0], jnp.full((num_rows,), n, jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("num_classes",))
 def _multiclass_binned_counts_kernel(
     input: jax.Array, target: jax.Array, threshold: jax.Array, num_classes: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    return _binned_counts_rows(input.T, class_hits(target, num_classes), threshold)
+    # Route chosen here, at call time, then baked into the jit as static.
+    route = _select_binned_route(
+        num_classes, input.shape[0], threshold.shape[0]
+    )
+    return _multiclass_binned_counts_jit(
+        input, target, threshold, num_classes, route
+    )
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("num_classes", "route"))
+def _multiclass_binned_counts_jit(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    num_classes: int,
+    route: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    return _binned_counts_rows(
+        input.T, class_hits(target, num_classes), threshold, route=route
+    )
+
+
 def _multilabel_binned_counts_kernel(
     input: jax.Array, target: jax.Array, threshold: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    return _binned_counts_rows(input.T, (target == 1).T, threshold)
+    route = _select_binned_route(
+        input.shape[1], input.shape[0], threshold.shape[0]
+    )
+    return _multilabel_binned_counts_jit(input, target, threshold, route)
+
+
+@partial(jax.jit, static_argnames=("route",))
+def _multilabel_binned_counts_jit(
+    input: jax.Array, target: jax.Array, threshold: jax.Array, route: str
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    return _binned_counts_rows(input.T, (target == 1).T, threshold, route=route)
 
 
 @jax.jit

@@ -4,8 +4,11 @@
 
 Fixed thresholds make the sufficient statistics fixed-shape per-bin TP/FP/FN
 counters — the TPU-friendly formulation of a PR curve (mergeable by addition,
-syncable by ``psum``; no sample buffers).  Kernels are one fused broadcast
-compare + reduction per batch."""
+syncable by ``psum``; no sample buffers).  Updates ride the shared
+binned-counts core (``binned_auc._binned_counts_rows``: one variadic sort +
+``searchsorted`` per row, or the Pallas MXU histogram kernel on TPU)
+instead of the reference's O(N·T·C) boolean broadcast-compare
+(reference ``binned_precision_recall_curve.py:184-197``)."""
 
 from functools import partial
 from typing import List, Optional, Tuple, Union
@@ -45,7 +48,7 @@ def multiclass_binned_precision_recall_curve(
     num_classes: Optional[int] = None,
     threshold: Union[int, List[float], "jax.Array"] = 100,
 ) -> Tuple[List[jax.Array], List[jax.Array], jax.Array]:
-    """Per-class binned PR curves via one-hot broadcast compare
+    """Per-class binned PR curves over the shared binned-counts core
     (reference ``binned_precision_recall_curve.py:113-221``)."""
     input, target = jnp.asarray(input), jnp.asarray(target)
     threshold = _create_threshold_tensor(threshold)
@@ -69,16 +72,34 @@ def _binary_binned_precision_recall_curve_update(
     return _binary_binned_update_kernel(input, target, threshold)
 
 
-@jax.jit
 def _binary_binned_update_kernel(
     input: jax.Array, target: jax.Array, threshold: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    pred_label = input >= threshold[:, None]
-    target_b = target.astype(jnp.bool_)
-    num_tp = (pred_label & target_b).sum(axis=1)
-    num_fp = pred_label.sum(axis=1) - num_tp
-    num_fn = target_b.sum() - num_tp
-    return num_tp, num_fp, num_fn
+    # Shared binned-counts core (broadcast-compare / Pallas MXU histogram
+    # / sort, chosen by measured regime — see binned_auc._select_binned
+    # _route).  The route is picked here at call time and baked into the
+    # jit as a static arg, so the kill-switch env var stays call-time.
+    # Lazy import: binned_auc imports this module's param-check helpers.
+    from torcheval_tpu.metrics.functional.classification.binned_auc import (
+        _select_binned_route,
+    )
+
+    route = _select_binned_route(1, input.shape[0], threshold.shape[0])
+    return _binary_binned_update_jit(input, target, threshold, route)
+
+
+@partial(jax.jit, static_argnames=("route",))
+def _binary_binned_update_jit(
+    input: jax.Array, target: jax.Array, threshold: jax.Array, route: str
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    from torcheval_tpu.metrics.functional.classification.binned_auc import (
+        _binned_counts_rows,
+    )
+
+    num_tp, num_fp, num_pos, _ = _binned_counts_rows(
+        input[None], (target == 1)[None], threshold, route=route
+    )
+    return num_tp[0], num_fp[0], num_pos[0] - num_tp[0]
 
 
 @jax.jit
@@ -118,19 +139,47 @@ def _multiclass_binned_precision_recall_curve_update(
     return _multiclass_binned_update_kernel(input, target, threshold, num_classes)
 
 
-@partial(jax.jit, static_argnames=("num_classes",))
 def _multiclass_binned_update_kernel(
     input: jax.Array,
     target: jax.Array,
     threshold: jax.Array,
     num_classes: int,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    labels = input >= threshold[:, None, None]
-    target_onehot = jax.nn.one_hot(target, num_classes, dtype=jnp.bool_)
-    num_tp = (labels & target_onehot).sum(axis=1)
-    num_fp = labels.sum(axis=1) - num_tp
-    num_fn = target_onehot.sum(axis=0) - num_tp
-    return num_tp, num_fp, num_fn
+    from torcheval_tpu.metrics.functional.classification.binned_auc import (
+        _select_binned_route,
+    )
+
+    route = _select_binned_route(
+        num_classes, input.shape[0], threshold.shape[0]
+    )
+    return _multiclass_binned_update_jit(
+        input, target, threshold, num_classes, route
+    )
+
+
+@partial(jax.jit, static_argnames=("num_classes", "route"))
+def _multiclass_binned_update_jit(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    num_classes: int,
+    route: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    # One-vs-rest through the shared binned-counts core (broadcast /
+    # Pallas MXU histogram / sort by measured regime).  Counts are
+    # identical exact integers across the formulations.
+    from torcheval_tpu.metrics.functional.classification._sort_scan import (
+        class_hits,
+    )
+    from torcheval_tpu.metrics.functional.classification.binned_auc import (
+        _binned_counts_rows,
+    )
+
+    num_tp_c, num_fp_c, num_pos_c, _ = _binned_counts_rows(
+        input.T, class_hits(target, num_classes), threshold, route=route
+    )
+    num_tp = num_tp_c.T  # (T, C) — the reference's state layout
+    return num_tp, num_fp_c.T, num_pos_c[None, :] - num_tp
 
 
 def _multiclass_binned_precision_recall_curve_compute(

@@ -40,9 +40,10 @@ timing, bit-equal counts in every config):
     (32, 131072, 200)    5.6 ms  748 M/s     7.1 ms 594 M/s    1.3x
     (1, 4M, 32768)      13.0 ms  322 M/s    70.7 ms  59 M/s    5.4x
 
-The dispatch in ``binned_auc.py`` routes TPU calls here (see
-``TORCHEVAL_TPU_DISABLE_PALLAS`` and the limits in
-``_use_pallas_binned``).
+The dispatch in ``binned_auc.py`` routes large-work TPU calls here (see
+``TORCHEVAL_TPU_DISABLE_PALLAS`` and the measured regime bounds in
+``_select_binned_route`` — a fused VPU broadcast-compare wins below
+R·N·T ≈ 2^32).
 """
 
 from functools import partial
