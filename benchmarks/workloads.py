@@ -525,6 +525,66 @@ def bench_sharded_multiclass_auroc() -> Tuple[str, float, Optional[float]]:
     return "sharded_multiclass_auroc_1000c", ours, ref
 
 
+def bench_sharded_multiclass_exact() -> Tuple[str, float, Optional[float]]:
+    """The north-star shape with EXACT results: 1000-class one-vs-rest
+    AUROC over mesh-sharded samples via the minority-gather ustat scheme
+    (``parallel/exact.py`` — exact Mann-Whitney pair counts, ~O(N) wire at
+    1000 classes vs O(N·C) raw).  Reference equivalent: its exact
+    1000-class MulticlassAUROC on torch CPU (smaller instance; its
+    per-sample cost grows superlinearly, so the ratio is conservative)."""
+    import jax.numpy as jnp
+
+    from torcheval_tpu.parallel import (
+        make_mesh,
+        shard_batch,
+        sharded_multiclass_auroc_ustat,
+    )
+
+    rng = np.random.default_rng(8)
+    n, c = 2**16, 1000
+    scores = rng.random((n, c), dtype=np.float32)
+    target = rng.integers(0, c, n).astype(np.int32)
+    mesh = make_mesh()
+    s, t = shard_batch(mesh, jnp.asarray(scores), jnp.asarray(target))
+    # Per-shard per-class counts are ~Poisson(mean); additive slack keeps
+    # the overflow probability negligible even when the mean is ~1 on a
+    # large mesh (a multiplicative factor alone would not).
+    mean = n / (c * mesh.devices.size)
+    cap = int(mean + 6 * max(1.0, mean) ** 0.5 + 16)
+
+    def step():
+        _force(
+            sharded_multiclass_auroc_ustat(
+                s,
+                t,
+                mesh,
+                num_classes=c,
+                max_class_count_per_shard=cap,
+            )
+        )
+
+    ours = n / _time_steps(step)
+
+    ref = None
+    try:
+        import torch
+
+        _reference()
+        from torcheval.metrics.functional import multiclass_auroc as ref_mc
+
+        n_ref = 2**13
+        ts = torch.from_numpy(scores[:n_ref].copy())
+        tt = torch.from_numpy(target[:n_ref].astype(np.int64))
+
+        def rstep():
+            ref_mc(ts, tt, num_classes=c)
+
+        ref = n_ref / _time_steps(rstep, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+    return "sharded_multiclass_auroc_exact_ustat", ours, ref
+
+
 def bench_binned_auroc() -> Tuple[str, float, Optional[float]]:
     """Binned AUROC (10k fixed thresholds, O(T) counter state) on 2^22
     samples.  The reference snapshot has no binned AUROC; its exact
@@ -643,6 +703,7 @@ ALL_WORKLOADS = [
     bench_regression,
     bench_sharded_auroc_sync,
     bench_sharded_multiclass_auroc,
+    bench_sharded_multiclass_exact,
     bench_binned_auroc,
     bench_collection_fused,
     bench_perplexity,

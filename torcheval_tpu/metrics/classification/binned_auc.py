@@ -28,6 +28,7 @@ from torcheval_tpu.metrics.functional.classification.binned_auc import (
     _multiclass_binned_auc_validate,
     _multiclass_binned_counts_kernel,
     _multilabel_binned_counts_kernel,
+    _select_binned_route,
 )
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     _binned_precision_recall_curve_param_check,
@@ -41,13 +42,15 @@ from torcheval_tpu.metrics.metric import Metric
 _COUNTS = ("num_tp", "num_fp", "num_pos", "num_total")
 
 
-@jax.jit
 def _binary_binned_counts_kernel(
-    input: jax.Array, target: jax.Array, threshold: jax.Array
+    input: jax.Array, target: jax.Array, threshold: jax.Array, route: str
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    # Runs inside the fused accumulate trace; ``route`` arrives as a
+    # call-time static so the formulation choice (and the kill-switch env
+    # var) is re-evaluated per update, not frozen at first compile.
     if input.ndim == 1:
         input, target = input[None], target[None]
-    return _binned_counts_rows(input, target == 1, threshold)
+    return _binned_counts_rows(input, target == 1, threshold, route=route)
 
 
 class _BinnedCountsBase(Metric):
@@ -105,7 +108,12 @@ class _BinaryBinnedAUC(_BinnedCountsBase):
     def update(self, input, target):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_auroc_update_input_check(input, target, self.num_tasks)
-        self._accumulate(_binary_binned_counts_kernel, input, target)
+        route = _select_binned_route(
+            self.num_tasks, input.shape[-1], self.threshold.shape[0]
+        )
+        self._accumulate(
+            _binary_binned_counts_kernel, input, target, statics=(route,)
+        )
         return self
 
     def compute(self) -> Tuple[jax.Array, jax.Array]:
@@ -127,9 +135,12 @@ class _MulticlassBinnedAUC(_BinnedCountsBase):
     def update(self, input, target):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multiclass_binned_auc_validate(input, target, self.num_classes)
+        route = _select_binned_route(
+            self.num_classes, input.shape[0], self.threshold.shape[0]
+        )
         self._accumulate(
             _multiclass_binned_counts_kernel, input, target,
-            statics=(self.num_classes,),
+            statics=(self.num_classes, route),
         )
         return self
 
@@ -152,7 +163,12 @@ class _MultilabelBinned(_BinnedCountsBase):
         _multilabel_precision_recall_curve_update_input_check(
             input, target, self.num_labels
         )
-        self._accumulate(_multilabel_binned_counts_kernel, input, target)
+        route = _select_binned_route(
+            self.num_labels, input.shape[0], self.threshold.shape[0]
+        )
+        self._accumulate(
+            _multilabel_binned_counts_kernel, input, target, statics=(route,)
+        )
         return self
 
 

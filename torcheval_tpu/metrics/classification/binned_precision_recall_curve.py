@@ -11,6 +11,9 @@ import jax.numpy as jnp
 
 from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification.binned_auc import (
+    _select_binned_route,
+)
 from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
     _binary_binned_precision_recall_curve_compute,
     _binary_binned_update_input_check,
@@ -51,12 +54,16 @@ class BinaryBinnedPrecisionRecallCurve(
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_binned_update_input_check(input, target)
         # Kernel + all three state adds fused into one dispatch (_fuse.py).
+        route = _select_binned_route(
+            1, input.shape[0], self.threshold.shape[0]
+        )
         self.num_tp, self.num_fp, self.num_fn = accumulate(
             _binary_binned_update_kernel,
             (self.num_tp, self.num_fp, self.num_fn),
             input,
             target,
             self.threshold,
+            statics=(route,),
         )
         return self
 
@@ -101,13 +108,16 @@ class MulticlassBinnedPrecisionRecallCurve(
     def update(self, input, target) -> "MulticlassBinnedPrecisionRecallCurve":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multiclass_binned_validate(input, target, self.num_classes)
+        route = _select_binned_route(
+            self.num_classes, input.shape[0], self.threshold.shape[0]
+        )
         self.num_tp, self.num_fp, self.num_fn = accumulate(
             _multiclass_binned_update_kernel,
             (self.num_tp, self.num_fp, self.num_fn),
             input,
             target,
             self.threshold,
-            statics=(self.num_classes,),
+            statics=(self.num_classes, route),
         )
         return self
 

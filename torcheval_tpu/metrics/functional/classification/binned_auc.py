@@ -308,12 +308,19 @@ def _binned_counts_rows_sort(
 
 
 def _multiclass_binned_counts_kernel(
-    input: jax.Array, target: jax.Array, threshold: jax.Array, num_classes: int
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    num_classes: int,
+    route: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    # Route chosen here, at call time, then baked into the jit as static.
-    route = _select_binned_route(
-        num_classes, input.shape[0], threshold.shape[0]
-    )
+    # Route chosen at call time, then baked into the jit as static.  Class
+    # metrics pass it explicitly (their fused update traces this function,
+    # and the choice must not be frozen into the trace).
+    if route is None:
+        route = _select_binned_route(
+            num_classes, input.shape[0], threshold.shape[0]
+        )
     return _multiclass_binned_counts_jit(
         input, target, threshold, num_classes, route
     )
@@ -333,11 +340,15 @@ def _multiclass_binned_counts_jit(
 
 
 def _multilabel_binned_counts_kernel(
-    input: jax.Array, target: jax.Array, threshold: jax.Array
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    route: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    route = _select_binned_route(
-        input.shape[1], input.shape[0], threshold.shape[0]
-    )
+    if route is None:
+        route = _select_binned_route(
+            input.shape[1], input.shape[0], threshold.shape[0]
+        )
     return _multilabel_binned_counts_jit(input, target, threshold, route)
 
 

@@ -73,7 +73,10 @@ def _binary_binned_precision_recall_curve_update(
 
 
 def _binary_binned_update_kernel(
-    input: jax.Array, target: jax.Array, threshold: jax.Array
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    route: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     # Shared binned-counts core (broadcast-compare / Pallas MXU histogram
     # / sort, chosen by measured regime — see binned_auc._select_binned
@@ -84,7 +87,8 @@ def _binary_binned_update_kernel(
         _select_binned_route,
     )
 
-    route = _select_binned_route(1, input.shape[0], threshold.shape[0])
+    if route is None:
+        route = _select_binned_route(1, input.shape[0], threshold.shape[0])
     return _binary_binned_update_jit(input, target, threshold, route)
 
 
@@ -144,14 +148,16 @@ def _multiclass_binned_update_kernel(
     target: jax.Array,
     threshold: jax.Array,
     num_classes: int,
+    route: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     from torcheval_tpu.metrics.functional.classification.binned_auc import (
         _select_binned_route,
     )
 
-    route = _select_binned_route(
-        num_classes, input.shape[0], threshold.shape[0]
-    )
+    if route is None:
+        route = _select_binned_route(
+            num_classes, input.shape[0], threshold.shape[0]
+        )
     return _multiclass_binned_update_jit(
         input, target, threshold, num_classes, route
     )
@@ -165,18 +171,14 @@ def _multiclass_binned_update_jit(
     num_classes: int,
     route: str,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    # One-vs-rest through the shared binned-counts core (broadcast /
-    # Pallas MXU histogram / sort by measured regime).  Counts are
-    # identical exact integers across the formulations.
-    from torcheval_tpu.metrics.functional.classification._sort_scan import (
-        class_hits,
-    )
+    # One thin epilogue over the SAME one-vs-rest counts jit the binned
+    # AUC family uses — single source for the counts plumbing.
     from torcheval_tpu.metrics.functional.classification.binned_auc import (
-        _binned_counts_rows,
+        _multiclass_binned_counts_jit,
     )
 
-    num_tp_c, num_fp_c, num_pos_c, _ = _binned_counts_rows(
-        input.T, class_hits(target, num_classes), threshold, route=route
+    num_tp_c, num_fp_c, num_pos_c, _ = _multiclass_binned_counts_jit(
+        input, target, threshold, num_classes, route
     )
     num_tp = num_tp_c.T  # (T, C) — the reference's state layout
     return num_tp, num_fp_c.T, num_pos_c[None, :] - num_tp

@@ -271,8 +271,14 @@ def sharded_binary_auroc_ustat(
 
         # Queries: this device's samples of the other class.  +inf pads sit
         # past every finite query, so `lo`/`hi` count only real scores.
-        lo = jnp.searchsorted(gathered, s, side="left").astype(acc)
-        hi = jnp.searchsorted(gathered, s, side="right").astype(acc)
+        # method="sort": one variadic sort instead of a gather-based binary
+        # search (TPU gathers serialize; see the multiclass variant).
+        lo = jnp.searchsorted(
+            gathered, s, side="left", method="sort"
+        ).astype(acc)
+        hi = jnp.searchsorted(
+            gathered, s, side="right", method="sort"
+        ).astype(acc)
         ties = hi - lo
         # chosen=pos: U = Σ_neg #pos>q = n_chosen - hi;  chosen=neg:
         # U = Σ_pos #neg<q = lo.  Either way + ½·ties.
@@ -385,12 +391,15 @@ def sharded_multiclass_auroc_ustat(
         row_len = rows.shape[-1]
 
         # For every local sample and every class: exact #pos_c above/equal.
-        lo = jax.vmap(lambda r, q: jnp.searchsorted(r, q, side="left"))(
-            rows, s.T
-        ).astype(acc)
-        hi = jax.vmap(lambda r, q: jnp.searchsorted(r, q, side="right"))(
-            rows, s.T
-        ).astype(acc)
+        # method="sort" turns the 65M-query binary search into one variadic
+        # sort per class — measured ~35x the gather-based 'scan' lowering
+        # on v5e at the (2^16, 1000) north-star shape.
+        lo = jax.vmap(
+            lambda r, q: jnp.searchsorted(r, q, side="left", method="sort")
+        )(rows, s.T).astype(acc)
+        hi = jax.vmap(
+            lambda r, q: jnp.searchsorted(r, q, side="right", method="sort")
+        )(rows, s.T).astype(acc)
         n_pos = lax.psum(jnp.sum(is_class, axis=1, dtype=jnp.int32), axis)
         above = row_len - hi  # -inf pads are never counted as > q
         ties = hi - lo
