@@ -126,6 +126,27 @@ def main(pid: int, nprocs: int, port: int) -> None:
     out = sync_and_compute(mx, group, recipient_rank="all")
     assert float(out) == float((nprocs - 1) * 10 + 1), float(out)
 
+    # --- ring-buffer archetype: windowed metric with ragged per-rank
+    # fills; merged window (grown across ranks) must equal the pooled
+    # AUROC of every rank's samples (window large enough to keep all).
+    from torcheval_tpu.metrics import BinaryBinnedAUROC, WindowedBinaryAUROC
+
+    win = WindowedBinaryAUROC(max_num_samples=256)
+    win.update(jnp.asarray(s), jnp.asarray(t))
+    out = sync_and_compute(win, group, recipient_rank="all")
+    np.testing.assert_allclose(float(out), oracle, rtol=1e-6)
+
+    # --- binned counter archetype (add-merge of (tasks, T) count states).
+    bb = BinaryBinnedAUROC(threshold=64)
+    bb.update(jnp.asarray(s), jnp.asarray(t))
+    out, _th = sync_and_compute(bb, group, recipient_rank="all")
+    from torcheval_tpu.metrics.functional import binary_binned_auroc
+
+    pooled, _ = binary_binned_auroc(
+        jnp.asarray(all_s), jnp.asarray(all_t), threshold=64
+    )
+    np.testing.assert_allclose(float(out), float(pooled), rtol=1e-6)
+
     print(f"WIRE_OK rank={pid}", flush=True)
 
 
