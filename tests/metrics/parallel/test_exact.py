@@ -27,6 +27,7 @@ from torcheval_tpu.parallel import (
     sharded_binary_auroc_ustat,
     sharded_multiclass_auroc_exact,
     sharded_multiclass_auroc_ustat,
+    sharded_multitask_auroc_exact,
 )
 
 
@@ -209,6 +210,28 @@ class TestShardedMulticlassExact(unittest.TestCase):
                 self.mesh,
                 num_classes=c,
                 max_class_count_per_shard=8,
+            )
+
+
+class TestShardedMultitaskExact(unittest.TestCase):
+    def test_bitwise_vs_single_device(self):
+        mesh = make_mesh()
+        rng = np.random.default_rng(21)
+        scores = jnp.asarray(
+            (rng.random((5, 4096)) * 64).round().astype(np.float32) / 64
+        )
+        targets = jnp.asarray((rng.random((5, 4096)) > 0.3).astype(np.int32))
+        got = sharded_multitask_auroc_exact(scores, targets, mesh)
+        want = binary_auroc(scores, targets, num_tasks=5)
+        self.assertEqual(
+            np.asarray(got).tobytes(), np.asarray(want).tobytes()
+        )
+
+    def test_bad_shape_raises(self):
+        mesh = make_mesh()
+        with self.assertRaisesRegex(ValueError, "num_tasks"):
+            sharded_multitask_auroc_exact(
+                jnp.ones(8), jnp.ones(8), mesh
             )
 
 

@@ -42,6 +42,7 @@ from torcheval_tpu.parallel.exact import (
     sharded_binary_auroc_ustat,
     sharded_multiclass_auroc_exact,
     sharded_multiclass_auroc_ustat,
+    sharded_multitask_auroc_exact,
 )
 from torcheval_tpu.parallel.sync import (
     make_synced_update,
@@ -66,4 +67,5 @@ __all__ = [
     "sharded_multiclass_auroc_exact",
     "sharded_multiclass_auroc_histogram",
     "sharded_multiclass_auroc_ustat",
+    "sharded_multitask_auroc_exact",
 ]

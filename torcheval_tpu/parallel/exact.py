@@ -7,7 +7,8 @@ one rank as pickled Python objects (reference ``classification/auroc.py:
 This module closes the gap with two TPU-native exact families:
 
 **gather-exact** (``sharded_binary_auroc_exact`` /
-``sharded_multiclass_auroc_exact`` / ``sharded_binary_auprc_exact``):
+``sharded_multitask_auroc_exact`` / ``sharded_multiclass_auroc_exact`` /
+``sharded_binary_auprc_exact``):
 ``lax.all_gather(..., tiled=True)`` reassembles the shard-order
 concatenation of the mesh-sharded samples *device-side* (the collective
 rides ICI/DCN; no host, no pickle) and every device runs the SAME exact
@@ -73,6 +74,64 @@ def _check_even_1d(scores, targets, mesh: Mesh, axis: str) -> None:
         )
 
 
+def _check_even_tasks(scores, targets, mesh: Mesh, axis: str) -> None:
+    if scores.ndim != 2 or scores.shape != targets.shape:
+        raise ValueError(
+            "scores and targets should be (num_tasks, N) of equal shape, "
+            f"got {scores.shape} / {targets.shape}."
+        )
+    size = mesh.shape[axis]
+    if scores.shape[1] % size != 0:
+        raise ValueError(
+            f"sample count {scores.shape[1]} must divide evenly over mesh "
+            f"axis {axis!r} of size {size}."
+        )
+
+
+def sharded_multitask_auroc_exact(
+    scores: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    axis: str = "dp",
+) -> jax.Array:
+    """Bit-exact pod AUROC for multi-task ``(num_tasks, N)`` inputs
+    sharded over the sample axis — the mesh analog of
+    ``binary_auroc(..., num_tasks=T)`` (same gather-exact scheme as
+    :func:`sharded_binary_auroc_exact`)."""
+    from torcheval_tpu.metrics.functional.classification.auroc import (
+        _binary_auroc_compute,
+    )
+
+    _check_even_tasks(scores, targets, mesh, axis)
+    return _gather_exact(_binary_auroc_compute, mesh, axis, 1, scores, targets)
+
+
+def _gather_exact(kernel, mesh: Mesh, axis: str, sample_axis: int, scores, targets):
+    """Shared gather-exact scaffold: device-side tiled all-gather along the
+    sample axis reassembles the shard-order concatenation, then ``kernel``
+    (the identical single-device jitted compute) runs replicated — the
+    bit-for-bit contract of the whole family."""
+
+    def local(s, t):
+        s_all = lax.all_gather(s, axis, axis=sample_axis, tiled=True)
+        t_all = lax.all_gather(t, axis, axis=sample_axis, tiled=True)
+        return kernel(s_all, t_all)
+
+    spec = (
+        PartitionSpec(axis) if sample_axis == 0 else PartitionSpec(None, axis)
+    )
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=PartitionSpec(),
+            check_vma=False,  # gathered result is replicated by construction
+        )
+    )
+    return fn(scores, targets)
+
+
 def sharded_binary_auroc_exact(
     scores: jax.Array,
     targets: jax.Array,
@@ -94,22 +153,7 @@ def sharded_binary_auroc_exact(
     )
 
     _check_even_1d(scores, targets, mesh, axis)
-
-    def local(s, t):
-        s_all = lax.all_gather(s, axis, axis=0, tiled=True)
-        t_all = lax.all_gather(t, axis, axis=0, tiled=True)
-        return _binary_auroc_compute(s_all, t_all)
-
-    fn = jax.jit(
-        jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=PartitionSpec(axis),
-            out_specs=PartitionSpec(),
-            check_vma=False,  # gathered result is replicated by construction
-        )
-    )
-    return fn(scores, targets)
+    return _gather_exact(_binary_auroc_compute, mesh, axis, 0, scores, targets)
 
 
 def sharded_binary_auprc_exact(
@@ -126,22 +170,9 @@ def sharded_binary_auprc_exact(
     )
 
     _check_even_1d(scores, targets, mesh, axis)
-
-    def local(s, t):
-        s_all = lax.all_gather(s, axis, axis=0, tiled=True)
-        t_all = lax.all_gather(t, axis, axis=0, tiled=True)
-        return _binary_auprc_compute_kernel(s_all, t_all)
-
-    fn = jax.jit(
-        jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=PartitionSpec(axis),
-            out_specs=PartitionSpec(),
-            check_vma=False,
-        )
+    return _gather_exact(
+        _binary_auprc_compute_kernel, mesh, axis, 0, scores, targets
     )
-    return fn(scores, targets)
 
 
 def sharded_multiclass_auroc_exact(
@@ -177,21 +208,10 @@ def sharded_multiclass_auroc_exact(
             f"axis {axis!r} of size {size}."
         )
 
-    def local(s, t):
-        s_all = lax.all_gather(s, axis, axis=0, tiled=True)
-        t_all = lax.all_gather(t, axis, axis=0, tiled=True)
+    def kernel(s_all, t_all):
         return _multiclass_auroc_compute(s_all, t_all, num_classes, average)
 
-    fn = jax.jit(
-        jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(PartitionSpec(axis), PartitionSpec(axis)),
-            out_specs=PartitionSpec(),
-            check_vma=False,
-        )
-    )
-    return fn(scores, targets)
+    return _gather_exact(kernel, mesh, axis, 0, scores, targets)
 
 
 def _work_dtype(dtype) -> jnp.dtype:
