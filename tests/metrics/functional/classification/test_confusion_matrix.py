@@ -67,6 +67,61 @@ if __name__ == "__main__":
 
 
 class TestMatmulFormulation(unittest.TestCase):
+    def test_out_of_range_labels_dropped_by_both_paths(self):
+        # Under skip_value_checks: [-C, 0) wraps numpy-style; anything in
+        # [-2C, -C) or >= C is dropped by BOTH formulations (the raw
+        # scatter would wrap twice and count -6 at C=4 as class 2).
+        import jax.numpy as jnp
+
+        from torcheval_tpu.metrics.functional._host_checks import (
+            skip_value_checks,
+        )
+        from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+            _confusion_matrix_update_kernel,
+        )
+
+        c = 4
+        pred = jnp.asarray([0, 1, -6, 2, 9, -1], dtype=jnp.int32)
+        target = jnp.asarray([0, -7, 1, 2, 3, 3], dtype=jnp.int32)
+        with skip_value_checks():
+            scatter = _confusion_matrix_update_kernel(
+                pred, target, c, use_matmul=False
+            )
+            matmul = _confusion_matrix_update_kernel(
+                pred, target, c, use_matmul=True
+            )
+        expect = jnp.zeros((c, c), jnp.int32).at[0, 0].add(1)  # (0, 0)
+        expect = expect.at[2, 2].add(1)  # (2, 2)
+        expect = expect.at[3, 3].add(1)  # (-1 wraps to 3, target 3)
+        # rows with label -6 (pred), -7 (target), 9 (pred) all dropped
+        self.assertTrue(bool(jnp.array_equal(scatter, expect)))
+        self.assertTrue(bool(jnp.array_equal(matmul, expect)))
+
+    def test_route_selected_outside_jit(self):
+        # The kill-switch must be honored per call, not frozen into the
+        # first compilation for a shape (_select_binned_route pattern).
+        import os
+        from unittest import mock
+
+        import jax
+
+        from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+            _use_matmul_cm,
+        )
+
+        on_tpu = jax.default_backend() == "tpu"
+        clean_env = {
+            k: v
+            for k, v in os.environ.items()
+            if k != "TORCHEVAL_TPU_DISABLE_PALLAS"
+        }
+        with mock.patch.dict(os.environ, clean_env, clear=True):
+            self.assertEqual(_use_matmul_cm(16, 1024), on_tpu)
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_DISABLE_PALLAS": "1"}
+        ):
+            self.assertFalse(_use_matmul_cm(16, 1024))
+
     def test_matmul_equals_scatter(self):
         # The MXU one-hot formulation must be bit-identical to the scatter
         # within its dispatch bounds (C <= 512, n < 2^24).

@@ -115,6 +115,18 @@ class TestShardedBinaryExact(unittest.TestCase):
                 s, t, self.mesh, max_minority_count_per_shard=8
             )
 
+    def test_ustat_infinite_scores_raise(self):
+        # The packed runs pad with +/-inf sentinels, so a legitimately
+        # infinite score would corrupt tie counts — must raise eagerly.
+        s, t = _binary_data(64, seed=7)
+        s = s.at[3].set(jnp.inf)
+        with self.assertRaisesRegex(ValueError, "finite scores"):
+            sharded_binary_auroc_ustat(s, t, self.mesh)
+        # gather-exact stays the documented escape hatch for such inputs.
+        got = sharded_binary_auroc_exact(s, t, self.mesh)
+        want = binary_auroc(s, t)
+        self.assertEqual(np.asarray(got).tobytes(), np.asarray(want).tobytes())
+
     def test_invalid_average_raises(self):
         rng = np.random.default_rng(9)
         scores = jnp.asarray(rng.random((64, 4)).astype(np.float32))

@@ -104,6 +104,32 @@ class TestPallasBinnedCounts(unittest.TestCase):
             _binned_counts_rows_sort(s, h, th),
         )
 
+    def test_huge_scores_above_sentinel(self):
+        # Scores in [3.0e38, inf) must not select a sentinel pad block and
+        # vanish from the counts: they clamp to just below the sentinel,
+        # which still satisfies ``score >= t`` for every real threshold.
+        s = jnp.asarray([[3.0e38, 3.39e38, float("inf"), 0.5, -1.0]])
+        h = jnp.asarray([[1, 0, 1, 1, 0]], dtype=bool)
+        th = jnp.asarray([0.0, 0.5, 1.0])
+        _assert_counts_equal(
+            self,
+            pallas_binned_counts(s, h, th, interpret=True),
+            _binned_counts_rows_sort(s, h, th),
+        )
+
+    def test_sentinel_grid_unreachable_via_public_api(self):
+        # The kernel's finite pad sentinel is safe because every public
+        # binned entry bounds grids to [0, 1] — a wild grid raises before
+        # any dispatch can reach the Pallas kernel.
+        from torcheval_tpu.metrics.functional import binary_binned_auroc
+
+        with self.assertRaisesRegex(ValueError, "range of \\[0, 1\\]"):
+            binary_binned_auroc(
+                jnp.asarray([0.1, 0.9]),
+                jnp.asarray([0, 1]),
+                threshold=jnp.asarray([0.0, 3.2e38]),
+            )
+
     def test_empty_input(self):
         s = jnp.zeros((2, 0), jnp.float32)
         h = jnp.zeros((2, 0), bool)

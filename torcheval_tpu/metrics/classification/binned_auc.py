@@ -109,7 +109,7 @@ class _BinaryBinnedAUC(_BinnedCountsBase):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_auroc_update_input_check(input, target, self.num_tasks)
         route = _select_binned_route(
-            self.num_tasks, input.shape[-1], self.threshold.shape[0]
+            self.num_tasks, input.shape[-1], self.threshold
         )
         self._accumulate(
             _binary_binned_counts_kernel, input, target, statics=(route,)
@@ -136,7 +136,7 @@ class _MulticlassBinnedAUC(_BinnedCountsBase):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multiclass_binned_auc_validate(input, target, self.num_classes)
         route = _select_binned_route(
-            self.num_classes, input.shape[0], self.threshold.shape[0]
+            self.num_classes, input.shape[0], self.threshold
         )
         self._accumulate(
             _multiclass_binned_counts_kernel, input, target,
@@ -164,7 +164,7 @@ class _MultilabelBinned(_BinnedCountsBase):
             input, target, self.num_labels
         )
         route = _select_binned_route(
-            self.num_labels, input.shape[0], self.threshold.shape[0]
+            self.num_labels, input.shape[0], self.threshold
         )
         self._accumulate(
             _multilabel_binned_counts_kernel, input, target, statics=(route,)

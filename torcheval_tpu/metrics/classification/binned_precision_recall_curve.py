@@ -54,9 +54,7 @@ class BinaryBinnedPrecisionRecallCurve(
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_binned_update_input_check(input, target)
         # Kernel + all three state adds fused into one dispatch (_fuse.py).
-        route = _select_binned_route(
-            1, input.shape[0], self.threshold.shape[0]
-        )
+        route = _select_binned_route(1, input.shape[0], self.threshold)
         self.num_tp, self.num_fp, self.num_fn = accumulate(
             _binary_binned_update_kernel,
             (self.num_tp, self.num_fp, self.num_fn),
@@ -109,7 +107,7 @@ class MulticlassBinnedPrecisionRecallCurve(
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multiclass_binned_validate(input, target, self.num_classes)
         route = _select_binned_route(
-            self.num_classes, input.shape[0], self.threshold.shape[0]
+            self.num_classes, input.shape[0], self.threshold
         )
         self.num_tp, self.num_fp, self.num_fn = accumulate(
             _multiclass_binned_update_kernel,

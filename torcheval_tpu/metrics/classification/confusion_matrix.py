@@ -15,6 +15,7 @@ from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
     _confusion_matrix_param_check,
     _confusion_matrix_update_input_check,
     _confusion_matrix_update_kernel,
+    _use_matmul_cm,
 )
 from torcheval_tpu.metrics.metric import Metric
 
@@ -43,12 +44,17 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _confusion_matrix_update_input_check(input, target, self.num_classes)
         # Scatter kernel + state add fused into one dispatch (_fuse.py).
+        # Route selection stays outside jit (honors the pallas kill-switch
+        # at call time — _select_binned_route pattern).
         (self.confusion_matrix,) = accumulate(
             _confusion_matrix_update_kernel,
             (self.confusion_matrix,),
             input,
             target,
-            statics=(self.num_classes,),
+            statics=(
+                self.num_classes,
+                _use_matmul_cm(self.num_classes, input.shape[0]),
+            ),
         )
         return self
 
@@ -88,6 +94,6 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
             (self.confusion_matrix,),
             input,
             target,
-            statics=(self.threshold,),
+            statics=(self.threshold, _use_matmul_cm(2, input.shape[0])),
         )
         return self

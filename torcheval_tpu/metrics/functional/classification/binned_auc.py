@@ -196,14 +196,15 @@ _BROADCAST_MAX_WORK = 2**32
 
 
 def _select_binned_route(
-    num_rows: int, num_samples: int, num_thresholds: int
+    num_rows: int, num_samples: int, thresholds: jax.Array
 ) -> str:
     """Call-time formulation choice for the binned-counts stage.
 
     Evaluated OUTSIDE jit (the result rides into the jitted kernels as a
     static argument), so the ``TORCHEVAL_TPU_DISABLE_PALLAS`` kill-switch
     is honored per call even for already-compiled shapes, and the Pallas
-    module is never imported while the switch is set.
+    module is never imported while the switch is set.  Only the grid's
+    static shape is consulted — no device sync on the update path.
 
     * ``"broadcast"`` — TPU, work = R·N·T ≤ 2^32: XLA fuses the
       ``(R, N, T)`` comparison straight into its two reductions (no
@@ -211,10 +212,15 @@ def _select_binned_route(
     * ``"pallas"`` — TPU, larger work, within the MXU kernel's bounds
       (rows < 2^24 samples for exact f32 per-bin accumulation — the sort
       is int32-exact — and ≤ 2^15 thresholds for the VMEM one-hot tiles).
+      The kernel's finite pad sentinel is safe here because every public
+      binned entry point enforces thresholds within [0, 1]
+      (``_binned_precision_recall_curve_param_check``), far below the
+      3.0e38 pad; scores above it are clamped inside the kernel wrapper.
     * ``"sort"`` — CPU, kill-switch, or out-of-bounds fallback.
     """
     from torcheval_tpu.ops._flags import pallas_disabled
 
+    num_thresholds = thresholds.shape[0]
     if pallas_disabled() or jax.default_backend() != "tpu":
         return "sort"
     if num_rows * num_samples * num_thresholds <= _BROADCAST_MAX_WORK:
@@ -237,7 +243,7 @@ def _binned_counts_rows(
     inside jit (it must be selected at call time, outside the trace)."""
     if route is None:
         route = _select_binned_route(
-            scores.shape[0], scores.shape[-1], thresholds.shape[0]
+            scores.shape[0], scores.shape[-1], thresholds
         )
     if route == "broadcast":
         return _binned_counts_rows_broadcast(scores, hits, thresholds)
@@ -318,9 +324,7 @@ def _multiclass_binned_counts_kernel(
     # metrics pass it explicitly (their fused update traces this function,
     # and the choice must not be frozen into the trace).
     if route is None:
-        route = _select_binned_route(
-            num_classes, input.shape[0], threshold.shape[0]
-        )
+        route = _select_binned_route(num_classes, input.shape[0], threshold)
     return _multiclass_binned_counts_jit(
         input, target, threshold, num_classes, route
     )
@@ -346,9 +350,7 @@ def _multilabel_binned_counts_kernel(
     route: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     if route is None:
-        route = _select_binned_route(
-            input.shape[1], input.shape[0], threshold.shape[0]
-        )
+        route = _select_binned_route(input.shape[1], input.shape[0], threshold)
     return _multilabel_binned_counts_jit(input, target, threshold, route)
 
 

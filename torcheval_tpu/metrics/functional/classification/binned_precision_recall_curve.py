@@ -88,7 +88,7 @@ def _binary_binned_update_kernel(
     )
 
     if route is None:
-        route = _select_binned_route(1, input.shape[0], threshold.shape[0])
+        route = _select_binned_route(1, input.shape[0], threshold)
     return _binary_binned_update_jit(input, target, threshold, route)
 
 
@@ -155,9 +155,7 @@ def _multiclass_binned_update_kernel(
     )
 
     if route is None:
-        route = _select_binned_route(
-            num_classes, input.shape[0], threshold.shape[0]
-        )
+        route = _select_binned_route(num_classes, input.shape[0], threshold)
     return _multiclass_binned_update_jit(
         input, target, threshold, num_classes, route
     )

@@ -58,7 +58,8 @@ def _confusion_matrix_update(
     input: jax.Array, target: jax.Array, num_classes: int
 ) -> jax.Array:
     _confusion_matrix_update_input_check(input, target, num_classes)
-    return _confusion_matrix_update_kernel(input, target, num_classes)
+    use_matmul = _use_matmul_cm(num_classes, input.shape[0])
+    return _confusion_matrix_update_kernel(input, target, num_classes, use_matmul)
 
 
 def _use_matmul_cm(num_classes: int, num_samples: int) -> bool:
@@ -75,7 +76,12 @@ def _use_matmul_cm(num_classes: int, num_samples: int) -> bool:
 
     f32 accumulation bounds the exact count range to 2^24 per cell, and
     the two (n, C) bf16 one-hots bound memory — n·C over 2^28 (≈1 GiB of
-    one-hots) keeps the O(n)-memory scatter."""
+    one-hots) keeps the O(n)-memory scatter.
+
+    Called OUTSIDE jit (the ``_select_binned_route`` pattern) and passed
+    into the kernel as a static argument, so the
+    ``TORCHEVAL_TPU_DISABLE_PALLAS`` kill-switch is honored at call time
+    rather than frozen into the first compilation per shape."""
     from torcheval_tpu.ops._flags import pallas_disabled
 
     if pallas_disabled():
@@ -108,19 +114,29 @@ def _matmul_cm(
     return cm.astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("num_classes",))
+def _wrap_labels(x: jax.Array, num_classes: int) -> jax.Array:
+    # Normalize numpy-style negative wrap-around up front so the matmul
+    # and scatter formulations agree bit-for-bit even on out-of-range
+    # labels under skip_value_checks: [-C, 0) wraps (what .at[] would do).
+    # Anything still negative after the single wrap maps to the OOB
+    # sentinel ``num_classes`` so BOTH paths drop it — the raw scatter
+    # would otherwise wrap a second time and count labels in [-2C, -C).
+    x = jnp.where(x < 0, x + num_classes, x)
+    return jnp.where(x < 0, num_classes, x)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "use_matmul"))
 def _confusion_matrix_update_kernel(
-    input: jax.Array, target: jax.Array, num_classes: int
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    use_matmul: bool = False,
 ) -> jax.Array:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
-    # Normalize numpy-style negative wrap-around up front so the matmul
-    # and scatter formulations agree bit-for-bit even on out-of-range
-    # labels under skip_value_checks: [-C, 0) wraps (what .at[] would do),
-    # anything still out of range is dropped by both paths.
-    input = jnp.where(input < 0, input + num_classes, input)
-    target = jnp.where(target < 0, target + num_classes, target)
-    if _use_matmul_cm(num_classes, input.shape[0]):
+    input = _wrap_labels(input, num_classes)
+    target = _wrap_labels(target, num_classes)
+    if use_matmul:
         return _matmul_cm(input, target, num_classes)
     return (
         jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
@@ -144,19 +160,27 @@ def _binary_confusion_matrix_validate(input: jax.Array, target: jax.Array) -> No
             )
 
 
-@partial(jax.jit, static_argnames=("threshold",))
+@partial(jax.jit, static_argnames=("threshold", "use_matmul"))
 def _binary_confusion_matrix_update_kernel(
-    input: jax.Array, target: jax.Array, threshold: float
+    input: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    use_matmul: bool = False,
 ) -> jax.Array:
     pred = jnp.where(input < threshold, 0, 1)
-    return _confusion_matrix_update_kernel(pred, target.astype(jnp.int32), 2)
+    return _confusion_matrix_update_kernel(
+        pred, target.astype(jnp.int32), 2, use_matmul
+    )
 
 
 def _binary_confusion_matrix_update(
     input: jax.Array, target: jax.Array, threshold: float
 ) -> jax.Array:
     _binary_confusion_matrix_validate(input, target)
-    return _binary_confusion_matrix_update_kernel(input, target, threshold)
+    use_matmul = _use_matmul_cm(2, input.shape[0])
+    return _binary_confusion_matrix_update_kernel(
+        input, target, threshold, use_matmul
+    )
 
 
 def _confusion_matrix_compute(

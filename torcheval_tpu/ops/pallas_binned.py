@@ -49,6 +49,8 @@ R·N·T ≈ 2^32).
 from functools import partial
 from typing import Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -56,7 +58,19 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _LANE = 128  # fine-stage width: thresholds per coarse block
-_SENTINEL = 3.0e38  # finite "never <= any score" pad for the threshold table
+# Finite "never <= any score" pad for the threshold table.  PRECONDITION:
+# every real threshold must lie strictly below this — guaranteed for the
+# public binned API, whose param check bounds grids to [0, 1]
+# (``_binned_precision_recall_curve_param_check``); direct callers of
+# ``pallas_binned_counts`` with wild grids own the check themselves.
+_SENTINEL = 3.0e38
+# Largest f32 strictly below the sentinel (numpy at import time: no device
+# dispatch as an import side effect).  Scores are clamped here so a score
+# in [_SENTINEL, inf) cannot select a sentinel pad block (it would be
+# dropped from every bin); with every real threshold < _SENTINEL the
+# clamped score still satisfies ``score >= t`` for all t, so counts stay
+# bit-identical to the sort/broadcast formulations.
+_SENTINEL_BELOW = float(np.nextafter(np.float32(_SENTINEL), np.float32(0)))
 _TILE = 2048  # samples per grid step; ~(Bc+384, 2048) VMEM temporaries
 
 
@@ -160,7 +174,7 @@ def _pallas_binned_hist(
         thresholds.astype(jnp.float32)
     )
     ttab = ttab.reshape(bc, _LANE).T  # (128, Bc)
-    s = scores.astype(jnp.float32)
+    s = jnp.minimum(scores.astype(jnp.float32), _SENTINEL_BELOW)
     h = hits.astype(jnp.float32)
     if n_pad != n:
         s = jnp.pad(s, ((0, 0), (0, n_pad - n)))

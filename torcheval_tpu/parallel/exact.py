@@ -49,8 +49,11 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
+import numpy as np
+
 from torcheval_tpu.metrics.functional._host_checks import (
     all_concrete,
+    bounds,
     value_checks_enabled,
 )
 
@@ -220,6 +223,25 @@ def _work_dtype(dtype) -> jnp.dtype:
     return dtype if dtype in (jnp.float32, jnp.float64) else jnp.float32
 
 
+def _check_finite_scores(scores, fn_name: str) -> None:
+    """The ustat families pack minority runs with ±inf sentinels, so a
+    legitimately infinite score would be indistinguishable from padding
+    (tie counts absorb pads; the binary ``n_chosen - hi`` base can go
+    negative).  Raise eagerly instead of returning a wrong AUROC.
+    Skippable via ``skip_value_checks`` like every other host check; the
+    gather-exact variants handle non-finite scores consistently."""
+    if value_checks_enabled() and all_concrete(scores) and scores.size:
+        # One fused round trip (the _host_checks bounds pattern): min/max
+        # propagate NaN and surface +/-inf, so two scalars decide it.
+        lo, hi = bounds(scores)
+        if not (np.isfinite(lo) and np.isfinite(hi)):
+            raise ValueError(
+                f"{fn_name} requires finite scores (its packed-run padding "
+                "uses +/-inf sentinels); use the gather-exact variant for "
+                "inputs that may contain inf/nan."
+            )
+
+
 def sharded_binary_auroc_ustat(
     scores: jax.Array,
     targets: jax.Array,
@@ -249,8 +271,13 @@ def sharded_binary_auroc_ustat(
     The minority side is chosen inside the program (``jnp.where`` masks, no
     host sync).  Exact pair counts; see module docstring for the
     accumulation-precision note.
+
+    Scores must be finite: the packed runs pad with ``+inf`` sentinels, so
+    infinite scores are rejected eagerly (skippable via
+    ``skip_value_checks``; use the gather-exact variant for such inputs).
     """
     _check_even_1d(scores, targets, mesh, axis)
+    _check_finite_scores(scores, "sharded_binary_auroc_ustat")
     size = mesh.shape[axis]
     n_local = scores.shape[0] // size
     cap = (
@@ -353,6 +380,10 @@ def sharded_multiclass_auroc_ustat(
     samples of one class than the cap (skippable via
     ``skip_value_checks``, in which case overflow silently drops the
     largest scores of the overflowing class).
+
+    Scores must be finite: the packed rows pad with ``-inf``/``inf``
+    sentinels, so infinite scores are rejected eagerly (skippable via
+    ``skip_value_checks``; use the gather-exact variant for such inputs).
     """
     from torcheval_tpu.metrics.functional.classification.auroc import (
         _multiclass_auroc_param_check,
@@ -374,6 +405,7 @@ def sharded_multiclass_auroc_ustat(
             f"sample count {scores.shape[0]} must divide evenly over mesh "
             f"axis {axis!r} of size {size}."
         )
+    _check_finite_scores(scores, "sharded_multiclass_auroc_ustat")
     n_local = scores.shape[0] // size
     cap = (
         min(max_class_count_per_shard, n_local)
