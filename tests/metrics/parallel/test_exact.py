@@ -12,6 +12,7 @@ integer grids.
 import unittest
 
 import numpy as np
+import pytest
 
 import jax.numpy as jnp
 
@@ -44,6 +45,7 @@ class TestShardedBinaryExact(unittest.TestCase):
     def setUp(self):
         self.mesh = make_mesh()
 
+    @pytest.mark.big
     def test_bitwise_headline_scale(self):
         # 2^22 samples with heavy ties: the VERDICT "done" criterion.
         s, t = _binary_data(2**22, tie_levels=1024)

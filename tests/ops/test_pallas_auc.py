@@ -4,6 +4,8 @@ analog it replaces; same-math check is therefore equality)."""
 
 import unittest
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -73,6 +75,7 @@ class TestPallasBinaryAUROC(unittest.TestCase):
             0.5,
         )
 
+    @pytest.mark.big
     def test_beyond_2pow24_exactness(self):
         # N = 2^25: beyond the old float32-count limit.  int32 count
         # carries keep tie-group boundaries and totals exact; the result

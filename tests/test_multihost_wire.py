@@ -20,6 +20,8 @@ import sys
 import unittest
 from pathlib import Path
 
+import pytest
+
 REPO_ROOT = Path(__file__).resolve().parent.parent
 WORKER = Path(__file__).resolve().parent / "_multihost_wire_worker.py"
 
@@ -83,6 +85,7 @@ def _run_world(nprocs: int, timeout: float = 420.0):
 
 
 class TestMultihostWirePath(unittest.TestCase):
+    @pytest.mark.big
     def test_four_process_sync_and_compute(self):
         nprocs = 4
         outputs = _run_world(nprocs)

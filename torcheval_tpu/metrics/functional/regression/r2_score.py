@@ -85,8 +85,8 @@ def _r2_score_compute(
 def _r2_score_size_check(num_obs: int, num_regressors: int) -> None:
     if num_obs < 2:
         raise ValueError(
-            "There is no enough data for computing. Needs at least two "
-            "samples to calculate r2 score."
+            "Not enough data to compute: the R2 score needs at least two "
+            "samples."
         )
     if num_regressors >= num_obs - 1:
         raise ValueError(

@@ -417,13 +417,13 @@ def _get_human_readable_count(
     ``module_summary.py:455-503`` behavior: <100 of a unit keeps one decimal,
     otherwise a comma-grouped integer)."""
     if not isinstance(number, int):
-        raise TypeError(f"Input type must be int, but received {type(number)}")
+        raise TypeError(f"expected an int to abbreviate, got {type(number)}")
     if number < 0:
-        raise ValueError(f"Input value must be greater than 0, received {number}")
+        raise ValueError(f"expected a non-negative count, got {number}")
     labels = labels if labels is not None else _PARAMETER_NUM_UNITS
     if not labels:
         raise ValueError(
-            f"Input labels must be a list with at least one string, received {labels}"
+            f"expected at least one unit label to abbreviate with, got {labels}"
         )
     group = 0
     value = float(number)
