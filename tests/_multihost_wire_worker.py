@@ -60,6 +60,18 @@ def main(pid: int, nprocs: int, port: int) -> None:
     payloads = group.all_gather_bytes(bytes(range(pid + 1)))
     assert payloads == [bytes(range(r + 1)) for r in range(nprocs)], payloads
 
+    # --- true gather (KV-store point-to-point): only dst gets the list,
+    # including a payload big enough to exercise the chunking.
+    big = {"rank": pid, "blob": b"x" * (3 * 2**20 + pid)}
+    gathered = group.gather_object(big, dst=1)
+    if pid == 1:
+        assert [g["rank"] for g in gathered] == list(range(nprocs)), gathered
+        assert all(
+            len(g["blob"]) == 3 * 2**20 + r for r, g in enumerate(gathered)
+        )
+    else:
+        assert gathered is None, "non-recipient must not receive the gather"
+
     # --- buffer-state metric (concat merge) with ragged per-rank lengths;
     # exercises _prepare_for_merge_state + pickle over the wire.
     auroc = BinaryAUROC()

@@ -39,6 +39,21 @@ class TestDefaultGroup(unittest.TestCase):
         self.assertIsInstance(default_group(), SingleProcessGroup)
 
 
+class CountingPayload:
+    """Counts deserializations (module-level: payloads must pickle)."""
+
+    unpickles = 0
+    lock = threading.Lock()
+
+    def __init__(self):
+        self.payload = "x"  # non-empty __dict__ so __setstate__ runs
+
+    def __setstate__(self, state):
+        with CountingPayload.lock:
+            CountingPayload.unpickles += 1
+        self.__dict__.update(state)
+
+
 class TestLocalWorld(unittest.TestCase):
     def test_all_gather_object_ordering(self):
         def fn(group, rank):
@@ -77,6 +92,64 @@ class TestLocalWorld(unittest.TestCase):
     def test_invalid_world_size(self):
         with self.assertRaises(ValueError):
             LocalWorld(0)
+
+    def test_gather_object_only_dst_receives(self):
+        def fn(group, rank):
+            return group.gather_object({"rank": rank}, dst=2)
+
+        results = LocalWorld(4).run(fn)
+        for rank, res in enumerate(results):
+            if rank == 2:
+                self.assertEqual([g["rank"] for g in res], [0, 1, 2, 3])
+            else:
+                self.assertIsNone(res)
+
+    def test_gather_object_memory_contract(self):
+        # The reference gathers to ONE rank "to use less memory"
+        # (reference toolkit.py:61-64): non-recipients must never
+        # materialize peers' payloads.  Count deserializations: a true
+        # gather unpickles exactly world_size payloads (all at dst);
+        # the all-gather fallback would unpickle world_size².
+        CountingPayload.unpickles = 0
+        world = 4
+
+        def fn(group, rank):
+            return group.gather_object(CountingPayload(), dst=0)
+
+        LocalWorld(world).run(fn)
+        self.assertEqual(CountingPayload.unpickles, world)
+
+    def test_gather_then_all_gather_stay_aligned(self):
+        def fn(group, rank):
+            g = group.gather_object(rank, dst=1)
+            a = group.all_gather_object(rank * 10)
+            return g, a
+
+        for rank, (g, a) in enumerate(LocalWorld(3).run(fn)):
+            self.assertEqual(a, [0, 10, 20])
+            self.assertEqual(g, [0, 1, 2] if rank == 1 else None)
+
+
+class TestToolkitRecipientGather(unittest.TestCase):
+    def test_sync_and_compute_recipient_uses_true_gather(self):
+        import jax.numpy as jnp
+
+        from torcheval_tpu.metrics import MulticlassAccuracy
+        from torcheval_tpu.metrics.toolkit import sync_and_compute
+
+        def fn(group, rank):
+            m = MulticlassAccuracy()
+            m.update(jnp.asarray([rank % 2, 1]), jnp.asarray([0, 1]))
+            return sync_and_compute(m, group, recipient_rank=3)
+
+        results = LocalWorld(4).run(fn)
+        for rank, res in enumerate(results):
+            if rank == 3:
+                # ranks 0,2 predict [0,1] on targets [0,1] → 2 correct;
+                # ranks 1,3 predict [1,1] → 1 correct: 6/8 overall.
+                self.assertAlmostEqual(float(res), 6 / 8, places=6)
+            else:
+                self.assertIsNone(res)
 
     def test_threads_do_not_leak(self):
         before = threading.active_count()

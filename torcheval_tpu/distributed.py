@@ -34,6 +34,10 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
+# Peer-payload wait budget for the KV-store gather (first compiles and big
+# pickles through the tunnel are slow; generous beats a spurious timeout).
+_KV_TIMEOUT_MS = 600_000
+
 
 class CollectiveGroup(ABC):
     """Process-group abstraction (reference ``PGWrapper``, ``toolkit.py:16``)."""
@@ -55,6 +59,20 @@ class CollectiveGroup(ABC):
     def broadcast_object(self, obj: Any, src: int) -> Any:
         """Broadcast ``obj`` from rank ``src``; returns the broadcast value."""
 
+    def gather_object(self, obj: Any, dst: int = 0) -> Optional[List[Any]]:
+        """Gather one picklable object from every rank TO rank ``dst``:
+        the world-size list there, ``None`` elsewhere.
+
+        This is the reference's ``dist.gather_object`` memory contract
+        (reference ``toolkit.py:61-64``: gather to one rank "to use less
+        memory"): non-recipient ranks must not materialize their peers'
+        payloads.  The base implementation falls back to
+        all-gather-then-drop (correct results, not the memory bound);
+        concrete groups override with a true gather.
+        """
+        gathered = self.all_gather_object(obj)
+        return gathered if self.rank == dst else None
+
 
 class SingleProcessGroup(CollectiveGroup):
     """Degenerate world of one (reference world_size==1 no-op path,
@@ -74,6 +92,9 @@ class SingleProcessGroup(CollectiveGroup):
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
         return obj
 
+    def gather_object(self, obj: Any, dst: int = 0) -> Optional[List[Any]]:
+        return [obj]
+
 
 class NullGroup(CollectiveGroup):
     """A group this process is not a member of (reference world_size == -1
@@ -91,6 +112,9 @@ class NullGroup(CollectiveGroup):
         raise RuntimeError("Process is not part of this group.")
 
     def broadcast_object(self, obj: Any, src: int) -> Any:
+        raise RuntimeError("Process is not part of this group.")
+
+    def gather_object(self, obj: Any, dst: int = 0) -> Optional[List[Any]]:
         raise RuntimeError("Process is not part of this group.")
 
 
@@ -169,6 +193,84 @@ class JaxProcessGroup(CollectiveGroup):
         # SPMD all-gather gives every rank the payload; select src's.
         # (On a pod the all-gather rides ICI, and "broadcast" is free.)
         return self.all_gather_object(obj)[src]
+
+    # One KV generation per collective call; every rank calls gather in
+    # lockstep, so matching counters address the same generation and no
+    # barrier is needed between calls.
+    _gather_gen: int = 0
+    _KV_CHUNK = 1 << 20  # 1 MiB raw per KV value (b64 ≈ 1.33 MiB < gRPC cap)
+
+    def gather_object(self, obj: Any, dst: int = 0) -> Optional[List[Any]]:
+        """TRUE gather: non-``dst`` ranks ship their payload point-to-point
+        over the coordination service's KV store and never materialize
+        their peers' states — the reference's ``dist.gather_object`` memory
+        contract (gather to one rank "to use less memory",
+        reference ``toolkit.py:61-64``).
+
+        This rides the host wire (gRPC to the coordinator), the analog of
+        the reference's gloo object gather — NOT the ICI array fabric; for
+        counter states prefer the in-jit ``psum`` path
+        (``metrics/toolkit.py``), and note the coordinator process buffers
+        in-flight payloads.  Falls back to all-gather-then-drop when no
+        coordination client is available (results identical; memory bound
+        lost)."""
+        if not 0 <= dst < self.world_size:
+            # Silent Nones would leak every rank's payload in the KV store.
+            raise ValueError(
+                f"dst must be a rank in [0, {self.world_size}), got {dst}."
+            )
+        client = self._kv_client()
+        if client is None:  # pragma: no cover - single-host or odd runtime
+            return super().gather_object(obj, dst)
+        import base64
+
+        gen = JaxProcessGroup._gather_gen
+        JaxProcessGroup._gather_gen += 1
+        prefix = f"torcheval_tpu/gather/{gen}"
+        rank, world = self.rank, self.world_size
+        if rank != dst:
+            payload = pickle.dumps(obj)
+            chunks = [
+                payload[i : i + self._KV_CHUNK]
+                for i in range(0, max(len(payload), 1), self._KV_CHUNK)
+            ]
+            for i, chunk in enumerate(chunks):
+                client.key_value_set(
+                    f"{prefix}/{rank}/{i}",
+                    base64.b64encode(chunk).decode("ascii"),
+                )
+            client.key_value_set(f"{prefix}/{rank}/n", str(len(chunks)))
+            return None
+        out: List[Any] = [None] * world
+        out[dst] = obj
+        for peer in range(world):
+            if peer == dst:
+                continue
+            n = int(
+                client.blocking_key_value_get(
+                    f"{prefix}/{peer}/n", _KV_TIMEOUT_MS
+                )
+            )
+            payload = b"".join(
+                base64.b64decode(
+                    client.blocking_key_value_get(
+                        f"{prefix}/{peer}/{i}", _KV_TIMEOUT_MS
+                    )
+                )
+                for i in range(n)
+            )
+            out[peer] = pickle.loads(payload)
+            client.key_value_delete(f"{prefix}/{peer}/")
+        return out
+
+    @staticmethod
+    def _kv_client():
+        try:
+            from jax._src import distributed as _distributed
+
+            return _distributed.global_state.client
+        except Exception:  # pragma: no cover - internal layout changed
+            return None
 
 
 class LocalWorld:
@@ -256,6 +358,25 @@ class LocalGroup(CollectiveGroup):
             self._world._slots[src] = pickle.dumps(obj)
         self._world._barrier.wait()
         result = pickle.loads(self._world._slots[src])
+        self._world._barrier.wait()
+        return result
+
+    def gather_object(self, obj: Any, dst: int = 0) -> Optional[List[Any]]:
+        # TRUE gather semantics: only the recipient deserializes the
+        # world's payloads; the others' peak memory stays O(own payload)
+        # regardless of world size (asserted by test_distributed.py's
+        # unpickle-count test).
+        if not 0 <= dst < self.world_size:
+            raise ValueError(
+                f"dst must be a rank in [0, {self.world_size}), got {dst}."
+            )
+        self._world._slots[self._rank] = pickle.dumps(obj)
+        self._world._barrier.wait()
+        result = (
+            [pickle.loads(p) for p in self._world._slots]
+            if self._rank == dst
+            else None
+        )
         self._world._barrier.wait()
         return result
 

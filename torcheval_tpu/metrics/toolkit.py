@@ -15,11 +15,14 @@ needs no second broadcast — each rank merges the identical gathered list and
 computes the identical result.  ``recipient_rank=i`` keeps reference parity:
 non-recipient ranks still enter the collective but return ``None``.
 
-Divergence (documented): the reference gathers to a single rank specifically
-to save memory (``toolkit.py:61-64``); the SPMD all-gather costs
-``world_size × state`` bytes of *host* memory on every rank.  For large
-buffer-state metrics prefer the sharded in-jit path (``psum`` of counter
-states / sharded buffer compute) over object sync.
+``recipient_rank=i`` honors the reference's memory rationale with a TRUE
+gather (``CollectiveGroup.gather_object``): non-recipient ranks ship their
+payload and never materialize their peers' states, so their peak memory
+stays O(own state) as the world grows.  ``recipient_rank="all"`` keeps the
+SPMD all-gather (every rank needs the merged result anyway), which costs
+``world_size × state`` host bytes per rank.  For large buffer-state metrics
+prefer the sharded in-jit path (``psum`` of counter states / sharded buffer
+compute) over object sync either way.
 """
 
 from __future__ import annotations
@@ -95,6 +98,15 @@ def get_synced_metric(
 
     group = process_group if process_group is not None else default_group()
     world_size = group.world_size
+    if (
+        isinstance(recipient_rank, int)
+        and world_size > 1
+        and not 0 <= recipient_rank < world_size
+    ):
+        raise ValueError(
+            f"``recipient_rank`` must be a rank in [0, {world_size}), "
+            f"got {recipient_rank}."
+        )
     if world_size == 1:
         log.warning(
             "World size is 1, and metric is not synced. "
@@ -129,14 +141,14 @@ def _sync_metric_object(
     recipient_rank: Union[int, Literal["all"]],
 ) -> Optional[List[Metric]]:
     """The process-boundary crossing (reference ``toolkit.py:235-257``):
-    pre-canonicalize list states, then all-gather the pickled metrics as
-    padded uint8 arrays over the mesh.  Every rank enters the collective;
-    non-recipient ranks drop the result."""
+    pre-canonicalize list states, then move the pickled metrics — a true
+    gather to the recipient for an integer ``recipient_rank`` (non-
+    recipients never hold peers' states), an all-gather for ``"all"``
+    (every rank merges the identical list; no second broadcast needed)."""
     metric._prepare_for_merge_state()
-    gathered = group.all_gather_object(metric)
-    if recipient_rank == "all" or group.rank == recipient_rank:
-        return gathered
-    return None
+    if recipient_rank == "all":
+        return group.all_gather_object(metric)
+    return group.gather_object(metric, dst=recipient_rank)
 
 
 def reset_metrics(metrics: _TMetrics) -> _TMetrics:
