@@ -24,6 +24,7 @@ from torcheval_tpu.metrics.functional import (
 from torcheval_tpu.parallel import (
     make_mesh,
     sharded_binary_auprc_exact,
+    sharded_binary_auprc_ustat,
     sharded_binary_auroc_exact,
     sharded_binary_auroc_ustat,
     sharded_multiclass_auroc_exact,
@@ -145,6 +146,50 @@ class TestShardedBinaryExact(unittest.TestCase):
                     num_classes=4,
                     average="weighted",
                 )
+
+    def test_auprc_ustat_matches_single_device(self):
+        for n, pos_rate, ties, seed in [
+            (4096, 0.5, None, 0),
+            (4096, 0.03, None, 1),  # rare positives: the wire-win regime
+            (2**16, 0.2, 128, 2),  # heavy ties
+            (4096, 0.0, None, 3),  # no positives → 0
+            (4096, 1.0, None, 4),  # no negatives → 1
+        ]:
+            s, t = _binary_data(n, tie_levels=ties, pos_rate=pos_rate, seed=seed)
+            got = float(sharded_binary_auprc_ustat(s, t, self.mesh))
+            want = float(binary_auprc(s, t))
+            self.assertAlmostEqual(got, want, places=6, msg=f"seed={seed}")
+
+    def test_auprc_ustat_with_cap(self):
+        s, t = _binary_data(4096, pos_rate=0.03, seed=5)
+        got = float(
+            sharded_binary_auprc_ustat(
+                s, t, self.mesh, max_positive_count_per_shard=64
+            )
+        )
+        want = float(binary_auprc(s, t))
+        self.assertAlmostEqual(got, want, places=6)
+
+    def test_auprc_ustat_cap_overflow_raises(self):
+        s, t = _binary_data(4096, pos_rate=0.5, seed=6)
+        with self.assertRaisesRegex(ValueError, "positive samples"):
+            sharded_binary_auprc_ustat(
+                s, t, self.mesh, max_positive_count_per_shard=8
+            )
+
+    @pytest.mark.big
+    def test_auprc_ustat_headline_scale(self):
+        # 2^22 samples incl. a tie grid: the VERDICT "done" criterion for
+        # exact distributed AUPRC without O(N) wire.
+        for ties in (None, 1024):
+            s, t = _binary_data(2**22, tie_levels=ties, pos_rate=0.1, seed=9)
+            got = float(
+                sharded_binary_auprc_ustat(
+                    s, t, self.mesh, max_positive_count_per_shard=2**17
+                )
+            )
+            want = float(binary_auprc(s, t))
+            self.assertAlmostEqual(got, want, places=5, msg=f"ties={ties}")
 
     def test_ustat_exact_on_integer_grid(self):
         # Tiny integer score grid: U and the trapezoid area are small exact

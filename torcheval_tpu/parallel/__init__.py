@@ -38,6 +38,7 @@ from torcheval_tpu.parallel.mesh import (
 )
 from torcheval_tpu.parallel.exact import (
     sharded_binary_auprc_exact,
+    sharded_binary_auprc_ustat,
     sharded_binary_auroc_exact,
     sharded_binary_auroc_ustat,
     sharded_multiclass_auroc_exact,
@@ -62,6 +63,7 @@ __all__ = [
     "sharded_auprc_histogram",
     "sharded_auroc_histogram",
     "sharded_binary_auprc_exact",
+    "sharded_binary_auprc_ustat",
     "sharded_binary_auroc_exact",
     "sharded_binary_auroc_ustat",
     "sharded_multiclass_auroc_exact",

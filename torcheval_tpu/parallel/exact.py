@@ -197,6 +197,7 @@ def sharded_multiclass_auroc_exact(
         _multiclass_auroc_compute,
         _multiclass_auroc_param_check,
     )
+    from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
 
     _multiclass_auroc_param_check(num_classes, average)
     if scores.ndim != 2 or targets.ndim != 1:
@@ -210,9 +211,17 @@ def sharded_multiclass_auroc_exact(
             f"sample count {scores.shape[0]} must divide evenly over mesh "
             f"axis {axis!r} of size {size}."
         )
+    # The gathered arrays equal the unsharded inputs bit-for-bit, so making
+    # the rank-sum fast-path decision HERE (eagerly, on the same data the
+    # replicated kernel will see) keeps the family's contract: the result
+    # stays bitwise-equal to eager `multiclass_auroc(scores, targets)`,
+    # whichever formulation the route picks.
+    cap = ustat_route_cap(scores, targets, num_classes)
 
     def kernel(s_all, t_all):
-        return _multiclass_auroc_compute(s_all, t_all, num_classes, average)
+        return _multiclass_auroc_compute(
+            s_all, t_all, num_classes, average, ustat_cap=cap
+        )
 
     return _gather_exact(kernel, mesh, axis, 0, scores, targets)
 
@@ -337,6 +346,109 @@ def sharded_binary_auroc_ustat(
         return jnp.where(
             factor == 0, jnp.asarray(0.5, acc), u / factor
         ).astype(jnp.float32)
+
+    fn = jax.jit(
+        jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(PartitionSpec(axis), PartitionSpec(axis)),
+            out_specs=PartitionSpec(),
+            check_vma=False,
+        )
+    )
+    return fn(scores, targets)
+
+
+def sharded_binary_auprc_ustat(
+    scores: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    axis: str = "dp",
+    *,
+    max_positive_count_per_shard: Optional[int] = None,
+) -> jax.Array:
+    """Exact pod average precision shipping ONLY the positive class.
+
+    Step-sum AP (the single-device ``binary_auprc`` semantics,
+    ``auprc.py:_auprc_rows``) is a sum over positive entries ``a`` of the
+    tie-group-end precision ``TP(≥v_a) / (TP(≥v_a) + FP(≥v_a))``, divided
+    by ``n_pos``.  Both factors are computable from (1) the full multiset
+    of positive scores and (2) per-threshold global negative counts — so
+    the scheme is:
+
+    1. Each shard packs its local positive scores into a sorted run
+       (``+inf`` pads); ONE tiled all-gather ships the ``(P · cap)``
+       runs — O(#pos) wire with the cap set, never O(N).
+    2. Every device re-sorts the gathered positives; per entry,
+       ``TP(≥v) = n_pos − #{P < v}`` by one binary search of the multiset
+       against itself (exact, tie groups share the count).
+    3. Each shard counts its local negatives ``≥ v`` for every gathered
+       ``v`` (binary search over its sorted local negatives) and ONE
+       ``psum`` merges the exact global ``FP`` vector — O(P · cap) wire.
+    4. The masked precision sum over real entries, divided by ``n_pos``,
+       is replicated-identical on every device.
+
+    The exactness contract the reference meets by raw gather
+    (reference ``toolkit.py:247-255``), at O(#pos) wire; matches the
+    single-device kernel to float32 (both sum the same per-group terms
+    through XLA tree reductions).  ``max_positive_count_per_shard``: like
+    the binary ustat cap — a host check raises on overflow (skippable via
+    ``skip_value_checks``, then overflow silently drops the largest
+    positive scores).  Scores must be finite (``+inf`` pads), like the
+    other ustat variants.
+    """
+    _check_even_1d(scores, targets, mesh, axis)
+    _check_finite_scores(scores, "sharded_binary_auprc_ustat")
+    size = mesh.shape[axis]
+    n_local = scores.shape[0] // size
+    cap = (
+        min(max_positive_count_per_shard, n_local)
+        if max_positive_count_per_shard is not None
+        else n_local
+    )
+    if (
+        cap < n_local
+        and value_checks_enabled()
+        and all_concrete(scores, targets)
+    ):
+        overflow = _max_shard_positive_count(targets, world=size)
+        if int(overflow) > cap:
+            raise ValueError(
+                f"max_positive_count_per_shard={max_positive_count_per_shard}"
+                f" but a shard holds {int(overflow)} positive samples;"
+                " raise the cap (or pass None to disable packing)."
+            )
+    acc = _accum_dtype()
+
+    def local(s, t):
+        s = s.astype(_work_dtype(s.dtype))
+        pos_mask = t == 1  # the single-device kernel's hit definition
+        n_pos_local = jnp.sum(pos_mask, dtype=jnp.int32)
+        n_pos = lax.psum(n_pos_local, axis)
+
+        run = jnp.sort(jnp.where(pos_mask, s, jnp.inf))[:cap]
+        gathered = jnp.sort(lax.all_gather(run, axis, axis=0, tiled=True))
+        real = jnp.isfinite(gathered)
+
+        # Per entry: TP(≥v) = n_pos − #{P < v}; dupes share the count, so
+        # each contributes its group's precision once — exactly m_g · P_g.
+        lo_self = jnp.searchsorted(
+            gathered, gathered, side="left", method="sort"
+        )
+        tp = (n_pos - lo_self).astype(acc)
+
+        neg_sorted = jnp.sort(jnp.where(pos_mask, jnp.inf, s))
+        lo_neg = jnp.searchsorted(
+            neg_sorted, gathered, side="left", method="sort"
+        )
+        n_neg_local = jnp.int32(s.shape[0]) - n_pos_local
+        fp = lax.psum(n_neg_local - lo_neg, axis).astype(acc)  # (P·cap,)
+
+        precision = jnp.where(real, tp / jnp.maximum(tp + fp, 1.0), 0.0)
+        ap = jnp.sum(precision, dtype=acc) / jnp.maximum(
+            n_pos.astype(acc), 1.0
+        )
+        return jnp.where(n_pos == 0, 0.0, ap).astype(jnp.float32)
 
     fn = jax.jit(
         jax.shard_map(
@@ -489,6 +601,13 @@ def _max_shard_class_count(targets, num_classes: int, world: int):
         dtype=jnp.int32,
     )
     return counts.max()
+
+
+@partial(jax.jit, static_argnames=("world",))
+def _max_shard_positive_count(targets, world: int):
+    """Largest per-shard positive-sample count (one fused round trip)."""
+    shards = jnp.reshape(targets == 1, (world, -1))
+    return jnp.sum(shards, axis=1, dtype=jnp.int32).max()
 
 
 @partial(jax.jit, static_argnames=("world",))
