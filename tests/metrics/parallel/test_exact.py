@@ -239,6 +239,31 @@ class TestShardedMulticlassExact(unittest.TestCase):
                 np.asarray(got), np.asarray(want), rtol=2e-6, atol=2e-6
             )
 
+    def test_ustat_cap_autotunes_by_default(self):
+        # None (the default) must pick the O(N)-wire packed mode from a
+        # measured class-count stat, not degenerate to the full shard.
+        from torcheval_tpu.parallel.exact import _max_shard_class_count
+
+        rng = np.random.default_rng(17)
+        n, c = 4096, 32
+        scores = jnp.asarray(rng.random((n, c)).astype(np.float32))
+        targets = jnp.asarray(rng.integers(0, c, n))
+        got = sharded_multiclass_auroc_ustat(
+            scores, targets, self.mesh, num_classes=c
+        )
+        want = multiclass_auroc(scores, targets, num_classes=c)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-6, atol=2e-6
+        )
+        # The measured stat at ~16 samples/class/shard rounds to 64 —
+        # far below the 512-sample shard the old default would pack.
+        most = int(
+            _max_shard_class_count(
+                targets, num_classes=c, world=self.mesh.devices.size
+            )
+        )
+        self.assertLessEqual(most, 64)
+
     def test_ustat_with_cap(self):
         rng = np.random.default_rng(13)
         n, c = 2048, 64

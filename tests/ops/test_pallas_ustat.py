@@ -1,0 +1,255 @@
+"""Rank-sum (Mann-Whitney) exact-AUROC kernel — interpret-mode parity with
+numpy oracles and the sort-path kernel (the compiled Mosaic kernel is
+asserted on-chip in ``test_pallas_tpu.py``)."""
+
+import unittest
+
+import numpy as np
+from sklearn.metrics import roc_auc_score
+
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.auprc import (
+    _multiclass_auprc_compute_kernel,
+)
+from torcheval_tpu.metrics.functional.classification.auroc import (
+    _multiclass_auroc_compute_kernel,
+)
+from torcheval_tpu.ops.pallas_ustat import (
+    _BIG,
+    multiclass_auprc_ustat,
+    multiclass_auroc_ustat,
+    rank_hist_counts,
+    rank_sum_counts,
+    ustat_route_cap,
+)
+
+
+def _np_rank_sums(tables, queries):
+    """Oracle: K[r] = Σ_q #{tables[r] ≤ queries[r, q]} (sorted tables)."""
+    return np.array(
+        [
+            np.searchsorted(np.asarray(t), np.asarray(q), side="right").sum()
+            for t, q in zip(tables, queries)
+        ],
+        dtype=np.int64,
+    )
+
+
+class TestRankSumCounts(unittest.TestCase):
+    def _check(self, tables, queries, tile=512, msg=""):
+        got = np.asarray(
+            rank_sum_counts(
+                jnp.asarray(queries), jnp.asarray(tables), interpret=True, tile=tile
+            )
+        )
+        want = _np_rank_sums(tables, queries)
+        np.testing.assert_array_equal(got, want, err_msg=msg)
+
+    def test_random_rows(self):
+        rng = np.random.default_rng(0)
+        for r, n, cap in [(3, 300, 16), (8, 512, 32), (11, 200, 48)]:
+            tables = np.sort(
+                rng.normal(size=(r, cap)).astype(np.float32), axis=1
+            )
+            queries = rng.normal(size=(r, n)).astype(np.float32)
+            self._check(tables, queries, msg=f"r={r} n={n} cap={cap}")
+
+    def test_ties_and_pads(self):
+        rng = np.random.default_rng(1)
+        r, n, cap = 8, 256, 32
+        # Quantized values: heavy ties between tables and queries.
+        tables = np.sort(
+            (rng.integers(0, 8, (r, cap)) * 0.125).astype(np.float32), axis=1
+        )
+        tables[:, cap // 2 :] = _BIG  # +BIG pads never count
+        queries = (rng.integers(0, 8, (r, n)) * 0.125).astype(np.float32)
+        self._check(tables, queries, msg="tie grid with pads")
+
+    def test_negated_pass_pads_front(self):
+        # Pass-B layout: -BIG pads sort to the FRONT and count for every
+        # query (the caller's algebra subtracts them).
+        rng = np.random.default_rng(2)
+        r, n, cap = 8, 128, 16
+        tables = np.sort(rng.normal(size=(r, cap)).astype(np.float32), axis=1)
+        tables[:, : cap // 4] = -_BIG
+        queries = rng.normal(size=(r, n)).astype(np.float32)
+        self._check(tables, queries, msg="front pads")
+
+    def test_multi_tile_and_row_padding(self):
+        rng = np.random.default_rng(3)
+        r, n, cap = 5, 300, 16  # r % 8 != 0, n % tile != 0
+        tables = np.sort(rng.normal(size=(r, cap)).astype(np.float32), axis=1)
+        queries = rng.normal(size=(r, n)).astype(np.float32)
+        self._check(tables, queries, tile=128, msg="tile=128 multi-step")
+
+    def test_extreme_values(self):
+        tables = np.sort(
+            np.array([[-1e30, -5.0, 0.0, 0.0, 2.5, 1e30] + [_BIG] * 10]),
+            axis=1,
+        ).astype(np.float32)
+        queries = np.array(
+            [[-2e30, -1e30, -5.0, -1e-30, 0.0, 2.5, 2.5000002, 1e30, 2.9e38]]
+        ).astype(np.float32)
+        self._check(tables, queries, msg="extremes")
+
+
+class TestRankHistCounts(unittest.TestCase):
+    def _check(self, tables, queries, tile=512, msg=""):
+        got = np.asarray(
+            rank_hist_counts(
+                jnp.asarray(queries), jnp.asarray(tables), interpret=True, tile=tile
+            )
+        )
+        want = np.zeros_like(got)
+        for r, (t, q) in enumerate(zip(tables, queries)):
+            bins = np.searchsorted(np.asarray(t), np.asarray(q), side="right") - 1
+            for b in bins[bins >= 0]:
+                want[r, b] += 1
+        np.testing.assert_array_equal(got, want, err_msg=msg)
+
+    def test_random_rows(self):
+        rng = np.random.default_rng(8)
+        for r, n, cap in [(3, 300, 16), (9, 512, 32)]:
+            tables = np.sort(
+                rng.normal(size=(r, cap)).astype(np.float32), axis=1
+            )
+            queries = rng.normal(size=(r, n)).astype(np.float32)
+            self._check(tables, queries, msg=f"r={r} n={n} cap={cap}")
+
+    def test_ties_pads_multi_tile(self):
+        rng = np.random.default_rng(9)
+        r, n, cap = 8, 300, 32
+        tables = np.sort(
+            (rng.integers(0, 6, (r, cap)) * 0.125).astype(np.float32), axis=1
+        )
+        tables[:, cap // 2 :] = _BIG
+        queries = (rng.integers(0, 6, (r, n)) * 0.125).astype(np.float32)
+        self._check(tables, queries, tile=128, msg="ties + pads + tiles")
+
+
+class TestMulticlassUstatAUPRC(unittest.TestCase):
+    def _ustat(self, scores, target, c, average="macro", cap=16):
+        most = int(np.bincount(target, minlength=c).max())
+        while cap < most:
+            cap *= 2
+        return multiclass_auprc_ustat(
+            jnp.asarray(scores),
+            jnp.asarray(target),
+            num_classes=c,
+            average=average,
+            cap=cap,
+            interpret=True,
+            tile=512,
+        )
+
+    def test_vs_sort_path_and_sklearn(self):
+        from sklearn.metrics import average_precision_score
+
+        rng = np.random.default_rng(10)
+        n, c = 512, 8
+        scores = rng.random((n, c)).astype(np.float32)
+        target = rng.integers(0, c, n)
+        got = np.asarray(self._ustat(scores, target, c, average=None))
+        want_sort = np.asarray(
+            _multiclass_auprc_compute_kernel(
+                jnp.asarray(scores), jnp.asarray(target), c, None
+            )
+        )
+        np.testing.assert_allclose(got, want_sort, rtol=1e-6, atol=1e-6)
+        want_sk = [
+            average_precision_score(target == k, scores[:, k]) for k in range(c)
+        ]
+        np.testing.assert_allclose(got, want_sk, rtol=1e-5, atol=1e-5)
+
+    def test_heavy_ties_and_absent_class(self):
+        rng = np.random.default_rng(11)
+        n, c = 384, 6
+        scores = (rng.integers(0, 5, (n, c)) * 0.25).astype(np.float32)
+        target = rng.integers(1, 4, n)  # classes 0, 4, 5 absent
+        got = np.asarray(self._ustat(scores, target, c, average=None))
+        want = np.asarray(
+            _multiclass_auprc_compute_kernel(
+                jnp.asarray(scores), jnp.asarray(target), c, None
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+        for k in (0, 4, 5):  # zero-positive classes keep the 0 convention
+            self.assertEqual(got[k], 0.0)
+
+
+class TestMulticlassUstatAUROC(unittest.TestCase):
+    def _ustat(self, scores, target, c, average="macro", cap=None):
+        if cap is None:
+            cap = 16
+            most = int(np.bincount(target, minlength=c).max())
+            while cap < most:
+                cap *= 2
+        return multiclass_auroc_ustat(
+            jnp.asarray(scores),
+            jnp.asarray(target),
+            num_classes=c,
+            average=average,
+            cap=cap,
+            interpret=True,
+            tile=512,
+        )
+
+    def test_vs_sort_path_and_sklearn(self):
+        rng = np.random.default_rng(4)
+        n, c = 512, 8
+        scores = rng.random((n, c)).astype(np.float32)
+        target = rng.integers(0, c, n)
+        got = np.asarray(self._ustat(scores, target, c, average=None))
+        want_sort = np.asarray(
+            _multiclass_auroc_compute_kernel(
+                jnp.asarray(scores), jnp.asarray(target), c, None
+            )
+        )
+        np.testing.assert_allclose(got, want_sort, rtol=1e-6, atol=1e-6)
+        want_sk = [
+            roc_auc_score(target == k, scores[:, k]) for k in range(c)
+        ]
+        np.testing.assert_allclose(got, want_sk, rtol=1e-5, atol=1e-5)
+        macro = float(self._ustat(scores, target, c, average="macro"))
+        self.assertAlmostEqual(macro, float(np.mean(want_sk)), places=5)
+
+    def test_heavy_ties(self):
+        rng = np.random.default_rng(5)
+        n, c = 384, 6
+        scores = (rng.integers(0, 5, (n, c)) * 0.25).astype(np.float32)
+        target = rng.integers(0, c, n)
+        got = np.asarray(self._ustat(scores, target, c, average=None))
+        want = [roc_auc_score(target == k, scores[:, k]) for k in range(c)]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_absent_class_and_skew(self):
+        rng = np.random.default_rng(6)
+        n, c = 256, 5
+        scores = rng.random((n, c)).astype(np.float32)
+        target = rng.integers(1, 3, n)  # classes 0, 3, 4 absent
+        got = np.asarray(self._ustat(scores, target, c, average=None))
+        want_sort = np.asarray(
+            _multiclass_auroc_compute_kernel(
+                jnp.asarray(scores), jnp.asarray(target), c, None
+            )
+        )
+        np.testing.assert_allclose(got, want_sort, rtol=1e-6, atol=1e-6)
+        for k in (0, 3, 4):  # degenerate classes keep the 0.5 convention
+            self.assertEqual(got[k], 0.5)
+
+    def test_single_sample_per_class(self):
+        scores = np.eye(4, dtype=np.float32) * 0.9 + 0.05
+        target = np.arange(4)
+        got = np.asarray(self._ustat(scores, target, 4, average=None))
+        np.testing.assert_allclose(got, np.ones(4), rtol=1e-6)
+
+    def test_route_is_off_on_cpu(self):
+        rng = np.random.default_rng(7)
+        scores = jnp.asarray(rng.random((64, 4)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 4, 64))
+        self.assertIsNone(ustat_route_cap(scores, target, 4))
+
+
+if __name__ == "__main__":
+    unittest.main()

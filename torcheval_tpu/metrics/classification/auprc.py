@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
 from torcheval_tpu.metrics.functional.classification.auprc import (
     _binary_auprc_compute_kernel,
-    _multiclass_auprc_compute_kernel,
+    _multiclass_auprc_compute,
     _multiclass_auprc_param_check,
     _multilabel_auprc_compute_kernel,
     _multilabel_auprc_param_check,
@@ -101,7 +101,7 @@ class MulticlassAUPRC(Metric[jax.Array]):
                 if self.average == "macro"
                 else jnp.zeros(self.num_classes)
             )
-        return _multiclass_auprc_compute_kernel(
+        return _multiclass_auprc_compute(
             input,
             jnp.concatenate(self.targets, axis=0),
             self.num_classes,

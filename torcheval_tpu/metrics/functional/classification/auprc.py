@@ -65,6 +65,29 @@ def multiclass_auprc(
     _multiclass_auroc_update_input_check(input, target, num_classes)
     if input.shape[0] == 0:
         return jnp.zeros(()) if average == "macro" else jnp.zeros(num_classes)
+    return _multiclass_auprc_compute(input, target, num_classes, average)
+
+
+def _multiclass_auprc_compute(
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    average: Optional[str],
+) -> jax.Array:
+    # Sort-free rank-histogram fast path (ops/pallas_ustat.py): sparse
+    # one-vs-rest positives make step-sum AP a per-entry count against a
+    # tiny packed table instead of a (C, N) variadic sort.  Same call-time
+    # route as the AUROC fast path, plus the kernel's N < 2^24 bound.
+    if input.shape[0] < 2**24:
+        from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
+
+        cap = ustat_route_cap(input, target, num_classes)
+        if cap is not None:
+            from torcheval_tpu.ops.pallas_ustat import multiclass_auprc_ustat
+
+            return multiclass_auprc_ustat(
+                input, target, num_classes=num_classes, average=average, cap=cap
+            )
     return _multiclass_auprc_compute_kernel(input, target, num_classes, average)
 
 

@@ -134,12 +134,31 @@ def _multiclass_auroc_compute(
     target: jax.Array,
     num_classes: int,
     average: Optional[str] = "macro",
+    ustat_cap: Optional[int] = None,
 ) -> jax.Array:
     if input.shape[0] == 0:
         # Degenerate (no samples) → 0.5 per class, matching the kernel's
         # no-positives/no-negatives convention.
         degenerate = jnp.full(num_classes, 0.5, dtype=jnp.float32)
         return degenerate.mean() if average == "macro" else degenerate
+    # Sort-free rank-sum fast path: one-vs-rest positives are sparse, so
+    # exact AUROC is a pair count against a tiny per-class table instead
+    # of a (C, N) variadic sort (ops/pallas_ustat.py) — a large win in the
+    # small-cap region, e.g. the (2^17, 1000) device-step headline where
+    # per-class tables are ~256 entries.  Route selection is call-time and
+    # eager (bigger caps keep the sort path — see ustat_route_cap's win
+    # region); pass ustat_cap to reuse a decision made on the same data
+    # (the sharded gather-exact path does, to stay bitwise-consistent).
+    if ustat_cap is None:
+        from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
+
+        ustat_cap = ustat_route_cap(input, target, num_classes)
+    if ustat_cap is not None:
+        from torcheval_tpu.ops.pallas_ustat import multiclass_auroc_ustat
+
+        return multiclass_auroc_ustat(
+            input, target, num_classes=num_classes, average=average, cap=ustat_cap
+        )
     if _use_pallas(input.shape[0]):
         return _multiclass_auroc_pallas_kernel(input, target, num_classes, average)
     return _multiclass_auroc_compute_kernel(input, target, num_classes, average)
