@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Device-clock comparison of the exact multiclass AUROC/AUPRC
+formulations at the BASELINE north-star shape (run ON the chip from the
+repo root: ``python scripts/measure_ustat.py [N] [C]``).
+
+Prints one JSON line per formulation with the fori_loop differencing
+clock (benchmarks.workloads._device_seconds).  NEVER timeout-kill this
+process (axon tunnel)."""
+
+import json
+import sys
+
+sys.path.insert(0, ".")
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    from benchmarks.workloads import _device_seconds
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 2**17
+    c = int(sys.argv[2]) if len(sys.argv) > 2 else 1000
+    rng = np.random.default_rng(0)
+    scores = jnp.asarray(rng.random((n, c)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+    print(f"backend={jax.default_backend()} shape=({n}, {c})", file=sys.stderr)
+
+    from torcheval_tpu.metrics.functional.classification.auprc import (
+        _multiclass_auprc_compute_kernel,
+    )
+    from torcheval_tpu.metrics.functional.classification.auroc import (
+        _multiclass_auroc_compute_kernel,
+        _multiclass_auroc_pallas_kernel,
+    )
+    from torcheval_tpu.ops.pallas_ustat import (
+        multiclass_auprc_ustat,
+        multiclass_auroc_ustat,
+        ustat_route_cap,
+    )
+
+    cap = ustat_route_cap(scores, target, c)
+    print(f"route cap: {cap}", file=sys.stderr)
+
+    def clock(name, fn):
+        sec = _device_seconds(fn, (scores, target))
+        print(
+            json.dumps(
+                {
+                    "kernel": name,
+                    "device_ms": round(sec * 1e3, 3),
+                    "samples_per_s": round(n / sec, 1),
+                }
+            ),
+            flush=True,
+        )
+        return sec
+
+    def perturb(s, i):
+        return s + i * jnp.float32(1e-38)
+
+    base = clock(
+        "auroc_sort_xla",
+        lambda s, t, i: _multiclass_auroc_compute_kernel(perturb(s, i), t, c, "macro"),
+    )
+    pall = clock(
+        "auroc_sort_pallas_scan",
+        lambda s, t, i: _multiclass_auroc_pallas_kernel(perturb(s, i), t, c, "macro"),
+    )
+    if cap is not None:
+        ust = clock(
+            "auroc_ustat",
+            lambda s, t, i: multiclass_auroc_ustat(
+                perturb(s, i), t, num_classes=c, average="macro", cap=cap
+            ),
+        )
+        print(
+            json.dumps(
+                {
+                    "speedup_vs_sort_xla": round(base / ust, 2),
+                    "speedup_vs_sort_pallas": round(pall / ust, 2),
+                }
+            ),
+            flush=True,
+        )
+    ap_base = clock(
+        "auprc_sort_xla",
+        lambda s, t, i: _multiclass_auprc_compute_kernel(perturb(s, i), t, c, "macro"),
+    )
+    if cap is not None:
+        ap_ust = clock(
+            "auprc_ustat",
+            lambda s, t, i: multiclass_auprc_ustat(
+                perturb(s, i), t, num_classes=c, average="macro", cap=cap
+            ),
+        )
+        print(
+            json.dumps({"ap_speedup_vs_sort": round(ap_base / ap_ust, 2)}),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

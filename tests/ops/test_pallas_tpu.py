@@ -227,6 +227,43 @@ class TestCompiledRankSum(unittest.TestCase):
         )
         np.testing.assert_allclose(got, want, rtol=2e-6, atol=2e-6)
 
+    def test_binary_rare_class_compiled(self):
+        from torcheval_tpu.metrics.functional.classification.auprc import (
+            _binary_auprc_compute_kernel,
+        )
+        from torcheval_tpu.metrics.functional.classification.auroc import (
+            _binary_auroc_compute_kernel,
+        )
+        from torcheval_tpu.ops.pallas_ustat import (
+            binary_auprc_ustat,
+            binary_auroc_ustat,
+        )
+
+        rng = np.random.default_rng(25)
+        r, n = 4, 2**17
+        scores = (rng.integers(0, 4096, (r, n)) / 4096).astype(np.float32)
+        target = (rng.random((r, n)) < 0.002).astype(np.int32)
+        auc = np.asarray(
+            binary_auroc_ustat(
+                jnp.asarray(scores), jnp.asarray(target), cap=512,
+                interpret=False,
+            )
+        )
+        want = np.asarray(
+            _binary_auroc_compute_kernel(jnp.asarray(scores), jnp.asarray(target))
+        )
+        np.testing.assert_allclose(auc, want, rtol=2e-6, atol=2e-6)
+        ap = np.asarray(
+            binary_auprc_ustat(
+                jnp.asarray(scores), jnp.asarray(target), cap=512,
+                interpret=False,
+            )
+        )
+        want_ap = np.asarray(
+            _binary_auprc_compute_kernel(jnp.asarray(scores), jnp.asarray(target))
+        )
+        np.testing.assert_allclose(ap, want_ap, rtol=2e-6, atol=2e-6)
+
     def test_route_cap_on_tpu(self):
         import os
         from unittest import mock

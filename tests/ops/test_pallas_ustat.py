@@ -17,6 +17,9 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
 )
 from torcheval_tpu.ops.pallas_ustat import (
     _BIG,
+    binary_auprc_ustat,
+    binary_auroc_ustat,
+    binary_ustat_route,
     multiclass_auprc_ustat,
     multiclass_auroc_ustat,
     rank_hist_counts,
@@ -243,6 +246,87 @@ class TestMulticlassUstatAUROC(unittest.TestCase):
         target = np.arange(4)
         got = np.asarray(self._ustat(scores, target, 4, average=None))
         np.testing.assert_allclose(got, np.ones(4), rtol=1e-6)
+
+    def test_binary_rare_class_rows(self):
+        from sklearn.metrics import average_precision_score, roc_auc_score
+
+        from torcheval_tpu.metrics.functional.classification.auprc import (
+            _binary_auprc_compute_kernel,
+        )
+        from torcheval_tpu.metrics.functional.classification.auroc import (
+            _binary_auroc_compute_kernel,
+        )
+
+        rng = np.random.default_rng(12)
+        r, n = 5, 600
+        scores = (rng.integers(0, 64, (r, n)) / 64).astype(np.float32)
+        target = (rng.random((r, n)) < 0.05).astype(np.int32)  # rare pos
+        for side in ("pos", "neg"):
+            t = target if side == "pos" else 1 - target
+            got = np.asarray(
+                binary_auroc_ustat(
+                    jnp.asarray(scores),
+                    jnp.asarray(t),
+                    cap=64,
+                    table_side=side,
+                    interpret=True,
+                    tile=1024,
+                )
+            )
+            want = np.asarray(
+                _binary_auroc_compute_kernel(jnp.asarray(scores), jnp.asarray(t))
+            )
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+            sk = [roc_auc_score(t[i], scores[i]) for i in range(r)]
+            np.testing.assert_allclose(got, sk, rtol=1e-5, atol=1e-5)
+
+        ap = np.asarray(
+            binary_auprc_ustat(
+                jnp.asarray(scores),
+                jnp.asarray(target),
+                cap=64,
+                interpret=True,
+                tile=1024,
+            )
+        )
+        want_ap = np.asarray(
+            _binary_auprc_compute_kernel(jnp.asarray(scores), jnp.asarray(target))
+        )
+        np.testing.assert_allclose(ap, want_ap, rtol=1e-6, atol=1e-6)
+        sk_ap = [average_precision_score(target[i], scores[i]) for i in range(r)]
+        np.testing.assert_allclose(ap, sk_ap, rtol=1e-5, atol=1e-5)
+
+    def test_binary_degenerate_rows(self):
+        # Rows with no positives (or no negatives) keep the 0.5 / 0 / 1
+        # conventions of the sort kernels.
+        scores = np.tile(np.linspace(0, 1, 32, dtype=np.float32), (3, 1))
+        target = np.stack(
+            [np.zeros(32), np.ones(32), (np.arange(32) == 31).astype(float)]
+        ).astype(np.int32)
+        auc = np.asarray(
+            binary_auroc_ustat(
+                jnp.asarray(scores), jnp.asarray(target), cap=32,
+                interpret=True, tile=256,
+            )
+        )
+        self.assertEqual(auc[0], 0.5)  # no positives
+        self.assertEqual(auc[1], 0.5)  # no negatives
+        self.assertEqual(auc[2], 1.0)  # single top-scored positive
+        ap = np.asarray(
+            binary_auprc_ustat(
+                jnp.asarray(scores), jnp.asarray(target), cap=32,
+                interpret=True, tile=256,
+            )
+        )
+        self.assertEqual(ap[0], 0.0)
+        self.assertEqual(ap[1], 1.0)
+        self.assertEqual(ap[2], 1.0)
+
+    def test_binary_route_off_on_cpu(self):
+        rng = np.random.default_rng(13)
+        scores = jnp.asarray(rng.random((2, 2**15)).astype(np.float32))
+        target = jnp.asarray((rng.random((2, 2**15)) < 0.01).astype(np.int32))
+        self.assertIsNone(binary_ustat_route(scores, target))
 
     def test_route_is_off_on_cpu(self):
         rng = np.random.default_rng(7)

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
 from torcheval_tpu.metrics.functional.classification.auprc import (
-    _binary_auprc_compute_kernel,
+    _binary_auprc_compute,
     _multiclass_auprc_compute,
     _multiclass_auprc_param_check,
     _multilabel_auprc_compute_kernel,
@@ -53,7 +53,7 @@ class BinaryAUPRC(Metric[jax.Array]):
         input = jnp.concatenate(self.inputs, axis=-1)
         if input.shape[-1] == 0:  # only zero-length updates buffered
             return jnp.zeros(input.shape[:-1])
-        return _binary_auprc_compute_kernel(
+        return _binary_auprc_compute(
             input, jnp.concatenate(self.targets, axis=-1)
         )
 

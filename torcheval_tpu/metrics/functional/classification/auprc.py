@@ -46,6 +46,32 @@ def binary_auprc(
     _binary_auroc_update_input_check(input, target, num_tasks)
     if input.shape[-1] == 0:
         return jnp.zeros(input.shape[:-1])
+    return _binary_auprc_compute(input, target)
+
+
+def _binary_auprc_compute(
+    input: jax.Array, target: jax.Array, ustat_route="auto"
+) -> jax.Array:
+    # Rare-positive fast path: step-sum AP against the packed positive
+    # table instead of a row sort (ops/pallas_ustat.py; AP is
+    # positive-anchored, so only that side packs).  ustat_route as in
+    # _binary_auroc_compute: "auto" decides here, None forces the sort
+    # path, a (side, cap) pair reuses a decision made on the same data.
+    if input.shape[-1] < 2**24:
+        from torcheval_tpu.ops.pallas_ustat import (
+            binary_auprc_ustat,
+            binary_ustat_route,
+        )
+
+        squeeze = input.ndim == 1
+        rows = input[None] if squeeze else input
+        t_rows = target[None] if squeeze else target
+        if ustat_route == "auto":
+            ustat_route = binary_ustat_route(rows, t_rows, need_pos=True)
+        if ustat_route is not None:
+            _, cap = ustat_route
+            ap = binary_auprc_ustat(rows, t_rows.astype(jnp.int32), cap=cap)
+            return ap[0] if squeeze else ap
     return _binary_auprc_compute_kernel(input, target)
 
 

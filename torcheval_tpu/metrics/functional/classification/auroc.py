@@ -95,6 +95,7 @@ def _binary_auroc_compute(
     input: jax.Array,
     target: jax.Array,
     use_fused: Optional[bool] = False,
+    ustat_route="auto",
 ) -> jax.Array:
     if input.shape[-1] == 0:
         # Degenerate (no samples) → 0.5, the same convention the kernel
@@ -102,6 +103,28 @@ def _binary_auroc_compute(
         return jnp.full(input.shape[:-1], 0.5, dtype=jnp.float32)
     if use_fused:
         return fused_auc(input, target)
+    # Sort-free rank-sum fast path for rare-class rows (ops/pallas_ustat):
+    # when one class's per-row count is tiny, exact AUROC is a pair count
+    # against the packed rare-side table instead of a row sort.  Pass
+    # ustat_route to reuse a decision made on the same data (the sharded
+    # gather-exact wrappers do, to stay bitwise-consistent); "auto"
+    # decides here, None forces the sort path.
+    from torcheval_tpu.ops.pallas_ustat import (
+        binary_auroc_ustat,
+        binary_ustat_route,
+    )
+
+    squeeze = input.ndim == 1
+    rows = input[None] if squeeze else input
+    t_rows = target[None] if squeeze else target
+    if ustat_route == "auto":
+        ustat_route = binary_ustat_route(rows, t_rows)
+    if ustat_route is not None:
+        side, cap = ustat_route
+        auc = binary_auroc_ustat(
+            rows, t_rows.astype(jnp.int32), cap=cap, table_side=side
+        )
+        return auc[0] if squeeze else auc
     if _use_pallas(input.shape[-1]):
         from torcheval_tpu.ops.pallas_auc import pallas_binary_auroc
 

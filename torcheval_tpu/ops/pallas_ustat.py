@@ -378,28 +378,8 @@ def multiclass_auprc_ustat(
     route as :func:`multiclass_auroc_ustat`, plus N < 2^24."""
     s = scores.astype(jnp.float32)
     counts, table = _pack_positive_tables(s, target, num_classes, cap)
-
     hist = rank_hist_counts(s.T, table, interpret=interpret, tile=tile)
-    num_ge = _suffix_cumsum(hist)  # (C, cap): #{q ≥ t_v} per entry
-
-    idx = jnp.arange(cap, dtype=jnp.int32)[None, :]
-    is_new = jnp.concatenate(
-        [
-            jnp.ones((num_classes, 1), bool),
-            table[:, 1:] != table[:, :-1],
-        ],
-        axis=1,
-    )
-    first_idx = lax.cummax(jnp.where(is_new, idx, -1), axis=1)
-    tp = counts[:, None] - first_idx  # TP(≥t_v); dupes share the group's
-    real = idx < counts[:, None]
-    precision = jnp.where(
-        real,
-        tp.astype(jnp.float32) / jnp.maximum(num_ge, 1).astype(jnp.float32),
-        0.0,
-    )
-    ap = precision.sum(axis=1) / jnp.maximum(counts, 1).astype(jnp.float32)
-    ap = jnp.where(counts == 0, 0.0, ap)
+    ap = _ap_from_hist(table, counts, hist)
     return ap.mean() if average == "macro" else ap
 
 
@@ -420,36 +400,228 @@ def multiclass_auroc_ustat(
     (see module docstring).  ``cap`` must be ≥ the largest per-class count
     (the route computes it; overflow cannot occur when it does) and scores
     must satisfy |s| < 3.0e38."""
-    n = scores.shape[0]
+    s = scores.astype(jnp.float32)
+    counts, sorted_pack = _pack_positive_tables(s, target, num_classes, cap)
+    auroc = _auroc_from_rank_sums(
+        s.T, sorted_pack, counts, interpret=interpret, tile=tile
+    )
+    return auroc.mean() if average == "macro" else auroc
+
+
+def _auroc_from_rank_sums(
+    queries: jax.Array,
+    table: jax.Array,
+    counts: jax.Array,
+    *,
+    interpret: bool,
+    tile: int,
+) -> jax.Array:
+    """The exactness-critical U-statistic core shared by the multiclass
+    and binary kernels: two rank-sum passes (the strict pass reuses the
+    same sort — the negated reversal is the ascending order of ``-table``
+    bitwise, since scores are finite and f32 negation is exact), then
+
+        2U = 2nN − K_A − N·cap + K_B − n²
+
+    in int32 (exact: the callers bound ``cap·N < 2^29`` and ``n ≤ cap``),
+    returning ``U/(n(N−n))`` with the degenerate-row 0.5 convention."""
+    n = queries.shape[1]
+    cap = table.shape[1]
     if cap * n >= 2**29:
-        # The int32 rank sums and the 2U algebra are exact only below
-        # this; past it the result would silently wrap (the route never
-        # picks such shapes — direct callers get the error instead).
+        # Past this the int32 algebra would silently wrap (the routes
+        # never pick such shapes — direct callers get the error instead).
         raise ValueError(
             f"cap·N = {cap * n} exceeds the exact-int32 bound 2^29; "
             "use the sort path for this shape"
         )
-    s = scores.astype(jnp.float32)
-    counts, sorted_pack = _pack_positive_tables(s, target, num_classes, cap)
-
-    queries = s.T  # (C, N)
-    k_a = rank_sum_counts(queries, sorted_pack, interpret=interpret, tile=tile)
-    # The strict pass reuses the same sort: the negated reversal is the
-    # ascending order of -pack bitwise (finite scores; f32 negation exact).
+    k_a = rank_sum_counts(queries, table, interpret=interpret, tile=tile)
     k_b = rank_sum_counts(
-        -queries, -sorted_pack[:, ::-1], interpret=interpret, tile=tile
+        -queries, -table[:, ::-1], interpret=interpret, tile=tile
     )
-
-    # 2U = 2nN − K_A − N·cap + K_B − n²  (all int32; the route bounds
-    # N·cap < 2^29 and n ≤ cap so every term fits).
     two_u = 2 * counts * n - k_a - n * cap + k_b - counts * counts
     factor = counts.astype(jnp.float32) * jnp.float32(n) - jnp.square(
         counts.astype(jnp.float32)
     )
-    auroc = jnp.where(
+    return jnp.where(
         factor == 0, jnp.float32(0.5), two_u.astype(jnp.float32) / (2.0 * factor)
     )
-    return auroc.mean() if average == "macro" else auroc
+
+
+def _ap_from_hist(
+    table: jax.Array, counts: jax.Array, hist: jax.Array
+) -> jax.Array:
+    """Step-sum AP rows from a per-entry rank histogram: ``num_ge`` by
+    suffix sums, ``TP`` positionally from the ascending table (group-first
+    indices handle ties), summed precisions divided by the positive count
+    (``auprc.py:_auprc_rows`` semantics; zero positives → 0)."""
+    cap = table.shape[1]
+    num_ge = _suffix_cumsum(hist)
+    idx = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    is_new = jnp.concatenate(
+        [jnp.ones((table.shape[0], 1), bool), table[:, 1:] != table[:, :-1]],
+        axis=1,
+    )
+    first_idx = lax.cummax(jnp.where(is_new, idx, -1), axis=1)
+    tp = counts[:, None] - first_idx
+    real = idx < counts[:, None]
+    precision = jnp.where(
+        real,
+        tp.astype(jnp.float32) / jnp.maximum(num_ge, 1).astype(jnp.float32),
+        0.0,
+    )
+    ap = precision.sum(axis=1) / jnp.maximum(counts, 1).astype(jnp.float32)
+    return jnp.where(counts == 0, 0.0, ap)
+
+
+def _pack_row_tables(
+    scores: jax.Array, hits: jax.Array, cap: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-row ascending tables of the hit-flagged scores, without any
+    (R, N) sort: a row-wise cumsum gives each hit its occupancy slot, one
+    scatter drops the rest, and a tiny (R, cap) row sort orders the pack
+    (+BIG pads last).  Returns ``(counts (R,), table (R, cap))``."""
+    r, n = scores.shape
+    counts = jnp.sum(hits, axis=1, dtype=jnp.int32)
+    occ = jnp.cumsum(hits, axis=1, dtype=jnp.int32) - 1
+    rows = jnp.broadcast_to(jnp.arange(r, dtype=jnp.int32)[:, None], (r, n))
+    col = jnp.where(hits, occ, cap)  # non-hits land out of bounds: dropped
+    pack = (
+        jnp.full((r, cap), _BIG, jnp.float32)
+        .at[rows, col]
+        .set(scores, mode="drop")
+    )
+    return counts, jnp.sort(pack, axis=1)
+
+
+@partial(jax.jit, static_argnames=("cap", "table_side", "interpret", "tile"))
+def binary_auroc_ustat(
+    scores: jax.Array,
+    target: jax.Array,
+    *,
+    cap: int,
+    table_side: str = "pos",
+    interpret: bool = False,
+    tile: int = _TILE,
+) -> jax.Array:
+    """Exact per-row binary AUROC from ``(R, N)`` scores/0-1 targets
+    without the row sort — the rare-class regime (e.g. fraud/CTR labels),
+    where the packed table of the rare side has ``cap ≪ N`` entries.
+    ``table_side="neg"`` packs the negatives instead and returns
+    ``1 − U/(n·m)`` (the mirror identity) for rare-negative data.
+    Same preconditions as :func:`multiclass_auroc_ustat`; targets must be
+    0/1 (the route checks)."""
+    s = scores.astype(jnp.float32)
+    hits = (target != 0) if table_side == "pos" else (target == 0)
+    counts, table = _pack_row_tables(s, hits, cap)
+    u_frac = _auroc_from_rank_sums(
+        s, table, counts, interpret=interpret, tile=tile
+    )
+    # _auroc_from_rank_sums already yields 0.5 for degenerate rows, which
+    # the mirror identity maps to itself.
+    return u_frac if table_side == "pos" else 1.0 - u_frac
+
+
+@partial(jax.jit, static_argnames=("cap", "interpret", "tile"))
+def binary_auprc_ustat(
+    scores: jax.Array,
+    target: jax.Array,
+    *,
+    cap: int,
+    interpret: bool = False,
+    tile: int = _TILE,
+) -> jax.Array:
+    """Exact per-row step-sum average precision from ``(R, N)`` scores /
+    0-1 targets without the row sort (rare-positive regime; AP is
+    positive-anchored, so only the positive side packs).  Same
+    preconditions as :func:`multiclass_auprc_ustat`."""
+    s = scores.astype(jnp.float32)
+    counts, table = _pack_row_tables(s, target == 1, cap)
+    hist = rank_hist_counts(s, table, interpret=interpret, tile=tile)
+    return _ap_from_hist(table, counts, hist)
+
+
+def _route_guards_ok(scores, target) -> bool:
+    """Shared call-time gate for every ustat route: TPU backend, the
+    pallas kill-switch (read per call), concrete values, and single-device
+    placement.  Mesh-sharded buffers keep the XLA sort path: a pallas_call
+    under plain jit has no partitioning rule, so routing would make GSPMD
+    replicate the full scores onto every device — destroying the O(N/P)
+    per-device distributed-sort economics.  The sharded gather-exact
+    wrappers make the SAME route call on the same arrays, so their
+    replicated kernels and the eager oracle always pick the same
+    formulation (the bitwise contract), single- or multi-device."""
+    from torcheval_tpu.metrics.functional._host_checks import all_concrete
+    from torcheval_tpu.ops._flags import pallas_disabled
+
+    if pallas_disabled() or jax.default_backend() != "tpu":
+        return False
+    if not all_concrete(scores, target):
+        return False
+    sharding = getattr(scores, "sharding", None)
+    return sharding is None or len(sharding.device_set) <= 1
+
+
+def _win_cap(most: float, n: int) -> Optional[int]:
+    """Bucket a measured max class count to the static table capacity iff
+    the (cap, N) point sits in the measured win region.  Per-query kernel
+    cost is ~2·(cap/16 + 16) VPU ops per pass, versus the sort's
+    ~6·log2(N) serial bitonic stages — the fast path wins when the table
+    is small relative to N (at the (2^17, 1000) device-step headline,
+    cap = 256: ~10x; by cap = 2048 at 2^20 samples the coarse stage alone
+    cancels the win, so the 8-update class-lifecycle compute stays on the
+    sort path by design).  cap·N < 2^29 additionally keeps the int32 rank
+    sums exact.  ONE definition serves the binary and multiclass routes so
+    retunes cannot drift them apart."""
+    cap = _FW
+    while cap < most:
+        cap *= 2
+    if cap > 512 or n < 2**15 or cap > n // 128 or cap * n >= 2**29:
+        return None
+    return cap
+
+
+def binary_ustat_route(
+    scores: jax.Array, target: jax.Array, *, need_pos: bool = False
+) -> Optional[Tuple[str, int]]:
+    """Call-time fast-path decision for the binary (R, N) kernels: returns
+    ``(table_side, cap)`` or None.  Shares :func:`ustat_route_cap`'s
+    guards and win region; additionally requires exactly-0/1 targets (the
+    sort kernels weight arbitrary target values, the pack cannot) and,
+    with ``need_pos`` (AP), only packs the positive side."""
+    if scores.ndim != 2 or not _route_guards_ok(scores, target):
+        return None
+    stats = _binary_route_stats(scores, target)
+    lo, hi, t_lo, t_hi, max_pos, max_neg = (float(x) for x in stats)
+    if not (lo > -_BIG and hi < _BIG):
+        return None
+    if not (t_lo in (0.0, 1.0) and t_hi in (0.0, 1.0)):
+        return None
+    n = scores.shape[1]
+    for side, most in (("pos", max_pos), ("neg", max_neg)):
+        if need_pos and side != "pos":
+            continue
+        cap = _win_cap(most, n)
+        if cap is not None:
+            return side, cap
+    return None
+
+
+@jax.jit
+def _binary_route_stats(scores, target) -> jax.Array:
+    """Score bounds, target bounds, and per-row class-count maxima in ONE
+    fused round trip."""
+    pos = jnp.sum(target != 0, axis=-1, dtype=jnp.int32)
+    neg = scores.shape[-1] - pos
+    return jnp.stack(
+        [
+            jnp.min(scores).astype(jnp.float32),
+            jnp.max(scores).astype(jnp.float32),
+            jnp.min(target).astype(jnp.float32),
+            jnp.max(target).astype(jnp.float32),
+            pos.max().astype(jnp.float32),
+            neg.max().astype(jnp.float32),
+        ]
+    )
 
 
 def ustat_route_cap(
@@ -460,41 +632,13 @@ def ustat_route_cap(
     call).  Returns the static table capacity, or None to keep the sort
     path — on CPU, under tracing, for non-finite/huge scores, for
     class-skewed data where the pack would be as big as a sort, and
-    beyond the int32 count bounds."""
-    from torcheval_tpu.metrics.functional._host_checks import all_concrete
-    from torcheval_tpu.ops._flags import pallas_disabled
-
-    if pallas_disabled() or jax.default_backend() != "tpu":
-        return None
-    if not all_concrete(scores, target) or scores.shape[0] == 0:
-        return None
-    # Mesh-sharded buffers keep the XLA sort path: a pallas_call under
-    # plain jit has no partitioning rule, so routing here would make GSPMD
-    # replicate the full (N, C) scores onto every device — destroying the
-    # O(N/P) per-device distributed-sort economics.  The sharded
-    # gather-exact wrapper makes the SAME call on the same arrays, so its
-    # replicated kernel and the eager oracle always pick the same
-    # formulation (the bitwise contract), single- or multi-device.
-    sharding = getattr(scores, "sharding", None)
-    if sharding is not None and len(sharding.device_set) > 1:
+    beyond the int32 count bounds (see :func:`_win_cap`)."""
+    if scores.shape[0] == 0 or not _route_guards_ok(scores, target):
         return None
     lo, hi, max_count = (float(x) for x in _route_stats(scores, target))
     if not (lo > -_BIG and hi < _BIG):  # non-finite or past the sentinel
         return None
-    cap = _FW
-    while cap < max_count:
-        cap *= 2
-    n = scores.shape[0]
-    # Win region: per-query kernel cost is ~2·(cap/16 + 16) VPU ops per
-    # pass, versus the sort's ~6·log2(N) serial bitonic stages — the fast
-    # path wins when the per-class table is small relative to N (at the
-    # (2^17, 1000) device-step headline, cap = 256: ~10x; by cap = 2048
-    # at 2^20 samples the coarse stage alone cancels the win, so the
-    # 8-update class-lifecycle compute stays on the sort path by design).
-    # cap·N < 2^29 additionally keeps the int32 rank sums exact.
-    if cap > 512 or n < 2**15 or cap > n // 128 or cap * n >= 2**29:
-        return None
-    return cap
+    return _win_cap(max_count, scores.shape[0])
 
 
 @jax.jit
@@ -518,5 +662,8 @@ __all__: Tuple[str, ...] = (
     "rank_hist_counts",
     "multiclass_auroc_ustat",
     "multiclass_auprc_ustat",
+    "binary_auroc_ustat",
+    "binary_auprc_ustat",
+    "binary_ustat_route",
     "ustat_route_cap",
 )

@@ -104,9 +104,18 @@ def sharded_multitask_auroc_exact(
     from torcheval_tpu.metrics.functional.classification.auroc import (
         _binary_auroc_compute,
     )
+    from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
 
     _check_even_tasks(scores, targets, mesh, axis)
-    return _gather_exact(_binary_auroc_compute, mesh, axis, 1, scores, targets)
+    # Route decided eagerly on the same data the replicated kernel sees
+    # (bitwise-consistency with the eager oracle, as in the multiclass
+    # wrapper).
+    route = binary_ustat_route(scores, targets)
+
+    def kernel(s_all, t_all):
+        return _binary_auroc_compute(s_all, t_all, ustat_route=route)
+
+    return _gather_exact(kernel, mesh, axis, 1, scores, targets)
 
 
 def _gather_exact(kernel, mesh: Mesh, axis: str, sample_axis: int, scores, targets):
@@ -154,9 +163,15 @@ def sharded_binary_auroc_exact(
     from torcheval_tpu.metrics.functional.classification.auroc import (
         _binary_auroc_compute,
     )
+    from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
 
     _check_even_1d(scores, targets, mesh, axis)
-    return _gather_exact(_binary_auroc_compute, mesh, axis, 0, scores, targets)
+    route = binary_ustat_route(scores[None], targets[None])
+
+    def kernel(s_all, t_all):
+        return _binary_auroc_compute(s_all, t_all, ustat_route=route)
+
+    return _gather_exact(kernel, mesh, axis, 0, scores, targets)
 
 
 def sharded_binary_auprc_exact(
@@ -169,13 +184,17 @@ def sharded_binary_auprc_exact(
     :func:`sharded_binary_auroc_exact`; kernel =
     ``functional.binary_auprc``'s tie-group step sum)."""
     from torcheval_tpu.metrics.functional.classification.auprc import (
-        _binary_auprc_compute_kernel,
+        _binary_auprc_compute,
     )
+    from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
 
     _check_even_1d(scores, targets, mesh, axis)
-    return _gather_exact(
-        _binary_auprc_compute_kernel, mesh, axis, 0, scores, targets
-    )
+    route = binary_ustat_route(scores[None], targets[None], need_pos=True)
+
+    def kernel(s_all, t_all):
+        return _binary_auprc_compute(s_all, t_all, ustat_route=route)
+
+    return _gather_exact(kernel, mesh, axis, 0, scores, targets)
 
 
 def sharded_multiclass_auroc_exact(
