@@ -245,11 +245,20 @@ def main() -> None:
     headline JSON line is printed LAST on stdout — the driver's parse
     contract — so the whole matrix lands in the round artifact instead of
     living as builder prose (round-2 VERDICT item 2)."""
-    print(f"backend: {_ensure_backend()}", file=sys.stderr)
-    rows = _ledger_rows(sys.stderr)
-    _write_bench_all(rows, None)  # ledger survives a headline failure
-    headline = _headline_row()
-    _write_bench_all(rows, headline)
+    backend = _ensure_backend()
+    print(f"backend: {backend}", file=sys.stderr)
+    if backend == "tpu":
+        rows = _ledger_rows(sys.stderr)
+        _write_bench_all(rows, None)  # ledger survives a headline failure
+        headline = _headline_row()
+        _write_bench_all(rows, headline)
+    else:
+        # CPU fallback (tunnel outage): the per-workload ledger is only
+        # meaningful on-chip and would crawl for hours on host CPU — emit
+        # the headline contract line and DON'T touch BENCH_ALL.json (a
+        # previous on-chip run's ledger must survive the outage).
+        print("ledger skipped: accelerator unavailable", file=sys.stderr)
+        headline = _headline_row()
     print(json.dumps(headline))
 
 

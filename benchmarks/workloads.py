@@ -26,51 +26,15 @@ V5E_HBM_GBPS = 819.0
 
 
 def _device_seconds(step_kernel, args, iters: int = 8) -> float:
-    """Pure on-device seconds per step.
+    """Pure on-device seconds per step — the fori_loop differencing clock,
+    now a library component (``torcheval_tpu.tools.profiling
+    .device_seconds``); see its docstring for the honesty argument and
+    caveats.  Through the axon tunnel, wall-clock lifecycle timing
+    measures 3-10 ms dispatch overhead and a ~16 MB/s result fetch — not
+    the kernel (BASELINE.md diagnosis)."""
+    from torcheval_tpu.tools.profiling import device_seconds
 
-    Through the axon tunnel, wall-clock lifecycle timing measures 3-10 ms
-    dispatch overhead and a ~16 MB/s result fetch — not the kernel (see
-    BASELINE.md diagnosis).  This clocks the kernel honestly: run
-    ``step_kernel(*args, i) -> f32 scalar`` in a ``fori_loop`` inside ONE
-    jit (the loop index must perturb the data so XLA's loop-invariant
-    code motion cannot hoist the body), then difference a 1-iteration
-    loop to cancel the launch overhead."""
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    def make(k):
-        @jax.jit
-        def run(*a):
-            def body(i, acc):
-                return acc + step_kernel(*a, i).astype(jnp.float32)
-
-            return lax.fori_loop(0, k, body, jnp.float32(0.0))
-
-        return run
-
-    def best_of(fn, reps=3):
-        best = 9e9
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            float(fn(*args))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    run1 = make(1)
-    float(run1(*args))  # compile
-    t1 = best_of(run1)
-    # Adaptive iteration count: microsecond kernels need thousands of
-    # iterations before the loop outweighs the ~3-10 ms launch overhead;
-    # grow until the K-loop takes at least 3x the 1-loop wall time.
-    while True:
-        runk = make(iters)
-        float(runk(*args))
-        tk = best_of(runk)
-        if tk >= 3.0 * t1 or iters >= 16384:
-            break
-        iters *= 8
-    return max((tk - t1) / (iters - 1), 1e-9)
+    return device_seconds(step_kernel, args, iters=iters)
 
 
 def _device_stats(step_kernel, args, n_samples: int, n_bytes: int) -> dict:

@@ -32,5 +32,33 @@ class TestTrace(unittest.TestCase):
         self.assertGreater(len(profile), 0)
 
 
+class TestDeviceSeconds(unittest.TestCase):
+    def test_clocks_a_kernel(self):
+        # The differencing clock must produce a positive, finite
+        # per-step time and actually scale with the work.
+        import numpy as np
+
+        from torcheval_tpu.metrics.functional import multiclass_accuracy
+
+        rng = np.random.default_rng(0)
+        small = (
+            jnp.asarray(rng.random((256, 8), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 8, 256)),
+        )
+        big = (
+            jnp.asarray(rng.random((65536, 8), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, 8, 65536)),
+        )
+
+        def step(s, t, i):
+            return multiclass_accuracy(s + i * jnp.float32(1e-38), t)
+
+        t_small = profiling.device_seconds(step, small, reps=2)
+        t_big = profiling.device_seconds(step, big, reps=2)
+        self.assertGreater(t_small, 0.0)
+        self.assertLess(t_small, 1.0)
+        self.assertGreater(t_big, t_small)
+
+
 if __name__ == "__main__":
     unittest.main()

@@ -50,6 +50,8 @@ BASELINE.md round-3 section).
 from functools import partial
 from typing import Optional, Tuple
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -590,11 +592,13 @@ def binary_ustat_route(
     with ``need_pos`` (AP), only packs the positive side."""
     if scores.ndim != 2 or not _route_guards_ok(scores, target):
         return None
-    stats = _binary_route_stats(scores, target)
-    lo, hi, t_lo, t_hi, max_pos, max_neg = (float(x) for x in stats)
+    # ONE device fetch for all five stats (the _host_checks bounds
+    # pattern) — per-element float() would block once per scalar.
+    stats = np.asarray(_binary_route_stats(scores, target))
+    lo, hi, non01, max_pos, max_neg = (float(x) for x in stats)
     if not (lo > -_BIG and hi < _BIG):
         return None
-    if not (t_lo in (0.0, 1.0) and t_hi in (0.0, 1.0)):
+    if non01 != 0.0:  # any target outside {0, 1} keeps the sort path
         return None
     n = scores.shape[1]
     for side, most in (("pos", max_pos), ("neg", max_neg)):
@@ -608,16 +612,17 @@ def binary_ustat_route(
 
 @jax.jit
 def _binary_route_stats(scores, target) -> jax.Array:
-    """Score bounds, target bounds, and per-row class-count maxima in ONE
-    fused round trip."""
+    """Score bounds, the count of targets outside {0, 1} (exact-membership
+    check: min/max alone would pass e.g. {0, 0.5, 1}), and per-row
+    class-count maxima — in ONE fused device program."""
     pos = jnp.sum(target != 0, axis=-1, dtype=jnp.int32)
     neg = scores.shape[-1] - pos
+    non01 = jnp.sum((target != 0) & (target != 1), dtype=jnp.int32)
     return jnp.stack(
         [
             jnp.min(scores).astype(jnp.float32),
             jnp.max(scores).astype(jnp.float32),
-            jnp.min(target).astype(jnp.float32),
-            jnp.max(target).astype(jnp.float32),
+            non01.astype(jnp.float32),
             pos.max().astype(jnp.float32),
             neg.max().astype(jnp.float32),
         ]
@@ -635,7 +640,9 @@ def ustat_route_cap(
     beyond the int32 count bounds (see :func:`_win_cap`)."""
     if scores.shape[0] == 0 or not _route_guards_ok(scores, target):
         return None
-    lo, hi, max_count = (float(x) for x in _route_stats(scores, target))
+    lo, hi, max_count = (
+        float(x) for x in np.asarray(_route_stats(scores, target))
+    )
     if not (lo > -_BIG and hi < _BIG):  # non-finite or past the sentinel
         return None
     return _win_cap(max_count, scores.shape[0])

@@ -1,19 +1,30 @@
-"""Runtime tracing — the TPU-side observability counterpart to the
-reference's instrumentation subsystem.
+"""Runtime tracing and honest kernel timing — the TPU-side observability
+counterpart to the reference's instrumentation subsystem.
 
 The reference's closest facilities are its dispatcher-interposing FLOP
 counter and per-construction usage telemetry (reference
 ``tools/flops.py:170-233``, ``metric.py:44``); it has no runtime tracer.
 On TPU the platform one is ``jax.profiler`` — traces carry XLA op timings,
-HBM traffic, and fusion boundaries, viewable in TensorBoard/Perfetto.
-This module is the thin, stable entry point so eval loops don't import
-``jax.profiler`` directly.
+HBM traffic, and fusion boundaries, viewable in TensorBoard/Perfetto; the
+tracing half of this module is the thin, stable entry point so eval loops
+don't import ``jax.profiler`` directly.
+
+The timing half solves a problem ``time.perf_counter`` around a dispatch
+cannot: on remote/tunneled backends, wall-clock lifecycle timing measures
+dispatch overhead (milliseconds) and device→host transfer, not the kernel
+— and async dispatch means the Python call returns before the device even
+starts.  :func:`device_seconds` clocks the kernel honestly by running it
+inside an on-device ``fori_loop`` under ONE jit and differencing against a
+1-iteration loop, with the loop index perturbing the inputs so XLA's
+loop-invariant code motion cannot hoist the body.  This is the clock every
+number in ``BASELINE.md``'s per-workload ledger uses.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+import time
+from typing import Callable, Iterator, Optional, Sequence
 
 import jax
 
@@ -48,3 +59,67 @@ def device_memory_profile(backend: Optional[str] = None) -> bytes:
     """Current device memory profile (pprof format) — the allocator-level
     view of metric buffer residency."""
     return jax.profiler.device_memory_profile(backend=backend)
+
+
+def device_seconds(
+    step_kernel: Callable[..., "jax.Array"],
+    args: Sequence,
+    *,
+    iters: int = 8,
+    reps: int = 3,
+    max_iters: int = 16384,
+) -> float:
+    """Pure on-device seconds per call of ``step_kernel(*args, i)``.
+
+    ``step_kernel`` must accept the loop index ``i`` as its last argument
+    and fold it into the computation (e.g. ``s + i * 1e-38`` for floats,
+    a ``jnp.where(i == -1, ...)`` select for ints) so the loop body cannot
+    be hoisted, and must return a float32 scalar (anything reducible —
+    the value is summed, never read).
+
+    Runs a K-iteration ``lax.fori_loop`` of the kernel under one jit and
+    differences against the 1-iteration loop, cancelling dispatch/launch
+    overhead; K grows adaptively until the K-loop dominates wall time, so
+    microsecond kernels and second-scale kernels both resolve.  The
+    result is forced with ``float()`` (a device→host transfer — on some
+    tunneled backends ``block_until_ready`` returns early).
+
+    Caveats: inputs that fit in VMEM stay resident across iterations, so
+    bandwidth-bound kernels can report above-HBM throughput; compiling a
+    very large program under ``fori_loop`` can be much slower than the
+    program itself — for seconds-scale steps, lifecycle wall-clock is
+    already honest (dispatch overhead is <1%) and this clock is
+    unnecessary.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    def make(k):
+        @jax.jit
+        def run(*a):
+            def body(i, acc):
+                return acc + step_kernel(*a, i).astype(jnp.float32)
+
+            return lax.fori_loop(0, k, body, jnp.float32(0.0))
+
+        return run
+
+    def best_of(fn):
+        best = 9e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            float(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    run1 = make(1)
+    float(run1(*args))  # compile
+    t1 = best_of(run1)
+    while True:
+        runk = make(iters)
+        float(runk(*args))
+        tk = best_of(runk)
+        if tk >= 3.0 * t1 or iters >= max_iters:
+            break
+        iters *= 8
+    return max((tk - t1) / (iters - 1), 1e-9)
