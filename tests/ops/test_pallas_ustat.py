@@ -322,6 +322,38 @@ class TestMulticlassUstatAUROC(unittest.TestCase):
         self.assertEqual(ap[1], 1.0)
         self.assertEqual(ap[2], 1.0)
 
+    def test_binary_route_stats_reject_non01_targets(self):
+        # The exact-membership check exists because min/max alone accepted
+        # {0, 0.5, 1}: a 0.5 target would be packed as negative by
+        # `target == 1` while the sort path weights it — silently
+        # different AP.  The stats kernel is backend-agnostic: assert the
+        # non-{0,1} count directly.
+        from torcheval_tpu.ops.pallas_ustat import _binary_route_stats
+
+        scores = jnp.ones((1, 8), jnp.float32)
+        ok = jnp.asarray([[0, 1, 0, 1, 1, 0, 0, 1]], jnp.float32)
+        bad = ok.at[0, 2].set(0.5)
+        self.assertEqual(float(np.asarray(_binary_route_stats(scores, ok))[2]), 0.0)
+        self.assertEqual(float(np.asarray(_binary_route_stats(scores, bad))[2]), 1.0)
+
+    def test_multilabel_fast_path_matches_kernel(self):
+        # The multilabel AP compute delegates to the binary (R, N) path on
+        # transposed inputs; parity with the sort kernel on sparse labels.
+        from torcheval_tpu.metrics.functional.classification.auprc import (
+            _multilabel_auprc_compute,
+            _multilabel_auprc_compute_kernel,
+        )
+
+        rng = np.random.default_rng(14)
+        n, labels = 512, 5
+        scores = jnp.asarray(rng.random((n, labels)).astype(np.float32))
+        target = jnp.asarray((rng.random((n, labels)) < 0.04).astype(np.int32))
+        got = np.asarray(_multilabel_auprc_compute(scores, target, None))
+        want = np.asarray(
+            _multilabel_auprc_compute_kernel(scores, target, None)
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
     def test_binary_route_off_on_cpu(self):
         rng = np.random.default_rng(13)
         scores = jnp.asarray(rng.random((2, 2**15)).astype(np.float32))

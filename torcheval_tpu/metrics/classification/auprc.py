@@ -14,7 +14,7 @@ from torcheval_tpu.metrics.functional.classification.auprc import (
     _binary_auprc_compute,
     _multiclass_auprc_compute,
     _multiclass_auprc_param_check,
-    _multilabel_auprc_compute_kernel,
+    _multilabel_auprc_compute,
     _multilabel_auprc_param_check,
     _multilabel_auprc_update_input_check,
 )
@@ -154,7 +154,7 @@ class MultilabelAUPRC(Metric[jax.Array]):
                 if self.average == "macro"
                 else jnp.zeros(self.num_labels)
             )
-        return _multilabel_auprc_compute_kernel(
+        return _multilabel_auprc_compute(
             input,
             jnp.concatenate(self.targets, axis=0),
             self.average,

@@ -135,7 +135,7 @@ def multilabel_auprc(
     _multilabel_auprc_update_input_check(input, target, num_labels)
     if input.shape[0] == 0:
         return jnp.zeros(()) if average == "macro" else jnp.zeros(num_labels)
-    return _multilabel_auprc_compute_kernel(input, target, average)
+    return _multilabel_auprc_compute(input, target, average)
 
 
 @partial(jax.jit, static_argnames=("average",))
@@ -143,6 +143,16 @@ def _multilabel_auprc_compute_kernel(
     input: jax.Array, target: jax.Array, average: Optional[str]
 ) -> jax.Array:
     ap = _auprc_rows(input.T, (target == 1).T)
+    return ap.mean() if average == "macro" else ap
+
+
+def _multilabel_auprc_compute(
+    input: jax.Array, target: jax.Array, average: Optional[str]
+) -> jax.Array:
+    # Label columns are usually sparse — exactly the rare-positive regime
+    # of the sort-free AP kernel.  Per-label rows ARE the binary (R, N)
+    # case on transposed inputs (one routing implementation, no drift).
+    ap = _binary_auprc_compute(input.T, target.T)
     return ap.mean() if average == "macro" else ap
 
 

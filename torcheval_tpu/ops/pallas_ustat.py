@@ -590,7 +590,13 @@ def binary_ustat_route(
     guards and win region; additionally requires exactly-0/1 targets (the
     sort kernels weight arbitrary target values, the pack cannot) and,
     with ``need_pos`` (AP), only packs the positive side."""
-    if scores.ndim != 2 or not _route_guards_ok(scores, target):
+    if scores.ndim != 2:
+        return None
+    # Static disqualifiers first: when no cap can pass the win region at
+    # this N, skip the device sync entirely (compute() stays fully async).
+    if _win_cap(1, scores.shape[1]) is None:
+        return None
+    if not _route_guards_ok(scores, target):
         return None
     # ONE device fetch for all five stats (the _host_checks bounds
     # pattern) — per-element float() would block once per scalar.
@@ -638,7 +644,9 @@ def ustat_route_cap(
     path — on CPU, under tracing, for non-finite/huge scores, for
     class-skewed data where the pack would be as big as a sort, and
     beyond the int32 count bounds (see :func:`_win_cap`)."""
-    if scores.shape[0] == 0 or not _route_guards_ok(scores, target):
+    if scores.shape[0] == 0 or _win_cap(1, scores.shape[0]) is None:
+        return None  # no cap can pass at this N: skip the device sync
+    if not _route_guards_ok(scores, target):
         return None
     lo, hi, max_count = (
         float(x) for x in np.asarray(_route_stats(scores, target))
