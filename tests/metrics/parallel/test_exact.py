@@ -29,6 +29,7 @@ from torcheval_tpu.parallel import (
     sharded_binary_auroc_ustat,
     sharded_multiclass_auroc_exact,
     sharded_multiclass_auroc_ustat,
+    sharded_multitask_auprc_exact,
     sharded_multitask_auroc_exact,
 )
 
@@ -307,6 +308,19 @@ class TestShardedMultitaskExact(unittest.TestCase):
         targets = jnp.asarray((rng.random((5, 4096)) > 0.3).astype(np.int32))
         got = sharded_multitask_auroc_exact(scores, targets, mesh)
         want = binary_auroc(scores, targets, num_tasks=5)
+        self.assertEqual(
+            np.asarray(got).tobytes(), np.asarray(want).tobytes()
+        )
+
+    def test_auprc_bitwise_vs_single_device(self):
+        mesh = make_mesh()
+        rng = np.random.default_rng(22)
+        scores = jnp.asarray(
+            (rng.random((3, 4096)) * 64).round().astype(np.float32) / 64
+        )
+        targets = jnp.asarray((rng.random((3, 4096)) < 0.1).astype(np.int32))
+        got = sharded_multitask_auprc_exact(scores, targets, mesh)
+        want = binary_auprc(scores, targets, num_tasks=3)
         self.assertEqual(
             np.asarray(got).tobytes(), np.asarray(want).tobytes()
         )

@@ -43,6 +43,7 @@ from torcheval_tpu.parallel.exact import (
     sharded_binary_auroc_ustat,
     sharded_multiclass_auroc_exact,
     sharded_multiclass_auroc_ustat,
+    sharded_multitask_auprc_exact,
     sharded_multitask_auroc_exact,
 )
 from torcheval_tpu.parallel.sync import (
@@ -69,5 +70,6 @@ __all__ = [
     "sharded_multiclass_auroc_exact",
     "sharded_multiclass_auroc_histogram",
     "sharded_multiclass_auroc_ustat",
+    "sharded_multitask_auprc_exact",
     "sharded_multitask_auroc_exact",
 ]

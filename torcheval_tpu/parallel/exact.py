@@ -197,6 +197,30 @@ def sharded_binary_auprc_exact(
     return _gather_exact(kernel, mesh, axis, 0, scores, targets)
 
 
+def sharded_multitask_auprc_exact(
+    scores: jax.Array,
+    targets: jax.Array,
+    mesh: Mesh,
+    axis: str = "dp",
+) -> jax.Array:
+    """Bit-exact pod average precision for multi-task ``(num_tasks, N)``
+    inputs sharded over the sample axis (same gather-exact scheme as
+    :func:`sharded_multitask_auroc_exact`; the rare-positive rank-sum
+    route is decided eagerly for bitwise consistency, as everywhere)."""
+    from torcheval_tpu.metrics.functional.classification.auprc import (
+        _binary_auprc_compute,
+    )
+    from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
+
+    _check_even_tasks(scores, targets, mesh, axis)
+    route = binary_ustat_route(scores, targets, need_pos=True)
+
+    def kernel(s_all, t_all):
+        return _binary_auprc_compute(s_all, t_all, ustat_route=route)
+
+    return _gather_exact(kernel, mesh, axis, 1, scores, targets)
+
+
 def sharded_multiclass_auroc_exact(
     scores: jax.Array,
     targets: jax.Array,
