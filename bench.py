@@ -194,6 +194,45 @@ def _headline_device_stats() -> dict:
     )
 
 
+def _self_check_fast_paths() -> None:
+    """One small routed-vs-sort comparison before anything is clocked: if
+    the rank-sum fast path disagrees with the sort kernel on this
+    hardware, flip its dedicated kill-switch so no recorded number ever
+    rides a miscompiled kernel (the sort path's numbers are the round-2
+    baseline either way)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return
+    from torcheval_tpu.metrics.functional import multiclass_auroc
+    from torcheval_tpu.metrics.functional.classification.auroc import (
+        _multiclass_auroc_compute_kernel,
+    )
+
+    rng = np.random.default_rng(42)
+    n, c = 2**15, 256  # route fires here (cap 256 ≤ n // 128)
+    s = jnp.asarray(rng.random((n, c)).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+    try:
+        got = float(multiclass_auroc(s, t, num_classes=c))
+        want = float(_multiclass_auroc_compute_kernel(s, t, c, "macro"))
+        ok = abs(got - want) < 1e-4
+    except Exception as exc:  # pragma: no cover - compile/runtime failure
+        print(f"ustat self-check raised: {exc}", file=sys.stderr)
+        ok = False
+    if not ok:
+        os.environ["TORCHEVAL_TPU_DISABLE_USTAT"] = "1"
+        print(
+            "ustat fast path FAILED self-check; disabled for this run",
+            file=sys.stderr,
+        )
+    else:
+        print("ustat fast path self-check ok", file=sys.stderr)
+
+
 def _headline_row() -> dict:
     ours = bench_tpu()
     ref = bench_reference()
@@ -247,6 +286,7 @@ def main() -> None:
     living as builder prose (round-2 VERDICT item 2)."""
     backend = _ensure_backend()
     print(f"backend: {backend}", file=sys.stderr)
+    _self_check_fast_paths()  # before anything routed gets clocked
     if backend == "tpu":
         rows = _ledger_rows(sys.stderr)
         _write_bench_all(rows, None)  # ledger survives a headline failure
@@ -276,6 +316,7 @@ def _write_bench_all(rows: list, headline) -> None:
 def main_all() -> None:
     """``--all``: just the workload ledger, one stdout JSON line each."""
     print(f"backend: {_ensure_backend()}", file=sys.stderr)
+    _self_check_fast_paths()
     _ledger_rows(sys.stdout)
 
 

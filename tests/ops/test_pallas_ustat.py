@@ -366,6 +366,21 @@ class TestMulticlassUstatAUROC(unittest.TestCase):
         target = jnp.asarray(rng.integers(0, 4, 64))
         self.assertIsNone(ustat_route_cap(scores, target, 4))
 
+    def test_ustat_kill_switch(self):
+        # The dedicated TORCHEVAL_TPU_DISABLE_USTAT switch (narrower than
+        # the pallas one) must gate the shared route guards at call time.
+        import os
+        from unittest import mock
+
+        from torcheval_tpu.ops.pallas_ustat import _route_guards_ok
+
+        scores = jnp.ones((4, 4), jnp.float32)
+        target = jnp.zeros((4,), jnp.int32)
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_DISABLE_USTAT": "1"}
+        ):
+            self.assertFalse(_route_guards_ok(scores, target))
+
 
 if __name__ == "__main__":
     unittest.main()

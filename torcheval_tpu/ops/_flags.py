@@ -13,3 +13,12 @@ def pallas_disabled() -> bool:
     return (
         os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in _TRUTHY
     )
+
+
+def ustat_disabled() -> bool:
+    """True when ``TORCHEVAL_TPU_DISABLE_USTAT`` is set truthy — a
+    narrower kill-switch for just the rank-sum (ustat) fast paths, leaving
+    the other Pallas kernels live.  Read at call time like the rest."""
+    return (
+        os.environ.get("TORCHEVAL_TPU_DISABLE_USTAT", "").lower() in _TRUTHY
+    )

@@ -553,9 +553,9 @@ def _route_guards_ok(scores, target) -> bool:
     replicated kernels and the eager oracle always pick the same
     formulation (the bitwise contract), single- or multi-device."""
     from torcheval_tpu.metrics.functional._host_checks import all_concrete
-    from torcheval_tpu.ops._flags import pallas_disabled
+    from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
 
-    if pallas_disabled() or jax.default_backend() != "tpu":
+    if pallas_disabled() or ustat_disabled() or jax.default_backend() != "tpu":
         return False
     if not all_concrete(scores, target):
         return False
