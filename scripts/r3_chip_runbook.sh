@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-3 on-chip session, in priority order. Run from /root/repo on a
+# healthy tunnel. NEVER timeout-kill any step (axon lease).
+set -u
+cd /root/repo
+
+echo "=== 1/3 compiled-kernel validation (-m tpu) -> TPUCHECK.json ==="
+python scripts/tpu_validate.py --checks-only 2>&1 | tail -5
+
+echo "=== 2/3 kernel-vs-kernel headline measurement ==="
+python scripts/measure_ustat.py 2>&1 | tail -12
+
+echo "=== 3/3 full bench: ledger + BENCH_ALL.json + headline ==="
+python bench.py 2>bench_r3_stderr.log; echo "bench rc=$?"
+tail -20 bench_r3_stderr.log
