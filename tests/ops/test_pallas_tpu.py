@@ -28,7 +28,11 @@ pytestmark = pytest.mark.tpu
 
 
 def _require_tpu():
-    if jax.default_backend() != "tpu":
+    try:
+        backend = jax.default_backend()
+    except RuntimeError as exc:  # tunnel outage: backend init raises
+        raise unittest.SkipTest(f"TPU backend unavailable: {exc}") from exc
+    if backend != "tpu":
         raise unittest.SkipTest("real TPU backend not available")
 
 
