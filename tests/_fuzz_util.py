@@ -1,0 +1,11 @@
+"""Shared helpers for the fuzz suites."""
+
+
+def pool(rng, values):
+    """Draw a dimension from a small pinned pool instead of a full range:
+    shapes then repeat across trials, so XLA's in-process jit cache hits
+    instead of recompiling every trial (the suite runs on one CPU core and
+    compile time dominates it).  Randomness lives in the VALUES — every
+    trial still draws fresh scores/targets/labels — and the pools keep the
+    edge sizes (1-element, single-class-adjacent, non-tile-aligned)."""
+    return int(values[int(rng.integers(0, len(values)))])

@@ -9,6 +9,8 @@ import unittest
 
 import jax.numpy as jnp
 import numpy as np
+
+from tests._fuzz_util import pool as _pool
 from sklearn.metrics import (
     auc as sk_auc,
     average_precision_score,
@@ -34,13 +36,15 @@ from torcheval_tpu.metrics.functional import (
 TRIALS = 8
 
 
+
+
 class TestBeyondSnapshotFuzz(unittest.TestCase):
     def test_binned_auc_grid_scores(self):
         rng = np.random.default_rng(100)
         for trial in range(TRIALS):
-            bins = int(rng.integers(8, 200))
+            bins = _pool(rng, (8, 57, 199))
             grid = np.linspace(0, 1, bins).astype(np.float32)
-            n = int(rng.integers(16, 600))
+            n = _pool(rng, (16, 129, 599))
             s = rng.choice(grid, n).astype(np.float32)
             t = (rng.random(n) > rng.uniform(0.2, 0.8)).astype(np.float32)
             if 0 < t.sum() < n:
@@ -59,8 +63,8 @@ class TestBeyondSnapshotFuzz(unittest.TestCase):
     def test_multilabel_auprc_chunked_equals_oneshot(self):
         rng = np.random.default_rng(101)
         for _ in range(TRIALS):
-            n = int(rng.integers(8, 200)) * 2
-            num_labels = int(rng.integers(2, 8))
+            n = _pool(rng, (8, 49, 199)) * 2
+            num_labels = _pool(rng, (2, 5, 7))
             s = np.round(rng.random((n, num_labels)) * 8).astype(np.float32) / 8
             t = (rng.random((n, num_labels)) > 0.5).astype(np.float32)
             m = MultilabelAUPRC(num_labels=num_labels, average=None)
@@ -85,7 +89,7 @@ class TestBeyondSnapshotFuzz(unittest.TestCase):
     def test_recall_at_fixed_precision_feasibility(self):
         rng = np.random.default_rng(102)
         for _ in range(TRIALS):
-            n = int(rng.integers(8, 300))
+            n = _pool(rng, (8, 65, 299))
             s = rng.random(n).astype(np.float32)
             t = (rng.random(n) > 0.5).astype(np.float32)
             floor = float(rng.uniform(0.05, 0.95))
@@ -105,7 +109,7 @@ class TestBeyondSnapshotFuzz(unittest.TestCase):
     def test_ctr_and_auc_random_weights(self):
         rng = np.random.default_rng(103)
         for _ in range(TRIALS):
-            n = int(rng.integers(4, 200))
+            n = _pool(rng, (4, 31, 199))
             clicks = (rng.random(n) > rng.uniform(0.1, 0.9)).astype(np.float32)
             w = rng.random(n).astype(np.float32) + 0.01
             got = float(
@@ -125,8 +129,8 @@ class TestBeyondSnapshotFuzz(unittest.TestCase):
     def test_retrieval_recall_random_k(self):
         rng = np.random.default_rng(104)
         for _ in range(TRIALS):
-            n = int(rng.integers(3, 80))
-            k = int(rng.integers(1, n + 4))
+            n = _pool(rng, (3, 17, 79))
+            k = _pool(rng, (1, n, n + 3))
             s = rng.random(n).astype(np.float32)
             t = (rng.random(n) > 0.5).astype(np.float32)
             t[int(rng.integers(0, n))] = 1.0

@@ -9,6 +9,8 @@ import unittest
 import jax.numpy as jnp
 import numpy as np
 
+from tests._fuzz_util import pool as _pool
+
 sys.path.insert(0, "/root/reference")
 
 try:
@@ -43,8 +45,8 @@ class TestFuzzCounterMetrics(unittest.TestCase):
             (our_f.multiclass_recall, ref_f.multiclass_recall),
         ]
         for trial in range(12):
-            n = int(rng.integers(1, 65))
-            c = int(rng.integers(2, 9))
+            n = _pool(rng, (1, 7, 33, 64))
+            c = _pool(rng, (2, 5, 8))
             average = ["micro", "macro", "weighted"][trial % 3]
             scores = rng.random((n, c)).astype(np.float32)
             # Degenerate distributions every third trial: constant target.
@@ -85,8 +87,8 @@ class TestFuzzCounterMetrics(unittest.TestCase):
             (our_f.multiclass_recall, ref_f.multiclass_recall),
         ]
         for trial in range(8):
-            n = int(rng.integers(2, 65))
-            c = int(rng.integers(2, 9))
+            n = _pool(rng, (2, 33, 64))
+            c = _pool(rng, (2, 5, 8))
             scores = rng.random((n, c)).astype(np.float32)
             target = rng.integers(0, c, n).astype(np.int64)
             for ours, ref in pairs:
@@ -127,11 +129,11 @@ class TestFuzzCounterMetrics(unittest.TestCase):
         """All five multilabel-accuracy criteria vs the reference."""
         rng = np.random.default_rng(654)
         for trial in range(6):
-            n = int(rng.integers(1, 33))
-            c = int(rng.integers(2, 7))
+            n = _pool(rng, (1, 8, 32))
+            c = _pool(rng, (2, 6))
             scores = rng.random((n, c)).astype(np.float32)
             target = (rng.random((n, c)) > 0.5).astype(np.int64)
-            threshold = float(rng.random())
+            threshold = _pool(rng, (29, 62, 97)) / 100.0
             for criteria in (
                 "exact_match",
                 "hamming",
@@ -159,13 +161,13 @@ class TestFuzzCounterMetrics(unittest.TestCase):
     def test_binary_family_random_configs(self):
         rng = np.random.default_rng(321)
         for trial in range(10):
-            n = int(rng.integers(1, 129))
+            n = _pool(rng, (1, 16, 128))
             scores = rng.random(n).astype(np.float32)
             if trial % 4 == 0:
                 target = np.full(n, trial % 2, dtype=np.int64)  # single class
             else:
                 target = (rng.random(n) > rng.random()).astype(np.int64)
-            threshold = float(rng.random())
+            threshold = _pool(rng, (29, 62, 97)) / 100.0
             pairs = [
                 (our_f.binary_accuracy, ref_f.binary_accuracy, {"threshold": threshold}),
                 (our_f.binary_f1_score, ref_f.binary_f1_score, {"threshold": threshold}),
@@ -195,7 +197,7 @@ class TestFuzzCounterMetrics(unittest.TestCase):
         macro/None averages, all vs the reference."""
         rng = np.random.default_rng(135)
         for trial in range(8):
-            n = int(rng.integers(2, 129))
+            n = _pool(rng, (2, 33, 128))
             num_tasks = int(rng.integers(1, 4))
             shape = (n,) if num_tasks == 1 else (num_tasks, n)
             # Every other trial quantizes scores into few distinct values to
@@ -215,7 +217,7 @@ class TestFuzzCounterMetrics(unittest.TestCase):
                 np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6,
                 err_msg=f"binary_auroc trial={trial} n={n} tasks={num_tasks}",
             )
-            c = int(rng.integers(2, 7))
+            c = _pool(rng, (2, 6))
             mc_scores = rng.random((n, c)).astype(np.float32)
             if trial % 2:
                 mc_scores = _quantize(mc_scores)
@@ -240,11 +242,13 @@ class TestFuzzCounterMetrics(unittest.TestCase):
         weighted_calibration vs the reference."""
         rng = np.random.default_rng(987)
         for trial in range(8):
-            n = int(rng.integers(1, 33))
-            c = int(rng.integers(2, 9))
+            n = _pool(rng, (1, 8, 32))
+            c = _pool(rng, (2, 5, 8))
             scores = rng.random((n, c)).astype(np.float32)
             target = rng.integers(0, c, n).astype(np.int64)
-            k = None if trial % 3 == 0 else int(rng.integers(1, c + 1))
+            # k sweeps its edges (None / 1 / c) rather than the full range:
+            # k is a static jit argument, so each distinct value compiles.
+            k = (None, 1, c)[trial % 3]
             for ours, ref in (
                 (our_f.hit_rate, ref_f.hit_rate),
                 (our_f.reciprocal_rank, ref_f.reciprocal_rank),
@@ -285,7 +289,7 @@ class TestFuzzCounterMetrics(unittest.TestCase):
     def test_regression_random_configs(self):
         rng = np.random.default_rng(777)
         for trial in range(8):
-            n = int(rng.integers(2, 257))
+            n = _pool(rng, (2, 64, 256))
             outputs = int(rng.integers(1, 4))
             shape = (n,) if outputs == 1 else (n, outputs)
             pred = rng.standard_normal(shape).astype(np.float32)

@@ -60,5 +60,114 @@ class TestDeviceSeconds(unittest.TestCase):
         self.assertGreater(t_big, t_small)
 
 
+
+
+class TestProfiledMetric(unittest.TestCase):
+    def _make(self, **kwargs):
+        from torcheval_tpu.tools import ProfiledMetric
+
+        return ProfiledMetric(MulticlassAccuracy(num_classes=3), **kwargs)
+
+    def test_counts_and_chaining(self):
+        pm = self._make()
+        scores = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+        target = jnp.asarray([0, 1])
+        out = pm.update(scores, target).update(scores, target)
+        self.assertIs(out, pm)  # chaining returns the wrapper
+        val = float(pm.compute())
+        self.assertAlmostEqual(val, 1.0)
+        pm.reset()
+        self.assertEqual(pm.stats["update"].calls, 2)
+        self.assertEqual(pm.stats["compute"].calls, 1)
+        self.assertEqual(pm.stats["reset"].calls, 1)
+        self.assertGreater(pm.stats["update"].seconds, 0.0)
+        self.assertGreater(pm.stats["update"].mean_ms, 0.0)
+
+    def test_state_bytes_and_report(self):
+        pm = self._make()
+        pm.update(
+            jnp.asarray([[0.7, 0.2, 0.1]]), jnp.asarray([0])
+        )
+        # micro accuracy: two float32 scalars of state
+        self.assertEqual(pm.state_bytes(), 8)
+        row = pm.report()
+        self.assertEqual(row["name"], "MulticlassAccuracy")
+        self.assertEqual(row["update"]["calls"], 1)
+        self.assertEqual(row["state_bytes"], 8)
+
+    def test_delegation_and_sync_mode(self):
+        pm = self._make(sync=True, name="acc")
+        scores = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+        pm.update(scores, jnp.asarray([0, 2]))
+        # non-lifecycle attrs delegate to the wrapped metric
+        sd = pm.state_dict()
+        self.assertIn("num_correct", sd)
+        self.assertAlmostEqual(float(pm.compute()), 0.5)
+        self.assertEqual(pm._name, "acc")
+
+    def test_merge_unwraps_profiled_peers(self):
+        from torcheval_tpu.tools import ProfiledMetric
+
+        a, b = self._make(), self._make()
+        scores = jnp.asarray([[0.7, 0.2, 0.1]])
+        a.update(scores, jnp.asarray([0]))
+        b.update(scores, jnp.asarray([1]))
+        a.merge_state([b])
+        self.assertEqual(a.stats["merge_state"].calls, 1)
+        self.assertAlmostEqual(float(a.compute()), 0.5)
+        self.assertIsInstance(b, ProfiledMetric)
+
+    def test_summary_table(self):
+        from torcheval_tpu.tools import profile_summary_table
+
+        pm = self._make(name="left")
+        pm.update(jnp.asarray([[0.7, 0.2, 0.1]]), jnp.asarray([0]))
+        table = profile_summary_table([pm, self._make(name="right")])
+        self.assertIn("left", table)
+        self.assertIn("right", table)
+        self.assertIn("update calls", table)
+        # one header, one separator, two body rows
+        self.assertEqual(len(table.splitlines()), 4)
+
+
+    def test_to_returns_wrapper_and_deque_states_counted(self):
+        import collections
+
+        from torcheval_tpu.tools import ProfiledMetric
+        from torcheval_tpu.utils.test_utils.dummy_metric import (
+            DummySumDequeStateMetric,
+        )
+
+        pm = self._make()
+        self.assertIs(pm.to("cpu"), pm)  # chaining keeps the wrapper
+        pm.update(jnp.asarray([[0.7, 0.2, 0.1]]), jnp.asarray([0]))
+        self.assertEqual(pm.stats["update"].calls, 1)
+
+        dq = ProfiledMetric(DummySumDequeStateMetric(), sync=True)
+        dq.update(jnp.asarray([1.0, 2.0], dtype=jnp.float32))
+        self.assertIsInstance(dq.metric.x, collections.deque)
+        self.assertEqual(dq.state_bytes(), 8)  # one buffered (2,) f32 array
+        self.assertEqual(float(dq.compute()), 3.0)
+
+
+    def test_metric_collection_member(self):
+        import numpy as np
+
+        from torcheval_tpu.metrics import MetricCollection, MulticlassAccuracy
+        from torcheval_tpu.tools import ProfiledMetric
+
+        pm = ProfiledMetric(MulticlassAccuracy(num_classes=3), sync=True)
+        col = MetricCollection({"acc": pm, "raw": MulticlassAccuracy(num_classes=3)})
+        scores = jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]])
+        target = jnp.asarray([0, 2])
+        col.update(scores, target)
+        col.fused_update(scores, target)  # state installs must reach the real metric
+        out = col.compute()
+        self.assertAlmostEqual(float(out["acc"]), 0.5)
+        self.assertAlmostEqual(float(out["raw"]), 0.5)
+        self.assertEqual(pm.stats["update"].calls, 2)  # update + traced fused pass
+        self.assertGreater(pm.state_bytes(), 0)
+
+
 if __name__ == "__main__":
     unittest.main()

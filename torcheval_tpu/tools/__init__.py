@@ -15,6 +15,7 @@ from torcheval_tpu.tools.module_summary import (
     prune_module_summary,
 )
 from torcheval_tpu.tools import profiling
+from torcheval_tpu.tools.profiling import ProfiledMetric, profile_summary_table
 
 __all__ = [
     "cost_summary",
@@ -24,6 +25,8 @@ __all__ = [
     "get_params_summary",
     "get_summary_table",
     "ModuleSummary",
+    "ProfiledMetric",
+    "profile_summary_table",
     "profiling",
     "prune_module_summary",
 ]

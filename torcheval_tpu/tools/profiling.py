@@ -23,8 +23,9 @@ number in ``BASELINE.md``'s per-workload ledger uses.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import time
-from typing import Callable, Iterator, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Sequence
 
 import jax
 
@@ -123,3 +124,222 @@ def device_seconds(
             break
         iters *= 8
     return max((tk - t1) / (iters - 1), 1e-9)
+
+
+# --------------------------------------------------------------------------
+# Per-metric lifecycle instrumentation
+# --------------------------------------------------------------------------
+
+_PHASES = ("update", "compute", "merge_state", "reset")
+
+
+@dataclasses.dataclass
+class PhaseStats:
+    """Aggregate clock for one lifecycle phase of one metric."""
+
+    calls: int = 0
+    seconds: float = 0.0
+
+    @property
+    def mean_ms(self) -> float:
+        return 1e3 * self.seconds / self.calls if self.calls else 0.0
+
+
+def _state_leaves(value: Any) -> list:
+    """Array leaves of one metric state; deques are legal state containers
+    (``metric.py``'s TState) but not pytree nodes, so unroll them — at the
+    top level or nested inside list/dict states."""
+    import collections
+
+    leaves: list = []
+    for leaf in jax.tree_util.tree_leaves(
+        value, is_leaf=lambda x: isinstance(x, collections.deque)
+    ):
+        if isinstance(leaf, collections.deque):
+            leaves.extend(jax.tree_util.tree_leaves(list(leaf)))
+        else:
+            leaves.append(leaf)
+    return leaves
+
+
+def _leaf_bytes(value: Any) -> int:
+    return sum(getattr(leaf, "nbytes", 0) for leaf in _state_leaves(value))
+
+
+class ProfiledMetric:
+    """Transparent instrumentation shell around a ``Metric``: counts and
+    wall-clocks every lifecycle call and accounts device state memory.
+
+    The reference library's only runtime observability is per-construction
+    usage telemetry (reference ``metric.py:44``) plus its user-space
+    ``Throughput`` metric; there is no per-metric cost attribution anywhere.
+    This wrapper is that subsystem for eval loops: wrap the metrics you
+    feed, run the loop unchanged (every non-lifecycle attribute delegates to
+    the wrapped metric, and ``update`` returns the wrapper so chaining
+    works), then render :func:`profile_summary_table`.
+
+    Two honesty caveats, both inherent to async dispatch:
+
+    - By default each phase's clock covers Python + dispatch only — JAX
+      returns before the device finishes.  That is the number an eval loop
+      actually blocks on (computation overlaps), so it is the default.
+    - ``sync=True`` additionally blocks on every state leaf (update/merge)
+      or on the result (compute) inside the clocked span, approximating
+      per-call device time at the cost of serializing the loop.  On
+      tunneled backends prefer :func:`device_seconds` for kernel truth.
+
+    Each phase also runs under :func:`annotate`, so spans are attributable
+    in a ``trace()`` timeline without extra plumbing.
+    """
+
+    _OWN_ATTRS = frozenset({"_metric", "_name", "_sync", "_stats"})
+
+    def __init__(self, metric, *, name: Optional[str] = None, sync: bool = False):
+        self._metric = metric
+        self._name = name or type(metric).__name__
+        self._sync = sync
+        self._stats: Dict[str, PhaseStats] = {p: PhaseStats() for p in _PHASES}
+
+    # ------------------------------------------------------------ lifecycle
+    def _clock(self, phase: str, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        stats = self._stats[phase]
+        with annotate(f"{self._name}.{phase}"):
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            if self._sync:
+                targets = [out] if phase == "compute" else [
+                    getattr(self._metric, s, None)
+                    for s in self._metric._state_name_to_default
+                ]
+                jax.block_until_ready(
+                    [
+                        x
+                        for t in targets
+                        for x in _state_leaves(t)
+                        # Under a trace (e.g. a member of
+                        # MetricCollection.fused_update) states are
+                        # tracers — nothing to block on.
+                        if x is not None and not isinstance(x, jax.core.Tracer)
+                    ]
+                )
+            stats.seconds += time.perf_counter() - t0
+        stats.calls += 1
+        return out
+
+    def update(self, *args: Any, **kwargs: Any) -> "ProfiledMetric":
+        self._clock("update", self._metric.update, *args, **kwargs)
+        return self
+
+    def compute(self) -> Any:
+        return self._clock("compute", self._metric.compute)
+
+    def merge_state(self, metrics: Iterable[Any]) -> "ProfiledMetric":
+        unwrapped = [
+            m._metric if isinstance(m, ProfiledMetric) else m for m in metrics
+        ]
+        self._clock("merge_state", self._metric.merge_state, unwrapped)
+        return self
+
+    def reset(self) -> "ProfiledMetric":
+        self._clock("reset", self._metric.reset)
+        return self
+
+    def to(self, device, *args: Any, **kwargs: Any) -> "ProfiledMetric":
+        # Not a clocked phase, but must return the wrapper: the delegated
+        # Metric.to returns the *inner* self, which would silently drop
+        # profiling from a chained ``ProfiledMetric(m).to(dev)``.
+        self._metric.to(device, *args, **kwargs)
+        return self
+
+    def load_state_dict(self, *args: Any, **kwargs: Any) -> None:
+        # Same None-returning contract as Metric.load_state_dict.
+        self._metric.load_state_dict(*args, **kwargs)
+
+    # ------------------------------------------------------------- reporting
+    @property
+    def metric(self):
+        """The wrapped metric (e.g. for toolkit sync, which needs the real
+        object on every rank)."""
+        return self._metric
+
+    @property
+    def stats(self) -> Dict[str, PhaseStats]:
+        return self._stats
+
+    def state_bytes(self) -> int:
+        """Device bytes currently held by the metric's registered states
+        (list/dict/deque containers included leaf-wise)."""
+        return sum(
+            _leaf_bytes(getattr(self._metric, name, None))
+            for name in self._metric._state_name_to_default
+        )
+
+    def report(self) -> Dict[str, Any]:
+        """Plain-dict snapshot: per-phase calls / total seconds / mean ms,
+        plus current state memory."""
+        row: Dict[str, Any] = {"name": self._name, "state_bytes": self.state_bytes()}
+        for phase, stats in self._stats.items():
+            row[phase] = {
+                "calls": stats.calls,
+                "seconds": round(stats.seconds, 6),
+                "mean_ms": round(stats.mean_ms, 4),
+            }
+        return row
+
+    def __getattr__(self, attr: str) -> Any:
+        # Only non-lifecycle attributes reach here (lifecycle methods are
+        # defined above); delegation keeps state_dict/to/device/… working.
+        # During unpickling/deepcopy the instance exists before __init__
+        # ran — guard via __dict__ or the _metric lookup would recurse.
+        if "_metric" not in self.__dict__:
+            raise AttributeError(attr)
+        return getattr(self._metric, attr)
+
+    def __setattr__(self, attr: str, value: Any) -> None:
+        # The wrapper is a transparent proxy: writes to anything but its
+        # own four fields land on the wrapped metric, so state installs
+        # (e.g. MetricCollection._install_states after fused_update) reach
+        # the real states instead of shadowing them on the shell.
+        if attr in self._OWN_ATTRS or "_metric" not in self.__dict__:
+            object.__setattr__(self, attr, value)
+        else:
+            setattr(self._metric, attr, value)
+
+    def __repr__(self) -> str:
+        return f"ProfiledMetric({self._metric!r}, name={self._name!r})"
+
+
+# Virtual subclass: isinstance(pm, Metric) holds (MetricCollection and the
+# toolkit gate on it) without inheriting the base's own state registry —
+# every Metric API reaches the wrapped instance via delegation instead.
+def _register_as_metric() -> None:
+    from torcheval_tpu.metrics.metric import Metric
+
+    Metric.register(ProfiledMetric)
+
+
+_register_as_metric()
+
+
+def profile_summary_table(profiled: Sequence[ProfiledMetric]) -> str:
+    """ASCII cost table over profiled metrics — the eval-loop counterpart
+    of ``tools.get_summary_table`` (one row per metric, one column block
+    per lifecycle phase)."""
+    headers = ["Metric", "State bytes"]
+    for phase in _PHASES:
+        headers += [f"{phase} calls", f"{phase} ms/call"]
+    rows = []
+    for pm in profiled:
+        row = [pm._name, f"{pm.state_bytes():,}"]
+        for phase in _PHASES:
+            st = pm.stats[phase]
+            row += [str(st.calls), f"{st.mean_ms:.3f}"]
+        rows.append(row)
+    widths = [
+        max(len(headers[i]), *(len(r[i]) for r in rows)) if rows else len(headers[i])
+        for i in range(len(headers))
+    ]
+    line = " | ".join(h.ljust(w) for h, w in zip(headers, widths))
+    sep = "-+-".join("-" * w for w in widths)
+    body = [" | ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows]
+    return "\n".join([line, sep] + body)
