@@ -128,6 +128,28 @@ class TestSyncAndCompute(unittest.TestCase):
         for r in LocalWorld(NUM_RANKS).run(dict_fn):
             self.assertEqual(float(r["k"]), 10.0)
 
+    def test_metric_collection_syncs_whole(self):
+        """A MetricCollection rides sync_and_compute as one object: the
+        members' states gather/merge together and the result dict lands on
+        the recipient rank only (the collection satisfies the full Metric
+        sync protocol — merge_state, _prepare_for_merge_state, state_dict,
+        to, device — by construction)."""
+        from torcheval_tpu.metrics import Mean, MetricCollection
+
+        def fn(group, rank):
+            col = MetricCollection(
+                {"sum": Sum(), "mean": Mean()}
+            )
+            col["sum"].update(jnp.asarray(float(rank + 1)))
+            col["mean"].update(jnp.asarray(float(rank)))
+            return sync_and_compute(col, process_group=group, recipient_rank=0)
+
+        results = LocalWorld(NUM_RANKS).run(fn)
+        self.assertEqual(float(results[0]["sum"]), 10.0)  # 1+2+3+4
+        self.assertEqual(float(results[0]["mean"]), 1.5)  # mean(0..3)
+        for r in results[1:]:
+            self.assertIsNone(r)
+
     def test_inputs_unchanged_by_sync(self):
         def fn(group, rank):
             metric = _rank_metric(rank)

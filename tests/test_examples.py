@@ -19,6 +19,11 @@ class TestExamples(unittest.TestCase):
 
         eval_example.main()
 
+    def test_profiling_example(self):
+        import profiling_example
+
+        profiling_example.main()
+
     def test_simple_example_one_epoch(self):
         import simple_example
 

@@ -23,6 +23,16 @@ SPMD all-gather (every rank needs the merged result anyway), which costs
 ``world_size × state`` host bytes per rank.  For large buffer-state metrics
 prefer the sharded in-jit path (``psum`` of counter states / sharded buffer
 compute) over object sync either way.
+
+The single-metric entry points (``sync_and_compute``,
+``get_synced_metric``, ``get_synced_state_dict``, ``clone_metric``) also
+accept a ``MetricCollection``: the collection implements the whole sync
+protocol (``merge_state``, ``_prepare_for_merge_state``, ``state_dict``,
+``to``, ``device``), so it gathers and merges as one object and
+``sync_and_compute`` returns its result dict on the recipient rank.  The
+iterable entry points (``reset_metrics``, ``to_device``,
+``clone_metrics``) take iterables *of metrics* — a collection iterates
+its member *names*, so call its own ``reset()``/``to()`` instead.
 """
 
 from __future__ import annotations
