@@ -1,30 +1,40 @@
 #!/usr/bin/env python
 """Headline benchmark: 1000-class MulticlassAUROC, update + compute.
 
-This is BASELINE.json configs[4]'s single-chip core: the heavy sort+scan
-AUROC kernel over (num_samples, 1000) scores, driven through the class-metric
+This is BASELINE.json configs[4]'s single-chip core: the heavy exact-AUROC
+kernel over (num_samples, 1000) scores, driven through the class-metric
 path (8 buffered updates + one compute), i.e. the same lifecycle the
 reference exercises (reference ``torcheval/metrics/classification/auroc.py``).
 
-Prints ONE JSON line:
+Prints ONE JSON line (the driver's parse contract, always on stdout, last):
     {"metric": ..., "value": samples/sec, "unit": ..., "vs_baseline": ratio}
 
 ``vs_baseline`` is measured live against the reference implementation
 (`/root/reference` torcheval, torch CPU — the only hardware the reference can
 use here) on the identical workload.  If the reference can't be imported the
 field is null.
+
+Orchestration: the bare invocation runs the full per-workload ledger first
+(one JSON row per BASELINE.json workload to stderr as it completes, all of
+them into ``BENCH_ALL.json``), then the headline.  Every workload and the
+headline run in their OWN subprocess with a timeout: the tunneled TPU
+backend can wedge mid-RPC for an hour with no error and no interruptible
+signal (the hang sits in a native PJRT call holding the GIL), so in-process
+execution would turn one flap into an empty round artifact.  A wedged
+worker costs its timeout; every completed row is already on disk.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
 
 def _enable_compile_cache() -> None:
-    """Persist compiled XLA programs across bench invocations (first
-    compile of the big sort kernels is ~20-40s via the remote compiler)."""
+    """Persist compiled XLA programs across bench invocations and worker
+    subprocesses (first compile of the big sort kernels is ~20-40s via the
+    remote compiler)."""
     try:
         import jax
 
@@ -34,15 +44,27 @@ def _enable_compile_cache() -> None:
         print(f"compile cache unavailable: {exc}", file=sys.stderr)
 
 
-_enable_compile_cache()
-
 NUM_CLASSES = 1000
 NUM_SAMPLES = 131072  # per step (2**17)
 NUM_UPDATES = 8
 REPEATS = 3
 
+# Per-worker wall budget.  Healthy workloads finish in well under half of
+# this (compiles ride the persistent cache); only a wedged tunnel RPC ever
+# reaches it.  Killing a wedged worker can orphan the tunnel's device
+# claim for a while — but the claim is already stuck when the timeout
+# fires, and the alternative is recording nothing at all.
+WORKER_TIMEOUT_S = 900
+HEADLINE_TIMEOUT_S = 1200
+CPU_FALLBACK_TIMEOUT_S = 2700  # 1/16-size instance on one CPU core
+# Stop launching new ledger workers past this so the headline always has
+# room inside the driver's overall budget.
+LEDGER_DEADLINE_S = 2700
+
 
 def _make_data(seed: int = 0):
+    import numpy as np
+
     rng = np.random.default_rng(seed)
     scores = rng.random((NUM_SAMPLES, NUM_CLASSES)).astype(np.float32)
     target = rng.integers(0, NUM_CLASSES, size=NUM_SAMPLES).astype(np.int32)
@@ -52,6 +74,7 @@ def _make_data(seed: int = 0):
 def bench_tpu() -> float:
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from torcheval_tpu.metrics import MulticlassAUROC
 
@@ -97,6 +120,8 @@ def bench_reference():
     sorts), so the smaller instance *overstates* reference per-sample
     throughput; the reported ratio is conservative.  None if unimportable."""
     try:
+        import numpy as np
+
         sys.path.insert(0, "/root/reference")
         import torch
 
@@ -134,41 +159,49 @@ def bench_reference():
     return REF_NUM_SAMPLES / min(times)
 
 
-def _ensure_backend() -> str:
-    """Initialize the JAX backend, falling back to host CPU when the
-    accelerator is unreachable (the tunneled TPU comes and goes), so the
-    benchmark always emits its JSON line.
-
-    The accelerator is probed in a SUBPROCESS first: a half-up tunnel can
-    hang backend init for tens of minutes with no error, and a hang inside
-    this process could never be recovered (the init call holds the GIL in
-    native code).  Healthy init takes seconds; the 300s budget only kills
-    probes that are already dead.
-    """
-    import subprocess
-
-    import jax
-
-    probe_error = ""
+def _probe_backend() -> bool:
+    """True iff a non-CPU accelerator initializes, decided in a
+    SUBPROCESS: a half-up tunnel can hang backend init for tens of minutes
+    with no error, and a hang inside this process could never be recovered
+    (the init call holds the GIL in native code).  Healthy init takes
+    seconds; the timeout budget only kills probes that are already dead."""
+    timeout_s = int(os.environ.get("TORCHEVAL_BENCH_PROBE_TIMEOUT", "300"))
+    code = (
+        "import jax, sys; jax.devices(); "
+        "sys.exit(0 if jax.default_backend() != 'cpu' else 4)"
+    )
     try:
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", code],
             capture_output=True,
             text=True,
-            timeout=300,
+            timeout=timeout_s,
         )
-        accelerator_up = probe.returncode == 0
-        if not accelerator_up:
-            probe_error = probe.stderr[-500:]
+        if probe.returncode == 4:
+            print("accelerator probe: CPU-only backend", file=sys.stderr)
+            return False
+        if probe.returncode != 0:
+            print(
+                f"accelerator probe failed: {probe.stderr[-500:]}",
+                file=sys.stderr,
+            )
+            return False
+        return True
     except subprocess.TimeoutExpired:
-        accelerator_up = False
-        probe_error = "probe timed out after 300s"
-    if not accelerator_up:
-        print(
-            "accelerator backend unavailable; falling back to CPU. "
-            f"Probe said: {probe_error}",
-            file=sys.stderr,
-        )
+        print(f"accelerator probe timed out after {timeout_s}s", file=sys.stderr)
+        return False
+
+
+def _ensure_backend() -> str:
+    """Worker-side backend init.  The parent passes its probe verdict down
+    (``TORCHEVAL_BENCH_ACCEL``) so workers don't burn a 300s re-probe
+    each; a worker launched directly (no env) probes for itself.  Workers
+    are subprocess-isolated, so a hung init here is bounded by the
+    parent's worker timeout."""
+    import jax
+
+    verdict = os.environ.get("TORCHEVAL_BENCH_ACCEL")
+    if verdict == "0" or (verdict is None and not _probe_backend()):
         jax.config.update("jax_platforms", "cpu")
     try:
         return jax.default_backend()
@@ -210,10 +243,9 @@ def _self_check_fast_paths() -> None:
     hardware, flip its dedicated kill-switch so no recorded number ever
     rides a miscompiled kernel (the sort path's numbers are the round-2
     baseline either way)."""
-    import os
-
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     if jax.default_backend() != "tpu":
         return
@@ -243,82 +275,102 @@ def _self_check_fast_paths() -> None:
         print("ustat fast path self-check ok", file=sys.stderr)
 
 
+def _make_row(name: str, ours: float, ref, extras: dict) -> dict:
+    """The one JSON-row schema every ledger/headline row uses."""
+    row = {
+        "metric": name,
+        "value": round(ours, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(ours / ref, 2) if ref else None,
+    }
+    row.update(extras)
+    if ref and extras.get("device_value"):
+        row["device_vs_baseline"] = round(extras["device_value"] / ref, 2)
+    return row
+
+
 def _headline_row() -> dict:
     import jax
 
     ours = bench_tpu()
     ref = bench_reference()
-    result = {
-        "metric": "multiclass_auroc_1000c_update_compute_throughput",
-        "value": round(ours, 1),
-        "unit": "samples/sec",
-        "vs_baseline": round(ours / ref, 2) if ref else None,
-    }
+    extras = dict(_headline_device_stats())
     if jax.default_backend() != "tpu":
-        result["degraded"] = "cpu fallback (accelerator unavailable); 1/16-size instance"
-    result.update(_headline_device_stats())
-    if ref and result.get("device_value"):
-        result["device_vs_baseline"] = round(result["device_value"] / ref, 2)
-    return result
+        extras["degraded"] = (
+            "cpu fallback (accelerator unavailable); 1/16-size instance"
+        )
+    return _make_row(
+        "multiclass_auroc_1000c_update_compute_throughput", ours, ref, extras
+    )
 
 
-def _ledger_rows(stream) -> list:
-    """Run every BASELINE.json workload; print each row to ``stream`` as it
-    completes and return them all."""
+# ---------------------------------------------------------------------------
+# Worker mode: run ONE workload (or the headline) and print its JSON row.
+# ---------------------------------------------------------------------------
+
+
+def _worker_names() -> list:
     from benchmarks.workloads import ALL_WORKLOADS
 
-    rows = []
-    for workload in ALL_WORKLOADS:
-        try:
-            result = workload()
-        except Exception as exc:  # pragma: no cover - keep the ledger going
-            print(f"workload {workload.__name__} failed: {exc}", file=sys.stderr)
-            continue
-        name, ours, ref = result[:3]
-        extras = result[3] if len(result) > 3 else {}
-        row = {
-            "metric": name,
-            "value": round(ours, 1),
-            "unit": "samples/sec",
-            "vs_baseline": round(ours / ref, 2) if ref else None,
-        }
-        # Device-loop stats (kernel clock + bandwidth accounting) — the
-        # tunnel-free numbers; see workloads._device_stats.
-        row.update(extras)
-        if ref and extras.get("device_value"):
-            row["device_vs_baseline"] = round(extras["device_value"] / ref, 2)
-        print(json.dumps(row), file=stream, flush=True)
-        rows.append(row)
-    return rows
+    return [w.__name__ for w in ALL_WORKLOADS]
 
 
-def main() -> None:
-    """Bare invocation: the full per-workload ledger runs FIRST (rows to
-    stderr as they complete, all of them into ``BENCH_ALL.json``), then the
-    headline JSON line is printed LAST on stdout — the driver's parse
-    contract — so the whole matrix lands in the round artifact instead of
-    living as builder prose (round-2 VERDICT item 2)."""
+def worker_main(name: str) -> int:
+    _enable_compile_cache()
     backend = _ensure_backend()
-    print(f"backend: {backend}", file=sys.stderr)
-    _self_check_fast_paths()  # before anything routed gets clocked
-    if backend == "tpu":
-        rows = _ledger_rows(sys.stderr)
-        _write_bench_all(rows, None)  # ledger survives a headline failure
-        headline = _headline_row()
-        _write_bench_all(rows, headline)
-    else:
-        # CPU fallback (tunnel outage): the per-workload ledger is only
-        # meaningful on-chip and would crawl for hours on host CPU — emit
-        # the headline contract line and DON'T touch BENCH_ALL.json (a
-        # previous on-chip run's ledger must survive the outage).
-        print("ledger skipped: accelerator unavailable", file=sys.stderr)
-        headline = _headline_row()
-    print(json.dumps(headline))
+    print(f"worker {name}: backend {backend}", file=sys.stderr)
+    if name == "headline":
+        _self_check_fast_paths()
+        print(json.dumps(_headline_row()), flush=True)
+        return 0
+    if backend != "tpu":
+        # The per-workload ledger is only meaningful on-chip.
+        print(f"worker {name}: skipped (no accelerator)", file=sys.stderr)
+        return 3
+    _self_check_fast_paths()
+    from benchmarks.workloads import ALL_WORKLOADS
+
+    workload = {w.__name__: w for w in ALL_WORKLOADS}[name]
+    result = workload()
+    row_name, ours, ref = result[:3]
+    extras = result[3] if len(result) > 3 else {}
+    print(json.dumps(_make_row(row_name, ours, ref, extras)), flush=True)
+    return 0
+
+
+def _run_worker(name: str, timeout_s: int, accel: bool):
+    """Run one worker subprocess; return its JSON row or None.  stderr
+    streams through (compile/step logs); stdout carries exactly the row.
+    ``accel`` hands the parent's probe verdict down so the worker skips
+    its own 300s probe."""
+    env = dict(os.environ)
+    env["TORCHEVAL_BENCH_ACCEL"] = "1" if accel else "0"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker", name],
+            stdout=subprocess.PIPE,
+            text=True,
+            timeout=timeout_s,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"worker {name}: TIMED OUT after {timeout_s}s", file=sys.stderr)
+        return None
+    if proc.returncode == 3:
+        return None  # skipped (no accelerator); already logged
+    if proc.returncode != 0:
+        print(f"worker {name}: exit {proc.returncode}", file=sys.stderr)
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    print(f"worker {name}: no JSON row in output", file=sys.stderr)
+    return None
 
 
 def _write_bench_all(rows: list, headline) -> None:
-    import os.path
-
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL.json")
     try:
         with open(path, "w") as f:
@@ -327,15 +379,72 @@ def _write_bench_all(rows: list, headline) -> None:
         print(f"BENCH_ALL.json not written: {exc}", file=sys.stderr)
 
 
+def main() -> None:
+    """Bare invocation: ledger first (each workload in a timeout-bounded
+    subprocess, rows to stderr + BENCH_ALL.json incrementally), then the
+    headline JSON line LAST on stdout — the driver's parse contract."""
+    accelerator = _probe_backend()
+    print(f"accelerator up: {accelerator}", file=sys.stderr)
+    if accelerator:
+        t0 = time.perf_counter()
+        rows = []
+        for name in _worker_names():
+            if time.perf_counter() - t0 > LEDGER_DEADLINE_S:
+                print(
+                    f"ledger deadline ({LEDGER_DEADLINE_S}s) reached; "
+                    f"skipping remaining workloads before {name}",
+                    file=sys.stderr,
+                )
+                break
+            row = _run_worker(name, WORKER_TIMEOUT_S, accel=True)
+            if row is not None:
+                print(json.dumps(row), file=sys.stderr, flush=True)
+                rows.append(row)
+                # Every completed row is on disk before the next worker
+                # runs — a later wedge cannot erase it.
+                _write_bench_all(rows, None)
+        headline = _run_worker("headline", HEADLINE_TIMEOUT_S, accel=True)
+        if headline is not None:
+            _write_bench_all(rows, headline)
+        else:
+            # The tunnel died under the accelerated attempt: fall back to
+            # the marked 1/16-size CPU measurement with the CPU budget
+            # (the accelerated timeout is far too short for it).
+            print("headline retrying on CPU fallback", file=sys.stderr)
+            headline = _run_worker("headline", CPU_FALLBACK_TIMEOUT_S, accel=False)
+    else:
+        # CPU fallback (tunnel outage): the ledger is only meaningful
+        # on-chip — emit the headline contract line and DON'T touch
+        # BENCH_ALL.json (a previous on-chip run's ledger must survive).
+        print("ledger skipped: accelerator unavailable", file=sys.stderr)
+        headline = _run_worker("headline", CPU_FALLBACK_TIMEOUT_S, accel=False)
+    if headline is None:
+        headline = {
+            "metric": "multiclass_auroc_1000c_update_compute_throughput",
+            "value": 0.0,
+            "unit": "samples/sec",
+            "vs_baseline": None,
+            "degraded": "benchmark worker failed or timed out (see stderr)",
+        }
+    print(json.dumps(headline))
+
+
 def main_all() -> None:
     """``--all``: just the workload ledger, one stdout JSON line each."""
-    print(f"backend: {_ensure_backend()}", file=sys.stderr)
-    _self_check_fast_paths()
-    _ledger_rows(sys.stdout)
+    if not _probe_backend():
+        print("ledger skipped: accelerator unavailable", file=sys.stderr)
+        return
+    for name in _worker_names():
+        row = _run_worker(name, WORKER_TIMEOUT_S, accel=True)
+        if row is not None:
+            print(json.dumps(row), flush=True)
 
 
 if __name__ == "__main__":
-    if "--all" in sys.argv[1:]:
+    argv = sys.argv[1:]
+    if "--worker" in argv:
+        sys.exit(worker_main(argv[argv.index("--worker") + 1]))
+    elif "--all" in argv:
         main_all()
     else:
         main()
