@@ -224,24 +224,23 @@ def _headline_device_stats() -> dict:
     import jax.numpy as jnp
 
     from benchmarks.workloads import _device_stats
-    from torcheval_tpu.metrics.functional.classification.auroc import (
-        _multiclass_auroc_compute,
-    )
+    from torcheval_tpu.metrics.functional import multiclass_auroc
     from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
 
     scores, target = _make_data()
     d_scores, d_target = jnp.asarray(scores), jnp.asarray(target)
     # Route decision is call-time (eager arrays only); inside the
     # fori_loop clock everything is a tracer, so decide here on the real
-    # data and pin it — otherwise the clock silently measures the sort
-    # path while users get the routed kernel.
+    # data and pin it via the public ustat_cap argument — otherwise the
+    # clock silently measures the sort path while eager users get the
+    # routed kernel.  This is exactly the documented jit-composition
+    # recipe, so the clocked path is the one jit users can reach.
     cap = ustat_route_cap(d_scores, d_target, NUM_CLASSES)
     stats = _device_stats(
-        lambda s, t, i: _multiclass_auroc_compute(
+        lambda s, t, i: multiclass_auroc(
             s + i * jnp.float32(1e-38),
             t,
-            NUM_CLASSES,
-            "macro",
+            num_classes=NUM_CLASSES,
             ustat_cap=cap,
         ),
         (d_scores, d_target),

@@ -54,13 +54,69 @@ def multiclass_auroc(
     *,
     num_classes: int,
     average: Optional[str] = "macro",
+    ustat_cap: Optional[int] = None,
 ) -> jax.Array:
     """One-vs-rest AUROC per class, macro-averaged by default
-    (reference ``auroc.py:65-103``)."""
+    (reference ``auroc.py:65-103``).
+
+    ``ustat_cap`` pins the sort-free rank-sum formulation's static table
+    capacity (≥ the largest per-class count, a multiple of 16).  Leave it
+    ``None`` for eager calls — the route self-decides from the data.  Set
+    it when composing this function under YOUR OWN ``jax.jit``: the
+    call-time route guard cannot inspect tracers, so an un-pinned jitted
+    call always takes the sort path; a pinned cap keeps the routed kernel
+    (measured 4.4× on the (2^17, 1000) headline) reachable under jit.
+    Decide it eagerly with :func:`torcheval_tpu.ops.pallas_ustat.
+    ustat_route_cap` on a representative batch.  Results match the sort
+    path to 1 ULP per class (both are exact integer-count formulations;
+    only the final float division rounds differently)."""
     _multiclass_auroc_param_check(num_classes, average)
     input, target = jnp.asarray(input), jnp.asarray(target)
     _multiclass_auroc_update_input_check(input, target, num_classes)
-    return _multiclass_auroc_compute(input, target, num_classes, average)
+    if ustat_cap is not None:
+        _ustat_cap_check(input, target, num_classes, ustat_cap)
+    return _multiclass_auroc_compute(
+        input, target, num_classes, average, ustat_cap=ustat_cap
+    )
+
+
+def _ustat_cap_check(
+    input: jax.Array, target: jax.Array, num_classes: int, cap: int
+) -> None:
+    """Validate a user-pinned rank-sum table capacity.  An undersized cap
+    would silently DROP the overflowing class's largest scores (the pack's
+    out-of-bounds scatter indices are discarded), so eager calls verify it
+    against the measured per-class maximum — one fused round trip, skipped
+    under tracing or ``skip_value_checks`` (then the documented
+    preconditions are the caller's contract)."""
+    from torcheval_tpu.metrics.functional._host_checks import (
+        all_concrete,
+        value_checks_enabled,
+    )
+    from torcheval_tpu.ops.pallas_ustat import _route_stats
+
+    if cap % 16 != 0 or cap < 16:
+        raise ValueError(f"ustat_cap must be a positive multiple of 16, got {cap}.")
+    if cap * input.shape[0] >= 2**29:
+        raise ValueError(
+            f"ustat_cap·N = {cap * input.shape[0]} exceeds the exact-int32 "
+            "bound 2^29; leave ustat_cap=None for this shape."
+        )
+    if not value_checks_enabled() or not all_concrete(input, target):
+        return
+    import numpy as np
+
+    lo, hi, max_count = (float(x) for x in np.asarray(_route_stats(input, target)))
+    if max_count > cap:
+        raise ValueError(
+            f"ustat_cap={cap} but one class has {int(max_count)} samples; "
+            "raise the cap (or leave it None to self-decide)."
+        )
+    if not (-3.0e38 < lo and hi < 3.0e38):
+        raise ValueError(
+            "the rank-sum formulation requires |scores| < 3e38 (its pad "
+            "sentinel); leave ustat_cap=None for such inputs."
+        )
 
 
 def _group_end_values(values: jax.Array, is_last: jax.Array) -> jax.Array:
@@ -158,6 +214,7 @@ def _multiclass_auroc_compute(
     num_classes: int,
     average: Optional[str] = "macro",
     ustat_cap: Optional[int] = None,
+    _interpret: bool = False,
 ) -> jax.Array:
     if input.shape[0] == 0:
         # Degenerate (no samples) → 0.5 per class, matching the kernel's
@@ -176,11 +233,31 @@ def _multiclass_auroc_compute(
         from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
 
         ustat_cap = ustat_route_cap(input, target, num_classes)
+    else:
+        # A pinned cap (the jit-composition recipe) asserts the data
+        # preconditions, not the environment: backend and kill-switches
+        # are host-level facts, checked here so pinned code still runs —
+        # on the sort path — on CPU or with Pallas disabled.
+        # (``_interpret``, a test hook, runs the pinned kernel in Pallas
+        # interpret mode instead, so the route is exercisable off-TPU.)
+        from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
+
+        if not _interpret and (
+            pallas_disabled()
+            or ustat_disabled()
+            or jax.default_backend() != "tpu"
+        ):
+            ustat_cap = None
     if ustat_cap is not None:
         from torcheval_tpu.ops.pallas_ustat import multiclass_auroc_ustat
 
         return multiclass_auroc_ustat(
-            input, target, num_classes=num_classes, average=average, cap=ustat_cap
+            input,
+            target,
+            num_classes=num_classes,
+            average=average,
+            cap=ustat_cap,
+            interpret=_interpret,
         )
     if _use_pallas(input.shape[0]):
         return _multiclass_auroc_pallas_kernel(input, target, num_classes, average)

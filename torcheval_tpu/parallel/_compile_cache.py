@@ -1,0 +1,23 @@
+"""Shared memoizer for the parallel layer's ``jit(shard_map(...))`` programs.
+
+``jax.jit`` caches by function identity, so building a shard_map closure
+inside a public wrapper would miss that cache and re-trace — and, through
+a remote compiler, re-compile — on EVERY call (measured ~15 s/call vs
+~1.8 s of device work for the 1000-class ustat at (2^16, 1000) on v5e).
+Keying on the module-level builder + hashable statics + mesh returns the
+already-compiled program instead.
+
+One builder convention for every call site: ``builder(statics, mesh,
+axis) -> jitted fn``, with ``statics`` a hashable tuple.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from jax.sharding import Mesh
+
+
+@lru_cache(maxsize=256)
+def compiled_spmd(builder, statics, mesh: Mesh, axis: str):
+    return builder(statics, mesh, axis)

@@ -42,7 +42,7 @@ implementation, with no quantization term.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -56,10 +56,15 @@ from torcheval_tpu.metrics.functional._host_checks import (
     bounds,
     value_checks_enabled,
 )
+from torcheval_tpu.parallel._compile_cache import compiled_spmd
 
 
 def _accum_dtype() -> jnp.dtype:
     return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+# Memoized jit(shard_map(...)) programs (see _compile_cache docstring).
+_compiled = compiled_spmd
 
 
 def _check_even_1d(scores, targets, mesh: Mesh, axis: str) -> None:
@@ -101,9 +106,6 @@ def sharded_multitask_auroc_exact(
     sharded over the sample axis — the mesh analog of
     ``binary_auroc(..., num_tasks=T)`` (same gather-exact scheme as
     :func:`sharded_binary_auroc_exact`)."""
-    from torcheval_tpu.metrics.functional.classification.auroc import (
-        _binary_auroc_compute,
-    )
     from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
 
     _check_even_tasks(scores, targets, mesh, axis)
@@ -111,28 +113,60 @@ def sharded_multitask_auroc_exact(
     # (bitwise-consistency with the eager oracle, as in the multiclass
     # wrapper).
     route = binary_ustat_route(scores, targets)
-
-    def kernel(s_all, t_all):
-        return _binary_auroc_compute(s_all, t_all, ustat_route=route)
-
-    return _gather_exact(kernel, mesh, axis, 1, scores, targets)
+    return _gather_exact(_k_binary_auroc, route, mesh, axis, 1, scores, targets)
 
 
-def _gather_exact(kernel, mesh: Mesh, axis: str, sample_axis: int, scores, targets):
+def _k_binary_auroc(route, s_all, t_all):
+    from torcheval_tpu.metrics.functional.classification.auroc import (
+        _binary_auroc_compute,
+    )
+
+    return _binary_auroc_compute(s_all, t_all, ustat_route=route)
+
+
+def _k_binary_auprc(route, s_all, t_all):
+    from torcheval_tpu.metrics.functional.classification.auprc import (
+        _binary_auprc_compute,
+    )
+
+    return _binary_auprc_compute(s_all, t_all, ustat_route=route)
+
+
+def _k_multiclass_auroc(statics, s_all, t_all):
+    from torcheval_tpu.metrics.functional.classification.auroc import (
+        _multiclass_auroc_compute,
+    )
+
+    num_classes, average, cap = statics
+    return _multiclass_auroc_compute(
+        s_all, t_all, num_classes, average, ustat_cap=cap
+    )
+
+
+def _gather_exact(
+    kernel_fn, statics, mesh: Mesh, axis: str, sample_axis: int, scores, targets
+):
     """Shared gather-exact scaffold: device-side tiled all-gather along the
-    sample axis reassembles the shard-order concatenation, then ``kernel``
-    (the identical single-device jitted compute) runs replicated — the
-    bit-for-bit contract of the whole family."""
+    sample axis reassembles the shard-order concatenation, then ``kernel_fn``
+    (a module-level function wrapping the identical single-device jitted
+    compute; hashable ``statics`` carry the route decision) runs replicated
+    — the bit-for-bit contract of the whole family."""
+    fn = _compiled(_build_gather_exact, (kernel_fn, statics, sample_axis), mesh, axis)
+    return fn(scores, targets)
+
+
+def _build_gather_exact(statics, mesh: Mesh, axis: str):
+    kernel_fn, kernel_statics, sample_axis = statics
 
     def local(s, t):
         s_all = lax.all_gather(s, axis, axis=sample_axis, tiled=True)
         t_all = lax.all_gather(t, axis, axis=sample_axis, tiled=True)
-        return kernel(s_all, t_all)
+        return kernel_fn(kernel_statics, s_all, t_all)
 
     spec = (
         PartitionSpec(axis) if sample_axis == 0 else PartitionSpec(None, axis)
     )
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
@@ -141,7 +175,6 @@ def _gather_exact(kernel, mesh: Mesh, axis: str, sample_axis: int, scores, targe
             check_vma=False,  # gathered result is replicated by construction
         )
     )
-    return fn(scores, targets)
 
 
 def sharded_binary_auroc_exact(
@@ -160,18 +193,11 @@ def sharded_binary_auroc_exact(
     ``functional/classification/auroc.py:111-142``, ``toolkit.py:247-255``)
     — minus the host round trip.
     """
-    from torcheval_tpu.metrics.functional.classification.auroc import (
-        _binary_auroc_compute,
-    )
     from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
 
     _check_even_1d(scores, targets, mesh, axis)
     route = binary_ustat_route(scores[None], targets[None])
-
-    def kernel(s_all, t_all):
-        return _binary_auroc_compute(s_all, t_all, ustat_route=route)
-
-    return _gather_exact(kernel, mesh, axis, 0, scores, targets)
+    return _gather_exact(_k_binary_auroc, route, mesh, axis, 0, scores, targets)
 
 
 def sharded_binary_auprc_exact(
@@ -183,18 +209,11 @@ def sharded_binary_auprc_exact(
     """Bit-exact pod average precision (same scheme as
     :func:`sharded_binary_auroc_exact`; kernel =
     ``functional.binary_auprc``'s tie-group step sum)."""
-    from torcheval_tpu.metrics.functional.classification.auprc import (
-        _binary_auprc_compute,
-    )
     from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
 
     _check_even_1d(scores, targets, mesh, axis)
     route = binary_ustat_route(scores[None], targets[None], need_pos=True)
-
-    def kernel(s_all, t_all):
-        return _binary_auprc_compute(s_all, t_all, ustat_route=route)
-
-    return _gather_exact(kernel, mesh, axis, 0, scores, targets)
+    return _gather_exact(_k_binary_auprc, route, mesh, axis, 0, scores, targets)
 
 
 def sharded_multitask_auprc_exact(
@@ -207,18 +226,11 @@ def sharded_multitask_auprc_exact(
     inputs sharded over the sample axis (same gather-exact scheme as
     :func:`sharded_multitask_auroc_exact`; the rare-positive rank-sum
     route is decided eagerly for bitwise consistency, as everywhere)."""
-    from torcheval_tpu.metrics.functional.classification.auprc import (
-        _binary_auprc_compute,
-    )
     from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
 
     _check_even_tasks(scores, targets, mesh, axis)
     route = binary_ustat_route(scores, targets, need_pos=True)
-
-    def kernel(s_all, t_all):
-        return _binary_auprc_compute(s_all, t_all, ustat_route=route)
-
-    return _gather_exact(kernel, mesh, axis, 1, scores, targets)
+    return _gather_exact(_k_binary_auprc, route, mesh, axis, 1, scores, targets)
 
 
 def sharded_multiclass_auroc_exact(
@@ -237,7 +249,6 @@ def sharded_multiclass_auroc_exact(
     variant (O(C·bins) wire) when the pod is bandwidth-bound.
     """
     from torcheval_tpu.metrics.functional.classification.auroc import (
-        _multiclass_auroc_compute,
         _multiclass_auroc_param_check,
     )
     from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
@@ -260,13 +271,10 @@ def sharded_multiclass_auroc_exact(
     # stays bitwise-equal to eager `multiclass_auroc(scores, targets)`,
     # whichever formulation the route picks.
     cap = ustat_route_cap(scores, targets, num_classes)
-
-    def kernel(s_all, t_all):
-        return _multiclass_auroc_compute(
-            s_all, t_all, num_classes, average, ustat_cap=cap
-        )
-
-    return _gather_exact(kernel, mesh, axis, 0, scores, targets)
+    return _gather_exact(
+        _k_multiclass_auroc, (num_classes, average, cap), mesh, axis, 0,
+        scores, targets,
+    )
 
 
 def _work_dtype(dtype) -> jnp.dtype:
@@ -305,23 +313,30 @@ def _resolve_ustat_cap(
     return cap
 
 
-def _check_finite_scores(scores, fn_name: str) -> None:
+def _check_finite_scores(
+    scores, fn_name: str
+) -> Optional[Tuple[float, float]]:
     """The ustat families pack minority runs with ±inf sentinels, so a
     legitimately infinite score would be indistinguishable from padding
     (tie counts absorb pads; the binary ``n_chosen - hi`` base can go
     negative).  Raise eagerly instead of returning a wrong AUROC.
     Skippable via ``skip_value_checks`` like every other host check; the
-    gather-exact variants handle non-finite scores consistently."""
+    gather-exact variants handle non-finite scores consistently.
+
+    Returns the fetched ``(min, max)`` when the check ran (so callers can
+    reuse the round trip for their own route decisions), else ``None``."""
     if value_checks_enabled() and all_concrete(scores) and scores.size:
         # One fused round trip (the _host_checks bounds pattern): min/max
         # propagate NaN and surface +/-inf, so two scalars decide it.
-        lo, hi = bounds(scores)
+        lo, hi = (float(x) for x in bounds(scores))
         if not (np.isfinite(lo) and np.isfinite(hi)):
             raise ValueError(
                 f"{fn_name} requires finite scores (its packed-run padding "
                 "uses +/-inf sentinels); use the gather-exact variant for "
                 "inputs that may contain inf/nan."
             )
+        return lo, hi
+    return None
 
 
 def sharded_binary_auroc_ustat(
@@ -371,6 +386,17 @@ def sharded_binary_auroc_ustat(
         "max_minority_count_per_shard",
         "minority-class samples",
     )
+    fn = _compiled(
+        _build_binary_auroc_ustat,
+        (cap, bool(jax.config.jax_enable_x64)),
+        mesh,
+        axis,
+    )
+    return fn(scores, targets)
+
+
+def _build_binary_auroc_ustat(statics, mesh: Mesh, axis: str):
+    cap, _x64 = statics
     acc = _accum_dtype()
 
     def local(s, t):
@@ -412,7 +438,7 @@ def sharded_binary_auroc_ustat(
             factor == 0, jnp.asarray(0.5, acc), u / factor
         ).astype(jnp.float32)
 
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
@@ -421,7 +447,6 @@ def sharded_binary_auroc_ustat(
             check_vma=False,
         )
     )
-    return fn(scores, targets)
 
 
 def sharded_binary_auprc_ustat(
@@ -475,6 +500,17 @@ def sharded_binary_auprc_ustat(
         "max_positive_count_per_shard",
         "positive samples",
     )
+    fn = _compiled(
+        _build_binary_auprc_ustat,
+        (cap, bool(jax.config.jax_enable_x64)),
+        mesh,
+        axis,
+    )
+    return fn(scores, targets)
+
+
+def _build_binary_auprc_ustat(statics, mesh: Mesh, axis: str):
+    cap, _x64 = statics
     acc = _accum_dtype()
 
     def local(s, t):
@@ -507,7 +543,7 @@ def sharded_binary_auprc_ustat(
         )
         return jnp.where(n_pos == 0, 0.0, ap).astype(jnp.float32)
 
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
@@ -516,7 +552,6 @@ def sharded_binary_auprc_ustat(
             check_vma=False,
         )
     )
-    return fn(scores, targets)
 
 
 def sharded_multiclass_auroc_ustat(
@@ -528,6 +563,8 @@ def sharded_multiclass_auroc_ustat(
     num_classes: int,
     average: Optional[str] = "macro",
     max_class_count_per_shard: Optional[int] = None,
+    _kernel: str = "auto",
+    _interpret: bool = False,
 ) -> jax.Array:
     """Exact pod one-vs-rest multiclass AUROC with O(C ·
     max_class_count_per_shard · P) wire — ~O(N) for balanced classes,
@@ -558,6 +595,13 @@ def sharded_multiclass_auroc_ustat(
     Scores must be finite: the packed rows pad with ``-inf``/``inf``
     sentinels, so infinite scores are rejected eagerly (skippable via
     ``skip_value_checks``; use the gather-exact variant for such inputs).
+
+    Local counting has two exact formulations, chosen per call by
+    :func:`_mc_ustat_kernel_ok`: the Pallas rank-sum kernel on TPU
+    (sort-free; the default whenever its int32/magnitude bounds hold) and
+    the vmapped variadic-searchsorted pair otherwise.  ``_kernel``
+    (``"auto"``/``"pallas"``/``"searchsorted"``) and ``_interpret`` pin a
+    formulation — test hooks, not public API.
     """
     from torcheval_tpu.metrics.functional.classification.auroc import (
         _multiclass_auroc_param_check,
@@ -579,7 +623,7 @@ def sharded_multiclass_auroc_ustat(
             f"sample count {scores.shape[0]} must divide evenly over mesh "
             f"axis {axis!r} of size {size}."
         )
-    _check_finite_scores(scores, "sharded_multiclass_auroc_ustat")
+    known_bounds = _check_finite_scores(scores, "sharded_multiclass_auroc_ustat")
     n_local = scores.shape[0] // size
     if max_class_count_per_shard is None and all_concrete(scores, targets):
         # Autotune (round-2 VERDICT item 6): one fused round trip for the
@@ -602,7 +646,64 @@ def sharded_multiclass_auroc_ustat(
             "max_class_count_per_shard",
             "samples of one class",
         )
+    if _kernel == "auto":
+        use_kernel = _mc_ustat_kernel_ok(
+            scores, n_local * size, cap * size, known_bounds
+        )
+    else:
+        use_kernel = _kernel == "pallas"
+    fn = _compiled(
+        _build_mc_ustat,
+        (
+            num_classes,
+            average,
+            cap,
+            use_kernel,
+            _interpret,
+            bool(jax.config.jax_enable_x64),
+        ),
+        mesh,
+        axis,
+    )
+    return fn(scores, targets)
+
+
+def _mc_ustat_kernel_ok(
+    scores,
+    n_total: int,
+    cap_tot: int,
+    known_bounds: Optional[Tuple[float, float]],
+) -> bool:
+    """Call-time gate for the Pallas rank-sum local-count formulation of
+    the sharded multiclass ustat (vs the vmapped variadic-searchsorted
+    pair, which sorts (C, P·cap + n_local) twice — the very sort this
+    family exists to avoid).  Mirrors the single-device route guards:
+    TPU backend, kill-switches honored per call, concrete values, scores
+    strictly inside the ±3e38 pad sentinels, and the int32 exactness
+    bound — the psum'd global rank sums are ≤ N·cap_tot, so
+    ``cap_tot · N < 2^29`` keeps every term of the U identity exact.
+    ``known_bounds`` reuses the finite-check's fetched (min, max) so the
+    common path costs no extra device round trip."""
+    from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
+
+    if pallas_disabled() or ustat_disabled() or jax.default_backend() != "tpu":
+        return False
+    if not all_concrete(scores) or scores.size == 0:
+        # bounds() requires non-empty (jnp.min of empty raises); the
+        # searchsorted path handles the degenerate 0-sample case.
+        return False
+    if cap_tot > 2**16 or cap_tot * n_total >= 2**29:
+        return False
+    if known_bounds is None:
+        known_bounds = tuple(float(x) for x in bounds(scores))
+    lo, hi = known_bounds
+    return -3.0e38 < lo and hi < 3.0e38
+
+
+def _build_mc_ustat(statics, mesh: Mesh, axis: str):
+    num_classes, average, cap, use_kernel, interpret, _x64 = statics
     acc = _accum_dtype()
+    size = mesh.shape[axis]
 
     def local(s, t):
         s = s.astype(_work_dtype(s.dtype))
@@ -614,34 +715,19 @@ def sharded_multiclass_auroc_ustat(
             jnp.where(is_class, -s.T, jnp.inf), axis=-1
         )[:, :cap]
         gathered = lax.all_gather(packed, axis, axis=1, tiled=True)
-        rows = jnp.sort(gathered, axis=-1)  # (C, P·cap) asc, -inf pads first
-        row_len = rows.shape[-1]
-
-        # For every local sample and every class: exact #pos_c above/equal.
-        # method="sort" turns the 65M-query binary search into one variadic
-        # sort per class — measured ~35x the gather-based 'scan' lowering
-        # on v5e at the (2^16, 1000) north-star shape.
-        lo = jax.vmap(
-            lambda r, q: jnp.searchsorted(r, q, side="left", method="sort")
-        )(rows, s.T).astype(acc)
-        hi = jax.vmap(
-            lambda r, q: jnp.searchsorted(r, q, side="right", method="sort")
-        )(rows, s.T).astype(acc)
         n_pos = lax.psum(jnp.sum(is_class, axis=1, dtype=jnp.int32), axis)
-        above = row_len - hi  # -inf pads are never counted as > q
-        ties = hi - lo
-        contrib = jnp.where(is_class, 0.0, above + 0.5 * ties)
-        u = lax.psum(jnp.sum(contrib, axis=1, dtype=acc), axis)
-
-        n_total = s.shape[0] * mesh.shape[axis]
-        n_posf = n_pos.astype(acc)
-        factor = n_posf * (n_total - n_posf)
-        aurocs = jnp.where(
-            factor == 0, jnp.asarray(0.5, acc), u / factor
-        ).astype(jnp.float32)
+        n_total = s.shape[0] * size
+        if use_kernel:
+            aurocs = _mc_ustat_kernel_counts(
+                s, gathered, n_pos, n_total, axis, interpret
+            )
+        else:
+            aurocs = _mc_ustat_searchsorted_counts(
+                s, gathered, is_class, n_pos, n_total, axis, acc
+            )
         return aurocs.mean() if average == "macro" else aurocs
 
-    fn = jax.jit(
+    return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
@@ -650,7 +736,80 @@ def sharded_multiclass_auroc_ustat(
             check_vma=False,
         )
     )
-    return fn(scores, targets)
+
+
+def _mc_ustat_searchsorted_counts(
+    s, gathered, is_class, n_pos, n_total: int, axis: str, acc
+):
+    """Local pair counts via the vmapped variadic-searchsorted pair — the
+    portable formulation (any backend, any score magnitude, no int32
+    bound; float ``acc`` accumulation)."""
+    rows = jnp.sort(gathered, axis=-1)  # (C, P·cap) asc, -inf pads first
+    row_len = rows.shape[-1]
+
+    # For every local sample and every class: exact #pos_c above/equal.
+    # method="sort" turns the 65M-query binary search into one variadic
+    # sort per class — measured ~35x the gather-based 'scan' lowering
+    # on v5e at the (2^16, 1000) north-star shape.
+    lo = jax.vmap(
+        lambda r, q: jnp.searchsorted(r, q, side="left", method="sort")
+    )(rows, s.T).astype(acc)
+    hi = jax.vmap(
+        lambda r, q: jnp.searchsorted(r, q, side="right", method="sort")
+    )(rows, s.T).astype(acc)
+    above = row_len - hi  # -inf pads are never counted as > q
+    ties = hi - lo
+    contrib = jnp.where(is_class, 0.0, above + 0.5 * ties)
+    u = lax.psum(jnp.sum(contrib, axis=1, dtype=acc), axis)
+
+    n_posf = n_pos.astype(acc)
+    factor = n_posf * (n_total - n_posf)
+    return jnp.where(
+        factor == 0, jnp.asarray(0.5, acc), u / factor
+    ).astype(jnp.float32)
+
+
+def _mc_ustat_kernel_counts(
+    s, gathered, n_pos, n_total: int, axis: str, interpret: bool
+):
+    """Local pair counts via the Pallas rank-sum kernel
+    (``ops/pallas_ustat.rank_sum_counts``) — the sort-free TPU
+    formulation.  The single-device U identity lifts to the pod level
+    because the psum makes the query multiset global: with K_A/K_B the
+    psum-merged strict/non-strict rank sums of ALL samples against the
+    global per-class table (width ``cap_tot`` incl. pads),
+
+        2·U_c = 2·n_c·N − K_A − N·cap_tot + K_B − n_c²
+
+    — the same algebra as ``ops/pallas_ustat._auroc_from_rank_sums``,
+    exact in int32 under the route's ``cap_tot · N < 2^29`` bound.
+    Unlike the searchsorted path there is no same-class mask: summing
+    over ordered same-class pairs is the closed form n_c²/2 (globally),
+    which the identity subtracts."""
+    from torcheval_tpu.ops.pallas_ustat import rank_sum_counts
+
+    big = jnp.float32(3.0e38)
+    # Ascending rows with +BIG pads (the kernel's table contract); pad the
+    # width to a multiple of 16 — extra pad columns are inert, the
+    # identity's cap_tot term accounts for all pads uniformly.
+    rows = jnp.sort(jnp.where(jnp.isinf(gathered), big, gathered), axis=-1)
+    pad = (-rows.shape[-1]) % 16
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=3.0e38)
+    cap_tot = rows.shape[-1]
+
+    k_a = lax.psum(rank_sum_counts(s.T, rows, interpret=interpret), axis)
+    k_b = lax.psum(
+        rank_sum_counts(-s.T, -rows[:, ::-1], interpret=interpret), axis
+    )
+    two_u = 2 * n_pos * n_total - k_a - n_total * cap_tot + k_b - n_pos * n_pos
+    n_posf = n_pos.astype(jnp.float32)
+    factor = n_posf * (jnp.float32(n_total) - n_posf)
+    return jnp.where(
+        factor == 0,
+        jnp.float32(0.5),
+        two_u.astype(jnp.float32) / (2.0 * factor),
+    )
 
 
 @partial(jax.jit, static_argnames=("num_classes", "world"))

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
+from torcheval_tpu.parallel._compile_cache import compiled_spmd
+
 Reduction = Union[str, Any]  # 'sum' | 'max' | 'min' | 'mean' | 'concat' | pytree
 
 _REDUCERS = {
@@ -137,6 +139,12 @@ def sharded_auroc_histogram(
     Use the exact ``binary_auroc`` on gathered buffers when bit-exactness
     matters more than wire cost.
     """
+    return _run_sharded_binary(
+        _build_auroc_hist_local, num_bins, mesh, axis, scores, targets, weights
+    )
+
+
+def _build_auroc_hist_local(num_bins: int, axis: str):
     def local(s, t, w):
         pos, tot = _local_binned_counts(s, t, w, num_bins, axis)
         neg = tot - pos
@@ -147,7 +155,7 @@ def sharded_auroc_histogram(
         area = jnp.trapezoid(cum_tp, cum_fp)
         return jnp.where(factor == 0, 0.5, area / factor)
 
-    return _run_sharded_binary(local, mesh, axis, scores, targets, weights)
+    return local
 
 
 def _check_scores_in_unit_interval(scores) -> None:
@@ -189,8 +197,15 @@ def _local_binned_counts(s, t, w, num_bins: int, axis: str):
     return lax.psum(pos, axis), lax.psum(tot, axis)
 
 
-def _run_sharded_binary(local, mesh: Mesh, axis: str, scores, targets, weights):
-    """Shared shape check + shard_map wrapper for the 1-D histogram metrics."""
+def _run_sharded_binary(
+    local_builder, num_bins: int, mesh: Mesh, axis: str, scores, targets, weights
+):
+    """Shared shape check + shard_map wrapper for the 1-D histogram metrics.
+
+    ``local_builder(num_bins, axis)`` is a module-level factory for the
+    per-device function; routing through the shared ``compiled_spmd``
+    memoizer keeps the jitted program cached across calls (a per-call
+    closure would re-trace and re-compile every invocation)."""
     if scores.ndim != 1 or targets.ndim != 1:
         raise ValueError(
             f"scores and targets should be 1-D, got {scores.shape} / {targets.shape}."
@@ -198,7 +213,17 @@ def _run_sharded_binary(local, mesh: Mesh, axis: str, scores, targets, weights):
     _check_scores_in_unit_interval(scores)
     if weights is None:
         weights = jnp.ones_like(scores, dtype=jnp.float32)
-    fn = jax.jit(
+    fn = compiled_spmd(_build_hist_spmd, (local_builder, (num_bins,)), mesh, axis)
+    return fn(scores, targets, weights)
+
+
+def _build_hist_spmd(statics, mesh: Mesh, axis: str):
+    """shard_map builder for the histogram family (shared-memoizer
+    convention, see ``parallel._compile_cache``): ``statics`` carries the
+    module-level local-builder plus its own statics tuple."""
+    local_builder, local_statics = statics
+    local = local_builder(*local_statics, axis)
+    return jax.jit(
         jax.shard_map(
             local,
             mesh=mesh,
@@ -206,7 +231,6 @@ def _run_sharded_binary(local, mesh: Mesh, axis: str, scores, targets, weights):
             out_specs=PartitionSpec(),
         )
     )
-    return fn(scores, targets, weights)
 
 
 def sharded_auprc_histogram(
@@ -230,6 +254,12 @@ def sharded_auprc_histogram(
     otherwise.  No positives → 0 (matching ``binary_auprc``).  Invariant
     to the scale of ``weights`` (like sklearn's ``sample_weight``)."""
 
+    return _run_sharded_binary(
+        _build_auprc_hist_local, num_bins, mesh, axis, scores, targets, weights
+    )
+
+
+def _build_auprc_hist_local(num_bins: int, axis: str):
     def local(s, t, w):
         pos, tot = _local_binned_counts(s, t, w, num_bins, axis)
         # Descending-threshold bins: cumulative TP / predicted-positive
@@ -248,7 +278,7 @@ def sharded_auprc_histogram(
         )
         return jnp.where(total_pos == 0, 0.0, ap)
 
-    return _run_sharded_binary(local, mesh, axis, scores, targets, weights)
+    return local
 
 
 def sharded_multiclass_auroc_histogram(
@@ -277,7 +307,16 @@ def sharded_multiclass_auroc_histogram(
         )
     _check_scores_in_unit_interval(scores)
     num_classes = scores.shape[1]
+    fn = compiled_spmd(
+        _build_hist_spmd,
+        (_build_mc_hist_local, (num_bins, num_classes, average)),
+        mesh,
+        axis,
+    )
+    return fn(scores, targets)
 
+
+def _build_mc_hist_local(num_bins: int, num_classes: int, average, axis: str):
     def local(s, t):
         idx = jnp.clip((s * num_bins).astype(jnp.int32), 0, num_bins - 1)
         class_grid = jnp.broadcast_to(
@@ -309,12 +348,4 @@ def sharded_multiclass_auroc_histogram(
         aurocs = jnp.where(factor == 0, 0.5, area / factor)
         return aurocs.mean() if average == "macro" else aurocs
 
-    fn = jax.jit(
-        jax.shard_map(
-            local,
-            mesh=mesh,
-            in_specs=(PartitionSpec(axis), PartitionSpec(axis)),
-            out_specs=PartitionSpec(),
-        )
-    )
-    return fn(scores, targets)
+    return local
