@@ -93,7 +93,7 @@ def _ustat_cap_check(
         all_concrete,
         value_checks_enabled,
     )
-    from torcheval_tpu.ops.pallas_ustat import _route_stats
+    from torcheval_tpu.ops.pallas_ustat import _BIG, _route_stats
 
     if cap % 16 != 0 or cap < 16:
         raise ValueError(f"ustat_cap must be a positive multiple of 16, got {cap}.")
@@ -102,7 +102,11 @@ def _ustat_cap_check(
             f"ustat_cap·N = {cap * input.shape[0]} exceeds the exact-int32 "
             "bound 2^29; leave ustat_cap=None for this shape."
         )
-    if not value_checks_enabled() or not all_concrete(input, target):
+    if (
+        not value_checks_enabled()
+        or not all_concrete(input, target)
+        or input.size == 0  # N=0 takes the degenerate path downstream
+    ):
         return
     import numpy as np
 
@@ -112,7 +116,7 @@ def _ustat_cap_check(
             f"ustat_cap={cap} but one class has {int(max_count)} samples; "
             "raise the cap (or leave it None to self-decide)."
         )
-    if not (-3.0e38 < lo and hi < 3.0e38):
+    if not (-_BIG < lo and hi < _BIG):
         raise ValueError(
             "the rank-sum formulation requires |scores| < 3e38 (its pad "
             "sentinel); leave ustat_cap=None for such inputs."

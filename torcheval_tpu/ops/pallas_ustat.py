@@ -308,6 +308,12 @@ def rank_hist_counts(
             f"per-bin accumulation, got {n}"
         )
     bc = cap // _FW
+    # Mosaic ICEs on this kernel when the (8·Bc, tile) one-hot operand
+    # exceeds ~2^19 elements (cap 512 at tile 4096 crashes the remote
+    # compiler; tile 2048 compiles and is correct) — shrink the tile to
+    # stay under the empirical bound.
+    while bc * _ROWS * tile > 2**19 and tile > 128:
+        tile //= 2
     n_pad = _pad_to(n, tile)
     tile = min(tile, n_pad)
     r_pad = _pad_to(r, _ROWS)

@@ -685,6 +685,7 @@ def _mc_ustat_kernel_ok(
     ``known_bounds`` reuses the finite-check's fetched (min, max) so the
     common path costs no extra device round trip."""
     from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
+    from torcheval_tpu.ops.pallas_ustat import _BIG
 
     if pallas_disabled() or ustat_disabled() or jax.default_backend() != "tpu":
         return False
@@ -695,9 +696,14 @@ def _mc_ustat_kernel_ok(
     if cap_tot > 2**16 or cap_tot * n_total >= 2**29:
         return False
     if known_bounds is None:
+        if not value_checks_enabled():
+            # skip_value_checks keeps this path fully async (no host
+            # sync): the documented finite-scores precondition is the
+            # caller's contract, like the pinned-cap path in auroc.py.
+            return True
         known_bounds = tuple(float(x) for x in bounds(scores))
     lo, hi = known_bounds
-    return -3.0e38 < lo and hi < 3.0e38
+    return -_BIG < lo and hi < _BIG
 
 
 def _build_mc_ustat(statics, mesh: Mesh, axis: str):
@@ -786,16 +792,17 @@ def _mc_ustat_kernel_counts(
     Unlike the searchsorted path there is no same-class mask: summing
     over ordered same-class pairs is the closed form n_c²/2 (globally),
     which the identity subtracts."""
-    from torcheval_tpu.ops.pallas_ustat import rank_sum_counts
+    from torcheval_tpu.ops.pallas_ustat import _BIG, rank_sum_counts
 
-    big = jnp.float32(3.0e38)
     # Ascending rows with +BIG pads (the kernel's table contract); pad the
     # width to a multiple of 16 — extra pad columns are inert, the
     # identity's cap_tot term accounts for all pads uniformly.
-    rows = jnp.sort(jnp.where(jnp.isinf(gathered), big, gathered), axis=-1)
+    rows = jnp.sort(
+        jnp.where(jnp.isinf(gathered), jnp.float32(_BIG), gathered), axis=-1
+    )
     pad = (-rows.shape[-1]) % 16
     if pad:
-        rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=3.0e38)
+        rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=_BIG)
     cap_tot = rows.shape[-1]
 
     k_a = lax.psum(rank_sum_counts(s.T, rows, interpret=interpret), axis)
