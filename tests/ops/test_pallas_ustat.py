@@ -39,6 +39,67 @@ def _np_rank_sums(tables, queries):
     )
 
 
+class TestSplit3Bf16(unittest.TestCase):
+    def test_reconstruction_is_bitwise(self):
+        # The kernels' gather exactness rests on a + b + c == x bit-for-bit
+        # (summed low-to-high) over the routes' admitted domain: zero or
+        # _MIN_SPLIT ≤ |x| < 3e38.  Adversarial values: full 24-bit
+        # mantissas, negatives, huge magnitudes up to the pad sentinel,
+        # full-mantissa values AT the magnitude floor, exact powers of
+        # two, and bf16-exact values.
+        from torcheval_tpu.ops.pallas_ustat import (
+            _BIG,
+            _MIN_SPLIT,
+            _split3_bf16,
+        )
+
+        rng = np.random.default_rng(7)
+        floor = np.float32(_MIN_SPLIT)
+        vals = np.concatenate(
+            [
+                rng.random(4096).astype(np.float32),  # full mantissas
+                -rng.random(1024).astype(np.float32),
+                (rng.random(1024).astype(np.float32) * 2 - 1) * _BIG,
+                np.array([_BIG, -_BIG, 0.0, 1.0, -1.0, 0.5, -0.5, 2.0], np.float32),
+                (1.0 + rng.random(1024).astype(np.float32)) * floor,
+                np.float32(2.0) ** rng.integers(-100, 127, 512),
+            ]
+        ).astype(np.float32).reshape(1, 8, -1)
+        assert np.all((vals == 0) | (np.abs(vals) >= floor))
+        split = np.asarray(
+            _split3_bf16(jnp.asarray(vals)), dtype=np.float32
+        )
+        a, b, c = split[:, 0:8], split[:, 8:16], split[:, 16:24]
+        recon = (c + b) + a
+        np.testing.assert_array_equal(
+            recon.view(np.uint32), vals.view(np.uint32)
+        )
+
+    def test_routes_decline_subnormal_region_scores(self):
+        # Below _MIN_SPLIT the low split component leaves bf16's normal
+        # range and the gather would be inexact — both route deciders
+        # must send such data to the sort path.  (Only meaningful where the
+        # routes can fire at all, i.e. on TPU; off-TPU they return None for
+        # the backend reason, which this test also accepts by asserting
+        # None.)
+        from torcheval_tpu.ops.pallas_ustat import (
+            binary_ustat_route,
+            ustat_route_cap,
+        )
+
+        rng = np.random.default_rng(11)
+        n, c = 2**16, 256
+        scores = rng.random((n, c)).astype(np.float32)
+        scores[0, 0] = np.float32(2.0**-120)
+        target = rng.integers(0, c, n).astype(np.int32)
+        self.assertIsNone(
+            ustat_route_cap(jnp.asarray(scores), jnp.asarray(target), c)
+        )
+        rows = jnp.asarray(scores[:, 0][None])
+        t_rows = jnp.asarray((rng.random(n)[None] < 0.01).astype(np.int32))
+        self.assertIsNone(binary_ustat_route(rows, t_rows))
+
+
 class TestRankSumCounts(unittest.TestCase):
     def _check(self, tables, queries, tile=512, msg=""):
         got = np.asarray(

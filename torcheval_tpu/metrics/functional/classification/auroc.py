@@ -69,7 +69,11 @@ def multiclass_auroc(
     Decide it eagerly with :func:`torcheval_tpu.ops.pallas_ustat.
     ustat_route_cap` on a representative batch.  Results match the sort
     path to 1 ULP per class (both are exact integer-count formulations;
-    only the final float division rounds differently)."""
+    only the final float division rounds differently).  A pinned cap
+    asserts the kernel's score domain — zero or 2^-100 ≤ |score| < 3e38
+    — which eager calls validate; under ``skip_value_checks`` (or inside
+    jit, where values are invisible) that domain is the caller's
+    contract."""
     _multiclass_auroc_param_check(num_classes, average)
     input, target = jnp.asarray(input), jnp.asarray(target)
     _multiclass_auroc_update_input_check(input, target, num_classes)
@@ -110,15 +114,20 @@ def _ustat_cap_check(
         return
     import numpy as np
 
-    lo, hi, max_count = (float(x) for x in np.asarray(_route_stats(input, target)))
+    from torcheval_tpu.ops.pallas_ustat import _MIN_SPLIT
+
+    lo, hi, max_count, min_nz = (
+        float(x) for x in np.asarray(_route_stats(input, target))
+    )
     if max_count > cap:
         raise ValueError(
             f"ustat_cap={cap} but one class has {int(max_count)} samples; "
             "raise the cap (or leave it None to self-decide)."
         )
-    if not (-_BIG < lo and hi < _BIG):
+    if not (-_BIG < lo and hi < _BIG) or min_nz < _MIN_SPLIT:
         raise ValueError(
-            "the rank-sum formulation requires |scores| < 3e38 (its pad "
+            "the rank-sum formulation requires nonzero scores with "
+            "2^-100 <= |score| < 3e38 (its bf16-split gather and pad "
             "sentinel); leave ustat_cap=None for such inputs."
         )
 

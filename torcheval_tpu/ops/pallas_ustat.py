@@ -31,11 +31,15 @@ The kernel computes ``K`` for 8 rows per grid step with each row's own
 1. Coarse: compare queries against the ``Bc = cap/16`` block bounds
    (every 16th table entry) — ``Bc`` broadcast compares on ``(8, tile)``
    blocks select each query's 16-entry candidate block.
-2. Gather-matmul: ONE ``(128, 8·Bc) @ (8·Bc, tile)`` f32 MXU matmul with
-   an interleaved block-diagonal table pulls each query's 16 candidate
-   thresholds (a one-hot f32 dot reproduces them bit-exactly —
-   ``precision=HIGHEST``; the TPU's default bf16 passes would mis-rank
-   scores between a threshold and its bf16 image).
+2. Gather-matmul: ``(128, 8·Bc) @ (8·Bc, tile)`` MXU matmuls with an
+   interleaved block-diagonal table pull each query's 16 candidate
+   thresholds bit-exactly.  A single bf16 pass would mis-rank scores
+   between a threshold and its bf16 image, and f32 ``precision=HIGHEST``
+   costs ~6 MXU passes; instead the table is pre-split into THREE exact
+   bf16 components (8+8+8 mantissa bits, :func:`_split3_bf16`) and
+   gathered with three native bf16 passes — the one-hot dot selects each
+   component exactly and the f32 re-assembly is bit-exact (headline
+   device step 44.5 → 27–33 ms).
 3. Fine: 16 sublane-sliced compares count within the block; rank =
    ``16·(block − 1) + fine``; one lane reduction per tile accumulates the
    per-row partial into an int32 VMEM carry (exact: per-tile partials are
@@ -62,10 +66,86 @@ _FW = 16  # fine width: table entries per coarse block
 _ROWS = 8  # rows per grid step (f32 min sublane tile)
 _TILE = 4096  # query lanes per grid step
 _BIG = 3.0e38  # pad sentinel; the route guarantees |score| < _BIG
+# Smallest nonzero |table value| the bf16-split gather reproduces exactly:
+# every split component must stay bf16-NORMAL (subnormal bf16 flushes in
+# conversion), and the low component of a full-mantissa f32 at exponent e
+# can be as small as its last bit 2^(e-23) — so e ≥ -103 (measured: exact
+# through e = -103, first failures at -104).  2^-100 keeps a margin; the
+# routes send scores below it to the sort path (zero itself is exact).
+_MIN_SPLIT = 2.0**-100
 
 
 def _pad_to(n: int, m: int) -> int:
     return max(m, -(-n // m) * m)
+
+
+def _split3_bf16(x: jax.Array) -> jax.Array:
+    """Exact 3-term bf16 decomposition of f32, stacked on the sublane dim.
+
+    ``a = bf16(x)``, ``b = bf16(x − a)``, ``c = x − a − b`` — each
+    subtraction is exact in f32 (the residual after removing the top bf16
+    component has ≤ 16 significant bits, the next ≤ 8, so ``c`` is itself
+    bf16-exact) and summing the components low-to-high reconstructs ``x``
+    bit-for-bit.  This turns the kernels' one f32 ``precision=HIGHEST``
+    gather matmul (~6 MXU passes) into three native bf16 passes with f32
+    accumulation: the one-hot selector is exactly bf16, each product
+    selects a single component exactly, and the f32 re-assembly is the
+    exact split sum — the (2^17, 1000) cap-256 headline device step
+    measured 44.5 → 27–33 ms on v5e.
+
+    Input ``(g, R, C)`` f32 → output ``(g, 3·R, C)`` bf16 with the three
+    components at row offsets 0, R, 2R.
+
+    The truncations are computed by INTEGER masking of the top 16 bits,
+    not ``astype(bf16)`` round trips: XLA's TPU bf16-conversion-folding
+    pass elides ``x − f32(bf16(x))`` as ``x − x`` (measured on v5e: the
+    b/c components silently became zero), and bit-level ops are opaque to
+    it.  Truncation (round-toward-zero) splits exactly like rounding: the
+    three masked fields partition x's 24-bit significand, every
+    subtraction is exact, and each component converts to bf16 exactly
+    (≤ 8 significant bits each).
+    """
+    a = _trunc_bf16_f32(x)
+    r1 = x - a
+    b = _trunc_bf16_f32(r1)
+    r2 = r1 - b
+    return jnp.concatenate(
+        [
+            a.astype(jnp.bfloat16),
+            b.astype(jnp.bfloat16),
+            r2.astype(jnp.bfloat16),
+        ],
+        axis=-2,
+    )
+
+
+def _trunc_bf16_f32(x: jax.Array) -> jax.Array:
+    """The round-toward-zero bf16 image of f32 ``x``, as f32 — top 16 bits
+    kept by integer masking (convert-free; see :func:`_split3_bf16`)."""
+    return lax.bitcast_convert_type(
+        lax.bitcast_convert_type(x, jnp.uint32) & jnp.uint32(0xFFFF0000),
+        jnp.float32,
+    )
+
+
+def _gather_split3(ttab3, oc):
+    """Exact f32 gather through three bf16 MXU passes (see
+    :func:`_split3_bf16`).  ``ttab3`` is ``(3·R, C)`` bf16; ``oc`` is the
+    f32 one-hot selector ``(C, tile)``.  Summing components low-to-high
+    keeps the reconstruction bit-exact."""
+    rows = ttab3.shape[0] // 3
+    ocb = oc.astype(jnp.bfloat16)
+
+    def dot(tt):
+        return lax.dot_general(
+            tt,
+            ocb,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    low = dot(ttab3[2 * rows :]) + dot(ttab3[rows : 2 * rows])
+    return low + dot(ttab3[:rows])
 
 
 def _rank_sum_kernel(
@@ -86,7 +166,7 @@ def _rank_sum_kernel(
         acc[:, :] = jnp.zeros(acc.shape, jnp.int32)
 
     q = q_ref[:]  # (8, tile) f32
-    ttab = ttab_ref[0]  # (128, 8*Bc) f32
+    ttab3 = ttab_ref[0]  # (3·128, 8*Bc) bf16 split components
     bounds = bounds_ref[0]  # (8, Bc) f32
     bc = bounds.shape[1]
 
@@ -104,13 +184,9 @@ def _rank_sum_kernel(
         axis=0,
     )  # (8*Bc, tile)
 
-    gathered = lax.dot_general(
-        ttab,
-        oc,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=lax.Precision.HIGHEST,
-    )  # (128, tile): row w*8+r = row r's selected-block entry w
+    # Exact f32 gather via three bf16 MXU passes (see _split3_bf16):
+    # (128, tile), row w*8+r = row r's selected-block entry w.
+    gathered = _gather_split3(ttab3, oc)
 
     fine = (gathered[0:_ROWS] <= q).astype(jnp.float32)
     for w in range(1, _FW):
@@ -177,6 +253,7 @@ def rank_sum_counts(
     ttab = jnp.einsum(
         "grbw,rs->gwrbs", t4, jnp.eye(_ROWS, dtype=jnp.float32)
     ).reshape(g, _FW * _ROWS, bc * _ROWS)
+    ttab3 = _split3_bf16(ttab)  # (g, 3·128, bc·8) bf16
     bounds = t4[:, :, :, 0]  # (g, 8, Bc)
 
     out = pl.pallas_call(
@@ -184,14 +261,16 @@ def rank_sum_counts(
         grid=(g, n_pad // tile),
         in_specs=[
             pl.BlockSpec((_ROWS, tile), lambda i, j: (i, j)),
-            pl.BlockSpec((1, _FW * _ROWS, bc * _ROWS), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec(
+                (1, 3 * _FW * _ROWS, bc * _ROWS), lambda i, j: (i, 0, 0)
+            ),
             pl.BlockSpec((1, _ROWS, bc), lambda i, j: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec((_ROWS, 1), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((r_pad, 1), jnp.int32),
         scratch_shapes=[pltpu.VMEM((_ROWS, 128), jnp.int32)],
         interpret=interpret,
-    )(q, ttab, bounds)
+    )(q, ttab3, bounds)
     return out[:r, 0]
 
 
@@ -235,7 +314,7 @@ def _rank_hist_kernel(
         acc[:, :] = jnp.zeros(acc.shape, jnp.float32)
 
     q = q_ref[:]  # (8, tile)
-    ttab = ttab_ref[0]  # (128, 8*Bc)
+    ttab3 = ttab_ref[0]  # (3·128, 8*Bc) bf16 split components
     bounds = bounds_ref[0]  # (8, Bc)
     bc = bounds.shape[1]
 
@@ -253,13 +332,8 @@ def _rank_hist_kernel(
         axis=0,
     )  # (8*Bc, tile)
 
-    gathered = lax.dot_general(
-        ttab,
-        oc,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=lax.Precision.HIGHEST,
-    )  # (128, tile)
+    # Exact f32 gather via three bf16 MXU passes (see _split3_bf16).
+    gathered = _gather_split3(ttab3, oc)  # (128, tile)
 
     gef = [
         (gathered[w * _ROWS : (w + 1) * _ROWS] <= q).astype(jnp.float32)
@@ -330,6 +404,7 @@ def rank_hist_counts(
     ttab = jnp.einsum(
         "grbw,rs->gwrbs", t4, jnp.eye(_ROWS, dtype=jnp.float32)
     ).reshape(g, _FW * _ROWS, bc * _ROWS)
+    ttab3 = _split3_bf16(ttab)  # (g, 3·128, bc·8) bf16
     bounds = t4[:, :, :, 0]
 
     cross = pl.pallas_call(
@@ -337,7 +412,9 @@ def rank_hist_counts(
         grid=(g, n_pad // tile),
         in_specs=[
             pl.BlockSpec((_ROWS, tile), lambda i, j: (i, j)),
-            pl.BlockSpec((1, _FW * _ROWS, bc * _ROWS), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec(
+                (1, 3 * _FW * _ROWS, bc * _ROWS), lambda i, j: (i, 0, 0)
+            ),
             pl.BlockSpec((1, _ROWS, bc), lambda i, j: (i, 0, 0)),
         ],
         out_specs=pl.BlockSpec(
@@ -350,7 +427,7 @@ def rank_hist_counts(
             pltpu.VMEM((bc * _ROWS, _FW * _ROWS), jnp.float32)
         ],
         interpret=interpret,
-    )(q, ttab, bounds)
+    )(q, ttab3, bounds)
 
     # Diagonal (r, r) blocks of the cross matrix are the histograms.
     m5 = cross.reshape(g, bc, _ROWS, _FW, _ROWS)
@@ -607,8 +684,10 @@ def binary_ustat_route(
     # ONE device fetch for all five stats (the _host_checks bounds
     # pattern) — per-element float() would block once per scalar.
     stats = np.asarray(_binary_route_stats(scores, target))
-    lo, hi, non01, max_pos, max_neg = (float(x) for x in stats)
+    lo, hi, non01, max_pos, max_neg, min_nz = (float(x) for x in stats)
     if not (lo > -_BIG and hi < _BIG):
+        return None
+    if min_nz < _MIN_SPLIT:  # subnormal-region scores: bf16 split inexact
         return None
     if non01 != 0.0:  # any target outside {0, 1} keeps the sort path
         return None
@@ -625,8 +704,9 @@ def binary_ustat_route(
 @jax.jit
 def _binary_route_stats(scores, target) -> jax.Array:
     """Score bounds, the count of targets outside {0, 1} (exact-membership
-    check: min/max alone would pass e.g. {0, 0.5, 1}), and per-row
-    class-count maxima — in ONE fused device program."""
+    check: min/max alone would pass e.g. {0, 0.5, 1}), per-row class-count
+    maxima, and the smallest nonzero |score| (the bf16-split exactness
+    gate) — in ONE fused device program."""
     pos = jnp.sum(target != 0, axis=-1, dtype=jnp.int32)
     neg = scores.shape[-1] - pos
     non01 = jnp.sum((target != 0) & (target != 1), dtype=jnp.int32)
@@ -637,8 +717,16 @@ def _binary_route_stats(scores, target) -> jax.Array:
             non01.astype(jnp.float32),
             pos.max().astype(jnp.float32),
             neg.max().astype(jnp.float32),
+            _min_nonzero_abs(scores),
         ]
     )
+
+
+def _min_nonzero_abs(scores) -> jax.Array:
+    """Smallest nonzero |score| (``inf`` when all scores are zero) — the
+    bf16-split gather is exact only for magnitudes ≥ ``_MIN_SPLIT``."""
+    mag = jnp.abs(scores)
+    return jnp.min(jnp.where(mag == 0, jnp.inf, mag)).astype(jnp.float32)
 
 
 def ustat_route_cap(
@@ -654,18 +742,21 @@ def ustat_route_cap(
         return None  # no cap can pass at this N: skip the device sync
     if not _route_guards_ok(scores, target):
         return None
-    lo, hi, max_count = (
+    lo, hi, max_count, min_nz = (
         float(x) for x in np.asarray(_route_stats(scores, target))
     )
     if not (lo > -_BIG and hi < _BIG):  # non-finite or past the sentinel
+        return None
+    if min_nz < _MIN_SPLIT:  # subnormal-region scores: bf16 split inexact
         return None
     return _win_cap(max_count, scores.shape[0])
 
 
 @jax.jit
 def _route_stats(scores, target) -> jax.Array:
-    """min, max, and largest per-class count in ONE fused round trip (the
-    _host_checks bounds pattern: route decisions cost one device sync)."""
+    """min, max, largest per-class count, and smallest nonzero |score| in
+    ONE fused round trip (the _host_checks bounds pattern: route decisions
+    cost one device sync)."""
     counts = jnp.zeros((scores.shape[1],), jnp.int32).at[
         target.astype(jnp.int32)
     ].add(1)
@@ -674,6 +765,7 @@ def _route_stats(scores, target) -> jax.Array:
             jnp.min(scores).astype(jnp.float32),
             jnp.max(scores).astype(jnp.float32),
             counts.max().astype(jnp.float32),
+            _min_nonzero_abs(scores),
         ]
     )
 

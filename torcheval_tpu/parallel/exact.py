@@ -53,7 +53,6 @@ import numpy as np
 
 from torcheval_tpu.metrics.functional._host_checks import (
     all_concrete,
-    bounds,
     value_checks_enabled,
 )
 from torcheval_tpu.parallel._compile_cache import compiled_spmd
@@ -315,7 +314,7 @@ def _resolve_ustat_cap(
 
 def _check_finite_scores(
     scores, fn_name: str
-) -> Optional[Tuple[float, float]]:
+) -> Optional[Tuple[float, float, float]]:
     """The ustat families pack minority runs with ±inf sentinels, so a
     legitimately infinite score would be indistinguishable from padding
     (tie counts absorb pads; the binary ``n_chosen - hi`` base can go
@@ -323,20 +322,37 @@ def _check_finite_scores(
     Skippable via ``skip_value_checks`` like every other host check; the
     gather-exact variants handle non-finite scores consistently.
 
-    Returns the fetched ``(min, max)`` when the check ran (so callers can
-    reuse the round trip for their own route decisions), else ``None``."""
+    Returns the fetched ``(min, max, min nonzero |score|)`` when the
+    check ran (so callers can reuse the round trip for their own route
+    decisions), else ``None``."""
     if value_checks_enabled() and all_concrete(scores) and scores.size:
         # One fused round trip (the _host_checks bounds pattern): min/max
         # propagate NaN and surface +/-inf, so two scalars decide it.
-        lo, hi = (float(x) for x in bounds(scores))
+        lo, hi, min_nz = (float(x) for x in np.asarray(_finite_gate_stats(scores)))
         if not (np.isfinite(lo) and np.isfinite(hi)):
             raise ValueError(
                 f"{fn_name} requires finite scores (its packed-run padding "
                 "uses +/-inf sentinels); use the gather-exact variant for "
                 "inputs that may contain inf/nan."
             )
-        return lo, hi
+        return lo, hi, min_nz
     return None
+
+
+@jax.jit
+def _finite_gate_stats(scores) -> jax.Array:
+    """min, max, and smallest nonzero |score| in ONE fused round trip —
+    the finite check plus the Pallas-kernel gate's stats (bf16-split
+    exactness needs magnitudes ≥ 2^-100; see ``pallas_ustat._MIN_SPLIT``)."""
+    from torcheval_tpu.ops.pallas_ustat import _min_nonzero_abs
+
+    return jnp.stack(
+        [
+            jnp.min(scores).astype(jnp.float32),
+            jnp.max(scores).astype(jnp.float32),
+            _min_nonzero_abs(scores),
+        ]
+    )
 
 
 def sharded_binary_auroc_ustat(
@@ -623,7 +639,7 @@ def sharded_multiclass_auroc_ustat(
             f"sample count {scores.shape[0]} must divide evenly over mesh "
             f"axis {axis!r} of size {size}."
         )
-    known_bounds = _check_finite_scores(scores, "sharded_multiclass_auroc_ustat")
+    known_stats = _check_finite_scores(scores, "sharded_multiclass_auroc_ustat")
     n_local = scores.shape[0] // size
     if max_class_count_per_shard is None and all_concrete(scores, targets):
         # Autotune (round-2 VERDICT item 6): one fused round trip for the
@@ -648,7 +664,7 @@ def sharded_multiclass_auroc_ustat(
         )
     if _kernel == "auto":
         use_kernel = _mc_ustat_kernel_ok(
-            scores, n_local * size, cap * size, known_bounds
+            scores, n_local * size, cap * size, known_stats
         )
     else:
         use_kernel = _kernel == "pallas"
@@ -672,38 +688,46 @@ def _mc_ustat_kernel_ok(
     scores,
     n_total: int,
     cap_tot: int,
-    known_bounds: Optional[Tuple[float, float]],
+    known_stats: Optional[Tuple[float, float, float]],
 ) -> bool:
     """Call-time gate for the Pallas rank-sum local-count formulation of
     the sharded multiclass ustat (vs the vmapped variadic-searchsorted
     pair, which sorts (C, P·cap + n_local) twice — the very sort this
     family exists to avoid).  Mirrors the single-device route guards:
     TPU backend, kill-switches honored per call, concrete values, scores
-    strictly inside the ±3e38 pad sentinels, and the int32 exactness
+    strictly inside the ±3e38 pad sentinels and outside the bf16-split
+    subnormal region (|score| ≥ 2^-100 or zero), and the int32 exactness
     bound — the psum'd global rank sums are ≤ N·cap_tot, so
     ``cap_tot · N < 2^29`` keeps every term of the U identity exact.
-    ``known_bounds`` reuses the finite-check's fetched (min, max) so the
-    common path costs no extra device round trip."""
+    ``known_stats`` reuses the finite-check's fetched (min, max, min
+    nonzero |score|) so the common path costs no extra device round
+    trip."""
     from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
-    from torcheval_tpu.ops.pallas_ustat import _BIG
+    from torcheval_tpu.ops.pallas_ustat import _BIG, _MIN_SPLIT
 
     if pallas_disabled() or ustat_disabled() or jax.default_backend() != "tpu":
         return False
     if not all_concrete(scores) or scores.size == 0:
-        # bounds() requires non-empty (jnp.min of empty raises); the
-        # searchsorted path handles the degenerate 0-sample case.
+        # The stats fetch requires non-empty (jnp.min of empty raises);
+        # the searchsorted path handles the degenerate 0-sample case.
         return False
     if cap_tot > 2**16 or cap_tot * n_total >= 2**29:
         return False
-    if known_bounds is None:
+    if known_stats is None:
         if not value_checks_enabled():
             # skip_value_checks keeps this path fully async (no host
-            # sync): the documented finite-scores precondition is the
-            # caller's contract, like the pinned-cap path in auroc.py.
-            return True
-        known_bounds = tuple(float(x) for x in bounds(scores))
-    lo, hi = known_bounds
-    return -_BIG < lo and hi < _BIG
+            # sync) — but the kernel's score-domain preconditions
+            # (|s| < 3e38, no nonzero magnitudes under 2^-100) can then
+            # not be verified, so the SAFE searchsorted formulation runs
+            # (exact for all finite scores).  Callers who assert the
+            # domain themselves can force the kernel with
+            # ``_kernel="pallas"``.
+            return False
+        known_stats = tuple(
+            float(x) for x in np.asarray(_finite_gate_stats(scores))
+        )
+    lo, hi, min_nz = known_stats
+    return -_BIG < lo and hi < _BIG and min_nz >= _MIN_SPLIT
 
 
 def _build_mc_ustat(statics, mesh: Mesh, axis: str):
