@@ -420,6 +420,14 @@ def bench_sharded_auroc_sync() -> Tuple[str, float, Optional[float]]:
         _force(sharded_auroc_histogram(s, t, mesh=mesh, num_bins=16384))
 
     ours = n / _time_steps(step)
+    extras = _device_stats(
+        lambda ss, tt, i: sharded_auroc_histogram(
+            ss + i * jnp.float32(1e-38), tt, mesh=mesh, num_bins=16384
+        ),
+        (s, t),
+        n,
+        scores.nbytes + target.nbytes,
+    )
 
     ref = None
     try:
@@ -438,7 +446,7 @@ def bench_sharded_auroc_sync() -> Tuple[str, float, Optional[float]]:
         ref = n_ref / _time_steps(rstep, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
-    return "sharded_auroc_histogram_sync", ours, ref
+    return "sharded_auroc_histogram_sync", ours, ref, extras
 
 
 def bench_sharded_multiclass_auroc() -> Tuple[str, float, Optional[float]]:
@@ -468,6 +476,14 @@ def bench_sharded_multiclass_auroc() -> Tuple[str, float, Optional[float]]:
         )
 
     ours = n / _time_steps(step)
+    extras = _device_stats(
+        lambda ss, tt, i: sharded_multiclass_auroc_histogram(
+            ss + i * jnp.float32(1e-38), tt, mesh=mesh, num_bins=2048
+        ),
+        (s, t),
+        n,
+        scores.nbytes + target.nbytes,
+    )
 
     ref = None
     try:
@@ -486,7 +502,7 @@ def bench_sharded_multiclass_auroc() -> Tuple[str, float, Optional[float]]:
         ref = n_ref / _time_steps(rstep, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
-    return "sharded_multiclass_auroc_1000c", ours, ref
+    return "sharded_multiclass_auroc_1000c", ours, ref, extras
 
 
 def bench_sharded_multiclass_exact() -> Tuple[str, float, Optional[float]]:
@@ -611,16 +627,49 @@ def bench_collection_fused() -> Tuple[str, float, Optional[float]]:
     n = 2**19
     scores = rng.random((n, c), dtype=np.float32)
     target = rng.integers(0, c, n).astype(np.int32)
-    col = MetricCollection(
-        {
-            "acc": MulticlassAccuracy(num_classes=c, average="macro"),
-            "f1": MulticlassF1Score(num_classes=c, average="macro"),
-            "cm": MulticlassConfusionMatrix(num_classes=c),
-            "prec": MulticlassPrecision(num_classes=c, average="macro"),
-            "rec": MulticlassRecall(num_classes=c, average="macro"),
-        }
-    )
+    def make_collection():
+        return MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=c, average="macro"),
+                "f1": MulticlassF1Score(num_classes=c, average="macro"),
+                "cm": MulticlassConfusionMatrix(num_classes=c),
+                "prec": MulticlassPrecision(num_classes=c, average="macro"),
+                "rec": MulticlassRecall(num_classes=c, average="macro"),
+            }
+        )
+
+    col = make_collection()
     ours = _lifecycle(col, _split((scores, target)), update="fused_update")
+
+    # Device-loop clock of ONE fused per-batch update (the lifecycle's hot
+    # step): a throwaway collection's members run their pure update
+    # transitions from pinned start states inside the loop — the same
+    # one-XLA-program trace fused_update builds.
+    import jax.numpy as jnp
+
+    clock_col = make_collection()
+    states0 = clock_col._read_states()
+    members = clock_col._metrics
+    batch = len(_split((scores, target))[0][0])
+
+    def fused_step(s, t, i):
+        for name, m in members.items():
+            for k, v in states0[name].items():
+                setattr(m, k, v)
+        for m in members.values():
+            m.update(s + i * jnp.float32(1e-38), t)
+        total = jnp.zeros((), jnp.float32)
+        for name, m in members.items():
+            for k in states0[name]:
+                total = total + jnp.sum(getattr(m, k)).astype(jnp.float32)
+        return total
+
+    s0, t0 = _split((scores, target))[0]
+    extras = _device_stats(
+        fused_step, (s0, t0), batch, s0.nbytes + t0.nbytes
+    )
+    # Leave no tracer residue on the throwaway members.
+    clock_col._install_states(states0)
 
     ref = None
     try:
@@ -646,7 +695,7 @@ def bench_collection_fused() -> Tuple[str, float, Optional[float]]:
         ref = n / _time_steps(rstep, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
-    return "collection_5metrics_fused", ours, ref
+    return "collection_5metrics_fused", ours, ref, extras
 
 
 def bench_perplexity() -> Tuple[str, float, Optional[float]]:
@@ -661,7 +710,25 @@ def bench_perplexity() -> Tuple[str, float, Optional[float]]:
     target = rng.integers(0, vocab, (seqs, tokens))
     # _lifecycle counts leading-dim sequences; scale to tokens/sec.
     ours = _lifecycle(Perplexity(), _split((logits, target))) * tokens
-    return "perplexity_tokens", ours, None
+
+    # Device-loop clock of one update batch (2 sequences): the fused
+    # log_softmax+gather counter kernel, in tokens/sec.
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics.functional.text.perplexity import (
+        _perplexity_update_kernel,
+    )
+
+    l0, t0 = _split((logits, target))[0]
+    extras = _device_stats(
+        lambda ll, tt, i: sum(
+            _perplexity_update_kernel(ll + i * jnp.float32(1e-38), tt, None)
+        ).astype(jnp.float32),
+        (l0, t0),
+        int(l0.shape[0]) * tokens,
+        l0.nbytes + t0.nbytes,
+    )
+    return "perplexity_tokens", ours, None, extras
 
 
 ALL_WORKLOADS = [

@@ -214,6 +214,41 @@ class TestPinnedUstatCap(unittest.TestCase):
                 scores, target, num_classes=8, ustat_cap=2**17
             )
 
+    def test_auprc_pinned_cap_mirrors_auroc(self) -> None:
+        import jax
+
+        from torcheval_tpu.metrics.functional import multiclass_auprc
+        from torcheval_tpu.metrics.functional.classification.auprc import (
+            _multiclass_auprc_compute,
+        )
+
+        scores, target = self._data()
+        eager = multiclass_auprc(scores, target, num_classes=8)
+
+        @jax.jit
+        def public_step(s, t):
+            return multiclass_auprc(s, t, num_classes=8, ustat_cap=1024)
+
+        np.testing.assert_allclose(
+            np.asarray(public_step(scores, target)),
+            np.asarray(eager),
+            atol=2e-6,
+        )
+
+        @jax.jit
+        def routed_step(s, t):
+            return _multiclass_auprc_compute(
+                s, t, 8, "macro", ustat_cap=1024, _interpret=True
+            )
+
+        np.testing.assert_allclose(
+            np.asarray(routed_step(scores, target)),
+            np.asarray(eager),
+            atol=2e-6,
+        )
+        with self.assertRaisesRegex(ValueError, "raise the cap"):
+            multiclass_auprc(scores, target, num_classes=8, ustat_cap=16)
+
 
 class TestFusedAUCLargeN(unittest.TestCase):
     def test_fused_large_sample_count(self) -> None:

@@ -304,6 +304,45 @@ class TestShardedAUPRCHistogram(unittest.TestCase):
             sharded_auprc_histogram(jnp.ones((2, 2)), jnp.ones((2, 2)), mesh=mesh)
 
 
+class TestHistogramPathConsistency(unittest.TestCase):
+    def test_weighted_ones_matches_unweighted_bitwise(self):
+        # The unweighted (binned-counts dispatch) and weighted (scatter)
+        # formulations must agree bitwise on identical data — the
+        # threshold grid is built as the exact f32 boundary of each
+        # scatter bin, including at the non-power-of-two bin edges where
+        # a naive j/num_bins grid diverges.
+        from torcheval_tpu.parallel import (
+            sharded_auprc_histogram,
+            sharded_auroc_histogram,
+        )
+        from torcheval_tpu.parallel.sync import _grid_np
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(3)
+        num_bins, n = 1000, 8192
+        # Adversarial scores: exact bin boundaries and their f32
+        # neighbors, plus uniform fill.
+        grid = _grid_np(num_bins)
+        edges = np.concatenate(
+            [grid, np.nextafter(grid, 0), np.nextafter(grid, 1)]
+        )
+        scores = np.concatenate(
+            [edges, rng.random(n - len(edges)).astype(np.float32)]
+        ).astype(np.float32)
+        scores = np.clip(scores, 0.0, 1.0)
+        target = (rng.random(n) < 0.4).astype(np.float32)
+        s, t = shard_batch(mesh, jnp.asarray(scores), jnp.asarray(target))
+        ones = jnp.ones_like(s)
+        for fn in (sharded_auroc_histogram, sharded_auprc_histogram):
+            unweighted = fn(s, t, mesh=mesh, num_bins=num_bins)
+            weighted = fn(s, t, mesh=mesh, num_bins=num_bins, weights=ones)
+            self.assertEqual(
+                np.asarray(unweighted).tobytes(),
+                np.asarray(weighted).tobytes(),
+                fn.__name__,
+            )
+
+
 class TestShardedMulticlassAUROCHistogram(unittest.TestCase):
     def test_matches_sklearn_macro_on_quantized_scores(self):
         from sklearn.metrics import roc_auc_score as sk_auc

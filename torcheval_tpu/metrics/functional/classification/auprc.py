@@ -81,17 +81,36 @@ def multiclass_auprc(
     *,
     num_classes: int,
     average: Optional[str] = "macro",
+    ustat_cap: Optional[int] = None,
 ) -> jax.Array:
     """One-vs-rest average precision with macro/None averaging.
 
     Classes absent from ``target`` contribute 0 to the macro mean —
-    sklearn yields NaN with a warning for such classes."""
+    sklearn yields NaN with a warning for such classes.
+
+    ``ustat_cap`` pins the sort-free rank-histogram formulation's static
+    table capacity for composition under a caller's ``jax.jit`` — the
+    same contract as ``multiclass_auroc``'s ``ustat_cap`` (see its
+    docstring), plus this kernel's ``N < 2^24`` bound."""
     _multiclass_auprc_param_check(num_classes, average)
     input, target = jnp.asarray(input), jnp.asarray(target)
     _multiclass_auroc_update_input_check(input, target, num_classes)
     if input.shape[0] == 0:
         return jnp.zeros(()) if average == "macro" else jnp.zeros(num_classes)
-    return _multiclass_auprc_compute(input, target, num_classes, average)
+    if ustat_cap is not None:
+        from torcheval_tpu.metrics.functional.classification.auroc import (
+            _ustat_cap_check,
+        )
+
+        if input.shape[0] >= 2**24:
+            raise ValueError(
+                "the rank-histogram formulation requires N < 2^24; leave "
+                "ustat_cap=None for this shape."
+            )
+        _ustat_cap_check(input, target, num_classes, ustat_cap)
+    return _multiclass_auprc_compute(
+        input, target, num_classes, average, ustat_cap=ustat_cap
+    )
 
 
 def _multiclass_auprc_compute(
@@ -99,20 +118,38 @@ def _multiclass_auprc_compute(
     target: jax.Array,
     num_classes: int,
     average: Optional[str],
+    ustat_cap: Optional[int] = None,
+    _interpret: bool = False,
 ) -> jax.Array:
     # Sort-free rank-histogram fast path (ops/pallas_ustat.py): sparse
     # one-vs-rest positives make step-sum AP a per-entry count against a
     # tiny packed table instead of a (C, N) variadic sort.  Same call-time
     # route as the AUROC fast path, plus the kernel's N < 2^24 bound.
+    # A pinned cap (the jit-composition recipe) asserts the data
+    # preconditions only; environment guards are re-checked here so
+    # pinned code still runs — on the sort path — off-TPU.
     if input.shape[0] < 2**24:
         from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
 
-        cap = ustat_route_cap(input, target, num_classes)
-        if cap is not None:
+        if ustat_cap is None:
+            ustat_cap = ustat_route_cap(input, target, num_classes)
+        else:
+            from torcheval_tpu.metrics.functional.classification.auroc import (
+                _pinned_cap_env_ok,
+            )
+
+            if not _pinned_cap_env_ok(_interpret):
+                ustat_cap = None
+        if ustat_cap is not None:
             from torcheval_tpu.ops.pallas_ustat import multiclass_auprc_ustat
 
             return multiclass_auprc_ustat(
-                input, target, num_classes=num_classes, average=average, cap=cap
+                input,
+                target,
+                num_classes=num_classes,
+                average=average,
+                cap=ustat_cap,
+                interpret=_interpret,
             )
     return _multiclass_auprc_compute_kernel(input, target, num_classes, average)
 

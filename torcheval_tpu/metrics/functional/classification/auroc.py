@@ -84,6 +84,25 @@ def multiclass_auroc(
     )
 
 
+def _pinned_cap_env_ok(_interpret: bool) -> bool:
+    """Environment guard shared by every pinned-``ustat_cap`` entry point
+    (AUROC and AUPRC): a pinned cap asserts the DATA preconditions, not
+    the environment — backend and kill-switches are host-level facts,
+    re-checked per call so pinned code still runs (on the sort path) on
+    CPU or with Pallas disabled.  ``_interpret``, a test hook, runs the
+    pinned kernel in Pallas interpret mode instead, so the route is
+    exercisable off-TPU."""
+    from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
+
+    if _interpret:
+        return True
+    return not (
+        pallas_disabled()
+        or ustat_disabled()
+        or jax.default_backend() != "tpu"
+    )
+
+
 def _ustat_cap_check(
     input: jax.Array, target: jax.Array, num_classes: int, cap: int
 ) -> None:
@@ -246,21 +265,8 @@ def _multiclass_auroc_compute(
         from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
 
         ustat_cap = ustat_route_cap(input, target, num_classes)
-    else:
-        # A pinned cap (the jit-composition recipe) asserts the data
-        # preconditions, not the environment: backend and kill-switches
-        # are host-level facts, checked here so pinned code still runs —
-        # on the sort path — on CPU or with Pallas disabled.
-        # (``_interpret``, a test hook, runs the pinned kernel in Pallas
-        # interpret mode instead, so the route is exercisable off-TPU.)
-        from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
-
-        if not _interpret and (
-            pallas_disabled()
-            or ustat_disabled()
-            or jax.default_backend() != "tpu"
-        ):
-            ustat_cap = None
+    elif not _pinned_cap_env_ok(_interpret):
+        ustat_cap = None
     if ustat_cap is not None:
         from torcheval_tpu.ops.pallas_ustat import multiclass_auroc_ustat
 

@@ -681,7 +681,7 @@ def binary_ustat_route(
         return None
     if not _route_guards_ok(scores, target):
         return None
-    # ONE device fetch for all five stats (the _host_checks bounds
+    # ONE device fetch for all six stats (the _host_checks bounds
     # pattern) — per-element float() would block once per scalar.
     stats = np.asarray(_binary_route_stats(scores, target))
     lo, hi, non01, max_pos, max_neg, min_nz = (float(x) for x in stats)
