@@ -342,6 +342,35 @@ class TestHistogramPathConsistency(unittest.TestCase):
                 fn.__name__,
             )
 
+    def test_soft_targets_keep_fractional_positive_semantics(self):
+        # Non-0/1 targets carry fractional positives (pos += w·t) — a
+        # semantics only the scatter formulation has; the unweighted call
+        # must route there (not to the counts dispatch, which would count
+        # any nonzero target as one full positive) and equal the
+        # explicit-ones weighted call bitwise.
+        from torcheval_tpu.parallel import (
+            sharded_auprc_histogram,
+            sharded_auroc_histogram,
+        )
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(5)
+        n = 2048
+        s = rng.random(n).astype(np.float32)
+        soft_t = rng.choice(
+            np.array([0.0, 0.25, 0.5, 1.0], np.float32), size=n
+        )
+        ss, ts = shard_batch(mesh, jnp.asarray(s), jnp.asarray(soft_t))
+        ones = jnp.ones_like(ss)
+        for fn in (sharded_auroc_histogram, sharded_auprc_histogram):
+            unweighted = fn(ss, ts, mesh=mesh, num_bins=256)
+            weighted = fn(ss, ts, mesh=mesh, num_bins=256, weights=ones)
+            self.assertEqual(
+                np.asarray(unweighted).tobytes(),
+                np.asarray(weighted).tobytes(),
+                fn.__name__,
+            )
+
 
 class TestShardedMulticlassAUROCHistogram(unittest.TestCase):
     def test_matches_sklearn_macro_on_quantized_scores(self):

@@ -153,6 +153,33 @@ def sharded_auroc_histogram(
     )
 
 
+def _binned_roc_area(cum_tp, cum_fp):
+    """Trapezoid ROC area from descending-threshold cumulative counts
+    (with the (0, 0) origin prepended); degenerate rows → 0.5.  ONE
+    definition serves the weighted (scatter) and unweighted (counts)
+    formulations so the bitwise weighted(ones) ≡ unweighted contract
+    cannot drift."""
+    factor = cum_tp[..., -1] * cum_fp[..., -1]
+    area = jnp.trapezoid(cum_tp, cum_fp, axis=-1)
+    return jnp.where(factor == 0, 0.5, area / factor)
+
+
+def _binned_step_ap(delta_tp, cum_tp, cum_all):
+    """Step-rule AP from descending-threshold per-bin TP increments and
+    cumulative TP / predicted-positive counts; no positives → 0.  ONE
+    definition serves both formulations (see :func:`_binned_roc_area`).
+    The 0/0 guards must not clamp small weighted counts — AP is invariant
+    to weight scale."""
+    precision = jnp.where(
+        cum_all > 0, cum_tp / jnp.where(cum_all > 0, cum_all, 1.0), 1.0
+    )
+    total_pos = cum_tp[-1]
+    ap = (delta_tp * precision).sum() / jnp.where(
+        total_pos > 0, total_pos, 1.0
+    )
+    return jnp.where(total_pos == 0, 0.0, ap)
+
+
 def _build_auroc_hist_local(num_bins: int, axis: str):
     def local(s, t, w):
         pos, tot = _local_binned_counts(s, t, w, num_bins, axis)
@@ -160,9 +187,7 @@ def _build_auroc_hist_local(num_bins: int, axis: str):
         # Descending-threshold cumulative curves, from the (0, 0) origin.
         cum_tp = jnp.concatenate([jnp.zeros(1), jnp.cumsum(pos[::-1])])
         cum_fp = jnp.concatenate([jnp.zeros(1), jnp.cumsum(neg[::-1])])
-        factor = cum_tp[-1] * cum_fp[-1]
-        area = jnp.trapezoid(cum_tp, cum_fp)
-        return jnp.where(factor == 0, 0.5, area / factor)
+        return _binned_roc_area(cum_tp, cum_fp)
 
     return local
 
@@ -219,9 +244,7 @@ def _build_auroc_hist_counts_local(num_bins: int, route: str, axis: str):
         zero = jnp.zeros(1, jnp.float32)
         cum_tp = jnp.concatenate([zero, num_tp[::-1]])
         cum_fp = jnp.concatenate([zero, num_fp[::-1]])
-        factor = cum_tp[-1] * cum_fp[-1]
-        area = jnp.trapezoid(cum_tp, cum_fp)
-        return jnp.where(factor == 0, 0.5, area / factor)
+        return _binned_roc_area(cum_tp, cum_fp)
 
     return local
 
@@ -280,25 +303,50 @@ def _run_sharded_binary(
     The builders are module-level factories for the per-device function;
     routing through the shared ``compiled_spmd`` memoizer keeps the jitted
     program cached across calls (a per-call closure would re-trace and
-    re-compile every invocation).  Unweighted calls run ``counts_builder``
-    (the binned-counts dispatch, with the formulation chosen at call time
-    outside jit); weighted calls keep the scatter histogram, the only
-    formulation that carries per-sample weights."""
+    re-compile every invocation).  Unweighted calls with verifiably 0/1
+    targets run ``counts_builder`` (the binned-counts dispatch, with the
+    formulation chosen at call time outside jit); weighted calls — and
+    soft/non-binary targets, whose fractional-positive semantics
+    (``pos += w·t``) only the scatter carries — keep the scatter
+    histogram."""
     if scores.ndim != 1 or targets.ndim != 1:
         raise ValueError(
             f"scores and targets should be 1-D, got {scores.shape} / {targets.shape}."
         )
     _check_scores_in_unit_interval(scores)
-    if weights is None:
+    if weights is None and _targets_are_01(targets):
         route = _hist_route(1, scores.shape[0] // mesh.shape[axis], num_bins)
         fn = compiled_spmd(
             _build_hist_spmd, (counts_builder, (num_bins, route)), mesh, axis
         )
         return fn(scores, targets)
+    if weights is None:
+        weights = jnp.ones_like(scores, dtype=jnp.float32)
     fn = compiled_spmd(
         _build_hist_spmd, (weighted_builder, (num_bins,)), mesh, axis
     )
     return fn(scores, targets, weights)
+
+
+def _targets_are_01(targets) -> bool:
+    """Eager check that every target is exactly 0 or 1 (one fused round
+    trip — the route-decision cost pattern).  Soft/non-binary targets keep
+    the scatter path's fractional-positive semantics; under tracing or
+    ``skip_value_checks`` the check cannot run, so the scatter path is the
+    safe default."""
+    from torcheval_tpu.metrics.functional._host_checks import (
+        all_concrete,
+        value_checks_enabled,
+    )
+
+    if not value_checks_enabled() or not all_concrete(targets):
+        return False
+    return not bool(_non01_count(targets))
+
+
+@jax.jit
+def _non01_count(targets) -> jax.Array:
+    return jnp.sum((targets != 0) & (targets != 1), dtype=jnp.int32)
 
 
 def _hist_route(num_rows: int, n_local: int, num_bins: int) -> str:
@@ -382,14 +430,7 @@ def _build_auprc_hist_counts_local(num_bins: int, route: str, axis: str):
             lax.psum(num_tp[0] + num_fp[0], axis).astype(jnp.float32)[::-1]
         )
         delta_tp = jnp.diff(cum_tp, prepend=0.0)
-        precision = jnp.where(
-            cum_all > 0, cum_tp / jnp.where(cum_all > 0, cum_all, 1.0), 1.0
-        )
-        total_pos = cum_tp[-1]
-        ap = (delta_tp * precision).sum() / jnp.where(
-            total_pos > 0, total_pos, 1.0
-        )
-        return jnp.where(total_pos == 0, 0.0, ap)
+        return _binned_step_ap(delta_tp, cum_tp, cum_all)
 
     return local
 
@@ -399,19 +440,11 @@ def _build_auprc_hist_local(num_bins: int, axis: str):
         pos, tot = _local_binned_counts(s, t, w, num_bins, axis)
         # Descending-threshold bins: cumulative TP / predicted-positive
         # counts at each bin end, precision there, weighted by the bin's
-        # recall increment.  0/0 guards must not clamp small weighted
-        # counts — AP is invariant to weight scale.
+        # recall increment.
         delta_tp = pos[::-1]
         cum_tp = jnp.cumsum(delta_tp)
         cum_all = jnp.cumsum(tot[::-1])
-        precision = jnp.where(
-            cum_all > 0, cum_tp / jnp.where(cum_all > 0, cum_all, 1.0), 1.0
-        )
-        total_pos = cum_tp[-1]
-        ap = (delta_tp * precision).sum() / jnp.where(
-            total_pos > 0, total_pos, 1.0
-        )
-        return jnp.where(total_pos == 0, 0.0, ap)
+        return _binned_step_ap(delta_tp, cum_tp, cum_all)
 
     return local
 
@@ -477,9 +510,7 @@ def _build_mc_hist_local(
         zero = jnp.zeros((num_classes, 1), jnp.float32)
         cum_tp = jnp.concatenate([zero, num_tp[:, ::-1]], axis=-1)
         cum_fp = jnp.concatenate([zero, num_fp[:, ::-1]], axis=-1)
-        factor = cum_tp[:, -1] * cum_fp[:, -1]
-        area = jnp.trapezoid(cum_tp, cum_fp, axis=-1)
-        aurocs = jnp.where(factor == 0, 0.5, area / factor)
+        aurocs = _binned_roc_area(cum_tp, cum_fp)
         return aurocs.mean() if average == "macro" else aurocs
 
     return local
