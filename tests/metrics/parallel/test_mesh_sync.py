@@ -342,6 +342,32 @@ class TestHistogramPathConsistency(unittest.TestCase):
                 fn.__name__,
             )
 
+    def test_assume_01_targets_pins_counts_path_under_jit(self):
+        # Under a caller's jit the 0/1 check sees tracers and the scatter
+        # path runs; assume_01_targets=True keeps the counts dispatch
+        # reachable (the ustat_cap recipe) with identical results.
+        import jax
+
+        from torcheval_tpu.parallel import sharded_auroc_histogram
+
+        mesh = make_mesh()
+        rng = np.random.default_rng(7)
+        n = 2048
+        s = rng.random(n).astype(np.float32)
+        t = (rng.random(n) < 0.4).astype(np.float32)
+        ss, ts = shard_batch(mesh, jnp.asarray(s), jnp.asarray(t))
+        eager = sharded_auroc_histogram(ss, ts, mesh=mesh, num_bins=256)
+
+        @jax.jit
+        def step(a, b):
+            return sharded_auroc_histogram(
+                a, b, mesh=mesh, num_bins=256, assume_01_targets=True
+            )
+
+        self.assertEqual(
+            np.asarray(step(ss, ts)).tobytes(), np.asarray(eager).tobytes()
+        )
+
     def test_soft_targets_keep_fractional_positive_semantics(self):
         # Non-0/1 targets carry fractional positives (pos += w·t) — a
         # semantics only the scatter formulation has; the unweighted call

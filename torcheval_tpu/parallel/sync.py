@@ -123,6 +123,7 @@ def sharded_auroc_histogram(
     axis: str = "dp",
     num_bins: int = 8192,
     weights: Optional[jax.Array] = None,
+    assume_01_targets: Optional[bool] = None,
 ) -> jax.Array:
     """Pod-scale binary AUROC with O(num_bins) communication.
 
@@ -140,6 +141,14 @@ def sharded_auroc_histogram(
     (exact for already-quantized scores; error ``O(1/num_bins)`` otherwise).
     Use the exact ``binary_auroc`` on gathered buffers when bit-exactness
     matters more than wire cost.
+
+    ``assume_01_targets``: ``None`` (default) checks eagerly that targets
+    are exactly 0/1 and routes accordingly — under a caller's jit the
+    check sees only tracers, so the scatter path runs.  Pass ``True``
+    (asserting 0/1 targets) to keep the faster binned-counts dispatch
+    reachable under jit (the ``ustat_cap`` recipe); ``False`` forces the
+    scatter path (required semantics for soft targets, whose fractional
+    positives only the scatter carries).
     """
     return _run_sharded_binary(
         _build_auroc_hist_local,
@@ -150,6 +159,7 @@ def sharded_auroc_histogram(
         scores,
         targets,
         weights,
+        assume_01_targets,
     )
 
 
@@ -266,6 +276,10 @@ def _check_scores_in_unit_interval(scores) -> None:
     if scores.size == 0:
         return
     lo, hi = bounds(scores)
+    _raise_if_scores_out_of_unit(float(lo), float(hi))
+
+
+def _raise_if_scores_out_of_unit(lo: float, hi: float) -> None:
     if lo < 0 or hi > 1:
         raise ValueError(
             "The values in `scores` should be in the range of [0, 1] for "
@@ -273,6 +287,52 @@ def _check_scores_in_unit_interval(scores) -> None:
             "(apply a sigmoid/softmax first, or use the exact sharded "
             "variants in torcheval_tpu.parallel.exact)."
         )
+
+
+@jax.jit
+def _binary_hist_stats_kernel(scores, targets):
+    return jnp.stack(
+        [
+            jnp.min(scores).astype(jnp.float32),
+            jnp.max(scores).astype(jnp.float32),
+            jnp.sum(
+                (targets != 0) & (targets != 1), dtype=jnp.int32
+            ).astype(jnp.float32),
+        ]
+    )
+
+
+def _binary_hist_gate(scores, targets) -> bool:
+    """Fused score-range validation + exact-0/1-target stat in ONE device
+    round trip (the `_host_checks` one-fetch pattern), deciding the
+    unweighted formulation: True → binned-counts dispatch, False → the
+    scatter path (soft targets; or tracing / ``skip_value_checks`` /
+    empty input, where the stats cannot be read).  Tracer-safe like
+    ``_host_checks.bounds``: inside someone else's trace even concrete
+    inputs stage to tracers, so the stats fall back to pure numpy on the
+    host values."""
+    from torcheval_tpu.metrics.functional._host_checks import (
+        all_concrete,
+        value_checks_enabled,
+    )
+
+    if (
+        not value_checks_enabled()
+        or not all_concrete(scores, targets)
+        or scores.size == 0
+    ):
+        return False
+    out = _binary_hist_stats_kernel(scores, targets)
+    if isinstance(out, jax.core.Tracer):
+        host_s = np.asarray(scores)
+        host_t = np.asarray(targets)
+        lo, hi = float(host_s.min()), float(host_s.max())
+        non01 = int(((host_t != 0) & (host_t != 1)).sum())
+    else:
+        lo, hi, non01f = (float(x) for x in np.asarray(out))
+        non01 = int(non01f)
+    _raise_if_scores_out_of_unit(lo, hi)
+    return non01 == 0
 
 
 def _local_binned_counts(s, t, w, num_bins: int, axis: str):
@@ -297,6 +357,7 @@ def _run_sharded_binary(
     scores,
     targets,
     weights,
+    assume_01_targets: Optional[bool] = None,
 ):
     """Shared shape check + shard_map wrapper for the 1-D histogram metrics.
 
@@ -313,8 +374,14 @@ def _run_sharded_binary(
         raise ValueError(
             f"scores and targets should be 1-D, got {scores.shape} / {targets.shape}."
         )
-    _check_scores_in_unit_interval(scores)
-    if weights is None and _targets_are_01(targets):
+    if assume_01_targets is None:
+        # ONE fused fetch validates the score range AND decides the
+        # formulation; an explicit assume_01_targets skips the target
+        # stat but keeps the score validation.
+        assume_01_targets = _binary_hist_gate(scores, targets)
+    else:
+        _check_scores_in_unit_interval(scores)
+    if weights is None and assume_01_targets:
         route = _hist_route(1, scores.shape[0] // mesh.shape[axis], num_bins)
         fn = compiled_spmd(
             _build_hist_spmd, (counts_builder, (num_bins, route)), mesh, axis
@@ -326,27 +393,6 @@ def _run_sharded_binary(
         _build_hist_spmd, (weighted_builder, (num_bins,)), mesh, axis
     )
     return fn(scores, targets, weights)
-
-
-def _targets_are_01(targets) -> bool:
-    """Eager check that every target is exactly 0 or 1 (one fused round
-    trip — the route-decision cost pattern).  Soft/non-binary targets keep
-    the scatter path's fractional-positive semantics; under tracing or
-    ``skip_value_checks`` the check cannot run, so the scatter path is the
-    safe default."""
-    from torcheval_tpu.metrics.functional._host_checks import (
-        all_concrete,
-        value_checks_enabled,
-    )
-
-    if not value_checks_enabled() or not all_concrete(targets):
-        return False
-    return not bool(_non01_count(targets))
-
-
-@jax.jit
-def _non01_count(targets) -> jax.Array:
-    return jnp.sum((targets != 0) & (targets != 1), dtype=jnp.int32)
 
 
 def _hist_route(num_rows: int, n_local: int, num_bins: int) -> str:
@@ -387,6 +433,7 @@ def sharded_auprc_histogram(
     axis: str = "dp",
     num_bins: int = 8192,
     weights: Optional[jax.Array] = None,
+    assume_01_targets: Optional[bool] = None,
 ) -> jax.Array:
     """Pod-scale binary average precision with O(num_bins) communication.
 
@@ -399,7 +446,8 @@ def sharded_auprc_histogram(
     is evaluated over descending-threshold bins on every device.  Exact
     for scores already quantized to the bin grid; error ``O(1/num_bins)``
     otherwise.  No positives → 0 (matching ``binary_auprc``).  Invariant
-    to the scale of ``weights`` (like sklearn's ``sample_weight``)."""
+    to the scale of ``weights`` (like sklearn's ``sample_weight``).
+    ``assume_01_targets`` as in :func:`sharded_auroc_histogram`."""
 
     return _run_sharded_binary(
         _build_auprc_hist_local,
@@ -410,6 +458,7 @@ def sharded_auprc_histogram(
         scores,
         targets,
         weights,
+        assume_01_targets,
     )
 
 
