@@ -329,30 +329,38 @@ def _check_finite_scores(
         # One fused round trip (the _host_checks bounds pattern): min/max
         # propagate NaN and surface +/-inf, so two scalars decide it.
         lo, hi, min_nz = (float(x) for x in np.asarray(_finite_gate_stats(scores)))
-        if not (np.isfinite(lo) and np.isfinite(hi)):
-            raise ValueError(
-                f"{fn_name} requires finite scores (its packed-run padding "
-                "uses +/-inf sentinels); use the gather-exact variant for "
-                "inputs that may contain inf/nan."
-            )
+        _raise_if_not_finite(lo, hi, fn_name)
         return lo, hi, min_nz
     return None
 
 
-@jax.jit
-def _finite_gate_stats(scores) -> jax.Array:
-    """min, max, and smallest nonzero |score| in ONE fused round trip —
-    the finite check plus the Pallas-kernel gate's stats (bf16-split
-    exactness needs magnitudes ≥ 2^-100; see ``pallas_ustat._MIN_SPLIT``)."""
+def _raise_if_not_finite(lo: float, hi: float, fn_name: str) -> None:
+    if not (np.isfinite(lo) and np.isfinite(hi)):
+        raise ValueError(
+            f"{fn_name} requires finite scores (its packed-run padding "
+            "uses +/-inf sentinels); use the gather-exact variant for "
+            "inputs that may contain inf/nan."
+        )
+
+
+def _finite_gate_stats_body(scores):
+    """min, max, and smallest nonzero |score| — the finite check plus the
+    Pallas-kernel gate's stats (bf16-split exactness needs magnitudes
+    ≥ 2^-100; see ``pallas_ustat._MIN_SPLIT``).  Shared by the standalone
+    and fused-wrapper fetch kernels."""
     from torcheval_tpu.ops.pallas_ustat import _min_nonzero_abs
 
-    return jnp.stack(
-        [
-            jnp.min(scores).astype(jnp.float32),
-            jnp.max(scores).astype(jnp.float32),
-            _min_nonzero_abs(scores),
-        ]
-    )
+    return [
+        jnp.min(scores).astype(jnp.float32),
+        jnp.max(scores).astype(jnp.float32),
+        _min_nonzero_abs(scores),
+    ]
+
+
+@jax.jit
+def _finite_gate_stats(scores) -> jax.Array:
+    """One fused round trip of :func:`_finite_gate_stats_body`."""
+    return jnp.stack(_finite_gate_stats_body(scores))
 
 
 def sharded_binary_auroc_ustat(
@@ -639,18 +647,56 @@ def sharded_multiclass_auroc_ustat(
             f"sample count {scores.shape[0]} must divide evenly over mesh "
             f"axis {axis!r} of size {size}."
         )
-    known_stats = _check_finite_scores(scores, "sharded_multiclass_auroc_ustat")
     n_local = scores.shape[0] // size
-    if max_class_count_per_shard is None and all_concrete(scores, targets):
-        # Autotune (round-2 VERDICT item 6): one fused round trip for the
-        # exact per-shard class-count maximum; rounding to a multiple of
-        # 64 keeps the compile-shape set small.  Never overflows — the
-        # cap upper-bounds the true maximum by construction.
+    if (
+        max_class_count_per_shard is None
+        and all_concrete(scores, targets)
+        and value_checks_enabled()
+        and scores.size
+    ):
+        # The common default path: finite check + kernel-gate stats + cap
+        # autotune (round-2 VERDICT item 6) in ONE fused round trip.
+        # Rounding the cap to a multiple of 64 keeps the compile-shape
+        # set small; it never overflows — the cap upper-bounds the true
+        # maximum by construction.
+        out = _mc_ustat_wrapper_stats(
+            scores, targets, num_classes=num_classes, world=size
+        )
+        if isinstance(out, jax.core.Tracer):
+            # Inside someone else's trace even concrete inputs stage to
+            # tracers (the _host_checks.bounds fallback pattern): compute
+            # the same stats in pure numpy on the host values.
+            host_s = np.asarray(scores)
+            host_t = np.asarray(targets).reshape(size, -1)
+            lo, hi = float(host_s.min()), float(host_s.max())
+            mag = np.abs(host_s)
+            nz = mag[mag > 0]
+            min_nz = float(nz.min()) if nz.size else float("inf")
+            most = int(
+                max(
+                    int((host_t == k).sum(axis=1).max())
+                    for k in range(num_classes)
+                )
+            )
+        else:
+            lo, hi, min_nz, most_hi, most_lo = (
+                float(x) for x in np.asarray(out)
+            )
+            most = int(most_hi) * 65536 + int(most_lo)
+        _raise_if_not_finite(lo, hi, "sharded_multiclass_auroc_ustat")
+        known_stats = (lo, hi, min_nz)
+        cap = min(n_local, -(-max(most, 1) // 64) * 64)
+    elif max_class_count_per_shard is None and all_concrete(scores, targets):
+        # skip_value_checks (or empty input): autotune alone.
+        known_stats = None
         most = int(
             _max_shard_class_count(targets, num_classes=num_classes, world=size)
         )
         cap = min(n_local, -(-max(most, 1) // 64) * 64)
     else:
+        known_stats = _check_finite_scores(
+            scores, "sharded_multiclass_auroc_ustat"
+        )
         cap = _resolve_ustat_cap(
             max_class_count_per_shard,
             n_local,
@@ -844,8 +890,29 @@ def _mc_ustat_kernel_counts(
 
 
 @partial(jax.jit, static_argnames=("num_classes", "world"))
-def _max_shard_class_count(targets, num_classes: int, world: int):
-    """Largest per-shard single-class sample count (one fused round trip)."""
+def _mc_ustat_wrapper_stats(scores, targets, num_classes: int, world: int):
+    """The multiclass ustat wrapper's ENTIRE host-fetch budget in one
+    fused kernel (composing :func:`_finite_gate_stats_body` and
+    :func:`_max_shard_class_count_body`): score min / max / smallest
+    nonzero magnitude (finite check + Pallas-kernel gate) and the
+    per-shard class-count maximum (cap autotune).  Separate fetches cost
+    one tunnel round trip each (~70 ms) — fusing them cut the
+    (2^16, 1000) lifecycle measurably.  The count rides TWO f32 lanes
+    (high/low 16 bits) so it stays exact past f32's 2^24 integer ceiling
+    — it feeds the never-overflows cap bound."""
+    most = _max_shard_class_count_body(targets, num_classes, world)
+    return jnp.stack(
+        _finite_gate_stats_body(scores)
+        + [
+            (most // 65536).astype(jnp.float32),
+            (most % 65536).astype(jnp.float32),
+        ]
+    )
+
+
+def _max_shard_class_count_body(targets, num_classes: int, world: int):
+    """Largest per-shard single-class sample count (exact int32), shared
+    by the standalone and fused-wrapper fetch kernels."""
     shards = jnp.reshape(targets, (world, -1))
     classes = jnp.arange(num_classes)
     counts = jnp.sum(
@@ -854,6 +921,12 @@ def _max_shard_class_count(targets, num_classes: int, world: int):
         dtype=jnp.int32,
     )
     return counts.max()
+
+
+@partial(jax.jit, static_argnames=("num_classes", "world"))
+def _max_shard_class_count(targets, num_classes: int, world: int):
+    """One fused round trip of :func:`_max_shard_class_count_body`."""
+    return _max_shard_class_count_body(targets, num_classes, world)
 
 
 @partial(jax.jit, static_argnames=("world",))

@@ -316,11 +316,14 @@ def _binary_hist_gate(scores, targets) -> bool:
         value_checks_enabled,
     )
 
-    if (
-        not value_checks_enabled()
-        or not all_concrete(scores, targets)
-        or scores.size == 0
-    ):
+    if not value_checks_enabled() or scores.size == 0:
+        return False
+    if not all_concrete(scores):
+        return False
+    if not all_concrete(targets):
+        # Scores are still checkable (the replaced code always validated
+        # them); only the target stat is out of reach — scatter path.
+        _check_scores_in_unit_interval(scores)
         return False
     out = _binary_hist_stats_kernel(scores, targets)
     if isinstance(out, jax.core.Tracer):
