@@ -519,10 +519,17 @@ def _auroc_from_rank_sums(
             f"cap·N = {cap * n} exceeds the exact-int32 bound 2^29; "
             "use the sort path for this shape"
         )
-    k_a = rank_sum_counts(queries, table, interpret=interpret, tile=tile)
-    k_b = rank_sum_counts(
-        -queries, -table[:, ::-1], interpret=interpret, tile=tile
+    # ONE stacked kernel call computes both passes (rows [0, R) = the
+    # non-strict counts, rows [R, 2R) = the negated strict pass): same
+    # math, one launch, one table prep.
+    r = queries.shape[0]
+    k = rank_sum_counts(
+        jnp.concatenate([queries, -queries], axis=0),
+        jnp.concatenate([table, -table[:, ::-1]], axis=0),
+        interpret=interpret,
+        tile=tile,
     )
+    k_a, k_b = k[:r], k[r:]
     two_u = 2 * counts * n - k_a - n * cap + k_b - counts * counts
     factor = counts.astype(jnp.float32) * jnp.float32(n) - jnp.square(
         counts.astype(jnp.float32)

@@ -672,11 +672,9 @@ def sharded_multiclass_auroc_ustat(
             mag = np.abs(host_s)
             nz = mag[mag > 0]
             min_nz = float(nz.min()) if nz.size else float("inf")
-            most = int(
-                max(
-                    int((host_t == k).sum(axis=1).max())
-                    for k in range(num_classes)
-                )
+            most = max(
+                int(np.bincount(row, minlength=num_classes).max())
+                for row in host_t
             )
         else:
             lo, hi, min_nz, most_hi, most_lo = (
@@ -875,10 +873,19 @@ def _mc_ustat_kernel_counts(
         rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=_BIG)
     cap_tot = rows.shape[-1]
 
-    k_a = lax.psum(rank_sum_counts(s.T, rows, interpret=interpret), axis)
-    k_b = lax.psum(
-        rank_sum_counts(-s.T, -rows[:, ::-1], interpret=interpret), axis
+    # ONE stacked kernel call + ONE psum for both passes (the
+    # _auroc_from_rank_sums pattern: rows [0, C) non-strict, [C, 2C)
+    # negated strict).
+    c = rows.shape[0]
+    k = lax.psum(
+        rank_sum_counts(
+            jnp.concatenate([s.T, -s.T], axis=0),
+            jnp.concatenate([rows, -rows[:, ::-1]], axis=0),
+            interpret=interpret,
+        ),
+        axis,
     )
+    k_a, k_b = k[:c], k[c:]
     two_u = 2 * n_pos * n_total - k_a - n_total * cap_tot + k_b - n_pos * n_pos
     n_posf = n_pos.astype(jnp.float32)
     factor = n_posf * (jnp.float32(n_total) - n_posf)
