@@ -237,8 +237,12 @@ def _headline_device_stats() -> dict:
     # recipe, so the clocked path is the one jit users can reach.
     cap = ustat_route_cap(d_scores, d_target, NUM_CLASSES)
     stats = _device_stats(
+        # The loop-varying epsilon defeats LICM; it must be ≥ 2^-100 so
+        # an exactly-zero score stays inside the pinned kernel's
+        # exactness domain (nonzero magnitudes < _MIN_SPLIT are routed
+        # to the sort path eagerly, which the pin bypasses).
         lambda s, t, i: multiclass_auroc(
-            s + i * jnp.float32(1e-38),
+            s + i * jnp.float32(1e-30),
             t,
             num_classes=NUM_CLASSES,
             ustat_cap=cap,

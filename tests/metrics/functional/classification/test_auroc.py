@@ -209,10 +209,17 @@ class TestPinnedUstatCap(unittest.TestCase):
         scores, target = self._data()
         with self.assertRaisesRegex(ValueError, "multiple of 16"):
             multiclass_auroc(scores, target, num_classes=8, ustat_cap=100)
-        with self.assertRaisesRegex(ValueError, "exact-int32"):
+        with self.assertRaisesRegex(ValueError, "Mosaic operand envelope"):
             multiclass_auroc(
                 scores, target, num_classes=8, ustat_cap=2**17
             )
+        # The int32 bound needs cap·N ≥ 2^29 with an in-envelope cap.
+        import jax.numpy as jnp
+
+        big_s = jnp.zeros((2**16 + 16, 8), jnp.float32)
+        big_t = jnp.zeros((2**16 + 16,), jnp.int32)
+        with self.assertRaisesRegex(ValueError, "exact-int32"):
+            multiclass_auroc(big_s, big_t, num_classes=8, ustat_cap=8192)
 
     def test_auprc_pinned_cap_mirrors_auroc(self) -> None:
         import jax

@@ -100,6 +100,48 @@ class TestSplit3Bf16(unittest.TestCase):
         self.assertIsNone(binary_ustat_route(rows, t_rows))
 
 
+class TestMosaicTileEnvelope(unittest.TestCase):
+    def test_lane_aligned_and_bounded(self):
+        from torcheval_tpu.ops.pallas_ustat import (
+            _MOSAIC_OPERAND_BOUND,
+            _ROWS,
+            _mosaic_tile,
+        )
+
+        # Every compiled-path result must be a multiple of 128 and keep
+        # the one-hot operand under the Mosaic bound — including tiles
+        # the cap·tile < 2^24 exactness shrink produced (e.g. 1920).
+        for bc, tile in [(16, 4096), (32, 4096), (257, 1920), (512, 4096),
+                         (512, 640), (128, 2048)]:
+            got = _mosaic_tile(bc, tile, interpret=False)
+            self.assertEqual(got % 128, 0, f"bc={bc} tile={tile} -> {got}")
+            self.assertLessEqual(got, tile)
+            self.assertLessEqual(bc * _ROWS * got, _MOSAIC_OPERAND_BOUND)
+
+    def test_interpret_keeps_tile(self):
+        from torcheval_tpu.ops.pallas_ustat import _mosaic_tile
+
+        self.assertEqual(_mosaic_tile(10**6, 4096, interpret=True), 4096)
+
+    def test_raises_past_envelope(self):
+        from torcheval_tpu.ops.pallas_ustat import _MAX_CAP, _mosaic_tile
+
+        with self.assertRaisesRegex(ValueError, "Mosaic operand envelope"):
+            _mosaic_tile(_MAX_CAP // 16 + 1, 4096, interpret=False)
+
+    def test_pinned_cap_rejects_past_envelope(self):
+        from torcheval_tpu.metrics.functional import multiclass_auroc
+        from torcheval_tpu.ops.pallas_ustat import _MAX_CAP
+
+        rng = np.random.default_rng(7)
+        s = jnp.asarray(rng.random((64, 4)).astype(np.float32))
+        t = jnp.asarray(rng.integers(0, 4, 64).astype(np.int32))
+        with self.assertRaisesRegex(ValueError, "Mosaic operand envelope"):
+            multiclass_auroc(
+                s, t, num_classes=4, ustat_cap=_MAX_CAP + 16
+            )
+
+
 class TestRankSumCounts(unittest.TestCase):
     def _check(self, tables, queries, tile=512, msg=""):
         got = np.asarray(

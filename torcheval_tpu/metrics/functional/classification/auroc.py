@@ -116,10 +116,16 @@ def _ustat_cap_check(
         all_concrete,
         value_checks_enabled,
     )
-    from torcheval_tpu.ops.pallas_ustat import _BIG, _route_stats
+    from torcheval_tpu.ops.pallas_ustat import _BIG, _MAX_CAP, _route_stats
 
     if cap % 16 != 0 or cap < 16:
         raise ValueError(f"ustat_cap must be a positive multiple of 16, got {cap}.")
+    if cap > _MAX_CAP:
+        raise ValueError(
+            f"ustat_cap={cap} exceeds the hardware-verified Mosaic operand "
+            f"envelope (cap ≤ {_MAX_CAP}); leave ustat_cap=None for this "
+            "shape."
+        )
     if cap * input.shape[0] >= 2**29:
         raise ValueError(
             f"ustat_cap·N = {cap * input.shape[0]} exceeds the exact-int32 "

@@ -79,6 +79,31 @@ def _pad_to(n: int, m: int) -> int:
     return max(m, -(-n // m) * m)
 
 
+# Mosaic ICEs when the (8·Bc, tile) one-hot operand exceeds ~2^19
+# elements (cap 512 at tile 4096 crashed the remote compiler; tile 2048
+# compiles and is correct) — the shared bound for both rank kernels.
+_MOSAIC_OPERAND_BOUND = 2**19
+_MAX_CAP = _MOSAIC_OPERAND_BOUND // _ROWS // 128 * _FW  # 8192
+
+
+def _mosaic_tile(bc: int, tile: int, interpret: bool) -> int:
+    """Largest lane-aligned (multiple-of-128) tile ≤ ``tile`` keeping the
+    (8·Bc, tile) one-hot operand under ``_MOSAIC_OPERAND_BOUND``.  Raises
+    when no 128-lane tile fits (caps past ``_MAX_CAP``): compiling there
+    is exactly the crash this bound guards, so a clear error beats an
+    ICE.  Interpret mode has no Mosaic and keeps the caller's tile."""
+    if interpret:
+        return tile
+    bound = _MOSAIC_OPERAND_BOUND // (bc * _ROWS) // 128 * 128
+    if bound < 128:
+        raise ValueError(
+            f"table capacity {bc * _FW} exceeds the hardware-verified "
+            f"Mosaic operand envelope (cap ≤ {_MAX_CAP}); use the "
+            "sort/searchsorted formulation for larger tables."
+        )
+    return min(tile, bound)
+
+
 def _split3_bf16(x: jax.Array) -> jax.Array:
     """Exact 3-term bf16 decomposition of f32, stacked on the sublane dim.
 
@@ -236,6 +261,11 @@ def rank_sum_counts(
                 "bound (cap·tile < 2^24 with tile ≥ 128 requires cap ≤ 2^16)"
             )
     bc = cap // _FW
+    # The pinned ustat_cap / pod paths can request caps far beyond the
+    # route's default ceiling — clamp the tile to the shared Mosaic
+    # operand bound (results are tile-independent; only arithmetic
+    # intensity changes).
+    tile = _mosaic_tile(bc, tile, interpret)
     n_pad = _pad_to(n, tile)
     tile = min(tile, n_pad)
     r_pad = _pad_to(r, _ROWS)
@@ -382,12 +412,7 @@ def rank_hist_counts(
             f"per-bin accumulation, got {n}"
         )
     bc = cap // _FW
-    # Mosaic ICEs on this kernel when the (8·Bc, tile) one-hot operand
-    # exceeds ~2^19 elements (cap 512 at tile 4096 crashes the remote
-    # compiler; tile 2048 compiles and is correct) — shrink the tile to
-    # stay under the empirical bound.
-    while bc * _ROWS * tile > 2**19 and tile > 128:
-        tile //= 2
+    tile = _mosaic_tile(bc, tile, interpret)
     n_pad = _pad_to(n, tile)
     tile = min(tile, n_pad)
     r_pad = _pad_to(r, _ROWS)

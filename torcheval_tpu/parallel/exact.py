@@ -747,7 +747,12 @@ def _mc_ustat_kernel_ok(
     nonzero |score|) so the common path costs no extra device round
     trip."""
     from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
-    from torcheval_tpu.ops.pallas_ustat import _BIG, _MIN_SPLIT
+    from torcheval_tpu.ops.pallas_ustat import (
+        _BIG,
+        _MAX_CAP,
+        _MIN_SPLIT,
+        _pad_to,
+    )
 
     if pallas_disabled() or ustat_disabled() or jax.default_backend() != "tpu":
         return False
@@ -755,7 +760,10 @@ def _mc_ustat_kernel_ok(
         # The stats fetch requires non-empty (jnp.min of empty raises);
         # the searchsorted path handles the degenerate 0-sample case.
         return False
-    if cap_tot > 2**16 or cap_tot * n_total >= 2**29:
+    # The kernel pads the table width to a multiple of 16; the padded
+    # width must stay inside the hardware-verified Mosaic envelope
+    # (pallas_ustat._mosaic_tile) or the compiled kernel ICEs.
+    if _pad_to(cap_tot, 16) > _MAX_CAP or cap_tot * n_total >= 2**29:
         return False
     if known_stats is None:
         if not value_checks_enabled():

@@ -246,8 +246,14 @@ def _build_auroc_hist_counts_local(num_bins: int, route: str, axis: str):
     )
 
     def local(s, t):
+        # f32 cast first: the bisected grid reproduces the scatter path
+        # bitwise for f32 scores ONLY (f64 / low-precision scores can
+        # disagree near bin edges between `s >= t_j` and trunc binning).
         num_tp, num_fp, _, _ = _binned_counts_rows(
-            s[None], (t != 0)[None], _grid(num_bins), route=route
+            s.astype(jnp.float32)[None],
+            (t != 0)[None],
+            _grid(num_bins),
+            route=route,
         )
         num_tp = lax.psum(num_tp[0], axis).astype(jnp.float32)
         num_fp = lax.psum(num_fp[0], axis).astype(jnp.float32)
@@ -342,7 +348,13 @@ def _local_binned_counts(s, t, w, num_bins: int, axis: str):
     """Per-device positive/total weighted histograms over the [0, 1] score
     grid, psum-merged across the mesh axis — the shared first stage of
     every O(num_bins)-communication curve metric here."""
-    idx = jnp.clip((s * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    # f32 cast keeps the weighted(ones) ≡ unweighted contract across
+    # score dtypes: the counts path's bisected grid is f32-exact only.
+    idx = jnp.clip(
+        (s.astype(jnp.float32) * num_bins).astype(jnp.int32),
+        0,
+        num_bins - 1,
+    )
     wt = w.astype(jnp.float32)
     pos = jnp.zeros(num_bins, jnp.float32).at[idx].add(
         wt * t.astype(jnp.float32)
@@ -474,8 +486,12 @@ def _build_auprc_hist_counts_local(num_bins: int, route: str, axis: str):
     )
 
     def local(s, t):
+        # f32 cast: see _build_auroc_hist_counts_local.
         num_tp, num_fp, _, _ = _binned_counts_rows(
-            s[None], (t != 0)[None], _grid(num_bins), route=route
+            s.astype(jnp.float32)[None],
+            (t != 0)[None],
+            _grid(num_bins),
+            route=route,
         )
         cum_tp = lax.psum(num_tp[0], axis).astype(jnp.float32)[::-1]
         cum_all = (
@@ -554,8 +570,12 @@ def _build_mc_hist_local(
     )
 
     def local(s, t):
+        # f32 cast: see _build_auroc_hist_counts_local.
         num_tp, num_fp, _, _ = _binned_counts_rows(
-            s.T, class_hits(t, num_classes), _grid(num_bins), route=route
+            s.T.astype(jnp.float32),
+            class_hits(t, num_classes),
+            _grid(num_bins),
+            route=route,
         )
         num_tp = lax.psum(num_tp, axis).astype(jnp.float32)
         num_fp = lax.psum(num_fp, axis).astype(jnp.float32)
