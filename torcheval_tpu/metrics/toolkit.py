@@ -102,8 +102,8 @@ def get_synced_metric(
     (reference ``toolkit.py:145-232``)."""
     if not (isinstance(recipient_rank, int) or recipient_rank == "all"):
         raise ValueError(
-            "``recipient_rank`` should be an integer or 'all', "
-            f"got {recipient_rank} instead."
+            "recipient_rank accepts a rank index or the string 'all'; "
+            f"got {recipient_rank!r}."
         )
 
     group = process_group if process_group is not None else default_group()
@@ -119,19 +119,20 @@ def get_synced_metric(
         )
     if world_size == 1:
         log.warning(
-            "World size is 1, and metric is not synced. "
-            "``get_synced_metric()`` returns the input metric."
+            "single-process collective group: there are no peer states to "
+            "merge, so get_synced_metric() hands back the metric unchanged."
         )
         return metric
     elif world_size == -1:
         log.warning(
-            "World size is -1, and current process might not be "
-            "in the process group. ``get_synced_metric()`` returns ``None``."
+            "collective group reports world size -1 (this process appears "
+            "to be outside the group); get_synced_metric() yields None."
         )
         return None
     if world_size <= 1:
         raise RuntimeError(
-            f"Unexpected world_size {world_size} is seen when syncing metrics!"
+            f"cannot sync metric states over a collective group of "
+            f"reported size {world_size}."
         )
 
     gathered_metric_list = _sync_metric_object(metric, group, recipient_rank)
