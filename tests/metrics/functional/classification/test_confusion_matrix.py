@@ -85,10 +85,10 @@ class TestMatmulFormulation(unittest.TestCase):
         target = jnp.asarray([0, -7, 1, 2, 3, 3], dtype=jnp.int32)
         with skip_value_checks():
             scatter = _confusion_matrix_update_kernel(
-                pred, target, c, use_matmul=False
+                pred, target, c, route="scatter"
             )
             matmul = _confusion_matrix_update_kernel(
-                pred, target, c, use_matmul=True
+                pred, target, c, route="matmul"
             )
         expect = jnp.zeros((c, c), jnp.int32).at[0, 0].add(1)  # (0, 0)
         expect = expect.at[2, 2].add(1)  # (2, 2)

@@ -1,0 +1,190 @@
+"""Bucket-compaction confusion-slab kernel vs numpy scatter oracle
+(interpret mode; the compiled kernel is asserted on-chip in
+``test_pallas_tpu.py``)."""
+
+import unittest
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    _class_counts,
+)
+from torcheval_tpu.ops.pallas_cm import _MAX_W, class_window, confusion_slab
+
+
+def _oracle(t, p, w):
+    m = np.zeros((w, w), np.float32)
+    np.add.at(m, (t, p), 1.0)
+    return m
+
+
+def _check_slab(self, t, p, c, msg=""):
+    w = class_window(c)
+    got = np.asarray(
+        confusion_slab(
+            jnp.asarray(t), jnp.asarray(p), num_classes=c, interpret=True
+        )
+    )
+    want = _oracle(t, p, w)
+    # Cell (W-1, W-1) additionally holds the kernel's own tile padding.
+    want[w - 1, w - 1] = got[w - 1, w - 1]
+    np.testing.assert_array_equal(got, want, err_msg=msg)
+
+
+class TestConfusionSlab(unittest.TestCase):
+    def test_random_large_c(self):
+        rng = np.random.default_rng(0)
+        c, n = 1000, 5000
+        _check_slab(
+            self,
+            rng.integers(0, c + 1, n).astype(np.int32),
+            rng.integers(0, c + 1, n).astype(np.int32),
+            c,
+            "random C=1000 incl sentinel",
+        )
+
+    def test_small_window_always_overflows(self):
+        # C=130 → W=256, two 64-wide buckets: every tile overflows CAP and
+        # takes the dense in-kernel path.
+        rng = np.random.default_rng(1)
+        c, n = 130, 2500
+        _check_slab(
+            self,
+            rng.integers(0, c, n).astype(np.int32),
+            rng.integers(0, c, n).astype(np.int32),
+            c,
+            "dense-path window",
+        )
+
+    def test_adversarial_single_class(self):
+        c, n = 1000, 4096
+        _check_slab(
+            self,
+            np.zeros(n, np.int32),
+            np.full(n, 7, np.int32),
+            c,
+            "all one class (overflow fallback)",
+        )
+
+    def test_mixed_overflow_and_compact_tiles(self):
+        rng = np.random.default_rng(2)
+        c, n = 1000, 8192
+        t = rng.integers(0, c, n).astype(np.int32)
+        t[:3000] = 5  # first tiles overflow, later tiles compact
+        _check_slab(
+            self, t, rng.integers(0, c, n).astype(np.int32), c, "mixed"
+        )
+
+    def test_tile_boundaries_and_empty(self):
+        rng = np.random.default_rng(3)
+        for n in (0, 1, 1023, 1024, 1025):
+            c = 700
+            _check_slab(
+                self,
+                rng.integers(0, c, n).astype(np.int32),
+                rng.integers(0, c, n).astype(np.int32),
+                c,
+                f"n={n}",
+            )
+
+    def test_bucket_and_split_boundaries(self):
+        # Labels straddling the 64-class bucket edges and the 128-split of
+        # the predicted-class payload.
+        rng = np.random.default_rng(4)
+        c, n = 1000, 3000
+        t = (64 * rng.integers(0, 15, n) + rng.integers(62, 66, n) % 64)
+        p = np.where(rng.integers(0, 2, n) == 1, 127, 128)
+        _check_slab(
+            self, t.astype(np.int32), p.astype(np.int32), c, "boundaries"
+        )
+
+    def test_bounds_raise(self):
+        big = jnp.zeros(4, jnp.int32)
+        with self.assertRaisesRegex(ValueError, "VMEM budget"):
+            confusion_slab(big, big, num_classes=2 * _MAX_W, interpret=True)
+
+
+class TestClassCountsParity(unittest.TestCase):
+    """All three routes of the (num_tp, num_label, num_prediction) trio
+    must be mutually bit-identical — including out-of-range labels
+    reachable under skip_value_checks, where the defined semantics are
+    wrap-then-compare (consistent with the confusion matrix; the
+    reference's torch scatters crash there)."""
+
+    def _reference_trio(self, pred, target, c):
+        """In-range reference: the three raw scatters (identical to the
+        wrapped formulation for valid labels)."""
+        correct = (pred == target).astype(jnp.int32)
+        return (
+            jnp.zeros(c, jnp.int32).at[target].add(correct),
+            jnp.zeros(c, jnp.int32).at[target].add(1),
+            jnp.zeros(c, jnp.int32).at[pred].add(1),
+        )
+
+    def _routes(self, pred, target, c):
+        pred, target = jnp.asarray(pred), jnp.asarray(target)
+        return {
+            route: [
+                np.asarray(x)
+                for x in _class_counts(pred, target, c, route, **kw)
+            ]
+            for route, kw in (
+                ("scatter", {}),
+                ("matmul", {}),
+                ("pallas", {"interpret": True}),
+            )
+        }
+
+    def _assert_parity(self, pred, target, c, msg, want=None):
+        got = self._routes(pred, target, c)
+        if want is None:
+            want = got["scatter"]
+        for route, trio in got.items():
+            for g, w, name in zip(trio, want, ("tp", "label", "pred")):
+                np.testing.assert_array_equal(
+                    np.asarray(g),
+                    np.asarray(w),
+                    err_msg=f"{msg} {route} {name}",
+                )
+
+    def test_in_range(self):
+        rng = np.random.default_rng(5)
+        for c, n in [(6, 500), (130, 3000), (1000, 4000)]:
+            pred = rng.integers(0, c, n).astype(np.int32)
+            target = rng.integers(0, c, n).astype(np.int32)
+            self._assert_parity(
+                pred,
+                target,
+                c,
+                f"c={c}",
+                want=[
+                    np.asarray(x)
+                    for x in self._reference_trio(
+                        jnp.asarray(pred), jnp.asarray(target), c
+                    )
+                ],
+            )
+
+    def test_out_of_range_marginals(self):
+        # Wrap-then-compare semantics: [-C, 0) wraps numpy-style, < -C
+        # and >= C drop from their own marginal but still count in the
+        # OTHER label's marginal; correctness is wrapped equality (the
+        # (-1, 5) pair below is a TP at class 5, exactly as the metric's
+        # own confusion matrix counts it at cell (5, 5)).
+        c = 6
+        pred = np.asarray([0, 1, -6, 2, 9, -1, 700, -1], np.int32)
+        target = np.asarray([0, -7, 1, 2, 3, 3, -800, 5], np.int32)
+        got = self._routes(pred, target, c)
+        want_tp = np.zeros(c, np.int32)
+        want_tp[[0, 2, 5]] = 1  # (0,0), (2,2), and the wrapped (-1, 5)
+        want_label = np.bincount([0, 1, 2, 3, 3, 5], minlength=c)
+        want_pred = np.bincount([0, 1, 0, 2, 5, 5], minlength=c)  # -6→0, -1→5
+        self._assert_parity(
+            pred, target, c, "oob", want=[want_tp, want_label, want_pred]
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

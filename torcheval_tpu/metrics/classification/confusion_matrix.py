@@ -15,6 +15,7 @@ from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
     _confusion_matrix_param_check,
     _confusion_matrix_update_input_check,
     _confusion_matrix_update_kernel,
+    _cm_route,
     _use_matmul_cm,
 )
 from torcheval_tpu.metrics.metric import Metric
@@ -53,7 +54,7 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
             target,
             statics=(
                 self.num_classes,
-                _use_matmul_cm(self.num_classes, input.shape[0]),
+                _cm_route(self.num_classes, input.shape[0]),
             ),
         )
         return self

@@ -7,6 +7,9 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    _counts_route,
+)
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.f1_score import (
     _binary_f1_score_update_input_check,
@@ -53,7 +56,11 @@ class MulticlassF1Score(Metric[jax.Array]):
             (self.num_tp, self.num_label, self.num_prediction),
             input,
             target,
-            statics=(self.num_classes, self.average),
+            statics=(
+                self.num_classes,
+                self.average,
+                _counts_route(input, self.num_classes, self.average),
+            ),
         )
         return self
 

@@ -7,6 +7,9 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    _counts_route,
+)
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.recall import (
     _binary_recall_compute,
@@ -83,7 +86,11 @@ class MulticlassRecall(Metric[jax.Array]):
             (self.num_tp, self.num_labels, self.num_predictions),
             input,
             target,
-            statics=(self.num_classes, self.average),
+            statics=(
+                self.num_classes,
+                self.average,
+                _counts_route(input, self.num_classes, self.average),
+            ),
         )
         return self
 

@@ -3,10 +3,15 @@
 
 TPU-first: where the reference builds a sparse COO tensor and densifies it
 (reference ``confusion_matrix.py:217-232``), the update here dispatches
-between ONE MXU matmul of one-hot encodings (``cm = onehot(target)ᵀ @
-onehot(pred)``, up to 207× the scatter at small C — see ``_use_matmul_cm``
-for the measured crossover) and a single scatter-add ``zeros((C,
-C)).at[target, pred].add(1)`` for large C.  The dead
+three ways (``_cm_route``): ONE MXU matmul of one-hot encodings (``cm =
+onehot(target)ᵀ @ onehot(pred)``, up to 207× the scatter at tiny C — see
+``_use_matmul_cm`` for the measured table), the bucket-compaction Pallas
+kernel (``ops/pallas_cm.py``, 2.1× the scatter at 2^20×1000 and the
+route's winner for C in (64, ~1150]), and a single scatter-add
+``zeros((C, C)).at[target, pred].add(1)`` elsewhere.  F1/precision/recall
+derive their per-class count trios from the same routed slab
+(``_class_counts``) instead of the reference's three separate label
+scatters.  The dead
 ``_binary_confusion_matrix_compute`` with swapped normalization dims
 (reference ``confusion_matrix.py:150-160``) is intentionally not
 reproduced (SURVEY §7 hard part 7)."""
@@ -58,8 +63,45 @@ def _confusion_matrix_update(
     input: jax.Array, target: jax.Array, num_classes: int
 ) -> jax.Array:
     _confusion_matrix_update_input_check(input, target, num_classes)
-    use_matmul = _use_matmul_cm(num_classes, input.shape[0])
-    return _confusion_matrix_update_kernel(input, target, num_classes, use_matmul)
+    route = _cm_route(num_classes, input.shape[0])
+    return _confusion_matrix_update_kernel(input, target, num_classes, route)
+
+
+def _cm_route(num_classes: int, num_samples: int) -> str:
+    """Three-way route for the (C, C) count accumulation, decided at call
+    time from shapes/backend/flags only (so it is identical under a
+    caller's jit — no tracer-dependent downgrade):
+
+    - ``"matmul"``: ONE dense one-hot MXU matmul — tiny C or tiny N
+      (:func:`_use_matmul_cm`'s measured table; 0.12 ms at 2^20×64).
+    - ``"pallas"``: the bucket-compaction kernel (`ops/pallas_cm.py`).
+      Measured crossover sweep on v5e at N=2^20 (ms, adaptive CAP):
+
+          C        64    128   256   512   768   1000  1100
+          pallas   1.16  1.68  2.58  3.84  3.12  3.34  3.67
+          matmul   0.12  3.38  3.63  4.43  —     —     —
+          scatter  ~7.1 at every C
+
+      and over N at C=1000 the kernel holds a ~2.1× lead down to 2^15
+      (0.108 vs 0.224 ms), so: matmul below C=65, pallas everywhere its
+      window/N bounds allow, scatter beyond.
+    - ``"scatter"``: the reference formulation — any backend, any
+      shape; O(N + C²) memory and exact int32 counts.
+    """
+    from torcheval_tpu.ops._flags import pallas_disabled
+
+    matmul_ok = _use_matmul_cm(num_classes, num_samples)
+    if matmul_ok and num_classes <= 64:
+        return "matmul"
+    if not pallas_disabled() and jax.default_backend() == "tpu":
+        from torcheval_tpu.ops.pallas_cm import _MAX_W, class_window
+
+        if (
+            class_window(num_classes) <= _MAX_W
+            and 2**15 <= num_samples < 2**24
+        ):
+            return "pallas"
+    return "matmul" if matmul_ok else "scatter"
 
 
 def _use_matmul_cm(num_classes: int, num_samples: int) -> bool:
@@ -102,16 +144,22 @@ def _matmul_cm(
     the f32 accumulation is exact below 2^24 per cell, so the result is
     bit-identical to the scatter formulation within the dispatch
     bounds."""
-    classes = jnp.arange(num_classes)
-    oh_true = (target[:, None] == classes[None, :]).astype(jnp.bfloat16)
-    oh_pred = (input[:, None] == classes[None, :]).astype(jnp.bfloat16)
-    cm = jax.lax.dot_general(
-        oh_true,
-        oh_pred,
+    return _onehot_cm(target, input, num_classes).astype(jnp.int32)
+
+
+def _onehot_cm(t: jax.Array, p: jax.Array, width: int) -> jax.Array:
+    """``(width, width)`` f32 counts as one bf16 one-hot dot_general —
+    the shared core of :func:`_matmul_cm` and the matmul branch of
+    :func:`_class_counts` (which widens by a sentinel column)."""
+    classes = jnp.arange(width)
+    oh_t = (t[:, None] == classes[None, :]).astype(jnp.bfloat16)
+    oh_p = (p[:, None] == classes[None, :]).astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        oh_t,
+        oh_p,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    return cm.astype(jnp.int32)
 
 
 def _wrap_labels(x: jax.Array, num_classes: int) -> jax.Array:
@@ -125,24 +173,87 @@ def _wrap_labels(x: jax.Array, num_classes: int) -> jax.Array:
     return jnp.where(x < 0, num_classes, x)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "use_matmul"))
+@partial(jax.jit, static_argnames=("num_classes", "route"))
 def _confusion_matrix_update_kernel(
     input: jax.Array,
     target: jax.Array,
     num_classes: int,
-    use_matmul: bool = False,
+    route: str = "scatter",
 ) -> jax.Array:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
     input = _wrap_labels(input, num_classes)
     target = _wrap_labels(target, num_classes)
-    if use_matmul:
+    if route == "matmul":
         return _matmul_cm(input, target, num_classes)
+    if route == "pallas":
+        from torcheval_tpu.ops.pallas_cm import confusion_slab
+
+        slab = confusion_slab(
+            jnp.minimum(target, num_classes),
+            jnp.minimum(input, num_classes),
+            num_classes=num_classes,
+        )
+        return slab[:num_classes, :num_classes].astype(jnp.int32)
     return (
         jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
         .at[target, input]
         .add(1, mode="drop")
     )
+
+
+def _counts_route(input, num_classes, average) -> str:
+    """Call-time route for the F1/precision/recall per-class count trio:
+    the micro paths are scatter-free scalars, everything else follows the
+    confusion-matrix route for its (N, C) shape."""
+    if average == "micro" or num_classes is None:
+        return "scatter"
+    return _cm_route(num_classes, input.shape[0])
+
+
+def _class_counts(
+    pred: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    route: str,
+    interpret: bool = False,
+):
+    """The per-class ``(num_tp, num_label, num_prediction)`` trio shared
+    by F1 / precision / recall, through the same three-way route as the
+    confusion matrix — ONE (C, C)-slab accumulation replaces the
+    reference's three separate label scatters (reference
+    ``f1_score.py:116-156``), which serialize on TPU (~7 ms each for 2^20
+    samples).  The slab carries a sentinel row/column ``C`` so labels the
+    scatters would drop stay accounted for in the marginals: a sample
+    with an out-of-range prediction still counts in ``num_label`` and
+    vice versa.  All three routes are bit-identical on the same defined
+    OOB semantics as the confusion matrix itself (``_wrap_labels``):
+    labels wrap numpy-style first and correctness is wrapped equality —
+    so ``num_tp`` equals the diagonal of the metric's own confusion
+    matrix even for ``(-1, C-1)``-style pairs reachable only under
+    ``skip_value_checks``/tracing (the reference's torch scatters simply
+    crash there).  ``pred`` must already be 1-D labels."""
+    t = jnp.minimum(_wrap_labels(target, num_classes), num_classes)
+    p = jnp.minimum(_wrap_labels(pred, num_classes), num_classes)
+    c = num_classes
+    if route == "scatter":
+        correct = ((t == p) & (t < c)).astype(jnp.int32)
+        num_label = jnp.zeros(c, jnp.int32).at[t].add(1, mode="drop")
+        num_prediction = jnp.zeros(c, jnp.int32).at[p].add(1, mode="drop")
+        num_tp = jnp.zeros(c, jnp.int32).at[t].add(correct, mode="drop")
+        return num_tp, num_label, num_prediction
+    if route == "pallas":
+        from torcheval_tpu.ops.pallas_cm import confusion_slab
+
+        slab = confusion_slab(
+            t, p, num_classes=num_classes, interpret=interpret
+        )
+    else:  # matmul over the (C+1)-wide sentinel window
+        slab = _onehot_cm(t, p, num_classes + 1)
+    num_label = jnp.sum(slab[:c, :], axis=1).astype(jnp.int32)
+    num_prediction = jnp.sum(slab[:, :c], axis=0).astype(jnp.int32)
+    num_tp = jnp.diagonal(slab[:c, :c]).astype(jnp.int32)
+    return num_tp, num_label, num_prediction
 
 
 def _binary_confusion_matrix_validate(input: jax.Array, target: jax.Array) -> None:
@@ -169,7 +280,7 @@ def _binary_confusion_matrix_update_kernel(
 ) -> jax.Array:
     pred = jnp.where(input < threshold, 0, 1)
     return _confusion_matrix_update_kernel(
-        pred, target.astype(jnp.int32), 2, use_matmul
+        pred, target.astype(jnp.int32), 2, "matmul" if use_matmul else "scatter"
     )
 
 

@@ -15,6 +15,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from torcheval_tpu.metrics.functional._host_checks import all_concrete
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    _class_counts,
+    _counts_route,
+)
 from torcheval_tpu.metrics.functional.classification.precision import (
     _check_index_ranges,
 )
@@ -56,7 +60,13 @@ def _f1_score_update(
     average: Optional[str],
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     _f1_score_validate(input, target, num_classes, average)
-    return _f1_score_update_kernel(input, target, num_classes, average)
+    return _f1_score_update_kernel(
+        input,
+        target,
+        num_classes,
+        average,
+        _counts_route(input, num_classes, average),
+    )
 
 
 def _f1_score_validate(
@@ -74,12 +84,13 @@ def _f1_score_validate(
         _check_index_ranges(pairs, num_classes)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "average"))
+@partial(jax.jit, static_argnames=("num_classes", "average", "route"))
 def _f1_score_update_kernel(
     input: jax.Array,
     target: jax.Array,
     num_classes: Optional[int],
     average: Optional[str],
+    route: str = "scatter",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
@@ -87,11 +98,9 @@ def _f1_score_update_kernel(
         num_tp = (input == target).sum()
         num_label = jnp.asarray(target.shape[0])
         return num_tp, num_label, num_label
-    correct = (input == target).astype(jnp.int32)
-    num_label = jnp.zeros(num_classes, jnp.int32).at[target].add(1)
-    num_prediction = jnp.zeros(num_classes, jnp.int32).at[input].add(1)
-    num_tp = jnp.zeros(num_classes, jnp.int32).at[target].add(correct)
-    return num_tp, num_label, num_prediction
+    # ONE routed (C, C)-slab accumulation instead of the reference's
+    # three label scatters (each serializes on TPU) — see _class_counts.
+    return _class_counts(input, target, num_classes, route)
 
 
 def _f1_score_compute(

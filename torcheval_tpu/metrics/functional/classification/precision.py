@@ -19,6 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    _class_counts,
+    _counts_route,
+)
 from torcheval_tpu.metrics.functional._host_checks import (
     all_concrete,
     check_index_ranges as _check_index_ranges,
@@ -56,7 +60,13 @@ def _precision_update(
     average: Optional[str],
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     _precision_validate(input, target, num_classes, average)
-    return _precision_update_kernel(input, target, num_classes, average)
+    return _precision_update_kernel(
+        input,
+        target,
+        num_classes,
+        average,
+        _counts_route(input, num_classes, average),
+    )
 
 
 def _precision_validate(
@@ -74,12 +84,13 @@ def _precision_validate(
         _check_index_ranges(pairs, num_classes)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "average"))
+@partial(jax.jit, static_argnames=("num_classes", "average", "route"))
 def _precision_update_kernel(
     input: jax.Array,
     target: jax.Array,
     num_classes: Optional[int],
     average: Optional[str],
+    route: str = "scatter",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
@@ -87,11 +98,13 @@ def _precision_update_kernel(
         num_tp = (input == target).sum()
         num_fp = (input != target).sum()
         return num_tp, num_fp, jnp.asarray(0.0)
-    correct = (input == target).astype(jnp.int32)
-    num_label = jnp.zeros(num_classes, jnp.int32).at[target].add(1)
-    num_tp = jnp.zeros(num_classes, jnp.int32).at[target].add(correct)
-    num_fp = jnp.zeros(num_classes, jnp.int32).at[input].add(1 - correct)
-    return num_tp, num_fp, num_label
+    # ONE routed (C, C)-slab accumulation instead of three label
+    # scatters (each serializes on TPU) — see _class_counts; the false
+    # positives are the prediction marginal minus the diagonal.
+    num_tp, num_label, num_prediction = _class_counts(
+        input, target, num_classes, route
+    )
+    return num_tp, num_prediction - num_tp, num_label
 
 
 def _precision_compute(

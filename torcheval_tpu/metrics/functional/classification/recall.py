@@ -19,6 +19,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+    _class_counts,
+    _counts_route,
+)
 from torcheval_tpu.metrics.functional._host_checks import all_concrete
 from torcheval_tpu.metrics.functional.classification.precision import (
     _check_index_ranges,
@@ -72,7 +76,13 @@ def _recall_update(
     average: Optional[str],
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     _recall_validate(input, target, num_classes, average)
-    return _recall_update_kernel(input, target, num_classes, average)
+    return _recall_update_kernel(
+        input,
+        target,
+        num_classes,
+        average,
+        _counts_route(input, num_classes, average),
+    )
 
 
 def _recall_validate(
@@ -90,12 +100,13 @@ def _recall_validate(
         _check_index_ranges(pairs, num_classes)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "average"))
+@partial(jax.jit, static_argnames=("num_classes", "average", "route"))
 def _recall_update_kernel(
     input: jax.Array,
     target: jax.Array,
     num_classes: Optional[int],
     average: Optional[str],
+    route: str = "scatter",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
@@ -103,11 +114,9 @@ def _recall_update_kernel(
         num_tp = (input == target).sum()
         num_labels = jnp.asarray(target.size)
         return num_tp, num_labels, num_labels
-    correct = (input == target).astype(jnp.int32)
-    num_labels = jnp.zeros(num_classes, jnp.int32).at[target].add(1)
-    num_predictions = jnp.zeros(num_classes, jnp.int32).at[input].add(1)
-    num_tp = jnp.zeros(num_classes, jnp.int32).at[target].add(correct)
-    return num_tp, num_labels, num_predictions
+    # ONE routed (C, C)-slab accumulation instead of three label
+    # scatters (each serializes on TPU) — see _class_counts.
+    return _class_counts(input, target, num_classes, route)
 
 
 def _recall_compute(
