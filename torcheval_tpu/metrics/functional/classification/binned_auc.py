@@ -292,11 +292,21 @@ def _binned_counts_rows_sort(
         zero_r = jnp.zeros((num_rows,), jnp.int32)
         return zero_t, zero_t, zero_r, zero_r
     # int8 payload: sort bandwidth dominates this pattern (see _sort_scan);
-    # widen in the cumsum instead.
-    s_sorted, h_sorted = lax.sort(
-        (scores, hits.astype(jnp.int8)), dimension=-1, num_keys=1
-    )
-    cum_hits = jnp.cumsum(h_sorted, axis=-1, dtype=jnp.int32)
+    # widen in the cumsum instead.  Single rows sort/scan in 1-D layout
+    # (see _sort_scan.sort_row_1d).
+    if num_rows == 1:
+        from torcheval_tpu.metrics.functional.classification._sort_scan import (
+            sort_row_1d,
+        )
+
+        s_1d, h_1d = sort_row_1d(scores[0], hits[0].astype(jnp.int8))
+        s_sorted = s_1d[None]
+        cum_hits = jnp.cumsum(h_1d, dtype=jnp.int32)[None]
+    else:
+        s_sorted, h_sorted = lax.sort(
+            (scores, hits.astype(jnp.int8)), dimension=-1, num_keys=1
+        )
+        cum_hits = jnp.cumsum(h_sorted, axis=-1, dtype=jnp.int32)
     total_hits = cum_hits[:, -1:]
     idx = jax.vmap(
         lambda row: jnp.searchsorted(row, thresholds, side="left")

@@ -263,9 +263,21 @@ def pallas_binary_auroc(
         scores, targets = scores[None], targets[None]
     # int8 payload through the sort (4x less payload bandwidth than f32 —
     # the sort dominates at headline scale, same as _sort_scan.py's core).
-    neg_t, hits_i8 = lax.sort(
-        (-scores.astype(jnp.float32), targets.astype(jnp.int8)), num_keys=1
-    )
+    # Single rows sort in 1-D layout (see _sort_scan.sort_row_1d).
+    if scores.shape[0] == 1:
+        from torcheval_tpu.metrics.functional.classification._sort_scan import (
+            sort_row_1d,
+        )
+
+        neg_1d, hits_1d = sort_row_1d(
+            -scores[0].astype(jnp.float32), targets[0].astype(jnp.int8)
+        )
+        neg_t, hits_i8 = neg_1d[None], hits_1d[None]
+    else:
+        neg_t, hits_i8 = lax.sort(
+            (-scores.astype(jnp.float32), targets.astype(jnp.int8)),
+            num_keys=1,
+        )
     auc = auc_from_sorted(
         -neg_t, hits_i8.astype(jnp.float32), interpret=interpret
     )
