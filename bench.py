@@ -253,6 +253,16 @@ def _headline_device_stats() -> dict:
     )
     if stats:  # don't assert a route when the device clock itself failed
         stats["device_route"] = "sort" if cap is None else f"ustat_cap{cap}"
+        if cap is not None:
+            from benchmarks.workloads import (
+                _ustat_rank_sum_macs,
+                _with_roofline,
+            )
+
+            _with_roofline(
+                stats,
+                mxu_macs=_ustat_rank_sum_macs(cap, NUM_CLASSES, NUM_SAMPLES),
+            )
     return stats
 
 

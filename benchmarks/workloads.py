@@ -23,6 +23,70 @@ REPEATS = 3
 
 # v5e single-chip HBM bandwidth ceiling, for utilization accounting.
 V5E_HBM_GBPS = 819.0
+# v5e single-chip roofs for the ledger's roofline fields (round-3 VERDICT
+# item 3).  bf16 MXU peak is the published 197 TFLOP/s; the VPU roof is an
+# estimate from the architecture (8 sublanes × 128 lanes × 4 ALUs ×
+# 0.94 GHz ≈ 3.9 T elementwise ops/s) — good to the ~2× a roofline needs.
+V5E_BF16_FLOPS = 197e12
+V5E_VPU_OPS = 3.9e12
+
+
+def _with_roofline(
+    extras: dict,
+    *,
+    mxu_macs: float = None,
+    vpu_ops: float = None,
+    note: str = None,
+) -> dict:
+    """Attach roofline fields to a device-clocked ledger row: the hand-
+    modelled op count, the fraction of each v5e roof it sustains, and the
+    BINDING resource (the roof used hardest).  ``mxu_macs`` counts bf16
+    multiply-accumulates (2 flops each); ``vpu_ops`` counts elementwise
+    lane ops.  The HBM percentage is the existing input-read lower bound;
+    values over 100 mean the inputs stayed VMEM-resident across the
+    timing loop.  Hand models over XLA cost_analysis: the hot rows are
+    Pallas kernels XLA cannot see into, and the models are one-line
+    formulas auditable against each kernel's docstring."""
+    if not extras or "device_ms_per_step" not in extras:
+        return extras
+    sec = extras["device_ms_per_step"] / 1e3
+    roofs = {"hbm": extras.get("hbm_util_pct_lower_bound", 0.0)}
+    if mxu_macs:
+        extras["model_mxu_tflops"] = round(2 * mxu_macs / sec / 1e12, 1)
+        roofs["bf16_mxu"] = 100.0 * 2 * mxu_macs / sec / V5E_BF16_FLOPS
+    if vpu_ops:
+        extras["model_vpu_tops"] = round(vpu_ops / sec / 1e12, 2)
+        roofs["vpu"] = 100.0 * vpu_ops / sec / V5E_VPU_OPS
+    binding = max(roofs, key=roofs.get)
+    extras["binding_roof"] = binding
+    extras["pct_of_binding_roof"] = round(roofs[binding], 1)
+    if note:
+        extras["roofline_note"] = note
+    return extras
+
+
+def _ustat_rank_sum_macs(cap: float, num_rows: float, n: float) -> float:
+    """bf16 MAC model for the rank-sum gather kernel (ops/pallas_ustat.py):
+    2 passes × 3 bf16 components × 128·(cap/16) MACs per (row, sample).
+    ONE definition serves the headline and the sharded-exact row."""
+    return 6.0 * 128 * (cap / 16) * num_rows * n
+
+
+def _binned_hist_macs(n: float, thresholds: float, rows: float = 1.0) -> float:
+    """bf16 MAC model for the binned-counts MXU histogram
+    (ops/pallas_binned.py): per element (128 gather + 256 accumulate)
+    MACs per coarse block, ceil(T/128) blocks."""
+    return rows * n * 384.0 * -(-int(thresholds) // 128)
+
+
+def _sort_stage_ops(n: float, rows: float = 1.0) -> float:
+    """VPU op model for XLA's bitonic-network sort: log2(L)·(log2(L)+1)/2
+    compare-exchange stages, ~4 lane ops each (compare + two selects +
+    shuffle), over rows·L elements."""
+    import math
+
+    s = math.log2(max(n, 2))
+    return rows * n * 4.0 * s * (s + 1) / 2
 
 
 def _device_seconds(step_kernel, args, iters: int = 8) -> float:
@@ -159,6 +223,8 @@ def bench_accuracy() -> Tuple[str, float, Optional[float]]:
         n,
         scores.nbytes + target.nbytes,
     )
+    # ~3 VPU ops per score element (argmax compare/select + eq).
+    _with_roofline(extras, vpu_ops=3.0 * n * 5)
     return "multiclass_accuracy_5c", ours, ref, extras
 
 
@@ -190,6 +256,11 @@ def bench_binary_auroc() -> Tuple[str, float, Optional[float]]:
         (jnp.asarray(scores), jnp.asarray(target)),
         n,
         scores.nbytes + target.nbytes,
+    )
+    _with_roofline(
+        extras,
+        vpu_ops=_sort_stage_ops(n) + 8.0 * n,
+        note="bitonic-stage sort model + Pallas scan (~8 ops/elem)",
     )
     return "binary_auroc_sort_scan", ours, ref, extras
 
@@ -237,6 +308,11 @@ def bench_binary_auprc() -> Tuple[str, float, Optional[float]]:
         n,
         scores.nbytes + target.nbytes,
     )
+    _with_roofline(
+        extras,
+        vpu_ops=_sort_stage_ops(n) + 12.0 * n,
+        note="bitonic-stage sort model + tie-group scan",
+    )
     return "binary_auprc_curve", ours, ref, extras
 
 
@@ -272,6 +348,11 @@ def bench_binary_auprc_scalar() -> Tuple[str, float, Optional[float]]:
         (jnp.asarray(scores), jnp.asarray(target)),
         n,
         scores.nbytes + target.nbytes,
+    )
+    _with_roofline(
+        extras,
+        vpu_ops=_sort_stage_ops(n) + 12.0 * n,
+        note="bitonic-stage sort model + tie-group scan",
     )
     return "binary_auprc_scalar", ours, ref, extras
 
@@ -339,6 +420,14 @@ def bench_confusion_f1() -> Tuple[str, float, Optional[float]]:
         n,
         pred.nbytes + target.nbytes,
     )
+    # Two pallas_cm slab passes (cm + f1 trio): per 1024-tile the
+    # triangular prefix (16*1024^2), payload compaction (3*96*1024*16)
+    # and 16 per-bucket (96,64)@(96,1024) matmuls (~100M MACs).
+    _with_roofline(
+        extras,
+        mxu_macs=2.0 * (n / 1024) * 122e6,
+        note="bucket-compaction slab model (ops/pallas_cm.py)",
+    )
     return "confusion_matrix_f1_1000c", ours, ref, extras
 
 
@@ -395,6 +484,12 @@ def bench_regression() -> Tuple[str, float, Optional[float]]:
         (jnp.asarray(pred), jnp.asarray(target)),
         n,
         pred.nbytes + target.nbytes,
+    )
+    _with_roofline(
+        extras,
+        vpu_ops=12.0 * n,
+        note="streaming sums; inputs VMEM-resident (HBM pct > 100 "
+        "means the loop never re-reads HBM)",
     )
     return "r2_mse_streaming", ours, ref, extras
 
@@ -453,6 +548,7 @@ def bench_sharded_auroc_sync() -> Tuple[str, float, Optional[float]]:
         ref = n_ref / _time_steps(rstep, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
+    _with_roofline(extras, mxu_macs=_binned_hist_macs(n, 16384))
     return "sharded_auroc_histogram_sync", ours, ref, extras
 
 
@@ -509,6 +605,11 @@ def bench_sharded_multiclass_auroc() -> Tuple[str, float, Optional[float]]:
         ref = n_ref / _time_steps(rstep, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
+    _with_roofline(
+        extras,
+        mxu_macs=_binned_hist_macs(n, 2048, rows=c),
+        note="binned-counts MXU histogram over (C, n) rows",
+    )
     return "sharded_multiclass_auroc_1000c", ours, ref, extras
 
 
@@ -561,20 +662,55 @@ def bench_sharded_multiclass_exact() -> Tuple[str, float, Optional[float]]:
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
 
-    # Device clock for the (2^16, 1000) north-star shape (round-2 VERDICT
-    # weak item 4).  The step is seconds-scale, so the tunnel's ~10 ms
-    # dispatch overhead is <1% and lifecycle wall-clock IS the device
-    # clock; the fori_loop differencing clock is deliberately not used
-    # here — compiling this sort-heavy shard_map kernel under fori_loop
-    # is pathologically slow on the remote compiler.
+    # Standard fori-loop differencing clock (round-3 VERDICT item 5):
+    # the route decisions (cap autotune + kernel gate) are hoisted out
+    # eagerly and pinned, so the loop body is the fully-decided program —
+    # no tracer-time downgrade, and (since round 3 replaced the local
+    # sorts with the Pallas rank-sum counts) nothing pathological for the
+    # remote compiler.  The 1e-30 epsilon keeps perturbed zeros inside
+    # the bf16-split exactness domain (≥ 2^-100).
     import jax
 
-    extras = {
-        "device_value": round(n / sec, 1),
-        "device_ms_per_step": round(sec * 1e3, 3),
-        "device_backend": jax.default_backend(),
-        "device_clock": "wall (step ≫ dispatch overhead)",
-    }
+    from torcheval_tpu.parallel.exact import eager_ustat_pin
+
+    size = mesh.shape["dp"]
+    cap, kernel = eager_ustat_pin(s, t, c, size)
+    extras = {}
+    if kernel == "pallas":
+        # Only the rank-sum formulation goes under the fori clock: the
+        # searchsorted fallback's (C, P·cap + n_local) sorts inside a
+        # fori_loop are pathologically slow on the remote compiler (the
+        # round-2 reason this row was wall-clocked).
+
+        def dstep(s_, t_, i):
+            return sharded_multiclass_auroc_ustat(
+                s_ + i * jnp.float32(1e-30),
+                t_,
+                mesh,
+                num_classes=c,
+                max_class_count_per_shard=cap,
+                _kernel=kernel,
+            )
+
+        extras = _device_stats(
+            dstep, (s, t), n, scores.nbytes + target.nbytes
+        )
+        if extras:
+            extras["device_clock"] = (
+                f"fori-loop (cap={cap}, kernel={kernel} pinned eagerly "
+                "via eager_ustat_pin)"
+            )
+    if not extras:  # searchsorted regime or clock failure: honest wall
+        extras = {
+            "device_value": round(n / sec, 1),
+            "device_ms_per_step": round(sec * 1e3, 3),
+            "device_backend": jax.default_backend(),
+            "device_clock": "wall (step ≫ dispatch overhead)",
+        }
+    if "fori-loop" in str(extras.get("device_clock", "")):
+        _with_roofline(
+            extras, mxu_macs=_ustat_rank_sum_macs(cap, c, n)
+        )
     return "sharded_multiclass_auroc_exact_ustat", ours, ref, extras
 
 
@@ -613,6 +749,7 @@ def bench_binned_auroc() -> Tuple[str, float, Optional[float]]:
         n,
         scores.nbytes + target.nbytes,
     )
+    _with_roofline(extras, mxu_macs=_binned_hist_macs(n, 10000))
     return "binary_binned_auroc_10kbins", ours, ref, extras
 
 
@@ -702,6 +839,12 @@ def bench_collection_fused() -> Tuple[str, float, Optional[float]]:
         ref = n / _time_steps(rstep, repeats=2)
     except Exception as exc:  # pragma: no cover
         print(f"reference unavailable: {exc}", file=sys.stderr)
+    _with_roofline(
+        extras,
+        vpu_ops=30.0 * batch * 100,
+        note="five fused 100-class counter kernels, ~30 ops/element; "
+        "dispatch-bound through the tunnel, HBM-bound on device",
+    )
     return "collection_5metrics_fused", ours, ref, extras
 
 
@@ -765,6 +908,8 @@ def bench_perplexity() -> Tuple[str, float, Optional[float]]:
         "reference snapshot has no perplexity/text metric; baseline is a "
         "torch-CPU streaming cross-entropy equivalent"
     )
+    # log_softmax + gather: ~8 VPU ops per logit element.
+    _with_roofline(extras, vpu_ops=8.0 * float(l0.size))
     return "perplexity_tokens", ours, ref, extras
 
 

@@ -1,0 +1,176 @@
+"""Route-downgrade warnings + explain_route (torcheval_tpu/routing.py)."""
+
+import unittest
+import warnings
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.routing import (
+    RouteDowngradeWarning,
+    explain_route,
+    reset_route_warnings,
+    warn_route_downgrade,
+)
+
+
+class TestWarnOncePerCallsite(unittest.TestCase):
+    def setUp(self):
+        reset_route_warnings()
+
+    def test_dedupes_by_callsite_and_kind(self):
+        def emit():
+            warn_route_downgrade("k1", "message one")
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            emit()
+            emit()  # same callsite (inside emit) → deduped
+            warn_route_downgrade("k2", "message two")  # new kind → fires
+        kinds = [str(w.message) for w in rec]
+        self.assertEqual(kinds, ["message one", "message two"])
+
+
+class TestUstatTracerWarning(unittest.TestCase):
+    def setUp(self):
+        reset_route_warnings()
+
+    def test_route_guard_warns_under_trace(self):
+        # On this CPU test env the backend check would short-circuit
+        # before the tracer check; mock it so the ONLY blocker is tracing
+        # (the exact TPU-user situation the warning exists for).
+        from torcheval_tpu.metrics.functional import multiclass_auroc
+
+        rng = np.random.default_rng(0)
+        c = 8
+        with mock.patch(
+            "jax.default_backend", return_value="tpu"
+        ), mock.patch(
+            "torcheval_tpu.metrics.functional.classification.auroc."
+            "_use_pallas",
+            return_value=False,
+        ):
+            with pytest.warns(RouteDowngradeWarning, match="ustat_cap"):
+                for n in (2**15, 2**15 + 128):  # two traces, ONE warning
+                    s = jnp.asarray(rng.random((n, c)).astype(np.float32))
+                    t = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+                    jax.jit(
+                        lambda s, t: multiclass_auroc(s, t, num_classes=c)
+                    )(s, t)
+
+    def test_no_warning_eagerly_or_off_tpu(self):
+        from torcheval_tpu.metrics.functional import multiclass_auroc
+
+        rng = np.random.default_rng(1)
+        n, c = 2**15, 8
+        s = jnp.asarray(rng.random((n, c)).astype(np.float32))
+        t = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            multiclass_auroc(s, t, num_classes=c)  # eager, CPU: quiet
+            jax.jit(lambda s, t: multiclass_auroc(s, t, num_classes=c))(
+                s, t
+            )  # traced but backend is CPU: sort path is not a downgrade
+        self.assertEqual(
+            [w for w in rec if issubclass(w.category, RouteDowngradeWarning)],
+            [],
+        )
+
+
+class TestShardedAutotuneWarning(unittest.TestCase):
+    def setUp(self):
+        reset_route_warnings()
+
+    def test_multiclass_autotune_warns_on_tracers(self):
+        from torcheval_tpu.parallel import (
+            make_mesh,
+            sharded_multiclass_auroc_ustat,
+        )
+
+        rng = np.random.default_rng(3)
+        n, c = 64, 4
+        s = jnp.asarray(rng.random((n, c)).astype(np.float32))
+        t = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+        mesh = make_mesh()
+
+        with pytest.warns(
+            RouteDowngradeWarning, match="max_class_count_per_shard"
+        ):
+            jax.jit(
+                lambda s, t: sharded_multiclass_auroc_ustat(
+                    s, t, mesh, num_classes=c
+                )
+            )(s, t)
+
+    def test_explicit_cap_is_quiet(self):
+        from torcheval_tpu.parallel.exact import _resolve_ustat_cap
+
+        s = jnp.zeros((64, 4), jnp.float32)
+        t = jnp.zeros((64,), jnp.int32)
+
+        @jax.jit
+        def traced(s, t):
+            _resolve_ustat_cap(
+                8, 16, s, t, lambda: 0, "max_class_count_per_shard", "x"
+            )
+            return s
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            traced(s, t)
+        self.assertEqual(
+            [w for w in rec if issubclass(w.category, RouteDowngradeWarning)],
+            [],
+        )
+
+
+class TestExplainRoute(unittest.TestCase):
+    def test_cm_family(self):
+        from torcheval_tpu.metrics.functional import (
+            multiclass_confusion_matrix,
+            multiclass_f1_score,
+        )
+
+        p = jnp.zeros((256,), jnp.int32)
+        t = jnp.zeros((256,), jnp.int32)
+        msg = explain_route(
+            multiclass_confusion_matrix, p, t, num_classes=1000
+        )
+        self.assertIn("scatter", msg)  # CPU test env routes to scatter
+        msg = explain_route(
+            multiclass_f1_score, p, t, num_classes=1000, average="macro"
+        )
+        self.assertIn("count trio", msg)
+        # The DEFAULT configuration (micro, num_classes=None) must not
+        # crash and must name the scatter-free scalar path.
+        msg = explain_route(multiclass_f1_score, p, t)
+        self.assertIn("micro", msg)
+
+    def test_ustat_family_names_reason(self):
+        from torcheval_tpu.metrics.functional import (
+            binary_auroc,
+            multiclass_auroc,
+        )
+
+        rng = np.random.default_rng(2)
+        n, c = 2**15, 8
+        s = jnp.asarray(rng.random((n, c)).astype(np.float32))
+        t = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+        msg = explain_route(multiclass_auroc, s, t, num_classes=c)
+        self.assertIn("sort", msg)  # CPU: sort path, reason included
+        self.assertIn("backend", msg)
+        msg = explain_route(
+            binary_auroc, s[:, 0], (t == 0).astype(jnp.float32)
+        )
+        self.assertIn("sort", msg)
+
+    def test_unknown_fn(self):
+        self.assertIn("no call-time routing", explain_route(len, [1]))
+
+
+if __name__ == "__main__":
+    unittest.main()

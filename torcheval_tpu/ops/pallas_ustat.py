@@ -657,7 +657,7 @@ def binary_auprc_ustat(
     return _ap_from_hist(table, counts, hist)
 
 
-def _route_guards_ok(scores, target) -> bool:
+def _route_guards_ok(scores, target, pin_hint: str = "") -> bool:
     """Shared call-time gate for every ustat route: TPU backend, the
     pallas kill-switch (read per call), concrete values, and single-device
     placement.  Mesh-sharded buffers keep the XLA sort path: a pallas_call
@@ -673,6 +673,21 @@ def _route_guards_ok(scores, target) -> bool:
     if pallas_disabled() or ustat_disabled() or jax.default_backend() != "tpu":
         return False
     if not all_concrete(scores, target):
+        # The ONLY blocker is tracing: the caller would get the routed
+        # kernel eagerly but silently gets the sort path under their jit
+        # — say so once per callsite (the repo's own headline clock was
+        # bitten by exactly this; BASELINE.md round-3).  The remedy
+        # differs per entry point, so the caller supplies it.
+        from torcheval_tpu.routing import warn_route_downgrade
+
+        warn_route_downgrade(
+            "ustat-tracer",
+            "the sort-free rank-sum AUROC/AUPRC route cannot be decided "
+            "under jit (inputs are tracers); keeping the sort path. "
+            + pin_hint
+            + "  (torcheval_tpu.routing.explain_route, called eagerly, "
+            "names the route this data would take.)",
+        )
         return False
     sharding = getattr(scores, "sharding", None)
     return sharding is None or len(sharding.device_set) <= 1
@@ -711,7 +726,13 @@ def binary_ustat_route(
     # this N, skip the device sync entirely (compute() stays fully async).
     if _win_cap(1, scores.shape[1]) is None:
         return None
-    if not _route_guards_ok(scores, target):
+    if not _route_guards_ok(
+        scores,
+        target,
+        "The binary route has no pin: call the metric eagerly (outside "
+        "your jit) to use it, or keep the jitted sort path (the 1-D-"
+        "layout sort, ~10 ms at 2^22 on v5e).",
+    ):
         return None
     # ONE device fetch for all six stats (the _host_checks bounds
     # pattern) — per-element float() would block once per scalar.
@@ -772,7 +793,13 @@ def ustat_route_cap(
     beyond the int32 count bounds (see :func:`_win_cap`)."""
     if scores.shape[0] == 0 or _win_cap(1, scores.shape[0]) is None:
         return None  # no cap can pass at this N: skip the device sync
-    if not _route_guards_ok(scores, target):
+    if not _route_guards_ok(
+        scores,
+        target,
+        "Decide eagerly on representative data and pin the decision "
+        "with ustat_cap=... (the README 'pinning the rank-sum route "
+        "under jit' recipe).",
+    ):
         return None
     lo, hi, max_count, min_nz = (
         float(x) for x in np.asarray(_route_stats(scores, target))

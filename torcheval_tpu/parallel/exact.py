@@ -656,34 +656,12 @@ def sharded_multiclass_auroc_ustat(
     ):
         # The common default path: finite check + kernel-gate stats + cap
         # autotune (round-2 VERDICT item 6) in ONE fused round trip.
-        # Rounding the cap to a multiple of 64 keeps the compile-shape
-        # set small; it never overflows — the cap upper-bounds the true
-        # maximum by construction.
-        out = _mc_ustat_wrapper_stats(
-            scores, targets, num_classes=num_classes, world=size
+        cap, known_stats = _eager_ustat_decision(
+            scores, targets, num_classes, size
         )
-        if isinstance(out, jax.core.Tracer):
-            # Inside someone else's trace even concrete inputs stage to
-            # tracers (the _host_checks.bounds fallback pattern): compute
-            # the same stats in pure numpy on the host values.
-            host_s = np.asarray(scores)
-            host_t = np.asarray(targets).reshape(size, -1)
-            lo, hi = float(host_s.min()), float(host_s.max())
-            mag = np.abs(host_s)
-            nz = mag[mag > 0]
-            min_nz = float(nz.min()) if nz.size else float("inf")
-            most = max(
-                int(np.bincount(row, minlength=num_classes).max())
-                for row in host_t
-            )
-        else:
-            lo, hi, min_nz, most_hi, most_lo = (
-                float(x) for x in np.asarray(out)
-            )
-            most = int(most_hi) * 65536 + int(most_lo)
-        _raise_if_not_finite(lo, hi, "sharded_multiclass_auroc_ustat")
-        known_stats = (lo, hi, min_nz)
-        cap = min(n_local, -(-max(most, 1) // 64) * 64)
+        _raise_if_not_finite(
+            known_stats[0], known_stats[1], "sharded_multiclass_auroc_ustat"
+        )
     elif max_class_count_per_shard is None and all_concrete(scores, targets):
         # skip_value_checks (or empty input): autotune alone.
         known_stats = None
@@ -692,6 +670,24 @@ def sharded_multiclass_auroc_ustat(
         )
         cap = min(n_local, -(-max(most, 1) // 64) * 64)
     else:
+        if max_class_count_per_shard is None and not all_concrete(
+            scores, targets
+        ):
+            # ONLY the multiclass wrapper autotunes; under tracing the
+            # autotune cannot peek at values and the pack silently widens
+            # to the full shard — O(N·C) wire instead of ~O(#positives).
+            # Loud, once per callsite (round-3 VERDICT weak item 5).
+            from torcheval_tpu.routing import warn_route_downgrade
+
+            warn_route_downgrade(
+                "ustat-cap-autotune",
+                "sharded_multiclass_auroc_ustat's cap autotune cannot "
+                "run under jit (inputs are tracers); packing the full "
+                f"shard ({n_local} rows) — O(N·C) wire instead of "
+                "~O(#positives).  Measure the cap eagerly once (e.g. "
+                "parallel.exact.eager_ustat_pin) and pass "
+                "max_class_count_per_shard= explicitly.",
+            )
         known_stats = _check_finite_scores(
             scores, "sharded_multiclass_auroc_ustat"
         )
@@ -902,6 +898,58 @@ def _mc_ustat_kernel_counts(
         jnp.float32(0.5),
         two_u.astype(jnp.float32) / (2.0 * factor),
     )
+
+
+def _eager_ustat_decision(scores, targets, num_classes: int, world: int):
+    """The multiclass pod-ustat wrapper's eager default decision — cap
+    autotune + kernel-gate stats in ONE fused device round trip.  Returns
+    ``(cap, (lo, hi, min_nz))``.  Rounding the cap to a multiple of 64
+    keeps the compile-shape set small; it never overflows — the cap
+    upper-bounds the true per-shard maximum by construction.  ONE
+    definition serves the wrapper, :func:`eager_ustat_pin`, and the
+    benchmark clock, so retunes cannot desynchronize them."""
+    n_local = scores.shape[0] // world
+    out = _mc_ustat_wrapper_stats(
+        scores, targets, num_classes=num_classes, world=world
+    )
+    if isinstance(out, jax.core.Tracer):
+        # Inside someone else's trace even ops on concrete arrays stage
+        # to tracers (the _host_checks.bounds fallback pattern): compute
+        # the same stats in pure numpy on the host values.
+        host_s = np.asarray(scores)
+        host_t = np.asarray(targets).reshape(world, -1)
+        lo, hi = float(host_s.min()), float(host_s.max())
+        mag = np.abs(host_s)
+        nz = mag[mag > 0]
+        min_nz = float(nz.min()) if nz.size else float("inf")
+        most = max(
+            int(np.bincount(row, minlength=num_classes).max())
+            for row in host_t
+        )
+    else:
+        lo, hi, min_nz, most_hi, most_lo = (
+            float(x) for x in np.asarray(out)
+        )
+        most = int(most_hi) * 65536 + int(most_lo)
+    cap = min(n_local, -(-max(most, 1) // 64) * 64)
+    return cap, (lo, hi, min_nz)
+
+
+def eager_ustat_pin(scores, targets, num_classes: int, world: int):
+    """Decide the pod ustat's ``(cap, kernel)`` pin EAGERLY on concrete
+    data — the same decision :func:`sharded_multiclass_auroc_ustat` makes
+    for its concrete defaults, exposed so jitted callers (whose traced
+    autotune would silently pack the full shard) and the benchmark clock
+    can pin it.  Returns ``(cap, kernel)`` with ``kernel`` one of
+    ``"pallas"`` / ``"searchsorted"`` — pass them as
+    ``max_class_count_per_shard=`` and ``_kernel=``."""
+    cap, known_stats = _eager_ustat_decision(
+        scores, targets, num_classes, world
+    )
+    ok = _mc_ustat_kernel_ok(
+        scores, scores.shape[0], cap * world, known_stats
+    )
+    return cap, ("pallas" if ok else "searchsorted")
 
 
 @partial(jax.jit, static_argnames=("num_classes", "world"))

@@ -1,0 +1,195 @@
+"""Route-downgrade diagnostics: loud fallbacks and ``explain_route``.
+
+Several hot paths pick their formulation at CALL time from concrete
+values (the ``_select_binned_route`` pattern): the sort-free rank-sum
+AUROC/AUPRC route, the sharded ustat cap autotune, the confusion-matrix
+three-way dispatch.  Under a caller's ``jax.jit`` those deciders see
+tracers and silently keep the safe-but-slower formulation — the exact
+failure mode that once made this repo's own headline clock measure the
+189 ms sort path while eager users got the 33 ms routed kernel
+(BASELINE.md round-3).  This module makes the downgrade loud (ONE
+warning per user callsite) and gives users a way to ask which
+formulation a call will take and why.
+"""
+
+from __future__ import annotations
+
+import traceback
+import warnings
+from typing import Set, Tuple
+
+_PKG_MARKER = "torcheval_tpu"
+_warned_callsites: Set[Tuple[str, int, str]] = set()
+
+
+class RouteDowngradeWarning(UserWarning):
+    """A call-time fast-path decider fell back to a slower formulation
+    for a reason the caller can fix (usually: pin the decision eagerly,
+    e.g. ``ustat_cap=`` / ``max_class_count_per_shard=``)."""
+
+
+def _user_callsite() -> Tuple[str, int]:
+    """First stack frame outside this package (the user's call line)."""
+    for frame in reversed(traceback.extract_stack(limit=40)[:-1]):
+        if _PKG_MARKER not in (frame.filename or ""):
+            return frame.filename, frame.lineno or 0
+    return "<unknown>", 0
+
+
+def warn_route_downgrade(kind: str, message: str) -> None:
+    """Emit ``RouteDowngradeWarning`` once per (user callsite, kind).
+
+    ``warn_explicit`` at the USER's file/line, with no Python warning
+    registry: a plain ``warnings.warn`` from here would register every
+    callsite under this module's fixed line, so under default filters
+    only the FIRST user callsite would ever warn — and the warning would
+    point at package internals instead of the user's jit call."""
+    filename, lineno = _user_callsite()
+    key = (filename, lineno, kind)
+    if key in _warned_callsites:
+        return
+    _warned_callsites.add(key)
+    warnings.warn_explicit(
+        message, RouteDowngradeWarning, filename, lineno
+    )
+
+
+def reset_route_warnings() -> None:
+    """Forget which callsites already warned (test hook)."""
+    _warned_callsites.clear()
+
+
+def explain_route(fn, *args, **kwargs) -> str:
+    """Explain which formulation ``fn(*args, **kwargs)`` would run and
+    why — a debugging aid for the call-time routed entry points.
+
+    Supported ``fn``: ``multiclass_auroc``, ``multiclass_auprc``,
+    ``binary_auroc``, ``binary_auprc``, ``multiclass_confusion_matrix``,
+    ``multiclass_f1_score``, ``multiclass_precision``,
+    ``multiclass_recall`` (the ``torcheval_tpu.metrics.functional``
+    callables).  Call it EAGERLY on representative data — inside a jit
+    the deciders see tracers, which is exactly the downgrade this helper
+    diagnoses.  Returns a one-paragraph human-readable explanation.
+    """
+    import jax
+
+    import torcheval_tpu.metrics.functional as F
+    from torcheval_tpu.metrics.functional._host_checks import all_concrete
+    from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
+
+    name = getattr(fn, "__name__", str(fn))
+    backend = jax.default_backend()
+
+    def env_blockers() -> str:
+        if pallas_disabled():
+            return "TORCHEVAL_TPU_DISABLE_PALLAS is set"
+        if backend != "tpu":
+            return f"backend is {backend!r}, not TPU"
+        return ""
+
+    if fn in (F.multiclass_auroc, F.multiclass_auprc):
+        from torcheval_tpu.ops.pallas_ustat import ustat_route_cap
+
+        scores, target = args[0], args[1]
+        num_classes = kwargs.get(
+            "num_classes", scores.shape[1] if hasattr(scores, "shape") else None
+        )
+        cap = ustat_route_cap(
+            jax.numpy.asarray(scores), jax.numpy.asarray(target), num_classes
+        )
+        if cap is not None:
+            return (
+                f"{name}: sort-free Pallas rank-sum route, table cap {cap}. "
+                f"Under a caller's jit this decision sees tracers and falls "
+                f"back to the sort path — pin it with ustat_cap={cap} (the "
+                f"README 'pinning the rank-sum route under jit' recipe)."
+            )
+        sharding = getattr(scores, "sharding", None)
+        reason = env_blockers() or (
+            "inputs are tracers (decide eagerly, then pin ustat_cap)"
+            if not all_concrete(scores, target)
+            else "TORCHEVAL_TPU_DISABLE_USTAT is set"
+            if ustat_disabled()
+            else "inputs are mesh-sharded (a pallas_call under plain jit "
+            "would replicate the full scores onto every device; the "
+            "sharded_* wrappers in torcheval_tpu.parallel keep O(N/P) "
+            "per-device economics instead)"
+            if sharding is not None and len(sharding.device_set) > 1
+            else "data outside the measured win region (small N, "
+            "class-skewed counts, non-finite or subnormal scores)"
+        )
+        return f"{name}: XLA sort + scan path ({reason})."
+
+    if fn in (F.binary_auroc, F.binary_auprc):
+        from torcheval_tpu.ops.pallas_ustat import binary_ustat_route
+
+        scores, target = jax.numpy.asarray(args[0]), jax.numpy.asarray(args[1])
+        rows = scores[None] if scores.ndim == 1 else scores
+        t_rows = target[None] if target.ndim == 1 else target
+        route = binary_ustat_route(
+            rows, t_rows, need_pos=fn is F.binary_auprc
+        )
+        if route is not None:
+            side, cap = route
+            return (
+                f"{name}: sort-free rank-sum route against the packed "
+                f"{side!r} side, cap {cap} (decided per call; jit callers "
+                f"keep the sort path)."
+            )
+        blocked = env_blockers()
+        tail = (
+            "fused Pallas scan after a 1-D-layout sort"
+            if not blocked
+            else "pure-XLA sort + scan"
+        )
+        return f"{name}: {tail}" + (f" ({blocked})." if blocked else ".")
+
+    _route_detail = {
+        "pallas": "bucket-compaction Pallas kernel (ops/pallas_cm.py)",
+        "matmul": "one dense one-hot MXU matmul",
+        "scatter": "int32 scatter-add (reference formulation)",
+    }
+    if fn is F.multiclass_confusion_matrix:
+        from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+            _cm_route,
+        )
+
+        inp = args[0]
+        num_classes = kwargs.get("num_classes")
+        if num_classes is None and len(args) > 2:
+            num_classes = args[2]
+        route = _cm_route(num_classes, inp.shape[0])
+        return (
+            f"{name}: confusion-matrix slab via {_route_detail[route]} — "
+            f"decided from shapes/backend only, so it is identical under "
+            f"a caller's jit."
+        )
+
+    if fn in (
+        F.multiclass_f1_score,
+        F.multiclass_precision,
+        F.multiclass_recall,
+    ):
+        from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+            _counts_route,
+        )
+
+        inp = args[0]
+        average = kwargs.get("average", "micro")
+        num_classes = kwargs.get("num_classes")
+        if average == "micro":
+            return (
+                f"{name}: micro average — scatter-free scalar counters "
+                "(no per-class trio, no routing)."
+            )
+        route = _counts_route(inp, num_classes, average)
+        return (
+            f"{name}: per-class count trio via {_route_detail[route]} — "
+            f"decided from shapes/backend only, so it is identical under "
+            f"a caller's jit."
+        )
+
+    return (
+        f"{name}: no call-time routing (single formulation, or not a "
+        "routed entry point this helper knows)."
+    )
