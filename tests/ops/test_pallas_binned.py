@@ -61,6 +61,45 @@ class TestPallasBinnedCounts(unittest.TestCase):
                 msg=f"r={r} n={n} T={t_count}",
             )
 
+    def test_split3_gather_matches_highest(self):
+        # The default path now pre-splits concrete grids into three exact
+        # bf16 components; the f32 HIGHEST gather remains for traced or
+        # subnormal grids.  Both must be bit-identical.
+        from torcheval_tpu.ops.pallas_binned import (
+            _pallas_binned_counts_jit,
+            _split_safe_thresholds,
+        )
+
+        rng = np.random.default_rng(7)
+        r, n, t_count = 2, 3000, 300
+        s = jnp.asarray(rng.random((r, n)).astype(np.float32))
+        h = jnp.asarray(rng.random((r, n)) > 0.4)
+        th = jnp.asarray(np.sort(rng.random(t_count).astype(np.float32)))
+        self.assertTrue(_split_safe_thresholds(th))
+        split = _pallas_binned_counts_jit(
+            s, h, th, interpret=True, split3=True
+        )
+        highest = _pallas_binned_counts_jit(
+            s, h, th, interpret=True, split3=False
+        )
+        _assert_counts_equal(self, split, highest, msg="split3 vs HIGHEST")
+
+    def test_subnormal_grid_keeps_highest_gather(self):
+        from torcheval_tpu.ops.pallas_binned import _split_safe_thresholds
+
+        th = jnp.asarray(np.array([0.0, 1e-45, 0.5], np.float32))
+        self.assertFalse(_split_safe_thresholds(th))
+        # The public entry still runs (fallback path) and matches sort.
+        rng = np.random.default_rng(8)
+        s = jnp.asarray(rng.random((1, 500)).astype(np.float32))
+        h = jnp.asarray(rng.random((1, 500)) > 0.5)
+        _assert_counts_equal(
+            self,
+            pallas_binned_counts(s, h, th, interpret=True),
+            _binned_counts_rows_sort(s, h, th),
+            msg="subnormal grid",
+        )
+
     def test_single_block_grid(self):
         # T <= 128 (Bc == 1) exercises the zero-shift special case.
         rng = np.random.default_rng(1)

@@ -16,9 +16,13 @@ O(N log N) sort with an O(N·T/MXU) streaming pass:
    count).
 3. Gather-matmul: ``(128, Bc) @ (Bc, tile)`` with the one-hot selector
    pulls each element's 128 candidate thresholds out of the VMEM-resident
-   ``(128, Bc)`` threshold table — an exact f32 MXU matmul standing in for
-   the per-element row gather Mosaic has no primitive for (a one-hot f32
-   dot reproduces the threshold values bit-exactly).
+   threshold table — standing in for the per-element row gather Mosaic
+   has no primitive for.  The gather must be UNROUNDED; by default the
+   table is pre-split into three exact bf16 components
+   (``pallas_ustat._split3_bf16``, three native bf16 passes — exact for
+   grids whose nonzero magnitudes are ≥ 2^-100, which the caller checks
+   eagerly), with one f32 ``precision=HIGHEST`` matmul (~6 passes) as
+   the fallback for traced or subnormal grids.
 4. Fine stage: compare, difference into a per-bin one-hot, stack
    ``[one_hot, one_hot * hit]``, and accumulate the ``(Bc, 256)``
    histogram pair with ONE bf16 MXU matmul per tile (0/1 values are exact
@@ -78,6 +82,53 @@ def _suffix_cumsum(x: jax.Array) -> jax.Array:
     return jnp.cumsum(x[..., ::-1], axis=-1)[..., ::-1]
 
 
+def _join_split3_row(ttab3: jax.Array) -> jax.Array:
+    """Exact f32 first-row (block bounds) of a bf16-split table: the three
+    components sum low-to-high bit-exactly (``pallas_ustat._split3_bf16``)."""
+    a = ttab3[0:1, :].astype(jnp.float32)
+    b = ttab3[_LANE : _LANE + 1, :].astype(jnp.float32)
+    c = ttab3[2 * _LANE : 2 * _LANE + 1, :].astype(jnp.float32)
+    return (c + b) + a
+
+
+# Per-buffer verdict memo for _split_safe_thresholds: id-keyed, with a
+# weakref.finalize evicting the entry when the array dies (so a recycled
+# id can never resurrect a stale verdict).  Grid buffers are long-lived —
+# metric state or lru-cached module constants — so the one host fetch per
+# distinct grid amortizes to zero on the update path.
+_split_safe_memo: dict = {}
+
+
+def _split_safe_thresholds(thresholds) -> bool:
+    """True when the bf16-split gather reproduces every threshold exactly:
+    concrete values with all nonzero magnitudes ≥ 2^-100 (subnormal split
+    components flush — ``pallas_ustat._MIN_SPLIT``).  Traced thresholds
+    keep the f32 HIGHEST gather (correct for any grid).  The library's
+    own grids (bisected [0, 1] grids, linspaces) always pass.  The one
+    device→host fetch per distinct grid buffer is memoized (see
+    ``_split_safe_memo``) so repeated updates stay sync-free."""
+    import weakref
+
+    from torcheval_tpu.metrics.functional._host_checks import all_concrete
+    from torcheval_tpu.ops.pallas_ustat import _MIN_SPLIT
+
+    if not all_concrete(thresholds):
+        return False
+    key = id(thresholds)
+    cached = _split_safe_memo.get(key)
+    if cached is not None:
+        return cached
+    t = np.abs(np.asarray(thresholds, dtype=np.float32))
+    nz = t[t > 0]
+    verdict = bool(nz.size == 0 or nz.min() >= _MIN_SPLIT)
+    try:
+        weakref.finalize(thresholds, _split_safe_memo.pop, key, None)
+        _split_safe_memo[key] = verdict
+    except TypeError:  # non-weakref-able input (e.g. plain numpy scalar)
+        pass
+    return verdict
+
+
 def _binned_count_kernel(
     s_ref, h_ref, ttab_ref, out_ref, hist, *, n_valid: int, tile: int,
     tiles_per_row: int,
@@ -85,9 +136,12 @@ def _binned_count_kernel(
     """1-D grid over (row, tile) pairs flattened in row-major order (rows
     are padded to a whole number of tiles, so no tile crosses a row
     boundary — Mosaic's block rules then only ever see (1, tile) blocks).
-    ``ttab`` is the (128, Bc) threshold table (column c holds thresholds
-    [c*128, (c+1)*128), finite sentinel pads); ``hist`` the (Bc, 256) f32
-    scratch accumulator ([:, :128] totals, [:, 128:] hits)."""
+    ``ttab`` is the threshold table (column c holds thresholds [c*128,
+    (c+1)*128), finite sentinel pads): ``(128, Bc)`` f32, or
+    ``(3·128, Bc)`` bf16 split components (``_split3_bf16`` layout) when
+    the caller pre-split it for the exact bf16 gather; ``hist`` the
+    (Bc, 256) f32 scratch accumulator ([:, :128] totals, [:, 128:]
+    hits)."""
     j = pl.program_id(0) % tiles_per_row  # tile index within the row
 
     @pl.when(j == 0)
@@ -96,16 +150,20 @@ def _binned_count_kernel(
 
     s = s_ref[:]  # (1, tile) f32 scores
     h = h_ref[:]  # (1, tile) f32 hits in {0, 1}
-    ttab = ttab_ref[:]  # (128, Bc) f32
+    ttab = ttab_ref[:]  # (128 or 3·128, Bc) f32 / bf16-split components
 
     lane = lax.broadcasted_iota(jnp.int32, s.shape, 1)
     valid = (j * tile + lane) < n_valid  # (1, tile)
+    split3 = ttab.shape[0] == 3 * _LANE
+    bounds_row = (
+        _join_split3_row(ttab) if split3 else ttab[0:1, :]
+    )
 
     # Coarse: block boundaries are the table's first row.  ge is 0/1 and
     # nonincreasing down the block axis; its vertical difference is the
     # one-hot block selector (all-zero for scores below every boundary,
     # and for sentinel pad blocks).
-    bounds = ttab[0:1, :].T  # (Bc, 1)
+    bounds = bounds_row.T  # (Bc, 1)
     ge_c = jnp.logical_and(s >= bounds, valid).astype(jnp.float32)
     if ge_c.shape[0] > 1:
         oc = ge_c - jnp.concatenate(
@@ -117,16 +175,24 @@ def _binned_count_kernel(
         oc = ge_c
 
     # Gather-matmul: pull each element's candidate block of thresholds.
-    # Precision HIGHEST is load-bearing: the TPU's default bf16 matmul
-    # passes would round the gathered thresholds and mis-bin every score
-    # that falls between a threshold and its bf16 image.
-    gathered = lax.dot_general(
-        ttab,
-        oc,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=lax.Precision.HIGHEST,
-    )  # (128, tile)
+    # An UNROUNDED gather is load-bearing — a default bf16 pass would
+    # mis-bin every score between a threshold and its bf16 image.  Two
+    # exact formulations: three native bf16 passes over the pre-split
+    # table (``pallas_ustat._split3_bf16``; exact when every nonzero
+    # |threshold| ≥ 2^-100 — the caller checks and falls back) or one
+    # f32 ``precision=HIGHEST`` matmul (~6 passes) for wild grids.
+    if split3:
+        from torcheval_tpu.ops.pallas_ustat import _gather_split3
+
+        gathered = _gather_split3(ttab, oc)
+    else:
+        gathered = lax.dot_general(
+            ttab,
+            oc,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
+        )  # (128, tile)
 
     # Fine: one-hot of the largest in-block threshold <= score.
     ge_f = (gathered <= s).astype(jnp.float32)  # nonincreasing down axis 0
@@ -152,7 +218,7 @@ def _pad_to(n: int, m: int) -> int:
     return max(m, -(-n // m) * m)
 
 
-@partial(jax.jit, static_argnames=("interpret", "tile"))
+@partial(jax.jit, static_argnames=("interpret", "tile", "split3"))
 def _pallas_binned_hist(
     scores: jax.Array,
     hits: jax.Array,
@@ -160,6 +226,7 @@ def _pallas_binned_hist(
     *,
     interpret: bool = False,
     tile: int = _TILE,
+    split3: bool = False,
 ) -> jax.Array:
     """(R, Bc, 256) per-bin histogram pair for ``(R, N)`` rows."""
     r, n = scores.shape
@@ -174,6 +241,10 @@ def _pallas_binned_hist(
         thresholds.astype(jnp.float32)
     )
     ttab = ttab.reshape(bc, _LANE).T  # (128, Bc)
+    if split3:
+        from torcheval_tpu.ops.pallas_ustat import _split3_bf16
+
+        ttab = _split3_bf16(ttab[None])[0]  # (3·128, Bc) bf16
     s = jnp.minimum(scores.astype(jnp.float32), _SENTINEL_BELOW)
     h = hits.astype(jnp.float32)
     if n_pad != n:
@@ -195,7 +266,9 @@ def _pallas_binned_hist(
         in_specs=[
             pl.BlockSpec((1, tile), lambda k: (0, k)),
             pl.BlockSpec((1, tile), lambda k: (0, k)),
-            pl.BlockSpec((_LANE, bc), lambda k: (0, 0)),
+            pl.BlockSpec(
+                ((3 if split3 else 1) * _LANE, bc), lambda k: (0, 0)
+            ),
         ],
         out_specs=pl.BlockSpec(
             (1, bc, 256), lambda k, _tpr=tiles_per_row: (k // _tpr, 0, 0)
@@ -221,16 +294,23 @@ def pallas_binned_counts(
     — 3-10 ms each through the tunnel)."""
     if interpret is None:
         interpret = not has_pallas()
-    return _pallas_binned_counts_jit(scores, hits, thresholds, interpret=interpret)
+    return _pallas_binned_counts_jit(
+        scores,
+        hits,
+        thresholds,
+        interpret=interpret,
+        split3=_split_safe_thresholds(thresholds),
+    )
 
 
-@partial(jax.jit, static_argnames=("interpret",))
+@partial(jax.jit, static_argnames=("interpret", "split3"))
 def _pallas_binned_counts_jit(
     scores: jax.Array,
     hits: jax.Array,
     thresholds: jax.Array,
     *,
     interpret: bool,
+    split3: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     r, n = scores.shape
     t = thresholds.shape[0]
@@ -238,7 +318,9 @@ def _pallas_binned_counts_jit(
         zero_t = jnp.zeros((r, t), jnp.int32)
         zero_r = jnp.zeros((r,), jnp.int32)
         return zero_t, zero_t, zero_r, zero_r
-    hist = _pallas_binned_hist(scores, hits, thresholds, interpret=interpret)
+    hist = _pallas_binned_hist(
+        scores, hits, thresholds, interpret=interpret, split3=split3
+    )
     bc = hist.shape[1]
     per_bin_total = hist[:, :, :_LANE].reshape(r, bc * _LANE)[:, :t]
     per_bin_tp = hist[:, :, _LANE:].reshape(r, bc * _LANE)[:, :t]
