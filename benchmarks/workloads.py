@@ -74,9 +74,10 @@ def _ustat_rank_sum_macs(cap: float, num_rows: float, n: float) -> float:
 
 def _binned_hist_macs(n: float, thresholds: float, rows: float = 1.0) -> float:
     """bf16 MAC model for the binned-counts MXU histogram
-    (ops/pallas_binned.py): per element (128 gather + 256 accumulate)
-    MACs per coarse block, ceil(T/128) blocks."""
-    return rows * n * 384.0 * -(-int(thresholds) // 128)
+    (ops/pallas_binned.py): per element, 3 bf16-split gather passes of
+    128 MACs plus a 256-row accumulate per coarse block, ceil(T/128)
+    blocks."""
+    return rows * n * 640.0 * -(-int(thresholds) // 128)
 
 
 def _sort_stage_ops(n: float, rows: float = 1.0) -> float:

@@ -10,7 +10,7 @@ binned-counts core (``binned_auc._binned_counts_rows``: one variadic sort +
 instead of the reference's O(N·T·C) boolean broadcast-compare
 (reference ``binned_precision_recall_curve.py:184-197``)."""
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Optional, Tuple, Union
 
 import jax
@@ -209,10 +209,19 @@ def _create_threshold_tensor(
     threshold: Union[int, List[float], "jax.Array"],
 ) -> jax.Array:
     """int → linspace(0, 1, n); list/array pass through
-    (reference ``binned_precision_recall_curve.py:224-232``)."""
+    (reference ``binned_precision_recall_curve.py:224-232``).  The
+    linspace grids are cached per count: repeated eager calls then hand
+    the SAME buffer to the kernels, whose per-buffer checks (e.g.
+    ``pallas_binned._split_safe_thresholds``) stay memoized instead of
+    re-fetching the grid every update."""
     if isinstance(threshold, int):
-        return jnp.linspace(0, 1.0, threshold)
+        return _linspace_grid(threshold)
     return jnp.asarray(threshold)
+
+
+@lru_cache(maxsize=64)
+def _linspace_grid(count: int) -> jax.Array:
+    return jnp.linspace(0, 1.0, count)
 
 
 def _binned_precision_recall_curve_param_check(threshold: jax.Array) -> None:

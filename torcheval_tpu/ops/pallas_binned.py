@@ -114,18 +114,21 @@ def _split_safe_thresholds(thresholds) -> bool:
 
     if not all_concrete(thresholds):
         return False
-    key = id(thresholds)
-    cached = _split_safe_memo.get(key)
-    if cached is not None:
-        return cached
+    # Memoize ONLY immutable jax arrays: a numpy buffer can be mutated in
+    # place under an unchanged id() (stale verdict), and checking numpy
+    # values is free anyway (no device fetch).
+    memoizable = isinstance(thresholds, jax.Array)
+    if memoizable:
+        key = id(thresholds)
+        cached = _split_safe_memo.get(key)
+        if cached is not None:
+            return cached
     t = np.abs(np.asarray(thresholds, dtype=np.float32))
     nz = t[t > 0]
     verdict = bool(nz.size == 0 or nz.min() >= _MIN_SPLIT)
-    try:
+    if memoizable:
         weakref.finalize(thresholds, _split_safe_memo.pop, key, None)
         _split_safe_memo[key] = verdict
-    except TypeError:  # non-weakref-able input (e.g. plain numpy scalar)
-        pass
     return verdict
 
 
@@ -228,7 +231,16 @@ def _pallas_binned_hist(
     tile: int = _TILE,
     split3: bool = False,
 ) -> jax.Array:
-    """(R, Bc, 256) per-bin histogram pair for ``(R, N)`` rows."""
+    """(R, Bc, 256) per-bin histogram pair for ``(R, N)`` rows.
+
+    HYPOTHESIS for the (1000, 2^17)×2048 histogram's 4.6%-of-roof gap
+    (BASELINE.md round-4 roofline): 64K grid steps × ~2 µs of per-step
+    pipeline/DMA latency ≈ 147 ms of overhead against ~20 ms of math —
+    a larger ``tile`` would amortize it.  UNVERIFIED on hardware: tile
+    4096 puts the fine-stage/of2 operands at 2^20 elements, PAST the
+    empirical ~2^19 Mosaic ICE bound
+    (``pallas_ustat._MOSAIC_OPERAND_BOUND``), so the default stays at
+    the compile-proven ``_TILE`` until a chip session can test it."""
     r, n = scores.shape
     t = thresholds.shape[0]
     bc = -(-t // _LANE)
