@@ -168,6 +168,15 @@ class TestExplainRoute(unittest.TestCase):
         )
         self.assertIn("sort", msg)
 
+    def test_binned_family(self):
+        import torcheval_tpu.metrics.functional as F
+
+        s = jnp.zeros((4096,), jnp.float32)
+        t = jnp.zeros((4096,), jnp.int32)
+        msg = explain_route(F.binary_binned_auroc, s, t, threshold=10000)
+        self.assertIn("binned counts", msg)
+        self.assertIn("sort", msg)  # CPU env: sort fallback named
+
     def test_unknown_fn(self):
         self.assertIn("no call-time routing", explain_route(len, [1]))
 

@@ -63,11 +63,14 @@ def explain_route(fn, *args, **kwargs) -> str:
     """Explain which formulation ``fn(*args, **kwargs)`` would run and
     why — a debugging aid for the call-time routed entry points.
 
-    Supported ``fn``: ``multiclass_auroc``, ``multiclass_auprc``,
+    Supported ``fn`` (the ``torcheval_tpu.metrics.functional``
+    callables): ``multiclass_auroc``, ``multiclass_auprc``,
     ``binary_auroc``, ``binary_auprc``, ``multiclass_confusion_matrix``,
     ``multiclass_f1_score``, ``multiclass_precision``,
-    ``multiclass_recall`` (the ``torcheval_tpu.metrics.functional``
-    callables).  Call it EAGERLY on representative data — inside a jit
+    ``multiclass_recall``, and the binned family (every
+    ``*_binned_auroc`` / ``*_binned_auprc`` /
+    ``*_binned_precision_recall_curve`` variant).  Call it EAGERLY on
+    representative data — inside a jit
     the deciders see tracers, which is exactly the downgrade this helper
     diagnoses.  Returns a one-paragraph human-readable explanation.
     """
@@ -187,6 +190,49 @@ def explain_route(fn, *args, **kwargs) -> str:
             f"{name}: per-class count trio via {_route_detail[route]} — "
             f"decided from shapes/backend only, so it is identical under "
             f"a caller's jit."
+        )
+
+    # (kind, default threshold count) per binned entry point — kinds fix
+    # the (rows, samples) orientation _binned_counts_rows actually sees.
+    _binned = {
+        F.binary_binned_auroc: ("binary", 200),
+        F.binary_binned_auprc: ("binary", 100),
+        F.multiclass_binned_auroc: ("classes", 200),
+        F.multiclass_binned_auprc: ("classes", 100),
+        F.multilabel_binned_auprc: ("classes", 100),
+        F.binary_binned_precision_recall_curve: ("binary", 100),
+        F.multiclass_binned_precision_recall_curve: ("classes", 100),
+        F.multilabel_binned_precision_recall_curve: ("classes", 100),
+    }
+    if fn in _binned:
+        from torcheval_tpu.metrics.functional.classification.binned_auc import (
+            _select_binned_route,
+        )
+        from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (
+            _create_threshold_tensor,
+        )
+
+        inp = jax.numpy.asarray(args[0])
+        kind, default_t = _binned[fn]
+        if kind == "binary":
+            # Multi-task binary: (R, N) rows; 1-D: one row of N samples.
+            rows = inp.shape[0] if inp.ndim == 2 else 1
+            n = inp.shape[-1]
+        else:
+            # Multiclass/multilabel: (N, C) → C rows of N samples.
+            rows = inp.shape[1] if inp.ndim == 2 else 1
+            n = inp.shape[0]
+        th = _create_threshold_tensor(kwargs.get("threshold", default_t))
+        route = _select_binned_route(rows, n, th)
+        detail = {
+            "broadcast": "fused VPU broadcast-compare (small work)",
+            "pallas": "MXU one-hot histogram kernel (ops/pallas_binned.py)",
+            "sort": "variadic sort + searchsorted (CPU / kill-switch / "
+            "out-of-bounds fallback)",
+        }[route]
+        return (
+            f"{name}: binned counts via {detail} — decided from static "
+            f"shapes and flags only, identical under a caller's jit."
         )
 
     return (
