@@ -100,6 +100,21 @@ class TestConfusionSlab(unittest.TestCase):
             self, t.astype(np.int32), p.astype(np.int32), c, "boundaries"
         )
 
+    def test_fuzz_shapes_and_distributions(self):
+        # Random (C, N, distribution) triples: skewed Zipf-ish labels mix
+        # compact and dense tiles; boundary window sizes exercise the
+        # adaptive cap formula's edges.
+        rng = np.random.default_rng(9)
+        for trial in range(8):
+            c = int(rng.integers(66, 1150))
+            n = int(rng.integers(1, 5000))
+            if rng.integers(0, 2):
+                t = rng.integers(0, c, n).astype(np.int32)
+            else:  # heavy skew: a few dominant classes
+                t = (rng.zipf(1.7, n) % c).astype(np.int32)
+            p = rng.integers(0, c + 1, n).astype(np.int32)
+            _check_slab(self, t, p, c, f"fuzz trial {trial} c={c} n={n}")
+
     def test_bounds_raise(self):
         big = jnp.zeros(4, jnp.int32)
         with self.assertRaisesRegex(ValueError, "VMEM budget"):
