@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Round-4 deferred chip measurements (run when the tunnel returns).
+
+Measures, in one session (NEVER timeout-kill this — see
+.claude/skills/verify/SKILL.md):
+
+1. The binned histogram kernel's bf16-split gather vs the old f32
+   HIGHEST gather (both compiled), bit-equality asserted first.
+2. The larger-tile hypothesis for the multi-row histogram's grid-step
+   overhead (compile-tests tile 4096 — expected to either ICE at the
+   ~2^19 Mosaic operand bound or win big on the (1000, 2^17)×2048 row).
+3. The refreshed sharded multiclass histogram ledger row.
+
+Prints one JSON line per measurement; exits nonzero on any parity
+failure.  Results feed BASELINE.md and the pallas_binned tile default.
+"""
+
+import json
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": "no TPU backend"}))
+        return 1
+    from benchmarks.workloads import _device_seconds
+    from torcheval_tpu.ops.pallas_binned import _pallas_binned_counts_jit
+
+    rng = np.random.default_rng(0)
+
+    def clock(fn, *args):
+        return _device_seconds(
+            lambda *a: fn(*a[:-1], a[-1]), args
+        ) * 1e3
+
+    def counts_step(split3, tile=None):
+        kw = {} if tile is None else {"tile": tile}
+
+        def step(s, h, th, i):
+            tp, fp, pos, tot = _pallas_binned_counts_jit(
+                s + i * jnp.float32(1e-30),
+                h,
+                th,
+                interpret=False,
+                split3=split3,
+                **kw,
+            )
+            return (
+                tp.sum() + fp.sum() + pos.sum() + tot.sum()
+            ).astype(jnp.float32)
+
+        return step
+
+    # --- 1. split3 vs HIGHEST at the ledger shapes -----------------------
+    for r, n_row, t_count, label in [
+        (1, 2**22, 16384, "binary_16384"),
+        (1, 2**22, 10000, "binary_10k"),
+        (1000, 2**17, 2048, "multiclass_2048"),
+    ]:
+        s = jnp.asarray(rng.random((r, n_row)).astype(np.float32))
+        h = jnp.asarray(rng.random(s.shape) > 0.4)
+        th = jnp.linspace(0, 1, t_count)
+        a = _pallas_binned_counts_jit(s, h, th, interpret=False, split3=True)
+        b = _pallas_binned_counts_jit(s, h, th, interpret=False, split3=False)
+        ok = all(bool(jnp.array_equal(x, y)) for x, y in zip(a, b))
+        if not ok:
+            print(json.dumps({"shape": label, "error": "split3 mismatch"}))
+            return 2
+        t_split = clock(counts_step(True), s, h, th)
+        t_highest = clock(counts_step(False), s, h, th)
+        print(
+            json.dumps(
+                {
+                    "measure": "binned_gather",
+                    "shape": label,
+                    "rows": r,
+                    "n_per_row": n_row,
+                    "thresholds": t_count,
+                    "split3_ms": round(t_split, 2),
+                    "highest_ms": round(t_highest, 2),
+                    "bit_equal": True,
+                }
+            ),
+            flush=True,
+        )
+
+    # --- 2. tile-4096 hypothesis (may ICE: catch and report) -------------
+    s = jnp.asarray(rng.random((1000, 2**17)).astype(np.float32))
+    h = jnp.asarray(rng.random(s.shape) > 0.4)
+    th = jnp.linspace(0, 1, 2048)
+    try:
+        a = _pallas_binned_counts_jit(
+            s, h, th, interpret=False, split3=True, tile=4096
+        )
+        b = _pallas_binned_counts_jit(s, h, th, interpret=False, split3=True)
+        ok = all(bool(jnp.array_equal(x, y)) for x, y in zip(a, b))
+        t4096 = clock(counts_step(True, tile=4096), s, h, th)
+        t2048 = clock(counts_step(True), s, h, th)
+        print(
+            json.dumps(
+                {
+                    "measure": "tile_hypothesis",
+                    "tile4096_ms": round(t4096, 2),
+                    "tile2048_ms": round(t2048, 2),
+                    "bit_equal": ok,
+                }
+            ),
+            flush=True,
+        )
+    except Exception as exc:
+        print(
+            json.dumps(
+                {"measure": "tile_hypothesis", "compile_error": str(exc)[:300]}
+            ),
+            flush=True,
+        )
+
+    # --- 3. refreshed sharded multiclass histogram row -------------------
+    from benchmarks.workloads import bench_sharded_multiclass_auroc
+
+    name, ours, ref, extras = bench_sharded_multiclass_auroc()
+    print(
+        json.dumps({"measure": name, "value": round(ours, 1), **extras}),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
