@@ -914,6 +914,138 @@ def bench_perplexity() -> Tuple[str, float, Optional[float]]:
     return "perplexity_tokens", ours, ref, extras
 
 
+def bench_windowed_auroc() -> Tuple[str, float, Optional[float]]:
+    """WindowedBinaryAUROC at a 1M-sample window: wrap-around ring
+    inserts (``window/auroc.py:_ring_insert`` — ``.at[:, idx].set`` with
+    a traced start, the op family XLA can mangle) + full-window compute,
+    vs reference ``window/auroc.py:102-144`` (round-4 VERDICT weak
+    item 5: the family had never been perf-measured)."""
+    from torcheval_tpu.metrics import WindowedBinaryAUROC
+
+    rng = np.random.default_rng(14)
+    w, batch, n_updates = 2**20, 2**16, 32
+    n = batch * n_updates  # 2 M: the window wraps twice
+    scores = rng.random(n, dtype=np.float32)
+    target = (rng.random(n) > 0.5).astype(np.float32)
+    ours = _lifecycle(
+        WindowedBinaryAUROC(max_num_samples=w),
+        _split((scores, target), n_updates),
+    )
+
+    ref = None
+    try:
+        Ref = _reference().WindowedBinaryAUROC
+        n_ref = n // 16  # reference CPU needs a smaller instance
+        batches = _split_torch(
+            (scores[:n_ref], target[:n_ref].astype(np.int64)), n_updates
+        )
+        ref = _lifecycle(Ref(max_num_samples=w // 16), batches, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics.functional.classification.auroc import (
+        _binary_auroc_compute,
+    )
+    from torcheval_tpu.metrics.window.auroc import _ring_insert
+
+    buf_s = jnp.asarray(scores[:w]).reshape(1, w)
+    buf_t = jnp.asarray(target[:w]).reshape(1, w)
+    ins_s = jnp.asarray(scores[w : w + batch]).reshape(1, batch)
+    ins_t = jnp.asarray(target[w : w + batch]).reshape(1, batch)
+    # Cursor near the end so every clocked insert exercises the
+    # wrap-around index arithmetic (the suspect op).
+    col = jnp.int32(w - batch // 2)
+
+    def step(bs, bt, xs, xt, i):
+        nbs, nbt = _ring_insert(bs, bt, xs + i * jnp.float32(1e-38), xt, col)
+        return _binary_auroc_compute(nbs[0], nbt[0])
+
+    extras = _device_stats(
+        step,
+        (buf_s, buf_t, ins_s, ins_t),
+        batch,
+        buf_s.nbytes + buf_t.nbytes + ins_s.nbytes + ins_t.nbytes,
+    )
+    _with_roofline(
+        extras,
+        vpu_ops=_sort_stage_ops(w) + 8.0 * w + 8.0 * batch,
+        note="full-window sort+scan dominates; ring insert ~8 ops/elem",
+    )
+    return "windowed_binary_auroc_1m", ours, ref, extras
+
+
+def bench_weighted_histogram() -> Tuple[str, float, Optional[float]]:
+    """Weighted pod multiclass histogram at the (2^17, 1000)x2048
+    north-star shape: the Pallas payload kernel route
+    (``pallas_binned._binned_wcount_kernel``) vs the per-class scatter it
+    replaces (round-4 VERDICT item 4).  The reference has no weighted
+    distributed curve story at all — its weighted binned counting is
+    host-side per-bin (reference
+    ``binned_precision_recall_curve.py:81-91``) — so the recorded
+    comparison is unweighted-kernel parity cost, not a reference clock."""
+    import jax
+    import jax.numpy as jnp
+
+    from torcheval_tpu.ops.pallas_binned import (
+        _pallas_binned_counts_jit,
+        _pallas_binned_weighted_counts_jit,
+        has_pallas,
+    )
+
+    rng = np.random.default_rng(15)
+    r, n, t_count = 1000, 2**17, 2048
+    if jax.default_backend() != "tpu":
+        r, n = 64, 2**13  # CPU fallback instance
+    s = jnp.asarray(rng.random((r, n)).astype(np.float32))
+    h = jnp.asarray((rng.random((r, n)) > 0.4).astype(np.float32))
+    w = jnp.asarray(rng.random(n).astype(np.float32) + 0.5)
+    th = jnp.linspace(0, 1, t_count)
+    interp = not has_pallas()
+
+    def weighted(s, h, w, th, i):
+        tp, fp, _, _ = _pallas_binned_weighted_counts_jit(
+            s + i * jnp.float32(1e-30), h, w, th,
+            interpret=interp, split3=True,
+        )
+        return tp.sum() + fp.sum()
+
+    def unweighted(s, h, th, i):
+        tp, fp, _, _ = _pallas_binned_counts_jit(
+            s + i * jnp.float32(1e-30), h, th,
+            interpret=interp, split3=True,
+        )
+        return (tp.sum() + fp.sum()).astype(jnp.float32)
+
+    sec_w = _device_seconds(weighted, (s, h, w, th))
+    sec_u = _device_seconds(unweighted, (s, h, th))
+    samples = float(r) * float(n)
+    extras = {
+        "device_value": round(samples / sec_w, 1),
+        "device_ms_per_step": round(sec_w * 1e3, 3),
+        "unweighted_ms_per_step": round(sec_u * 1e3, 3),
+        "weighted_over_unweighted": round(sec_w / sec_u, 2),
+        "input_gb_per_s": round(
+            (s.nbytes + h.nbytes + w.nbytes) / sec_w / 1e9, 1
+        ),
+        "hbm_util_pct_lower_bound": round(
+            100.0 * (s.nbytes + h.nbytes + w.nbytes) / sec_w / 1e9
+            / V5E_HBM_GBPS, 1,
+        ),
+        "device_backend": jax.default_backend(),
+    }
+    # Payload model: 3 split passes x (gather 128 + accumulate 256) MACs
+    # per coarse block per element.
+    _with_roofline(
+        extras,
+        mxu_macs=float(r) * n * 3.0 * 384 * -(-t_count // 128),
+        note="3 exact bf16 payload passes (split3 weights)",
+    )
+    ours = samples / sec_w
+    return "weighted_multiclass_histogram", ours, None, extras
+
+
 ALL_WORKLOADS = [
     bench_accuracy,
     bench_binary_auroc,
@@ -927,4 +1059,6 @@ ALL_WORKLOADS = [
     bench_binned_auroc,
     bench_collection_fused,
     bench_perplexity,
+    bench_windowed_auroc,
+    bench_weighted_histogram,
 ]

@@ -34,9 +34,7 @@ def main() -> int:
     rng = np.random.default_rng(0)
 
     def clock(fn, *args):
-        return _device_seconds(
-            lambda *a: fn(*a[:-1], a[-1]), args
-        ) * 1e3
+        return _device_seconds(fn, args) * 1e3
 
     def counts_step(split3, tile=None):
         kw = {} if tile is None else {"tile": tile}

@@ -398,6 +398,184 @@ class TestHistogramPathConsistency(unittest.TestCase):
             )
 
 
+class TestWeightedKernelRoute(unittest.TestCase):
+    """The weighted histogram's Pallas payload-kernel route (round-4
+    VERDICT item 4): parity with the scatter formulation at the 1e-6
+    summation-order contract, the jit pin, and the gate fallbacks.  The
+    kernel route is forced by patching ``_hist_route`` (the CPU test env
+    would otherwise route by measured-TPU economics)."""
+
+    def setUp(self):
+        self.mesh = make_mesh()
+        rng = np.random.default_rng(21)
+        self.n = 2048
+        self.s = rng.random(self.n).astype(np.float32)
+        self.t = (rng.random(self.n) < 0.4).astype(np.float32)
+        self.w = rng.random(self.n).astype(np.float32) * 2 + 0.05
+
+    def _force_pallas(self):
+        from unittest import mock
+
+        from torcheval_tpu.parallel import sync
+
+        return mock.patch.object(
+            sync, "_hist_route", lambda r, nl, nb: "pallas"
+        )
+
+    def test_kernel_matches_scatter_binary(self):
+        from torcheval_tpu.parallel import (
+            sharded_auprc_histogram,
+            sharded_auroc_histogram,
+        )
+
+        ss, ts, ws = shard_batch(
+            self.mesh,
+            jnp.asarray(self.s),
+            jnp.asarray(self.t),
+            jnp.asarray(self.w),
+        )
+        for fn in (sharded_auroc_histogram, sharded_auprc_histogram):
+            scatter = fn(ss, ts, mesh=self.mesh, num_bins=512, weights=ws)
+            with self._force_pallas():
+                kernel = fn(ss, ts, mesh=self.mesh, num_bins=512, weights=ws)
+            self.assertLess(
+                abs(float(scatter) - float(kernel)), 1e-6, fn.__name__
+            )
+
+    def test_kernel_matches_scatter_multiclass(self):
+        from torcheval_tpu.parallel import sharded_multiclass_auroc_histogram
+
+        rng = np.random.default_rng(22)
+        c = 12
+        sc = rng.random((self.n, c)).astype(np.float32)
+        tc = rng.integers(0, c, self.n).astype(np.int32)
+        ss, ts, ws = shard_batch(
+            self.mesh,
+            jnp.asarray(sc),
+            jnp.asarray(tc),
+            jnp.asarray(self.w),
+        )
+        scatter = sharded_multiclass_auroc_histogram(
+            ss, ts, mesh=self.mesh, num_bins=256, weights=ws
+        )
+        with self._force_pallas():
+            kernel = sharded_multiclass_auroc_histogram(
+                ss, ts, mesh=self.mesh, num_bins=256, weights=ws
+            )
+        self.assertLess(abs(float(scatter) - float(kernel)), 1e-6)
+        # sklearn oracle within the O(1/num_bins) quantization error.
+        aucs = [
+            roc_auc_score((tc == k).astype(int), sc[:, k], sample_weight=self.w)
+            for k in range(c)
+        ]
+        self.assertLess(abs(float(kernel) - float(np.mean(aucs))), 6e-3)
+
+    def test_weighted_ones_bitwise_on_kernel_route(self):
+        from torcheval_tpu.parallel import sharded_auroc_histogram
+
+        ss, ts = shard_batch(
+            self.mesh, jnp.asarray(self.s), jnp.asarray(self.t)
+        )
+        with self._force_pallas():
+            unweighted = sharded_auroc_histogram(
+                ss, ts, mesh=self.mesh, num_bins=512
+            )
+            weighted = sharded_auroc_histogram(
+                ss,
+                ts,
+                mesh=self.mesh,
+                num_bins=512,
+                weights=jnp.ones_like(ss),
+            )
+        self.assertEqual(
+            np.asarray(unweighted).tobytes(), np.asarray(weighted).tobytes()
+        )
+
+    def test_pin_keeps_kernel_under_jit_and_tracers_warn(self):
+        import warnings
+
+        import jax
+
+        from torcheval_tpu.parallel import sharded_auroc_histogram
+        from torcheval_tpu.routing import (
+            RouteDowngradeWarning,
+            reset_route_warnings,
+        )
+
+        reset_route_warnings()
+        ss, ts, ws = shard_batch(
+            self.mesh,
+            jnp.asarray(self.s),
+            jnp.asarray(self.t),
+            jnp.asarray(self.w),
+        )
+        with self._force_pallas():
+            eager = sharded_auroc_histogram(
+                ss, ts, mesh=self.mesh, num_bins=256, weights=ws
+            )
+
+            @jax.jit
+            def pinned(a, b, w):
+                return sharded_auroc_histogram(
+                    a,
+                    b,
+                    mesh=self.mesh,
+                    num_bins=256,
+                    weights=w,
+                    assume_01_targets=True,
+                    assume_split_safe_weights=True,
+                )
+
+            self.assertLess(abs(float(pinned(ss, ts, ws)) - float(eager)), 1e-6)
+
+            @jax.jit
+            def unpinned(a, b, w):
+                return sharded_auroc_histogram(
+                    a,
+                    b,
+                    mesh=self.mesh,
+                    num_bins=256,
+                    weights=w,
+                    assume_01_targets=True,
+                )
+
+            with warnings.catch_warnings(record=True) as rec:
+                warnings.simplefilter("always")
+                unpinned(ss, ts, ws)
+            msgs = [
+                str(w.message)
+                for w in rec
+                if issubclass(w.category, RouteDowngradeWarning)
+            ]
+            self.assertTrue(
+                any("assume_split_safe_weights" in m for m in msgs), msgs
+            )
+
+    def test_subnormal_weights_fall_back_to_scatter(self):
+        from torcheval_tpu.parallel import sharded_auroc_histogram
+
+        w = self.w.copy()
+        w[3] = 1e-35  # below the 2^-100 split floor
+        ss, ts, ws = shard_batch(
+            self.mesh,
+            jnp.asarray(self.s),
+            jnp.asarray(self.t),
+            jnp.asarray(w),
+        )
+        plain = sharded_auroc_histogram(
+            ss, ts, mesh=self.mesh, num_bins=256, weights=ws
+        )
+        with self._force_pallas():
+            # Gate declines split3 → scatter even though the dispatch
+            # says pallas; results identical (same formulation).
+            gated = sharded_auroc_histogram(
+                ss, ts, mesh=self.mesh, num_bins=256, weights=ws
+            )
+        self.assertEqual(
+            np.asarray(plain).tobytes(), np.asarray(gated).tobytes()
+        )
+
+
 class TestShardedMulticlassAUROCHistogram(unittest.TestCase):
     def test_matches_sklearn_macro_on_quantized_scores(self):
         from sklearn.metrics import roc_auc_score as sk_auc

@@ -178,5 +178,127 @@ class TestPallasBinnedCounts(unittest.TestCase):
         self.assertEqual(int(jnp.sum(tp) + jnp.sum(fp) + jnp.sum(tot)), 0)
 
 
+class TestWeightedKernel(unittest.TestCase):
+    """The weighted payload kernel (``_binned_wcount_kernel``) against a
+    dense numpy oracle: ~1e-6 relative per the f32 summation-order
+    contract, and BITWISE equal to the unweighted counts under unit
+    weights (round-4 VERDICT item 4)."""
+
+    def _oracle(self, s, h, w, th):
+        ge = s[:, None, :] >= th[None, :, None]  # (r, T, n)
+        w_tp = (ge * (w[None, None, :] * h[:, None, :])).sum(-1)
+        w_fp = (ge * (w[None, None, :] * (1.0 - h)[:, None, :])).sum(-1)
+        return w_tp, w_fp
+
+    def test_matches_oracle_random(self):
+        from torcheval_tpu.ops.pallas_binned import (
+            pallas_binned_weighted_counts,
+        )
+
+        rng = np.random.default_rng(11)
+        for r, n, t_count in [(1, 5000, 200), (3, 4097, 130), (2, 2048, 257)]:
+            s = rng.random((r, n)).astype(np.float32)
+            h = (rng.random((r, n)) > 0.4).astype(np.float32)
+            w = rng.random(n).astype(np.float32) * 3 + 0.01
+            th = np.sort(rng.random(t_count).astype(np.float32))
+            w_tp, w_fp, w_pos, w_tot = pallas_binned_weighted_counts(
+                jnp.asarray(s),
+                jnp.asarray(h),
+                jnp.asarray(w),
+                jnp.asarray(th),
+                interpret=True,
+            )
+            o_tp, o_fp = self._oracle(s, h, w, th)
+            np.testing.assert_allclose(
+                np.asarray(w_tp), o_tp, rtol=2e-6, atol=1e-4
+            )
+            np.testing.assert_allclose(
+                np.asarray(w_fp), o_fp, rtol=2e-6, atol=1e-4
+            )
+            np.testing.assert_allclose(
+                np.asarray(w_pos), (w[None] * h).sum(-1), rtol=1e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(w_tot), np.full(r, w.sum()), rtol=1e-6
+            )
+
+    def test_unit_weights_bitwise_equal_unweighted(self):
+        from torcheval_tpu.ops.pallas_binned import (
+            pallas_binned_weighted_counts,
+        )
+
+        rng = np.random.default_rng(12)
+        r, n, t_count = 2, 4099, 100
+        s = jnp.asarray(rng.random((r, n)).astype(np.float32))
+        h = jnp.asarray(rng.random((r, n)) > 0.3)
+        th = jnp.linspace(0, 1.0, t_count)
+        u_tp, u_fp, _, _ = pallas_binned_counts(s, h, th, interpret=True)
+        e_tp, e_fp, _, _ = pallas_binned_weighted_counts(
+            s, h, jnp.ones(n, jnp.float32), th, interpret=True
+        )
+        self.assertTrue(
+            np.array_equal(
+                np.asarray(e_tp), np.asarray(u_tp).astype(np.float32)
+            )
+        )
+        self.assertTrue(
+            np.array_equal(
+                np.asarray(e_fp), np.asarray(u_fp).astype(np.float32)
+            )
+        )
+
+    def test_negative_and_zero_weights(self):
+        # sklearn-style sample_weight admits zeros and negatives; the
+        # payload matmul carries them like any other component.
+        from torcheval_tpu.ops.pallas_binned import (
+            pallas_binned_weighted_counts,
+        )
+
+        rng = np.random.default_rng(13)
+        n = 2048
+        s = rng.random((1, n)).astype(np.float32)
+        h = (rng.random((1, n)) > 0.5).astype(np.float32)
+        w = (rng.random(n).astype(np.float32) - 0.3) * 2
+        w[:17] = 0.0
+        th = np.linspace(0, 1, 64, dtype=np.float32)
+        w_tp, w_fp, _, _ = pallas_binned_weighted_counts(
+            jnp.asarray(s),
+            jnp.asarray(h),
+            jnp.asarray(w),
+            jnp.asarray(th),
+            interpret=True,
+        )
+        o_tp, o_fp = self._oracle(s, h, w, th)
+        np.testing.assert_allclose(np.asarray(w_tp), o_tp, rtol=3e-6, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(w_fp), o_fp, rtol=3e-6, atol=2e-4)
+
+    def test_split_safe_weights_gate(self):
+        from torcheval_tpu.ops.pallas_binned import split_safe_weights
+
+        ok = jnp.asarray(np.array([1.0, 0.25, 0.0, 100.0], np.float32))
+        self.assertTrue(split_safe_weights(ok))
+        tiny = jnp.asarray(np.array([1.0, 1e-35], np.float32))
+        self.assertFalse(split_safe_weights(tiny))
+        inf = jnp.asarray(np.array([1.0, np.inf], np.float32))
+        self.assertFalse(split_safe_weights(inf))
+        nan = jnp.asarray(np.array([np.nan], np.float32))
+        self.assertFalse(split_safe_weights(nan))
+
+    def test_empty_input(self):
+        from torcheval_tpu.ops.pallas_binned import (
+            pallas_binned_weighted_counts,
+        )
+
+        w_tp, w_fp, w_pos, w_tot = pallas_binned_weighted_counts(
+            jnp.zeros((2, 0), jnp.float32),
+            jnp.zeros((2, 0), bool),
+            jnp.zeros((0,), jnp.float32),
+            jnp.linspace(0, 1.0, 5),
+            interpret=True,
+        )
+        self.assertEqual(w_tp.shape, (2, 5))
+        self.assertEqual(float(jnp.sum(w_tp) + jnp.sum(w_fp) + jnp.sum(w_tot)), 0.0)
+
+
 if __name__ == "__main__":
     unittest.main()

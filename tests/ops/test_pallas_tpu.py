@@ -363,6 +363,97 @@ class TestBinnedRouteEconomics(unittest.TestCase):
             )
 
 
+class TestCompiledWeightedBinned(unittest.TestCase):
+    """The weighted payload kernel compiled on the chip: oracle parity at
+    the f32 summation-order contract, bitwise unit-weight equivalence,
+    and the 2×-of-unweighted budget at the (1000, 2^17)×2048 pod shape
+    (round-4 VERDICT item 4)."""
+
+    def setUp(self):
+        _require_tpu()
+
+    def test_compiled_matches_interpret(self):
+        from torcheval_tpu.ops.pallas_binned import (
+            _pallas_binned_weighted_counts_jit,
+        )
+
+        rng = np.random.default_rng(31)
+        r, n, t_count = 8, 2**15, 300
+        s = jnp.asarray(rng.random((r, n)).astype(np.float32))
+        h = jnp.asarray((rng.random((r, n)) > 0.4).astype(np.float32))
+        w = jnp.asarray(rng.random(n).astype(np.float32) * 3 + 0.01)
+        th = jnp.asarray(np.sort(rng.random(t_count).astype(np.float32)))
+        for split3 in (True, False):
+            compiled = _pallas_binned_weighted_counts_jit(
+                s, h, w, th, interpret=False, split3=split3
+            )
+            interp = _pallas_binned_weighted_counts_jit(
+                s, h, w, th, interpret=True, split3=split3
+            )
+            for a, b in zip(compiled, interp):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b), f"split3={split3}"
+                )
+
+    def test_compiled_unit_weights_bitwise(self):
+        from torcheval_tpu.ops.pallas_binned import (
+            pallas_binned_counts,
+            pallas_binned_weighted_counts,
+        )
+
+        rng = np.random.default_rng(32)
+        n = 2**16
+        s = jnp.asarray(rng.random((1, n)).astype(np.float32))
+        h = jnp.asarray(rng.random((1, n)) > 0.3)
+        th = jnp.linspace(0, 1, 1000)
+        u_tp, u_fp, _, _ = pallas_binned_counts(s, h, th, interpret=False)
+        e_tp, e_fp, _, _ = pallas_binned_weighted_counts(
+            s, h, jnp.ones(n, jnp.float32), th, interpret=False
+        )
+        np.testing.assert_array_equal(
+            np.asarray(e_tp), np.asarray(u_tp).astype(np.float32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(e_fp), np.asarray(u_fp).astype(np.float32)
+        )
+
+    def test_weighted_within_2x_of_unweighted_at_pod_shape(self):
+        from benchmarks.workloads import _device_seconds
+        from torcheval_tpu.ops.pallas_binned import (
+            _pallas_binned_counts_jit,
+            _pallas_binned_weighted_counts_jit,
+        )
+
+        rng = np.random.default_rng(33)
+        r, n, t_count = 1000, 2**17, 2048
+        s = jnp.asarray(rng.random((r, n)).astype(np.float32))
+        h = jnp.asarray((rng.random((r, n)) > 0.4).astype(np.float32))
+        w = jnp.asarray(rng.random(n).astype(np.float32) + 0.5)
+        th = jnp.linspace(0, 1, t_count)
+
+        def unweighted(s, h, th, i):
+            tp, fp, _, _ = _pallas_binned_counts_jit(
+                s + i * jnp.float32(1e-30), h, th,
+                interpret=False, split3=True,
+            )
+            return (tp.sum() + fp.sum()).astype(jnp.float32)
+
+        def weighted(s, h, w, th, i):
+            tp, fp, _, _ = _pallas_binned_weighted_counts_jit(
+                s + i * jnp.float32(1e-30), h, w, th,
+                interpret=False, split3=True,
+            )
+            return tp.sum() + fp.sum()
+
+        t_u = _device_seconds(unweighted, (s, h, th))
+        t_w = _device_seconds(weighted, (s, h, w, th))
+        self.assertLess(
+            t_w,
+            2.0 * t_u,
+            f"weighted {t_w * 1e3:.1f} ms > 2x unweighted {t_u * 1e3:.1f} ms",
+        )
+
+
 class TestBinaryCurveLayout(unittest.TestCase):
     """The single-row curve family must run its sort/scan in 1-D layout:
     XLA lays (1, N) out as one sublane × N lanes, so every sorting stage
@@ -476,6 +567,29 @@ class TestCompiledConfusionSlab(unittest.TestCase):
         p = jnp.full((n,), 3, jnp.int32)
         got = np.asarray(confusion_slab(t, p, num_classes=c))
         self.assertEqual(int(got[0, 3]), n)
+        self.assertEqual(float(np.abs(got[: c + 1, : c + 1]).sum()), n)
+
+    def test_compiled_dense_branch_at_max_window(self):
+        # The in-kernel dense fallback builds (W, tile) one-hot operands
+        # up to 1152x1024 (~2^20 elements) — past the ~2^19 empirical
+        # Mosaic operand bound that ICEs the rank kernels
+        # (pallas_ustat._MOSAIC_OPERAND_BOUND).  This compiles the dense
+        # branch at the full W = _MAX_W window with an adversarial
+        # single-bucket distribution (every tile overflows its cap) to
+        # pin down that this kernel's operand shape is exempt.
+        from torcheval_tpu.ops.pallas_cm import (
+            _MAX_W,
+            class_window,
+            confusion_slab,
+        )
+
+        c = _MAX_W - 2  # class_window(c) == _MAX_W exactly
+        self.assertEqual(class_window(c), _MAX_W)
+        n = 2**17
+        t = jnp.zeros(n, jnp.int32)
+        p = jnp.full((n,), c - 1, jnp.int32)
+        got = np.asarray(confusion_slab(t, p, num_classes=c))
+        self.assertEqual(int(got[0, c - 1]), n)
         self.assertEqual(float(np.abs(got[: c + 1, : c + 1]).sum()), n)
 
     def test_compiled_beats_scatter(self):

@@ -219,8 +219,19 @@ def _create_threshold_tensor(
     return jnp.asarray(threshold)
 
 
-@lru_cache(maxsize=64)
 def _linspace_grid(count: int) -> jax.Array:
+    # The x64 flag joins the cache key: a cached jax.Array would
+    # otherwise freeze the dtype of the first call (stale under a later
+    # jax_enable_x64 toggle).  Keeping the cache ON the device array (not
+    # a host grid) matters — jnp.asarray re-transfers eagerly on every
+    # call, and this grid is fetched per update; and the values must stay
+    # jnp.linspace's exact f32 images (a host np.linspace computes in f64
+    # and rounds differently by 1 ulp on ~1/8 of the entries).
+    return _linspace_grid_cached(count, bool(jax.config.jax_enable_x64))
+
+
+@lru_cache(maxsize=64)
+def _linspace_grid_cached(count: int, _x64: bool) -> jax.Array:
     return jnp.linspace(0, 1.0, count)
 
 

@@ -132,31 +132,14 @@ def _split_safe_thresholds(thresholds) -> bool:
     return verdict
 
 
-def _binned_count_kernel(
-    s_ref, h_ref, ttab_ref, out_ref, hist, *, n_valid: int, tile: int,
-    tiles_per_row: int,
-):
-    """1-D grid over (row, tile) pairs flattened in row-major order (rows
-    are padded to a whole number of tiles, so no tile crosses a row
-    boundary — Mosaic's block rules then only ever see (1, tile) blocks).
-    ``ttab`` is the threshold table (column c holds thresholds [c*128,
-    (c+1)*128), finite sentinel pads): ``(128, Bc)`` f32, or
-    ``(3·128, Bc)`` bf16 split components (``_split3_bf16`` layout) when
-    the caller pre-split it for the exact bf16 gather; ``hist`` the
-    (Bc, 256) f32 scratch accumulator ([:, :128] totals, [:, 128:]
-    hits)."""
-    j = pl.program_id(0) % tiles_per_row  # tile index within the row
-
-    @pl.when(j == 0)
-    def _init():
-        hist[:, :] = jnp.zeros(hist.shape, jnp.float32)
-
-    s = s_ref[:]  # (1, tile) f32 scores
-    h = h_ref[:]  # (1, tile) f32 hits in {0, 1}
-    ttab = ttab_ref[:]  # (128 or 3·128, Bc) f32 / bf16-split components
-
-    lane = lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    valid = (j * tile + lane) < n_valid  # (1, tile)
+def _coarse_fine_onehots(s, valid, ttab):
+    """The shared coarse/gather/fine stage: per-element one-hot selectors
+    ``(oc, of)`` for the coarse block (``(Bc, tile)``) and the fine
+    threshold within the block (``(128, tile)``).  ``ttab`` is the
+    threshold table (column c holds thresholds [c*128, (c+1)*128), finite
+    sentinel pads): ``(128, Bc)`` f32, or ``(3·128, Bc)`` bf16 split
+    components (``_split3_bf16`` layout) when the caller pre-split it for
+    the exact bf16 gather."""
     split3 = ttab.shape[0] == 3 * _LANE
     bounds_row = (
         _join_split3_row(ttab) if split3 else ttab[0:1, :]
@@ -202,6 +185,31 @@ def _binned_count_kernel(
     of = ge_f - jnp.concatenate(
         [ge_f[1:, :], jnp.zeros((1, ge_f.shape[1]), jnp.float32)], axis=0
     )
+    return oc, of
+
+
+def _binned_count_kernel(
+    s_ref, h_ref, ttab_ref, out_ref, hist, *, n_valid: int, tile: int,
+    tiles_per_row: int,
+):
+    """1-D grid over (row, tile) pairs flattened in row-major order (rows
+    are padded to a whole number of tiles, so no tile crosses a row
+    boundary — Mosaic's block rules then only ever see (1, tile) blocks).
+    ``hist`` is the (Bc, 256) f32 scratch accumulator ([:, :128] totals,
+    [:, 128:] hits)."""
+    j = pl.program_id(0) % tiles_per_row  # tile index within the row
+
+    @pl.when(j == 0)
+    def _init():
+        hist[:, :] = jnp.zeros(hist.shape, jnp.float32)
+
+    s = s_ref[:]  # (1, tile) f32 scores
+    h = h_ref[:]  # (1, tile) f32 hits in {0, 1}
+    ttab = ttab_ref[:]  # (128 or 3·128, Bc) f32 / bf16-split components
+
+    lane = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = (j * tile + lane) < n_valid  # (1, tile)
+    oc, of = _coarse_fine_onehots(s, valid, ttab)
     of2 = jnp.concatenate([of, of * h], axis=0)  # (256, tile)
 
     # Histogram accumulation: ONE MXU matmul per tile.
@@ -217,8 +225,103 @@ def _binned_count_kernel(
         out_ref[0, :, :] = hist[:, :]
 
 
+def _binned_wcount_kernel(
+    s_ref, h_ref, w3_ref, ttab_ref, out_ref, hist, *, n_valid: int,
+    tile: int, tiles_per_row: int,
+):
+    """Weighted variant: per-bin ``Σ w_i`` payload sums instead of 0/1
+    counts (round-4 VERDICT item 4 — the last 100×-class scatter gap).
+
+    ``w3`` is the per-SAMPLE weight tile as three exact bf16 split
+    components (``_split3_bf16`` layout, (3, tile)) — weights are shared
+    across rows (the multiclass case: C class-rows over one sample axis),
+    so the block index is the within-row tile ``j``, not the global grid
+    step.  The payload construction stays exact per component:
+    ``of·(1−h)`` / ``of·h`` are 0/1 in f32, cast to bf16 exactly, and a
+    bf16 multiply by an exact 0/1 factor reproduces the other operand
+    bit-for-bit — so each of the three MXU passes accumulates true
+    component values in f32.
+
+    SUMMATION-ORDER CONTRACT: per bin the result is
+    ``f32(Σ aᵢ) + f32(Σ bᵢ) + f32(Σ cᵢ)`` with each component sum in the
+    MXU's f32 tile-accumulation order — a DIFFERENT f32 rounding order
+    than the scatter formulation's per-element adds, so weighted parity
+    vs the scatter path is ~1e-6 relative, not bitwise.  With unit
+    weights the b/c components vanish and the a-sums count integers
+    (exact below 2^24 per bin), so weighted(ones) ≡ unweighted BITWISE.
+
+    ``hist`` layout: [:, :128] = Σ w·(1−h) (fp side), [:, 128:] = Σ w·h
+    (tp side) — the fp side is accumulated directly instead of by
+    ``tot − tp`` cancellation."""
+    j = pl.program_id(0) % tiles_per_row  # tile index within the row
+
+    @pl.when(j == 0)
+    def _init():
+        hist[:, :] = jnp.zeros(hist.shape, jnp.float32)
+
+    s = s_ref[:]  # (1, tile) f32 scores
+    h = h_ref[:]  # (1, tile) f32 hits in {0, 1}
+    w3 = w3_ref[:]  # (3, tile) bf16 weight components, high-to-low
+    ttab = ttab_ref[:]
+
+    lane = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = (j * tile + lane) < n_valid  # (1, tile)
+    oc, of = _coarse_fine_onehots(s, valid, ttab)
+    ocb = oc.astype(jnp.bfloat16)
+    of2 = jnp.concatenate([of * (1.0 - h), of * h], axis=0).astype(
+        jnp.bfloat16
+    )  # (256, tile), exactly 0/1
+
+    # Three payload matmuls, low component first (the epilogue adds
+    # nothing across components — each lands in the same f32 accumulator,
+    # so ordering only shapes the rounding; low-first matches the split
+    # reconstruction convention).
+    for k in (2, 1, 0):
+        hist[:, :] += lax.dot_general(
+            ocb,
+            of2 * w3[k : k + 1, :],  # exact: 0/1 × bf16 component
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (Bc, 256)
+
+    @pl.when(j == tiles_per_row - 1)
+    def _epilogue():
+        out_ref[0, :, :] = hist[:, :]
+
+
 def _pad_to(n: int, m: int) -> int:
     return max(m, -(-n // m) * m)
+
+
+def _make_ttab(thresholds: jax.Array, bc: int, split3: bool) -> jax.Array:
+    """The VMEM-resident threshold table: column c holds thresholds
+    [c·128, (c+1)·128).  Finite sentinel pads, not ``+inf``: pad entries
+    ride through the gather matmul as ``sentinel·0`` and ``inf·0`` would
+    poison it with NaNs."""
+    t = thresholds.shape[0]
+    ttab = jnp.full((bc * _LANE,), _SENTINEL, jnp.float32).at[:t].set(
+        thresholds.astype(jnp.float32)
+    )
+    ttab = ttab.reshape(bc, _LANE).T  # (128, Bc)
+    if split3:
+        from torcheval_tpu.ops.pallas_ustat import _split3_bf16
+
+        ttab = _split3_bf16(ttab[None])[0]  # (3·128, Bc) bf16
+    return ttab
+
+
+def _flatten_rows(scores, hits, n_pad: int):
+    """Sentinel-clamp, zero-pad each row to ``n_pad``, and flatten
+    row-major to ``(1, R·n_pad)`` — grid step k then handles row
+    ``k // tiles_per_row``, tile ``k % tiles_per_row``, so every block is
+    ``(1, tile)`` regardless of R."""
+    r, n = scores.shape
+    s = jnp.minimum(scores.astype(jnp.float32), _SENTINEL_BELOW)
+    h = hits.astype(jnp.float32)
+    if n_pad != n:
+        s = jnp.pad(s, ((0, 0), (0, n_pad - n)))
+        h = jnp.pad(h, ((0, 0), (0, n_pad - n)))
+    return s.reshape(1, r * n_pad), h.reshape(1, r * n_pad)
 
 
 @partial(jax.jit, static_argnames=("interpret", "tile", "split3"))
@@ -247,25 +350,8 @@ def _pallas_binned_hist(
     n_pad = _pad_to(n, tile)
     tile = min(tile, n_pad)
     tiles_per_row = n_pad // tile
-    # Finite sentinel, not +inf: pad entries ride through the gather
-    # matmul as sentinel*0 and inf*0 would poison it with NaNs.
-    ttab = jnp.full((bc * _LANE,), _SENTINEL, jnp.float32).at[:t].set(
-        thresholds.astype(jnp.float32)
-    )
-    ttab = ttab.reshape(bc, _LANE).T  # (128, Bc)
-    if split3:
-        from torcheval_tpu.ops.pallas_ustat import _split3_bf16
-
-        ttab = _split3_bf16(ttab[None])[0]  # (3·128, Bc) bf16
-    s = jnp.minimum(scores.astype(jnp.float32), _SENTINEL_BELOW)
-    h = hits.astype(jnp.float32)
-    if n_pad != n:
-        s = jnp.pad(s, ((0, 0), (0, n_pad - n)))
-        h = jnp.pad(h, ((0, 0), (0, n_pad - n)))
-    # Row-major flatten: grid step k handles row k // tiles_per_row, tile
-    # k % tiles_per_row — every block is (1, tile) regardless of R.
-    s = s.reshape(1, r * n_pad)
-    h = h.reshape(1, r * n_pad)
+    ttab = _make_ttab(thresholds, bc, split3)
+    s, h = _flatten_rows(scores, hits, n_pad)
 
     return pl.pallas_call(
         partial(
@@ -315,7 +401,7 @@ def pallas_binned_counts(
     )
 
 
-@partial(jax.jit, static_argnames=("interpret", "split3"))
+@partial(jax.jit, static_argnames=("interpret", "split3", "tile"))
 def _pallas_binned_counts_jit(
     scores: jax.Array,
     hits: jax.Array,
@@ -323,6 +409,7 @@ def _pallas_binned_counts_jit(
     *,
     interpret: bool,
     split3: bool = False,
+    tile: int = _TILE,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     r, n = scores.shape
     t = thresholds.shape[0]
@@ -331,7 +418,7 @@ def _pallas_binned_counts_jit(
         zero_r = jnp.zeros((r,), jnp.int32)
         return zero_t, zero_t, zero_r, zero_r
     hist = _pallas_binned_hist(
-        scores, hits, thresholds, interpret=interpret, split3=split3
+        scores, hits, thresholds, interpret=interpret, split3=split3, tile=tile
     )
     bc = hist.shape[1]
     per_bin_total = hist[:, :, :_LANE].reshape(r, bc * _LANE)[:, :t]
@@ -342,6 +429,130 @@ def _pallas_binned_counts_jit(
     num_pos = jnp.sum(hits.astype(jnp.int32), axis=-1)
     num_total = jnp.full((r,), n, jnp.int32)
     return num_tp, num_fp, num_pos, num_total
+
+
+def pallas_binned_weighted_counts(
+    scores: jax.Array,
+    hits: jax.Array,
+    weights: jax.Array,
+    thresholds: jax.Array,
+    *,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Weighted analog of :func:`pallas_binned_counts`: returns
+    ``(w_tp (R,T), w_fp (R,T), w_pos (R,), w_total (R,))`` as f32, where
+    ``w_tp[r, j] = Σ_{i : scores[r,i] ≥ thresholds[j]} weights[i]·hits[r,i]``
+    (and ``w_fp`` the same over the misses) — the weighted binned
+    counting the reference does per-bin on the host
+    (reference ``binned_precision_recall_curve.py:81-91``), as MXU payload
+    matmuls instead of the serializing TPU scatter.
+
+    ``weights`` is per-SAMPLE, ``(N,)``, shared across the R rows (the
+    one-vs-rest multiclass layout).  PRECONDITIONS the caller owns (the
+    sharded wrappers gate eagerly, see ``parallel.sync``): every nonzero
+    ``|weight|`` ≥ 2^-100 and finite (the exact bf16 split flushes
+    subnormal components — ``pallas_ustat._MIN_SPLIT``), and ``hits``
+    exactly 0/1 (a fractional hit would need a second split — soft
+    targets stay on the scatter path).  Summation-order contract: see
+    ``_binned_wcount_kernel`` (~1e-6 relative vs scatter; BITWISE equal
+    to the unweighted counts under unit weights)."""
+    if interpret is None:
+        interpret = not has_pallas()
+    return _pallas_binned_weighted_counts_jit(
+        scores,
+        hits,
+        weights,
+        thresholds,
+        interpret=interpret,
+        split3=_split_safe_thresholds(thresholds),
+    )
+
+
+@partial(jax.jit, static_argnames=("interpret", "split3", "tile"))
+def _pallas_binned_weighted_counts_jit(
+    scores: jax.Array,
+    hits: jax.Array,
+    weights: jax.Array,
+    thresholds: jax.Array,
+    *,
+    interpret: bool,
+    split3: bool = False,
+    tile: int = _TILE,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    from torcheval_tpu.ops.pallas_ustat import _split3_bf16
+
+    r, n = scores.shape
+    t = thresholds.shape[0]
+    w_pos = jnp.sum(
+        weights.astype(jnp.float32)[None, :] * hits.astype(jnp.float32),
+        axis=-1,
+    )
+    w_total = jnp.full((r,), jnp.sum(weights.astype(jnp.float32)))
+    if n == 0:
+        zero_t = jnp.zeros((r, t), jnp.float32)
+        return zero_t, zero_t, w_pos, w_total
+    bc = -(-t // _LANE)
+    n_pad = _pad_to(n, tile)
+    tile = min(tile, n_pad)
+    tiles_per_row = n_pad // tile
+    ttab = _make_ttab(thresholds, bc, split3)
+    s, h = _flatten_rows(scores, hits, n_pad)
+    w = weights.astype(jnp.float32)
+    if n_pad != n:
+        w = jnp.pad(w, (0, n_pad - n))
+    w3 = _split3_bf16(w[None, None, :])[0]  # (3, n_pad) bf16
+
+    hist = pl.pallas_call(
+        partial(
+            _binned_wcount_kernel,
+            n_valid=n,
+            tile=tile,
+            tiles_per_row=tiles_per_row,
+        ),
+        grid=(r * tiles_per_row,),
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda k: (0, k)),
+            pl.BlockSpec((1, tile), lambda k: (0, k)),
+            pl.BlockSpec(
+                (3, tile), lambda k, _tpr=tiles_per_row: (0, k % _tpr)
+            ),
+            pl.BlockSpec(
+                ((3 if split3 else 1) * _LANE, bc), lambda k: (0, 0)
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, bc, 256), lambda k, _tpr=tiles_per_row: (k // _tpr, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((r, bc, 256), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bc, 256), jnp.float32)],
+        interpret=interpret,
+    )(s, h, w3, ttab)
+
+    per_bin_fp = hist[:, :, :_LANE].reshape(r, bc * _LANE)[:, :t]
+    per_bin_tp = hist[:, :, _LANE:].reshape(r, bc * _LANE)[:, :t]
+    w_tp = _suffix_cumsum(per_bin_tp)
+    w_fp = _suffix_cumsum(per_bin_fp)
+    return w_tp, w_fp, w_pos, w_total
+
+
+def split_safe_weights(weights) -> bool:
+    """True when the weighted kernel's bf16-split accumulation is exact
+    for these weights: concrete, finite, every nonzero magnitude ≥ 2^-100
+    (``pallas_ustat._MIN_SPLIT``).  Mirrors
+    :func:`_split_safe_thresholds`, but weights are per-batch (not
+    long-lived buffers) so there is no memo — callers on a hot path
+    should gate once eagerly and pin the route.  Tracers → False (the
+    scatter fallback is always correct)."""
+    from torcheval_tpu.metrics.functional._host_checks import all_concrete
+    from torcheval_tpu.ops.pallas_ustat import _MIN_SPLIT
+
+    if not all_concrete(weights):
+        return False
+    w = np.abs(np.asarray(weights, dtype=np.float32))
+    if not np.isfinite(w).all():
+        return False
+    nz = w[w > 0]
+    return bool(nz.size == 0 or nz.min() >= _MIN_SPLIT)
 
 
 def has_pallas() -> bool:
