@@ -701,6 +701,33 @@ def bench_sharded_multiclass_exact() -> Tuple[str, float, Optional[float]]:
                 f"fori-loop (cap={cap}, kernel={kernel} pinned eagerly "
                 "via eager_ustat_pin)"
             )
+
+            # Ring-overlap schedule (round-4 VERDICT item 3): same exact
+            # counts, O(C·cap) memory, ppermute overlapping the count
+            # kernels.  On one chip the ring degenerates to the local
+            # count (no wire), so this clock isolates the compute side
+            # the pod schedule overlaps.  Re-pin under comm="ring" — its
+            # per-chunk Mosaic envelope can differ from the gathered one.
+            ring_cap, ring_kernel = eager_ustat_pin(
+                s, t, c, size, comm="ring"
+            )
+
+            def rstep_ring(s_, t_, i):
+                return sharded_multiclass_auroc_ustat(
+                    s_ + i * jnp.float32(1e-30),
+                    t_,
+                    mesh,
+                    num_classes=c,
+                    max_class_count_per_shard=ring_cap,
+                    comm="ring",
+                    _kernel=ring_kernel,
+                )
+
+            try:
+                ring_sec = _device_seconds(rstep_ring, (s, t))
+                extras["ring_ms_per_step"] = round(ring_sec * 1e3, 3)
+            except Exception as exc:  # pragma: no cover
+                print(f"ring clock unavailable: {exc}", file=sys.stderr)
     if not extras:  # searchsorted regime or clock failure: honest wall
         extras = {
             "device_value": round(n / sec, 1),

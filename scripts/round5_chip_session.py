@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Round-5 chip session: every measurement deferred by the tunnel outage,
+in ONE sequential session (NEVER timeout-kill any stage — see
+.claude/skills/verify/SKILL.md).
+
+Stages (each a subprocess so a Mosaic crash in one cannot wedge the rest;
+only one chip process runs at a time, per the outage protocol):
+
+1. ``scripts/round4_chip_session.py`` — the round-4 deferred
+   measurements (bf16-split binned clocks, tile-4096 hypothesis,
+   refreshed multiclass-histogram row).
+2. Inline round-5 measurements: the weighted payload kernel at the
+   (1000, 2^17)x2048 pod shape vs its unweighted twin, and the
+   ring-vs-gather pod-ustat clocks at the (2^16, 1000) north-star shape.
+3. ``scripts/tpu_validate.py`` — TPUCHECK (the full compiled-kernel
+   tier) + the complete bench ledger (BENCH_ALL.json refresh with
+   roofline fields).
+
+Prints one JSON line per measurement; a failed stage is reported and the
+session continues to the next stage.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+def round5_measurements() -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"error": "no TPU backend"}))
+        return 1
+    from benchmarks.workloads import _device_seconds
+    from torcheval_tpu.ops.pallas_binned import (
+        _pallas_binned_counts_jit,
+        _pallas_binned_weighted_counts_jit,
+    )
+
+    rng = np.random.default_rng(50)
+
+    # --- weighted payload kernel at the pod histogram shape -------------
+    r, n, t_count = 1000, 2**17, 2048
+    s = jnp.asarray(rng.random((r, n)).astype(np.float32))
+    h = jnp.asarray((rng.random((r, n)) > 0.4).astype(np.float32))
+    w = jnp.asarray(rng.random(n).astype(np.float32) + 0.5)
+    th = jnp.linspace(0, 1, t_count)
+
+    # Bit-parity first: unit weights must equal the unweighted counts.
+    u = _pallas_binned_counts_jit(s, h, th, interpret=False, split3=True)
+    e = _pallas_binned_weighted_counts_jit(
+        s, h, jnp.ones(n, jnp.float32), th, interpret=False, split3=True
+    )
+    ones_ok = bool(
+        jnp.array_equal(e[0], u[0].astype(jnp.float32))
+    ) and bool(jnp.array_equal(e[1], u[1].astype(jnp.float32)))
+
+    def weighted(s_, h_, w_, th_, i):
+        tp, fp, _, _ = _pallas_binned_weighted_counts_jit(
+            s_ + i * jnp.float32(1e-30), h_, w_, th_,
+            interpret=False, split3=True,
+        )
+        return tp.sum() + fp.sum()
+
+    def unweighted(s_, h_, th_, i):
+        tp, fp, _, _ = _pallas_binned_counts_jit(
+            s_ + i * jnp.float32(1e-30), h_, th_,
+            interpret=False, split3=True,
+        )
+        return (tp.sum() + fp.sum()).astype(jnp.float32)
+
+    t_w = _device_seconds(weighted, (s, h, w, th)) * 1e3
+    t_u = _device_seconds(unweighted, (s, h, th)) * 1e3
+    print(
+        json.dumps(
+            {
+                "measure": "weighted_binned_pod_shape",
+                "weighted_ms": round(t_w, 2),
+                "unweighted_ms": round(t_u, 2),
+                "ratio": round(t_w / t_u, 2),
+                "ones_bitwise": ones_ok,
+            }
+        ),
+        flush=True,
+    )
+    del s, h, w, th, u, e
+
+    # --- ring vs gather pod ustat at the north-star shape ---------------
+    from torcheval_tpu.parallel import (
+        make_mesh,
+        shard_batch,
+        sharded_multiclass_auroc_ustat,
+    )
+    from torcheval_tpu.parallel.exact import eager_ustat_pin
+
+    n2, c2 = 2**16, 1000
+    scores = jnp.asarray(rng.random((n2, c2)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, c2, n2).astype(np.int32))
+    mesh = make_mesh()  # one real chip: size-1 axis
+    ss, ts = shard_batch(mesh, scores, target)
+
+    results = {"measure": "pod_ustat_schedules"}
+    for comm in ("gather", "ring"):
+        # Pin per schedule: the ring's per-chunk Mosaic envelope can keep
+        # the kernel route where the gathered table would be too wide.
+        cap, kernel = eager_ustat_pin(ss, ts, c2, mesh.shape["dp"], comm=comm)
+        results[f"{comm}_pin"] = f"cap={cap} kernel={kernel}"
+
+        def dstep(s_, t_, i, _comm=comm, _cap=cap, _kernel=kernel):
+            return sharded_multiclass_auroc_ustat(
+                s_ + i * jnp.float32(1e-30),
+                t_,
+                mesh,
+                num_classes=c2,
+                max_class_count_per_shard=_cap,
+                comm=_comm,
+                _kernel=_kernel,
+            )
+
+        try:
+            results[f"{comm}_ms"] = round(
+                _device_seconds(dstep, (ss, ts)) * 1e3, 2
+            )
+        except Exception as exc:
+            results[f"{comm}_error"] = str(exc)[:200]
+    print(json.dumps(results), flush=True)
+    return 0
+
+
+def main() -> int:
+    if "--inline" in sys.argv[1:]:
+        return round5_measurements()
+    rc = 0
+    stages = [
+        [sys.executable, "scripts/round4_chip_session.py"],
+        [sys.executable, "scripts/round5_chip_session.py", "--inline"],
+        [sys.executable, "scripts/tpu_validate.py"],
+    ]
+    for cmd in stages:
+        print(f"=== {' '.join(cmd[1:])} ===", flush=True)
+        proc = subprocess.run(cmd, cwd=REPO_ROOT)
+        if proc.returncode != 0:
+            print(
+                json.dumps(
+                    {"stage": cmd[1], "returncode": proc.returncode}
+                ),
+                flush=True,
+            )
+            rc = proc.returncode
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -240,6 +240,122 @@ class TestShardedMulticlassExact(unittest.TestCase):
                 np.asarray(got), np.asarray(want), rtol=2e-6, atol=2e-6
             )
 
+    def test_ring_comm_bitwise_equals_gather(self):
+        # The ring-overlap schedule (comm="ring") must reproduce the
+        # gathered-table result BITWISE for both local-count formulations
+        # — pair counts are additive over disjoint table chunks
+        # (round-4 VERDICT item 3).
+        rng = np.random.default_rng(23)
+        n, c = 4096, 24
+        scores = jnp.asarray(
+            (rng.random((n, c)) * 128).round().astype(np.float32) / 128
+        )
+        targets = jnp.asarray(rng.integers(0, c, n))
+        for kern in ("searchsorted", "pallas"):
+            for average in ("macro", None):
+                g = sharded_multiclass_auroc_ustat(
+                    scores, targets, self.mesh, num_classes=c,
+                    average=average, comm="gather", _kernel=kern,
+                    _interpret=True,
+                )
+                r = sharded_multiclass_auroc_ustat(
+                    scores, targets, self.mesh, num_classes=c,
+                    average=average, comm="ring", _kernel=kern,
+                    _interpret=True,
+                )
+                self.assertEqual(
+                    np.asarray(g).tobytes(),
+                    np.asarray(r).tobytes(),
+                    (kern, average),
+                )
+        want = multiclass_auroc(scores, targets, num_classes=c)
+        got = sharded_multiclass_auroc_ustat(
+            scores, targets, self.mesh, num_classes=c, comm="ring"
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-6, atol=2e-6
+        )
+
+    def test_ring_comm_skewed_and_empty_classes(self):
+        # Heavy skew (an overflowing majority class is capped the same
+        # way in both schedules) and classes with zero samples.
+        rng = np.random.default_rng(24)
+        n, c = 2048, 8
+        scores = jnp.asarray(rng.random((n, c)).astype(np.float32))
+        targets = jnp.asarray(
+            np.where(rng.random(n) < 0.85, 0, rng.integers(1, c - 1, n))
+        )  # class c-1 empty
+        g = sharded_multiclass_auroc_ustat(
+            scores, targets, self.mesh, num_classes=c, average=None,
+            comm="gather",
+        )
+        r = sharded_multiclass_auroc_ustat(
+            scores, targets, self.mesh, num_classes=c, average=None,
+            comm="ring",
+        )
+        self.assertEqual(np.asarray(g).tobytes(), np.asarray(r).tobytes())
+        self.assertEqual(float(np.asarray(r)[c - 1]), 0.5)  # empty class
+
+    def test_ring_rejects_unknown_comm(self):
+        rng = np.random.default_rng(25)
+        scores = jnp.asarray(rng.random((64, 4)).astype(np.float32))
+        targets = jnp.asarray(rng.integers(0, 4, 64))
+        with self.assertRaisesRegex(ValueError, "comm"):
+            sharded_multiclass_auroc_ustat(
+                scores, targets, self.mesh, num_classes=4, comm="tree"
+            )
+
+    def test_eager_pin_honors_ring_envelope(self):
+        # eager_ustat_pin(comm="ring") must pin "pallas" where the
+        # gathered envelope would decline — the decision the ring's
+        # per-chunk width actually faces (code-review r5 finding).
+        from unittest import mock
+
+        from torcheval_tpu.ops.pallas_ustat import _MAX_CAP
+        from torcheval_tpu.parallel import exact as E
+
+        rng = np.random.default_rng(27)
+        scores = jnp.asarray(rng.random((1024, 4)).astype(np.float32))
+        targets = jnp.asarray(rng.integers(0, 4, 1024))
+        world = 8
+        cap = _MAX_CAP // world * 2  # gathered 2*_MAX_CAP wide
+
+        def fake_decision(s, t, c, w):
+            return cap, (0.1, 0.9, 0.1)
+
+        with mock.patch.object(
+            E, "_eager_ustat_decision", fake_decision
+        ), mock.patch("jax.default_backend", lambda: "tpu"):
+            _, k_gather = E.eager_ustat_pin(scores, targets, 4, world)
+            _, k_ring = E.eager_ustat_pin(
+                scores, targets, 4, world, comm="ring"
+            )
+        self.assertEqual(k_gather, "searchsorted")
+        self.assertEqual(k_ring, "pallas")
+
+    def test_ring_widens_kernel_envelope(self):
+        # The Mosaic width envelope applies per chunk under the ring, so
+        # caps whose GATHERED table exceeds _MAX_CAP stay kernel-eligible.
+        from torcheval_tpu.ops.pallas_ustat import _MAX_CAP
+        from torcheval_tpu.parallel.exact import _mc_ustat_kernel_ok
+
+        rng = np.random.default_rng(26)
+        scores = jnp.asarray(rng.random((1024, 4)).astype(np.float32))
+        stats = (0.1, 0.9, 0.1)
+        world = 8
+        from unittest import mock
+
+        cap = _MAX_CAP // world * 2  # gathered width 2*_MAX_CAP: too wide
+        with mock.patch("jax.default_backend", lambda: "tpu"):
+            self.assertFalse(
+                _mc_ustat_kernel_ok(scores, 1024, cap * world, stats)
+            )
+            self.assertTrue(
+                _mc_ustat_kernel_ok(
+                    scores, 1024, cap * world, stats, env_cap=cap
+                )
+            )
+
     def test_ustat_cap_autotunes_by_default(self):
         # None (the default) must pick the O(N)-wire packed mode from a
         # measured class-count stat, not degenerate to the full shard.

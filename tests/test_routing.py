@@ -250,6 +250,19 @@ class TestExplainRouteParallel(unittest.TestCase):
             P.sharded_multiclass_auroc_ustat, s, t, self.mesh
         )
         self.assertIn("num_classes", msg)
+        # The ring schedule is named, and an invalid comm explains the
+        # failure the real call would raise.
+        msg = explain_route(
+            P.sharded_multiclass_auroc_ustat, s, t, self.mesh,
+            num_classes=c, comm="ring",
+        )
+        self.assertIn("ppermute ring", msg)
+        self.assertIn("O(C·cap) peak memory", msg)
+        msg = explain_route(
+            P.sharded_multiclass_auroc_ustat, s, t, self.mesh,
+            num_classes=c, comm="tree",
+        )
+        self.assertIn("would fail", msg)
 
     def test_multiclass_ustat_tracer_explanation(self):
         import torcheval_tpu.parallel as P

@@ -587,6 +587,7 @@ def sharded_multiclass_auroc_ustat(
     num_classes: int,
     average: Optional[str] = "macro",
     max_class_count_per_shard: Optional[int] = None,
+    comm: str = "gather",
     _kernel: str = "auto",
     _interpret: bool = False,
 ) -> jax.Array:
@@ -626,12 +627,30 @@ def sharded_multiclass_auroc_ustat(
     the vmapped variadic-searchsorted pair otherwise.  ``_kernel``
     (``"auto"``/``"pallas"``/``"searchsorted"``) and ``_interpret`` pin a
     formulation — test hooks, not public API.
+
+    ``comm`` selects the communication schedule (round-4 VERDICT item 3):
+
+    * ``"gather"`` (default) — ONE tiled all-gather materializes the full
+      ``(C, P·cap)`` pack on every device, then one counting pass.
+      Simplest program; peak memory and the counting table grow with P.
+    * ``"ring"`` — each device sorts only its OWN ``(C, cap)`` chunk and
+      the chunks rotate around the mesh axis via ``lax.ppermute``; every
+      step counts local queries against the resident chunk while the
+      next chunk is in flight.  Pair counts are additive over disjoint
+      table chunks, so the result is the same exact integer counts —
+      with O(C·cap) peak memory instead of O(C·P·cap) (constant in P:
+      at C=1000, cap=256, P=256 the gathered pack is ~262 MB, a ring
+      chunk ~1 MB), compute overlapping communication, and the Pallas
+      kernel's Mosaic width envelope applying to the CHUNK width, so the
+      kernel route stays open at P× larger caps.
     """
     from torcheval_tpu.metrics.functional.classification.auroc import (
         _multiclass_auroc_param_check,
     )
 
     _multiclass_auroc_param_check(num_classes, average)
+    if comm not in ("gather", "ring"):
+        raise ValueError(f"comm should be 'gather' or 'ring', got {comm!r}.")
     if scores.ndim != 2 or targets.ndim != 1:
         raise ValueError(
             "scores should be (N, C) and targets (N,), got "
@@ -703,8 +722,18 @@ def sharded_multiclass_auroc_ustat(
             "samples of one class",
         )
     if _kernel == "auto":
+        from torcheval_tpu.ops.pallas_ustat import _pad_to
+
         use_kernel = _mc_ustat_kernel_ok(
-            scores, n_local * size, cap * size, known_stats
+            scores,
+            n_local * size,
+            # Ring pads each chunk to 16 columns, so the global table the
+            # int32 bound must cover is the padded-chunk total.
+            (_pad_to(cap, 16) if comm == "ring" else cap) * size,
+            known_stats,
+            # Ring mode: the Mosaic width envelope applies to the chunk
+            # each kernel call actually sees, not the global table.
+            env_cap=_pad_to(cap, 16) if comm == "ring" else None,
         )
     else:
         use_kernel = _kernel == "pallas"
@@ -715,6 +744,7 @@ def sharded_multiclass_auroc_ustat(
             average,
             cap,
             use_kernel,
+            comm,
             _interpret,
             bool(jax.config.jax_enable_x64),
         ),
@@ -729,6 +759,7 @@ def _mc_ustat_kernel_ok(
     n_total: int,
     cap_tot: int,
     known_stats: Optional[Tuple[float, float, float]],
+    env_cap: Optional[int] = None,
 ) -> bool:
     """Call-time gate for the Pallas rank-sum local-count formulation of
     the sharded multiclass ustat (vs the vmapped variadic-searchsorted
@@ -757,9 +788,13 @@ def _mc_ustat_kernel_ok(
         # the searchsorted path handles the degenerate 0-sample case.
         return False
     # The kernel pads the table width to a multiple of 16; the padded
-    # width must stay inside the hardware-verified Mosaic envelope
-    # (pallas_ustat._mosaic_tile) or the compiled kernel ICEs.
-    if _pad_to(cap_tot, 16) > _MAX_CAP or cap_tot * n_total >= 2**29:
+    # width each kernel call SEES (the full gathered table, or one ring
+    # chunk — ``env_cap``) must stay inside the hardware-verified Mosaic
+    # envelope (pallas_ustat._mosaic_tile) or the compiled kernel ICEs.
+    # The int32-exactness bound is on the GLOBAL accumulated rank sums
+    # either way.
+    env = env_cap if env_cap is not None else _pad_to(cap_tot, 16)
+    if env > _MAX_CAP or cap_tot * n_total >= 2**29:
         return False
     if known_stats is None:
         if not value_checks_enabled():
@@ -779,7 +814,7 @@ def _mc_ustat_kernel_ok(
 
 
 def _build_mc_ustat(statics, mesh: Mesh, axis: str):
-    num_classes, average, cap, use_kernel, interpret, _x64 = statics
+    num_classes, average, cap, use_kernel, comm, interpret, _x64 = statics
     acc = _accum_dtype()
     size = mesh.shape[axis]
 
@@ -792,17 +827,27 @@ def _build_mc_ustat(statics, mesh: Mesh, axis: str):
         packed = -jnp.sort(
             jnp.where(is_class, -s.T, jnp.inf), axis=-1
         )[:, :cap]
-        gathered = lax.all_gather(packed, axis, axis=1, tiled=True)
         n_pos = lax.psum(jnp.sum(is_class, axis=1, dtype=jnp.int32), axis)
         n_total = s.shape[0] * size
-        if use_kernel:
-            aurocs = _mc_ustat_kernel_counts(
-                s, gathered, n_pos, n_total, axis, interpret
-            )
+        if comm == "ring":
+            if use_kernel:
+                aurocs = _mc_ustat_kernel_counts_ring(
+                    s, packed, n_pos, n_total, axis, interpret, size
+                )
+            else:
+                aurocs = _mc_ustat_searchsorted_counts_ring(
+                    s, packed, is_class, n_pos, n_total, axis, acc, size
+                )
         else:
-            aurocs = _mc_ustat_searchsorted_counts(
-                s, gathered, is_class, n_pos, n_total, axis, acc
-            )
+            gathered = lax.all_gather(packed, axis, axis=1, tiled=True)
+            if use_kernel:
+                aurocs = _mc_ustat_kernel_counts(
+                    s, gathered, n_pos, n_total, axis, interpret
+                )
+            else:
+                aurocs = _mc_ustat_searchsorted_counts(
+                    s, gathered, is_class, n_pos, n_total, axis, acc
+                )
         return aurocs.mean() if average == "macro" else aurocs
 
     return jax.jit(
@@ -816,6 +861,35 @@ def _build_mc_ustat(statics, mesh: Mesh, axis: str):
     )
 
 
+def _searchsorted_above_ties(rows, queries, acc):
+    """Per-(class, query) exact ``(#entries > q, #entries == q)`` against
+    ascending rows with ``-inf`` pads (pads cancel: never ``> q``, and
+    they land in both sides of the tie difference).  method="sort" turns
+    the 65M-query binary search into one variadic sort per class —
+    measured ~35x the gather-based 'scan' lowering on v5e at the
+    (2^16, 1000) north-star shape."""
+    lo = jax.vmap(
+        lambda r, q: jnp.searchsorted(r, q, side="left", method="sort")
+    )(rows, queries).astype(acc)
+    hi = jax.vmap(
+        lambda r, q: jnp.searchsorted(r, q, side="right", method="sort")
+    )(rows, queries).astype(acc)
+    return rows.shape[-1] - hi, hi - lo
+
+
+def _auroc_from_u(is_class, above, ties, n_pos, n_total: int, axis: str, acc):
+    """Shared searchsorted epilogue (gather and ring schedules): mask
+    same-class queries, psum the U contributions, divide by the pair
+    count; degenerate classes → 0.5."""
+    contrib = jnp.where(is_class, 0.0, above + 0.5 * ties)
+    u = lax.psum(jnp.sum(contrib, axis=1, dtype=acc), axis)
+    n_posf = n_pos.astype(acc)
+    factor = n_posf * (n_total - n_posf)
+    return jnp.where(
+        factor == 0, jnp.asarray(0.5, acc), u / factor
+    ).astype(jnp.float32)
+
+
 def _mc_ustat_searchsorted_counts(
     s, gathered, is_class, n_pos, n_total: int, axis: str, acc
 ):
@@ -823,28 +897,8 @@ def _mc_ustat_searchsorted_counts(
     portable formulation (any backend, any score magnitude, no int32
     bound; float ``acc`` accumulation)."""
     rows = jnp.sort(gathered, axis=-1)  # (C, P·cap) asc, -inf pads first
-    row_len = rows.shape[-1]
-
-    # For every local sample and every class: exact #pos_c above/equal.
-    # method="sort" turns the 65M-query binary search into one variadic
-    # sort per class — measured ~35x the gather-based 'scan' lowering
-    # on v5e at the (2^16, 1000) north-star shape.
-    lo = jax.vmap(
-        lambda r, q: jnp.searchsorted(r, q, side="left", method="sort")
-    )(rows, s.T).astype(acc)
-    hi = jax.vmap(
-        lambda r, q: jnp.searchsorted(r, q, side="right", method="sort")
-    )(rows, s.T).astype(acc)
-    above = row_len - hi  # -inf pads are never counted as > q
-    ties = hi - lo
-    contrib = jnp.where(is_class, 0.0, above + 0.5 * ties)
-    u = lax.psum(jnp.sum(contrib, axis=1, dtype=acc), axis)
-
-    n_posf = n_pos.astype(acc)
-    factor = n_posf * (n_total - n_posf)
-    return jnp.where(
-        factor == 0, jnp.asarray(0.5, acc), u / factor
-    ).astype(jnp.float32)
+    above, ties = _searchsorted_above_ties(rows, s.T, acc)
+    return _auroc_from_u(is_class, above, ties, n_pos, n_total, axis, acc)
 
 
 def _mc_ustat_kernel_counts(
@@ -864,19 +918,10 @@ def _mc_ustat_kernel_counts(
     Unlike the searchsorted path there is no same-class mask: summing
     over ordered same-class pairs is the closed form n_c²/2 (globally),
     which the identity subtracts."""
-    from torcheval_tpu.ops.pallas_ustat import _BIG, rank_sum_counts
+    from torcheval_tpu.ops.pallas_ustat import rank_sum_counts
 
-    # Ascending rows with +BIG pads (the kernel's table contract); pad the
-    # width to a multiple of 16 — extra pad columns are inert, the
-    # identity's cap_tot term accounts for all pads uniformly.
-    rows = jnp.sort(
-        jnp.where(jnp.isinf(gathered), jnp.float32(_BIG), gathered), axis=-1
-    )
-    pad = (-rows.shape[-1]) % 16
-    if pad:
-        rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=_BIG)
+    rows = _ustat_kernel_table(gathered)
     cap_tot = rows.shape[-1]
-
     # ONE stacked kernel call + ONE psum for both passes (the
     # _auroc_from_rank_sums pattern: rows [0, C) non-strict, [C, 2C)
     # negated strict).
@@ -889,6 +934,31 @@ def _mc_ustat_kernel_counts(
         ),
         axis,
     )
+    return _auroc_from_pod_rank_sums(k, c, n_pos, n_total, cap_tot)
+
+
+def _ustat_kernel_table(packed):
+    """Ascending rows with +BIG pads (the rank-sum kernel's table
+    contract), width padded to a multiple of 16 — extra pad columns are
+    inert, the identity's ``cap_tot`` term accounts for all pads
+    uniformly.  Shared by the gathered table and each ring chunk."""
+    from torcheval_tpu.ops.pallas_ustat import _BIG
+
+    rows = jnp.sort(
+        jnp.where(jnp.isinf(packed), jnp.float32(_BIG), packed), axis=-1
+    )
+    pad = (-rows.shape[-1]) % 16
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)), constant_values=_BIG)
+    return rows
+
+
+def _auroc_from_pod_rank_sums(k, c: int, n_pos, n_total: int, cap_tot: int):
+    """Shared rank-sum epilogue (gather and ring schedules): the pod U
+    identity 2·U = 2·n_c·N − K_A − N·cap_tot + K_B − n_c² (see
+    :func:`_mc_ustat_kernel_counts`); degenerate classes → 0.5.
+    ``cap_tot`` is the total table width the accumulated ``k`` counted
+    against, INCLUDING every pad column."""
     k_a, k_b = k[:c], k[c:]
     two_u = 2 * n_pos * n_total - k_a - n_total * cap_tot + k_b - n_pos * n_pos
     n_posf = n_pos.astype(jnp.float32)
@@ -898,6 +968,74 @@ def _mc_ustat_kernel_counts(
         jnp.float32(0.5),
         two_u.astype(jnp.float32) / (2.0 * factor),
     )
+
+
+def _mc_ustat_kernel_counts_ring(
+    s, packed, n_pos, n_total: int, axis: str, interpret: bool, size: int
+):
+    """Ring-overlap variant of :func:`_mc_ustat_kernel_counts`: each
+    device sorts only its OWN ``(C, cap)`` chunk, the chunks rotate via
+    ``lax.ppermute``, and each ring step counts the local queries against
+    the resident chunk while the next is in flight.  Exactness: the
+    strict/non-strict rank counts are ADDITIVE over disjoint table
+    chunks, every per-chunk count is exact int32 (the kernel contract),
+    and the identity's ``cap_tot`` is the sum of padded chunk widths —
+    so the accumulated sums are bit-identical to the gathered table's
+    (both count the same global multiset; int32 addition is exact under
+    the route's ``cap_tot·N < 2^29`` bound)."""
+    from torcheval_tpu.ops.pallas_ustat import rank_sum_counts
+
+    rows = _ustat_kernel_table(packed)  # sorted ONCE; sortedness is
+    cap_tot = rows.shape[-1] * size  # invariant under the rotation
+    c = rows.shape[0]
+    queries = jnp.concatenate([s.T, -s.T], axis=0)
+    perm = [(j, (j + 1) % size) for j in range(size)]
+
+    def body(_, carry):
+        chunk, k_acc = carry
+        table = jnp.concatenate([chunk, -chunk[:, ::-1]], axis=0)
+        k_acc = k_acc + rank_sum_counts(queries, table, interpret=interpret)
+        # The final rotation returns the chunk home — wasted wire for a
+        # uniform loop body, and exactly the step XLA overlaps with the
+        # next iteration's counting.
+        chunk = lax.ppermute(chunk, axis, perm=perm)
+        return chunk, k_acc
+
+    _, k_local = lax.fori_loop(
+        0, size, body, (rows, jnp.zeros((2 * c,), jnp.int32))
+    )
+    return _auroc_from_pod_rank_sums(
+        lax.psum(k_local, axis), c, n_pos, n_total, cap_tot
+    )
+
+
+def _mc_ustat_searchsorted_counts_ring(
+    s, packed, is_class, n_pos, n_total: int, axis: str, acc, size: int
+):
+    """Ring-overlap variant of :func:`_mc_ustat_searchsorted_counts`
+    (portable formulation).  Per-chunk ``above``/``ties`` are additive
+    over disjoint chunks; the cost is one variadic sort of
+    ``(cap + n_local)`` per class per ring step — P× the query-side sort
+    work of the gathered formulation, the price of O(C·cap) memory
+    (document: prefer ``comm="ring"`` with the kernel route, where
+    compute is flat in P)."""
+    queries = s.T  # (C, n_local)
+    perm = [(j, (j + 1) % size) for j in range(size)]
+    zeros = jnp.zeros(queries.shape, acc)
+    # Sort the chunk ONCE before the loop — sortedness is invariant under
+    # the rotation, so every received chunk arrives pre-sorted.
+    rows0 = jnp.sort(packed, axis=-1)  # asc, -inf pads first
+
+    def body(_, carry):
+        chunk, above, ties = carry
+        d_above, d_ties = _searchsorted_above_ties(chunk, queries, acc)
+        above = above + d_above
+        ties = ties + d_ties
+        chunk = lax.ppermute(chunk, axis, perm=perm)
+        return chunk, above, ties
+
+    _, above, ties = lax.fori_loop(0, size, body, (rows0, zeros, zeros))
+    return _auroc_from_u(is_class, above, ties, n_pos, n_total, axis, acc)
 
 
 def _eager_ustat_decision(scores, targets, num_classes: int, world: int):
@@ -935,19 +1073,30 @@ def _eager_ustat_decision(scores, targets, num_classes: int, world: int):
     return cap, (lo, hi, min_nz)
 
 
-def eager_ustat_pin(scores, targets, num_classes: int, world: int):
+def eager_ustat_pin(
+    scores, targets, num_classes: int, world: int, comm: str = "gather"
+):
     """Decide the pod ustat's ``(cap, kernel)`` pin EAGERLY on concrete
     data — the same decision :func:`sharded_multiclass_auroc_ustat` makes
     for its concrete defaults, exposed so jitted callers (whose traced
     autotune would silently pack the full shard) and the benchmark clock
     can pin it.  Returns ``(cap, kernel)`` with ``kernel`` one of
     ``"pallas"`` / ``"searchsorted"`` — pass them as
-    ``max_class_count_per_shard=`` and ``_kernel=``."""
+    ``max_class_count_per_shard=`` and ``_kernel=``.  ``comm`` must match
+    the schedule of the pinned call: under ``"ring"`` the Mosaic width
+    envelope applies per chunk, so caps whose GATHERED table is too wide
+    for the kernel can still pin ``"pallas"``."""
+    from torcheval_tpu.ops.pallas_ustat import _pad_to
+
     cap, known_stats = _eager_ustat_decision(
         scores, targets, num_classes, world
     )
     ok = _mc_ustat_kernel_ok(
-        scores, scores.shape[0], cap * world, known_stats
+        scores,
+        scores.shape[0],
+        (_pad_to(cap, 16) if comm == "ring" else cap) * world,
+        known_stats,
+        env_cap=_pad_to(cap, 16) if comm == "ring" else None,
     )
     return cap, ("pallas" if ok else "searchsorted")
 

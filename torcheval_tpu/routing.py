@@ -347,6 +347,12 @@ def _explain_parallel_route(fn, name, args, kwargs):
                 f"{name}: not routable — the call itself would fail "
                 f"(num_classes is required, got {num_classes!r})."
             )
+        comm = kwargs.get("comm", "gather")
+        if comm not in ("gather", "ring"):
+            return (
+                f"{name}: not routable — the call itself would fail "
+                f"(comm should be 'gather' or 'ring', got {comm!r})."
+            )
         size = mesh.shape[axis]
         n_local = scores.shape[0] // size
         cap = kwargs.get("max_class_count_per_shard")
@@ -373,8 +379,16 @@ def _explain_parallel_route(fn, name, args, kwargs):
         else:
             cap = min(cap, n_local)
             cap_src = f"pinned at {cap}"
+        from torcheval_tpu.ops.pallas_ustat import _pad_to
+
+        # Mirror the wrapper's gate exactly: the ring schedule's Mosaic
+        # width envelope applies per CHUNK, not to the gathered table.
         use_kernel = _mc_ustat_kernel_ok(
-            scores, n_local * size, cap * size, known_stats
+            scores,
+            n_local * size,
+            (_pad_to(cap, 16) if comm == "ring" else cap) * size,
+            known_stats,
+            env_cap=_pad_to(cap, 16) if comm == "ring" else None,
         )
         local = (
             "Pallas rank-sum kernel (sort-free)"
@@ -382,11 +396,20 @@ def _explain_parallel_route(fn, name, args, kwargs):
             else "vmapped variadic-searchsorted (the kernel's "
             "backend/int32/score-domain gate declined)"
         )
+        schedule = (
+            "one all-gather of the packed runs (O(C·cap·P) wire and "
+            "peak memory)"
+            if comm == "gather"
+            else "ppermute ring over the packed chunks (O(C·cap·P) "
+            "total wire, O(C·cap) peak memory, counting overlapped "
+            "per step)"
+        )
         return (
-            f"{name}: packed per-class runs, cap {cap_src} — "
-            f"O(C·cap·P) wire; local counting via {local}.  Under a "
-            f"caller's jit the autotune and kernel gate see tracers — "
-            f"pin max_class_count_per_shard to keep the wire bound."
+            f"{name}: packed per-class runs, cap {cap_src}; {schedule}; "
+            f"local counting via {local}.  Under a caller's jit the "
+            f"autotune and kernel gate see tracers — pin "
+            f"max_class_count_per_shard (eager_ustat_pin, with matching "
+            f"comm=) to keep the wire bound."
         )
 
     # --- histogram family: 0/1-target gate + binned-counts dispatch ------
