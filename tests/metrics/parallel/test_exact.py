@@ -101,6 +101,54 @@ class TestShardedBinaryExact(unittest.TestCase):
             want = float(binary_auroc(s, t))
             self.assertAlmostEqual(got, want, places=6, msg=f"seed={seed}")
 
+    def test_binary_ring_comm(self):
+        # AUROC: the ring rotates the packed tables; accumulated lo/hi
+        # are identical integers → BITWISE equal to the gathered result.
+        # AUPRC: entries travel with their counts; integers identical,
+        # only the precision-sum order differs (~f32 epsilon).
+        for n, pos_rate, ties, seed in [
+            (4096, 0.5, None, 41),
+            (4096, 0.03, None, 42),
+            (2**13, 0.2, 128, 43),
+            (4096, 0.0, None, 44),  # degenerate
+        ]:
+            s, t = _binary_data(n, tie_levels=ties, pos_rate=pos_rate, seed=seed)
+            g = sharded_binary_auroc_ustat(s, t, self.mesh)
+            r = sharded_binary_auroc_ustat(s, t, self.mesh, comm="ring")
+            self.assertEqual(
+                np.asarray(g).tobytes(), np.asarray(r).tobytes(), seed
+            )
+            ga = float(sharded_binary_auprc_ustat(s, t, self.mesh))
+            ra = float(
+                sharded_binary_auprc_ustat(s, t, self.mesh, comm="ring")
+            )
+            self.assertAlmostEqual(ra, ga, places=6, msg=f"seed={seed}")
+        with self.assertRaisesRegex(ValueError, "comm"):
+            sharded_binary_auroc_ustat(s, t, self.mesh, comm="mesh")
+        with self.assertRaisesRegex(ValueError, "comm"):
+            sharded_binary_auprc_ustat(s, t, self.mesh, comm="mesh")
+
+    def test_binary_ring_comm_with_cap(self):
+        s, t = _binary_data(4096, pos_rate=0.03, seed=45)
+        g = sharded_binary_auroc_ustat(
+            s, t, self.mesh, max_minority_count_per_shard=64
+        )
+        r = sharded_binary_auroc_ustat(
+            s, t, self.mesh, max_minority_count_per_shard=64, comm="ring"
+        )
+        self.assertEqual(np.asarray(g).tobytes(), np.asarray(r).tobytes())
+        ga = float(
+            sharded_binary_auprc_ustat(
+                s, t, self.mesh, max_positive_count_per_shard=64
+            )
+        )
+        ra = float(
+            sharded_binary_auprc_ustat(
+                s, t, self.mesh, max_positive_count_per_shard=64, comm="ring"
+            )
+        )
+        self.assertAlmostEqual(ra, ga, places=6)
+
     def test_ustat_minority_cap(self):
         # Rare positives with a tight per-shard cap: the O(P·cap) wire mode.
         s, t = _binary_data(4096, pos_rate=0.03, seed=5)

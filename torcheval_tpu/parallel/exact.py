@@ -370,6 +370,7 @@ def sharded_binary_auroc_ustat(
     axis: str = "dp",
     *,
     max_minority_count_per_shard: Optional[int] = None,
+    comm: str = "gather",
 ) -> jax.Array:
     """Exact pod AUROC gathering ONLY the minority class.
 
@@ -396,8 +397,15 @@ def sharded_binary_auroc_ustat(
     Scores must be finite: the packed runs pad with ``+inf`` sentinels, so
     infinite scores are rejected eagerly (skippable via
     ``skip_value_checks``; use the gather-exact variant for such inputs).
+
+    ``comm="ring"`` replaces the all-gather with a ``ppermute`` ring of
+    the packed runs — the multiclass variant's schedule (additive counts
+    over disjoint chunks → BITWISE-identical result) at O(cap) peak
+    memory instead of O(P·cap), with counting overlapped per step.
     """
     _check_even_1d(scores, targets, mesh, axis)
+    if comm not in ("gather", "ring"):
+        raise ValueError(f"comm should be 'gather' or 'ring', got {comm!r}.")
     _check_finite_scores(scores, "sharded_binary_auroc_ustat")
     size = mesh.shape[axis]
     n_local = scores.shape[0] // size
@@ -412,7 +420,7 @@ def sharded_binary_auroc_ustat(
     )
     fn = _compiled(
         _build_binary_auroc_ustat,
-        (cap, bool(jax.config.jax_enable_x64)),
+        (cap, comm, bool(jax.config.jax_enable_x64)),
         mesh,
         axis,
     )
@@ -420,14 +428,15 @@ def sharded_binary_auroc_ustat(
 
 
 def _build_binary_auroc_ustat(statics, mesh: Mesh, axis: str):
-    cap, _x64 = statics
+    cap, comm, _x64 = statics
     acc = _accum_dtype()
+    size = mesh.shape[axis]
 
     def local(s, t):
         s = s.astype(_work_dtype(s.dtype))
         pos_mask = t != 0
         n_pos = lax.psum(jnp.sum(pos_mask, dtype=jnp.int32), axis)
-        n_total = s.shape[0] * mesh.shape[axis]
+        n_total = s.shape[0] * size
         n_neg = n_total - n_pos
         # Minority = positives iff they are no more than half the samples.
         pick_pos = n_pos * 2 <= n_total
@@ -438,18 +447,49 @@ def _build_binary_auroc_ustat(statics, mesh: Mesh, axis: str):
         # the cap slice keeps every minority score unless the shard
         # overflows (checked above).
         run = jnp.sort(jnp.where(chosen_mask, s, jnp.inf))[:cap]
-        gathered = jnp.sort(lax.all_gather(run, axis, axis=0, tiled=True))
 
         # Queries: this device's samples of the other class.  +inf pads sit
         # past every finite query, so `lo`/`hi` count only real scores.
         # method="sort": one variadic sort instead of a gather-based binary
         # search (TPU gathers serialize; see the multiclass variant).
-        lo = jnp.searchsorted(
-            gathered, s, side="left", method="sort"
-        ).astype(acc)
-        hi = jnp.searchsorted(
-            gathered, s, side="right", method="sort"
-        ).astype(acc)
+        if comm == "ring":
+            # Rotate the sorted runs; lo/hi are additive over disjoint
+            # chunks.  int32 accumulation keeps every partial sum exact
+            # (counts ≤ N), so the accumulated integers — and everything
+            # derived from them — are BITWISE the gathered result's
+            # after the single .astype(acc), the same one rounding the
+            # gather path applies.  size-1 rotations: the last chunk is
+            # counted in place, not shipped home.
+            perm = [(j, (j + 1) % size) for j in range(size)]
+            zeros = jnp.zeros(s.shape, jnp.int32)
+
+            def count(chunk, lo_a, hi_a):
+                lo_a = lo_a + jnp.searchsorted(
+                    chunk, s, side="left", method="sort"
+                )
+                hi_a = hi_a + jnp.searchsorted(
+                    chunk, s, side="right", method="sort"
+                )
+                return lo_a, hi_a
+
+            def body(_, carry):
+                chunk, lo_a, hi_a = carry
+                lo_a, hi_a = count(chunk, lo_a, hi_a)
+                return lax.ppermute(chunk, axis, perm=perm), lo_a, hi_a
+
+            chunk, lo_i, hi_i = lax.fori_loop(
+                0, size - 1, body, (run, zeros, zeros)
+            )
+            lo_i, hi_i = count(chunk, lo_i, hi_i)
+            lo, hi = lo_i.astype(acc), hi_i.astype(acc)
+        else:
+            gathered = jnp.sort(lax.all_gather(run, axis, axis=0, tiled=True))
+            lo = jnp.searchsorted(
+                gathered, s, side="left", method="sort"
+            ).astype(acc)
+            hi = jnp.searchsorted(
+                gathered, s, side="right", method="sort"
+            ).astype(acc)
         ties = hi - lo
         # chosen=pos: U = Σ_neg #pos>q = n_chosen - hi;  chosen=neg:
         # U = Σ_pos #neg<q = lo.  Either way + ½·ties.
@@ -480,6 +520,7 @@ def sharded_binary_auprc_ustat(
     axis: str = "dp",
     *,
     max_positive_count_per_shard: Optional[int] = None,
+    comm: str = "gather",
 ) -> jax.Array:
     """Exact pod average precision shipping ONLY the positive class.
 
@@ -510,8 +551,20 @@ def sharded_binary_auprc_ustat(
     ``skip_value_checks``, then overflow silently drops the largest
     positive scores).  Scores must be finite (``+inf`` pads), like the
     other ustat variants.
+
+    ``comm="ring"``: here the gathered positives are the QUERY set, so
+    the ring rotates each chunk of positive entries together with its
+    partial ``(#positives < v, FP(≥v))`` counts; every device adds its
+    local contributions to the visiting entries, and after P steps each
+    chunk arrives home complete — O(cap) peak memory instead of
+    O(P·cap).  The per-entry counts are identical integers; only the
+    final precision SUM order differs (per-chunk instead of globally
+    sorted), so ring-vs-gather parity is f32 summation order (~1e-7),
+    not bitwise.
     """
     _check_even_1d(scores, targets, mesh, axis)
+    if comm not in ("gather", "ring"):
+        raise ValueError(f"comm should be 'gather' or 'ring', got {comm!r}.")
     _check_finite_scores(scores, "sharded_binary_auprc_ustat")
     size = mesh.shape[axis]
     n_local = scores.shape[0] // size
@@ -526,7 +579,7 @@ def sharded_binary_auprc_ustat(
     )
     fn = _compiled(
         _build_binary_auprc_ustat,
-        (cap, bool(jax.config.jax_enable_x64)),
+        (cap, comm, bool(jax.config.jax_enable_x64)),
         mesh,
         axis,
     )
@@ -534,8 +587,9 @@ def sharded_binary_auprc_ustat(
 
 
 def _build_binary_auprc_ustat(statics, mesh: Mesh, axis: str):
-    cap, _x64 = statics
+    cap, comm, _x64 = statics
     acc = _accum_dtype()
+    size = mesh.shape[axis]
 
     def local(s, t):
         s = s.astype(_work_dtype(s.dtype))
@@ -544,27 +598,71 @@ def _build_binary_auprc_ustat(statics, mesh: Mesh, axis: str):
         n_pos = lax.psum(n_pos_local, axis)
 
         run = jnp.sort(jnp.where(pos_mask, s, jnp.inf))[:cap]
-        gathered = jnp.sort(lax.all_gather(run, axis, axis=0, tiled=True))
-        real = jnp.isfinite(gathered)
+        neg_sorted = jnp.sort(jnp.where(pos_mask, jnp.inf, s))
+        n_neg_local = jnp.int32(s.shape[0]) - n_pos_local
 
         # Per entry: TP(≥v) = n_pos − #{P < v}; dupes share the count, so
         # each contributes its group's precision once — exactly m_g · P_g.
-        lo_self = jnp.searchsorted(
-            gathered, gathered, side="left", method="sort"
-        )
-        tp = (n_pos - lo_self).astype(acc)
+        if comm == "ring":
+            # The entries themselves are the query set, so each chunk
+            # travels WITH its partial counts: every device adds
+            # #{own positives < v} and its share of FP(≥v) to the
+            # visiting entries; after P steps the chunk is home with
+            # complete integers.
+            perm = [(j, (j + 1) % size) for j in range(size)]
+            zeros = jnp.zeros(run.shape, jnp.int32)
 
-        neg_sorted = jnp.sort(jnp.where(pos_mask, jnp.inf, s))
-        lo_neg = jnp.searchsorted(
-            neg_sorted, gathered, side="left", method="sort"
-        )
-        n_neg_local = jnp.int32(s.shape[0]) - n_pos_local
-        fp = lax.psum(n_neg_local - lo_neg, axis).astype(acc)  # (P·cap,)
+            def count(chunk, lo_a, fp_a):
+                lo_a = lo_a + jnp.searchsorted(
+                    run, chunk, side="left", method="sort"
+                )
+                fp_a = fp_a + (
+                    n_neg_local
+                    - jnp.searchsorted(
+                        neg_sorted, chunk, side="left", method="sort"
+                    )
+                )
+                return lo_a, fp_a
 
-        precision = jnp.where(real, tp / jnp.maximum(tp + fp, 1.0), 0.0)
-        ap = jnp.sum(precision, dtype=acc) / jnp.maximum(
-            n_pos.astype(acc), 1.0
-        )
+            def body(_, carry):
+                chunk, lo_a, fp_a = carry
+                lo_a, fp_a = count(chunk, lo_a, fp_a)
+                return (
+                    lax.ppermute(chunk, axis, perm=perm),
+                    lax.ppermute(lo_a, axis, perm=perm),
+                    lax.ppermute(fp_a, axis, perm=perm),
+                )
+
+            # size-1 rotations, final count in place: the psum below is
+            # placement-agnostic, so shipping every chunk "home" on a
+            # last rotation would be pure wasted wire.
+            entries, lo_self, fp_i = lax.fori_loop(
+                0, size - 1, body, (run, zeros, zeros)
+            )
+            lo_self, fp_i = count(entries, lo_self, fp_i)
+            tp = (n_pos - lo_self).astype(acc)
+            fp = fp_i.astype(acc)
+            real = jnp.isfinite(entries)
+            # Each device sums ITS chunk's precisions; one psum merges.
+            precision = jnp.where(real, tp / jnp.maximum(tp + fp, 1.0), 0.0)
+            prec_sum = lax.psum(jnp.sum(precision, dtype=acc), axis)
+        else:
+            gathered = jnp.sort(
+                lax.all_gather(run, axis, axis=0, tiled=True)
+            )
+            real = jnp.isfinite(gathered)
+            lo_self = jnp.searchsorted(
+                gathered, gathered, side="left", method="sort"
+            )
+            tp = (n_pos - lo_self).astype(acc)
+            lo_neg = jnp.searchsorted(
+                neg_sorted, gathered, side="left", method="sort"
+            )
+            fp = lax.psum(n_neg_local - lo_neg, axis).astype(acc)
+            precision = jnp.where(real, tp / jnp.maximum(tp + fp, 1.0), 0.0)
+            prec_sum = jnp.sum(precision, dtype=acc)
+
+        ap = prec_sum / jnp.maximum(n_pos.astype(acc), 1.0)
         return jnp.where(n_pos == 0, 0.0, ap).astype(jnp.float32)
 
     return jax.jit(
@@ -861,27 +959,32 @@ def _build_mc_ustat(statics, mesh: Mesh, axis: str):
     )
 
 
-def _searchsorted_above_ties(rows, queries, acc):
-    """Per-(class, query) exact ``(#entries > q, #entries == q)`` against
-    ascending rows with ``-inf`` pads (pads cancel: never ``> q``, and
-    they land in both sides of the tie difference).  method="sort" turns
-    the 65M-query binary search into one variadic sort per class —
-    measured ~35x the gather-based 'scan' lowering on v5e at the
-    (2^16, 1000) north-star shape."""
+def _searchsorted_above_ties(rows, queries):
+    """Per-(class, query) exact int32 ``(#entries > q, #entries == q)``
+    against ascending rows with ``-inf`` pads (pads cancel: never
+    ``> q``, and they land in both sides of the tie difference).
+    method="sort" turns the 65M-query binary search into one variadic
+    sort per class — measured ~35x the gather-based 'scan' lowering on
+    v5e at the (2^16, 1000) north-star shape.  Integer returns so ring
+    accumulation stays exact past f32's 2^24 integer ceiling."""
     lo = jax.vmap(
         lambda r, q: jnp.searchsorted(r, q, side="left", method="sort")
-    )(rows, queries).astype(acc)
+    )(rows, queries)
     hi = jax.vmap(
         lambda r, q: jnp.searchsorted(r, q, side="right", method="sort")
-    )(rows, queries).astype(acc)
+    )(rows, queries)
     return rows.shape[-1] - hi, hi - lo
 
 
 def _auroc_from_u(is_class, above, ties, n_pos, n_total: int, axis: str, acc):
     """Shared searchsorted epilogue (gather and ring schedules): mask
     same-class queries, psum the U contributions, divide by the pair
-    count; degenerate classes → 0.5."""
-    contrib = jnp.where(is_class, 0.0, above + 0.5 * ties)
+    count; degenerate classes → 0.5.  ``above``/``ties`` arrive as exact
+    integers and take their ONE rounding to ``acc`` here — the same
+    single cast on both schedules."""
+    contrib = jnp.where(
+        is_class, 0.0, above.astype(acc) + 0.5 * ties.astype(acc)
+    )
     u = lax.psum(jnp.sum(contrib, axis=1, dtype=acc), axis)
     n_posf = n_pos.astype(acc)
     factor = n_posf * (n_total - n_posf)
@@ -897,7 +1000,7 @@ def _mc_ustat_searchsorted_counts(
     portable formulation (any backend, any score magnitude, no int32
     bound; float ``acc`` accumulation)."""
     rows = jnp.sort(gathered, axis=-1)  # (C, P·cap) asc, -inf pads first
-    above, ties = _searchsorted_above_ties(rows, s.T, acc)
+    above, ties = _searchsorted_above_ties(rows, s.T)
     return _auroc_from_u(is_class, above, ties, n_pos, n_total, axis, acc)
 
 
@@ -991,19 +1094,22 @@ def _mc_ustat_kernel_counts_ring(
     queries = jnp.concatenate([s.T, -s.T], axis=0)
     perm = [(j, (j + 1) % size) for j in range(size)]
 
+    def count(chunk, k_acc):
+        table = jnp.concatenate([chunk, -chunk[:, ::-1]], axis=0)
+        return k_acc + rank_sum_counts(queries, table, interpret=interpret)
+
     def body(_, carry):
         chunk, k_acc = carry
-        table = jnp.concatenate([chunk, -chunk[:, ::-1]], axis=0)
-        k_acc = k_acc + rank_sum_counts(queries, table, interpret=interpret)
-        # The final rotation returns the chunk home — wasted wire for a
-        # uniform loop body, and exactly the step XLA overlaps with the
-        # next iteration's counting.
-        chunk = lax.ppermute(chunk, axis, perm=perm)
-        return chunk, k_acc
+        k_acc = count(chunk, k_acc)
+        return lax.ppermute(chunk, axis, perm=perm), k_acc
 
-    _, k_local = lax.fori_loop(
-        0, size, body, (rows, jnp.zeros((2 * c,), jnp.int32))
+    # size-1 rotations; the last chunk is counted in place (a final
+    # rotation home would be wasted wire — the psum is placement-
+    # agnostic).
+    chunk, k_local = lax.fori_loop(
+        0, size - 1, body, (rows, jnp.zeros((2 * c,), jnp.int32))
     )
+    k_local = count(chunk, k_local)
     return _auroc_from_pod_rank_sums(
         lax.psum(k_local, axis), c, n_pos, n_total, cap_tot
     )
@@ -1021,20 +1127,26 @@ def _mc_ustat_searchsorted_counts_ring(
     compute is flat in P)."""
     queries = s.T  # (C, n_local)
     perm = [(j, (j + 1) % size) for j in range(size)]
-    zeros = jnp.zeros(queries.shape, acc)
+    zeros = jnp.zeros(queries.shape, jnp.int32)
     # Sort the chunk ONCE before the loop — sortedness is invariant under
     # the rotation, so every received chunk arrives pre-sorted.
     rows0 = jnp.sort(packed, axis=-1)  # asc, -inf pads first
 
+    def count(chunk, above, ties):
+        d_above, d_ties = _searchsorted_above_ties(chunk, queries)
+        return above + d_above, ties + d_ties
+
     def body(_, carry):
         chunk, above, ties = carry
-        d_above, d_ties = _searchsorted_above_ties(chunk, queries, acc)
-        above = above + d_above
-        ties = ties + d_ties
-        chunk = lax.ppermute(chunk, axis, perm=perm)
-        return chunk, above, ties
+        above, ties = count(chunk, above, ties)
+        return lax.ppermute(chunk, axis, perm=perm), above, ties
 
-    _, above, ties = lax.fori_loop(0, size, body, (rows0, zeros, zeros))
+    # size-1 rotations; final chunk counted in place (see the kernel
+    # ring variant).
+    chunk, above, ties = lax.fori_loop(
+        0, size - 1, body, (rows0, zeros, zeros)
+    )
+    above, ties = count(chunk, above, ties)
     return _auroc_from_u(is_class, above, ties, n_pos, n_total, axis, acc)
 
 

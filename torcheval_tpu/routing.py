@@ -315,18 +315,31 @@ def _explain_parallel_route(fn, name, args, kwargs):
         size = mesh.shape[axis]
         n_local = scores.shape[0] // size
         cap = kwargs.get(param)
+        comm = kwargs.get("comm", "gather")
+        if comm not in ("gather", "ring"):
+            return (
+                f"{name}: not routable — the call itself would fail "
+                f"(comm should be 'gather' or 'ring', got {comm!r})."
+            )
+        schedule = (
+            "one all-gather of the packed runs"
+            if comm == "gather"
+            else "ppermute ring over the packed runs (O(cap) peak "
+            "memory, counting overlapped per step)"
+        )
         if cap is not None:
             return (
-                f"{name}: packed-run formulation, cap {min(cap, n_local)} "
-                f"per shard — O(P·cap) = O({size}·{min(cap, n_local)}) "
-                f"wire (a host check validates the cap unless "
-                f"skip_value_checks)."
+                f"{name}: packed-run formulation via {schedule}, cap "
+                f"{min(cap, n_local)} per shard — O(P·cap) = "
+                f"O({size}·{min(cap, n_local)}) total wire (a host check "
+                f"validates the cap unless skip_value_checks)."
             )
         return (
             f"{name}: {param} is None, so each shard packs its FULL "
-            f"{n_local}-sample run — O(N) wire like the gather-exact "
-            f"path.  Measure the per-shard minority/positive maximum "
-            f"eagerly and pass {param}= to get O(P·cap) wire."
+            f"{n_local}-sample run via {schedule} — O(N) wire like the "
+            f"gather-exact path.  Measure the per-shard "
+            f"minority/positive maximum eagerly and pass {param}= to "
+            f"get O(P·cap) wire."
         )
 
     # --- multiclass ustat: cap autotune + local-count kernel gate --------
