@@ -195,12 +195,27 @@ def pod_exact_curves() -> None:
     ustat = float(
         sharded_binary_auroc_ustat(s, t, mesh, max_minority_count_per_shard=256)
     )
+    # At pod scale, comm="ring" rotates the packed runs (ppermute) instead
+    # of gathering them: same exact counts, O(cap) peak memory.
+    ring = float(
+        sharded_binary_auroc_ustat(
+            s, t, mesh, max_minority_count_per_shard=256, comm="ring"
+        )
+    )
+    # Weighted curves ride a Pallas payload kernel instead of a scatter.
+    weights = jnp.asarray(rng.random(n).astype(np.float32) + 0.5)
+    sw = shard_batch(mesh, weights)
+    weighted = float(
+        sharded_auroc_histogram(s, t, mesh=mesh, num_bins=256, weights=sw)
+    )
     oracle = float(binary_auroc(scores, targets))
     assert exact == oracle, (exact, oracle)  # bit-exact by construction
     assert abs(ustat - oracle) < 1e-6
+    assert ring == ustat, (ring, ustat)  # additive counts: bitwise
     print(
         f"pod AUROC: histogram(256 bins)={approx:.4f}  exact={exact:.6f}  "
-        f"ustat={ustat:.6f}  (single-device oracle {oracle:.6f})"
+        f"ustat={ustat:.6f}  ring={ring:.6f}  weighted={weighted:.4f}  "
+        f"(single-device oracle {oracle:.6f})"
     )
 
 

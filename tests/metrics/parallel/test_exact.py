@@ -353,6 +353,88 @@ class TestShardedMulticlassExact(unittest.TestCase):
                 scores, targets, self.mesh, num_classes=4, comm="tree"
             )
 
+    def test_ring_gather_fuzz(self):
+        # Randomized shapes/skews/caps: the ring and gathered schedules
+        # must stay bitwise-equal (AUROC families) across the space, not
+        # just the handpicked cases above.  Odd trials pass an explicit
+        # valid cap (measured per-shard max + slack) so the capped pack
+        # path is fuzzed too.
+        from torcheval_tpu.parallel.exact import (
+            _max_shard_class_count,
+            _max_shard_minority_count,
+        )
+
+        rng = np.random.default_rng(30)
+        for trial in range(8):
+            world = self.mesh.shape["dp"]
+            n = int(rng.integers(2, 40)) * 8 * world
+            c = int(rng.integers(2, 20))
+            skew = rng.random()
+            scores = jnp.asarray(
+                (rng.random((n, c)) * 64).round().astype(np.float32) / 64
+            )
+            targets = jnp.asarray(
+                np.where(
+                    rng.random(n) < skew, 0, rng.integers(0, c, n)
+                ).astype(np.int32)
+            )
+            cap = None
+            if trial % 2:
+                most = int(
+                    _max_shard_class_count(
+                        targets, num_classes=c, world=world
+                    )
+                )
+                cap = most + int(rng.integers(0, 32))
+            g = sharded_multiclass_auroc_ustat(
+                scores, targets, self.mesh, num_classes=c, average=None,
+                max_class_count_per_shard=cap,
+            )
+            r = sharded_multiclass_auroc_ustat(
+                scores, targets, self.mesh, num_classes=c, average=None,
+                max_class_count_per_shard=cap, comm="ring",
+            )
+            self.assertEqual(
+                np.asarray(g).tobytes(), np.asarray(r).tobytes(), trial
+            )
+            bs = scores[:, 0]
+            bt = (targets == 0).astype(jnp.float32)
+            bcap = None
+            if trial % 2:
+                bcap = int(_max_shard_minority_count(bt, world=world)) + 1
+            gb = sharded_binary_auroc_ustat(
+                bs, bt, self.mesh, max_minority_count_per_shard=bcap
+            )
+            rb = sharded_binary_auroc_ustat(
+                bs, bt, self.mesh, max_minority_count_per_shard=bcap,
+                comm="ring",
+            )
+            self.assertEqual(
+                np.asarray(gb).tobytes(), np.asarray(rb).tobytes(), trial
+            )
+
+    def test_ring_gather_truncating_cap_bitwise(self):
+        # A deliberately TIGHT cap (under skip_value_checks, where
+        # overflow silently drops the largest scores) must truncate
+        # IDENTICALLY in both schedules — the packed slice is computed
+        # before any communication.
+        from torcheval_tpu.metrics.functional import skip_value_checks
+
+        rng = np.random.default_rng(33)
+        n, c = 2048, 6
+        scores = jnp.asarray(rng.random((n, c)).astype(np.float32))
+        targets = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+        with skip_value_checks():
+            g = sharded_multiclass_auroc_ustat(
+                scores, targets, self.mesh, num_classes=c, average=None,
+                max_class_count_per_shard=8,
+            )
+            r = sharded_multiclass_auroc_ustat(
+                scores, targets, self.mesh, num_classes=c, average=None,
+                max_class_count_per_shard=8, comm="ring",
+            )
+        self.assertEqual(np.asarray(g).tobytes(), np.asarray(r).tobytes())
+
     def test_eager_pin_honors_ring_envelope(self):
         # eager_ustat_pin(comm="ring") must pin "pallas" where the
         # gathered envelope would decline — the decision the ring's

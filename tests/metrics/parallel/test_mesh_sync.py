@@ -551,6 +551,45 @@ class TestWeightedKernelRoute(unittest.TestCase):
                 any("assume_split_safe_weights" in m for m in msgs), msgs
             )
 
+    def test_kernel_vs_scatter_at_adversarial_bin_edges(self):
+        # Exact f32 bin-boundary scores and their one-ulp neighbors: the
+        # bisected grid makes the kernel's `s >= t_j` counting
+        # SET-identical to the scatter's trunc binning, so even at the
+        # edges the weighted difference is pure f32 summation order.
+        from torcheval_tpu.parallel import sharded_auroc_histogram
+        from torcheval_tpu.parallel.sync import _grid_np
+
+        rng = np.random.default_rng(29)
+        num_bins = 1000
+        grid = _grid_np(num_bins)
+        edges = np.concatenate(
+            [grid, np.nextafter(grid, 0), np.nextafter(grid, 1)]
+        )
+        n = 4096
+        scores = np.clip(
+            np.concatenate(
+                [edges, rng.random(n - len(edges)).astype(np.float32)]
+            ).astype(np.float32),
+            0.0,
+            1.0,
+        )
+        t = (rng.random(n) < 0.4).astype(np.float32)
+        w = rng.random(n).astype(np.float32) * 3 + 0.01
+        ss, ts, ws = shard_batch(
+            self.mesh,
+            jnp.asarray(scores),
+            jnp.asarray(t),
+            jnp.asarray(w),
+        )
+        scatter = sharded_auroc_histogram(
+            ss, ts, mesh=self.mesh, num_bins=num_bins, weights=ws
+        )
+        with self._force_pallas():
+            kernel = sharded_auroc_histogram(
+                ss, ts, mesh=self.mesh, num_bins=num_bins, weights=ws
+            )
+        self.assertLess(abs(float(scatter) - float(kernel)), 1e-6)
+
     def test_subnormal_weights_fall_back_to_scatter(self):
         from torcheval_tpu.parallel import sharded_auroc_histogram
 

@@ -14,6 +14,13 @@ class TestExamples(unittest.TestCase):
 
         distributed_example.train_rank_world()
 
+    def test_pod_exact_curves_path(self):
+        # The ring + weighted additions live here; the verify drive
+        # caught a shard_batch unpacking bug the old smoke set missed.
+        import distributed_example
+
+        distributed_example.pod_exact_curves()
+
     def test_eval_example(self):
         import eval_example
 
