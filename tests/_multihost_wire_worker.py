@@ -159,6 +159,57 @@ def main(pid: int, nprocs: int, port: int) -> None:
     )
     np.testing.assert_allclose(float(out), float(pooled), rtol=1e-6)
 
+    # --- shard_map ring schedule across REAL processes: the ppermute
+    # ring (comm="ring") runs over the distributed CPU backend's wire,
+    # proving the schedule outside the single-process virtual mesh.
+    # Value-reading gates are pinned (explicit cap, pinned kernel,
+    # skip_value_checks): a multi-process global array is not fully
+    # addressable, so eager host fetches are unavailable by design —
+    # exactly the jit-caller recipe the docs prescribe.
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from torcheval_tpu.metrics.functional import (
+        multiclass_auroc,
+        skip_value_checks,
+    )
+    from torcheval_tpu.parallel import sharded_multiclass_auroc_ustat
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    c, n_local = 5, 64
+
+    def _ring_rank_data(rank: int):
+        rng = np.random.default_rng(4321 + rank)
+        return (
+            (rng.random((n_local, c)) * 64).round().astype(np.float32) / 64,
+            rng.integers(0, c, n_local).astype(np.int32),
+        )
+
+    ls, lt = _ring_rank_data(pid)
+    sh = NamedSharding(mesh, P("dp"))
+    gs = jax.make_array_from_process_local_data(sh, ls)
+    gt = jax.make_array_from_process_local_data(sh, lt)
+    with skip_value_checks():
+        ring = sharded_multiclass_auroc_ustat(
+            gs, gt, mesh, num_classes=c,
+            max_class_count_per_shard=n_local,
+            _kernel="searchsorted", comm="ring",
+        )
+        gathered = sharded_multiclass_auroc_ustat(
+            gs, gt, mesh, num_classes=c,
+            max_class_count_per_shard=n_local,
+            _kernel="searchsorted",
+        )
+    assert np.asarray(ring).tobytes() == np.asarray(gathered).tobytes()
+    pool_s = np.concatenate([_ring_rank_data(r)[0] for r in range(nprocs)])
+    pool_t = np.concatenate([_ring_rank_data(r)[1] for r in range(nprocs)])
+    mc_oracle = float(
+        multiclass_auroc(
+            jnp.asarray(pool_s), jnp.asarray(pool_t), num_classes=c
+        )
+    )
+    np.testing.assert_allclose(float(ring), mc_oracle, rtol=1e-6)
+
     print(f"WIRE_OK rank={pid}", flush=True)
 
 
