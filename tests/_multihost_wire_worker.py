@@ -198,7 +198,7 @@ def main(pid: int, nprocs: int, port: int) -> None:
         gathered = sharded_multiclass_auroc_ustat(
             gs, gt, mesh, num_classes=c,
             max_class_count_per_shard=n_local,
-            _kernel="searchsorted",
+            _kernel="searchsorted", comm="gather",
         )
     assert np.asarray(ring).tobytes() == np.asarray(gathered).tobytes()
     pool_s = np.concatenate([_ring_rank_data(r)[0] for r in range(nprocs)])

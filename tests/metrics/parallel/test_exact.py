@@ -456,12 +456,60 @@ class TestShardedMulticlassExact(unittest.TestCase):
         with mock.patch.object(
             E, "_eager_ustat_decision", fake_decision
         ), mock.patch("jax.default_backend", lambda: "tpu"):
-            _, k_gather = E.eager_ustat_pin(scores, targets, 4, world)
+            _, k_gather = E.eager_ustat_pin(
+                scores, targets, 4, world, comm="gather"
+            )
             _, k_ring = E.eager_ustat_pin(
                 scores, targets, 4, world, comm="ring"
             )
+            # The "auto" default resolves to ring exactly because it
+            # buys the kernel route here.
+            _, k_auto = E.eager_ustat_pin(scores, targets, 4, world)
         self.assertEqual(k_gather, "searchsorted")
         self.assertEqual(k_ring, "pallas")
+        self.assertEqual(k_auto, "pallas")
+
+    def test_auto_comm_policy(self):
+        from torcheval_tpu.parallel.exact import (
+            _RING_PACK_BYTES,
+            _choose_ustat_comm,
+        )
+
+        # Kernel-buying wins regardless of size.
+        self.assertEqual(_choose_ustat_comm(4, 64, 8, True), "ring")
+        # Small pack → gather.
+        self.assertEqual(_choose_ustat_comm(1000, 256, 8), "gather")
+        # Prohibitive gathered pack → ring (C·cap·P·4 bytes > 1 GB).
+        big_p = _RING_PACK_BYTES // (4 * 1000 * 256) + 1
+        self.assertEqual(_choose_ustat_comm(1000, 256, big_p), "ring")
+
+    def test_auto_resolution_is_static_and_shared(self):
+        # The "ring buys the kernel" signal must be a pure function of
+        # statics (code-review r5 finding: a value-dependent signal made
+        # the pinned-kernel branch, the pin, and the explainer resolve
+        # comm="auto" to DIFFERENT schedules — a pallas pin could then
+        # land on a gathered table past the Mosaic envelope).
+        from unittest import mock
+
+        from torcheval_tpu.ops.pallas_ustat import _MAX_CAP
+        from torcheval_tpu.parallel import exact as E
+
+        world = 8
+        cap = _MAX_CAP // world * 2  # ring chunk fits; gathered does not
+        with mock.patch("jax.default_backend", lambda: "tpu"):
+            self.assertTrue(E._ring_buys_envelope(cap, world, 1024))
+            self.assertEqual(
+                E._choose_ustat_comm(
+                    4, cap, world,
+                    E._ring_buys_envelope(cap, world, 1024),
+                ),
+                "ring",
+            )
+            # int32 bound failing kills the envelope win (kernel declines
+            # under either schedule).
+            self.assertFalse(E._ring_buys_envelope(cap, world, 2**26))
+        # Off-TPU the envelope buys nothing (no compiled kernel at all).
+        self.assertFalse(E._ring_buys_envelope(cap, world, 1024))
 
     def test_ring_widens_kernel_envelope(self):
         # The Mosaic width envelope applies per chunk under the ring, so

@@ -370,7 +370,7 @@ def sharded_binary_auroc_ustat(
     axis: str = "dp",
     *,
     max_minority_count_per_shard: Optional[int] = None,
-    comm: str = "gather",
+    comm: str = "auto",
 ) -> jax.Array:
     """Exact pod AUROC gathering ONLY the minority class.
 
@@ -404,8 +404,10 @@ def sharded_binary_auroc_ustat(
     memory instead of O(P·cap), with counting overlapped per step.
     """
     _check_even_1d(scores, targets, mesh, axis)
-    if comm not in ("gather", "ring"):
-        raise ValueError(f"comm should be 'gather' or 'ring', got {comm!r}.")
+    if comm not in ("auto", "gather", "ring"):
+        raise ValueError(
+            f"comm should be 'auto', 'gather' or 'ring', got {comm!r}."
+        )
     _check_finite_scores(scores, "sharded_binary_auroc_ustat")
     size = mesh.shape[axis]
     n_local = scores.shape[0] // size
@@ -418,6 +420,10 @@ def sharded_binary_auroc_ustat(
         "max_minority_count_per_shard",
         "minority-class samples",
     )
+    if comm == "auto":
+        # No kernel route in the binary family: ring only pays for
+        # itself when the gathered pack is prohibitively large.
+        comm = _choose_ustat_comm(1, cap, size)
     fn = _compiled(
         _build_binary_auroc_ustat,
         (cap, comm, bool(jax.config.jax_enable_x64)),
@@ -520,7 +526,7 @@ def sharded_binary_auprc_ustat(
     axis: str = "dp",
     *,
     max_positive_count_per_shard: Optional[int] = None,
-    comm: str = "gather",
+    comm: str = "auto",
 ) -> jax.Array:
     """Exact pod average precision shipping ONLY the positive class.
 
@@ -563,8 +569,10 @@ def sharded_binary_auprc_ustat(
     not bitwise.
     """
     _check_even_1d(scores, targets, mesh, axis)
-    if comm not in ("gather", "ring"):
-        raise ValueError(f"comm should be 'gather' or 'ring', got {comm!r}.")
+    if comm not in ("auto", "gather", "ring"):
+        raise ValueError(
+            f"comm should be 'auto', 'gather' or 'ring', got {comm!r}."
+        )
     _check_finite_scores(scores, "sharded_binary_auprc_ustat")
     size = mesh.shape[axis]
     n_local = scores.shape[0] // size
@@ -577,6 +585,8 @@ def sharded_binary_auprc_ustat(
         "max_positive_count_per_shard",
         "positive samples",
     )
+    if comm == "auto":
+        comm = _choose_ustat_comm(1, cap, size)
     fn = _compiled(
         _build_binary_auprc_ustat,
         (cap, comm, bool(jax.config.jax_enable_x64)),
@@ -685,7 +695,7 @@ def sharded_multiclass_auroc_ustat(
     num_classes: int,
     average: Optional[str] = "macro",
     max_class_count_per_shard: Optional[int] = None,
-    comm: str = "gather",
+    comm: str = "auto",
     _kernel: str = "auto",
     _interpret: bool = False,
 ) -> jax.Array:
@@ -728,7 +738,11 @@ def sharded_multiclass_auroc_ustat(
 
     ``comm`` selects the communication schedule (round-4 VERDICT item 3):
 
-    * ``"gather"`` (default) — ONE tiled all-gather materializes the full
+    * ``"auto"`` (default) — resolves from statics only
+      (:func:`_choose_ustat_comm`, identical under jit): the ring when
+      it keeps the sort-free kernel route open or when the gathered
+      pack would exceed ~1 GB, the gather otherwise.
+    * ``"gather"`` — ONE tiled all-gather materializes the full
       ``(C, P·cap)`` pack on every device, then one counting pass.
       Simplest program; peak memory and the counting table grow with P.
     * ``"ring"`` — each device sorts only its OWN ``(C, cap)`` chunk and
@@ -747,8 +761,10 @@ def sharded_multiclass_auroc_ustat(
     )
 
     _multiclass_auroc_param_check(num_classes, average)
-    if comm not in ("gather", "ring"):
-        raise ValueError(f"comm should be 'gather' or 'ring', got {comm!r}.")
+    if comm not in ("auto", "gather", "ring"):
+        raise ValueError(
+            f"comm should be 'auto', 'gather' or 'ring', got {comm!r}."
+        )
     if scores.ndim != 2 or targets.ndim != 1:
         raise ValueError(
             "scores should be (N, C) and targets (N,), got "
@@ -822,19 +838,36 @@ def sharded_multiclass_auroc_ustat(
     if _kernel == "auto":
         from torcheval_tpu.ops.pallas_ustat import _pad_to
 
-        use_kernel = _mc_ustat_kernel_ok(
-            scores,
-            n_local * size,
+        def kernel_ok(schedule: str) -> bool:
             # Ring pads each chunk to 16 columns, so the global table the
-            # int32 bound must cover is the padded-chunk total.
-            (_pad_to(cap, 16) if comm == "ring" else cap) * size,
-            known_stats,
-            # Ring mode: the Mosaic width envelope applies to the chunk
-            # each kernel call actually sees, not the global table.
-            env_cap=_pad_to(cap, 16) if comm == "ring" else None,
-        )
+            # int32 bound must cover is the padded-chunk total; and its
+            # Mosaic width envelope applies to the chunk each kernel call
+            # actually sees, not the gathered table.
+            ring = schedule == "ring"
+            return _mc_ustat_kernel_ok(
+                scores,
+                n_local * size,
+                (_pad_to(cap, 16) if ring else cap) * size,
+                known_stats,
+                env_cap=_pad_to(cap, 16) if ring else None,
+            )
+
+        if comm == "auto":
+            comm = _choose_ustat_comm(
+                num_classes, cap, size,
+                ring_buys_kernel=_ring_buys_envelope(cap, size, n_local * size),
+            )
+        use_kernel = kernel_ok(comm)
     else:
         use_kernel = _kernel == "pallas"
+        if comm == "auto":
+            # SAME static resolution as the auto-kernel branch — a
+            # pinned-kernel caller following the eager_ustat_pin recipe
+            # must land on the schedule the pin assumed.
+            comm = _choose_ustat_comm(
+                num_classes, cap, size,
+                ring_buys_kernel=_ring_buys_envelope(cap, size, n_local * size),
+            )
     fn = _compiled(
         _build_mc_ustat,
         (
@@ -850,6 +883,53 @@ def sharded_multiclass_auroc_ustat(
         axis,
     )
     return fn(scores, targets)
+
+
+# Above this gathered-pack size the auto schedule prefers the ring: the
+# materialized (C, P·cap) f32 pack would claim a serious slice of a v5e's
+# 16 GB HBM (and at pod scale simply not fit), while a ring chunk stays
+# O(C·cap).  1 GB leaves the compute arrays room; callers with tighter
+# budgets pass comm="ring" explicitly.
+_RING_PACK_BYTES = 1 << 30
+
+
+def _ring_buys_envelope(cap: int, size: int, n_total: int) -> bool:
+    """True when the Pallas rank-sum table ENVELOPE admits a ring chunk
+    but not the gathered table — a pure function of statics (backend,
+    kill-switch flags, cap, P, N), deliberately EXCLUDING the
+    value-dependent score-domain gate: every surface that resolves
+    ``comm="auto"`` (the wrapper's auto and pinned-kernel branches,
+    ``eager_ustat_pin``, ``explain_route``) must reach the same schedule,
+    including under a caller's jit where values are unreadable.  The
+    score-domain gate then only decides kernel-vs-searchsorted GIVEN the
+    schedule — identically for both."""
+    from torcheval_tpu.ops._flags import pallas_disabled, ustat_disabled
+    from torcheval_tpu.ops.pallas_ustat import _MAX_CAP, _pad_to
+
+    if pallas_disabled() or ustat_disabled() or jax.default_backend() != "tpu":
+        return False
+    ring_cap = _pad_to(cap, 16)
+    if ring_cap * size * n_total >= 2**29:  # int32 bound fails either way
+        return False
+    return ring_cap <= _MAX_CAP < _pad_to(cap * size, 16)
+
+
+def _choose_ustat_comm(
+    num_rows: int, cap: int, size: int, ring_buys_kernel: bool = False
+) -> str:
+    """Resolve ``comm="auto"`` from STATICS only (shape-derived, so the
+    decision is identical under a caller's jit): ring when it keeps the
+    sort-free kernel route open (``ring_buys_kernel`` — pass
+    :func:`_ring_buys_envelope`) or when the gathered pack would be
+    prohibitively large; gather otherwise (its single collective is the
+    simpler program, and the ring's searchsorted fallback re-sorts the
+    query side P times)."""
+    from torcheval_tpu.ops.pallas_ustat import _pad_to
+
+    if ring_buys_kernel:
+        return "ring"
+    pack_bytes = 4 * num_rows * _pad_to(cap, 16) * size
+    return "ring" if pack_bytes > _RING_PACK_BYTES else "gather"
 
 
 def _mc_ustat_kernel_ok(
@@ -1186,7 +1266,7 @@ def _eager_ustat_decision(scores, targets, num_classes: int, world: int):
 
 
 def eager_ustat_pin(
-    scores, targets, num_classes: int, world: int, comm: str = "gather"
+    scores, targets, num_classes: int, world: int, comm: str = "auto"
 ):
     """Decide the pod ustat's ``(cap, kernel)`` pin EAGERLY on concrete
     data — the same decision :func:`sharded_multiclass_auroc_ustat` makes
@@ -1195,22 +1275,38 @@ def eager_ustat_pin(
     can pin it.  Returns ``(cap, kernel)`` with ``kernel`` one of
     ``"pallas"`` / ``"searchsorted"`` — pass them as
     ``max_class_count_per_shard=`` and ``_kernel=``.  ``comm`` must match
-    the schedule of the pinned call: under ``"ring"`` the Mosaic width
-    envelope applies per chunk, so caps whose GATHERED table is too wide
-    for the kernel can still pin ``"pallas"``."""
+    the schedule of the pinned call; ``"auto"``, the shared default,
+    resolves identically here and in the wrapper — in BOTH of the
+    wrapper's kernel branches, and under a caller's jit — because the
+    policy is a pure function of statics
+    (:func:`_ring_buys_envelope` + pack size; no value-dependent gate).
+    Under ``"ring"`` the Mosaic width envelope applies per chunk, so
+    caps whose GATHERED table is too wide for the kernel can still pin
+    ``"pallas"``."""
     from torcheval_tpu.ops.pallas_ustat import _pad_to
 
     cap, known_stats = _eager_ustat_decision(
         scores, targets, num_classes, world
     )
-    ok = _mc_ustat_kernel_ok(
-        scores,
-        scores.shape[0],
-        (_pad_to(cap, 16) if comm == "ring" else cap) * world,
-        known_stats,
-        env_cap=_pad_to(cap, 16) if comm == "ring" else None,
-    )
-    return cap, ("pallas" if ok else "searchsorted")
+
+    def ok(schedule: str) -> bool:
+        ring = schedule == "ring"
+        return _mc_ustat_kernel_ok(
+            scores,
+            scores.shape[0],
+            (_pad_to(cap, 16) if ring else cap) * world,
+            known_stats,
+            env_cap=_pad_to(cap, 16) if ring else None,
+        )
+
+    if comm == "auto":
+        comm = _choose_ustat_comm(
+            num_classes, cap, world,
+            ring_buys_kernel=_ring_buys_envelope(
+                cap, world, scores.shape[0]
+            ),
+        )
+    return cap, ("pallas" if ok(comm) else "searchsorted")
 
 
 @partial(jax.jit, static_argnames=("num_classes", "world"))

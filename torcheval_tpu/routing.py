@@ -315,18 +315,28 @@ def _explain_parallel_route(fn, name, args, kwargs):
         size = mesh.shape[axis]
         n_local = scores.shape[0] // size
         cap = kwargs.get(param)
-        comm = kwargs.get("comm", "gather")
-        if comm not in ("gather", "ring"):
+        comm = kwargs.get("comm", "auto")
+        if comm not in ("auto", "gather", "ring"):
             return (
                 f"{name}: not routable — the call itself would fail "
-                f"(comm should be 'gather' or 'ring', got {comm!r})."
+                f"(comm should be 'auto', 'gather' or 'ring', got "
+                f"{comm!r})."
             )
+        if comm == "auto":
+            from torcheval_tpu.parallel.exact import _choose_ustat_comm
+
+            comm = _choose_ustat_comm(
+                1, min(cap, n_local) if cap is not None else n_local, size
+            )
+            auto_note = " (resolved from comm='auto' by pack size)"
+        else:
+            auto_note = ""
         schedule = (
             "one all-gather of the packed runs"
             if comm == "gather"
             else "ppermute ring over the packed runs (O(cap) peak "
             "memory, counting overlapped per step)"
-        )
+        ) + auto_note
         if cap is not None:
             return (
                 f"{name}: packed-run formulation via {schedule}, cap "
@@ -360,11 +370,12 @@ def _explain_parallel_route(fn, name, args, kwargs):
                 f"{name}: not routable — the call itself would fail "
                 f"(num_classes is required, got {num_classes!r})."
             )
-        comm = kwargs.get("comm", "gather")
-        if comm not in ("gather", "ring"):
+        comm = kwargs.get("comm", "auto")
+        if comm not in ("auto", "gather", "ring"):
             return (
                 f"{name}: not routable — the call itself would fail "
-                f"(comm should be 'gather' or 'ring', got {comm!r})."
+                f"(comm should be 'auto', 'gather' or 'ring', got "
+                f"{comm!r})."
             )
         size = mesh.shape[axis]
         n_local = scores.shape[0] // size
@@ -395,14 +406,33 @@ def _explain_parallel_route(fn, name, args, kwargs):
         from torcheval_tpu.ops.pallas_ustat import _pad_to
 
         # Mirror the wrapper's gate exactly: the ring schedule's Mosaic
-        # width envelope applies per CHUNK, not to the gathered table.
-        use_kernel = _mc_ustat_kernel_ok(
-            scores,
-            n_local * size,
-            (_pad_to(cap, 16) if comm == "ring" else cap) * size,
-            known_stats,
-            env_cap=_pad_to(cap, 16) if comm == "ring" else None,
-        )
+        # width envelope applies per CHUNK, not to the gathered table,
+        # and comm="auto" resolves from the same statics/gates.
+        def kernel_ok(schedule):
+            ring = schedule == "ring"
+            return _mc_ustat_kernel_ok(
+                scores,
+                n_local * size,
+                (_pad_to(cap, 16) if ring else cap) * size,
+                known_stats,
+                env_cap=_pad_to(cap, 16) if ring else None,
+            )
+
+        auto_note = ""
+        if comm == "auto":
+            from torcheval_tpu.parallel.exact import (
+                _choose_ustat_comm,
+                _ring_buys_envelope,
+            )
+
+            comm = _choose_ustat_comm(
+                num_classes, cap, size,
+                ring_buys_kernel=_ring_buys_envelope(
+                    cap, size, n_local * size
+                ),
+            )
+            auto_note = " (resolved from comm='auto')"
+        use_kernel = kernel_ok(comm)
         local = (
             "Pallas rank-sum kernel (sort-free)"
             if use_kernel
@@ -416,7 +446,7 @@ def _explain_parallel_route(fn, name, args, kwargs):
             else "ppermute ring over the packed chunks (O(C·cap·P) "
             "total wire, O(C·cap) peak memory, counting overlapped "
             "per step)"
-        )
+        ) + auto_note
         return (
             f"{name}: packed per-class runs, cap {cap_src}; {schedule}; "
             f"local counting via {local}.  Under a caller's jit the "
