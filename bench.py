@@ -159,12 +159,44 @@ def bench_reference():
     return REF_NUM_SAMPLES / min(times)
 
 
+# Touched by scripts/tpu_watch.sh while its staged chip session runs.
+# Only ONE process may hold the tunnel (a second chip process can wedge
+# the first's device claim), so bench defers to a live session first.
+CHIP_SESSION_LOCK = "/tmp/torcheval_chip_session.lock"
+
+
+def _wait_for_chip_session(max_wait_s: int = 5400) -> None:
+    """Block while a staged chip session (tpu_watch.sh) holds the tunnel.
+    The watcher refreshes the lock's mtime every minute, so a lock older
+    than 10 min means a crashed watcher and is ignored.  The session's
+    OWN bench/validate children are exempted via TORCHEVAL_CHIP_SESSION
+    (otherwise the session would deadlock on its own lock)."""
+    if os.environ.get("TORCHEVAL_CHIP_SESSION") == "1":
+        return
+    waited = 0
+    while waited < max_wait_s and os.path.exists(CHIP_SESSION_LOCK):
+        try:
+            if time.time() - os.path.getmtime(CHIP_SESSION_LOCK) > 600:
+                print("stale chip-session lock ignored", file=sys.stderr)
+                return
+        except OSError:
+            return
+        if waited == 0:
+            print(
+                "staged chip session in progress; waiting for the tunnel",
+                file=sys.stderr,
+            )
+        time.sleep(60)
+        waited += 60
+
+
 def _probe_backend() -> bool:
     """True iff a non-CPU accelerator initializes, decided in a
     SUBPROCESS: a half-up tunnel can hang backend init for tens of minutes
     with no error, and a hang inside this process could never be recovered
     (the init call holds the GIL in native code).  Healthy init takes
     seconds; the timeout budget only kills probes that are already dead."""
+    _wait_for_chip_session()
     timeout_s = int(os.environ.get("TORCHEVAL_BENCH_PROBE_TIMEOUT", "300"))
     code = (
         "import jax, sys; jax.devices(); "

@@ -1,21 +1,40 @@
 #!/bin/bash
-# Retry TPU init every 5 minutes; on success immediately run the
-# validation + benchmark suite. Never SIGTERM the probe mid-flight —
-# each probe either succeeds or errors out on its own.
+# Retry TPU init every 5 minutes; on success immediately run the staged
+# round-5 chip session (round-4 deferred measurements + round-5
+# measurements + TPUCHECK + the full bench ledger).  Never SIGTERM a
+# probe mid-flight — each probe either succeeds or errors out on its
+# own, and only ONE chip process may run at a time (outage protocol).
 cd /root/repo
-for i in $(seq 1 40); do
+for i in $(seq 1 60); do
   echo "=== probe $i $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
   if python -u -c "
 import jax, jax.numpy as jnp
 print('devices', jax.devices())
 print('ok', float(jnp.ones(8).sum()))
 " >> /tmp/tpu_watch.log 2>&1; then
-    echo "=== TPU BACK — running validation $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
-    python -u scripts/tpu_validate.py >> /tmp/tpu_watch.log 2>&1
-    echo "=== validation done $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+    echo "=== TPU BACK $(date -u +%H:%M:%S) — draining stragglers ===" >> /tmp/tpu_watch.log
+    # Drain must outlast bench's probe budget (TORCHEVAL_BENCH_PROBE_
+    # TIMEOUT, 300 s): a probe that launched before the lock appeared
+    # may hold the claim for that long.
+    sleep 310
+    # Lock the tunnel: bench.py defers to a live staged session (the
+    # session itself refreshes TPUCHECK + the full ledger).  A
+    # background refresher keeps the mtime fresh so a long session is
+    # never misread as a crashed watcher; the session's own bench
+    # children are exempt via TORCHEVAL_CHIP_SESSION.
+    LOCK=/tmp/torcheval_chip_session.lock
+    touch "$LOCK"
+    ( while :; do touch "$LOCK"; sleep 60; done ) &
+    REFRESH_PID=$!
+    echo "=== running round5 chip session $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
+    TORCHEVAL_CHIP_SESSION=1 python -u scripts/round5_chip_session.py >> /tmp/tpu_watch.log 2>&1
+    rc=$?
+    kill "$REFRESH_PID" 2>/dev/null
+    rm -f "$LOCK"
+    echo "=== chip session done rc=$rc $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
     exit 0
   fi
   sleep 300
 done
-echo "=== gave up after 40 probes ===" >> /tmp/tpu_watch.log
+echo "=== gave up after 60 probes ===" >> /tmp/tpu_watch.log
 exit 1

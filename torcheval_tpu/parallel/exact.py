@@ -836,28 +836,14 @@ def sharded_multiclass_auroc_ustat(
             "samples of one class",
         )
     if _kernel == "auto":
-        from torcheval_tpu.ops.pallas_ustat import _pad_to
-
-        def kernel_ok(schedule: str) -> bool:
-            # Ring pads each chunk to 16 columns, so the global table the
-            # int32 bound must cover is the padded-chunk total; and its
-            # Mosaic width envelope applies to the chunk each kernel call
-            # actually sees, not the gathered table.
-            ring = schedule == "ring"
-            return _mc_ustat_kernel_ok(
-                scores,
-                n_local * size,
-                (_pad_to(cap, 16) if ring else cap) * size,
-                known_stats,
-                env_cap=_pad_to(cap, 16) if ring else None,
-            )
-
         if comm == "auto":
             comm = _choose_ustat_comm(
                 num_classes, cap, size,
                 ring_buys_kernel=_ring_buys_envelope(cap, size, n_local * size),
             )
-        use_kernel = kernel_ok(comm)
+        use_kernel = _mc_kernel_ok_for_schedule(
+            scores, n_local * size, cap, size, known_stats, comm
+        )
     else:
         use_kernel = _kernel == "pallas"
         if comm == "auto":
@@ -891,6 +877,26 @@ def sharded_multiclass_auroc_ustat(
 # O(C·cap).  1 GB leaves the compute arrays room; callers with tighter
 # budgets pass comm="ring" explicitly.
 _RING_PACK_BYTES = 1 << 30
+
+
+def _mc_kernel_ok_for_schedule(
+    scores, n_total: int, cap: int, size: int, known_stats, schedule: str
+) -> bool:
+    """:func:`_mc_ustat_kernel_ok` evaluated for one schedule — THE
+    single definition of how the ring changes the gate (padded-chunk
+    int32 total; per-chunk Mosaic envelope).  Shared by the wrapper,
+    :func:`eager_ustat_pin`, and ``routing.explain_route`` so the three
+    surfaces cannot drift apart again."""
+    from torcheval_tpu.ops.pallas_ustat import _pad_to
+
+    ring = schedule == "ring"
+    return _mc_ustat_kernel_ok(
+        scores,
+        n_total,
+        (_pad_to(cap, 16) if ring else cap) * size,
+        known_stats,
+        env_cap=_pad_to(cap, 16) if ring else None,
+    )
 
 
 def _ring_buys_envelope(cap: int, size: int, n_total: int) -> bool:
@@ -1283,22 +1289,9 @@ def eager_ustat_pin(
     Under ``"ring"`` the Mosaic width envelope applies per chunk, so
     caps whose GATHERED table is too wide for the kernel can still pin
     ``"pallas"``."""
-    from torcheval_tpu.ops.pallas_ustat import _pad_to
-
     cap, known_stats = _eager_ustat_decision(
         scores, targets, num_classes, world
     )
-
-    def ok(schedule: str) -> bool:
-        ring = schedule == "ring"
-        return _mc_ustat_kernel_ok(
-            scores,
-            scores.shape[0],
-            (_pad_to(cap, 16) if ring else cap) * world,
-            known_stats,
-            env_cap=_pad_to(cap, 16) if ring else None,
-        )
-
     if comm == "auto":
         comm = _choose_ustat_comm(
             num_classes, cap, world,
@@ -1306,7 +1299,10 @@ def eager_ustat_pin(
                 cap, world, scores.shape[0]
             ),
         )
-    return cap, ("pallas" if ok(comm) else "searchsorted")
+    ok = _mc_kernel_ok_for_schedule(
+        scores, scores.shape[0], cap, world, known_stats, comm
+    )
+    return cap, ("pallas" if ok else "searchsorted")
 
 
 @partial(jax.jit, static_argnames=("num_classes", "world"))

@@ -357,10 +357,7 @@ def _explain_parallel_route(fn, name, args, kwargs):
         from torcheval_tpu.metrics.functional._host_checks import (
             value_checks_enabled,
         )
-        from torcheval_tpu.parallel.exact import (
-            _eager_ustat_decision,
-            _mc_ustat_kernel_ok,
-        )
+        from torcheval_tpu.parallel.exact import _eager_ustat_decision
 
         scores, targets = args[0], args[1]
         mesh, axis = mesh_and_axis()
@@ -403,28 +400,16 @@ def _explain_parallel_route(fn, name, args, kwargs):
         else:
             cap = min(cap, n_local)
             cap_src = f"pinned at {cap}"
-        from torcheval_tpu.ops.pallas_ustat import _pad_to
-
-        # Mirror the wrapper's gate exactly: the ring schedule's Mosaic
-        # width envelope applies per CHUNK, not to the gathered table,
-        # and comm="auto" resolves from the same statics/gates.
-        def kernel_ok(schedule):
-            ring = schedule == "ring"
-            return _mc_ustat_kernel_ok(
-                scores,
-                n_local * size,
-                (_pad_to(cap, 16) if ring else cap) * size,
-                known_stats,
-                env_cap=_pad_to(cap, 16) if ring else None,
-            )
+        # THE wrapper's own gate/resolution helpers — one definition,
+        # three surfaces (wrapper, eager_ustat_pin, this explainer).
+        from torcheval_tpu.parallel.exact import (
+            _choose_ustat_comm,
+            _mc_kernel_ok_for_schedule,
+            _ring_buys_envelope,
+        )
 
         auto_note = ""
         if comm == "auto":
-            from torcheval_tpu.parallel.exact import (
-                _choose_ustat_comm,
-                _ring_buys_envelope,
-            )
-
             comm = _choose_ustat_comm(
                 num_classes, cap, size,
                 ring_buys_kernel=_ring_buys_envelope(
@@ -432,7 +417,9 @@ def _explain_parallel_route(fn, name, args, kwargs):
                 ),
             )
             auto_note = " (resolved from comm='auto')"
-        use_kernel = kernel_ok(comm)
+        use_kernel = _mc_kernel_ok_for_schedule(
+            scores, n_local * size, cap, size, known_stats, comm
+        )
         local = (
             "Pallas rank-sum kernel (sort-free)"
             if use_kernel
