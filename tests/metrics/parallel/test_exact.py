@@ -344,6 +344,41 @@ class TestShardedMulticlassExact(unittest.TestCase):
         self.assertEqual(np.asarray(g).tobytes(), np.asarray(r).tobytes())
         self.assertEqual(float(np.asarray(r)[c - 1]), 0.5)  # empty class
 
+    def test_tuple_axis_2d_mesh(self):
+        # Samples sharded jointly over BOTH axes of a dp×sp mesh (the
+        # dryrun's own train-step layout): the gather-exact family stays
+        # bit-exact, the ustat family exact, and the ring schedule —
+        # which needs a single ppermute axis — is rejected with a clear
+        # error while comm="auto" silently serves the gather.
+        mesh2 = make_mesh((4, 2), ("dp", "sp"))
+        rng = np.random.default_rng(31)
+        n, c = 2048, 6
+        scores = jnp.asarray(
+            (rng.random((n, c)) * 64).round().astype(np.float32) / 64
+        )
+        targets = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+        bs, bt = scores[:, 0], (targets == 0).astype(jnp.float32)
+
+        exact = sharded_binary_auroc_exact(bs, bt, mesh2, axis=("dp", "sp"))
+        want = binary_auroc(bs, bt)
+        self.assertEqual(
+            np.asarray(exact).tobytes(), np.asarray(want).tobytes()
+        )
+        u = sharded_binary_auroc_ustat(bs, bt, mesh2, axis=("dp", "sp"))
+        self.assertAlmostEqual(float(u), float(want), places=6)
+        mc = sharded_multiclass_auroc_ustat(
+            scores, targets, mesh2, axis=("dp", "sp"), num_classes=c
+        )
+        mc_want = multiclass_auroc(scores, targets, num_classes=c)
+        np.testing.assert_allclose(
+            np.asarray(mc), np.asarray(mc_want), rtol=2e-6, atol=2e-6
+        )
+        with self.assertRaisesRegex(ValueError, "single mesh axis"):
+            sharded_multiclass_auroc_ustat(
+                scores, targets, mesh2, axis=("dp", "sp"), num_classes=c,
+                comm="ring",
+            )
+
     def test_ring_rejects_unknown_comm(self):
         rng = np.random.default_rng(25)
         scores = jnp.asarray(rng.random((64, 4)).astype(np.float32))
@@ -468,6 +503,38 @@ class TestShardedMulticlassExact(unittest.TestCase):
         self.assertEqual(k_gather, "searchsorted")
         self.assertEqual(k_ring, "pallas")
         self.assertEqual(k_auto, "pallas")
+
+    def test_pin_gates_under_gather_for_tuple_axes(self):
+        # eager_ustat_pin(axis=tuple) must resolve to the gather schedule
+        # the wrapper will force — a ring-envelope "pallas" pin would
+        # otherwise bypass the gather-width gate (code-review r5).
+        from unittest import mock
+
+        from torcheval_tpu.ops.pallas_ustat import _MAX_CAP
+        from torcheval_tpu.parallel import exact as E
+
+        rng = np.random.default_rng(28)
+        scores = jnp.asarray(rng.random((1024, 4)).astype(np.float32))
+        targets = jnp.asarray(rng.integers(0, 4, 1024))
+        world = 16
+        cap = _MAX_CAP // world * 2  # ring chunk fits; gathered does not
+
+        def fake_decision(s, t, c, w):
+            return cap, (0.1, 0.9, 0.1)
+
+        with mock.patch.object(
+            E, "_eager_ustat_decision", fake_decision
+        ), mock.patch("jax.default_backend", lambda: "tpu"):
+            _, k_1d = E.eager_ustat_pin(scores, targets, 4, world)
+            _, k_2d = E.eager_ustat_pin(
+                scores, targets, 4, world, axis=("dp", "sp")
+            )
+        self.assertEqual(k_1d, "pallas")  # auto → ring buys the kernel
+        self.assertEqual(k_2d, "searchsorted")  # forced gather: too wide
+        with self.assertRaisesRegex(ValueError, "single mesh axis"):
+            E.eager_ustat_pin(
+                scores, targets, 4, world, comm="ring", axis=("dp", "sp")
+            )
 
     def test_auto_comm_policy(self):
         from torcheval_tpu.parallel.exact import (

@@ -56,6 +56,7 @@ from torcheval_tpu.metrics.functional._host_checks import (
     value_checks_enabled,
 )
 from torcheval_tpu.parallel._compile_cache import compiled_spmd
+from torcheval_tpu.parallel.mesh import AxisSpec, _axis_size
 
 
 def _accum_dtype() -> jnp.dtype:
@@ -66,13 +67,30 @@ def _accum_dtype() -> jnp.dtype:
 _compiled = compiled_spmd
 
 
+def _resolve_multi_axis_comm(comm: str, axis: AxisSpec) -> str:
+    """THE tuple-axis schedule policy, shared by every ustat wrapper,
+    :func:`eager_ustat_pin`, and ``routing.explain_route``: multi-axis
+    sample sharding keeps every collective (they take the axis tuple
+    directly) but has no single-axis ``lax.ppermute`` ring.  Returns the
+    resolved ``comm``; raises for an explicit ring request."""
+    if isinstance(axis, str):
+        return comm
+    if comm == "ring":
+        raise ValueError(
+            "comm='ring' needs a single mesh axis (lax.ppermute has no "
+            "multi-axis ring); use comm='gather' or a 1-D mesh axis for "
+            "the sample dimension."
+        )
+    return "gather"
+
+
 def _check_even_1d(scores, targets, mesh: Mesh, axis: str) -> None:
     if scores.ndim != 1 or targets.ndim != 1 or scores.shape != targets.shape:
         raise ValueError(
             "scores and targets should be 1-D of equal length, got "
             f"{scores.shape} / {targets.shape}."
         )
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
     if scores.shape[0] % size != 0:
         raise ValueError(
             f"sample count {scores.shape[0]} must divide evenly over mesh "
@@ -87,7 +105,7 @@ def _check_even_tasks(scores, targets, mesh: Mesh, axis: str) -> None:
             "scores and targets should be (num_tasks, N) of equal shape, "
             f"got {scores.shape} / {targets.shape}."
         )
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
     if scores.shape[1] % size != 0:
         raise ValueError(
             f"sample count {scores.shape[1]} must divide evenly over mesh "
@@ -99,7 +117,7 @@ def sharded_multitask_auroc_exact(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
 ) -> jax.Array:
     """Bit-exact pod AUROC for multi-task ``(num_tasks, N)`` inputs
     sharded over the sample axis — the mesh analog of
@@ -180,7 +198,7 @@ def sharded_binary_auroc_exact(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
 ) -> jax.Array:
     """Bit-exact pod AUROC from mesh-sharded samples.
 
@@ -203,7 +221,7 @@ def sharded_binary_auprc_exact(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
 ) -> jax.Array:
     """Bit-exact pod average precision (same scheme as
     :func:`sharded_binary_auroc_exact`; kernel =
@@ -219,7 +237,7 @@ def sharded_multitask_auprc_exact(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
 ) -> jax.Array:
     """Bit-exact pod average precision for multi-task ``(num_tasks, N)``
     inputs sharded over the sample axis (same gather-exact scheme as
@@ -236,7 +254,7 @@ def sharded_multiclass_auroc_exact(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     *,
     num_classes: int,
     average: Optional[str] = "macro",
@@ -258,7 +276,7 @@ def sharded_multiclass_auroc_exact(
             "scores should be (N, C) and targets (N,), got "
             f"{scores.shape} / {targets.shape}."
         )
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
     if scores.shape[0] % size != 0:
         raise ValueError(
             f"sample count {scores.shape[0]} must divide evenly over mesh "
@@ -367,7 +385,7 @@ def sharded_binary_auroc_ustat(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     *,
     max_minority_count_per_shard: Optional[int] = None,
     comm: str = "auto",
@@ -408,8 +426,9 @@ def sharded_binary_auroc_ustat(
         raise ValueError(
             f"comm should be 'auto', 'gather' or 'ring', got {comm!r}."
         )
+    comm = _resolve_multi_axis_comm(comm, axis)
     _check_finite_scores(scores, "sharded_binary_auroc_ustat")
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
     n_local = scores.shape[0] // size
     cap = _resolve_ustat_cap(
         max_minority_count_per_shard,
@@ -436,7 +455,7 @@ def sharded_binary_auroc_ustat(
 def _build_binary_auroc_ustat(statics, mesh: Mesh, axis: str):
     cap, comm, _x64 = statics
     acc = _accum_dtype()
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
 
     def local(s, t):
         s = s.astype(_work_dtype(s.dtype))
@@ -523,7 +542,7 @@ def sharded_binary_auprc_ustat(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     *,
     max_positive_count_per_shard: Optional[int] = None,
     comm: str = "auto",
@@ -573,8 +592,9 @@ def sharded_binary_auprc_ustat(
         raise ValueError(
             f"comm should be 'auto', 'gather' or 'ring', got {comm!r}."
         )
+    comm = _resolve_multi_axis_comm(comm, axis)
     _check_finite_scores(scores, "sharded_binary_auprc_ustat")
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
     n_local = scores.shape[0] // size
     cap = _resolve_ustat_cap(
         max_positive_count_per_shard,
@@ -599,7 +619,7 @@ def sharded_binary_auprc_ustat(
 def _build_binary_auprc_ustat(statics, mesh: Mesh, axis: str):
     cap, comm, _x64 = statics
     acc = _accum_dtype()
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
 
     def local(s, t):
         s = s.astype(_work_dtype(s.dtype))
@@ -690,7 +710,7 @@ def sharded_multiclass_auroc_ustat(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     *,
     num_classes: int,
     average: Optional[str] = "macro",
@@ -765,6 +785,7 @@ def sharded_multiclass_auroc_ustat(
         raise ValueError(
             f"comm should be 'auto', 'gather' or 'ring', got {comm!r}."
         )
+    comm = _resolve_multi_axis_comm(comm, axis)
     if scores.ndim != 2 or targets.ndim != 1:
         raise ValueError(
             "scores should be (N, C) and targets (N,), got "
@@ -774,7 +795,7 @@ def sharded_multiclass_auroc_ustat(
         raise ValueError(
             f"scores should have {num_classes} columns, got {scores.shape}."
         )
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
     if scores.shape[0] % size != 0:
         raise ValueError(
             f"sample count {scores.shape[0]} must divide evenly over mesh "
@@ -1000,7 +1021,7 @@ def _mc_ustat_kernel_ok(
 def _build_mc_ustat(statics, mesh: Mesh, axis: str):
     num_classes, average, cap, use_kernel, comm, interpret, _x64 = statics
     acc = _accum_dtype()
-    size = mesh.shape[axis]
+    size = _axis_size(mesh, axis)
 
     def local(s, t):
         s = s.astype(_work_dtype(s.dtype))
@@ -1272,7 +1293,12 @@ def _eager_ustat_decision(scores, targets, num_classes: int, world: int):
 
 
 def eager_ustat_pin(
-    scores, targets, num_classes: int, world: int, comm: str = "auto"
+    scores,
+    targets,
+    num_classes: int,
+    world: int,
+    comm: str = "auto",
+    axis: AxisSpec = "dp",
 ):
     """Decide the pod ustat's ``(cap, kernel)`` pin EAGERLY on concrete
     data — the same decision :func:`sharded_multiclass_auroc_ustat` makes
@@ -1288,10 +1314,14 @@ def eager_ustat_pin(
     (:func:`_ring_buys_envelope` + pack size; no value-dependent gate).
     Under ``"ring"`` the Mosaic width envelope applies per chunk, so
     caps whose GATHERED table is too wide for the kernel can still pin
-    ``"pallas"``."""
+    ``"pallas"``.  Pass the pinned call's ``axis`` too when it is a
+    TUPLE of mesh axes — multi-axis sharding has no ring, so the pin
+    must gate under the gather envelope the wrapper will actually
+    use."""
     cap, known_stats = _eager_ustat_decision(
         scores, targets, num_classes, world
     )
+    comm = _resolve_multi_axis_comm(comm, axis)
     if comm == "auto":
         comm = _choose_ustat_comm(
             num_classes, cap, world,

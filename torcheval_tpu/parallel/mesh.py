@@ -17,6 +17,24 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
+# A sharded entry point's sample axis: one mesh-axis name, or a tuple of
+# names when samples are sharded jointly over several (e.g. dp×sp).
+AxisSpec = Union[str, Tuple[str, ...]]
+
+
+def _axis_size(mesh: Mesh, axis: AxisSpec) -> int:
+    """Total device count along ``axis`` — a single mesh-axis name or a
+    tuple of names (samples sharded jointly over e.g. ``("dp", "sp")``;
+    every collective in the sharded families accepts the tuple form
+    directly)."""
+    if isinstance(axis, str):
+        return mesh.shape[axis]
+    size = 1
+    for a in axis:
+        size *= mesh.shape[a]
+    return size
+
+
 def device_count() -> int:
     """Global device count (addressable by this controller's program — the
     pod size under multi-host SPMD, which is what mesh shapes are sized by).

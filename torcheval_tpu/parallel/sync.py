@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
 from torcheval_tpu.parallel._compile_cache import compiled_spmd
+from torcheval_tpu.parallel.mesh import AxisSpec, _axis_size
 
 Reduction = Union[str, Any]  # 'sum' | 'max' | 'min' | 'mean' | 'concat' | pytree
 
@@ -71,7 +72,7 @@ def mesh_merge_states(states, axis: str, reductions: Reduction = "sum"):
 def make_synced_update(
     kernel: Callable[..., Any],
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     reductions: Reduction = "sum",
     in_specs: Optional[Sequence[PartitionSpec]] = None,
 ) -> Callable[..., Any]:
@@ -120,7 +121,7 @@ def sharded_auroc_histogram(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     num_bins: int = 8192,
     weights: Optional[jax.Array] = None,
     assume_01_targets: Optional[bool] = None,
@@ -520,7 +521,7 @@ def _run_sharded_binary(
         assume_01_targets = _binary_hist_gate(scores, targets)
     else:
         _check_scores_in_unit_interval(scores)
-    n_local = scores.shape[0] // mesh.shape[axis]
+    n_local = scores.shape[0] // _axis_size(mesh, axis)
     if weights is None and assume_01_targets:
         route = _hist_route(1, n_local, num_bins)
         fn = compiled_spmd(
@@ -586,7 +587,7 @@ def sharded_auprc_histogram(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     num_bins: int = 8192,
     weights: Optional[jax.Array] = None,
     assume_01_targets: Optional[bool] = None,
@@ -665,7 +666,7 @@ def sharded_multiclass_auroc_histogram(
     scores: jax.Array,
     targets: jax.Array,
     mesh: Mesh,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     num_bins: int = 2048,
     average: Optional[str] = "macro",
     weights: Optional[jax.Array] = None,
@@ -704,7 +705,7 @@ def sharded_multiclass_auroc_histogram(
         )
     _check_scores_in_unit_interval(scores)
     num_classes = scores.shape[1]
-    n_local = scores.shape[0] // mesh.shape[axis]
+    n_local = scores.shape[0] // _axis_size(mesh, axis)
     if weights is not None:
         use_kernel, split3 = _weighted_kernel_route(
             weights, num_classes, n_local, num_bins, assume_split_safe_weights

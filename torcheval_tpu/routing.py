@@ -274,6 +274,8 @@ def _explain_parallel_route(fn, name, args, kwargs):
     import torcheval_tpu.parallel as P
     from torcheval_tpu.metrics.collection import MetricCollection
     from torcheval_tpu.metrics.functional._host_checks import all_concrete
+    from torcheval_tpu.parallel.exact import _resolve_multi_axis_comm
+    from torcheval_tpu.parallel.mesh import _axis_size
 
     # --- MetricCollection.fused_update (bound method) --------------------
     owner = getattr(fn, "__self__", None)
@@ -312,7 +314,7 @@ def _explain_parallel_route(fn, name, args, kwargs):
         param = _binary_ustat[fn]
         scores = jax.numpy.asarray(args[0])
         mesh, axis = mesh_and_axis()
-        size = mesh.shape[axis]
+        size = _axis_size(mesh, axis)
         n_local = scores.shape[0] // size
         cap = kwargs.get(param)
         comm = kwargs.get("comm", "auto")
@@ -321,6 +323,13 @@ def _explain_parallel_route(fn, name, args, kwargs):
                 f"{name}: not routable — the call itself would fail "
                 f"(comm should be 'auto', 'gather' or 'ring', got "
                 f"{comm!r})."
+            )
+        try:
+            comm = _resolve_multi_axis_comm(comm, axis)
+        except ValueError as exc:
+            return (
+                f"{name}: not routable — the call itself would fail "
+                f"({exc})"
             )
         if comm == "auto":
             from torcheval_tpu.parallel.exact import _choose_ustat_comm
@@ -374,7 +383,14 @@ def _explain_parallel_route(fn, name, args, kwargs):
                 f"(comm should be 'auto', 'gather' or 'ring', got "
                 f"{comm!r})."
             )
-        size = mesh.shape[axis]
+        try:
+            comm = _resolve_multi_axis_comm(comm, axis)
+        except ValueError as exc:
+            return (
+                f"{name}: not routable — the call itself would fail "
+                f"({exc})"
+            )
+        size = _axis_size(mesh, axis)
         n_local = scores.shape[0] // size
         cap = kwargs.get("max_class_count_per_shard")
         if not all_concrete(scores, targets):
@@ -495,7 +511,7 @@ def _explain_parallel_route(fn, name, args, kwargs):
         num_bins = call_arg(4, "num_bins", 8192)
         weights = call_arg(5, "weights")
         assume = kwargs.get("assume_01_targets")
-        n_local = scores.shape[0] // mesh.shape[axis]
+        n_local = scores.shape[0] // _axis_size(mesh, axis)
         if assume is None:
             if not all_concrete(scores, targets):
                 return (
@@ -529,7 +545,7 @@ def _explain_parallel_route(fn, name, args, kwargs):
         num_bins = call_arg(4, "num_bins", 2048)
         weights = call_arg(6, "weights")
         num_classes = scores.shape[1]
-        n_local = scores.shape[0] // mesh.shape[axis]
+        n_local = scores.shape[0] // _axis_size(mesh, axis)
         if weights is not None:
             return weighted_verdict(
                 name, weights, num_classes, n_local, num_bins
