@@ -4,6 +4,15 @@
 # measurements + TPUCHECK + the full bench ledger).  Never SIGTERM a
 # probe mid-flight — each probe either succeeds or errors out on its
 # own, and only ONE chip process may run at a time (outage protocol).
+#
+# Singleton: an flock on the watch lockfile makes concurrent launches
+# (e.g. a session-managed copy plus a setsid-detached survivor) exit
+# instead of double-probing the tunnel.
+exec 9>/tmp/torcheval_tpu_watch.flock
+if ! flock -n 9; then
+  echo "tpu_watch already running; exiting" >> /tmp/tpu_watch.log
+  exit 0
+fi
 cd /root/repo
 for i in $(seq 1 60); do
   echo "=== probe $i $(date -u +%H:%M:%S) ===" >> /tmp/tpu_watch.log
