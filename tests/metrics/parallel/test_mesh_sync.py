@@ -435,6 +435,31 @@ class TestTupleAxisHistograms(unittest.TestCase):
             np.asarray(two_d).tobytes(), np.asarray(one_d).tobytes()
         )
 
+    def test_weighted_over_2d_mesh(self):
+        # Weighted mass (scatter route here; kernel route shares the
+        # same psum) over the axis tuple, placed via shard_batch's tuple
+        # axis, against the sklearn sample_weight oracle.
+        mesh2 = make_mesh((4, 2), ("dp", "sp"))
+        rng = np.random.default_rng(38)
+        n = 4096
+        s = rng.random(n).astype(np.float32)
+        t = (rng.random(n) < 0.4).astype(np.float32)
+        w = rng.random(n).astype(np.float32) * 2 + 0.1
+        ss, ts, ws = shard_batch(
+            mesh2,
+            jnp.asarray(s),
+            jnp.asarray(t),
+            jnp.asarray(w),
+            axis=("dp", "sp"),
+        )
+        got = float(
+            sharded_auroc_histogram(
+                ss, ts, mesh2, axis=("dp", "sp"), num_bins=8192, weights=ws
+            )
+        )
+        want = roc_auc_score(t, s, sample_weight=w)
+        self.assertLess(abs(got - want), 2e-3)
+
 
 class TestWeightedKernelRoute(unittest.TestCase):
     """The weighted histogram's Pallas payload-kernel route (round-4

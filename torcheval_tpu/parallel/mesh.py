@@ -74,10 +74,12 @@ def make_mesh(
 def shard_batch(
     mesh: Mesh,
     *arrays: jax.Array,
-    axis: str = "dp",
+    axis: AxisSpec = "dp",
     dim: int = 0,
 ) -> Union[jax.Array, Tuple[jax.Array, ...]]:
-    """Place arrays with dimension ``dim`` sharded over mesh axis ``axis``.
+    """Place arrays with dimension ``dim`` sharded over mesh axis ``axis``
+    (a name, or a tuple of names to shard one dimension jointly over
+    several mesh axes — e.g. ``axis=("dp", "sp")`` on a 2-D mesh).
 
     The sharded batch is the SPMD analog of the reference's per-rank data
     shard (reference ``metric_class_tester.py:301-326`` deals update batches
