@@ -336,6 +336,31 @@ def _self_check_fast_paths() -> None:
         print("ustat fast path self-check ok", file=sys.stderr)
 
 
+_GIT_COMMIT = None
+
+
+def _git_commit() -> str:
+    """Short commit hash of the tree being measured (cached; "unknown"
+    outside a repo).  Stamped into every row so rows merged across rounds
+    in BENCH_ALL.json stay attributable to the code that produced them."""
+    global _GIT_COMMIT
+    if _GIT_COMMIT is None:
+        try:
+            _GIT_COMMIT = (
+                subprocess.run(
+                    ["git", "rev-parse", "--short", "HEAD"],
+                    capture_output=True,
+                    text=True,
+                    timeout=10,
+                    cwd=os.path.dirname(os.path.abspath(__file__)),
+                ).stdout.strip()
+                or "unknown"
+            )
+        except Exception:
+            _GIT_COMMIT = "unknown"
+    return _GIT_COMMIT
+
+
 def _make_row(name: str, ours: float, ref, extras: dict) -> dict:
     """The one JSON-row schema every ledger/headline row uses."""
     row = {
@@ -347,6 +372,10 @@ def _make_row(name: str, ours: float, ref, extras: dict) -> dict:
     row.update(extras)
     if ref and extras.get("device_value"):
         row["device_vs_baseline"] = round(extras["device_value"] / ref, 2)
+    row["git_commit"] = _git_commit()
+    # Workloads that don't route (single formulation) still get a stamped
+    # column so the ledger schema is uniform.
+    row.setdefault("device_route", "default")
     return row
 
 
@@ -432,10 +461,34 @@ def _run_worker(name: str, timeout_s: int, accel: bool):
 
 
 def _write_bench_all(rows: list, headline) -> None:
+    """Merge this run's rows into BENCH_ALL.json by metric name.
+
+    A partial run (ledger deadline, wedged worker) must not erase rows a
+    previous round DID complete: rows measured now replace same-name
+    predecessors, everything else is carried forward — each row's
+    ``git_commit`` stamp says which tree actually produced it.  Same for
+    the headline: ``None`` keeps the previous one."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_ALL.json")
+    merged = {}
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        for r in prev.get("workloads", []):
+            if isinstance(r, dict) and "metric" in r:
+                merged[r["metric"]] = r
+        if headline is None:
+            headline = prev.get("headline")
+    except (OSError, ValueError):
+        pass
+    for r in rows:
+        merged[r["metric"]] = r
     try:
         with open(path, "w") as f:
-            json.dump({"headline": headline, "workloads": rows}, f, indent=1)
+            json.dump(
+                {"headline": headline, "workloads": list(merged.values())},
+                f,
+                indent=1,
+            )
     except OSError as exc:  # pragma: no cover
         print(f"BENCH_ALL.json not written: {exc}", file=sys.stderr)
 

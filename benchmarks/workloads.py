@@ -1073,6 +1073,105 @@ def bench_weighted_histogram() -> Tuple[str, float, Optional[float]]:
     return "weighted_multiclass_histogram", ours, None, extras
 
 
+def bench_ragged_stream() -> Tuple[str, float, Optional[float]]:
+    """Ragged-batch eval stream (8 distinct batch sizes, partial tail
+    included) through a BUCKETED five-metric collection: batches are
+    padded to power-of-two buckets with a validity mask, so the stream
+    compiles O(log max_batch) fused programs instead of one per distinct
+    size.  Records the actual compile (trace) count next to steady-state
+    throughput — the compile column is the row's point (each avoided
+    trace is ~15 s through a remote TPU compiler); the reference is torch
+    eager, which retraces nothing but also fuses nothing."""
+    import jax.numpy as jnp
+
+    from torcheval_tpu._stats import trace_counts
+    from torcheval_tpu.metrics import (
+        MetricCollection,
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+
+    c = 100
+    rng = np.random.default_rng(16)
+    # 8 distinct sizes spanning 77..313 (partial tail 77 last): buckets
+    # reached are 128/256/512 — 3 fused programs for 8 shapes.
+    sizes = [160, 96, 224, 130, 313, 200, 256, 77]
+    raw = [
+        (
+            rng.random((b, c), dtype=np.float32),
+            rng.integers(0, c, b).astype(np.int32),
+        )
+        for b in sizes
+    ]
+    batches = [(jnp.asarray(s), jnp.asarray(t)) for s, t in raw]
+
+    col = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=c, average="macro"),
+            "f1": MulticlassF1Score(num_classes=c, average="macro"),
+            "cm": MulticlassConfusionMatrix(num_classes=c),
+            "prec": MulticlassPrecision(num_classes=c, average="macro"),
+            "rec": MulticlassRecall(num_classes=c, average="macro"),
+        },
+        bucket=True,
+    )
+
+    before = trace_counts().get("fused_collection", 0)
+
+    def step():
+        col.reset()
+        for args in batches:
+            col.fused_update(*args)
+        _force(col.compute())
+
+    n = sum(sizes)
+    sec = _time_steps(step)  # first (warm) pass pays every compile
+    ours = n / sec
+    compile_count = trace_counts().get("fused_collection", 0) - before
+
+    ref = None
+    try:
+        ref_metrics = _reference()
+        refs = [
+            ref_metrics.MulticlassAccuracy(num_classes=c, average="macro"),
+            ref_metrics.MulticlassF1Score(num_classes=c, average="macro"),
+            ref_metrics.MulticlassConfusionMatrix(num_classes=c),
+            ref_metrics.MulticlassPrecision(num_classes=c, average="macro"),
+            ref_metrics.MulticlassRecall(num_classes=c, average="macro"),
+        ]
+        import torch
+
+        rbatches = [
+            (torch.from_numpy(s.copy()), torch.from_numpy(t.copy()).long())
+            for s, t in raw
+        ]
+
+        def rstep():
+            for m in refs:
+                m.reset()
+            for args in rbatches:
+                for m in refs:
+                    m.update(*args)
+            for m in refs:
+                _force(m.compute())
+
+        ref = n / _time_steps(rstep, repeats=2)
+    except Exception as exc:  # pragma: no cover
+        print(f"reference unavailable: {exc}", file=sys.stderr)
+
+    extras = {
+        "compile_count": compile_count,
+        "distinct_batch_sizes": len(set(sizes)),
+        "steady_state_ms_per_stream": round(sec * 1e3, 3),
+        "roofline_note": "compile column is the point: 8 ragged shapes "
+        "reach 3 power-of-two buckets, so steady state retraces nothing",
+    }
+    return "collection_ragged_bucketed_stream", ours, ref, extras
+
+
 ALL_WORKLOADS = [
     bench_accuracy,
     bench_binary_auroc,
@@ -1085,6 +1184,7 @@ ALL_WORKLOADS = [
     bench_sharded_multiclass_exact,
     bench_binned_auroc,
     bench_collection_fused,
+    bench_ragged_stream,
     bench_perplexity,
     bench_windowed_auroc,
     bench_weighted_histogram,

@@ -1,0 +1,59 @@
+"""The shared sharded-program memoizer's introspection hooks
+(``spmd_cache_info`` / ``spmd_cache_clear``): a second identical
+shard_map call must be a cache hit, and the counters surface through
+``routing.hot_path_stats``."""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.parallel import (
+    make_mesh,
+    shard_batch,
+    sharded_auroc_histogram,
+    spmd_cache_clear,
+    spmd_cache_info,
+)
+
+
+class TestSpmdCacheInfo(unittest.TestCase):
+    def test_second_identical_call_is_a_hit(self):
+        if len(jax.devices()) < 8:
+            self.skipTest("needs the 8-device CPU mesh from conftest")
+        mesh = make_mesh(8)
+        rng = np.random.default_rng(0)
+        scores = jnp.asarray(rng.random(256, dtype=np.float32))
+        target = jnp.asarray((rng.random(256) > 0.5).astype(np.float32))
+        s, t = shard_batch(mesh, scores, target)
+
+        spmd_cache_clear()
+        base = spmd_cache_info()
+        self.assertEqual((base.hits, base.misses, base.currsize), (0, 0, 0))
+
+        first = float(sharded_auroc_histogram(s, t, mesh))
+        after_first = spmd_cache_info()
+        self.assertEqual(after_first.misses, 1)  # program built once
+        self.assertEqual(after_first.currsize, 1)
+
+        second = float(sharded_auroc_histogram(s, t, mesh))
+        after_second = spmd_cache_info()
+        self.assertEqual(after_second.misses, after_first.misses)  # no rebuild
+        self.assertGreater(after_second.hits, after_first.hits)
+        self.assertEqual(first, second)
+
+    def test_hot_path_stats_surfaces_counters(self):
+        from torcheval_tpu.routing import hot_path_stats
+
+        stats = hot_path_stats()
+        self.assertIn("trace_counts", stats)
+        self.assertEqual(
+            set(stats["spmd_cache"]), {"hits", "misses", "maxsize", "currsize"}
+        )
+        info = spmd_cache_info()
+        self.assertEqual(stats["spmd_cache"]["currsize"], info.currsize)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -48,6 +48,7 @@ class TestShardedBinaryExact(unittest.TestCase):
         self.mesh = make_mesh()
 
     @pytest.mark.big
+    @pytest.mark.slow
     def test_bitwise_headline_scale(self):
         # 2^22 samples with heavy ties: the VERDICT "done" criterion.
         s, t = _binary_data(2**22, tie_levels=1024)
@@ -227,6 +228,7 @@ class TestShardedBinaryExact(unittest.TestCase):
             )
 
     @pytest.mark.big
+    @pytest.mark.slow
     def test_auprc_ustat_headline_scale(self):
         # 2^22 samples incl. a tie grid: the VERDICT "done" criterion for
         # exact distributed AUPRC without O(N) wire.
@@ -288,6 +290,7 @@ class TestShardedMulticlassExact(unittest.TestCase):
                 np.asarray(got), np.asarray(want), rtol=2e-6, atol=2e-6
             )
 
+    @pytest.mark.slow
     def test_ring_comm_bitwise_equals_gather(self):
         # The ring-overlap schedule (comm="ring") must reproduce the
         # gathered-table result BITWISE for both local-count formulations
@@ -388,6 +391,7 @@ class TestShardedMulticlassExact(unittest.TestCase):
                 scores, targets, self.mesh, num_classes=4, comm="tree"
             )
 
+    @pytest.mark.slow
     def test_ring_gather_fuzz(self):
         # Randomized shapes/skews/caps: the ring and gathered schedules
         # must stay bitwise-equal (AUROC families) across the space, not

@@ -11,6 +11,7 @@ import numpy as np
 from sklearn.metrics import roc_auc_score
 
 from torcheval_tpu.metrics import BinaryAUROC, MulticlassAccuracy
+from torcheval_tpu.parallel._compat import shard_map
 from torcheval_tpu.metrics.functional.classification.accuracy import (
     _multiclass_accuracy_update_kernel,
 )
@@ -124,7 +125,7 @@ class TestMakeSyncedUpdate(unittest.TestCase):
             return mesh_merge_states({"n": x.sum()}, "dp")
 
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 local,
                 mesh=mesh,
                 in_specs=PartitionSpec("dp"),

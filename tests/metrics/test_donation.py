@@ -1,0 +1,168 @@
+"""Buffer donation on the update hot paths (``TORCHEVAL_TPU_DONATE``):
+donation must actually alias (old buffers deleted), yet stay
+semantically invisible — aborted fused updates restore readable states,
+checkpoints round-trip, reset works after donated updates, and windowed
+metrics keep their numbers."""
+
+import os
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    WindowedClickThroughRate,
+)
+
+
+def _data(seed=0, n=64, c=5):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.random((n, c)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, c, n).astype(np.int32)),
+    )
+
+
+class DonationEnvCase(unittest.TestCase):
+    """Force donation ON for the test body, restore the env after."""
+
+    def setUp(self):
+        self._prev = os.environ.get("TORCHEVAL_TPU_DONATE")
+        os.environ["TORCHEVAL_TPU_DONATE"] = "1"
+
+    def tearDown(self):
+        if self._prev is None:
+            os.environ.pop("TORCHEVAL_TPU_DONATE", None)
+        else:
+            os.environ["TORCHEVAL_TPU_DONATE"] = self._prev
+
+
+class TestDonationActive(DonationEnvCase):
+    def test_per_metric_update_donates_state(self):
+        m = MulticlassAccuracy(num_classes=5)
+        m.update(*_data(0))  # states now come from the donated program
+        old = m.num_correct
+        m.update(*_data(1))
+        self.assertTrue(old.is_deleted())  # the buffer was really aliased
+        self.assertFalse(m.num_correct.is_deleted())
+        self.assertGreater(float(m.compute()), 0.0)
+
+    def test_fused_collection_donates_state(self):
+        col = MetricCollection({"acc": MulticlassAccuracy(num_classes=5)})
+        col.fused_update(*_data(0))
+        old = col["acc"].num_correct
+        col.fused_update(*_data(1))
+        self.assertTrue(old.is_deleted())
+        self.assertFalse(col["acc"].num_correct.is_deleted())
+
+    def test_reset_after_donated_updates(self):
+        m = MulticlassAccuracy(num_classes=5)
+        m.update(*_data(0))
+        m.update(*_data(1))
+        m.reset()
+        self.assertEqual(float(np.asarray(m.num_total)), 0.0)
+        m.update(*_data(2))  # defaults were not donated away
+        m.reset()
+        m.update(*_data(3))
+        self.assertGreater(float(np.asarray(m.num_total)), 0.0)
+
+
+class TestAbortRestore(DonationEnvCase):
+    """ISSUE 1 satellite 4: an exception mid-fused_update must leave
+    every member state concrete and readable (never a tracer, never a
+    deleted donated buffer)."""
+
+    def _collection(self):
+        return MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=5),
+                "cm": MulticlassConfusionMatrix(num_classes=5),
+            }
+        )
+
+    def test_failed_update_restores_states(self):
+        col = self._collection()
+        s, t = _data(0)
+        col.fused_update(s, t)
+        want = {
+            k: np.asarray(v) for k, v in col.state_dict().items()
+        }
+        with self.assertRaises(Exception):
+            # rank-3 scores fail shape handling inside the traced kernels
+            col.fused_update(jnp.zeros((4, 5, 2)), jnp.zeros(4, jnp.int32))
+        for k, v in col.state_dict().items():
+            arr = np.asarray(v)  # readable: concrete, not deleted
+            np.testing.assert_array_equal(arr, want[k])
+        # and the collection still works afterwards
+        col.fused_update(*_data(1))
+        self.assertGreater(float(np.asarray(col.compute()["acc"])), 0.0)
+
+    def test_guarded_install_replaces_deleted_snapshot(self):
+        # Simulate the donated-then-aborted corner directly: a snapshot
+        # entry whose buffer was already consumed falls back to a fresh
+        # default instead of installing a dead array.
+        col = self._collection()
+        col.fused_update(*_data(0))
+        states = col._read_states()
+        states["acc"]["num_correct"].delete()
+        col._install_states(states, guard_deleted=True)
+        arr = np.asarray(col["acc"].num_correct)  # readable
+        np.testing.assert_array_equal(arr, 0)
+        col.fused_update(*_data(1))  # lifecycle continues
+
+
+class TestDonatedCheckpointRoundTrip(DonationEnvCase):
+    def test_state_dict_survives_later_donated_updates(self):
+        m = MulticlassAccuracy(num_classes=5)
+        m.update(*_data(0))
+        snap = m.state_dict()
+        at_snap = float(m.compute())
+        m.update(*_data(1))  # donated update must not eat the snapshot
+        m.update(*_data(2))
+        for v in snap.values():
+            self.assertFalse(v.is_deleted())
+        m2 = MulticlassAccuracy(num_classes=5)
+        m2.load_state_dict(snap)
+        self.assertEqual(float(m2.compute()), at_snap)
+        # the restored metric keeps updating under donation
+        m2.update(*_data(1))
+        m2.update(*_data(2))
+        self.assertEqual(float(m2.compute()), float(m.compute()))
+
+    def test_collection_round_trip(self):
+        col = MetricCollection({"acc": MulticlassAccuracy(num_classes=5)})
+        col.fused_update(*_data(0))
+        snap = col.state_dict()
+        col.fused_update(*_data(1))
+        col2 = MetricCollection({"acc": MulticlassAccuracy(num_classes=5)})
+        col2.load_state_dict(snap)
+        col2.fused_update(*_data(1))
+        np.testing.assert_array_equal(
+            np.asarray(col2.compute()["acc"]), np.asarray(col.compute()["acc"])
+        )
+
+
+class TestWindowedDonation(DonationEnvCase):
+    def test_windowed_ctr_matches_undonated(self):
+        rng = np.random.default_rng(7)
+        batches = [
+            jnp.asarray((rng.random(32) > 0.6).astype(np.float32))
+            for _ in range(5)
+        ]
+        donated = WindowedClickThroughRate(max_num_updates=3)
+        for b in batches:
+            donated.update(b)
+        val_donated = np.asarray(donated.compute())
+        os.environ["TORCHEVAL_TPU_DONATE"] = "0"
+        plain = WindowedClickThroughRate(max_num_updates=3)
+        for b in batches:
+            plain.update(b)
+        np.testing.assert_array_equal(val_donated, np.asarray(plain.compute()))
+
+
+if __name__ == "__main__":
+    unittest.main()

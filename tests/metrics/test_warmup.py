@@ -1,0 +1,94 @@
+"""AOT warmup (``torcheval_tpu.aot``): pre-compiling every reachable
+bucket shape makes a later ragged stream trace-free, warmup leaves metric
+values untouched, and the ``TORCHEVAL_TPU_CACHE_DIR`` flag wires JAX's
+persistent compile cache."""
+
+import os
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu import aot
+from torcheval_tpu._stats import trace_counts
+from torcheval_tpu.metrics import (
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+)
+
+
+def _data(seed, n, c=5):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.random((n, c)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, c, n).astype(np.int32)),
+    )
+
+
+class TestWarmup(unittest.TestCase):
+    def _collection(self):
+        return MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=5, average="macro"),
+                "f1": MulticlassF1Score(num_classes=5, average="macro"),
+            },
+            bucket=True,
+        )
+
+    def test_warmed_ragged_stream_traces_nothing(self):
+        col = self._collection()
+        warmed = aot.warmup(col, _data(0, 64), max_batch=300)
+        # the sweep is exactly the reachable buckets, O(log max_batch)
+        self.assertEqual(warmed, aot.bucket_sizes(300))
+        # warmup is invisible to the metric values
+        self.assertEqual(float(np.asarray(col["acc"].num_total).sum()), 0.0)
+        before = trace_counts()
+        for i, n in enumerate([33, 64, 100, 129, 300, 7]):
+            col.fused_update(*_data(i + 1, n))
+        self.assertEqual(trace_counts(), before)  # zero additional traces
+        self.assertGreater(float(np.asarray(col.compute()["acc"])), 0.0)
+
+    def test_explicit_sizes_and_plain_metric_state_restore(self):
+        m = MulticlassAccuracy(num_classes=5)
+        m.update(*_data(0, 32))
+        want = float(m.compute())
+        warmed = aot.warmup(m, _data(1, 16), sizes=[16, 48])
+        self.assertEqual(warmed, (16, 48))
+        self.assertEqual(float(m.compute()), want)
+
+    def test_empty_example_batch_raises(self):
+        with self.assertRaises(ValueError):
+            aot.warmup(self._collection(), ())
+
+
+class TestPersistentCacheFlag(unittest.TestCase):
+    def test_configure_persistent_cache(self):
+        import tempfile
+
+        from torcheval_tpu.ops._flags import configure_persistent_cache
+
+        prev_env = os.environ.get("TORCHEVAL_TPU_CACHE_DIR")
+        prev_dir = jax.config.jax_compilation_cache_dir
+        prev_secs = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            with tempfile.TemporaryDirectory() as tmp:
+                os.environ["TORCHEVAL_TPU_CACHE_DIR"] = tmp
+                self.assertEqual(configure_persistent_cache(), tmp)
+                self.assertEqual(jax.config.jax_compilation_cache_dir, tmp)
+            os.environ.pop("TORCHEVAL_TPU_CACHE_DIR", None)
+            self.assertIsNone(configure_persistent_cache())
+        finally:
+            if prev_env is None:
+                os.environ.pop("TORCHEVAL_TPU_CACHE_DIR", None)
+            else:
+                os.environ["TORCHEVAL_TPU_CACHE_DIR"] = prev_env
+            jax.config.update("jax_compilation_cache_dir", prev_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", prev_secs
+            )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,11 +1,18 @@
 """torcheval_tpu — a TPU-native (JAX/XLA/Pallas) model-metrics framework.
 
 Capability parity target: torcheval v0.0.4 (see /root/reference, SURVEY.md).
-Top-level exports mirror the reference's `torcheval/__init__.py:10-16`:
-only ``metrics``, ``tools`` and ``__version__``.
+Top-level exports mirror the reference's `torcheval/__init__.py:10-16`
+(``metrics``, ``tools``, ``__version__``) plus :mod:`torcheval_tpu.aot`
+— the hot-path warmup/instrumentation layer with no reference analog.
 """
 
-from torcheval_tpu import metrics, tools
+# Before anything builds a jit program: TORCHEVAL_TPU_CACHE_DIR opts this
+# process into JAX's persistent compile cache (no-op when unset).
+from torcheval_tpu.ops._flags import configure_persistent_cache as _cfg_cache
+
+_cfg_cache()
+
+from torcheval_tpu import aot, metrics, tools
 from torcheval_tpu.version import __version__
 
-__all__ = ["metrics", "tools", "__version__"]
+__all__ = ["aot", "metrics", "tools", "__version__"]

@@ -1,0 +1,42 @@
+"""Hot-path instrumentation: a process-wide trace counter.
+
+Every retrace of an update-path program costs a compile — through a
+remote compiler, ~15 s/call (``parallel/_compile_cache.py``'s own
+measurement).  The counters here are bumped INSIDE the Python bodies of
+the jitted update programs, which only run at trace time, so the count
+is exactly "how many distinct update programs were built this process".
+``aot.warmup`` uses the delta to assert its zero-additional-traces
+contract, and ``routing.hot_path_stats`` surfaces it to users.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+_trace_counts: Dict[str, int] = {}
+
+
+def bump_trace(kind: str) -> None:
+    """Record one trace of the ``kind`` update program.  Call this from
+    inside a jitted function body — the body runs once per (shape,
+    statics) cache entry, never on cache hits."""
+    _trace_counts[kind] = _trace_counts.get(kind, 0) + 1
+
+
+def trace_count(kind: Optional[str] = None) -> int:
+    """Traces recorded since process start (or the last reset): one
+    ``kind`` or the total across all kinds."""
+    if kind is not None:
+        return _trace_counts.get(kind, 0)
+    return sum(_trace_counts.values())
+
+
+def trace_counts() -> Dict[str, int]:
+    """Per-kind snapshot (copy; safe to hold)."""
+    return dict(_trace_counts)
+
+
+def reset_trace_count() -> None:
+    """Zero every counter (test/benchmark hook).  Does NOT clear any jit
+    cache — an already-compiled shape still won't re-trace."""
+    _trace_counts.clear()

@@ -1,0 +1,114 @@
+"""Ahead-of-time warmup for the metric update hot path.
+
+A jit-cached update program is only free after its first call; with M
+distinct batch shapes in an evaluation stream, the first pass through the
+data pays M traces + compiles (~15 s each through a remote TPU
+compiler).  Bucketing (``metrics/_bucket.py``) shrinks M to
+O(log max_batch) — :func:`warmup` then moves even those compiles off the
+measured path by replaying a representative batch through every
+reachable bucket size before the real stream starts.
+
+Pairs with ``TORCHEVAL_TPU_CACHE_DIR``
+(:func:`torcheval_tpu.ops._flags.configure_persistent_cache`): warmed
+programs land in the persistent compile cache, so the NEXT process skips
+the compiles entirely.
+
+Trace/compile accounting lives in :mod:`torcheval_tpu._stats` —
+:func:`trace_count` after a warmed stream shows zero additional update
+traces.
+"""
+
+from typing import Any, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from torcheval_tpu._stats import (  # noqa: F401  (re-exported)
+    reset_trace_count,
+    trace_count,
+    trace_counts,
+)
+from torcheval_tpu.metrics._bucket import bucket_sizes
+
+__all__ = [
+    "warmup",
+    "trace_count",
+    "trace_counts",
+    "reset_trace_count",
+    "bucket_sizes",
+]
+
+
+def _tile_to(a: np.ndarray, n: int) -> np.ndarray:
+    """A length-``n`` batch with the same trailing shape/dtype as ``a``,
+    cycling ``a``'s rows (values are irrelevant for compilation; cycling
+    real rows keeps class indices in their valid range for the host-side
+    value checks on the update path)."""
+    if a.shape[0] == n:
+        return a
+    reps = -(-n // max(a.shape[0], 1))
+    return np.concatenate([a] * reps, axis=0)[:n]
+
+
+def warmup(
+    metric_or_collection: Any,
+    example_batch: Sequence[Any],
+    *,
+    max_batch: Optional[int] = None,
+    sizes: Optional[Iterable[int]] = None,
+    fused: Optional[bool] = None,
+) -> Tuple[int, ...]:
+    """Pre-compile every update program a ragged evaluation stream can
+    reach, so the stream itself runs trace-free.
+
+    ``example_batch`` is one representative update's positional args
+    (e.g. ``(input, target)``); its leading dim seeds the size sweep.
+    ``max_batch`` extends the sweep to the largest batch the stream will
+    produce (default: the example's size); ``sizes`` overrides the sweep
+    entirely with explicit batch sizes.  For a bucketed
+    ``MetricCollection`` the swept sizes are the reachable bucket sizes
+    — O(log max_batch) of them — and each warmed program is exactly the
+    masked program later updates dispatch to.  ``fused`` picks the entry
+    point for collections (default: ``fused_update`` when its members
+    allow it); plain metrics always warm ``update``.
+
+    State is snapshotted before and restored after (checkpoint
+    round-trip), so warmup is invisible to the metric values.  Returns
+    the tuple of batch sizes actually warmed.
+    """
+    from torcheval_tpu.metrics.collection import MetricCollection
+
+    obj = metric_or_collection
+    arrays = [np.asarray(a) for a in example_batch]
+    if not arrays:
+        raise ValueError("example_batch must contain at least one array.")
+    n = arrays[0].shape[0]
+    top = int(max_batch) if max_batch is not None else n
+
+    is_collection = isinstance(obj, MetricCollection)
+    if sizes is not None:
+        sweep = tuple(int(s) for s in sizes)
+    elif is_collection and obj._bucket:
+        sweep = bucket_sizes(top, min_bucket=obj._min_bucket)
+    else:
+        sweep = (top,)
+
+    if is_collection:
+        if fused is None:
+            try:
+                obj._check_fusable()
+                fused = True
+            except ValueError:
+                fused = False
+        entry = obj.fused_update if fused else obj.update
+    else:
+        entry = obj.update
+
+    # state_dict() hands back fresh, never-donated copies (metric.py), so
+    # the snapshot survives donated warmup updates untouched.
+    snapshot = obj.state_dict()
+    try:
+        for b in sweep:
+            entry(*(_tile_to(a, b) for a in arrays))
+    finally:
+        obj.load_state_dict(snapshot)
+    return tuple(sweep)

@@ -136,7 +136,16 @@ def initialize_multihost(
     """
     import jax
 
-    if not jax.distributed.is_initialized():
+    def _already_initialized() -> bool:
+        # jax.distributed.is_initialized landed after 0.4.x; older
+        # runtimes expose the same fact through the global client handle.
+        if hasattr(jax.distributed, "is_initialized"):
+            return jax.distributed.is_initialized()
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+
+    if not _already_initialized():
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -171,8 +180,21 @@ class JaxProcessGroup(CollectiveGroup):
         return self._jax.process_count()
 
     def all_gather_bytes(self, payload: bytes) -> List[bytes]:
+        import jax
         from jax.experimental import multihost_utils
 
+        client = self._kv_client()
+        if (
+            client is not None
+            and self.world_size > 1
+            and jax.default_backend() == "cpu"
+        ):
+            # Older CPU runtimes reject multiprocess array collectives
+            # ("Multiprocess computations aren't implemented on the CPU
+            # backend"); ride the coordination service's KV wire instead —
+            # same chunked-b64 scheme as gather_object, every rank reading
+            # every peer.
+            return self._kv_all_gather_bytes(client, payload)
         data = np.frombuffer(payload, dtype=np.uint8)
         lengths = multihost_utils.process_allgather(
             np.asarray([data.size], dtype=np.int64)
@@ -180,10 +202,57 @@ class JaxProcessGroup(CollectiveGroup):
         max_len = int(lengths.max())
         padded = np.zeros(max_len, dtype=np.uint8)
         padded[: data.size] = data
-        gathered = np.asarray(multihost_utils.process_allgather(padded))
+        # Older jax returns the gather flat (no leading process axis for a
+        # single process, tiled for several); normalize to (world, max_len).
+        gathered = np.asarray(multihost_utils.process_allgather(padded)).reshape(
+            self.world_size, max_len
+        )
         return [
             gathered[i, : int(lengths[i])].tobytes() for i in range(self.world_size)
         ]
+
+    def _kv_all_gather_bytes(self, client, payload: bytes) -> List[bytes]:
+        import base64
+
+        gen = JaxProcessGroup._gather_gen
+        JaxProcessGroup._gather_gen += 1
+        prefix = f"torcheval_tpu/allgather/{gen}"
+        rank, world = self.rank, self.world_size
+        chunks = [
+            payload[i : i + self._KV_CHUNK]
+            for i in range(0, max(len(payload), 1), self._KV_CHUNK)
+        ]
+        for i, chunk in enumerate(chunks):
+            client.key_value_set(
+                f"{prefix}/{rank}/{i}",
+                base64.b64encode(chunk).decode("ascii"),
+            )
+        client.key_value_set(f"{prefix}/{rank}/n", str(len(chunks)))
+        out: List[bytes] = []
+        for peer in range(world):
+            if peer == rank:
+                out.append(payload)
+                continue
+            n = int(
+                client.blocking_key_value_get(
+                    f"{prefix}/{peer}/n", _KV_TIMEOUT_MS
+                )
+            )
+            out.append(
+                b"".join(
+                    base64.b64decode(
+                        client.blocking_key_value_get(
+                            f"{prefix}/{peer}/{i}", _KV_TIMEOUT_MS
+                        )
+                    )
+                    for i in range(n)
+                )
+            )
+        # Every rank has read every peer once it reaches the barrier; each
+        # then deletes its own keys (deleting earlier would race readers).
+        client.wait_at_barrier(f"{prefix}-done", _KV_TIMEOUT_MS)
+        client.key_value_delete(f"{prefix}/{rank}/")
+        return out
 
     def all_gather_object(self, obj: Any) -> List[Any]:
         payloads = self.all_gather_bytes(pickle.dumps(obj))

@@ -1,0 +1,115 @@
+"""Shape bucketing for ragged update streams.
+
+``jax.jit`` caches by input shape, so a stream of M distinct batch sizes
+retraces — and, through a remote compiler, recompiles — every metric
+program M times.  Padding each batch up to the next power-of-two bucket
+caps the distinct shapes at O(log max_batch), and a validity mask keeps
+the padded rows out of every count: weighted kernels take the mask as a
+zero weight for free, and the unweighted counter kernels (accuracy,
+confusion-matrix slab, binned counters, F1/precision/recall trio) have a
+mask-aware path that multiplies each row's contribution by its mask bit.
+
+Padded rows EDGE-REPLICATE the last valid row rather than zero-fill, so
+class indices stay in range for the host-side validation the update
+paths run before dispatch (a zero-filled score row would also be fine,
+but a replicated row is valid by construction for every input flavor).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Floor for bucket sizes: batches below this all share one shape, so a
+# stream of tiny ragged batches costs ONE compile, not log2(spread).
+DEFAULT_MIN_BUCKET = 128
+
+
+def bucket_size(
+    n: int, *, min_bucket: int = DEFAULT_MIN_BUCKET, multiple_of: int = 1
+) -> int:
+    """The padded batch size for a raw batch of ``n`` rows: the next
+    power of two, floored at ``min_bucket``, then rounded up to a
+    multiple of ``multiple_of`` (for sharding over a mesh axis whose
+    size is not a power of two)."""
+    if n < 0:
+        raise ValueError(f"batch size must be non-negative, got {n}")
+    b = max(int(min_bucket), 1)
+    while b < n:
+        b *= 2
+    if multiple_of > 1:
+        b += (-b) % multiple_of
+    return b
+
+
+def bucket_sizes(
+    max_batch: int, *, min_bucket: int = DEFAULT_MIN_BUCKET, multiple_of: int = 1
+) -> Tuple[int, ...]:
+    """Every bucket a stream with batches in ``[0, max_batch]`` can land
+    in — the shapes ``aot.warmup`` pre-compiles.  Length is
+    O(log2(max_batch / min_bucket) + 1)."""
+    sizes = []
+    b = bucket_size(0, min_bucket=min_bucket, multiple_of=multiple_of)
+    top = bucket_size(max_batch, min_bucket=min_bucket, multiple_of=multiple_of)
+    while True:
+        sizes.append(b)
+        if b >= top:
+            return tuple(sizes)
+        b = bucket_size(b + 1, min_bucket=min_bucket, multiple_of=multiple_of)
+
+
+def pad_to_bucket(
+    *arrays,
+    mask: Optional[jax.Array] = None,
+    min_bucket: int = DEFAULT_MIN_BUCKET,
+    multiple_of: int = 1,
+) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
+    """Pad every array's leading (batch) dim up to its power-of-two
+    bucket; return ``(padded_arrays, mask)`` where ``mask`` is int32
+    ``(bucket,)`` with 1 for real rows and 0 for padding.
+
+    Padded rows edge-replicate the last valid row (see module
+    docstring).  An incoming ``mask`` (already-masked data being
+    re-bucketed) is padded with zeros and combined.  All arrays must
+    share the same leading dim.  Empty batches pad against zeros.
+    """
+    if not arrays:
+        raise ValueError("pad_to_bucket needs at least one array")
+    arrays = tuple(jnp.asarray(a) for a in arrays)
+    n = arrays[0].shape[0]
+    for a in arrays[1:]:
+        if a.shape[0] != n:
+            raise ValueError(
+                "pad_to_bucket requires a shared leading dim, got "
+                f"{[a.shape for a in arrays]}."
+            )
+    if mask is not None:
+        mask = jnp.asarray(mask)
+        if mask.shape != (n,):
+            raise ValueError(
+                f"mask must have shape ({n},), got {mask.shape}."
+            )
+    bucket = bucket_size(n, min_bucket=min_bucket, multiple_of=multiple_of)
+    pad = bucket - n
+    if pad == 0:
+        out_mask = (
+            mask.astype(jnp.int32)
+            if mask is not None
+            else jnp.ones(n, jnp.int32)
+        )
+        return arrays, out_mask
+    padded = []
+    for a in arrays:
+        if n == 0:
+            fill = jnp.zeros((pad,) + a.shape[1:], a.dtype)
+            padded.append(fill)
+            continue
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        padded.append(jnp.pad(a, widths, mode="edge"))
+    valid = (
+        mask.astype(jnp.int32) if mask is not None else jnp.ones(n, jnp.int32)
+    )
+    out_mask = jnp.concatenate([valid, jnp.zeros(pad, jnp.int32)])
+    return tuple(padded), out_mask

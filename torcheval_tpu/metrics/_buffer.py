@@ -150,6 +150,9 @@ def _windowed_pair_update_fused_impl(
     """Two-statistic kernel + window-column writes (+ lifetime adds) in ONE
     dispatch — the fused update shared by every two-sum windowed metric
     (CTR, weighted calibration, MSE)."""
+    from torcheval_tpu._stats import bump_trace
+
+    bump_trace("windowed")
     a, b = kernel(*args)
     w_a = w_a.at[:, col].set(jnp.atleast_1d(a))
     w_b = w_b.at[:, col].set(b)
@@ -160,6 +163,16 @@ def _windowed_pair_update_fused_impl(
 
 _windowed_pair_update_fused = jax.jit(
     _windowed_pair_update_fused_impl, static_argnames=("kernel", "lifetime")
+)
+# Donated variant: the ring windows (and lifetime sums, when enabled) are
+# the library's largest states (1M-capacity windowed AUROC); in-place
+# aliasing halves their update HBM traffic and peak memory.  The caller
+# must pass FRESH lifetime placeholders when lifetime is off — donating
+# the module-level ``_EMPTY`` would delete it for every later caller.
+_windowed_pair_update_fused_donated = jax.jit(
+    _windowed_pair_update_fused_impl,
+    static_argnames=("kernel", "lifetime"),
+    donate_argnums=(0, 1, 2, 3),
 )
 
 
@@ -223,14 +236,25 @@ class WindowedLifetimeMixin(RingWindowMixin):
 
     def _update_windowed_pair(self, kernel, args) -> None:
         """Run the fused two-statistic update and advance the window."""
+        from torcheval_tpu.ops._flags import donation_enabled
+
+        donate = donation_enabled()
+        fn = (
+            _windowed_pair_update_fused_donated
+            if donate
+            else _windowed_pair_update_fused
+        )
         wa, wb = self._window_states
         la, lb = self._fused_lifetime
-        lifetime_in = (
-            (getattr(self, la), getattr(self, lb))
-            if self.enable_lifetime
-            else (_EMPTY, _EMPTY)
-        )
-        new_wa, new_wb, a, b = _windowed_pair_update_fused(
+        if self.enable_lifetime:
+            lifetime_in = (getattr(self, la), getattr(self, lb))
+        elif donate:
+            # Fresh zero-size placeholders: the donated variant deletes
+            # its lifetime operands, and _EMPTY is a shared module global.
+            lifetime_in = (jnp.zeros(0, jnp.float32), jnp.zeros(0, jnp.float32))
+        else:
+            lifetime_in = (_EMPTY, _EMPTY)
+        new_wa, new_wb, a, b = fn(
             getattr(self, wa),
             getattr(self, wb),
             *lifetime_in,

@@ -11,6 +11,18 @@ the per-update cost becomes a single dispatch regardless of how many states
 the metric owns.  Input validation stays on the host, before the call (it
 must raise eagerly — reference semantics, e.g. reference
 ``torcheval/metrics/functional/classification/confusion_matrix.py:245-280``).
+
+Hot-path extensions (see ``_bucket.py`` / ``ops/_flags.py``):
+
+* ``mask=`` threads a ragged-batch validity mask into mask-aware kernels
+  (it rides as a trailing ``mask=`` keyword after the statics), so padded
+  bucket rows add exact zeros to every counter.
+* When :func:`torcheval_tpu.ops._flags.donation_enabled`, the state
+  operand is donated (``donate_argnums=(0,)``): XLA aliases old→new state
+  in place, halving HBM traffic on the add and peak memory for large
+  states.  The caller's old state arrays are DELETED after execution —
+  the metric base class copies registry defaults and checkpoint
+  snapshots so no live reference ever dangles.
 """
 
 from functools import partial
@@ -18,10 +30,15 @@ from typing import Tuple
 
 import jax
 
+from torcheval_tpu._stats import bump_trace
 
-@partial(jax.jit, static_argnames=("kernel", "statics", "grow", "fold"))
-def _accumulate_jit(states, args, kernel, statics, grow, fold):
-    deltas = kernel(*args, *statics)
+
+def _accumulate_impl(states, args, kernel, statics, grow, fold, mask=None):
+    bump_trace("accumulate")
+    if mask is None:
+        deltas = kernel(*args, *statics)
+    else:
+        deltas = kernel(*args, *statics, mask=mask)
     if not isinstance(deltas, tuple):
         deltas = (deltas,)
     out = []
@@ -37,6 +54,16 @@ def _accumulate_jit(states, args, kernel, statics, grow, fold):
     return tuple(out)
 
 
+_accumulate_jit = partial(jax.jit, static_argnames=("kernel", "statics", "grow", "fold"))(
+    _accumulate_impl
+)
+_accumulate_jit_donated = partial(
+    jax.jit,
+    static_argnames=("kernel", "statics", "grow", "fold"),
+    donate_argnums=(0,),
+)(_accumulate_impl)
+
+
 def accumulate(
     kernel,
     states: Tuple[jax.Array, ...],
@@ -44,6 +71,7 @@ def accumulate(
     statics: tuple = (),
     grow: bool = False,
     fold=None,
+    mask=None,
 ) -> Tuple[jax.Array, ...]:
     """Run ``kernel(*args, *statics)`` and fold its delta(s) onto ``states``
     in one fused dispatch.
@@ -56,8 +84,16 @@ def accumulate(
     (``None`` entries mean addition) — give the tuple a stable module-level
     identity, since ``fold`` is part of the jit cache key.  ``grow=True``
     replicates the scalar→vector replace-on-first-2-D-update semantics of
-    per-output regression states.  Returns the new state tuple.
+    per-output regression states.  ``mask`` (a validity array, or ``None``)
+    is forwarded to the kernel as a trailing ``mask=`` keyword — only pass
+    it to mask-aware kernels.  Returns the new state tuple.
+
+    Under :func:`~torcheval_tpu.ops._flags.donation_enabled` the ``states``
+    buffers are donated to XLA and unusable afterwards; callers must (and
+    the class metrics do) rebind their state attributes to the return
+    value immediately.
     """
-    return _accumulate_jit(
-        tuple(states), tuple(args), kernel, tuple(statics), grow, fold
-    )
+    from torcheval_tpu.ops._flags import donation_enabled
+
+    fn = _accumulate_jit_donated if donation_enabled() else _accumulate_jit
+    return fn(tuple(states), tuple(args), kernel, tuple(statics), grow, fold, mask)

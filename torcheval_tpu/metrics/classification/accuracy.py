@@ -40,6 +40,9 @@ class MulticlassAccuracy(Metric[jax.Array]):
     Merge: elementwise add.
     """
 
+    # Accepts update(..., mask=) for bucketed ragged batches (_bucket.py).
+    _supports_mask = True
+
     def __init__(
         self,
         *,
@@ -60,7 +63,7 @@ class MulticlassAccuracy(Metric[jax.Array]):
             self._add_state("num_correct", jnp.zeros(num_classes or 0))
             self._add_state("num_total", jnp.zeros(num_classes or 0))
 
-    def update(self, input, target):
+    def update(self, input, target, *, mask=None):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multiclass_accuracy_validate(
             input, target, self.average, self.num_classes, self.k
@@ -72,6 +75,7 @@ class MulticlassAccuracy(Metric[jax.Array]):
             input,
             target,
             statics=(self.average, self.num_classes, self.k),
+            mask=mask,
         )
         return self
 
@@ -104,7 +108,7 @@ class BinaryAccuracy(MulticlassAccuracy):
         super().__init__(device=device)
         self.threshold = threshold
 
-    def update(self, input, target):
+    def update(self, input, target, *, mask=None):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_accuracy_update_input_check(input, target)
         self.num_correct, self.num_total = accumulate(
@@ -113,6 +117,7 @@ class BinaryAccuracy(MulticlassAccuracy):
             input,
             target,
             statics=(self.threshold,),
+            mask=mask,
         )
         return self
 
@@ -133,7 +138,7 @@ class MultilabelAccuracy(MulticlassAccuracy):
         self.threshold = threshold
         self.criteria = criteria
 
-    def update(self, input, target):
+    def update(self, input, target, *, mask=None):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multilabel_accuracy_update_input_check(input, target)
         self.num_correct, self.num_total = accumulate(
@@ -142,6 +147,7 @@ class MultilabelAccuracy(MulticlassAccuracy):
             input,
             target,
             statics=(self.threshold, self.criteria),
+            mask=mask,
         )
         return self
 
@@ -166,7 +172,7 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
         self.criteria = criteria
         self.k = k
 
-    def update(self, input, target):
+    def update(self, input, target, *, mask=None):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _topk_multilabel_accuracy_update_input_check(input, target, self.k)
         self.num_correct, self.num_total = accumulate(
@@ -175,5 +181,6 @@ class TopKMultilabelAccuracy(MulticlassAccuracy):
             input,
             target,
             statics=(self.criteria, self.k),
+            mask=mask,
         )
         return self

@@ -43,14 +43,18 @@ _COUNTS = ("num_tp", "num_fp", "num_pos", "num_total")
 
 
 def _binary_binned_counts_kernel(
-    input: jax.Array, target: jax.Array, threshold: jax.Array, route: str
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    route: str,
+    mask=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     # Runs inside the fused accumulate trace; ``route`` arrives as a
     # call-time static so the formulation choice (and the kill-switch env
     # var) is re-evaluated per update, not frozen at first compile.
     if input.ndim == 1:
         input, target = input[None], target[None]
-    return _binned_counts_rows(input, target == 1, threshold, route=route)
+    return _binned_counts_rows(input, target == 1, threshold, route=route, mask=mask)
 
 
 class _BinnedCountsBase(Metric):
@@ -71,7 +75,7 @@ class _BinnedCountsBase(Metric):
         self._add_state("num_pos", jnp.zeros(num_rows, jnp.int32))
         self._add_state("num_total", jnp.zeros(num_rows, jnp.int32))
 
-    def _accumulate(self, kernel, input, target, statics=()) -> None:
+    def _accumulate(self, kernel, input, target, statics=(), mask=None) -> None:
         # Kernel + all four state adds fused into one dispatch (_fuse.py).
         self.num_tp, self.num_fp, self.num_pos, self.num_total = accumulate(
             kernel,
@@ -80,6 +84,7 @@ class _BinnedCountsBase(Metric):
             target,
             self.threshold,
             statics=statics,
+            mask=mask,
         )
 
     def _row_scores(self) -> jax.Array:
@@ -105,14 +110,15 @@ class _BinaryBinnedAUC(_BinnedCountsBase):
         self.num_tasks = num_tasks
         super().__init__(num_tasks, threshold, device)
 
-    def update(self, input, target):
+    def update(self, input, target, *, mask=None):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_auroc_update_input_check(input, target, self.num_tasks)
         route = _select_binned_route(
             self.num_tasks, input.shape[-1], self.threshold
         )
         self._accumulate(
-            _binary_binned_counts_kernel, input, target, statics=(route,)
+            _binary_binned_counts_kernel, input, target, statics=(route,),
+            mask=mask,
         )
         return self
 
@@ -132,7 +138,7 @@ class _MulticlassBinnedAUC(_BinnedCountsBase):
         self.average = average
         super().__init__(num_classes, threshold, device)
 
-    def update(self, input, target):
+    def update(self, input, target, *, mask=None):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multiclass_binned_auc_validate(input, target, self.num_classes)
         route = _select_binned_route(
@@ -141,6 +147,7 @@ class _MulticlassBinnedAUC(_BinnedCountsBase):
         self._accumulate(
             _multiclass_binned_counts_kernel, input, target,
             statics=(self.num_classes, route),
+            mask=mask,
         )
         return self
 
@@ -158,7 +165,7 @@ class _MultilabelBinned(_BinnedCountsBase):
         self.num_labels = num_labels
         super().__init__(num_labels, threshold, device)
 
-    def update(self, input, target):
+    def update(self, input, target, *, mask=None):
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multilabel_precision_recall_curve_update_input_check(
             input, target, self.num_labels
@@ -167,7 +174,8 @@ class _MultilabelBinned(_BinnedCountsBase):
             self.num_labels, input.shape[0], self.threshold
         )
         self._accumulate(
-            _multilabel_binned_counts_kernel, input, target, statics=(route,)
+            _multilabel_binned_counts_kernel, input, target, statics=(route,),
+            mask=mask,
         )
         return self
 

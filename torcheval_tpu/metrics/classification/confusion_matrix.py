@@ -26,6 +26,9 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
     (reference ``confusion_matrix.py:30-210``); merge: add (reference
     ``:203-209``).  Entry (i, j) counts true class i predicted as j."""
 
+    # Accepts update(..., mask=) for bucketed ragged batches (_bucket.py).
+    _supports_mask = True
+
     def __init__(
         self,
         num_classes: int,
@@ -41,7 +44,7 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
             "confusion_matrix", jnp.zeros((num_classes, num_classes), jnp.int32)
         )
 
-    def update(self, input, target) -> "MulticlassConfusionMatrix":
+    def update(self, input, target, *, mask=None) -> "MulticlassConfusionMatrix":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _confusion_matrix_update_input_check(input, target, self.num_classes)
         # Scatter kernel + state add fused into one dispatch (_fuse.py).
@@ -56,6 +59,7 @@ class MulticlassConfusionMatrix(Metric[jax.Array]):
                 self.num_classes,
                 _cm_route(self.num_classes, input.shape[0]),
             ),
+            mask=mask,
         )
         return self
 
@@ -87,7 +91,7 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
         super().__init__(num_classes=2, normalize=normalize, device=device)
         self.threshold = threshold
 
-    def update(self, input, target) -> "BinaryConfusionMatrix":
+    def update(self, input, target, *, mask=None) -> "BinaryConfusionMatrix":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_confusion_matrix_validate(input, target)
         (self.confusion_matrix,) = accumulate(
@@ -96,5 +100,6 @@ class BinaryConfusionMatrix(MulticlassConfusionMatrix):
             input,
             target,
             statics=(self.threshold, _use_matmul_cm(2, input.shape[0])),
+            mask=mask,
         )
         return self

@@ -29,6 +29,9 @@ class MulticlassF1Score(Metric[jax.Array]):
     micro, per-class vectors otherwise (reference ``f1_score.py:91-114``);
     merge: add (reference ``:149``)."""
 
+    # Accepts update(..., mask=) for bucketed ragged batches (_bucket.py).
+    _supports_mask = True
+
     def __init__(
         self,
         *,
@@ -47,7 +50,7 @@ class MulticlassF1Score(Metric[jax.Array]):
             for name in _STATES:
                 self._add_state(name, jnp.zeros(num_classes))
 
-    def update(self, input, target) -> "MulticlassF1Score":
+    def update(self, input, target, *, mask=None) -> "MulticlassF1Score":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _f1_score_validate(input, target, self.num_classes, self.average)
         # Kernel + all three state adds fused into one dispatch (_fuse.py).
@@ -61,6 +64,7 @@ class MulticlassF1Score(Metric[jax.Array]):
                 self.average,
                 _counts_route(input, self.num_classes, self.average),
             ),
+            mask=mask,
         )
         return self
 
@@ -82,7 +86,7 @@ class BinaryF1Score(MulticlassF1Score):
         super().__init__(average="micro", device=device)
         self.threshold = threshold
 
-    def update(self, input, target) -> "BinaryF1Score":
+    def update(self, input, target, *, mask=None) -> "BinaryF1Score":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_f1_score_update_input_check(input, target)
         self.num_tp, self.num_label, self.num_prediction = accumulate(
@@ -91,5 +95,6 @@ class BinaryF1Score(MulticlassF1Score):
             input,
             target,
             statics=(self.threshold,),
+            mask=mask,
         )
         return self

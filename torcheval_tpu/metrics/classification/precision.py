@@ -29,6 +29,9 @@ class MulticlassPrecision(Metric[jax.Array]):
     per-class vectors otherwise (reference ``precision.py:89-110``); merge:
     add (reference ``:147``)."""
 
+    # Accepts update(..., mask=) for bucketed ragged batches (_bucket.py).
+    _supports_mask = True
+
     def __init__(
         self,
         *,
@@ -47,7 +50,7 @@ class MulticlassPrecision(Metric[jax.Array]):
             for name in _STATES:
                 self._add_state(name, jnp.zeros(num_classes))
 
-    def update(self, input, target) -> "MulticlassPrecision":
+    def update(self, input, target, *, mask=None) -> "MulticlassPrecision":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _precision_validate(input, target, self.num_classes, self.average)
         # Kernel + all three state adds fused into one dispatch (_fuse.py).
@@ -61,6 +64,7 @@ class MulticlassPrecision(Metric[jax.Array]):
                 self.average,
                 _counts_route(input, self.num_classes, self.average),
             ),
+            mask=mask,
         )
         return self
 
@@ -82,7 +86,7 @@ class BinaryPrecision(MulticlassPrecision):
         super().__init__(num_classes=2, device=device)
         self.threshold = threshold
 
-    def update(self, input, target) -> "BinaryPrecision":
+    def update(self, input, target, *, mask=None) -> "BinaryPrecision":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_precision_update_input_check(input, target)
         self.num_tp, self.num_fp, self.num_label = accumulate(
@@ -91,5 +95,6 @@ class BinaryPrecision(MulticlassPrecision):
             input,
             target,
             statics=(self.threshold,),
+            mask=mask,
         )
         return self

@@ -27,13 +27,16 @@ class BinaryRecall(Metric[jax.Array]):
     """States: ``num_tp`` / ``num_true_labels``
     (reference ``recall.py:26-110``); merge: add."""
 
+    # Accepts update(..., mask=) for bucketed ragged batches (_bucket.py).
+    _supports_mask = True
+
     def __init__(self, *, threshold: float = 0.5, device=None) -> None:
         super().__init__(device=device)
         self.threshold = threshold
         self._add_state("num_tp", jnp.asarray(0.0))
         self._add_state("num_true_labels", jnp.asarray(0.0))
 
-    def update(self, input, target) -> "BinaryRecall":
+    def update(self, input, target, *, mask=None) -> "BinaryRecall":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_recall_update_input_check(input, target)
         # Kernel + state adds fused into one dispatch (_fuse.py).
@@ -43,6 +46,7 @@ class BinaryRecall(Metric[jax.Array]):
             input,
             target,
             statics=(self.threshold,),
+            mask=mask,
         )
         return self
 
@@ -57,6 +61,9 @@ class BinaryRecall(Metric[jax.Array]):
 class MulticlassRecall(Metric[jax.Array]):
     """States: ``num_tp`` / ``num_labels`` / ``num_predictions``
     (reference ``recall.py:113-245``); merge: add (reference ``:240``)."""
+
+    # Accepts update(..., mask=) for bucketed ragged batches (_bucket.py).
+    _supports_mask = True
 
     _STATES = ("num_tp", "num_labels", "num_predictions")
 
@@ -78,7 +85,7 @@ class MulticlassRecall(Metric[jax.Array]):
             for name in self._STATES:
                 self._add_state(name, jnp.zeros(num_classes))
 
-    def update(self, input, target) -> "MulticlassRecall":
+    def update(self, input, target, *, mask=None) -> "MulticlassRecall":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _recall_validate(input, target, self.num_classes, self.average)
         self.num_tp, self.num_labels, self.num_predictions = accumulate(
@@ -91,6 +98,7 @@ class MulticlassRecall(Metric[jax.Array]):
                 self.average,
                 _counts_route(input, self.num_classes, self.average),
             ),
+            mask=mask,
         )
         return self
 

@@ -16,7 +16,10 @@ from typing import Any, Dict, Iterable, Iterator, Mapping, Optional, Tuple
 
 import jax
 
-from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu._stats import bump_trace
+from torcheval_tpu.metrics._bucket import DEFAULT_MIN_BUCKET, pad_to_bucket
+from torcheval_tpu.metrics.metric import Metric, _move_state
+from torcheval_tpu.ops import _flags
 
 
 class MetricCollection:
@@ -24,9 +27,27 @@ class MetricCollection:
 
     All members must accept the same ``update(*args, **kwargs)``
     signature (e.g. ``(input, target)`` classification metrics).
+
+    ``bucket=True`` pads every update batch's leading dim up to a
+    power-of-two bucket (``metrics/_bucket.py``) and threads the validity
+    mask into every member — a ragged stream of M distinct batch sizes
+    then costs O(log max_batch) compiled programs instead of M.  Every
+    member must be mask-aware (``Metric._supports_mask``).
+
+    ``donate`` controls buffer donation of the fused-update state operand
+    (``None`` follows :func:`torcheval_tpu.ops._flags.donation_enabled`):
+    XLA aliases old→new member states in place, halving state HBM
+    traffic per batch.
     """
 
-    def __init__(self, metrics: Mapping[str, Metric]) -> None:
+    def __init__(
+        self,
+        metrics: Mapping[str, Metric],
+        *,
+        bucket: bool = False,
+        min_bucket: int = DEFAULT_MIN_BUCKET,
+        donate: Optional[bool] = None,
+    ) -> None:
         if not metrics:
             raise ValueError("MetricCollection requires at least one metric.")
         for name, metric in metrics.items():
@@ -41,8 +62,34 @@ class MetricCollection:
                 raise ValueError(
                     f"Metric names must not contain '/', got {name!r}."
                 )
+            if bucket and not metric._supports_mask:
+                raise ValueError(
+                    f"bucket=True requires mask-aware members; "
+                    f"{name}={type(metric).__name__} does not support "
+                    f"update(..., mask=)."
+                )
         self._metrics: Dict[str, Metric] = dict(metrics)
+        self._bucket = bool(bucket)
+        self._min_bucket = int(min_bucket)
+        self._donate = donate
         self._fused_apply: Optional[Any] = None
+        self._fused_apply_donated: Optional[bool] = None
+
+    def _bucket_args(
+        self, args: Tuple[Any, ...], kwargs: Dict[str, Any]
+    ) -> Tuple[Tuple[Any, ...], Dict[str, Any]]:
+        """Pad positional batch arrays to their bucket and merge the
+        validity mask into ``kwargs`` (combining with a caller-supplied
+        ``mask=`` if present)."""
+        if not self._bucket or not args:
+            return args, kwargs
+        kwargs = dict(kwargs)
+        mask = kwargs.pop("mask", None)
+        args, mask = pad_to_bucket(
+            *args, mask=mask, min_bucket=self._min_bucket
+        )
+        kwargs["mask"] = mask
+        return args, kwargs
 
     # ------------------------------------------------------------- container
     def __getitem__(self, name: str) -> Metric:
@@ -59,6 +106,7 @@ class MetricCollection:
 
     # ------------------------------------------------------------- lifecycle
     def update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
+        args, kwargs = self._bucket_args(args, kwargs)
         for metric in self._metrics.values():
             metric.update(*args, **kwargs)
         return self
@@ -81,10 +129,17 @@ class MetricCollection:
         the functional metrics into a user jit program); shape/parameter
         validation still applies."""
         self._check_fusable()
-        if self._fused_apply is None:
+        args, kwargs = self._bucket_args(args, kwargs)
+        donate = (
+            self._donate
+            if self._donate is not None
+            else _flags.donation_enabled()
+        )
+        if self._fused_apply is None or self._fused_apply_donated != donate:
             metrics = self._metrics
 
             def apply(states, a, kw):
+                bump_trace("fused_collection")
                 for name, m in metrics.items():
                     for s, v in states[name].items():
                         setattr(m, s, v)
@@ -92,14 +147,21 @@ class MetricCollection:
                     m.update(*a, **kw)
                 return self._read_states()
 
-            self._fused_apply = jax.jit(apply)
+            self._fused_apply = jax.jit(
+                apply, donate_argnums=(0,) if donate else ()
+            )
+            self._fused_apply_donated = donate
         before = self._read_states()
         try:
             new_states = self._fused_apply(before, args, kwargs)
         except BaseException:
             # An aborted trace (including KeyboardInterrupt mid-compile)
             # leaves tracer attrs on members; restore the concrete states.
-            self._install_states(before)
+            # Under donation an abort can also land AFTER the donated
+            # buffers were consumed — any deleted snapshot entry falls
+            # back to the member's registered default (a fresh reset
+            # state), keeping every state attribute concrete + readable.
+            self._install_states(before, guard_deleted=True)
             raise
         self._install_states(new_states)
         return self
@@ -127,10 +189,20 @@ class MetricCollection:
             for name, m in self._metrics.items()
         }
 
-    def _install_states(self, states: Dict[str, Dict[str, Any]]) -> None:
+    def _install_states(
+        self, states: Dict[str, Dict[str, Any]], guard_deleted: bool = False
+    ) -> None:
         for name, per_state in states.items():
             m = self._metrics[name]
             for s, v in per_state.items():
+                if (
+                    guard_deleted
+                    and isinstance(v, jax.Array)
+                    and v.is_deleted()
+                ):
+                    v = _move_state(
+                        m._state_name_to_default[s], m._device, fresh=True
+                    )
                 setattr(m, s, v)
 
     def compute(self) -> Dict[str, Any]:

@@ -122,23 +122,36 @@ def _multiclass_accuracy_update_kernel(
     average: Optional[str],
     num_classes: Optional[int],
     k: int,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     if k == 1:
         if input.ndim == 2:
             input = jnp.argmax(input, axis=1)
-        mask = (input == target).astype(jnp.int32)
+        correct = (input == target).astype(jnp.int32)
     else:
         y_score = jnp.take_along_axis(input, target[:, None], axis=-1)
         rank = jnp.sum(input > y_score, axis=-1)
-        mask = (rank < k).astype(jnp.float32)
+        correct = (rank < k).astype(jnp.float32)
 
+    if mask is not None:
+        # Padded rows contribute exact zeros: 0*correct to the numerator,
+        # 0 to every per-class total (scatter-add of a 0 is a no-op).
+        correct = correct * mask.astype(correct.dtype)
     if average == "micro":
-        return mask.sum(), jnp.asarray(target.shape[0])
+        total = (
+            jnp.asarray(target.shape[0])
+            if mask is None
+            else mask.astype(target.dtype).sum()
+        )
+        return correct.sum(), total
 
-    num_correct = jnp.zeros(num_classes, dtype=mask.dtype).at[target].add(mask)
-    num_total = (
-        jnp.zeros(num_classes, dtype=target.dtype).at[target].add(1)
+    num_correct = (
+        jnp.zeros(num_classes, dtype=correct.dtype).at[target].add(correct)
     )
+    ones = (
+        jnp.ones_like(target) if mask is None else mask.astype(target.dtype)
+    )
+    num_total = jnp.zeros(num_classes, dtype=target.dtype).at[target].add(ones)
     return num_correct, num_total
 
 
@@ -195,10 +208,17 @@ def _accuracy_compute(
 
 @partial(jax.jit, static_argnames=("threshold",))
 def _binary_accuracy_update_kernel(
-    input: jax.Array, target: jax.Array, threshold: float
+    input: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     pred = jnp.where(input < threshold, 0, 1)
-    return (pred == target).sum(), jnp.asarray(target.shape[0])
+    correct = (pred == target).astype(jnp.int32)
+    if mask is None:
+        return correct.sum(), jnp.asarray(target.shape[0])
+    m = mask.astype(jnp.int32)
+    return (correct * m).sum(), m.sum()
 
 
 def _binary_accuracy_update(
@@ -213,22 +233,31 @@ def _multilabel_update(
     input: jax.Array,
     target: jax.Array,
     criteria: str = "exact_match",
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Shared top of the multilabel criteria lattice
-    (reference ``accuracy.py:399-432``)."""
-    n = jnp.asarray(target.shape[0])
+    (reference ``accuracy.py:399-432``).  ``mask`` zeroes padded rows'
+    contribution to both counters (hamming counts per-element, so its
+    total is ``mask.sum() * num_labels``)."""
+    if mask is None:
+        n = jnp.asarray(target.shape[0])
+        per_row = jnp.ones(target.shape[0], dtype=jnp.int32)
+    else:
+        per_row = mask.astype(jnp.int32)
+        n = per_row.sum()
     if criteria == "exact_match":
-        return jnp.all(input == target, axis=1).sum(), n
+        return (jnp.all(input == target, axis=1) * per_row).sum(), n
     if criteria == "hamming":
-        return (input == target).sum(), jnp.asarray(target.size)
+        eq = (input == target).astype(jnp.int32)
+        return (eq * per_row[:, None]).sum(), n * target.shape[1]
     if criteria == "overlap":
         hit = jnp.max(jnp.logical_and(input == target, input == 1), axis=1)
         empty = jnp.all(jnp.logical_and(input == 0, target == 0), axis=1)
-        return hit.sum() + empty.sum(), n
+        return (hit * per_row).sum() + (empty * per_row).sum(), n
     if criteria == "contain":
-        return jnp.all((input - target) >= 0, axis=1).sum(), n
+        return (jnp.all((input - target) >= 0, axis=1) * per_row).sum(), n
     # belong
-    return jnp.all((input - target) <= 0, axis=1).sum(), n
+    return (jnp.all((input - target) <= 0, axis=1) * per_row).sum(), n
 
 
 @partial(jax.jit, static_argnames=("threshold", "criteria"))
@@ -237,9 +266,10 @@ def _multilabel_accuracy_update_kernel(
     target: jax.Array,
     threshold: float,
     criteria: str,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     input_label = jnp.where(input < threshold, 0, 1)
-    return _multilabel_update(input_label, target, criteria)
+    return _multilabel_update(input_label, target, criteria, mask=mask)
 
 
 def _multilabel_accuracy_update(
@@ -254,13 +284,17 @@ def _multilabel_accuracy_update(
 
 @partial(jax.jit, static_argnames=("criteria", "k"))
 def _topk_multilabel_accuracy_update_kernel(
-    input: jax.Array, target: jax.Array, criteria: str, k: int
+    input: jax.Array,
+    target: jax.Array,
+    criteria: str,
+    k: int,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     _, topk_idx = jax.lax.top_k(input, k)
     input_label = jnp.zeros(input.shape, dtype=jnp.float32).at[
         jnp.arange(input.shape[0])[:, None], topk_idx
     ].set(1.0)
-    return _multilabel_update(input_label, target, criteria)
+    return _multilabel_update(input_label, target, criteria, mask=mask)
 
 
 def _topk_multilabel_accuracy_update(

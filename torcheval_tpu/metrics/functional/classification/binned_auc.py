@@ -235,23 +235,45 @@ def _binned_counts_rows(
     hits: jax.Array,
     thresholds: jax.Array,
     route: Optional[str] = None,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Per-threshold prediction counts for ``pred = score >= t`` over
     ``(R, N)`` score/hit rows — three formulations returning
     bit-identical int32 counts, chosen by :func:`_select_binned_route`
     (measured regimes in BASELINE.md).  Pass ``route`` when calling from
-    inside jit (it must be selected at call time, outside the trace)."""
+    inside jit (it must be selected at call time, outside the trace).
+
+    ``mask`` (shape ``(N,)``) excludes padded samples exactly: their
+    scores become ``-inf`` — below every threshold (public entry points
+    enforce thresholds in [0, 1]) in every formulation, so they never
+    count as predictions — their hits are zeroed out of ``num_tp`` /
+    ``num_pos``, and ``num_total`` becomes ``mask.sum()``.  The Pallas
+    histogram has no masked-row path (its pad sentinel is a large
+    finite), so a mask downgrades that route to the bit-identical
+    sort."""
     if route is None:
         route = _select_binned_route(
             scores.shape[0], scores.shape[-1], thresholds
         )
+    if mask is not None:
+        if route == "pallas":
+            route = "sort"
+        valid = mask.astype(jnp.bool_)
+        scores = jnp.where(valid[None, :], scores, -jnp.inf)
+        hits = jnp.logical_and(hits, valid[None, :])
     if route == "broadcast":
-        return _binned_counts_rows_broadcast(scores, hits, thresholds)
-    if route == "pallas":
+        out = _binned_counts_rows_broadcast(scores, hits, thresholds)
+    elif route == "pallas":
         from torcheval_tpu.ops.pallas_binned import pallas_binned_counts
 
-        return pallas_binned_counts(scores, hits, thresholds)
-    return _binned_counts_rows_sort(scores, hits, thresholds)
+        out = pallas_binned_counts(scores, hits, thresholds)
+    else:
+        out = _binned_counts_rows_sort(scores, hits, thresholds)
+    if mask is None:
+        return out
+    num_tp, num_fp, num_pos, num_total = out
+    num_total = jnp.zeros_like(num_total) + valid.sum(dtype=jnp.int32)
+    return num_tp, num_fp, num_pos, num_total
 
 
 @jax.jit
@@ -329,6 +351,7 @@ def _multiclass_binned_counts_kernel(
     threshold: jax.Array,
     num_classes: int,
     route: Optional[str] = None,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     # Route chosen at call time, then baked into the jit as static.  Class
     # metrics pass it explicitly (their fused update traces this function,
@@ -336,7 +359,7 @@ def _multiclass_binned_counts_kernel(
     if route is None:
         route = _select_binned_route(num_classes, input.shape[0], threshold)
     return _multiclass_binned_counts_jit(
-        input, target, threshold, num_classes, route
+        input, target, threshold, num_classes, route, mask=mask
     )
 
 
@@ -347,9 +370,14 @@ def _multiclass_binned_counts_jit(
     threshold: jax.Array,
     num_classes: int,
     route: str,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     return _binned_counts_rows(
-        input.T, class_hits(target, num_classes), threshold, route=route
+        input.T,
+        class_hits(target, num_classes),
+        threshold,
+        route=route,
+        mask=mask,
     )
 
 
@@ -358,17 +386,24 @@ def _multilabel_binned_counts_kernel(
     target: jax.Array,
     threshold: jax.Array,
     route: Optional[str] = None,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     if route is None:
         route = _select_binned_route(input.shape[1], input.shape[0], threshold)
-    return _multilabel_binned_counts_jit(input, target, threshold, route)
+    return _multilabel_binned_counts_jit(input, target, threshold, route, mask=mask)
 
 
 @partial(jax.jit, static_argnames=("route",))
 def _multilabel_binned_counts_jit(
-    input: jax.Array, target: jax.Array, threshold: jax.Array, route: str
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    route: str,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    return _binned_counts_rows(input.T, (target == 1).T, threshold, route=route)
+    return _binned_counts_rows(
+        input.T, (target == 1).T, threshold, route=route, mask=mask
+    )
 
 
 @jax.jit

@@ -137,22 +137,32 @@ def _use_matmul_cm(num_classes: int, num_samples: int) -> bool:
 
 
 def _matmul_cm(
-    input: jax.Array, target: jax.Array, num_classes: int
+    input: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """(C, C) counts as ONE MXU matmul of one-hot encodings: cm =
     onehot(target)ᵀ @ onehot(pred).  0/1 one-hots are exact in bf16 and
     the f32 accumulation is exact below 2^24 per cell, so the result is
     bit-identical to the scatter formulation within the dispatch
     bounds."""
-    return _onehot_cm(target, input, num_classes).astype(jnp.int32)
+    return _onehot_cm(target, input, num_classes, mask=mask).astype(jnp.int32)
 
 
-def _onehot_cm(t: jax.Array, p: jax.Array, width: int) -> jax.Array:
+def _onehot_cm(
+    t: jax.Array, p: jax.Array, width: int, mask: Optional[jax.Array] = None
+) -> jax.Array:
     """``(width, width)`` f32 counts as one bf16 one-hot dot_general —
     the shared core of :func:`_matmul_cm` and the matmul branch of
-    :func:`_class_counts` (which widens by a sentinel column)."""
+    :func:`_class_counts` (which widens by a sentinel column).  ``mask``
+    zeroes padded rows of the contracted (target) one-hot — 0/1 scaling
+    is exact in bf16, so masked counts stay bit-identical to a scatter
+    over only the valid rows."""
     classes = jnp.arange(width)
     oh_t = (t[:, None] == classes[None, :]).astype(jnp.bfloat16)
+    if mask is not None:
+        oh_t = oh_t * mask.astype(jnp.bfloat16)[:, None]
     oh_p = (p[:, None] == classes[None, :]).astype(jnp.bfloat16)
     return jax.lax.dot_general(
         oh_t,
@@ -179,13 +189,18 @@ def _confusion_matrix_update_kernel(
     target: jax.Array,
     num_classes: int,
     route: str = "scatter",
+    mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
     input = _wrap_labels(input, num_classes)
     target = _wrap_labels(target, num_classes)
+    if mask is not None and route == "pallas":
+        # The compaction kernel has no masked row path; the scatter is
+        # bit-identical and adding a 0 is a no-op, so downgrade in-trace.
+        route = "scatter"
     if route == "matmul":
-        return _matmul_cm(input, target, num_classes)
+        return _matmul_cm(input, target, num_classes, mask=mask)
     if route == "pallas":
         from torcheval_tpu.ops.pallas_cm import confusion_slab
 
@@ -195,10 +210,15 @@ def _confusion_matrix_update_kernel(
             num_classes=num_classes,
         )
         return slab[:num_classes, :num_classes].astype(jnp.int32)
+    ones = (
+        jnp.ones_like(target, dtype=jnp.int32)
+        if mask is None
+        else mask.astype(jnp.int32)
+    )
     return (
         jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
         .at[target, input]
-        .add(1, mode="drop")
+        .add(ones, mode="drop")
     )
 
 
@@ -217,6 +237,7 @@ def _class_counts(
     num_classes: int,
     route: str,
     interpret: bool = False,
+    mask: Optional[jax.Array] = None,
 ):
     """The per-class ``(num_tp, num_label, num_prediction)`` trio shared
     by F1 / precision / recall, through the same three-way route as the
@@ -236,10 +257,17 @@ def _class_counts(
     t = jnp.minimum(_wrap_labels(target, num_classes), num_classes)
     p = jnp.minimum(_wrap_labels(pred, num_classes), num_classes)
     c = num_classes
+    if mask is not None and route == "pallas":
+        route = "scatter"  # no masked-row path in the compaction kernel
     if route == "scatter":
-        correct = ((t == p) & (t < c)).astype(jnp.int32)
-        num_label = jnp.zeros(c, jnp.int32).at[t].add(1, mode="drop")
-        num_prediction = jnp.zeros(c, jnp.int32).at[p].add(1, mode="drop")
+        ones = (
+            jnp.ones_like(t, dtype=jnp.int32)
+            if mask is None
+            else mask.astype(jnp.int32)
+        )
+        correct = ((t == p) & (t < c)).astype(jnp.int32) * ones
+        num_label = jnp.zeros(c, jnp.int32).at[t].add(ones, mode="drop")
+        num_prediction = jnp.zeros(c, jnp.int32).at[p].add(ones, mode="drop")
         num_tp = jnp.zeros(c, jnp.int32).at[t].add(correct, mode="drop")
         return num_tp, num_label, num_prediction
     if route == "pallas":
@@ -249,7 +277,7 @@ def _class_counts(
             t, p, num_classes=num_classes, interpret=interpret
         )
     else:  # matmul over the (C+1)-wide sentinel window
-        slab = _onehot_cm(t, p, num_classes + 1)
+        slab = _onehot_cm(t, p, num_classes + 1, mask=mask)
     num_label = jnp.sum(slab[:c, :], axis=1).astype(jnp.int32)
     num_prediction = jnp.sum(slab[:, :c], axis=0).astype(jnp.int32)
     num_tp = jnp.diagonal(slab[:c, :c]).astype(jnp.int32)
@@ -277,10 +305,15 @@ def _binary_confusion_matrix_update_kernel(
     target: jax.Array,
     threshold: float,
     use_matmul: bool = False,
+    mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     pred = jnp.where(input < threshold, 0, 1)
     return _confusion_matrix_update_kernel(
-        pred, target.astype(jnp.int32), 2, "matmul" if use_matmul else "scatter"
+        pred,
+        target.astype(jnp.int32),
+        2,
+        "matmul" if use_matmul else "scatter",
+        mask=mask,
     )
 
 

@@ -91,16 +91,22 @@ def _f1_score_update_kernel(
     num_classes: Optional[int],
     average: Optional[str],
     route: str = "scatter",
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
     if average == "micro":
-        num_tp = (input == target).sum()
-        num_label = jnp.asarray(target.shape[0])
+        if mask is None:
+            num_tp = (input == target).sum()
+            num_label = jnp.asarray(target.shape[0])
+        else:
+            m = mask.astype(jnp.int32)
+            num_tp = ((input == target).astype(jnp.int32) * m).sum()
+            num_label = m.sum()
         return num_tp, num_label, num_label
     # ONE routed (C, C)-slab accumulation instead of the reference's
     # three label scatters (each serializes on TPU) — see _class_counts.
-    return _class_counts(input, target, num_classes, route)
+    return _class_counts(input, target, num_classes, route, mask=mask)
 
 
 def _f1_score_compute(
@@ -151,9 +157,16 @@ def _binary_f1_score_update(
 
 @partial(jax.jit, static_argnames=("threshold",))
 def _binary_f1_score_update_kernel(
-    input: jax.Array, target: jax.Array, threshold: float
+    input: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     pred = jnp.where(input < threshold, 0, 1)
+    if mask is not None:
+        m = mask.astype(target.dtype)
+        target = target * m
+        pred = pred * mask.astype(pred.dtype)
     num_tp = jnp.sum(pred * target)
     num_label = jnp.sum(target)
     num_prediction = jnp.sum(pred)

@@ -91,18 +91,24 @@ def _precision_update_kernel(
     num_classes: Optional[int],
     average: Optional[str],
     route: str = "scatter",
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
     if average == "micro":
-        num_tp = (input == target).sum()
-        num_fp = (input != target).sum()
+        if mask is None:
+            num_tp = (input == target).sum()
+            num_fp = (input != target).sum()
+        else:
+            m = mask.astype(jnp.int32)
+            num_tp = ((input == target).astype(jnp.int32) * m).sum()
+            num_fp = ((input != target).astype(jnp.int32) * m).sum()
         return num_tp, num_fp, jnp.asarray(0.0)
     # ONE routed (C, C)-slab accumulation instead of three label
     # scatters (each serializes on TPU) — see _class_counts; the false
     # positives are the prediction marginal minus the diagonal.
     num_tp, num_label, num_prediction = _class_counts(
-        input, target, num_classes, route
+        input, target, num_classes, route, mask=mask
     )
     return num_tp, num_prediction - num_tp, num_label
 
@@ -197,13 +203,22 @@ def _binary_precision_update(
 
 @partial(jax.jit, static_argnames=("threshold",))
 def _binary_precision_update_kernel(
-    input: jax.Array, target: jax.Array, threshold: float
+    input: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     pred = jnp.where(input < threshold, 0, 1)
     target_b = target.astype(jnp.bool_)
     pred_b = pred.astype(jnp.bool_)
+    if mask is not None:
+        valid = mask.astype(jnp.bool_)
+        pred_b = pred_b & valid
+        target_b = target_b & valid
+        num_fp = (pred_b & ~target_b & valid).sum()
+    else:
+        num_fp = (pred_b & ~target_b).sum()
     num_tp = (pred_b & target_b).sum()
-    num_fp = (pred_b & ~target_b).sum()
     return num_tp, num_fp, jnp.asarray(0.0)
 
 

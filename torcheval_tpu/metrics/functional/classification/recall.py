@@ -107,16 +107,22 @@ def _recall_update_kernel(
     num_classes: Optional[int],
     average: Optional[str],
     route: str = "scatter",
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
     if average == "micro":
-        num_tp = (input == target).sum()
-        num_labels = jnp.asarray(target.size)
+        if mask is None:
+            num_tp = (input == target).sum()
+            num_labels = jnp.asarray(target.size)
+        else:
+            m = mask.astype(jnp.int32)
+            num_tp = ((input == target).astype(jnp.int32) * m).sum()
+            num_labels = m.sum()
         return num_tp, num_labels, num_labels
     # ONE routed (C, C)-slab accumulation instead of three label
     # scatters (each serializes on TPU) — see _class_counts.
-    return _class_counts(input, target, num_classes, route)
+    return _class_counts(input, target, num_classes, route, mask=mask)
 
 
 def _recall_compute(
@@ -200,10 +206,15 @@ def _binary_recall_update(
 
 @partial(jax.jit, static_argnames=("threshold",))
 def _binary_recall_update_kernel(
-    input: jax.Array, target: jax.Array, threshold: float
+    input: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     pred = jnp.where(input < threshold, 0, 1)
     target_b = target.astype(jnp.bool_)
+    if mask is not None:
+        target_b = target_b & mask.astype(jnp.bool_)
     num_tp = (pred.astype(jnp.bool_) & target_b).sum()
     num_true_labels = target_b.sum()
     return num_tp, num_true_labels

@@ -119,11 +119,27 @@ def _zero_scalar() -> jax.Array:
     return jnp.asarray(0.0)
 
 
-def _move_state(value: TState, device: "Placement") -> TState:
+def _fresh_array(value: jax.Array, device: "Placement") -> jax.Array:
+    """A NEW buffer holding ``value`` on ``device``.
+
+    ``jax.device_put`` onto the array's current device ALIASES the input
+    buffer — so a donated update (``donate_argnums`` on the hot paths,
+    ``ops/_flags.donation_enabled``) would delete the caller's array too:
+    the registry default behind ``reset()``, a checkpoint snapshot, a
+    user-held reference.  The explicit copy decouples the live state's
+    lifetime from every other holder's.
+    """
+    return jax.device_put(jnp.array(value, copy=True), device)
+
+
+def _move_state(value: TState, device: "Placement", fresh: bool = False) -> TState:
     """Copy a state value onto ``device`` (containers are shallow-copied;
-    defaultdict-ness is preserved)."""
+    defaultdict-ness is preserved).  ``fresh=True`` forces array leaves
+    into NEW buffers (donation safety — see :func:`_fresh_array`);
+    container states are never donated, so their leaves keep the cheap
+    aliasing ``device_put``."""
     if _is_array(value):
-        return jax.device_put(value, device)
+        return _fresh_array(value, device) if fresh else jax.device_put(value, device)
     if isinstance(value, list):
         return [jax.device_put(v, device) for v in value]
     if isinstance(value, deque):
@@ -141,6 +157,12 @@ def _move_state(value: TState, device: "Placement") -> TState:
 class Metric(Generic[TComputeReturn], ABC):
     """Base class for all metrics: a registry of array states plus the
     update/compute/merge lifecycle (reference ``Metric``, ``metric.py:23``)."""
+
+    # Capability marker: True on metrics whose ``update`` accepts a
+    # ``mask=`` validity array (the ragged-batch bucketing path,
+    # ``metrics/_bucket.py``).  ``MetricCollection(bucket=True)`` requires
+    # it of every member.
+    _supports_mask: bool = False
 
     def __init__(self: TSelf, *, device: DeviceLike = None) -> None:
         # Usage telemetry analog of the reference's
@@ -172,7 +194,10 @@ class Metric(Generic[TComputeReturn], ABC):
             # preserves the caller's defaultdict-ness via _move_state.
             stored = dict(default)
         self._state_name_to_default[name] = stored
-        setattr(self, name, _move_state(default, self._device))
+        # fresh=True: the live state must not share a buffer with the
+        # registry default, or a donated update would delete the default
+        # and break every later reset() (see _fresh_array).
+        setattr(self, name, _move_state(default, self._device, fresh=True))
 
     # ------------------------------------------------------------- lifecycle
     @abstractmethod
@@ -211,22 +236,25 @@ class Metric(Generic[TComputeReturn], ABC):
                     fresh[k] = jax.device_put(v, device)
                 setattr(self, name, fresh)
             else:
-                setattr(self, name, _move_state(default, device))
+                setattr(self, name, _move_state(default, device, fresh=True))
         return self
 
     # ---------------------------------------------------------- checkpointing
     def state_dict(self) -> Dict[str, TState]:
         """Snapshot of all states (reference ``metric.py:158-186``).
 
-        Arrays are immutable so no defensive clone is needed; containers are
-        shallow-copied.  The result is a pytree of arrays — directly
-        orbax-checkpointable.
+        Array states are snapshotted into FRESH buffers: arrays are
+        immutable, but under donated updates (``ops/_flags
+        .donation_enabled``) the live buffer is deleted by the next
+        ``update()`` — an aliased snapshot would dangle.  Containers are
+        shallow-copied (never donated).  The result is a pytree of
+        arrays — directly orbax-checkpointable.
         """
         out: Dict[str, TState] = {}
         for name in self._state_name_to_default:
             value = getattr(self, name)
             if _is_array(value):
-                out[name] = value
+                out[name] = _fresh_array(value, self._device)
             elif isinstance(value, list):
                 out[name] = list(value)
             elif isinstance(value, deque):
@@ -249,7 +277,9 @@ class Metric(Generic[TComputeReturn], ABC):
                 if isinstance(default, deque) and isinstance(value, list):
                     value = deque(value, maxlen=default.maxlen)
                 _check_state_variable_type(name, value)
-                setattr(self, name, _move_state(value, self._device))
+                # fresh=True: the caller keeps its checkpoint arrays; a
+                # donated update must not delete them out from under it.
+                setattr(self, name, _move_state(value, self._device, fresh=True))
         if strict:
             unexpected_keys = set(state_dict.keys())
             missing_keys = metric_state_names - provided_keys

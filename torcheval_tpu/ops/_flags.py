@@ -1,8 +1,10 @@
 """Shared dispatch flags for the native-kernel routes."""
 
 import os
+import sys
 
 _TRUTHY = ("1", "true", "yes", "on")
+_FALSY = ("0", "false", "no", "off")
 
 
 def pallas_disabled() -> bool:
@@ -22,3 +24,58 @@ def ustat_disabled() -> bool:
     return (
         os.environ.get("TORCHEVAL_TPU_DISABLE_USTAT", "").lower() in _TRUTHY
     )
+
+
+def donation_enabled() -> bool:
+    """Whether the update hot paths donate their state operands
+    (``donate_argnums``), aliasing old→new state in HBM instead of
+    allocating fresh buffers every batch.
+
+    ``TORCHEVAL_TPU_DONATE`` forces it: truthy → on, falsy → off.  Unset,
+    donation defaults on for accelerator backends (where the halved state
+    traffic matters) and off on CPU.  Read at call time, so harnesses may
+    toggle it after import; the state-registry copies that make donation
+    semantically invisible (``metrics/metric.py``) are unconditional, so
+    toggling mid-lifecycle is safe.
+    """
+    raw = os.environ.get("TORCHEVAL_TPU_DONATE", "").lower()
+    if raw in _TRUTHY:
+        return True
+    if raw in _FALSY:
+        return False
+    import jax
+
+    try:
+        return jax.default_backend() in ("tpu", "gpu")
+    except RuntimeError:  # pragma: no cover - backend init failure
+        return False
+
+
+def configure_persistent_cache() -> "str | None":
+    """Enable JAX's persistent compilation cache when
+    ``TORCHEVAL_TPU_CACHE_DIR`` names a directory, returning the path (or
+    ``None`` when unset / unconfigurable).
+
+    Called once at package import: without this, the persistent cache
+    existed only inside ``bench.py``/``conftest.py``, so every library
+    user process paid cold compiles (~15 s/program through a remote
+    compiler).  ``TORCHEVAL_TPU_CACHE_MIN_COMPILE_SECS`` tunes the
+    write threshold (default 0.5 s, matching bench.py)."""
+    path = os.environ.get("TORCHEVAL_TPU_CACHE_DIR")
+    if not path:
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(os.environ.get("TORCHEVAL_TPU_CACHE_MIN_COMPILE_SECS", "0.5")),
+        )
+        return path
+    except Exception as exc:  # pragma: no cover - cache is best-effort
+        print(
+            f"torcheval_tpu: persistent compile cache unavailable: {exc}",
+            file=sys.stderr,
+        )
+        return None

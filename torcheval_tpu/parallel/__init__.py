@@ -10,7 +10,9 @@ A TPU pod runs the opposite model: one logical SPMD program over a
 This package provides that layer:
 
 * :mod:`torcheval_tpu.parallel.mesh` — mesh construction and batch-sharding
-  helpers (``make_mesh``, ``shard_batch``, ``replicate``).
+  helpers (``make_mesh``, ``shard_batch``, ``replicate``, and the
+  ragged-stream ``bucket_shard_batch`` that pads to a device-divisible
+  power-of-two bucket before sharding).
 * :mod:`torcheval_tpu.parallel.sync` — explicit in-jit state sync:
   ``make_synced_update`` wraps any functional sufficient-statistic kernel in
   ``shard_map`` so each device reduces its local batch shard and one fused
@@ -30,7 +32,12 @@ XLA's partitioner auto-inserts the same collectives (verified by
 you want guaranteed single-collective sync or per-shard control.
 """
 
+from torcheval_tpu.parallel._compile_cache import (
+    spmd_cache_clear,
+    spmd_cache_info,
+)
 from torcheval_tpu.parallel.mesh import (
+    bucket_shard_batch,
     device_count,
     make_mesh,
     replicate,
@@ -55,6 +62,7 @@ from torcheval_tpu.parallel.sync import (
 )
 
 __all__ = [
+    "bucket_shard_batch",
     "device_count",
     "make_mesh",
     "make_synced_update",
@@ -72,4 +80,6 @@ __all__ = [
     "sharded_multiclass_auroc_ustat",
     "sharded_multitask_auprc_exact",
     "sharded_multitask_auroc_exact",
+    "spmd_cache_clear",
+    "spmd_cache_info",
 ]

@@ -1,0 +1,32 @@
+"""JAX version compatibility for the parallel layer.
+
+The sharded builders are written against the stable ``jax.shard_map``
+API (jax >= 0.6).  Older installs only ship
+``jax.experimental.shard_map.shard_map``, whose replication checker is
+spelled ``check_rep`` instead of ``check_vma``; this adapter presents the
+stable keyword signature over whichever one exists.
+"""
+
+import jax
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_rep=check_vma,
+    )

@@ -21,3 +21,18 @@ from jax.sharding import Mesh
 @lru_cache(maxsize=256)
 def compiled_spmd(builder, statics, mesh: Mesh, axis: str):
     return builder(statics, mesh, axis)
+
+
+def spmd_cache_info():
+    """Hit/miss counters of the shared sharded-program memoizer — a
+    ``functools.CacheInfo`` ``(hits, misses, maxsize, currsize)``.  A
+    steady-state eval loop should show hits climbing and misses flat;
+    climbing misses mean program churn (e.g. rebuilding meshes per step,
+    which keys a fresh entry every call).  Surfaced by
+    :func:`torcheval_tpu.routing.hot_path_stats`."""
+    return compiled_spmd.cache_info()
+
+
+def spmd_cache_clear() -> None:
+    """Drop every memoized sharded program (test isolation hook)."""
+    compiled_spmd.cache_clear()

@@ -55,6 +55,7 @@ from torcheval_tpu.metrics.functional._host_checks import (
     all_concrete,
     value_checks_enabled,
 )
+from torcheval_tpu.parallel._compat import shard_map
 from torcheval_tpu.parallel._compile_cache import compiled_spmd
 from torcheval_tpu.parallel.mesh import AxisSpec, _axis_size
 
@@ -184,7 +185,7 @@ def _build_gather_exact(statics, mesh: Mesh, axis: str):
         PartitionSpec(axis) if sample_axis == 0 else PartitionSpec(None, axis)
     )
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=spec,
@@ -528,7 +529,7 @@ def _build_binary_auroc_ustat(statics, mesh: Mesh, axis: str):
         ).astype(jnp.float32)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(PartitionSpec(axis), PartitionSpec(axis)),
@@ -696,7 +697,7 @@ def _build_binary_auprc_ustat(statics, mesh: Mesh, axis: str):
         return jnp.where(n_pos == 0, 0.0, ap).astype(jnp.float32)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(PartitionSpec(axis), PartitionSpec(axis)),
@@ -1056,7 +1057,7 @@ def _build_mc_ustat(statics, mesh: Mesh, axis: str):
         return aurocs.mean() if average == "macro" else aurocs
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=(PartitionSpec(axis), PartitionSpec(axis)),

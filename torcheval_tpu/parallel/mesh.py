@@ -100,3 +100,35 @@ def replicate(mesh: Mesh, tree):
     """Replicate every array leaf of ``tree`` across the whole mesh."""
     sharding = NamedSharding(mesh, PartitionSpec())
     return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+
+
+def bucket_shard_batch(
+    mesh: Mesh,
+    *arrays: jax.Array,
+    axis: AxisSpec = "dp",
+    min_bucket: Optional[int] = None,
+    mask=None,
+):
+    """:func:`shard_batch` for ragged streams: pad the shared leading dim
+    up to a power-of-two bucket that is also a multiple of the axis'
+    device count (so the shard divides evenly), shard the padded arrays,
+    and return them with the replicated validity mask to pass to
+    mask-aware sharded entry points or a bucketed ``MetricCollection``.
+
+    Returns ``(sharded_arrays_tuple, mask)`` — ``mask`` sharded like the
+    batch, 1 for real rows, 0 for padding.  With M distinct batch sizes
+    in the stream, the downstream sharded programs compile
+    O(log max_batch) times instead of M (see ``metrics/_bucket.py``).
+    """
+    from torcheval_tpu.metrics._bucket import DEFAULT_MIN_BUCKET, pad_to_bucket
+
+    padded, out_mask = pad_to_bucket(
+        *arrays,
+        mask=mask,
+        min_bucket=DEFAULT_MIN_BUCKET if min_bucket is None else min_bucket,
+        multiple_of=_axis_size(mesh, axis),
+    )
+    sharded = shard_batch(mesh, *padded, axis=axis)
+    if len(padded) == 1:
+        sharded = (sharded,)
+    return sharded, shard_batch(mesh, out_mask, axis=axis)

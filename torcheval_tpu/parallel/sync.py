@@ -28,6 +28,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
+from torcheval_tpu.parallel._compat import shard_map
 from torcheval_tpu.parallel._compile_cache import compiled_spmd
 from torcheval_tpu.parallel.mesh import AxisSpec, _axis_size
 
@@ -107,7 +108,7 @@ def make_synced_update(
         [reductions] if isinstance(reductions, str) else jax.tree.leaves(reductions)
     )
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=specs,
@@ -570,7 +571,7 @@ def _build_hist_spmd(statics, mesh: Mesh, axis: str):
     local_builder, local_statics = statics
     local = local_builder(*local_statics, axis)
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local,
             mesh=mesh,
             in_specs=PartitionSpec(axis),

@@ -59,6 +59,45 @@ def reset_route_warnings() -> None:
     _warned_callsites.clear()
 
 
+def hot_path_stats() -> dict:
+    """Process-wide update hot-path instrumentation in one dict:
+
+    * ``"trace_counts"`` — how many distinct update programs were BUILT,
+      by kind (``"accumulate"`` / ``"windowed"`` / ``"fused_collection"``;
+      see :mod:`torcheval_tpu._stats`).  In a steady-state eval loop this
+      must stop growing; each +1 is a retrace — through a remote TPU
+      compiler, ~15 s of wall clock (bucket the stream or
+      :func:`torcheval_tpu.aot.warmup` it).
+    * ``"spmd_cache"`` — hits/misses/currsize of the shared sharded-
+      program memoizer (``parallel/_compile_cache.py``); climbing misses
+      mean program churn (e.g. a fresh mesh per step keys a new entry).
+    """
+    from torcheval_tpu._stats import trace_counts
+    from torcheval_tpu.parallel._compile_cache import spmd_cache_info
+
+    info = spmd_cache_info()
+    return {
+        "trace_counts": trace_counts(),
+        "spmd_cache": {
+            "hits": info.hits,
+            "misses": info.misses,
+            "maxsize": info.maxsize,
+            "currsize": info.currsize,
+        },
+    }
+
+
+def _spmd_cache_line() -> str:
+    from torcheval_tpu.parallel._compile_cache import spmd_cache_info
+
+    info = spmd_cache_info()
+    return (
+        f"Sharded-program cache this process: {info.hits} hits / "
+        f"{info.misses} misses, {info.currsize} live programs "
+        "(see hot_path_stats())."
+    )
+
+
 def explain_route(fn, *args, **kwargs) -> str:
     """Explain which formulation ``fn(*args, **kwargs)`` would run and
     why — a debugging aid for the call-time routed entry points.
@@ -256,6 +295,10 @@ def explain_route(fn, *args, **kwargs) -> str:
 
     parallel_answer = _explain_parallel_route(fn, name, args, kwargs)
     if parallel_answer is not None:
+        # Sharded entry points share one jit(shard_map) memoizer; its
+        # counters tell the caller whether this call re-compiles.
+        if getattr(fn, "__self__", None) is None:
+            parallel_answer += "  " + _spmd_cache_line()
         return parallel_answer
 
     return (
@@ -287,6 +330,28 @@ def _explain_parallel_route(fn, name, args, kwargs):
                 f"fused_update: not fusable — the call itself would "
                 f"raise ({exc})"
             )
+        from torcheval_tpu._stats import trace_count
+
+        if owner._bucket:
+            ragged = (
+                f"Ragged batches are padded to power-of-two buckets "
+                f"(min {owner._min_bucket}) with a validity mask, so M "
+                "batch sizes compile O(log max_batch) programs."
+            )
+        else:
+            ragged = (
+                "Bucketing is OFF (bucket=False): every distinct batch "
+                "size traces + compiles its own program."
+            )
+        donated = owner._fused_apply_donated
+        donation = (
+            "state buffers are donated to XLA (in-place accumulate)"
+            if donated
+            else "state buffers are copied each step (donation off; set "
+            "TORCHEVAL_TPU_DONATE=1 or donate=True)"
+            if donated is not None
+            else "donation resolves from TORCHEVAL_TPU_DONATE at first call"
+        )
         return (
             "fused_update: all member updates trace into ONE jitted "
             "program.  Inside that trace every member's call-time route "
@@ -294,7 +359,9 @@ def _explain_parallel_route(fn, name, args, kwargs):
             "rank-sum ustat route) downgrade to their sort formulations "
             "unless pinned via the member's static kwargs (e.g. "
             "ustat_cap); shape-static routes (confusion slab, binned "
-            "counts) are unaffected."
+            f"counts) are unaffected.  {ragged}  This process has built "
+            f"{trace_count('fused_collection')} fused program(s) so far "
+            f"(hot_path_stats() for the full counters), and {donation}."
         )
 
     def call_arg(pos, kw, default=None):
