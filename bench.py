@@ -376,6 +376,16 @@ def _make_row(name: str, ours: float, ref, extras: dict) -> dict:
     # Workloads that don't route (single formulation) still get a stamped
     # column so the ledger schema is uniform.
     row.setdefault("device_route", "default")
+    # Hot-path health snapshot rides next to git_commit/device_route:
+    # retrace offenders, cache hit rate, pad waste, slowest collectives
+    # — whatever the workload's process accumulated (live counters work
+    # with the bus disabled; event sections fill in when it was enabled).
+    try:
+        from torcheval_tpu import telemetry
+
+        row["telemetry"] = telemetry.report()
+    except Exception:  # pragma: no cover - report must never sink a row
+        pass
     return row
 
 

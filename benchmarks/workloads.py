@@ -1172,6 +1172,80 @@ def bench_ragged_stream() -> Tuple[str, float, Optional[float]]:
     return "collection_ragged_bucketed_stream", ours, ref, extras
 
 
+def bench_ragged_stream_telemetry() -> Tuple[str, float, Optional[float]]:
+    """The ragged bucketed stream (see :func:`bench_ragged_stream`) with
+    the telemetry event bus ENABLED — measures the observability tax on
+    the library's most hook-dense path (bucket_pad per batch, a dispatch
+    span per member kernel, a collection span per fused step, retrace
+    events on every compile).  The acceptance bar is <5% of the
+    disabled-path throughput; the disabled path itself is guarded at
+    zero hook calls by ``scripts/check_hot_path_overhead.py``."""
+    import jax.numpy as jnp
+
+    from torcheval_tpu import telemetry
+    from torcheval_tpu.metrics import (
+        MetricCollection,
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+
+    c = 100
+    rng = np.random.default_rng(16)
+    sizes = [160, 96, 224, 130, 313, 200, 256, 77]
+    batches = [
+        (
+            jnp.asarray(rng.random((b, c), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, c, b).astype(np.int32)),
+        )
+        for b in sizes
+    ]
+
+    col = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=c, average="macro"),
+            "f1": MulticlassF1Score(num_classes=c, average="macro"),
+            "cm": MulticlassConfusionMatrix(num_classes=c),
+            "prec": MulticlassPrecision(num_classes=c, average="macro"),
+            "rec": MulticlassRecall(num_classes=c, average="macro"),
+        },
+        bucket=True,
+    )
+
+    def step():
+        col.reset()
+        for args in batches:
+            col.fused_update(*args)
+        _force(col.compute())
+
+    n = sum(sizes)
+    # Baseline pass with the bus off (also pays every compile so the
+    # enabled pass measures steady-state hook cost, not tracing).
+    sec_off = _time_steps(step)
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    telemetry.clear()
+    try:
+        sec_on = _time_steps(step)
+        rep = telemetry.report()
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+    ours = n / sec_on
+    pad = rep["bucket_pad"]
+    extras = {
+        "telemetry_overhead_pct": round(100.0 * (sec_on - sec_off) / sec_off, 2),
+        "events_captured": rep["events_captured"],
+        "pad_waste_pct": pad["waste_pct"],
+        "steady_state_ms_per_stream": round(sec_on * 1e3, 3),
+        "roofline_note": "observability tax of the enabled event bus on "
+        "the bucketed ragged stream; acceptance bar is <5%",
+    }
+    return "collection_ragged_stream_telemetry_on", ours, n / sec_off, extras
+
+
 ALL_WORKLOADS = [
     bench_accuracy,
     bench_binary_auroc,
@@ -1185,6 +1259,7 @@ ALL_WORKLOADS = [
     bench_binned_auroc,
     bench_collection_fused,
     bench_ragged_stream,
+    bench_ragged_stream_telemetry,
     bench_perplexity,
     bench_windowed_auroc,
     bench_weighted_histogram,

@@ -1,0 +1,125 @@
+"""Zero-overhead guard for the disabled telemetry bus.
+
+The telemetry contract (``torcheval_tpu/telemetry/events.py``) is that a
+DISABLED bus costs the hot path exactly one module-attribute read and one
+branch per hook site — no ``record_*`` helper, no ``emit``, no
+``timed_phase`` may ever run.  This script proves the contract
+empirically instead of by inspection: every hook entry point in the
+events module is replaced with a counting wrapper, a hook-dense workload
+is driven (a bucketed five-metric fused-collection stream over ragged
+batch sizes, plus plain per-metric update/compute and an explicit
+``pad_to_bucket``), and the check fails if ANY wrapper fired.
+
+Run directly (``python scripts/check_hot_path_overhead.py``) or through
+the test tier (``tests/test_telemetry.py::test_hot_path_zero_overhead``,
+marker ``telemetry``, not-slow).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Dict, List
+from unittest import mock
+
+# Direct invocation puts scripts/ (not the repo root) on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Hook entry points that must stay cold while the bus is disabled.
+# ``dir()``-discovered record_* helpers plus the two shared funnels;
+# discovery keeps the guard honest when a new event kind lands.
+_EXTRA_HOOKS = ("emit", "timed_phase")
+
+
+def _hook_names(events_module) -> List[str]:
+    names = sorted(
+        n for n in dir(events_module) if n.startswith("record_")
+    )
+    return names + list(_EXTRA_HOOKS)
+
+
+def _counting(fn, counter: Dict[str, int], name: str):
+    def wrapper(*args, **kwargs):
+        counter[name] = counter.get(name, 0) + 1
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def _drive_hot_path() -> None:
+    """A hook-dense slice of the eval hot path: every telemetry site in
+    ``_bucket`` / ``_fuse`` / ``metric`` / ``collection`` / ``_stats``
+    is crossed at least once."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torcheval_tpu.metrics import (
+        BinaryAccuracy,
+        MetricCollection,
+        MulticlassAccuracy,
+        MulticlassF1Score,
+    )
+    from torcheval_tpu.metrics._bucket import pad_to_bucket
+
+    rng = np.random.default_rng(7)
+    c = 10
+    col = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=c, average="macro"),
+            "f1": MulticlassF1Score(num_classes=c, average="macro"),
+        },
+        bucket=True,
+    )
+    for b in (33, 70, 150, 97):  # two buckets (128/256) + repeats
+        scores = jnp.asarray(rng.random((b, c), dtype=np.float32))
+        targets = jnp.asarray(rng.integers(0, c, b).astype(np.int32))
+        col.fused_update(scores, targets)
+    jnp.asarray(list(col.compute().values())[0]).block_until_ready()
+
+    m = BinaryAccuracy()
+    m.update(jnp.asarray([0.9, 0.2, 0.7]), jnp.asarray([1, 0, 1]))
+    m.compute()
+
+    pad_to_bucket(jnp.ones((5, 2)))
+
+
+def check(verbose: bool = True) -> List[str]:
+    """Assert zero hook calls on the disabled path; returns the guarded
+    hook names (so the test tier can sanity-check coverage)."""
+    from torcheval_tpu import telemetry
+    from torcheval_tpu.telemetry import events as ev
+
+    was_enabled = telemetry.enabled()
+    telemetry.disable()
+    counter: Dict[str, int] = {}
+    names = _hook_names(ev)
+    try:
+        with contextlib.ExitStack() as stack:
+            for name in names:
+                stack.enter_context(
+                    mock.patch.object(
+                        ev, name, _counting(getattr(ev, name), counter, name)
+                    )
+                )
+            _drive_hot_path()
+    finally:
+        if was_enabled:
+            telemetry.enable()
+    fired = {k: v for k, v in counter.items() if v}
+    if fired:
+        raise AssertionError(
+            "telemetry hooks ran with the bus DISABLED (the zero-overhead "
+            f"contract is broken): {fired}"
+        )
+    if verbose:
+        print(
+            f"ok: {len(names)} hook entry points stayed cold on the "
+            "disabled hot path"
+        )
+    return names
+
+
+if __name__ == "__main__":
+    check()
+    sys.exit(0)

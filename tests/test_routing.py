@@ -379,6 +379,112 @@ class TestExplainRouteParallel(unittest.TestCase):
         self.assertIn("not fusable", msg)
 
 
+class TestExplainRouteWindowed(unittest.TestCase):
+    """explain_route over the windowed pair-update path (bound .update of
+    a WindowedLifetimeMixin metric)."""
+
+    def test_windowed_pair_update_explanation(self):
+        from torcheval_tpu.metrics import WindowedClickThroughRate
+
+        m = WindowedClickThroughRate(max_num_updates=4)
+        msg = explain_route(m.update)
+        self.assertIn("fused windowed pair update", msg)
+        self.assertIn("ONE jitted", msg)
+        self.assertIn("ring cursor", msg)
+        self.assertIn("lifetime sums ride the same dispatch", msg)
+        self.assertIn("windowed program(s)", msg)
+
+    def test_lifetime_off_is_named(self):
+        from torcheval_tpu.metrics import WindowedClickThroughRate
+
+        m = WindowedClickThroughRate(max_num_updates=4, enable_lifetime=False)
+        msg = explain_route(m.update)
+        self.assertIn("lifetime tracking is off", msg)
+
+    def test_trace_count_is_live(self):
+        # The explanation quotes the live windowed trace counter.
+        from torcheval_tpu._stats import trace_count
+        from torcheval_tpu.metrics import WindowedClickThroughRate
+
+        m = WindowedClickThroughRate(max_num_updates=4)
+        m.update(jnp.asarray([1.0, 0.0, 1.0]))
+        msg = explain_route(m.update)
+        self.assertIn(f"{trace_count('windowed')} windowed program(s)", msg)
+
+
+class TestDowngradeOncePerCallsiteFusedBucketed(unittest.TestCase):
+    """A downgrade fired from INSIDE a bucketed fused collection must warn
+    once per user callsite even though each new bucket shape re-traces the
+    fused program (and re-runs the member's traced update)."""
+
+    def setUp(self):
+        reset_route_warnings()
+
+    def test_one_warning_across_bucket_retraces(self):
+        from torcheval_tpu.metrics import MetricCollection
+        from torcheval_tpu.metrics.metric import Metric
+
+        class _WarnyMaskedSum(Metric[jax.Array]):
+            _supports_mask = True
+
+            def __init__(self, device=None):
+                super().__init__(device=device)
+                self._add_state("total", jnp.asarray(0.0))
+
+            def update(self, input, *, mask=None):
+                warn_route_downgrade(
+                    "warny-bucketed", "downgrade inside fused trace"
+                )
+                valid = jnp.where(mask > 0, input, 0.0)
+                self.total = self.total + jnp.sum(valid)
+                return self
+
+            def compute(self):
+                return self.total
+
+            def merge_state(self, metrics):
+                for other in metrics:
+                    self.total = self.total + other.total
+                return self
+
+        col = MetricCollection({"w": _WarnyMaskedSum()}, bucket=True)
+        rng = np.random.default_rng(12)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            # Three distinct buckets (128/256/512) from ONE loop line:
+            # three traces of the fused program, three executions of the
+            # member's update body, one user callsite.
+            expected = 0.0
+            for n in (100, 200, 400, 90):  # 90 re-hits bucket 128
+                batch = rng.random(n).astype(np.float32)
+                expected += float(batch.sum())
+                col.fused_update(jnp.asarray(batch))
+        downgrades = [
+            w
+            for w in rec
+            if issubclass(w.category, RouteDowngradeWarning)
+        ]
+        self.assertEqual(len(downgrades), 1, [str(w.message) for w in rec])
+        self.assertIn("downgrade inside fused trace", str(downgrades[0].message))
+        # The mask kept the pad rows out of the member's state math.
+        self.assertAlmostEqual(
+            float(col.compute()["w"]), expected, places=2
+        )
+
+    def test_distinct_callsites_both_warn(self):
+        # The same kind from two different user lines → two warnings.
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            warn_route_downgrade("two-sites", "site A")
+            warn_route_downgrade("two-sites", "site B")
+        msgs = [
+            str(w.message)
+            for w in rec
+            if issubclass(w.category, RouteDowngradeWarning)
+        ]
+        self.assertEqual(msgs, ["site A", "site B"])
+
+
 class TestShardedDecidersRouteOrWarn(unittest.TestCase):
     """Every sharded decider, called from inside a caller's jit, must
     either keep its route (shape-static deciders) or fire a

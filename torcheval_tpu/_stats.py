@@ -7,36 +7,53 @@ the jitted update programs, which only run at trace time, so the count
 is exactly "how many distinct update programs were built this process".
 ``aot.warmup`` uses the delta to assert its zero-additional-traces
 contract, and ``routing.hot_path_stats`` surfaces it to users.
+
+Mutation is lock-guarded: users can trace update programs from multiple
+threads (jax tracing is thread-compatible), and the unguarded
+read-modify-write ``dict[k] = dict.get(k, 0) + 1`` would drop bumps
+under that race.  ``bump_trace`` is also the ``retrace`` hook of the
+telemetry bus (:mod:`torcheval_tpu.telemetry`) — a single branch on the
+bus's module flag, and only ever at trace time, never in steady state.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
+from torcheval_tpu.telemetry import events as _telemetry
+
 _trace_counts: Dict[str, int] = {}
+_lock = threading.Lock()
 
 
 def bump_trace(kind: str) -> None:
     """Record one trace of the ``kind`` update program.  Call this from
     inside a jitted function body — the body runs once per (shape,
     statics) cache entry, never on cache hits."""
-    _trace_counts[kind] = _trace_counts.get(kind, 0) + 1
+    with _lock:
+        _trace_counts[kind] = _trace_counts.get(kind, 0) + 1
+    if _telemetry.ENABLED:
+        _telemetry.record_retrace(kind)
 
 
 def trace_count(kind: Optional[str] = None) -> int:
     """Traces recorded since process start (or the last reset): one
     ``kind`` or the total across all kinds."""
-    if kind is not None:
-        return _trace_counts.get(kind, 0)
-    return sum(_trace_counts.values())
+    with _lock:
+        if kind is not None:
+            return _trace_counts.get(kind, 0)
+        return sum(_trace_counts.values())
 
 
 def trace_counts() -> Dict[str, int]:
     """Per-kind snapshot (copy; safe to hold)."""
-    return dict(_trace_counts)
+    with _lock:
+        return dict(_trace_counts)
 
 
 def reset_trace_count() -> None:
     """Zero every counter (test/benchmark hook).  Does NOT clear any jit
     cache — an already-compiled shape still won't re-trace."""
-    _trace_counts.clear()
+    with _lock:
+        _trace_counts.clear()

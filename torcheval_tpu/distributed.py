@@ -29,10 +29,13 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 from abc import ABC, abstractmethod
 from typing import Any, Callable, List, Optional
 
 import numpy as np
+
+from torcheval_tpu.telemetry import events as _telemetry
 
 # Peer-payload wait budget for the KV-store gather (first compiles and big
 # pickles through the tunnel are slow; generous beats a spurious timeout).
@@ -180,6 +183,19 @@ class JaxProcessGroup(CollectiveGroup):
         return self._jax.process_count()
 
     def all_gather_bytes(self, payload: bytes) -> List[bytes]:
+        if not _telemetry.ENABLED:
+            return self._all_gather_bytes_impl(payload)
+        t0 = time.monotonic()
+        out = self._all_gather_bytes_impl(payload)
+        # Wire payload: every peer's pickled bytes land on this rank.
+        _telemetry.record_sync(
+            "all_gather_bytes",
+            time.monotonic() - t0,
+            sum(len(p) for p in out),
+        )
+        return out
+
+    def _all_gather_bytes_impl(self, payload: bytes) -> List[bytes]:
         import jax
         from jax.experimental import multihost_utils
 
@@ -270,6 +286,18 @@ class JaxProcessGroup(CollectiveGroup):
     _KV_CHUNK = 1 << 20  # 1 MiB raw per KV value (b64 ≈ 1.33 MiB < gRPC cap)
 
     def gather_object(self, obj: Any, dst: int = 0) -> Optional[List[Any]]:
+        if not _telemetry.ENABLED:
+            return self._gather_object_impl(obj, dst)
+        t0 = time.monotonic()
+        out = self._gather_object_impl(obj, dst)
+        # This rank's wire contribution (repickled for sizing only when
+        # telemetry is on — the disabled path never pays it).
+        _telemetry.record_sync(
+            "gather_object", time.monotonic() - t0, len(pickle.dumps(obj))
+        )
+        return out
+
+    def _gather_object_impl(self, obj: Any, dst: int = 0) -> Optional[List[Any]]:
         """TRUE gather: non-``dst`` ranks ship their payload point-to-point
         over the coordination service's KV store and never materialize
         their peers' states — the reference's ``dist.gather_object`` memory
@@ -416,10 +444,20 @@ class LocalGroup(CollectiveGroup):
     def all_gather_object(self, obj: Any) -> List[Any]:
         # Serialize through pickle so the simulation exercises the same wire
         # constraints (picklability) as the multi-host backend.
-        self._world._slots[self._rank] = pickle.dumps(obj)
+        t0 = time.monotonic()
+        payload = pickle.dumps(obj)
+        self._world._slots[self._rank] = payload
         self._world._barrier.wait()
         result = [pickle.loads(p) for p in self._world._slots]
         self._world._barrier.wait()
+        if _telemetry.ENABLED:
+            # The simulation reports the same event shape as the pod
+            # backend, so telemetry tests run host-only.
+            _telemetry.record_sync(
+                "local_all_gather_object",
+                time.monotonic() - t0,
+                len(payload) * self.world_size,
+            )
         return result
 
     def broadcast_object(self, obj: Any, src: int) -> Any:
@@ -439,7 +477,9 @@ class LocalGroup(CollectiveGroup):
             raise ValueError(
                 f"dst must be a rank in [0, {self.world_size}), got {dst}."
             )
-        self._world._slots[self._rank] = pickle.dumps(obj)
+        t0 = time.monotonic()
+        payload = pickle.dumps(obj)
+        self._world._slots[self._rank] = payload
         self._world._barrier.wait()
         result = (
             [pickle.loads(p) for p in self._world._slots]
@@ -447,6 +487,10 @@ class LocalGroup(CollectiveGroup):
             else None
         )
         self._world._barrier.wait()
+        if _telemetry.ENABLED:
+            _telemetry.record_sync(
+                "local_gather_object", time.monotonic() - t0, len(payload)
+            )
         return result
 
 

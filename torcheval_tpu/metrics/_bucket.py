@@ -22,6 +22,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.telemetry import events as _telemetry
+
 # Floor for bucket sizes: batches below this all share one shape, so a
 # stream of tiny ragged batches costs ONE compile, not log2(spread).
 DEFAULT_MIN_BUCKET = 128
@@ -93,6 +95,11 @@ def pad_to_bucket(
             )
     bucket = bucket_size(n, min_bucket=min_bucket, multiple_of=multiple_of)
     pad = bucket - n
+    if _telemetry.ENABLED:
+        # rows_padded/rows_valid waste accounting — emitted on the
+        # pad == 0 path too, so the per-bucket waste ratio has the full
+        # denominator.
+        _telemetry.record_bucket_pad(bucket, n, pad)
     if pad == 0:
         out_mask = (
             mask.astype(jnp.int32)

@@ -25,12 +25,14 @@ Hot-path extensions (see ``_bucket.py`` / ``ops/_flags.py``):
   snapshots so no live reference ever dangles.
 """
 
+import time
 from functools import partial
 from typing import Tuple
 
 import jax
 
 from torcheval_tpu._stats import bump_trace
+from torcheval_tpu.telemetry import events as _telemetry
 
 
 def _accumulate_impl(states, args, kernel, statics, grow, fold, mask=None):
@@ -96,4 +98,21 @@ def accumulate(
     from torcheval_tpu.ops._flags import donation_enabled
 
     fn = _accumulate_jit_donated if donation_enabled() else _accumulate_jit
-    return fn(tuple(states), tuple(args), kernel, tuple(statics), grow, fold, mask)
+    if not _telemetry.ENABLED:
+        return fn(
+            tuple(states), tuple(args), kernel, tuple(statics), grow, fold, mask
+        )
+    # Telemetry on: the fused dispatch becomes a "dispatch" span named
+    # after the kernel (dispatch wall time, NOT device time — steady
+    # state it measures the jit cache hit + launch).
+    t0 = time.monotonic()
+    out = fn(
+        tuple(states), tuple(args), kernel, tuple(statics), grow, fold, mask
+    )
+    _telemetry.record_span(
+        "dispatch",
+        getattr(kernel, "__name__", str(kernel)),
+        time.monotonic() - t0,
+        sum(getattr(s, "nbytes", 0) for s in out),
+    )
+    return out

@@ -12,6 +12,7 @@ State-dict keys are namespaced ``"{name}/{state}"`` so a collection
 checkpoints like any single metric (orbax-compatible flat mapping).
 """
 
+import time
 from typing import Any, Dict, Iterable, Iterator, Mapping, Optional, Tuple
 
 import jax
@@ -20,6 +21,7 @@ from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics._bucket import DEFAULT_MIN_BUCKET, pad_to_bucket
 from torcheval_tpu.metrics.metric import Metric, _move_state
 from torcheval_tpu.ops import _flags
+from torcheval_tpu.telemetry import events as _telemetry
 
 
 class MetricCollection:
@@ -152,6 +154,7 @@ class MetricCollection:
             )
             self._fused_apply_donated = donate
         before = self._read_states()
+        t0 = time.monotonic() if _telemetry.ENABLED else 0.0
         try:
             new_states = self._fused_apply(before, args, kwargs)
         except BaseException:
@@ -161,9 +164,21 @@ class MetricCollection:
             # buffers were consumed — any deleted snapshot entry falls
             # back to the member's registered default (a fresh reset
             # state), keeping every state attribute concrete + readable.
+            if _telemetry.ENABLED and donate:
+                _telemetry.record_donation("abort")
             self._install_states(before, guard_deleted=True)
             raise
         self._install_states(new_states)
+        if _telemetry.ENABLED:
+            _telemetry.record_span(
+                "update",
+                "MetricCollection.fused",
+                time.monotonic() - t0,
+                sum(
+                    _telemetry.state_nbytes(m)
+                    for m in self._metrics.values()
+                ),
+            )
         return self
 
     def _check_fusable(self) -> None:
@@ -203,9 +218,17 @@ class MetricCollection:
                     v = _move_state(
                         m._state_name_to_default[s], m._device, fresh=True
                     )
+                    if _telemetry.ENABLED:
+                        # The donated buffer was consumed before the
+                        # abort; this state restarts from its registered
+                        # default — an operator-visible data-loss event.
+                        _telemetry.record_donation("restore")
                 setattr(m, s, v)
 
     def compute(self) -> Dict[str, Any]:
+        # Members' own compute spans fire inside this loop (metric.py's
+        # phase wrapper); no collection-level span, which would double
+        # count every member.
         return {name: m.compute() for name, m in self._metrics.items()}
 
     def reset(self) -> "MetricCollection":

@@ -36,12 +36,15 @@ from typing import (
     Union,
 )
 
+import functools
 import logging
 
 import jax
 import jax.numpy as jnp
 
-_telemetry = logging.getLogger("torcheval_tpu.telemetry")
+from torcheval_tpu.telemetry import events as _telemetry
+
+_usage_log = logging.getLogger("torcheval_tpu.telemetry")
 
 TComputeReturn = TypeVar("TComputeReturn")
 
@@ -154,6 +157,27 @@ def _move_state(value: TState, device: "Placement", fresh: bool = False) -> TSta
     raise TypeError(f"Unsupported state type: {type(value)}")
 
 
+def _wrap_phase(fn, phase: str):
+    """Wrap a subclass's ``update``/``compute`` as a telemetry span hook.
+
+    Disabled (the default), the wrapper is one module-flag branch plus a
+    passthrough call; enabled, the phase is timed, its state-memory
+    footprint recorded, and (under ``enable(annotate=True)``) the call
+    runs inside a ``jax.profiler.TraceAnnotation``.  Inside a fused
+    collection trace the member's wrapped update only runs at trace time,
+    so steady-state fused dispatch stays span-free.
+    """
+
+    @functools.wraps(fn)
+    def wrapped(self, *args, **kwargs):
+        if not _telemetry.ENABLED:
+            return fn(self, *args, **kwargs)
+        return _telemetry.timed_phase(self, phase, fn, args, kwargs)
+
+    wrapped.__torcheval_tpu_phase__ = phase
+    return wrapped
+
+
 class Metric(Generic[TComputeReturn], ABC):
     """Base class for all metrics: a registry of array states plus the
     update/compute/merge lifecycle (reference ``Metric``, ``metric.py:23``)."""
@@ -164,12 +188,27 @@ class Metric(Generic[TComputeReturn], ABC):
     # it of every member.
     _supports_mask: bool = False
 
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        # Telemetry span hooks: every concrete update/compute a subclass
+        # defines is wrapped ONCE (inherited already-wrapped methods are
+        # left alone), so enabling the bus times each phase with no
+        # per-metric opt-in.
+        for phase in ("update", "compute"):
+            fn = cls.__dict__.get(phase)
+            if (
+                callable(fn)
+                and not getattr(fn, "__isabstractmethod__", False)
+                and getattr(fn, "__torcheval_tpu_phase__", None) is None
+            ):
+                setattr(cls, phase, _wrap_phase(fn, phase))
+
     def __init__(self: TSelf, *, device: DeviceLike = None) -> None:
         # Usage telemetry analog of the reference's
         # ``torch._C._log_api_usage_once`` (reference ``metric.py:44``):
         # one debug record per construction on a dedicated logger, for
         # deployments that want adoption counts without a torch runtime.
-        _telemetry.debug("torcheval_tpu.metrics.%s", type(self).__name__)
+        _usage_log.debug("torcheval_tpu.metrics.%s", type(self).__name__)
         self._device: Placement = canonicalize_device(device)
         self._state_name_to_default: Dict[str, TState] = {}
 

@@ -9,6 +9,11 @@ already-compiled program instead.
 
 One builder convention for every call site: ``builder(statics, mesh,
 axis) -> jitted fn``, with ``statics`` a hashable tuple.
+
+Each lookup is also a telemetry hook (``spmd_cache_hit`` /
+``spmd_cache_miss`` events): with the bus enabled, the miss counter is
+diffed around the memoized call; disabled, the lookup is the bare
+``lru_cache`` hit it always was behind a single branch.
 """
 
 from __future__ import annotations
@@ -17,10 +22,29 @@ from functools import lru_cache
 
 from jax.sharding import Mesh
 
+from torcheval_tpu.telemetry import events as _telemetry
+
 
 @lru_cache(maxsize=256)
-def compiled_spmd(builder, statics, mesh: Mesh, axis: str):
+def _compiled_spmd_cached(builder, statics, mesh: Mesh, axis: str):
     return builder(statics, mesh, axis)
+
+
+def compiled_spmd(builder, statics, mesh: Mesh, axis: str):
+    if not _telemetry.ENABLED:
+        return _compiled_spmd_cached(builder, statics, mesh, axis)
+    misses_before = _compiled_spmd_cached.cache_info().misses
+    fn = _compiled_spmd_cached(builder, statics, mesh, axis)
+    _telemetry.record_cache(
+        hit=_compiled_spmd_cached.cache_info().misses == misses_before
+    )
+    return fn
+
+
+# ``compiled_spmd`` was the lru_cache object itself before the telemetry
+# wrapper; callers (``parallel/exact.py``, tests) introspect it like one.
+compiled_spmd.cache_info = _compiled_spmd_cached.cache_info
+compiled_spmd.cache_clear = _compiled_spmd_cached.cache_clear
 
 
 def spmd_cache_info():
@@ -30,9 +54,9 @@ def spmd_cache_info():
     climbing misses mean program churn (e.g. rebuilding meshes per step,
     which keys a fresh entry every call).  Surfaced by
     :func:`torcheval_tpu.routing.hot_path_stats`."""
-    return compiled_spmd.cache_info()
+    return _compiled_spmd_cached.cache_info()
 
 
 def spmd_cache_clear() -> None:
     """Drop every memoized sharded program (test isolation hook)."""
-    compiled_spmd.cache_clear()
+    _compiled_spmd_cached.cache_clear()

@@ -19,6 +19,7 @@ pod mesh and DCN across slices, exactly where XLA places them.
 
 from __future__ import annotations
 
+import time
 from functools import lru_cache
 from typing import Any, Callable, Optional, Sequence, Union
 
@@ -31,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec
 from torcheval_tpu.parallel._compat import shard_map
 from torcheval_tpu.parallel._compile_cache import compiled_spmd
 from torcheval_tpu.parallel.mesh import AxisSpec, _axis_size
+from torcheval_tpu.telemetry import events as _telemetry
 
 Reduction = Union[str, Any]  # 'sum' | 'max' | 'min' | 'mean' | 'concat' | pytree
 
@@ -50,6 +52,19 @@ def _reduce_leaf(value: jax.Array, how: str, axis: str) -> jax.Array:
         raise ValueError(
             f"Unknown reduction {how!r}; expected one of {sorted(_REDUCERS)}"
         ) from None
+
+
+def _timed_dispatch(fn, op: str, payload_bytes: int, *args):
+    """Telemetry-on dispatch wrapper for the sharded histogram programs:
+    wall time (blocked to completion — the collective rides inside the
+    program, so this bounds it from above) plus the merge's wire payload
+    estimate, emitted as ONE ``sync`` event.  Callers branch on
+    ``_telemetry.ENABLED`` so the disabled path stays a bare call."""
+    t0 = time.monotonic()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    _telemetry.record_sync(op, time.monotonic() - t0, payload_bytes)
+    return out
 
 
 def mesh_merge_states(states, axis: str, reductions: Reduction = "sum"):
@@ -107,7 +122,7 @@ def make_synced_update(
     leaves = (
         [reductions] if isinstance(reductions, str) else jax.tree.leaves(reductions)
     )
-    return jax.jit(
+    jitted = jax.jit(
         shard_map(
             local,
             mesh=mesh,
@@ -116,6 +131,23 @@ def make_synced_update(
             check_vma="concat" not in leaves,
         )
     )
+    op = f"synced_update:{getattr(kernel, '__name__', str(kernel))}"
+
+    def synced(*batch):
+        if not _telemetry.ENABLED:
+            return jitted(*batch)
+        t0 = time.monotonic()
+        out = jitted(*batch)
+        jax.block_until_ready(out)
+        # The merged state pytree IS the collective's payload (every
+        # device ends up holding the full value).
+        payload = sum(
+            getattr(leaf, "nbytes", 0) for leaf in jax.tree.leaves(out)
+        )
+        _telemetry.record_sync(op, time.monotonic() - t0, payload)
+        return out
+
+    return synced
 
 
 def sharded_auroc_histogram(
@@ -528,6 +560,11 @@ def _run_sharded_binary(
         fn = compiled_spmd(
             _build_hist_spmd, (counts_builder, (num_bins, route)), mesh, axis
         )
+        if _telemetry.ENABLED:
+            # Wire payload of the psum merge: 2 × num_bins f32 counters.
+            return _timed_dispatch(
+                fn, "binary_hist_counts", 2 * num_bins * 4, scores, targets
+            )
         return fn(scores, targets)
     if weights is not None and assume_01_targets:
         # Weighted with verifiably-0/1 targets: the Pallas payload kernel
@@ -544,12 +581,25 @@ def _run_sharded_binary(
                 mesh,
                 axis,
             )
+            if _telemetry.ENABLED:
+                return _timed_dispatch(
+                    fn,
+                    "binary_hist_wcounts",
+                    2 * num_bins * 4,
+                    scores,
+                    targets,
+                    weights,
+                )
             return fn(scores, targets, weights)
     if weights is None:
         weights = jnp.ones_like(scores, dtype=jnp.float32)
     fn = compiled_spmd(
         _build_hist_spmd, (weighted_builder, (num_bins,)), mesh, axis
     )
+    if _telemetry.ENABLED:
+        return _timed_dispatch(
+            fn, "binary_hist_scatter", 2 * num_bins * 4, scores, targets, weights
+        )
     return fn(scores, targets, weights)
 
 
@@ -721,6 +771,16 @@ def sharded_multiclass_auroc_histogram(
         fn = compiled_spmd(
             _build_hist_spmd, (builder, statics), mesh, axis
         )
+        if _telemetry.ENABLED:
+            # psum payload: (C, 2 × num_bins) f32 per-class counters.
+            return _timed_dispatch(
+                fn,
+                "multiclass_hist_weighted",
+                num_classes * 2 * num_bins * 4,
+                scores,
+                targets,
+                weights,
+            )
         return fn(scores, targets, weights)
     route = _hist_route(num_classes, n_local, num_bins)
     fn = compiled_spmd(
@@ -729,6 +789,14 @@ def sharded_multiclass_auroc_histogram(
         mesh,
         axis,
     )
+    if _telemetry.ENABLED:
+        return _timed_dispatch(
+            fn,
+            "multiclass_hist_counts",
+            num_classes * 2 * num_bins * 4,
+            scores,
+            targets,
+        )
     return fn(scores, targets)
 
 

@@ -14,12 +14,16 @@ formulation a call will take and why.
 
 from __future__ import annotations
 
+import os
 import traceback
 import warnings
-from typing import Set, Tuple
+from typing import Optional, Set, Tuple
+
+from torcheval_tpu.telemetry import events as _telemetry
 
 _PKG_MARKER = "torcheval_tpu"
 _warned_callsites: Set[Tuple[str, int, str]] = set()
+_SKIP_PREFIXES: Optional[Tuple[str, ...]] = None
 
 
 class RouteDowngradeWarning(UserWarning):
@@ -28,11 +32,43 @@ class RouteDowngradeWarning(UserWarning):
     e.g. ``ustat_cap=`` / ``max_class_count_per_shard=``)."""
 
 
+def _skip_prefixes() -> Tuple[str, ...]:
+    """Directory prefixes whose frames are never "the user's call line":
+    the jax/jaxlib trees (trace-time hooks fire with jax's tracing
+    machinery on the stack between the package and the user's jit call).
+    Computed once; the package's own frames are matched by name."""
+    global _SKIP_PREFIXES
+    if _SKIP_PREFIXES is None:
+        prefixes = []
+        for mod_name in ("jax", "jaxlib"):
+            try:
+                mod = __import__(mod_name)
+                prefixes.append(
+                    os.path.dirname(os.path.abspath(mod.__file__)) + os.sep
+                )
+            except Exception:  # pragma: no cover - module absent/odd layout
+                pass
+        _SKIP_PREFIXES = tuple(prefixes)
+    return _SKIP_PREFIXES
+
+
 def _user_callsite() -> Tuple[str, int]:
-    """First stack frame outside this package (the user's call line)."""
-    for frame in reversed(traceback.extract_stack(limit=40)[:-1]):
-        if _PKG_MARKER not in (frame.filename or ""):
-            return frame.filename, frame.lineno or 0
+    """First stack frame outside this package (and outside jax's tracing
+    machinery) — the user's call line.  When the WHOLE captured stack is
+    internal (e.g. ``aot.warmup`` driving updates from inside the
+    package), fall back to the outermost frame instead of ``<unknown>``
+    so downgrade warnings and telemetry events are never unattributed."""
+    stack = traceback.extract_stack(limit=40)[:-1]
+    for frame in reversed(stack):
+        filename = frame.filename or ""
+        if _PKG_MARKER in filename:
+            continue
+        if any(filename.startswith(p) for p in _skip_prefixes()):
+            continue
+        return filename, frame.lineno or 0
+    if stack:
+        outer = stack[0]
+        return outer.filename or "<unknown>", outer.lineno or 0
     return "<unknown>", 0
 
 
@@ -45,6 +81,13 @@ def warn_route_downgrade(kind: str, message: str) -> None:
     only the FIRST user callsite would ever warn — and the warning would
     point at package internals instead of the user's jit call."""
     filename, lineno = _user_callsite()
+    if _telemetry.ENABLED:
+        # Every occurrence is an event (the warning dedupes; the counter
+        # must not — "how often does this downgrade fire" is the question
+        # an operator asks).
+        _telemetry.record_route_downgrade(
+            kind, message, callsite=f"{filename}:{lineno}"
+        )
     key = (filename, lineno, kind)
     if key in _warned_callsites:
         return
@@ -71,20 +114,18 @@ def hot_path_stats() -> dict:
     * ``"spmd_cache"`` — hits/misses/currsize of the shared sharded-
       program memoizer (``parallel/_compile_cache.py``); climbing misses
       mean program churn (e.g. a fresh mesh per step keys a new entry).
-    """
-    from torcheval_tpu._stats import trace_counts
-    from torcheval_tpu.parallel._compile_cache import spmd_cache_info
 
-    info = spmd_cache_info()
-    return {
-        "trace_counts": trace_counts(),
-        "spmd_cache": {
-            "hits": info.hits,
-            "misses": info.misses,
-            "maxsize": info.maxsize,
-            "currsize": info.currsize,
-        },
-    }
+    Compatibility view over :func:`torcheval_tpu.telemetry.report` —
+    these two sections read live counters and work with the bus disabled;
+    the full report adds callsite attribution, padding waste, collective
+    timing, and spans when telemetry is enabled.
+    """
+    from torcheval_tpu import telemetry
+
+    rep = telemetry.report()
+    cache = dict(rep["spmd_cache"])
+    cache.pop("hit_rate", None)
+    return {"trace_counts": rep["trace_counts"], "spmd_cache": cache}
 
 
 def _spmd_cache_line() -> str:
@@ -320,8 +361,38 @@ def _explain_parallel_route(fn, name, args, kwargs):
     from torcheval_tpu.parallel.exact import _resolve_multi_axis_comm
     from torcheval_tpu.parallel.mesh import _axis_size
 
-    # --- MetricCollection.fused_update (bound method) --------------------
+    # --- windowed pair-update metrics (bound .update) --------------------
+    from torcheval_tpu.metrics._buffer import WindowedLifetimeMixin
+
     owner = getattr(fn, "__self__", None)
+    if isinstance(owner, WindowedLifetimeMixin) and name == "update":
+        from torcheval_tpu._stats import trace_count
+        from torcheval_tpu.ops._flags import donation_enabled
+
+        donation = (
+            "window/lifetime buffers are donated to XLA (in-place column "
+            "writes)"
+            if donation_enabled()
+            else "window/lifetime buffers are copied each step (donation "
+            "off; set TORCHEVAL_TPU_DONATE=1)"
+        )
+        lifetime = (
+            "lifetime sums ride the same dispatch"
+            if owner.enable_lifetime
+            else "lifetime tracking is off (zero-size placeholders)"
+        )
+        return (
+            f"{name}: fused windowed pair update — the two-statistic "
+            "kernel and both ring-window column writes run in ONE jitted "
+            f"dispatch (metrics/_buffer.py); {lifetime}, and {donation}.  "
+            "The ring cursor is host-side state, so this metric cannot "
+            "join MetricCollection.fused_update; the program re-traces "
+            "only per batch SHAPE — this process has built "
+            f"{trace_count('windowed')} windowed program(s) so far "
+            "(hot_path_stats() for the full counters)."
+        )
+
+    # --- MetricCollection.fused_update (bound method) --------------------
     if isinstance(owner, MetricCollection) and name == "fused_update":
         try:
             owner._check_fusable()

@@ -1,0 +1,190 @@
+"""Unified telemetry for the eval hot path: one structured feed answering
+"why did step latency spike" — retrace? cache miss? route downgrade?
+collective stall? padding waste?
+
+Disabled by default and free when off (every hook is a single branch on a
+module flag — see :mod:`torcheval_tpu.telemetry.events`).  Enable with
+:func:`enable` or ``TORCHEVAL_TPU_TELEMETRY=1``, then:
+
+* :func:`events` / :func:`export_jsonl` — the raw typed event stream;
+* :func:`prometheus_text` — aggregate counters/histograms for scraping;
+* :func:`report` — the health summary (top retrace offenders by callsite,
+  pad-waste ratio per bucket, cache hit rate, slowest collectives), which
+  ``bench.py`` stamps into every bench row and
+  :func:`torcheval_tpu.routing.hot_path_stats` is a thin view over.
+
+Example::
+
+    from torcheval_tpu import telemetry
+    telemetry.enable()
+    ... run the eval loop ...
+    print(telemetry.report(as_text=True))
+    telemetry.export_jsonl("telemetry.jsonl")
+    open("metrics.prom", "w").write(telemetry.prometheus_text())
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Union
+
+from torcheval_tpu.telemetry import events, export
+from torcheval_tpu.telemetry.events import (
+    BucketPadEvent,
+    CacheEvent,
+    DonationEvent,
+    Event,
+    RetraceEvent,
+    RouteDowngradeEvent,
+    SpanEvent,
+    SyncEvent,
+    clear,
+    disable,
+    emit,
+    enable,
+    enabled,
+)
+from torcheval_tpu.telemetry.events import events as _events_snapshot
+from torcheval_tpu.telemetry.export import (
+    event_from_dict,
+    event_to_dict,
+    export_jsonl,
+    format_report,
+    prometheus_text,
+    read_jsonl,
+)
+
+# Re-export the snapshot accessor under its natural name without shadowing
+# the submodule for `telemetry.events.ENABLED` readers.
+events_snapshot = _events_snapshot
+
+_TOP_N = 5
+
+
+def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
+    """Process health summary over everything the bus has captured plus
+    the always-on counters (trace counts, spmd cache) — a JSON-able dict,
+    or the rendered text with ``as_text=True``.
+
+    The ``trace_counts`` / ``spmd_cache`` sections are live reads of
+    :mod:`torcheval_tpu._stats` and ``parallel/_compile_cache`` and are
+    meaningful even with telemetry disabled;
+    :func:`torcheval_tpu.routing.hot_path_stats` is exactly that subset.
+    """
+    from torcheval_tpu._stats import trace_counts
+    from torcheval_tpu.parallel._compile_cache import spmd_cache_info
+
+    info = spmd_cache_info()
+    lookups = info.hits + info.misses
+    agg = events.aggregates()
+
+    retrace_total = sum(agg["retrace"].values())
+    offenders = sorted(
+        (
+            {"program": program, "callsite": callsite, "count": count}
+            for (program, callsite), count in agg["retrace"].items()
+        ),
+        key=lambda item: -item["count"],
+    )[:_TOP_N]
+
+    pad_valid = sum(e["rows_valid"] for e in agg["bucket_pad"].values())
+    pad_padded = sum(e["rows_padded"] for e in agg["bucket_pad"].values())
+    pad_rows = pad_valid + pad_padded
+    per_bucket = {}
+    for bucket, entry in agg["bucket_pad"].items():
+        rows = entry["rows_valid"] + entry["rows_padded"]
+        per_bucket[bucket] = {
+            **entry,
+            "waste_pct": 100.0 * entry["rows_padded"] / rows if rows else 0.0,
+        }
+
+    downgrade_total = sum(agg["route_downgrade"].values())
+    by_kind: Dict[str, int] = {}
+    for (route_kind, _callsite), count in agg["route_downgrade"].items():
+        by_kind[route_kind] = by_kind.get(route_kind, 0) + count
+
+    sync_events = events.events("sync")
+    slowest = sorted(
+        (
+            {
+                "op": e.op,
+                "seconds": e.seconds,
+                "payload_bytes": e.payload_bytes,
+                "callsite": e.callsite,
+            }
+            for e in sync_events
+        ),
+        key=lambda item: -item["seconds"],
+    )[:_TOP_N]
+    sync_totals = {
+        "calls": sum(e["calls"] for e in agg["sync"].values()),
+        "seconds": sum(e["seconds"] for e in agg["sync"].values()),
+        "payload_bytes": sum(
+            e["payload_bytes"] for e in agg["sync"].values()
+        ),
+        "slowest": slowest,
+    }
+
+    spans = {
+        f"{name}.{phase}": {
+            "calls": entry["calls"],
+            "seconds": entry["seconds"],
+            "state_bytes": entry["state_bytes"],
+        }
+        for (name, phase), entry in agg["spans"].items()
+    }
+
+    result: Dict[str, Any] = {
+        "enabled": events.ENABLED,
+        "trace_counts": trace_counts(),
+        "spmd_cache": {
+            "hits": info.hits,
+            "misses": info.misses,
+            "maxsize": info.maxsize,
+            "currsize": info.currsize,
+            "hit_rate": info.hits / lookups if lookups else 0.0,
+        },
+        "retrace": {"total": retrace_total, "top_offenders": offenders},
+        "route_downgrades": {"total": downgrade_total, "by_kind": by_kind},
+        "bucket_pad": {
+            "rows_valid": pad_valid,
+            "rows_padded": pad_padded,
+            "waste_pct": 100.0 * pad_padded / pad_rows if pad_rows else 0.0,
+            "per_bucket": per_bucket,
+        },
+        "donation": dict(agg["donation"]),
+        "sync": sync_totals,
+        "spans": spans,
+        "events_captured": agg["emitted"],
+        "events_dropped": events.dropped(),
+        "ring_capacity": events.capacity(),
+    }
+    if as_text:
+        return format_report(result)
+    return result
+
+
+__all__ = [
+    "BucketPadEvent",
+    "CacheEvent",
+    "DonationEvent",
+    "Event",
+    "RetraceEvent",
+    "RouteDowngradeEvent",
+    "SpanEvent",
+    "SyncEvent",
+    "clear",
+    "disable",
+    "emit",
+    "enable",
+    "enabled",
+    "event_from_dict",
+    "event_to_dict",
+    "events",
+    "events_snapshot",
+    "export",
+    "export_jsonl",
+    "format_report",
+    "prometheus_text",
+    "read_jsonl",
+    "report",
+]

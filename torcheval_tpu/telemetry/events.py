@@ -1,0 +1,481 @@
+"""The telemetry event bus: typed events, a bounded ring buffer, and the
+aggregate counters the exporters drain.
+
+Every instrument in the library feeds this one module: retraces
+(``_stats.bump_trace``), sharded-program cache hits/misses
+(``parallel/_compile_cache``), route downgrades (``routing``), bucket
+padding waste (``metrics/_bucket``), donation aborts/restores
+(``metrics/collection`` / ``metrics/_buffer``), collective sync calls
+(``parallel/sync`` / ``distributed``), and update/compute/dispatch spans
+(``metrics/metric`` / ``metrics/collection`` / ``metrics/_fuse``).
+
+Zero-cost-when-off contract
+---------------------------
+Every hook site in the library is guarded by a single branch on the
+module-level :data:`ENABLED` flag::
+
+    from torcheval_tpu.telemetry import events as _telemetry
+    ...
+    if _telemetry.ENABLED:
+        _telemetry.record_bucket_pad(...)
+
+so with telemetry disabled (the default) the hot path pays one attribute
+read + one branch and never calls into this module —
+``scripts/check_hot_path_overhead.py`` asserts exactly that by mocking
+every ``record_*``/:func:`emit` entry point and counting calls.
+
+The buffer is a bounded deque under a lock: emission is thread-safe (the
+trace-time hooks can fire from concurrent tracing threads) and memory is
+capped — when full, the oldest events are dropped and counted in
+``dropped``.  Aggregate counters are updated on every emit and survive
+ring overflow, so the Prometheus snapshot and :func:`report` totals stay
+exact even after the ring has wrapped.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Tuple
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+DEFAULT_CAPACITY = 4096
+
+# Fixed histogram bucket bounds (seconds) for sync / span durations —
+# Prometheus ``le`` convention, +Inf implicit.
+DURATION_BUCKETS: Tuple[float, ...] = (
+    1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0
+)
+
+
+def _env_capacity() -> int:
+    raw = os.environ.get("TORCHEVAL_TPU_TELEMETRY_CAPACITY", "")
+    try:
+        n = int(raw)
+        return n if n > 0 else DEFAULT_CAPACITY
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+# Module-level flags: the hooks read these as plain attributes.  Both are
+# initialized from the environment at import so ``TORCHEVAL_TPU_TELEMETRY=1
+# python eval.py`` needs no code change.
+ENABLED: bool = (
+    os.environ.get("TORCHEVAL_TPU_TELEMETRY", "").lower() in _TRUTHY
+)
+# When also truthy, update/compute spans run under
+# ``tools.profiling.annotate`` so they land in TensorBoard/Perfetto traces.
+ANNOTATE: bool = (
+    os.environ.get("TORCHEVAL_TPU_TELEMETRY_ANNOTATE", "").lower() in _TRUTHY
+)
+
+_lock = threading.Lock()
+_events: "deque[Event]" = deque(maxlen=_env_capacity())
+_dropped: int = 0
+
+
+# --------------------------------------------------------------------- events
+@dataclass
+class Event:
+    """Base event: a kind tag, a monotonic timestamp, and the user
+    callsite (``"file:line"``) the emission is attributed to."""
+
+    kind: str = field(init=False, default="event")
+    time_s: float = field(default=0.0)
+    callsite: str = field(default="<unknown>:0")
+
+
+@dataclass
+class RetraceEvent(Event):
+    """One trace of an update-path program (``_stats.bump_trace``) —
+    each is a compile, ~15 s through a remote TPU compiler."""
+
+    kind: str = field(init=False, default="retrace")
+    program: str = ""  # "accumulate" | "windowed" | "fused_collection" | ...
+
+
+@dataclass
+class CacheEvent(Event):
+    """One lookup in the shared sharded-program memoizer
+    (``parallel/_compile_cache.compiled_spmd``)."""
+
+    kind: str = field(init=False, default="spmd_cache_hit")
+    hit: bool = True
+
+    def __post_init__(self) -> None:
+        self.kind = "spmd_cache_hit" if self.hit else "spmd_cache_miss"
+
+
+@dataclass
+class RouteDowngradeEvent(Event):
+    """A call-time fast-path decider fell back to a slower formulation
+    (``routing.warn_route_downgrade``) — recorded on EVERY occurrence,
+    unlike the warning, which dedupes per callsite."""
+
+    kind: str = field(init=False, default="route_downgrade")
+    route_kind: str = ""
+    message: str = ""
+
+
+@dataclass
+class BucketPadEvent(Event):
+    """One ragged batch padded to its power-of-two bucket
+    (``metrics/_bucket.pad_to_bucket``): ``rows_padded / bucket`` is the
+    wasted compute fraction of that dispatch."""
+
+    kind: str = field(init=False, default="bucket_pad")
+    bucket: int = 0
+    rows_valid: int = 0
+    rows_padded: int = 0
+
+
+@dataclass
+class DonationEvent(Event):
+    """Buffer-donation lifecycle on the fused update paths: ``abort``
+    when a donated update died mid-trace/mid-flight, ``restore`` when a
+    consumed state buffer was re-materialized from its registry default."""
+
+    kind: str = field(init=False, default="donation_restore")
+    action: str = "restore"  # "restore" | "abort"
+
+    def __post_init__(self) -> None:
+        self.kind = f"donation_{self.action}"
+
+
+@dataclass
+class SyncEvent(Event):
+    """One cross-device/cross-process merge: collective wall-clock
+    seconds (dispatch + block_until_ready, or host wire round trip) and
+    the merged payload size in bytes."""
+
+    kind: str = field(init=False, default="sync")
+    op: str = ""
+    seconds: float = 0.0
+    payload_bytes: int = 0
+
+
+@dataclass
+class SpanEvent(Event):
+    """A timed metric phase (``update`` / ``compute`` / ``dispatch``)
+    with the metric's state-memory footprint after the phase."""
+
+    kind: str = field(init=False, default="span")
+    phase: str = "update"
+    name: str = ""
+    seconds: float = 0.0
+    state_bytes: int = 0
+
+
+# Every event kind the bus can carry → its dataclass, for the JSON-lines
+# round trip (``export.event_from_dict``).
+KIND_TO_CLASS: Dict[str, type] = {
+    "retrace": RetraceEvent,
+    "spmd_cache_hit": CacheEvent,
+    "spmd_cache_miss": CacheEvent,
+    "route_downgrade": RouteDowngradeEvent,
+    "bucket_pad": BucketPadEvent,
+    "donation_restore": DonationEvent,
+    "donation_abort": DonationEvent,
+    "sync": SyncEvent,
+    "span": SpanEvent,
+}
+
+
+# ----------------------------------------------------------------- aggregates
+def _zero_aggregates() -> Dict[str, Any]:
+    return {
+        "retrace": {},          # (program, callsite) -> count
+        "cache": {"hits": 0, "misses": 0},
+        "route_downgrade": {},  # (route_kind, callsite) -> count
+        "bucket_pad": {},       # bucket -> {"rows_valid": n, "rows_padded": n, "calls": n}
+        "donation": {"restore": 0, "abort": 0},
+        # op -> {"calls", "seconds", "payload_bytes", "hist": [..]}
+        "sync": {},
+        # (name, phase) -> {"calls", "seconds", "state_bytes", "hist": [..]}
+        "spans": {},
+        "emitted": 0,
+    }
+
+
+_agg: Dict[str, Any] = _zero_aggregates()
+
+
+def _hist_slot(seconds: float) -> int:
+    for i, le in enumerate(DURATION_BUCKETS):
+        if seconds <= le:
+            return i
+    return len(DURATION_BUCKETS)
+
+
+# ------------------------------------------------------------------- control
+def enable(
+    *, capacity: Optional[int] = None, annotate: Optional[bool] = None
+) -> None:
+    """Turn the bus on (equivalently: ``TORCHEVAL_TPU_TELEMETRY=1``).
+
+    ``capacity`` resizes the ring buffer (existing events are kept up to
+    the new bound); ``annotate=True`` additionally wraps update/compute
+    spans in ``jax.profiler.TraceAnnotation`` via
+    :func:`torcheval_tpu.tools.profiling.annotate`.
+    """
+    global ENABLED, ANNOTATE, _events
+    with _lock:
+        if capacity is not None:
+            if capacity < 1:
+                raise ValueError(f"capacity must be >= 1, got {capacity}")
+            _events = deque(_events, maxlen=int(capacity))
+        if annotate is not None:
+            ANNOTATE = bool(annotate)
+        ENABLED = True
+
+
+def disable() -> None:
+    """Turn the bus off — hooks go back to their single disabled branch.
+    Captured events and counters are kept (drain/inspect after a run)."""
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def clear() -> None:
+    """Drop every captured event and zero the aggregates (test hook)."""
+    global _dropped, _agg
+    with _lock:
+        _events.clear()
+        _dropped = 0
+        _agg = _zero_aggregates()
+
+
+def capacity() -> int:
+    return _events.maxlen or 0
+
+
+def dropped() -> int:
+    """Events evicted from the ring since the last :func:`clear`."""
+    return _dropped
+
+
+def events(kind: Optional[str] = None) -> List[Event]:
+    """Snapshot of the ring buffer, oldest first, optionally filtered by
+    ``kind`` (safe to hold; the bus keeps emitting)."""
+    with _lock:
+        snap = list(_events)
+    if kind is None:
+        return snap
+    return [e for e in snap if e.kind == kind]
+
+
+def aggregates() -> Dict[str, Any]:
+    """Deep-enough copy of the aggregate counters (exporter feed)."""
+    with _lock:
+        return {
+            "retrace": dict(_agg["retrace"]),
+            "cache": dict(_agg["cache"]),
+            "route_downgrade": dict(_agg["route_downgrade"]),
+            "bucket_pad": {
+                k: dict(v) for k, v in _agg["bucket_pad"].items()
+            },
+            "donation": dict(_agg["donation"]),
+            "sync": {k: _copy_hist_entry(v) for k, v in _agg["sync"].items()},
+            "spans": {k: _copy_hist_entry(v) for k, v in _agg["spans"].items()},
+            "emitted": _agg["emitted"],
+        }
+
+
+def _copy_hist_entry(entry: Dict[str, Any]) -> Dict[str, Any]:
+    out = dict(entry)
+    out["hist"] = list(entry["hist"])
+    return out
+
+
+# ------------------------------------------------------------------ emission
+def _callsite() -> str:
+    from torcheval_tpu.routing import _user_callsite
+
+    filename, lineno = _user_callsite()
+    return f"{filename}:{lineno}"
+
+
+def emit(event: Event) -> None:
+    """Append ``event`` to the ring and fold it into the aggregates.
+    Timestamp/callsite are stamped here when the caller left defaults."""
+    global _dropped
+    if event.time_s == 0.0:
+        event.time_s = time.monotonic()
+    if event.callsite == "<unknown>:0":
+        event.callsite = _callsite()
+    with _lock:
+        if len(_events) == _events.maxlen:
+            _dropped += 1
+        _events.append(event)
+        _agg["emitted"] += 1
+        _fold(event)
+
+
+def _fold(event: Event) -> None:
+    """Update aggregates for one event.  Caller holds ``_lock``."""
+    if isinstance(event, RetraceEvent):
+        key = (event.program, event.callsite)
+        _agg["retrace"][key] = _agg["retrace"].get(key, 0) + 1
+    elif isinstance(event, CacheEvent):
+        _agg["cache"]["hits" if event.hit else "misses"] += 1
+    elif isinstance(event, RouteDowngradeEvent):
+        key = (event.route_kind, event.callsite)
+        _agg["route_downgrade"][key] = (
+            _agg["route_downgrade"].get(key, 0) + 1
+        )
+    elif isinstance(event, BucketPadEvent):
+        entry = _agg["bucket_pad"].setdefault(
+            event.bucket, {"rows_valid": 0, "rows_padded": 0, "calls": 0}
+        )
+        entry["rows_valid"] += event.rows_valid
+        entry["rows_padded"] += event.rows_padded
+        entry["calls"] += 1
+    elif isinstance(event, DonationEvent):
+        _agg["donation"][event.action] = (
+            _agg["donation"].get(event.action, 0) + 1
+        )
+    elif isinstance(event, SyncEvent):
+        entry = _agg["sync"].setdefault(
+            event.op,
+            {
+                "calls": 0,
+                "seconds": 0.0,
+                "payload_bytes": 0,
+                "hist": [0] * (len(DURATION_BUCKETS) + 1),
+            },
+        )
+        entry["calls"] += 1
+        entry["seconds"] += event.seconds
+        entry["payload_bytes"] += event.payload_bytes
+        entry["hist"][_hist_slot(event.seconds)] += 1
+    elif isinstance(event, SpanEvent):
+        entry = _agg["spans"].setdefault(
+            (event.name, event.phase),
+            {
+                "calls": 0,
+                "seconds": 0.0,
+                "state_bytes": 0,
+                "hist": [0] * (len(DURATION_BUCKETS) + 1),
+            },
+        )
+        entry["calls"] += 1
+        entry["seconds"] += event.seconds
+        entry["state_bytes"] = event.state_bytes  # last observed footprint
+        entry["hist"][_hist_slot(event.seconds)] += 1
+
+
+# ------------------------------------------------------- typed record helpers
+# One helper per hook site.  Callers MUST branch on ENABLED before calling
+# (the zero-overhead contract); the helpers do not re-check.
+def record_retrace(program: str) -> None:
+    emit(RetraceEvent(program=program))
+
+
+def record_cache(hit: bool) -> None:
+    emit(CacheEvent(hit=hit))
+
+
+def record_route_downgrade(
+    route_kind: str, message: str, callsite: Optional[str] = None
+) -> None:
+    emit(
+        RouteDowngradeEvent(
+            route_kind=route_kind,
+            message=message,
+            callsite=callsite or "<unknown>:0",
+        )
+    )
+
+
+def record_bucket_pad(bucket: int, rows_valid: int, rows_padded: int) -> None:
+    emit(
+        BucketPadEvent(
+            bucket=int(bucket),
+            rows_valid=int(rows_valid),
+            rows_padded=int(rows_padded),
+        )
+    )
+
+
+def record_donation(action: str) -> None:
+    emit(DonationEvent(action=action))
+
+
+def record_sync(op: str, seconds: float, payload_bytes: int) -> None:
+    emit(
+        SyncEvent(
+            op=op, seconds=float(seconds), payload_bytes=int(payload_bytes)
+        )
+    )
+
+
+def record_span(
+    phase: str, name: str, seconds: float, state_bytes: int
+) -> None:
+    emit(
+        SpanEvent(
+            phase=phase,
+            name=name,
+            seconds=float(seconds),
+            state_bytes=int(state_bytes),
+        )
+    )
+
+
+# --------------------------------------------------------------- span helper
+def state_nbytes(metric: Any) -> int:
+    """Total bytes of a metric's registered states — tracer-safe (at
+    trace time, sizes come from the aval's shape/dtype)."""
+    total = 0
+    for name in getattr(metric, "_state_name_to_default", {}):
+        value = getattr(metric, name, None)
+        if isinstance(value, dict):
+            leaves = list(value.values())
+        elif isinstance(value, (list, tuple, deque)):
+            leaves = list(value)
+        else:
+            leaves = [value]
+        for leaf in leaves:
+            try:
+                shape = leaf.shape
+                itemsize = leaf.dtype.itemsize
+            except AttributeError:
+                continue
+            n = 1
+            for d in shape:
+                n *= int(d)
+            total += n * itemsize
+    return total
+
+
+def timed_phase(obj: Any, phase: str, fn, args, kwargs):
+    """Run ``fn(obj, *args, **kwargs)`` as a recorded ``phase`` span
+    (optionally under a profiler ``TraceAnnotation``).  Only called from
+    hook wrappers after their ENABLED branch."""
+    name = type(obj).__name__
+    if ANNOTATE:
+        from torcheval_tpu.tools.profiling import annotate
+
+        with annotate(f"torcheval_tpu.{name}.{phase}"):
+            t0 = time.monotonic()
+            out = fn(obj, *args, **kwargs)
+            seconds = time.monotonic() - t0
+    else:
+        t0 = time.monotonic()
+        out = fn(obj, *args, **kwargs)
+        seconds = time.monotonic() - t0
+    record_span(phase, name, seconds, state_nbytes(obj))
+    return out
+
+
+def event_fields(cls: type) -> Tuple[str, ...]:
+    """The dataclass field names of an event class (exporter helper)."""
+    return tuple(f.name for f in fields(cls))

@@ -1,0 +1,335 @@
+"""Exporters draining the telemetry bus: JSON-lines, Prometheus text
+format, and the report formatter.
+
+* :func:`export_jsonl` — one JSON object per captured event, suitable for
+  ``jq``/pandas post-mortems; :func:`event_from_dict` round-trips a line
+  back into its typed event.
+* :func:`prometheus_text` — a text-format snapshot of the aggregate
+  counters/histograms (scrape it from a debug handler, or write it to a
+  node-exporter textfile-collector directory).
+* ``jax.profiler.TraceAnnotation`` spans are not a drain but a live
+  export: enable them with ``telemetry.enable(annotate=True)`` (or
+  ``TORCHEVAL_TPU_TELEMETRY_ANNOTATE=1``) and every update/compute span
+  lands in TensorBoard/Perfetto traces via
+  :func:`torcheval_tpu.tools.profiling.annotate`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+from typing import Any, Dict, List, Optional, TextIO, Union
+
+from torcheval_tpu.telemetry import events as _events
+
+_PREFIX = "torcheval_tpu"
+
+
+# ------------------------------------------------------------------ JSON-lines
+def event_to_dict(event: "_events.Event") -> Dict[str, Any]:
+    return dataclasses.asdict(event)
+
+
+def event_from_dict(payload: Dict[str, Any]) -> "_events.Event":
+    """Rebuild the typed event a JSON line came from (inverse of
+    :func:`event_to_dict`)."""
+    kind = payload.get("kind")
+    cls = _events.KIND_TO_CLASS.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown telemetry event kind {kind!r}")
+    kwargs = {
+        k: v
+        for k, v in payload.items()
+        if k != "kind" and k in _events.event_fields(cls)
+    }
+    event = cls(**kwargs)
+    if event.kind != kind:
+        raise ValueError(
+            f"payload kind {kind!r} does not match rebuilt {event.kind!r}"
+        )
+    return event
+
+
+def export_jsonl(
+    target: Union[str, "os.PathLike", TextIO],
+    kind: Optional[str] = None,
+) -> int:
+    """Write every captured event (oldest first, optionally filtered by
+    ``kind``) as JSON lines to a path or open text file.  Returns the
+    number of events written."""
+    snapshot = _events.events(kind)
+    if isinstance(target, (str, os.PathLike)):
+        with open(target, "w", encoding="utf-8") as fh:
+            return _write_jsonl(fh, snapshot)
+    return _write_jsonl(target, snapshot)
+
+
+def _write_jsonl(fh: TextIO, snapshot: List["_events.Event"]) -> int:
+    for event in snapshot:
+        fh.write(json.dumps(event_to_dict(event), sort_keys=True))
+        fh.write("\n")
+    return len(snapshot)
+
+
+def read_jsonl(source: Union[str, "os.PathLike", TextIO]) -> List["_events.Event"]:
+    """Parse a JSON-lines dump back into typed events."""
+    if isinstance(source, (str, os.PathLike)):
+        with open(source, "r", encoding="utf-8") as fh:
+            lines = fh.readlines()
+    else:
+        lines = source.readlines()
+    return [event_from_dict(json.loads(line)) for line in lines if line.strip()]
+
+
+# ------------------------------------------------------------------ Prometheus
+def _label_escape(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _labels(**labels: Any) -> str:
+    inner = ",".join(
+        f'{k}="{_label_escape(v)}"' for k, v in labels.items()
+    )
+    return f"{{{inner}}}" if inner else ""
+
+
+def _fmt(value: float) -> str:
+    # Prometheus floats: integers render without the trailing .0 noise.
+    if float(value) == int(value):
+        return str(int(value))
+    return repr(float(value))
+
+
+def _histogram_lines(
+    out: List[str], family: str, labels: Dict[str, Any], entry: Dict[str, Any]
+) -> None:
+    cumulative = 0
+    for le, count in zip(_events.DURATION_BUCKETS, entry["hist"]):
+        cumulative += count
+        out.append(
+            f"{family}_bucket{_labels(**labels, le=_fmt(le))} {cumulative}"
+        )
+    cumulative += entry["hist"][-1]
+    out.append(f'{family}_bucket{_labels(**labels, le="+Inf")} {cumulative}')
+    out.append(f"{family}_sum{_labels(**labels)} {_fmt(entry['seconds'])}")
+    out.append(f"{family}_count{_labels(**labels)} {entry['calls']}")
+
+
+def prometheus_text() -> str:
+    """Text-format (version 0.0.4) snapshot of the aggregate counters and
+    duration histograms.  Labels carry only low-cardinality dimensions
+    (event program/kind/op/bucket/phase) — callsites stay in the
+    JSON-lines feed and :func:`torcheval_tpu.telemetry.report`."""
+    agg = _events.aggregates()
+    out: List[str] = []
+
+    out.append(
+        f"# HELP {_PREFIX}_telemetry_events_total "
+        "Events captured by the telemetry bus since the last clear."
+    )
+    out.append(f"# TYPE {_PREFIX}_telemetry_events_total counter")
+    out.append(f"{_PREFIX}_telemetry_events_total {agg['emitted']}")
+    out.append(
+        f"# TYPE {_PREFIX}_telemetry_events_dropped_total counter"
+    )
+    out.append(
+        f"{_PREFIX}_telemetry_events_dropped_total {_events.dropped()}"
+    )
+
+    out.append(
+        f"# HELP {_PREFIX}_retrace_total Update-program traces by program "
+        "kind (each one is a compile)."
+    )
+    out.append(f"# TYPE {_PREFIX}_retrace_total counter")
+    per_program: Dict[str, int] = {}
+    for (program, _callsite), count in agg["retrace"].items():
+        per_program[program] = per_program.get(program, 0) + count
+    for program, count in sorted(per_program.items()):
+        out.append(
+            f"{_PREFIX}_retrace_total{_labels(program=program)} {count}"
+        )
+
+    out.append(
+        f"# HELP {_PREFIX}_spmd_cache_total Sharded-program memoizer "
+        "lookups by result."
+    )
+    out.append(f"# TYPE {_PREFIX}_spmd_cache_total counter")
+    out.append(
+        f"{_PREFIX}_spmd_cache_total{_labels(result='hit')} "
+        f"{agg['cache']['hits']}"
+    )
+    out.append(
+        f"{_PREFIX}_spmd_cache_total{_labels(result='miss')} "
+        f"{agg['cache']['misses']}"
+    )
+
+    out.append(
+        f"# HELP {_PREFIX}_route_downgrade_total Call-time fast-path "
+        "downgrades by route kind."
+    )
+    out.append(f"# TYPE {_PREFIX}_route_downgrade_total counter")
+    per_kind: Dict[str, int] = {}
+    for (route_kind, _callsite), count in agg["route_downgrade"].items():
+        per_kind[route_kind] = per_kind.get(route_kind, 0) + count
+    for route_kind, count in sorted(per_kind.items()):
+        out.append(
+            f"{_PREFIX}_route_downgrade_total{_labels(kind=route_kind)} "
+            f"{count}"
+        )
+
+    out.append(
+        f"# HELP {_PREFIX}_bucket_pad_rows_total Rows through the ragged "
+        "bucketing pad by bucket and validity."
+    )
+    out.append(f"# TYPE {_PREFIX}_bucket_pad_rows_total counter")
+    for bucket in sorted(agg["bucket_pad"]):
+        entry = agg["bucket_pad"][bucket]
+        out.append(
+            f"{_PREFIX}_bucket_pad_rows_total"
+            f"{_labels(bucket=bucket, status='valid')} "
+            f"{entry['rows_valid']}"
+        )
+        out.append(
+            f"{_PREFIX}_bucket_pad_rows_total"
+            f"{_labels(bucket=bucket, status='padded')} "
+            f"{entry['rows_padded']}"
+        )
+
+    out.append(
+        f"# HELP {_PREFIX}_donation_total Donated-buffer aborts and "
+        "default restores on the fused update paths."
+    )
+    out.append(f"# TYPE {_PREFIX}_donation_total counter")
+    for action in sorted(agg["donation"]):
+        out.append(
+            f"{_PREFIX}_donation_total{_labels(action=action)} "
+            f"{agg['donation'][action]}"
+        )
+
+    out.append(
+        f"# HELP {_PREFIX}_sync_seconds Collective merge wall time by op."
+    )
+    out.append(f"# TYPE {_PREFIX}_sync_seconds histogram")
+    for op in sorted(agg["sync"]):
+        _histogram_lines(
+            out, f"{_PREFIX}_sync_seconds", {"op": op}, agg["sync"][op]
+        )
+    out.append(
+        f"# TYPE {_PREFIX}_sync_payload_bytes_total counter"
+    )
+    for op in sorted(agg["sync"]):
+        out.append(
+            f"{_PREFIX}_sync_payload_bytes_total{_labels(op=op)} "
+            f"{agg['sync'][op]['payload_bytes']}"
+        )
+
+    out.append(
+        f"# HELP {_PREFIX}_span_seconds Metric phase wall time by metric "
+        "and phase."
+    )
+    out.append(f"# TYPE {_PREFIX}_span_seconds histogram")
+    for name, phase in sorted(agg["spans"]):
+        _histogram_lines(
+            out,
+            f"{_PREFIX}_span_seconds",
+            {"metric": name, "phase": phase},
+            agg["spans"][(name, phase)],
+        )
+    out.append(
+        f"# HELP {_PREFIX}_span_state_bytes Last observed state-memory "
+        "footprint by metric and phase."
+    )
+    out.append(f"# TYPE {_PREFIX}_span_state_bytes gauge")
+    for name, phase in sorted(agg["spans"]):
+        out.append(
+            f"{_PREFIX}_span_state_bytes"
+            f"{_labels(metric=name, phase=phase)} "
+            f"{agg['spans'][(name, phase)]['state_bytes']}"
+        )
+
+    return "\n".join(out) + "\n"
+
+
+# --------------------------------------------------------------------- report
+def format_report(report: Dict[str, Any]) -> str:
+    """Render :func:`torcheval_tpu.telemetry.report`'s dict as the
+    human-readable health summary."""
+    buf = io.StringIO()
+    state = "ENABLED" if report.get("enabled") else "disabled"
+    buf.write(f"torcheval_tpu telemetry ({state})\n")
+    tc = report.get("trace_counts", {})
+    buf.write(
+        f"  traces built: {sum(tc.values())} "
+        f"({', '.join(f'{k}={v}' for k, v in sorted(tc.items())) or 'none'})\n"
+    )
+    cache = report.get("spmd_cache", {})
+    buf.write(
+        f"  spmd cache: {cache.get('hits', 0)} hits / "
+        f"{cache.get('misses', 0)} misses "
+        f"(hit rate {cache.get('hit_rate', 0.0):.2f}, "
+        f"{cache.get('currsize', 0)} live programs)\n"
+    )
+    offenders = report.get("retrace", {}).get("top_offenders", [])
+    if offenders:
+        buf.write("  top retrace offenders:\n")
+        for item in offenders:
+            buf.write(
+                f"    {item['count']:>4}x {item['program']} @ "
+                f"{item['callsite']}\n"
+            )
+    pad = report.get("bucket_pad", {})
+    if pad.get("per_bucket"):
+        buf.write(
+            f"  bucket padding: {pad['rows_padded']} padded / "
+            f"{pad['rows_valid']} valid rows "
+            f"(waste {pad['waste_pct']:.1f}%)\n"
+        )
+        for bucket, entry in sorted(pad["per_bucket"].items()):
+            buf.write(
+                f"    bucket {bucket}: {entry['rows_padded']} padded / "
+                f"{entry['rows_valid']} valid over {entry['calls']} calls "
+                f"(waste {entry['waste_pct']:.1f}%)\n"
+            )
+    downs = report.get("route_downgrades", {})
+    if downs.get("total"):
+        by_kind = ", ".join(
+            f"{k}={v}" for k, v in sorted(downs["by_kind"].items())
+        )
+        buf.write(f"  route downgrades: {downs['total']} ({by_kind})\n")
+    donation = report.get("donation", {})
+    if donation.get("abort") or donation.get("restore"):
+        buf.write(
+            f"  donation: {donation.get('abort', 0)} aborts, "
+            f"{donation.get('restore', 0)} default restores\n"
+        )
+    slowest = report.get("sync", {}).get("slowest", [])
+    if slowest:
+        buf.write("  slowest collectives:\n")
+        for item in slowest:
+            buf.write(
+                f"    {item['seconds'] * 1e3:8.3f} ms  {item['op']} "
+                f"({item['payload_bytes']} B) @ {item['callsite']}\n"
+            )
+    spans = report.get("spans", {})
+    if spans:
+        buf.write("  metric phases:\n")
+        for key in sorted(spans):
+            entry = spans[key]
+            buf.write(
+                f"    {key}: {entry['calls']} calls, "
+                f"{entry['seconds'] * 1e3:.3f} ms total, "
+                f"state {entry['state_bytes']} B\n"
+            )
+    buf.write(
+        f"  events: {report.get('events_captured', 0)} captured, "
+        f"{report.get('events_dropped', 0)} dropped "
+        f"(ring capacity {report.get('ring_capacity', 0)})\n"
+    )
+    return buf.getvalue()

@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Sequence
 
 import jax
 
+from torcheval_tpu.telemetry import events as _telemetry
+
 
 @contextlib.contextmanager
 def trace(log_dir: str, *, create_perfetto_link: bool = False) -> Iterator[None]:
@@ -45,7 +47,12 @@ def trace(log_dir: str, *, create_perfetto_link: bool = False) -> Iterator[None]
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
     """Label the enclosed host span in the trace (``TraceAnnotation``), so
-    per-metric phases are attributable in the timeline."""
+    per-metric phases are attributable in the timeline.
+
+    This is also the entry point :mod:`torcheval_tpu.telemetry` uses for
+    automatic span annotation (``telemetry.enable(annotate=True)`` labels
+    every metric update/compute with ``torcheval_tpu.<Metric>.<phase>``).
+    """
     with jax.profiler.TraceAnnotation(name):
         yield
 
@@ -222,8 +229,17 @@ class ProfiledMetric:
                         if x is not None and not isinstance(x, jax.core.Tracer)
                     ]
                 )
-            stats.seconds += time.perf_counter() - t0
+            elapsed = time.perf_counter() - t0
+            stats.seconds += elapsed
         stats.calls += 1
+        if _telemetry.ENABLED and phase in ("merge_state", "reset"):
+            # Bridge the two lifecycle phases the Metric-level telemetry
+            # wrapper (metric.py) does NOT cover into the event bus;
+            # update/compute spans already come from the inner metric, so
+            # re-emitting them here would double count.
+            _telemetry.record_span(
+                phase, self._name, elapsed, self.state_bytes()
+            )
         return out
 
     def update(self, *args: Any, **kwargs: Any) -> "ProfiledMetric":
