@@ -83,6 +83,31 @@ def _drive_hot_path() -> None:
 
     pad_to_bucket(jnp.ones((5, 2)))
 
+    # The streaming engine (scan-fused blocks + prefetch thread) must
+    # stay just as cold: its block spans, dispatch counters, and
+    # prefetch-stall hooks are all ENABLED-gated.
+    from torcheval_tpu.engine import Evaluator
+
+    col2 = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=c, average="macro"),
+            "f1": MulticlassF1Score(num_classes=c, average="macro"),
+        },
+        bucket=True,
+    )
+    stream = [
+        (
+            jnp.asarray(rng.random((b, c), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, c, b).astype(np.int32)),
+        )
+        for b in (33, 70, 150, 97, 40)  # ragged incl. a partial tail
+    ]
+    evaluator = Evaluator(col2, block_size=2, prefetch=True)
+    evaluator.run(stream)
+    jnp.asarray(
+        list(evaluator.result().values())[0]
+    ).block_until_ready()
+
 
 def check(verbose: bool = True) -> List[str]:
     """Assert zero hook calls on the disabled path; returns the guarded

@@ -1,0 +1,352 @@
+"""Evaluator: scan-fused block parity (bit-identical to the per-batch
+fused loop), dispatch accounting, warmup, snapshots, and abort safety
+(torcheval_tpu/engine/)."""
+
+import os
+import unittest
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu import aot, telemetry
+from torcheval_tpu.engine import Evaluator
+from torcheval_tpu.metrics import (
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    Sum,
+)
+
+pytestmark = pytest.mark.engine
+
+_C = 7
+
+
+def _collection(bucket=True):
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+            "f1": MulticlassF1Score(num_classes=_C, average="macro"),
+            "cm": MulticlassConfusionMatrix(num_classes=_C),
+        },
+        bucket=bucket,
+    )
+
+
+def _stream(sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.random((b, _C), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, _C, b).astype(np.int32)),
+        )
+        for b in sizes
+    ]
+
+
+RAGGED = (33, 70, 150, 97, 40, 256, 12, 130, 64, 99, 201, 5)
+
+
+def _reference(batches, bucket=True):
+    """The per-batch fused loop the engine must match bit-for-bit."""
+    col = _collection(bucket=bucket)
+    for args in batches:
+        col.fused_update(*args)
+    return col
+
+
+def _assert_states_bitwise(test, col_a, col_b):
+    a, b = col_a.state_dict(), col_b.state_dict()
+    test.assertEqual(set(a), set(b))
+    for key in a:
+        na, nb = np.asarray(a[key]), np.asarray(b[key])
+        test.assertEqual(na.dtype, nb.dtype, key)
+        test.assertEqual(na.shape, nb.shape, key)
+        test.assertEqual(na.tobytes(), nb.tobytes(), f"{key} not bit-identical")
+
+
+class TestScanParity(unittest.TestCase):
+    """ISSUE acceptance: Evaluator.run over a ragged stream is
+    bit-identical to the per-batch fused_update loop."""
+
+    def test_bucketed_parity_with_prefetch(self):
+        batches = _stream(RAGGED)
+        col = _collection()
+        ev = Evaluator(col, block_size=4, prefetch=True).run(batches)
+        _assert_states_bitwise(self, col, _reference(batches))
+        # All 12 batches rode scan blocks: 3 dispatches, no fallback.
+        self.assertEqual(ev.blocks_dispatched, 3)
+        self.assertEqual(ev.batches_seen, len(batches))
+
+    def test_bucketed_parity_without_prefetch(self):
+        batches = _stream(RAGGED, seed=1)
+        col = _collection()
+        Evaluator(col, block_size=4, prefetch=False).run(batches)
+        _assert_states_bitwise(self, col, _reference(batches))
+
+    def test_partial_tail_block_is_masked_not_dropped(self):
+        # 5 batches, block_size 4: the tail block carries 3 pad steps.
+        batches = _stream(RAGGED[:5], seed=2)
+        col = _collection()
+        ev = Evaluator(col, block_size=4).run(batches)
+        self.assertEqual(ev.blocks_dispatched, 2)
+        _assert_states_bitwise(self, col, _reference(batches))
+
+    def test_unbucketed_uniform_blocks_with_perbatch_tail(self):
+        # Exact-shape mode: two full scan blocks, a ragged tail that
+        # must fall back to the per-batch path — order preserved.
+        sizes = (64, 64, 64, 64, 64, 64, 64, 64, 32, 17)
+        batches = _stream(sizes, seed=3)
+        col = _collection(bucket=False)
+        ev = Evaluator(col, block_size=4, bucket=False).run(batches)
+        self.assertEqual(ev.blocks_dispatched, 2)
+        self.assertEqual(ev.batches_seen, len(sizes))
+        _assert_states_bitwise(self, col, _reference(batches, bucket=False))
+
+    def test_compute_matches_reference_values(self):
+        batches = _stream(RAGGED, seed=4)
+        col = _collection()
+        out = Evaluator(col, block_size=8).run(batches).result()
+        ref = _reference(batches).compute()
+        for name in ref:
+            np.testing.assert_array_equal(
+                np.asarray(out[name]), np.asarray(ref[name]), err_msg=name
+            )
+
+    def test_interleaving_direct_fused_updates_stays_consistent(self):
+        # The engine installs states through the same plumbing as
+        # fused_update, so mixing the two entry points is well-defined.
+        batches = _stream(RAGGED[:8], seed=5)
+        col = _collection()
+        ev = Evaluator(col, block_size=4)
+        ev.run(batches[:4])
+        col.fused_update(*batches[4])
+        ev.run(batches[5:])
+        _assert_states_bitwise(self, col, _reference(batches))
+
+
+class TestDonationParity(unittest.TestCase):
+    """Donation flips the block program to in-place aliasing; results
+    must stay bit-identical and abort-safe."""
+
+    def setUp(self):
+        self._prev = os.environ.get("TORCHEVAL_TPU_DONATE")
+        os.environ["TORCHEVAL_TPU_DONATE"] = "1"
+
+    def tearDown(self):
+        if self._prev is None:
+            os.environ.pop("TORCHEVAL_TPU_DONATE", None)
+        else:
+            os.environ["TORCHEVAL_TPU_DONATE"] = self._prev
+
+    def test_donated_parity(self):
+        batches = _stream(RAGGED, seed=6)
+        col = _collection()
+        Evaluator(col, block_size=4).run(batches)
+        _assert_states_bitwise(self, col, _reference(batches))
+
+    def test_donation_really_aliases_across_blocks(self):
+        batches = _stream((64, 64, 64, 64, 64, 64), seed=7)
+        col = _collection()
+        ev = Evaluator(col, block_size=2, prefetch=False)
+        ev.run(batches[:2])
+        old = col["cm"].confusion_matrix
+        ev.run(batches[2:])
+        self.assertTrue(old.is_deleted())
+        self.assertFalse(col["cm"].confusion_matrix.is_deleted())
+
+
+class TestStepFlushResult(unittest.TestCase):
+    def test_step_buffers_and_auto_dispatches(self):
+        batches = _stream(RAGGED[:5], seed=8)
+        col = _collection()
+        ev = Evaluator(col, block_size=2)
+        for args in batches:
+            ev.step(*args)
+        self.assertEqual(ev.blocks_dispatched, 2)  # one batch pending
+        out = ev.result()  # flushes the partial tail
+        self.assertEqual(ev.blocks_dispatched, 3)
+        self.assertEqual(ev.batches_seen, 5)
+        ref = _reference(batches).compute()
+        np.testing.assert_array_equal(
+            np.asarray(out["cm"]), np.asarray(ref["cm"])
+        )
+
+    def test_step_then_run_joins_pending_batches_in_order(self):
+        batches = _stream(RAGGED[:6], seed=9)
+        col = _collection()
+        ev = Evaluator(col, block_size=4)
+        ev.step(*batches[0])
+        ev.run(batches[1:])
+        _assert_states_bitwise(self, col, _reference(batches))
+
+    def test_single_array_batches(self):
+        vals = [jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 4.0, 5.0])]
+        col = MetricCollection({"s": Sum()}, bucket=False)
+        ev = Evaluator(col, block_size=2, bucket=False).run(vals)
+        self.assertEqual(float(ev.result()["s"]), 15.0)
+
+    def test_reset_between_epochs(self):
+        batches = _stream(RAGGED[:4], seed=10)
+        col = _collection()
+        ev = Evaluator(col, block_size=4)
+        ev.run(batches)
+        col.reset()
+        ev.run(batches)
+        _assert_states_bitwise(self, col, _reference(batches))
+
+
+class TestSnapshots(unittest.TestCase):
+    def test_periodic_snapshots_and_callback(self):
+        batches = _stream((64,) * 8, seed=11)
+        col = _collection()
+        seen = []
+        ev = Evaluator(
+            col,
+            block_size=2,
+            snapshot_every=2,
+            on_snapshot=lambda blocks, vals: seen.append(blocks),
+        )
+        ev.run(batches)
+        self.assertEqual(ev.blocks_dispatched, 4)
+        self.assertEqual(seen, [2, 4])
+        self.assertEqual(len(ev.snapshots), 2)
+        self.assertIs(ev.last_snapshot, ev.snapshots[-1])
+        # The final snapshot equals the final computed values.
+        out = ev.result()
+        np.testing.assert_array_equal(
+            np.asarray(ev.last_snapshot["cm"]), np.asarray(out["cm"])
+        )
+
+
+class TestWarmup(unittest.TestCase):
+    def test_warmed_stream_adds_zero_scan_traces(self):
+        batches = _stream(RAGGED, seed=12)
+        col = _collection()
+        ev = Evaluator(col, block_size=4)
+        sweep = ev.warmup(batches[0], max_batch=max(RAGGED))
+        self.assertTrue(sweep)
+        # Warmup is state-invisible and bypasses the dispatch counters.
+        self.assertEqual(ev.blocks_dispatched, 0)
+        fresh = _collection()
+        _assert_states_bitwise(self, col, fresh)
+        before = aot.trace_count("engine_scan")
+        ev.run(batches)
+        self.assertEqual(aot.trace_count("engine_scan"), before)
+        _assert_states_bitwise(self, col, _reference(batches))
+
+    def test_aot_warmup_delegates_to_evaluator(self):
+        batches = _stream((64, 200), seed=13)
+        ev = Evaluator(_collection(), block_size=2)
+        sweep = aot.warmup(ev, batches[0], max_batch=256)
+        self.assertEqual(sweep, ev.warmup(batches[0], max_batch=256))
+
+
+class TestTelemetryAccounting(unittest.TestCase):
+    def setUp(self):
+        telemetry.disable()
+        telemetry.clear()
+
+    def tearDown(self):
+        telemetry.disable()
+        telemetry.clear()
+
+    def test_dispatch_counters_measure_o_n_over_block(self):
+        batches = _stream(RAGGED, seed=14)
+        telemetry.enable()
+        ev = Evaluator(_collection(), block_size=4)
+        ev.run(batches)
+        rep = telemetry.report()
+        eng = rep["engine"]
+        self.assertEqual(eng["blocks"], 3)
+        self.assertEqual(eng["batches"], 12)
+        self.assertAlmostEqual(eng["dispatches_per_batch"], 0.25)
+        self.assertIn("Evaluator.engine_block", rep["spans"])
+        self.assertEqual(rep["spans"]["Evaluator.engine_block"]["calls"], 3)
+        self.assertIn("Evaluator.prefetch_wait", rep["spans"])
+
+    def test_pad_steps_counted_for_partial_tail(self):
+        batches = _stream(RAGGED[:5], seed=15)
+        telemetry.enable()
+        Evaluator(_collection(), block_size=4).run(batches)
+        self.assertEqual(telemetry.report()["engine"]["pad_steps"], 3)
+
+
+class TestAbortSafety(unittest.TestCase):
+    """ISSUE satellite: a mid-stream failure leaves every member state
+    concrete and resettable, and the run can restart cleanly."""
+
+    def test_prefetch_source_error_propagates_with_usable_states(self):
+        batches = _stream(RAGGED[:8], seed=16)
+
+        def bad_stream():
+            yield from batches[:6]
+            raise ValueError("loader died mid-stream")
+
+        col = _collection()
+        ev = Evaluator(col, block_size=2, prefetch=True)
+        with self.assertRaisesRegex(ValueError, "loader died"):
+            ev.run(bad_stream())
+        # Everything dispatched before the failure stayed applied, and
+        # every state is a live, concrete array — never a tracer, never
+        # a deleted donated buffer.
+        for name in col:
+            for s in col[name]._state_name_to_default:
+                v = getattr(col[name], s)
+                self.assertIsInstance(v, jax.Array, f"{name}.{s}")
+                self.assertFalse(v.is_deleted(), f"{name}.{s}")
+        # Reset + rerun over the full stream: full parity.
+        col.reset()
+        ev.run(batches)
+        _assert_states_bitwise(self, col, _reference(batches))
+
+    def test_exploding_member_restores_states(self):
+        class _Exploding(Sum):
+            def update(self, *args, **kwargs):
+                raise RuntimeError("boom inside the scan trace")
+
+        col = MetricCollection({"s": _Exploding()}, bucket=False)
+        ev = Evaluator(col, block_size=2, bucket=False, prefetch=False)
+        vals = _stream((64, 64), seed=17)
+        with self.assertRaisesRegex(RuntimeError, "boom"):
+            ev.run([(v[0][:, 0],) for v in vals])
+        self.assertEqual(float(col["s"].weighted_sum), 0.0)
+        self.assertFalse(col["s"].weighted_sum.is_deleted())
+
+
+class TestValidation(unittest.TestCase):
+    def test_rejects_non_collection(self):
+        with self.assertRaisesRegex(TypeError, "MetricCollection"):
+            Evaluator(MulticlassAccuracy(num_classes=3))
+
+    def test_rejects_bad_block_size(self):
+        with self.assertRaisesRegex(ValueError, "block_size"):
+            Evaluator(_collection(), block_size=0)
+
+    def test_rejects_bad_snapshot_every(self):
+        with self.assertRaisesRegex(ValueError, "snapshot_every"):
+            Evaluator(_collection(), snapshot_every=0)
+
+    def test_bucket_requires_mask_aware_members(self):
+        col = MetricCollection({"s": Sum()}, bucket=False)
+        with self.assertRaisesRegex(ValueError, "mask-aware"):
+            Evaluator(col, bucket=True)
+
+    def test_bucket_inherited_from_collection(self):
+        self.assertTrue(Evaluator(_collection())._bucket)
+        self.assertFalse(Evaluator(_collection(bucket=False))._bucket)
+
+    def test_unfusable_member_fails_fast(self):
+        from torcheval_tpu.metrics import BinaryAUROC
+
+        col = MetricCollection({"auroc": BinaryAUROC()})
+        with self.assertRaisesRegex(ValueError, "array states"):
+            Evaluator(col)
+
+
+if __name__ == "__main__":
+    unittest.main()

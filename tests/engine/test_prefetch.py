@@ -1,0 +1,125 @@
+"""Prefetcher: ordering, bounded run-ahead, error relay, shutdown
+(torcheval_tpu/engine/prefetch.py)."""
+
+import threading
+import time
+import unittest
+
+import pytest
+
+from torcheval_tpu.engine.prefetch import Prefetcher
+
+pytestmark = pytest.mark.engine
+
+
+def _identity(x):
+    return x
+
+
+class TestPrefetcher(unittest.TestCase):
+    def test_order_and_completeness(self):
+        got = list(Prefetcher(range(50), stage=_identity))
+        self.assertEqual(got, list(range(50)))
+
+    def test_stage_applied_to_every_item(self):
+        got = list(Prefetcher(range(10), stage=lambda x: x * 2))
+        self.assertEqual(got, [x * 2 for x in range(10)])
+
+    def test_default_stage_device_puts(self):
+        import jax
+        import jax.numpy as jnp
+
+        got = list(Prefetcher([jnp.ones(3), jnp.zeros(2)]))
+        self.assertEqual(len(got), 2)
+        self.assertTrue(all(isinstance(a, jax.Array) for a in got))
+
+    def test_bounded_run_ahead(self):
+        # The producer may hold at most depth queued items plus one
+        # in-flight in stage(); a slow consumer must apply backpressure.
+        depth = 2
+        staged = []
+        lock = threading.Lock()
+
+        def counting_stage(x):
+            with lock:
+                staged.append(x)
+            return x
+
+        pf = Prefetcher(range(30), stage=counting_stage, depth=depth)
+        try:
+            consumed = 0
+            for _ in pf:
+                consumed += 1
+                time.sleep(0.005)  # let the producer run ahead if it can
+                with lock:
+                    ahead = len(staged) - consumed
+                self.assertLessEqual(ahead, depth + 1)
+        finally:
+            pf.close()
+        self.assertEqual(consumed, 30)
+
+    def test_source_error_relayed_in_order(self):
+        def source():
+            yield 1
+            yield 2
+            raise ValueError("stream went bad")
+
+        pf = Prefetcher(source(), stage=_identity)
+        try:
+            it = iter(pf)
+            self.assertEqual(next(it), 1)
+            self.assertEqual(next(it), 2)
+            with self.assertRaisesRegex(ValueError, "stream went bad"):
+                next(it)
+            # Errored prefetchers are terminal and joined.
+            with self.assertRaises(StopIteration):
+                next(it)
+            self.assertFalse(pf._thread.is_alive())
+        finally:
+            pf.close()
+
+    def test_stage_error_relayed(self):
+        def bad_stage(x):
+            if x == 3:
+                raise RuntimeError("staging exploded")
+            return x
+
+        pf = Prefetcher(range(10), stage=bad_stage)
+        try:
+            got = []
+            with self.assertRaisesRegex(RuntimeError, "staging exploded"):
+                for item in pf:
+                    got.append(item)
+            self.assertEqual(got, [0, 1, 2])
+        finally:
+            pf.close()
+
+    def test_close_mid_stream_joins_producer(self):
+        def endless():
+            i = 0
+            while True:
+                yield i
+                i += 1
+
+        pf = Prefetcher(endless(), stage=_identity, depth=2)
+        it = iter(pf)
+        self.assertEqual(next(it), 0)
+        self.assertEqual(next(it), 1)
+        pf.close()
+        self.assertFalse(pf._thread.is_alive())
+        with self.assertRaises(StopIteration):
+            next(it)
+        pf.close()  # idempotent
+
+    def test_exhaustion_joins_producer(self):
+        pf = Prefetcher(range(5), stage=_identity)
+        self.assertEqual(list(pf), list(range(5)))
+        self.assertFalse(pf._thread.is_alive())
+
+    def test_rejects_bad_depth(self):
+        with self.assertRaisesRegex(ValueError, "depth"):
+            Prefetcher(range(3), stage=_identity, depth=0)
+
+
+if __name__ == "__main__":
+    unittest.main()

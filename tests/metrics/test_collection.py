@@ -101,6 +101,53 @@ class TestMetricCollection(unittest.TestCase):
         with self.assertRaisesRegex(RuntimeError, "Unexpected keys"):
             coll.load_state_dict(snapshot)
 
+    def test_load_strict_rejects_missing_member(self):
+        # strict=True must refuse a state_dict that silently drops an
+        # ENTIRE member (e.g. a checkpoint from a collection without it)
+        # — and refuse up front, before any other member's state loads.
+        scores, target = _data()
+        coll = _collection().update(scores, target)
+        snapshot = coll.state_dict()
+        before = coll.state_dict()
+        for key in [k for k in snapshot if k.startswith("f1/")]:
+            del snapshot[key]
+        fresh = _collection().update(scores, target)
+        with self.assertRaisesRegex(
+            RuntimeError, r"missing every state of member\(s\) \['f1'\]"
+        ):
+            fresh.load_state_dict(snapshot)
+        # Nothing was half-installed: every member still holds its own
+        # pre-load values.
+        after = fresh.state_dict()
+        for k, v in before.items():
+            np.testing.assert_array_equal(np.asarray(after[k]), np.asarray(v))
+
+    def test_load_strict_reports_missing_and_unexpected_together(self):
+        coll = _collection()
+        snapshot = coll.state_dict()
+        for key in [k for k in snapshot if k.startswith("confusion/")]:
+            del snapshot[key]
+        snapshot["bogus/key"] = jnp.zeros(1)
+        with self.assertRaisesRegex(RuntimeError, "Unexpected keys.*bogus"):
+            _collection().load_state_dict(snapshot)
+        with self.assertRaisesRegex(RuntimeError, r"\['confusion'\]"):
+            _collection().load_state_dict(snapshot)
+
+    def test_load_non_strict_allows_missing_member(self):
+        scores, target = _data()
+        coll = _collection().update(scores, target)
+        snapshot = coll.state_dict()
+        for key in [k for k in snapshot if k.startswith("f1/")]:
+            del snapshot[key]
+        fresh = _collection()
+        fresh.load_state_dict(snapshot, strict=False)
+        np.testing.assert_allclose(
+            np.asarray(fresh.compute()["confusion"]),
+            np.asarray(coll.compute()["confusion"]),
+        )
+        # The absent member simply kept its defaults.
+        self.assertEqual(float(np.asarray(fresh["f1"].num_label)[0]), 0.0)
+
     def test_constructor_validation(self):
         with self.assertRaisesRegex(ValueError, "at least one"):
             MetricCollection({})
@@ -192,6 +239,43 @@ class TestFusedUpdate(unittest.TestCase):
             np.asarray(col["confusion"].compute()),
             np.asarray(plain["confusion"].compute()),
         )
+
+    def test_steady_state_skips_fusability_sweep(self):
+        # Micro-opt: the per-member fusability sweep runs once per call
+        # signature; a steady-state stream of repeated shapes (a jit
+        # cache hit) must not pay it again.
+        from unittest import mock
+
+        col = _collection()
+        with mock.patch.object(
+            MetricCollection, "_check_fusable", autospec=True,
+            side_effect=MetricCollection._check_fusable,
+        ) as check:
+            col.fused_update(*_data(0))
+            col.fused_update(*_data(1))  # same signature: no sweep
+            col.fused_update(*_data(2))
+            self.assertEqual(check.call_count, 1)
+            col.fused_update(*_data(3, n=64))  # new shape: one more sweep
+            self.assertEqual(check.call_count, 2)
+            col.fused_update(*_data(4, n=64))
+            self.assertEqual(check.call_count, 2)
+
+    def test_failed_signature_is_not_cached(self):
+        # A signature whose sweep raised must be re-checked next call —
+        # only successful dispatches mark a signature as seen.
+        from unittest import mock
+
+        from torcheval_tpu.metrics import BinaryAUROC
+
+        col = MetricCollection({"auroc": BinaryAUROC()})
+        with mock.patch.object(
+            MetricCollection, "_check_fusable", autospec=True,
+            side_effect=MetricCollection._check_fusable,
+        ) as check:
+            for _ in range(2):
+                with self.assertRaisesRegex(ValueError, "array states"):
+                    col.fused_update(jnp.zeros(4), jnp.zeros(4))
+            self.assertEqual(check.call_count, 2)
 
     def test_buffer_member_rejected(self):
         from torcheval_tpu.metrics import BinaryAUROC

@@ -141,6 +141,11 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         # donation_restore / donation_abort.
         ev.record_donation("restore")
         ev.record_donation("abort")
+        # engine_block / prefetch_stall — the streaming engine's hooks
+        # (the real dispatch path is covered by tests/engine; recording
+        # directly keeps this round-trip fast and deterministic).
+        ev.record_engine_block(4, 3, 1)
+        ev.record_prefetch_stall(0.002)
         # sync — the in-process wire simulation's hook.
         LocalWorld(2).run(lambda g, r: g.all_gather_object({"rank": r}))
         # span — the Metric phase wrapper.
@@ -190,6 +195,10 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         )
         self.assertIn('torcheval_tpu_donation_total{action="abort"} 1', text)
         self.assertIn('torcheval_tpu_donation_total{action="restore"} 1', text)
+        self.assertIn("torcheval_tpu_engine_blocks_total 1", text)
+        self.assertIn("torcheval_tpu_engine_batches_total 3", text)
+        self.assertIn("torcheval_tpu_engine_pad_steps_total 1", text)
+        self.assertIn("torcheval_tpu_engine_prefetch_stall_total 1", text)
         self.assertIn(
             'torcheval_tpu_sync_seconds_count{op="local_all_gather_object"} 2',
             text,
@@ -217,6 +226,10 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         self.assertEqual(rep["bucket_pad"]["rows_valid"], 5)
         self.assertEqual(rep["bucket_pad"]["rows_padded"], 123)
         self.assertEqual(rep["donation"], {"restore": 1, "abort": 1})
+        self.assertEqual(rep["engine"]["blocks"], 1)
+        self.assertEqual(rep["engine"]["batches"], 3)
+        self.assertEqual(rep["engine"]["prefetch_stalls"], 1)
+        self.assertAlmostEqual(rep["engine"]["dispatches_per_batch"], 1 / 3)
         self.assertEqual(rep["sync"]["calls"], 2)
         self.assertTrue(rep["sync"]["slowest"])
         self.assertIn("BinaryAccuracy.update", rep["spans"])

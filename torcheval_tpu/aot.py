@@ -74,8 +74,19 @@ def warmup(
     State is snapshotted before and restored after (checkpoint
     round-trip), so warmup is invisible to the metric values.  Returns
     the tuple of batch sizes actually warmed.
+
+    An :class:`~torcheval_tpu.engine.Evaluator` delegates to its own
+    :meth:`~torcheval_tpu.engine.Evaluator.warmup` — the swept shapes
+    become stacked scan-block programs instead of per-batch programs
+    (``fused`` does not apply there).
     """
+    from torcheval_tpu.engine import Evaluator
     from torcheval_tpu.metrics.collection import MetricCollection
+
+    if isinstance(metric_or_collection, Evaluator):
+        return metric_or_collection.warmup(
+            example_batch, max_batch=max_batch, sizes=sizes
+        )
 
     obj = metric_or_collection
     arrays = [np.asarray(a) for a in example_batch]

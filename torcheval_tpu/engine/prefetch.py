@@ -1,0 +1,151 @@
+"""Double-buffered host→device prefetch for the streaming engine.
+
+While the device runs scan block *k*, a background thread assembles and
+stages block *k+1* (``jax.device_put``), so H2D transfer and the
+host-side pad/stack work overlap XLA execution instead of serializing
+after it.  The queue is bounded (default depth 2 — classic double
+buffering): the producer blocks once it is ``depth`` blocks ahead, so a
+fast source can never balloon host/device memory.
+
+Error contract: an exception from the source iterator (or from staging)
+is captured in the producer thread and re-raised at the consumer's next
+``__next__`` — the dispatch loop sees it exactly where a plain
+``for batch in source`` loop would have, and everything already
+dispatched stays applied.  :meth:`Prefetcher.close` shuts the producer
+down promptly from any state (mid-put included) and joins the thread.
+"""
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+
+from torcheval_tpu.telemetry import events as _telemetry
+
+DEFAULT_DEPTH = 2
+
+# Producer-side poll period for stop-aware blocking puts: close() is
+# observed within one tick even if the consumer never drains the queue.
+_PUT_TICK_S = 0.05
+
+
+class _SourceError:
+    """Queue envelope carrying an exception out of the producer thread."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
+
+
+_DONE = object()
+
+
+class Prefetcher:
+    """Iterate ``source`` on a background thread, staging each item to
+    device ahead of the consumer.
+
+    ``stage`` maps a host item to its device-resident form; the default
+    is :func:`jax.device_put` over the item pytree (``device=None``
+    keeps JAX's default placement; pass a ``jax.Device`` or sharding to
+    pin).  Yields items in source order.  Use as an iterator, ideally
+    under ``try/finally: close()`` (iterating to exhaustion also joins
+    the thread).
+    """
+
+    def __init__(
+        self,
+        source: Iterable[Any],
+        *,
+        stage: Optional[Callable[[Any], Any]] = None,
+        device: Any = None,
+        depth: int = DEFAULT_DEPTH,
+    ) -> None:
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if stage is None:
+
+            def stage(item: Any) -> Any:
+                if device is None:
+                    return jax.device_put(item)
+                return jax.device_put(item, device)
+
+        self._source = iter(source)
+        self._stage = stage
+        self._queue: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._finished = False
+        self._thread = threading.Thread(
+            target=self._produce, name="torcheval-tpu-prefetch", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------- producer
+    def _put(self, item: Any) -> bool:
+        """Stop-aware blocking put; False means close() won the race."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=_PUT_TICK_S)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            for item in self._source:
+                staged = self._stage(item)
+                if not self._put(staged):
+                    return
+            self._put(_DONE)
+        except BaseException as exc:  # noqa: BLE001 - relayed to consumer
+            self._put(_SourceError(exc))
+
+    # ------------------------------------------------------------- consumer
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._finished:
+            raise StopIteration
+        if _telemetry.ENABLED:
+            t0 = time.monotonic()
+            stalled = False
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                # The pipeline bubbled: the producer is behind the
+                # consumer.  Time the wait so report() can show it.
+                stalled = True
+                item = self._queue.get()
+            waited = time.monotonic() - t0
+            _telemetry.record_span("prefetch_wait", "Evaluator", waited, 0)
+            if stalled:
+                _telemetry.record_prefetch_stall(waited)
+        else:
+            item = self._queue.get()
+        if item is _DONE:
+            self._finished = True
+            self._thread.join()
+            raise StopIteration
+        if isinstance(item, _SourceError):
+            self._finished = True
+            self._thread.join()
+            raise item.exc
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and join its thread.  Idempotent; safe from
+        any consumer state (mid-stream, exhausted, errored)."""
+        self._finished = True
+        self._stop.set()
+        # Drain so a producer blocked in put() observes the stop flag on
+        # its next tick rather than waiting out a full queue.
+        while True:
+            try:
+                self._queue.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)

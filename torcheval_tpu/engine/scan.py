@@ -1,0 +1,124 @@
+"""Scan-fused multi-batch stepping: ``block_size`` batches through every
+member's fused update in ONE host dispatch.
+
+``MetricCollection.fused_update`` already folds all members into a
+single program per batch, but the *loop* is still host-driven: N batches
+cost N Python round trips and N dispatches.  Here the block's batches
+are stacked on a leading axis and folded through the same member update
+transitions as a :func:`jax.lax.scan` body inside one jitted,
+donation-aware program — the carry is the collection's state dict, each
+scan step is exactly one ``fused_update`` body, so N batches cost
+N/block_size dispatches with bit-identical states (masked pad rows and
+fully-masked pad steps contribute exact zeros, as in ``_bucket.py``).
+
+The program reuses the collection's machinery wholesale: member
+``update`` methods (and through them the ``_fuse.py`` kernels) run
+unchanged at trace time via the same setattr-states trick as
+``fused_update``'s ``apply``, and abort safety is the same
+``_install_states(before, guard_deleted=True)`` restore — an exception
+mid-trace or mid-flight (donation included) leaves every member state
+concrete and readable.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from torcheval_tpu._stats import bump_trace
+from torcheval_tpu.metrics.collection import MetricCollection, _call_signature
+from torcheval_tpu.ops import _flags
+from torcheval_tpu.telemetry import events as _telemetry
+
+
+def _build_apply(collection: MetricCollection, donate: bool):
+    """The jitted block program: ``(states, stacked_args, stacked_mask)
+    -> states`` where the stacked leaves carry a leading ``block_size``
+    axis and ``stacked_mask`` is ``None`` for unbucketed blocks."""
+    metrics = collection._metrics
+
+    def apply(states, stacked_args, stacked_mask):
+        bump_trace("engine_scan")
+
+        def body(carry, xs):
+            step_args, step_mask = xs
+            for name, m in metrics.items():
+                for s, v in carry[name].items():
+                    setattr(m, s, v)
+            for m in metrics.values():
+                if step_mask is None:
+                    m.update(*step_args)
+                else:
+                    m.update(*step_args, mask=step_mask)
+            return collection._read_states(), None
+
+        final, _ = jax.lax.scan(
+            body, states, (stacked_args, stacked_mask)
+        )
+        return final
+
+    return jax.jit(apply, donate_argnums=(0,) if donate else ())
+
+
+class ScanRunner:
+    """Owns the jitted scan program for one (collection, donate) pair and
+    dispatches stacked blocks through it with the collection's abort-safe
+    state install/restore semantics."""
+
+    def __init__(self, collection: MetricCollection, donate: bool) -> None:
+        self._collection = collection
+        self._donate = bool(donate)
+        self._apply = _build_apply(collection, self._donate)
+        # Signatures already executed — same steady-state contract as
+        # MetricCollection._fused_seen: a hit means no trace can run.
+        self._seen: set = set()
+
+    @property
+    def donate(self) -> bool:
+        return self._donate
+
+    def dispatch(
+        self,
+        stacked_args: Tuple[Any, ...],
+        stacked_mask: Optional[jax.Array],
+    ) -> None:
+        """Run one block and install the resulting member states."""
+        col = self._collection
+        key = _call_signature(stacked_args, {"mask": stacked_mask})
+        if key not in self._seen:
+            col._check_fusable()
+        before = col._read_states()
+        try:
+            new_states = self._apply(before, stacked_args, stacked_mask)
+        except BaseException:
+            if _telemetry.ENABLED and self._donate:
+                _telemetry.record_donation("abort")
+            col._install_states(before, guard_deleted=True)
+            raise
+        self._seen.add(key)
+        col._install_states(new_states)
+
+
+def resolve_donate(
+    collection: MetricCollection, donate: Optional[bool]
+) -> bool:
+    """Engine-level donation default: explicit flag, else the
+    collection's, else the global :func:`_flags.donation_enabled`."""
+    if donate is not None:
+        return bool(donate)
+    if collection._donate is not None:
+        return bool(collection._donate)
+    return _flags.donation_enabled()
+
+
+def states_nbytes(collection: MetricCollection) -> int:
+    """Total member state bytes (span payload for engine_block spans)."""
+    return sum(
+        _telemetry.state_nbytes(m) for m in collection._metrics.values()
+    )
+
+
+def read_state_arrays(
+    collection: MetricCollection,
+) -> Dict[str, Dict[str, Any]]:
+    """Concrete snapshot of member states for parity/debug inspection."""
+    return collection._read_states()

@@ -24,6 +24,29 @@ from torcheval_tpu.ops import _flags
 from torcheval_tpu.telemetry import events as _telemetry
 
 
+def _call_signature(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Any:
+    """Hashable shape/dtype signature of one fused call — mirrors the jit
+    cache key (structure, shapes, dtypes, weak types) closely enough that
+    a previously-seen signature implies a compiled-program cache hit.  A
+    hit means no trace can run, which is what lets ``fused_update`` skip
+    the per-step fusability sweep on the steady state."""
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape is None:
+            sig.append((type(leaf).__name__,))
+        else:
+            sig.append(
+                (
+                    tuple(shape),
+                    str(leaf.dtype),
+                    bool(getattr(leaf, "weak_type", False)),
+                )
+            )
+    return (treedef, tuple(sig))
+
+
 class MetricCollection:
     """A named, ordered set of metrics updated with the same batch.
 
@@ -76,6 +99,21 @@ class MetricCollection:
         self._donate = donate
         self._fused_apply: Optional[Any] = None
         self._fused_apply_donated: Optional[bool] = None
+        # The fused paths read every member state once per step; a
+        # precomputed (name, metric, state-names) layout makes that a
+        # flat loop instead of rebuilding the registry iteration each
+        # time.  Members register all states in __init__, so the layout
+        # is fixed for the collection's lifetime.
+        self._state_layout: Tuple[Tuple[str, Metric, Tuple[str, ...]], ...] = (
+            tuple(
+                (name, m, tuple(m._state_name_to_default))
+                for name, m in self._metrics.items()
+            )
+        )
+        # Call signatures fused_update has already executed.  A hit means
+        # the jitted program is compiled-cache resident — no trace can
+        # run — so the per-step fusability sweep is skipped.
+        self._fused_seen: set = set()
 
     def _bucket_args(
         self, args: Tuple[Any, ...], kwargs: Dict[str, Any]
@@ -130,7 +168,6 @@ class MetricCollection:
         validation is skipped inside the trace (exactly as when composing
         the functional metrics into a user jit program); shape/parameter
         validation still applies."""
-        self._check_fusable()
         args, kwargs = self._bucket_args(args, kwargs)
         donate = (
             self._donate
@@ -153,6 +190,13 @@ class MetricCollection:
                 apply, donate_argnums=(0,) if donate else ()
             )
             self._fused_apply_donated = donate
+            self._fused_seen = set()
+        key = _call_signature(args, kwargs)
+        if key not in self._fused_seen:
+            # Only a first-at-this-signature call can trace; the steady
+            # state (compiled-cache hit) skips the O(members x states)
+            # fusability sweep.
+            self._check_fusable()
         before = self._read_states()
         t0 = time.monotonic() if _telemetry.ENABLED else 0.0
         try:
@@ -168,6 +212,7 @@ class MetricCollection:
                 _telemetry.record_donation("abort")
             self._install_states(before, guard_deleted=True)
             raise
+        self._fused_seen.add(key)
         self._install_states(new_states)
         if _telemetry.ENABLED:
             _telemetry.record_span(
@@ -200,8 +245,8 @@ class MetricCollection:
 
     def _read_states(self) -> Dict[str, Dict[str, Any]]:
         return {
-            name: {s: getattr(m, s) for s in m._state_name_to_default}
-            for name, m in self._metrics.items()
+            name: {s: getattr(m, s) for s in states}
+            for name, m, states in self._state_layout
         }
 
     def _install_states(
@@ -295,10 +340,27 @@ class MetricCollection:
                 per_metric[name][state_key] = value
             else:
                 unexpected.append(key)
-        if strict and unexpected:
-            raise RuntimeError(
-                f"Unexpected keys in state_dict: {sorted(unexpected)}."
+        if strict:
+            problems = []
+            if unexpected:
+                problems.append(
+                    f"Unexpected keys in state_dict: {sorted(unexpected)}"
+                )
+            # A member with zero keys would silently keep its current
+            # state — raise up front (before any member loads) so a
+            # partially-written checkpoint cannot half-install.
+            missing_members = sorted(
+                name
+                for name, states in per_metric.items()
+                if not states and self._metrics[name]._state_name_to_default
             )
+            if missing_members:
+                problems.append(
+                    "state_dict is missing every state of member(s) "
+                    f"{missing_members}"
+                )
+            if problems:
+                raise RuntimeError("; ".join(problems) + ".")
         for name, metric in self._metrics.items():
             metric.load_state_dict(per_metric[name], strict=strict)
 
@@ -313,6 +375,9 @@ class MetricCollection:
     def __getstate__(self) -> Dict[str, Any]:
         state = self.__dict__.copy()
         state["_fused_apply"] = None
+        # Seen signatures hold treedefs (unpicklable) and describe a jit
+        # cache that dies with this process anyway.
+        state["_fused_seen"] = set()
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:

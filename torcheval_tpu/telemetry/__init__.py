@@ -32,7 +32,9 @@ from torcheval_tpu.telemetry.events import (
     BucketPadEvent,
     CacheEvent,
     DonationEvent,
+    EngineBlockEvent,
     Event,
+    PrefetchStallEvent,
     RetraceEvent,
     RouteDowngradeEvent,
     SpanEvent,
@@ -124,6 +126,15 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         "slowest": slowest,
     }
 
+    eng = agg["engine"]
+    engine_section = {
+        **eng,
+        # The O(N/block) claim, directly: host dispatches per real batch.
+        "dispatches_per_batch": (
+            eng["blocks"] / eng["batches"] if eng["batches"] else 0.0
+        ),
+    }
+
     spans = {
         f"{name}.{phase}": {
             "calls": entry["calls"],
@@ -153,6 +164,7 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         },
         "donation": dict(agg["donation"]),
         "sync": sync_totals,
+        "engine": engine_section,
         "spans": spans,
         "events_captured": agg["emitted"],
         "events_dropped": events.dropped(),
@@ -167,7 +179,9 @@ __all__ = [
     "BucketPadEvent",
     "CacheEvent",
     "DonationEvent",
+    "EngineBlockEvent",
     "Event",
+    "PrefetchStallEvent",
     "RetraceEvent",
     "RouteDowngradeEvent",
     "SpanEvent",

@@ -6,8 +6,10 @@ Every instrument in the library feeds this one module: retraces
 (``parallel/_compile_cache``), route downgrades (``routing``), bucket
 padding waste (``metrics/_bucket``), donation aborts/restores
 (``metrics/collection`` / ``metrics/_buffer``), collective sync calls
-(``parallel/sync`` / ``distributed``), and update/compute/dispatch spans
-(``metrics/metric`` / ``metrics/collection`` / ``metrics/_fuse``).
+(``parallel/sync`` / ``distributed``), update/compute/dispatch spans
+(``metrics/metric`` / ``metrics/collection`` / ``metrics/_fuse``), and
+the streaming engine's block dispatches and prefetch stalls
+(``torcheval_tpu/engine``).
 
 Zero-cost-when-off contract
 ---------------------------
@@ -159,6 +161,29 @@ class SyncEvent(Event):
 
 
 @dataclass
+class EngineBlockEvent(Event):
+    """One scan-fused block dispatched by the streaming engine
+    (``torcheval_tpu/engine``): ``batches`` real batches plus
+    ``pad_steps`` fully-masked tail-pad steps folded through ONE host
+    dispatch of ``block_size`` scan steps."""
+
+    kind: str = field(init=False, default="engine_block")
+    block_size: int = 0
+    batches: int = 0
+    pad_steps: int = 0
+
+
+@dataclass
+class PrefetchStallEvent(Event):
+    """The engine's dispatch loop found the prefetch queue empty and
+    blocked ``seconds`` for the next staged block — a pipeline bubble
+    (the host/H2D side could not keep ahead of the device)."""
+
+    kind: str = field(init=False, default="prefetch_stall")
+    seconds: float = 0.0
+
+
+@dataclass
 class SpanEvent(Event):
     """A timed metric phase (``update`` / ``compute`` / ``dispatch``)
     with the metric's state-memory footprint after the phase."""
@@ -182,6 +207,8 @@ KIND_TO_CLASS: Dict[str, type] = {
     "donation_abort": DonationEvent,
     "sync": SyncEvent,
     "span": SpanEvent,
+    "engine_block": EngineBlockEvent,
+    "prefetch_stall": PrefetchStallEvent,
 }
 
 
@@ -197,6 +224,15 @@ def _zero_aggregates() -> Dict[str, Any]:
         "sync": {},
         # (name, phase) -> {"calls", "seconds", "state_bytes", "hist": [..]}
         "spans": {},
+        # The streaming engine's dispatch accounting: blocks is the host
+        # dispatch count, batches the real batches folded into them.
+        "engine": {
+            "blocks": 0,
+            "batches": 0,
+            "pad_steps": 0,
+            "prefetch_stalls": 0,
+            "stall_seconds": 0.0,
+        },
         "emitted": 0,
     }
 
@@ -285,6 +321,7 @@ def aggregates() -> Dict[str, Any]:
             "donation": dict(_agg["donation"]),
             "sync": {k: _copy_hist_entry(v) for k, v in _agg["sync"].items()},
             "spans": {k: _copy_hist_entry(v) for k, v in _agg["spans"].items()},
+            "engine": dict(_agg["engine"]),
             "emitted": _agg["emitted"],
         }
 
@@ -356,6 +393,15 @@ def _fold(event: Event) -> None:
         entry["seconds"] += event.seconds
         entry["payload_bytes"] += event.payload_bytes
         entry["hist"][_hist_slot(event.seconds)] += 1
+    elif isinstance(event, EngineBlockEvent):
+        entry = _agg["engine"]
+        entry["blocks"] += 1
+        entry["batches"] += event.batches
+        entry["pad_steps"] += event.pad_steps
+    elif isinstance(event, PrefetchStallEvent):
+        entry = _agg["engine"]
+        entry["prefetch_stalls"] += 1
+        entry["stall_seconds"] += event.seconds
     elif isinstance(event, SpanEvent):
         entry = _agg["spans"].setdefault(
             (event.name, event.phase),
@@ -415,6 +461,22 @@ def record_sync(op: str, seconds: float, payload_bytes: int) -> None:
             op=op, seconds=float(seconds), payload_bytes=int(payload_bytes)
         )
     )
+
+
+def record_engine_block(
+    block_size: int, batches: int, pad_steps: int
+) -> None:
+    emit(
+        EngineBlockEvent(
+            block_size=int(block_size),
+            batches=int(batches),
+            pad_steps=int(pad_steps),
+        )
+    )
+
+
+def record_prefetch_stall(seconds: float) -> None:
+    emit(PrefetchStallEvent(seconds=float(seconds)))
 
 
 def record_span(

@@ -214,6 +214,39 @@ def prometheus_text() -> str:
         )
 
     out.append(
+        f"# HELP {_PREFIX}_engine_blocks_total Scan-fused blocks "
+        "dispatched by the streaming engine (one host dispatch each)."
+    )
+    out.append(f"# TYPE {_PREFIX}_engine_blocks_total counter")
+    out.append(f"{_PREFIX}_engine_blocks_total {agg['engine']['blocks']}")
+    out.append(
+        f"# HELP {_PREFIX}_engine_batches_total Real batches folded into "
+        "scan-fused engine blocks."
+    )
+    out.append(f"# TYPE {_PREFIX}_engine_batches_total counter")
+    out.append(f"{_PREFIX}_engine_batches_total {agg['engine']['batches']}")
+    out.append(f"# TYPE {_PREFIX}_engine_pad_steps_total counter")
+    out.append(
+        f"{_PREFIX}_engine_pad_steps_total {agg['engine']['pad_steps']}"
+    )
+    out.append(
+        f"# HELP {_PREFIX}_engine_prefetch_stall_total Engine dispatch "
+        "loop blocked on an empty prefetch queue (pipeline bubbles)."
+    )
+    out.append(f"# TYPE {_PREFIX}_engine_prefetch_stall_total counter")
+    out.append(
+        f"{_PREFIX}_engine_prefetch_stall_total "
+        f"{agg['engine']['prefetch_stalls']}"
+    )
+    out.append(
+        f"# TYPE {_PREFIX}_engine_prefetch_stall_seconds_total counter"
+    )
+    out.append(
+        f"{_PREFIX}_engine_prefetch_stall_seconds_total "
+        f"{_fmt(agg['engine']['stall_seconds'])}"
+    )
+
+    out.append(
         f"# HELP {_PREFIX}_sync_seconds Collective merge wall time by op."
     )
     out.append(f"# TYPE {_PREFIX}_sync_seconds histogram")
@@ -308,6 +341,16 @@ def format_report(report: Dict[str, Any]) -> str:
         buf.write(
             f"  donation: {donation.get('abort', 0)} aborts, "
             f"{donation.get('restore', 0)} default restores\n"
+        )
+    eng = report.get("engine", {})
+    if eng.get("blocks"):
+        buf.write(
+            f"  engine: {eng['blocks']} block dispatches over "
+            f"{eng['batches']} batches "
+            f"({eng['dispatches_per_batch']:.3f} dispatches/batch, "
+            f"{eng['pad_steps']} pad steps); "
+            f"{eng['prefetch_stalls']} prefetch stalls "
+            f"({eng['stall_seconds'] * 1e3:.3f} ms)\n"
         )
     slowest = report.get("sync", {}).get("slowest", [])
     if slowest:
