@@ -384,6 +384,9 @@ def _make_row(name: str, ours: float, ref, extras: dict) -> dict:
         from torcheval_tpu import telemetry
 
         row["telemetry"] = telemetry.report()
+        # The fleet rollup rides alongside (sample_events=0 keeps rows
+        # compact; single-process runs degrade to a one-host fleet).
+        row["fleet"] = telemetry.fleet_report(sample_events=0)
     except Exception:  # pragma: no cover - report must never sink a row
         pass
     return row

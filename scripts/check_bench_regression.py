@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: fail CI when throughput drops.
+
+Compares a fresh ``bench.py`` artifact against the committed
+``BENCH_ALL.json`` baseline — the headline row plus every per-workload
+ledger row, matched by metric name — and exits nonzero when any row's
+``value`` (samples/sec) fell by more than the threshold (default 10%).
+
+Usage::
+
+    # compare two artifacts on disk
+    python scripts/check_bench_regression.py --baseline BENCH_ALL.json \
+        --fresh /tmp/bench_fresh.json
+
+    # run bench.py now and compare against the committed baseline
+    python scripts/check_bench_regression.py --run
+
+``--run`` snapshots the baseline into memory FIRST: ``bench.py`` merges
+its rows into ``BENCH_ALL.json`` in place, so reading the baseline after
+the run would compare the fresh numbers against themselves.
+
+Rows are skipped (never failed) when either side is missing the metric,
+is zero/absent (a worker that never produced a number), or is marked
+``degraded`` (CPU-fallback instances measure a different machine).
+Improvements and new workloads pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
+
+DEFAULT_THRESHOLD = 0.10
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "BENCH_ALL.json")
+
+
+def _rows_by_metric(doc: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """headline + workloads keyed by metric name (rows without a usable
+    metric/value are dropped here, not compared)."""
+    rows: Dict[str, Dict[str, Any]] = {}
+    candidates: List[Any] = list(doc.get("workloads", []))
+    if doc.get("headline"):
+        candidates.append(doc["headline"])
+    for row in candidates:
+        if isinstance(row, dict) and row.get("metric"):
+            rows[row["metric"]] = row
+    return rows
+
+
+def _comparable(row: Optional[Dict[str, Any]]) -> bool:
+    return (
+        row is not None
+        and not row.get("degraded")
+        and bool(row.get("value"))
+    )
+
+
+def compare(
+    baseline_doc: Dict[str, Any],
+    fresh_doc: Dict[str, Any],
+    threshold: float = DEFAULT_THRESHOLD,
+) -> List[Dict[str, Any]]:
+    """All regressions: rows present and comparable on both sides whose
+    fresh throughput is more than ``threshold`` below baseline.  Each
+    entry carries metric/baseline/fresh/drop_pct."""
+    baseline = _rows_by_metric(baseline_doc)
+    fresh = _rows_by_metric(fresh_doc)
+    regressions: List[Dict[str, Any]] = []
+    for metric, base_row in sorted(baseline.items()):
+        fresh_row = fresh.get(metric)
+        if not _comparable(base_row) or not _comparable(fresh_row):
+            continue
+        base_v = float(base_row["value"])
+        fresh_v = float(fresh_row["value"])
+        drop = (base_v - fresh_v) / base_v
+        if drop > threshold:
+            regressions.append(
+                {
+                    "metric": metric,
+                    "baseline": base_v,
+                    "fresh": fresh_v,
+                    "drop_pct": round(100.0 * drop, 1),
+                }
+            )
+    return regressions
+
+
+def _load(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _run_bench(baseline_path: str) -> Dict[str, Any]:
+    """Run ``bench.py`` and return the refreshed artifact.  The caller
+    must have snapshotted the baseline BEFORE this: bench merges into
+    BENCH_ALL.json in place."""
+    bench = os.path.join(REPO_ROOT, "bench.py")
+    proc = subprocess.run([sys.executable, bench], cwd=REPO_ROOT)
+    if proc.returncode != 0:
+        raise SystemExit(f"bench.py failed with exit {proc.returncode}")
+    return _load(baseline_path)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--baseline",
+        default=DEFAULT_BASELINE,
+        help="baseline artifact (default: repo BENCH_ALL.json)",
+    )
+    parser.add_argument(
+        "--fresh",
+        help="fresh artifact to compare (omit with --run)",
+    )
+    parser.add_argument(
+        "--run",
+        action="store_true",
+        help="run bench.py now; the pre-run baseline is snapshotted first",
+    )
+    parser.add_argument(
+        "--threshold",
+        type=float,
+        default=DEFAULT_THRESHOLD,
+        help="relative drop that fails (default 0.10 = 10%%)",
+    )
+    args = parser.parse_args(argv)
+
+    baseline_doc = copy.deepcopy(_load(args.baseline))
+    if args.run:
+        fresh_doc = _run_bench(args.baseline)
+    elif args.fresh:
+        fresh_doc = _load(args.fresh)
+    else:
+        parser.error("need --fresh PATH or --run")
+        return 2  # unreachable; parser.error exits
+
+    regressions = compare(baseline_doc, fresh_doc, threshold=args.threshold)
+    compared = sum(
+        1
+        for metric, row in _rows_by_metric(baseline_doc).items()
+        if _comparable(row) and _comparable(_rows_by_metric(fresh_doc).get(metric))
+    )
+    if regressions:
+        print(
+            f"REGRESSION: {len(regressions)} of {compared} compared "
+            f"workload(s) dropped >{args.threshold:.0%}:"
+        )
+        for r in regressions:
+            print(
+                f"  {r['metric']}: {r['baseline']:.1f} -> {r['fresh']:.1f} "
+                f"samples/sec (-{r['drop_pct']}%)"
+            )
+        return 1
+    print(
+        f"ok: {compared} workload(s) compared, none dropped "
+        f">{args.threshold:.0%}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

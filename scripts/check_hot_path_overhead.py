@@ -1,14 +1,19 @@
-"""Zero-overhead guard for the disabled telemetry bus.
+"""Zero-overhead guard for the disabled telemetry bus and the disabled
+data-health monitor.
 
 The telemetry contract (``torcheval_tpu/telemetry/events.py``) is that a
 DISABLED bus costs the hot path exactly one module-attribute read and one
 branch per hook site — no ``record_*`` helper, no ``emit``, no
-``timed_phase`` may ever run.  This script proves the contract
-empirically instead of by inspection: every hook entry point in the
-events module is replaced with a counting wrapper, a hook-dense workload
-is driven (a bucketed five-metric fused-collection stream over ragged
-batch sizes, plus plain per-metric update/compute and an explicit
-``pad_to_bucket``), and the check fails if ANY wrapper fired.
+``timed_phase`` may ever run.  The data-health monitor
+(``torcheval_tpu/telemetry/health.py``) makes the same promise: disabled,
+none of its entry points run and the fused update/scan programs carry no
+side outputs.  This script proves both contracts empirically instead of
+by inspection: every hook entry point in the events module AND every
+health-module entry point is replaced with a counting wrapper, a
+hook-dense workload is driven (a bucketed five-metric fused-collection
+stream over ragged batch sizes, plus plain per-metric update/compute, an
+explicit ``pad_to_bucket``, and a prefetching scan-engine run), and the
+check fails if ANY wrapper fired.
 
 Run directly (``python scripts/check_hot_path_overhead.py``) or through
 the test tier (``tests/test_telemetry.py::test_hot_path_zero_overhead``,
@@ -30,6 +35,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # ``dir()``-discovered record_* helpers plus the two shared funnels;
 # discovery keeps the guard honest when a new event kind lands.
 _EXTRA_HOOKS = ("emit", "timed_phase")
+
+# Health-monitor entry points that must stay cold while the monitor is
+# disabled: the fused programs must carry no side outputs (batch_stats /
+# stats_for_update are traced INTO them), and no host fold may run.
+_HEALTH_HOOKS = ("label_bounds", "batch_stats", "stats_for_update", "inspect")
 
 
 def _hook_names(events_module) -> List[str]:
@@ -114,9 +124,12 @@ def check(verbose: bool = True) -> List[str]:
     hook names (so the test tier can sanity-check coverage)."""
     from torcheval_tpu import telemetry
     from torcheval_tpu.telemetry import events as ev
+    from torcheval_tpu.telemetry import health as hm
 
     was_enabled = telemetry.enabled()
+    health_was_enabled = hm.enabled()
     telemetry.disable()
+    hm.disable()
     counter: Dict[str, int] = {}
     names = _hook_names(ev)
     try:
@@ -127,22 +140,32 @@ def check(verbose: bool = True) -> List[str]:
                         ev, name, _counting(getattr(ev, name), counter, name)
                     )
                 )
+            for name in _HEALTH_HOOKS:
+                stack.enter_context(
+                    mock.patch.object(
+                        hm,
+                        name,
+                        _counting(getattr(hm, name), counter, f"health.{name}"),
+                    )
+                )
             _drive_hot_path()
     finally:
         if was_enabled:
             telemetry.enable()
+        if health_was_enabled:
+            hm.enable()
     fired = {k: v for k, v in counter.items() if v}
     if fired:
         raise AssertionError(
-            "telemetry hooks ran with the bus DISABLED (the zero-overhead "
-            f"contract is broken): {fired}"
+            "telemetry/health hooks ran with the bus DISABLED (the "
+            f"zero-overhead contract is broken): {fired}"
         )
     if verbose:
         print(
-            f"ok: {len(names)} hook entry points stayed cold on the "
-            "disabled hot path"
+            f"ok: {len(names) + len(_HEALTH_HOOKS)} hook entry points "
+            "stayed cold on the disabled hot path"
         )
-    return names
+    return names + [f"health.{n}" for n in _HEALTH_HOOKS]
 
 
 if __name__ == "__main__":

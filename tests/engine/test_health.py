@@ -1,0 +1,307 @@
+"""Streaming data-health monitor: fused side-output detection (NaN/Inf,
+constant feeds, out-of-range labels, zero-weight batches) on the
+fused-update and scan-engine paths, per-metric attribution, the
+raise-on-corrupt policy, and the zero-cost-when-off contract
+(torcheval_tpu/telemetry/health.py wired through metrics/collection.py
+and engine/scan.py)."""
+
+import unittest
+from unittest import mock
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.engine import Evaluator
+from torcheval_tpu.metrics import MetricCollection, MulticlassAccuracy
+from torcheval_tpu.telemetry import events as ev, health as hm
+
+pytestmark = [pytest.mark.engine, pytest.mark.fleet]
+
+_C = 4
+
+
+class HealthIsolation(unittest.TestCase):
+    """Cleared bus, monitor off, both restored — findings land in the
+    ring even with the wider telemetry bus disabled, so each test reads
+    exactly its own data_health events."""
+
+    def setUp(self):
+        self._capacity = ev.capacity()
+        self._enabled = hm.ENABLED
+        self._raise = hm.RAISE_ON_CORRUPT
+        telemetry.disable()
+        telemetry.clear()
+        hm.disable()
+
+    def tearDown(self):
+        hm.ENABLED = self._enabled
+        hm.RAISE_ON_CORRUPT = self._raise
+        ev.enable(capacity=self._capacity)
+        telemetry.disable()
+        telemetry.clear()
+
+    def findings(self):
+        return [
+            (e.check, e.metric, e.arg, e.count, e.source)
+            for e in ev.events("data_health")
+        ]
+
+
+def _collection():
+    return MetricCollection(
+        {"acc": MulticlassAccuracy(num_classes=_C, average="macro")},
+        bucket=True,
+    )
+
+
+def _stream(sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            rng.random((b, _C), dtype=np.float32),
+            rng.integers(0, _C, b).astype(np.int32),
+        )
+        for b in sizes
+    ]
+
+
+def _as_device(batches):
+    return [(jnp.asarray(s), jnp.asarray(t)) for s, t in batches]
+
+
+def _state_bytes(col):
+    return {
+        k: np.asarray(v).tobytes() for k, v in col.state_dict().items()
+    }
+
+
+class TestCleanStreamIsSilentAndBitIdentical(HealthIsolation):
+    def test_fused_update_identical_with_monitor_on(self):
+        batches = _stream((33, 70, 40))
+        off = _collection()
+        for args in _as_device(batches):
+            off.fused_update(*args)
+
+        hm.enable()
+        on = _collection()
+        for args in _as_device(batches):
+            on.fused_update(*args)
+
+        self.assertEqual(_state_bytes(off), _state_bytes(on))
+        self.assertEqual(self.findings(), [])
+
+    def test_engine_identical_with_monitor_on(self):
+        batches = _stream((33, 70, 150, 97, 40, 12), seed=1)
+        off = _collection()
+        Evaluator(off, block_size=4, prefetch=False).run(
+            _as_device(batches)
+        )
+
+        hm.enable()
+        on = _collection()
+        Evaluator(on, block_size=4, prefetch=False).run(
+            _as_device(batches)
+        )
+
+        self.assertEqual(_state_bytes(off), _state_bytes(on))
+        self.assertEqual(self.findings(), [])
+
+    def test_partial_tail_block_is_not_zero_weight(self):
+        # block_size=4 over 6 batches pads the second block with two
+        # fully-masked scan steps; inspect() must reduce over the real
+        # steps only, so the deliberate pad never reads as a dead batch.
+        hm.enable()
+        col = _collection()
+        engine = Evaluator(col, block_size=4, prefetch=False).run(
+            _as_device(_stream((33, 70, 150, 97, 40, 12), seed=2))
+        )
+        self.assertEqual(engine.blocks_dispatched, 2)
+        self.assertEqual(self.findings(), [])
+
+
+class TestFusedUpdateDetection(HealthIsolation):
+    def test_nan_and_inf_counts(self):
+        hm.enable()
+        scores, targets = _stream((64,))[0]
+        scores[0, 1] = np.nan
+        scores[3, 2] = np.nan
+        scores[5, 0] = np.nan
+        scores[7, 3] = np.inf
+        scores[9, 1] = -np.inf
+        col = _collection()
+        col.fused_update(jnp.asarray(scores), jnp.asarray(targets))
+        self.assertEqual(
+            sorted(self.findings()),
+            [
+                ("inf", "", 0, 2, "fused_update"),
+                ("nan", "", 0, 3, "fused_update"),
+            ],
+        )
+
+    def test_constant_feed(self):
+        hm.enable()
+        _scores, targets = _stream((32,))[0]
+        col = _collection()
+        col.fused_update(
+            jnp.full((32, _C), 0.5, dtype=jnp.float32),
+            jnp.asarray(targets),
+        )
+        self.assertEqual(
+            self.findings(), [("constant", "", 0, 1, "fused_update")]
+        )
+
+    def test_label_range_per_metric_attribution(self):
+        # Two members share the batch; label 7 is legal for the 8-class
+        # member and corrupt for the 4-class one — the finding must name
+        # acc4 specifically.  A negative label is corrupt input-wide.
+        hm.enable()
+        col = MetricCollection(
+            {
+                "acc4": MulticlassAccuracy(num_classes=4),
+                "acc8": MulticlassAccuracy(num_classes=8),
+            },
+            bucket=True,
+        )
+        preds = jnp.asarray([0, 1, 2, 3, 1, 0], dtype=jnp.int32)
+        targets = jnp.asarray([0, 7, 2, 7, -1, 1], dtype=jnp.int32)
+        col.fused_update(preds, targets)
+        found = self.findings()
+        self.assertIn(("label_range", "acc4", 1, 2, "fused_update"), found)
+        self.assertIn(("label_range", "", 1, 1, "fused_update"), found)
+        self.assertNotIn("acc8", [f[1] for f in found])
+
+    def test_zero_weight_batch(self):
+        hm.enable()
+        col = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=_C)}
+        )
+        scores, targets = _stream((8,))[0]
+        col.fused_update(
+            jnp.asarray(scores),
+            jnp.asarray(targets),
+            mask=jnp.zeros((8,), dtype=jnp.float32),
+        )
+        self.assertIn(
+            ("zero_weight", "", -1, 1, "fused_update"), self.findings()
+        )
+
+
+class TestEngineDetection(HealthIsolation):
+    def test_scan_block_catches_inf_with_exact_count(self):
+        hm.enable()
+        batches = _stream((33, 70, 150, 97, 40, 12), seed=3)
+        batches[4][0][2, 1] = np.inf
+        batches[4][0][6, 0] = np.inf
+        col = _collection()
+        Evaluator(col, block_size=4, prefetch=False).run(
+            _as_device(batches)
+        )
+        self.assertEqual(
+            self.findings(), [("inf", "", 0, 2, "engine_block")]
+        )
+        rep = telemetry.report()
+        self.assertTrue(rep["data_health"]["enabled"])
+        self.assertEqual(
+            rep["data_health"]["checks"]["inf"]["count"], 2
+        )
+
+    def test_scan_block_catches_out_of_range_label(self):
+        hm.enable()
+        batches = _stream((33, 70, 40), seed=4)
+        batches[1][1][5] = _C + 3  # illegal class id for acc
+        col = _collection()
+        Evaluator(col, block_size=4, prefetch=False).run(
+            _as_device(batches)
+        )
+        self.assertEqual(
+            self.findings(),
+            [("label_range", "acc", 1, 1, "engine_block")],
+        )
+
+
+class TestRaiseOnCorrupt(HealthIsolation):
+    def test_fused_update_raises_after_applying(self):
+        hm.enable(raise_on_corrupt=True)
+        scores, targets = _stream((16,))[0]
+        scores[2, 2] = np.nan
+        col = _collection()
+        with self.assertRaises(hm.DataCorruptionError) as ctx:
+            col.fused_update(jnp.asarray(scores), jnp.asarray(targets))
+        self.assertEqual(ctx.exception.findings[0]["check"], "nan")
+        # The monitor observes, it does not gate: the batch WAS applied
+        # and the collection still computes.
+        self.assertIn("acc", col.compute())
+
+    def test_engine_raises(self):
+        hm.enable(raise_on_corrupt=True)
+        batches = _stream((33, 70, 40), seed=5)
+        batches[0][0][1, 1] = np.inf
+        col = _collection()
+        with self.assertRaises(hm.DataCorruptionError):
+            Evaluator(col, block_size=4, prefetch=False).run(
+                _as_device(batches)
+            )
+
+    def test_suspicious_checks_do_not_raise(self):
+        # constant / zero_weight degrade signal but cannot poison a
+        # merge; they report without escalating.
+        hm.enable(raise_on_corrupt=True)
+        _scores, targets = _stream((16,))[0]
+        col = _collection()
+        col.fused_update(
+            jnp.full((16, _C), 0.25, dtype=jnp.float32),
+            jnp.asarray(targets),
+        )
+        self.assertEqual(
+            self.findings(), [("constant", "", 0, 1, "fused_update")]
+        )
+
+
+class TestZeroCostWhenOff(HealthIsolation):
+    def test_no_health_entry_point_runs_disabled(self):
+        counter = {}
+
+        def counting(name, fn):
+            def wrapper(*args, **kwargs):
+                counter[name] = counter.get(name, 0) + 1
+                return fn(*args, **kwargs)
+
+            return wrapper
+
+        hooks = ("label_bounds", "batch_stats", "stats_for_update", "inspect")
+        with mock.patch.object(
+            hm, "label_bounds", counting("label_bounds", hm.label_bounds)
+        ), mock.patch.object(
+            hm, "batch_stats", counting("batch_stats", hm.batch_stats)
+        ), mock.patch.object(
+            hm,
+            "stats_for_update",
+            counting("stats_for_update", hm.stats_for_update),
+        ), mock.patch.object(
+            hm, "inspect", counting("inspect", hm.inspect)
+        ):
+            batches = _as_device(_stream((33, 70, 150, 97, 40), seed=6))
+            col = _collection()
+            for args in batches[:2]:
+                col.fused_update(*args)
+            col2 = _collection()
+            Evaluator(col2, block_size=2, prefetch=True).run(batches)
+        self.assertEqual(counter, {}, f"health hooks ran disabled: {counter}")
+        del hooks
+
+    def test_program_rebuilds_once_per_flag_flip(self):
+        # Flipping the monitor rebuilds the fused program (side outputs
+        # traced in/out); steady state on either side reuses it.
+        batches = _as_device(_stream((32, 32, 32), seed=7))
+        col = _collection()
+        col.fused_update(*batches[0])
+        program_off = col._fused_apply
+        col.fused_update(*batches[1])
+        self.assertIs(col._fused_apply, program_off)
+        hm.enable()
+        col.fused_update(*batches[2])
+        self.assertIsNot(col._fused_apply, program_off)
+        self.assertTrue(col._fused_apply_health)

@@ -1,0 +1,565 @@
+"""Fleet-scope observability: cross-host snapshot aggregation + skew
+diagnostics, graceful single-host degradation, Perfetto trace export,
+forward-compatible JSONL reads, the offline CLI, and the bench
+regression sentinel (torcheval_tpu/telemetry/{aggregate,export,__main__},
+scripts/check_bench_regression.py)."""
+
+import contextlib
+import importlib.util
+import io
+import json
+import os
+import tempfile
+import unittest
+import warnings
+
+import pytest
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.distributed import (
+    CollectiveGroup,
+    LocalWorld,
+    NullGroup,
+    SingleProcessGroup,
+)
+from torcheval_tpu.telemetry import aggregate, events as ev, export
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.fleet]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FleetIsolation(unittest.TestCase):
+    """Same contract as test_telemetry.TelemetryIsolation: every test
+    starts from a cleared, disabled bus and leaves the process so."""
+
+    def setUp(self):
+        self._capacity = ev.capacity()
+        telemetry.disable()
+        telemetry.clear()
+
+    def tearDown(self):
+        ev.enable(capacity=self._capacity)
+        telemetry.disable()
+        telemetry.clear()
+
+
+def _emit_host_activity():
+    """A small deterministic slice of one host's telemetry."""
+    telemetry.enable()
+    ev.record_retrace("fleet-test-program")
+    ev.record_engine_block(4, 3, 1)
+    ev.record_prefetch_stall(0.004)
+    ev.record_sync("all_gather_object", 0.010, 128)
+    ev.record_span("update", "BinaryAccuracy", 0.002, 64)
+    ev.record_data_health("nan", "fused_update", "", 0, 2)
+
+
+def _synthetic_snapshot(
+    process_index,
+    *,
+    sync_seconds=0.0,
+    slowest=0.0,
+    stalls=0,
+    retraces=0,
+    pad_waste=0.0,
+    health=0,
+):
+    """A hand-built host snapshot with known numbers, the test seam for
+    skew assertions without real multi-host collectives."""
+    return {
+        "version": aggregate.SNAPSHOT_VERSION,
+        "host": {
+            "process_index": process_index,
+            "hostname": f"host{process_index}",
+        },
+        "report": {
+            "events_captured": 10,
+            "events_dropped": 0,
+            "sync": {
+                "calls": 4,
+                "seconds": sync_seconds,
+                "slowest": [
+                    {
+                        "op": "all_gather_object",
+                        "seconds": slowest,
+                        "payload_bytes": 128,
+                        "callsite": "eval.py:1",
+                    }
+                ],
+            },
+            "engine": {
+                "blocks": 2,
+                "batches": 6,
+                "prefetch_stalls": stalls,
+                "stall_seconds": stalls * 0.01,
+            },
+            "retrace": {"total": retraces},
+            "bucket_pad": {"waste_pct": pad_waste},
+            "data_health": {
+                "checks": (
+                    {"nan": {"count": health, "events": 1}} if health else {}
+                )
+            },
+        },
+        "events": [],
+    }
+
+
+class _FakeGroup(CollectiveGroup):
+    """CollectiveGroup test seam: collectives return this rank's payload
+    merged with preset peer snapshots — a simulated multi-host gather."""
+
+    def __init__(self, peers, rank=0):
+        self._peers = list(peers)
+        self._rank = rank
+        self.all_gathers = 0
+        self.gathers = 0
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return len(self._peers) + 1
+
+    def all_gather_object(self, obj):
+        self.all_gathers += 1
+        return [obj] + self._peers
+
+    def broadcast_object(self, obj, src):
+        return obj
+
+    def gather_object(self, obj, dst=0):
+        self.gathers += 1
+        if dst == self._rank:
+            return [obj] + self._peers
+        return None
+
+
+class TestHostSnapshot(FleetIsolation):
+    def test_snapshot_structure_and_jsonability(self):
+        _emit_host_activity()
+        snap = aggregate.host_snapshot()
+        self.assertEqual(
+            set(snap), {"version", "host", "report", "events"}
+        )
+        self.assertEqual(snap["version"], aggregate.SNAPSHOT_VERSION)
+        self.assertIsInstance(snap["host"]["process_index"], int)
+        self.assertTrue(snap["host"]["hostname"])
+        self.assertEqual(len(snap["events"]), 6)
+        # The whole snapshot crosses the wire as plain JSON — tuple keys
+        # in report sections must have been flattened.
+        json.dumps(snap)
+
+    def test_sample_is_bounded(self):
+        _emit_host_activity()
+        self.assertEqual(
+            len(aggregate.host_snapshot(sample_events=2)["events"]), 2
+        )
+        self.assertEqual(
+            aggregate.host_snapshot(sample_events=0)["events"], []
+        )
+
+
+class TestSingleHostDegradation(FleetIsolation):
+    def test_single_process_group_issues_no_collective(self):
+        from unittest import mock
+
+        _emit_host_activity()
+        group = SingleProcessGroup()
+        with mock.patch.object(
+            group,
+            "all_gather_object",
+            side_effect=AssertionError("collective issued"),
+        ), mock.patch.object(
+            group,
+            "gather_object",
+            side_effect=AssertionError("collective issued"),
+        ):
+            merged = telemetry.fleet_report(group=group)
+            # dst on a world of one also stays local (no gather).
+            merged_dst = telemetry.fleet_report(group=group, dst=0)
+        self.assertEqual(merged["hosts"], 1)
+        self.assertEqual(merged_dst["hosts"], 1)
+        self.assertEqual(merged["totals"]["engine_blocks"], 1)
+        self.assertEqual(merged["totals"]["data_health_findings"], 2)
+
+    def test_null_group_reports_local_host(self):
+        # NullGroup raises on any collective; fleet_report must not
+        # issue one (world_size <= 1 path).
+        _emit_host_activity()
+        merged = telemetry.fleet_report(group=NullGroup())
+        self.assertEqual(merged["hosts"], 1)
+
+    def test_as_text(self):
+        _emit_host_activity()
+        text = telemetry.fleet_report(
+            group=SingleProcessGroup(), as_text=True
+        )
+        self.assertIn("fleet telemetry (1 hosts)", text)
+        self.assertIn("DATA HEALTH", text)
+
+
+class TestMergeSnapshots(FleetIsolation):
+    def _three_hosts(self):
+        # host 1 is the straggler (slowest collective + most stalls);
+        # host 2 feeds the NaNs.  Shuffled input order on purpose.
+        return [
+            _synthetic_snapshot(
+                1,
+                sync_seconds=0.9,
+                slowest=0.5,
+                stalls=30,
+                retraces=12,
+                pad_waste=40.0,
+            ),
+            _synthetic_snapshot(
+                2,
+                sync_seconds=0.2,
+                slowest=0.1,
+                stalls=6,
+                retraces=3,
+                pad_waste=10.0,
+                health=7,
+            ),
+            _synthetic_snapshot(
+                0,
+                sync_seconds=0.1,
+                slowest=0.05,
+                stalls=0,
+                retraces=3,
+                pad_waste=10.0,
+            ),
+        ]
+
+    def test_totals_and_host_order(self):
+        merged = aggregate.merge_snapshots(self._three_hosts())
+        self.assertEqual(merged["hosts"], 3)
+        self.assertEqual(
+            [r["host"]["process_index"] for r in merged["per_host"]],
+            [0, 1, 2],
+        )
+        totals = merged["totals"]
+        self.assertEqual(totals["sync_calls"], 12)
+        self.assertAlmostEqual(totals["sync_seconds"], 1.2)
+        self.assertEqual(totals["prefetch_stalls"], 36)
+        self.assertEqual(totals["retrace_total"], 18)
+        self.assertEqual(totals["engine_blocks"], 6)
+        self.assertEqual(totals["engine_batches"], 18)
+        self.assertEqual(totals["data_health_findings"], 7)
+
+    def test_skew_diagnostics(self):
+        merged = aggregate.merge_snapshots(self._three_hosts())
+        skew = merged["skew"]
+        # The single worst collective fleet-wide, pinned to its host.
+        self.assertAlmostEqual(skew["slowest_sync"]["seconds"], 0.5)
+        self.assertEqual(
+            skew["slowest_sync"]["host"]["process_index"], 1
+        )
+        # Prefetch-stall asymmetry: host 1 holds the max; imbalance is
+        # max/mean = 30 / 12.
+        stalls = skew["prefetch_stalls"]
+        self.assertEqual(stalls["max"], 30.0)
+        self.assertEqual(stalls["min"], 0.0)
+        self.assertEqual(stalls["max_host"]["process_index"], 1)
+        self.assertAlmostEqual(stalls["imbalance"], 30 / 12)
+        # Retrace asymmetry.
+        self.assertEqual(skew["retrace"]["max"], 12.0)
+        self.assertEqual(skew["retrace"]["max_host"]["process_index"], 1)
+        # Padding-waste variance of [40, 10, 10]: mean 20, var 200.
+        pad = skew["pad_waste_pct"]
+        self.assertAlmostEqual(pad["mean"], 20.0)
+        self.assertAlmostEqual(pad["variance"], 200.0)
+        # Health findings pinned to the producing host only.
+        self.assertEqual(
+            merged["data_health_by_host"],
+            [
+                {
+                    "host": {"process_index": 2, "hostname": "host2"},
+                    "findings": 7,
+                }
+            ],
+        )
+
+    def test_empty_rejected(self):
+        with self.assertRaises(ValueError):
+            aggregate.merge_snapshots([])
+
+    def test_format_fleet_report_renders(self):
+        text = export.format_fleet_report(
+            aggregate.merge_snapshots(self._three_hosts())
+        )
+        self.assertIn("fleet telemetry (3 hosts)", text)
+        self.assertIn("slowest collective", text)
+        self.assertIn("on host 1", text)
+        self.assertIn("DATA HEALTH: host 2", text)
+
+
+class TestFleetReportCollectives(FleetIsolation):
+    def test_all_gather_merges_simulated_hosts(self):
+        _emit_host_activity()
+        peers = [
+            _synthetic_snapshot(1, sync_seconds=0.3, stalls=5, retraces=2),
+            _synthetic_snapshot(2, sync_seconds=0.1, stalls=1, retraces=9),
+        ]
+        group = _FakeGroup(peers, rank=0)
+        merged = telemetry.fleet_report(group=group)
+        self.assertEqual(group.all_gathers, 1)
+        self.assertEqual(merged["hosts"], 3)
+        # The live local snapshot rode along with the injected peers.
+        self.assertEqual(
+            merged["totals"]["prefetch_stalls"],
+            6 + telemetry.report()["engine"]["prefetch_stalls"],
+        )
+        self.assertEqual(
+            merged["skew"]["retrace"]["max_host"]["process_index"], 2
+        )
+
+    def test_gather_dst_returns_none_elsewhere(self):
+        _emit_host_activity()
+        peers = [_synthetic_snapshot(1)]
+        coordinator = _FakeGroup(peers, rank=0)
+        self.assertEqual(
+            telemetry.fleet_report(group=coordinator, dst=0)["hosts"], 2
+        )
+        other = _FakeGroup(peers, rank=1)
+        self.assertIsNone(telemetry.fleet_report(group=other, dst=0))
+
+    def test_local_world_fleet_report(self):
+        # Threaded multi-rank smoke: every rank gathers every snapshot.
+        # (LocalWorld ranks share one process-global bus, so the per-host
+        # numbers coincide — the point is the collective path itself.)
+        _emit_host_activity()
+        results = LocalWorld(2).run(
+            lambda g, r: telemetry.fleet_report(group=g, sample_events=0)
+        )
+        self.assertEqual([m["hosts"] for m in results], [2, 2])
+        dst_results = LocalWorld(2).run(
+            lambda g, r: telemetry.fleet_report(
+                group=g, dst=0, sample_events=0
+            )
+        )
+        self.assertEqual(dst_results[0]["hosts"], 2)
+        self.assertIsNone(dst_results[1])
+
+
+class TestPerfetto(FleetIsolation):
+    SPAN_PHASES = (
+        "update",
+        "compute",
+        "merge_state",
+        "reset",
+        "dispatch",
+        "engine_block",
+        "prefetch_wait",
+    )
+
+    def _emit_every_span_kind(self):
+        telemetry.enable()
+        for phase in self.SPAN_PHASES:
+            ev.record_span(phase, "BinaryAccuracy", 0.001, 32)
+        ev.record_sync("all_gather_object", 0.010, 128)
+        ev.record_prefetch_stall(0.004)
+        ev.record_retrace("perfetto-test")
+        ev.record_data_health("inf", "engine_block", "acc", 1, 3)
+
+    def test_schema_and_span_round_trip(self):
+        self._emit_every_span_kind()
+        trace = telemetry.to_perfetto()
+        json.dumps(trace)  # the file Perfetto loads is plain JSON
+        self.assertEqual(trace["displayTimeUnit"], "ms")
+        rows = trace["traceEvents"]
+        for row in rows:
+            self.assertIn(row["ph"], {"M", "X", "i"})
+            self.assertIsInstance(row["pid"], int)
+            self.assertIsInstance(row["tid"], int)
+            if row["ph"] == "X":
+                self.assertGreaterEqual(row["ts"], 0.0)
+                self.assertGreaterEqual(row["dur"], 0.0)
+                self.assertTrue(row["name"])
+            elif row["ph"] == "i":
+                self.assertEqual(row["s"], "t")
+        # Every duration kind becomes a complete event under its
+        # span-phase name; the stall renders as prefetch_wait.
+        x_names = {r["name"] for r in rows if r["ph"] == "X"}
+        for phase in self.SPAN_PHASES:
+            self.assertIn(f"BinaryAccuracy.{phase}", x_names)
+        self.assertIn("sync.all_gather_object", x_names)
+        self.assertIn("prefetch_wait", x_names)
+        # Instants carry their kind; metadata names the process.
+        i_names = {r["name"] for r in rows if r["ph"] == "i"}
+        self.assertEqual(i_names, {"retrace", "data_health"})
+        meta = [r for r in rows if r["ph"] == "M"]
+        self.assertIn(
+            "process_name", {r["name"] for r in meta}
+        )
+        # MainThread pins to track 0.
+        threads = {
+            r["args"]["name"]: r["tid"]
+            for r in meta
+            if r["name"] == "thread_name"
+        }
+        self.assertEqual(threads["MainThread"], 0)
+
+    def test_fleet_to_perfetto_separates_hosts(self):
+        self._emit_every_span_kind()
+        snap0 = aggregate.host_snapshot()
+        snap1 = aggregate.host_snapshot()
+        snap1["host"] = {"process_index": 1, "hostname": "peer"}
+        # Forward compat: a newer writer's unknown kind is skipped.
+        snap1["events"].append({"kind": "from_the_future", "time_s": 1.0})
+        trace = export.fleet_to_perfetto([snap0, snap1])
+        pids = {r["pid"] for r in trace["traceEvents"]}
+        self.assertEqual(pids, {0, 1})
+        names = {
+            r["args"]["name"]
+            for r in trace["traceEvents"]
+            if r["ph"] == "M" and r["name"] == "process_name"
+        }
+        self.assertIn("host 1 (peer)", names)
+        self.assertNotIn(
+            "from_the_future",
+            {r.get("cat") for r in trace["traceEvents"]},
+        )
+
+
+class TestReadJsonlForwardCompat(FleetIsolation):
+    def _dump_with_future_kind(self):
+        telemetry.enable()
+        ev.record_retrace("compat-test")
+        buf = io.StringIO()
+        telemetry.export_jsonl(buf)
+        buf.write(
+            json.dumps({"kind": "from_the_future", "time_s": 1.0}) + "\n"
+        )
+        buf.write(
+            json.dumps({"kind": "also_unknown", "time_s": 2.0}) + "\n"
+        )
+        buf.seek(0)
+        return buf
+
+    def test_unknown_kinds_skipped_with_counted_warning(self):
+        buf = self._dump_with_future_kind()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            back = telemetry.read_jsonl(buf)
+        self.assertEqual([e.kind for e in back], ["retrace"])
+        messages = [str(w.message) for w in caught]
+        self.assertEqual(len(messages), 1)
+        self.assertIn("skipped 2 event(s) of unknown kind", messages[0])
+        self.assertIn("also_unknown", messages[0])
+        self.assertIn("from_the_future", messages[0])
+
+    def test_strict_raises(self):
+        buf = self._dump_with_future_kind()
+        with self.assertRaises(ValueError):
+            telemetry.read_jsonl(buf, strict=True)
+
+
+class TestTelemetryCLI(FleetIsolation):
+    def _write_dump(self, td):
+        _emit_host_activity()
+        path = os.path.join(td, "report.jsonl")
+        telemetry.export_jsonl(path)
+        telemetry.disable()
+        telemetry.clear()
+        return path
+
+    def _main(self, argv):
+        from torcheval_tpu.telemetry.__main__ import main
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            code = main(argv)
+        return code, out.getvalue()
+
+    def test_text_report(self):
+        with tempfile.TemporaryDirectory() as td:
+            code, out = self._main([self._write_dump(td)])
+        self.assertEqual(code, 0)
+        self.assertIn("fleet-test-program", out)
+        self.assertIn("DATA HEALTH", out)
+
+    def test_prometheus(self):
+        with tempfile.TemporaryDirectory() as td:
+            code, out = self._main(
+                [self._write_dump(td), "--prometheus"]
+            )
+        self.assertEqual(code, 0)
+        self.assertIn(
+            'torcheval_tpu_data_health_total{check="nan",metric=""} 2', out
+        )
+
+    def test_perfetto_file(self):
+        with tempfile.TemporaryDirectory() as td:
+            dump = self._write_dump(td)
+            trace_path = os.path.join(td, "trace.json")
+            code, out = self._main([dump, "--perfetto", trace_path])
+            self.assertEqual(code, 0)
+            with open(trace_path, "r", encoding="utf-8") as fh:
+                trace = json.load(fh)
+        self.assertTrue(
+            any(r["ph"] == "X" for r in trace["traceEvents"])
+        )
+        self.assertIn("wrote", out)
+
+
+class TestBenchRegressionSentinel(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        spec = importlib.util.spec_from_file_location(
+            "check_bench_regression",
+            os.path.join(
+                _REPO_ROOT, "scripts", "check_bench_regression.py"
+            ),
+        )
+        cls.sentinel = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cls.sentinel)
+
+    @staticmethod
+    def _doc(values):
+        rows = [
+            {"metric": name, "value": value, "unit": "samples/sec"}
+            for name, value in values.items()
+        ]
+        return {"headline": rows[0], "workloads": rows}
+
+    def test_regression_detected(self):
+        baseline = self._doc({"acc": 1000.0, "f1": 500.0})
+        fresh = self._doc({"acc": 800.0, "f1": 495.0})  # acc -20%
+        regressions = self.sentinel.compare(baseline, fresh)
+        self.assertEqual(
+            [(r["metric"], r["drop_pct"]) for r in regressions],
+            [("acc", 20.0)],
+        )
+
+    def test_within_threshold_and_improvement_pass(self):
+        baseline = self._doc({"acc": 1000.0, "f1": 500.0})
+        fresh = self._doc({"acc": 905.0, "f1": 600.0})  # -9.5% / +20%
+        self.assertEqual(self.sentinel.compare(baseline, fresh), [])
+
+    def test_incomparable_rows_skipped(self):
+        baseline = self._doc({"acc": 1000.0, "old": 500.0, "zero": 100.0})
+        fresh = self._doc({"acc": 1000.0, "new": 50.0, "zero": 0.0})
+        fresh["workloads"][0]["degraded"] = True  # CPU-fallback acc row
+        self.assertEqual(self.sentinel.compare(baseline, fresh), [])
+
+    def test_main_exit_codes(self):
+        with tempfile.TemporaryDirectory() as td:
+            base_path = os.path.join(td, "base.json")
+            fresh_path = os.path.join(td, "fresh.json")
+            with open(base_path, "w", encoding="utf-8") as fh:
+                json.dump(self._doc({"acc": 1000.0}), fh)
+            with open(fresh_path, "w", encoding="utf-8") as fh:
+                json.dump(self._doc({"acc": 500.0}), fh)
+            with contextlib.redirect_stdout(io.StringIO()):
+                code_bad = self.sentinel.main(
+                    ["--baseline", base_path, "--fresh", fresh_path]
+                )
+                code_ok = self.sentinel.main(
+                    ["--baseline", base_path, "--fresh", base_path]
+                )
+        self.assertEqual(code_bad, 1)
+        self.assertEqual(code_ok, 0)

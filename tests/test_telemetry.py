@@ -2,6 +2,7 @@
 zero-overhead guard, and the hot_path_stats compatibility view
 (torcheval_tpu/telemetry/)."""
 
+import collections
 import importlib.util
 import io
 import itertools
@@ -146,6 +147,9 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         # directly keeps this round-trip fast and deterministic).
         ev.record_engine_block(4, 3, 1)
         ev.record_prefetch_stall(0.002)
+        # data_health — the streaming health monitor's finding (the real
+        # fused/engine detection paths are covered by tests/engine/test_health).
+        ev.record_data_health("nan", "fused_update", "", 0, 2)
         # sync — the in-process wire simulation's hook.
         LocalWorld(2).run(lambda g, r: g.all_gather_object({"rank": r}))
         # span — the Metric phase wrapper.
@@ -200,6 +204,9 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         self.assertIn("torcheval_tpu_engine_pad_steps_total 1", text)
         self.assertIn("torcheval_tpu_engine_prefetch_stall_total 1", text)
         self.assertIn(
+            'torcheval_tpu_data_health_total{check="nan",metric=""} 2', text
+        )
+        self.assertIn(
             'torcheval_tpu_sync_seconds_count{op="local_all_gather_object"} 2',
             text,
         )
@@ -229,6 +236,9 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         self.assertEqual(rep["engine"]["blocks"], 1)
         self.assertEqual(rep["engine"]["batches"], 3)
         self.assertEqual(rep["engine"]["prefetch_stalls"], 1)
+        self.assertEqual(rep["data_health"]["findings"], 2)
+        self.assertEqual(rep["data_health"]["events"], 1)
+        self.assertEqual(rep["data_health"]["checks"]["nan"]["count"], 2)
         self.assertAlmostEqual(rep["engine"]["dispatches_per_batch"], 1 / 3)
         self.assertEqual(rep["sync"]["calls"], 2)
         self.assertTrue(rep["sync"]["slowest"])
@@ -339,6 +349,72 @@ class TestThreadSafety(TelemetryIsolation):
         rep = telemetry.report()
         self.assertEqual(rep["retrace"]["total"], total)
         self.assertEqual(len(ev.events()) + ev.dropped(), total)
+
+
+class TestConcurrentPrefetchEmission(TelemetryIsolation):
+    """The real producer/consumer pair: the Prefetcher's background
+    thread emits through ``stage`` while the main thread emits between
+    ``__next__`` calls — the shapes the ring sees in a live eval run."""
+
+    def _drive(self, n_items):
+        from torcheval_tpu.engine.prefetch import Prefetcher
+
+        def stage(item):
+            ev.record_retrace("prefetch-producer")
+            return item
+
+        pf = Prefetcher(range(n_items), stage=stage, depth=2)
+        try:
+            for _ in pf:
+                ev.record_retrace("main-consumer")
+        finally:
+            pf.close()
+
+    def test_no_drops_below_capacity(self):
+        ev.enable(capacity=4096)
+        n = 200
+        self._drive(n)
+        self.assertEqual(ev.dropped(), 0)
+        captured = ev.events()
+        by_program = collections.Counter(
+            e.program for e in captured if e.kind == "retrace"
+        )
+        self.assertEqual(by_program["prefetch-producer"], n)
+        self.assertEqual(by_program["main-consumer"], n)
+        # Events carry the emitting thread: producer events come from the
+        # prefetch thread, consumer events from the main thread.
+        threads_by_program = collections.defaultdict(set)
+        for e in captured:
+            if e.kind == "retrace":
+                threads_by_program[e.program].add(e.thread)
+        self.assertEqual(
+            threads_by_program["prefetch-producer"], {"torcheval-tpu-prefetch"}
+        )
+        self.assertEqual(
+            threads_by_program["main-consumer"], {"MainThread"}
+        )
+
+    def test_exact_aggregates_across_ring_overflow(self):
+        capacity = 32
+        ev.enable(capacity=capacity)
+        n = 500
+        self._drive(n)
+        rep = telemetry.report()
+        offenders = {
+            o["program"]: o["count"]
+            for o in rep["retrace"]["top_offenders"]
+        }
+        # Aggregates fold at emit time, so they stay exact even though
+        # the ring kept only the last ``capacity`` events.
+        self.assertEqual(offenders["prefetch-producer"], n)
+        self.assertEqual(offenders["main-consumer"], n)
+        self.assertEqual(len(ev.events()), capacity)
+        # dropped() is consistent with everything emitted: 2n retraces,
+        # one prefetch_wait span per __next__ (n items + the _DONE
+        # sentinel), plus however many stalls were actually timed.
+        emitted = 2 * n + (n + 1) + rep["engine"]["prefetch_stalls"]
+        self.assertEqual(rep["events_captured"], emitted)
+        self.assertEqual(ev.dropped(), emitted - capacity)
 
 
 class TestCallsiteAttribution(TelemetryIsolation):

@@ -53,6 +53,7 @@ from torcheval_tpu.metrics._bucket import (
 )
 from torcheval_tpu.metrics.collection import MetricCollection
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import health as _health
 
 __all__ = ["Evaluator", "Prefetcher", "ScanRunner"]
 
@@ -337,12 +338,20 @@ class Evaluator:
     # ------------------------------------------------------------ dispatch
     def _ensure_runner(self) -> ScanRunner:
         donate = resolve_donate(self._collection, self._donate)
-        if self._runner is None or self._runner.donate != donate:
-            self._runner = ScanRunner(self._collection, donate)
+        if (
+            self._runner is None
+            or self._runner.donate != donate
+            or self._runner.health != _health.ENABLED
+        ):
+            self._runner = ScanRunner(
+                self._collection, donate, health=_health.ENABLED
+            )
         return self._runner
 
     def _dispatch(self, block: _Block) -> None:
         if block.perbatch:
+            # The per-batch tail goes through fused_update, which carries
+            # its own health side-outputs — every batch stays monitored.
             for args in block.perbatch:
                 self._collection.fused_update(*args)
             self.batches_seen += block.batches
@@ -350,7 +359,7 @@ class Evaluator:
             return
         runner = self._ensure_runner()
         t0 = time.monotonic() if _telemetry.ENABLED else 0.0
-        runner.dispatch(block.args, block.mask)
+        health_stats = runner.dispatch(block.args, block.mask)
         self.blocks_dispatched += 1
         self.batches_seen += block.batches
         if _telemetry.ENABLED:
@@ -362,6 +371,16 @@ class Evaluator:
                 "Evaluator",
                 time.monotonic() - t0,
                 states_nbytes(self._collection),
+            )
+        if health_stats is not None:
+            # steps=block.batches: stacked stats are reduced over the
+            # REAL scan steps only, so the deliberate fully-masked tail
+            # pad steps can never read as zero-weight batches.
+            _health.inspect(
+                health_stats,
+                source="engine_block",
+                bounds=runner.bounds,
+                steps=block.batches,
             )
         self._maybe_snapshot()
 

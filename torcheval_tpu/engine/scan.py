@@ -28,12 +28,22 @@ from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics.collection import MetricCollection, _call_signature
 from torcheval_tpu.ops import _flags
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import health as _health
 
 
-def _build_apply(collection: MetricCollection, donate: bool):
+def _build_apply(
+    collection: MetricCollection,
+    donate: bool,
+    health: bool = False,
+    bounds: Tuple[Tuple[str, int], ...] = (),
+):
     """The jitted block program: ``(states, stacked_args, stacked_mask)
     -> states`` where the stacked leaves carry a leading ``block_size``
-    axis and ``stacked_mask`` is ``None`` for unbucketed blocks."""
+    axis and ``stacked_mask`` is ``None`` for unbucketed blocks.  With
+    ``health`` the scan additionally stacks per-step
+    :func:`~torcheval_tpu.telemetry.health.batch_stats` as its ys and
+    returns ``(states, stats)`` — the data-health side output, fused
+    into the same dispatch."""
     metrics = collection._metrics
 
     def apply(states, stacked_args, stacked_mask):
@@ -49,25 +59,43 @@ def _build_apply(collection: MetricCollection, donate: bool):
                     m.update(*step_args)
                 else:
                     m.update(*step_args, mask=step_mask)
-            return collection._read_states(), None
+            ys = (
+                _health.batch_stats(step_args, step_mask, bounds)
+                if health
+                else None
+            )
+            return collection._read_states(), ys
 
-        final, _ = jax.lax.scan(
+        final, stats = jax.lax.scan(
             body, states, (stacked_args, stacked_mask)
         )
+        if health:
+            return final, stats
         return final
 
     return jax.jit(apply, donate_argnums=(0,) if donate else ())
 
 
 class ScanRunner:
-    """Owns the jitted scan program for one (collection, donate) pair and
-    dispatches stacked blocks through it with the collection's abort-safe
-    state install/restore semantics."""
+    """Owns the jitted scan program for one (collection, donate, health)
+    triple and dispatches stacked blocks through it with the
+    collection's abort-safe state install/restore semantics."""
 
-    def __init__(self, collection: MetricCollection, donate: bool) -> None:
+    def __init__(
+        self,
+        collection: MetricCollection,
+        donate: bool,
+        health: bool = False,
+    ) -> None:
         self._collection = collection
         self._donate = bool(donate)
-        self._apply = _build_apply(collection, self._donate)
+        self._health = bool(health)
+        self.bounds: Tuple[Tuple[str, int], ...] = (
+            _health.label_bounds(collection._metrics) if health else ()
+        )
+        self._apply = _build_apply(
+            collection, self._donate, self._health, self.bounds
+        )
         # Signatures already executed — same steady-state contract as
         # MetricCollection._fused_seen: a hit means no trace can run.
         self._seen: set = set()
@@ -76,26 +104,37 @@ class ScanRunner:
     def donate(self) -> bool:
         return self._donate
 
+    @property
+    def health(self) -> bool:
+        return self._health
+
     def dispatch(
         self,
         stacked_args: Tuple[Any, ...],
         stacked_mask: Optional[jax.Array],
-    ) -> None:
-        """Run one block and install the resulting member states."""
+    ) -> Optional[Any]:
+        """Run one block and install the resulting member states.
+        Returns the stacked health stats (device pytree) when the
+        runner was built with health, else ``None``."""
         col = self._collection
         key = _call_signature(stacked_args, {"mask": stacked_mask})
         if key not in self._seen:
             col._check_fusable()
         before = col._read_states()
         try:
-            new_states = self._apply(before, stacked_args, stacked_mask)
+            out = self._apply(before, stacked_args, stacked_mask)
         except BaseException:
             if _telemetry.ENABLED and self._donate:
                 _telemetry.record_donation("abort")
             col._install_states(before, guard_deleted=True)
             raise
         self._seen.add(key)
+        if self._health:
+            new_states, stats = out
+        else:
+            new_states, stats = out, None
         col._install_states(new_states)
+        return stats
 
 
 def resolve_donate(

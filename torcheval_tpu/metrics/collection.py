@@ -22,6 +22,7 @@ from torcheval_tpu.metrics._bucket import DEFAULT_MIN_BUCKET, pad_to_bucket
 from torcheval_tpu.metrics.metric import Metric, _move_state
 from torcheval_tpu.ops import _flags
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import health as _health
 
 
 def _call_signature(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Any:
@@ -99,6 +100,8 @@ class MetricCollection:
         self._donate = donate
         self._fused_apply: Optional[Any] = None
         self._fused_apply_donated: Optional[bool] = None
+        self._fused_apply_health: Optional[bool] = None
+        self._health_bounds: Tuple[Tuple[str, int], ...] = ()
         # The fused paths read every member state once per step; a
         # precomputed (name, metric, state-names) layout makes that a
         # flat loop instead of rebuilding the registry iteration each
@@ -174,8 +177,17 @@ class MetricCollection:
             if self._donate is not None
             else _flags.donation_enabled()
         )
-        if self._fused_apply is None or self._fused_apply_donated != donate:
+        health = _health.ENABLED
+        if (
+            self._fused_apply is None
+            or self._fused_apply_donated != donate
+            or self._fused_apply_health != health
+        ):
             metrics = self._metrics
+            # With the monitor off the program is byte-identical to a
+            # build without health.py: no side outputs, no extra
+            # dispatches (the zero-cost-when-off contract).
+            bounds = _health.label_bounds(metrics) if health else ()
 
             def apply(states, a, kw):
                 bump_trace("fused_collection")
@@ -184,12 +196,19 @@ class MetricCollection:
                         setattr(m, s, v)
                 for m in metrics.values():
                     m.update(*a, **kw)
+                if health:
+                    return (
+                        self._read_states(),
+                        _health.stats_for_update(a, kw, bounds),
+                    )
                 return self._read_states()
 
             self._fused_apply = jax.jit(
                 apply, donate_argnums=(0,) if donate else ()
             )
             self._fused_apply_donated = donate
+            self._fused_apply_health = health
+            self._health_bounds = bounds
             self._fused_seen = set()
         key = _call_signature(args, kwargs)
         if key not in self._fused_seen:
@@ -200,7 +219,7 @@ class MetricCollection:
         before = self._read_states()
         t0 = time.monotonic() if _telemetry.ENABLED else 0.0
         try:
-            new_states = self._fused_apply(before, args, kwargs)
+            out = self._fused_apply(before, args, kwargs)
         except BaseException:
             # An aborted trace (including KeyboardInterrupt mid-compile)
             # leaves tracer attrs on members; restore the concrete states.
@@ -213,6 +232,10 @@ class MetricCollection:
             self._install_states(before, guard_deleted=True)
             raise
         self._fused_seen.add(key)
+        if self._fused_apply_health:
+            new_states, health_stats = out
+        else:
+            new_states, health_stats = out, None
         self._install_states(new_states)
         if _telemetry.ENABLED:
             _telemetry.record_span(
@@ -223,6 +246,15 @@ class MetricCollection:
                     _telemetry.state_nbytes(m)
                     for m in self._metrics.values()
                 ),
+            )
+        if health_stats is not None:
+            # After _install_states: a raise-on-corrupt escalation must
+            # not leave tracer/deleted states behind — the batch was
+            # applied, the monitor only reports it.
+            _health.inspect(
+                health_stats,
+                source="fused_update",
+                bounds=self._health_bounds,
             )
         return self
 

@@ -11,26 +11,50 @@ module flag — see :mod:`torcheval_tpu.telemetry.events`).  Enable with
 * :func:`report` — the health summary (top retrace offenders by callsite,
   pad-waste ratio per bucket, cache hit rate, slowest collectives), which
   ``bench.py`` stamps into every bench row and
-  :func:`torcheval_tpu.routing.hot_path_stats` is a thin view over.
+  :func:`torcheval_tpu.routing.hot_path_stats` is a thin view over;
+* :func:`fleet_report` — the cross-host rollup: per-host snapshots merged
+  over a :class:`~torcheval_tpu.distributed.CollectiveGroup` with skew
+  diagnostics (slowest-host collectives, prefetch-stall/retrace
+  asymmetry, padding-waste variance) — see
+  :mod:`torcheval_tpu.telemetry.aggregate`;
+* :mod:`~torcheval_tpu.telemetry.health` — the streaming data-health
+  monitor (NaN/Inf, constant inputs, out-of-range labels, zero-weight
+  batches) fused into the update programs, reported here under
+  ``data_health``;
+* :func:`to_perfetto` — the span stream as Chrome/Perfetto trace-event
+  JSON for ``ui.perfetto.dev``.
 
 Example::
 
     from torcheval_tpu import telemetry
     telemetry.enable()
+    telemetry.health.enable()
     ... run the eval loop ...
     print(telemetry.report(as_text=True))
+    print(telemetry.fleet_report(as_text=True))
     telemetry.export_jsonl("telemetry.jsonl")
     open("metrics.prom", "w").write(telemetry.prometheus_text())
+    json.dump(telemetry.to_perfetto(), open("trace.json", "w"))
+
+A saved JSONL dump replays offline through the CLI::
+
+    python -m torcheval_tpu.telemetry telemetry.jsonl --perfetto trace.json
 """
 
 from __future__ import annotations
 
 from typing import Any, Dict, Union
 
-from torcheval_tpu.telemetry import events, export
+from torcheval_tpu.telemetry import aggregate, events, export, health
+from torcheval_tpu.telemetry.aggregate import (
+    fleet_report,
+    host_snapshot,
+    merge_snapshots,
+)
 from torcheval_tpu.telemetry.events import (
     BucketPadEvent,
     CacheEvent,
+    DataHealthEvent,
     DonationEvent,
     EngineBlockEvent,
     Event,
@@ -50,9 +74,12 @@ from torcheval_tpu.telemetry.export import (
     event_from_dict,
     event_to_dict,
     export_jsonl,
+    fleet_to_perfetto,
+    format_fleet_report,
     format_report,
     prometheus_text,
     read_jsonl,
+    to_perfetto,
 )
 
 # Re-export the snapshot accessor under its natural name without shadowing
@@ -135,6 +162,17 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         ),
     }
 
+    health_checks = {
+        (check if not metric else f"{check}:{metric}"): dict(entry)
+        for (check, metric), entry in agg["data_health"].items()
+    }
+    health_section = {
+        "enabled": health.ENABLED,
+        "findings": sum(e["count"] for e in health_checks.values()),
+        "events": sum(e["events"] for e in health_checks.values()),
+        "checks": health_checks,
+    }
+
     spans = {
         f"{name}.{phase}": {
             "calls": entry["calls"],
@@ -165,6 +203,7 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         "donation": dict(agg["donation"]),
         "sync": sync_totals,
         "engine": engine_section,
+        "data_health": health_section,
         "spans": spans,
         "events_captured": agg["emitted"],
         "events_dropped": events.dropped(),
@@ -178,6 +217,7 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
 __all__ = [
     "BucketPadEvent",
     "CacheEvent",
+    "DataHealthEvent",
     "DonationEvent",
     "EngineBlockEvent",
     "Event",
@@ -186,6 +226,7 @@ __all__ = [
     "RouteDowngradeEvent",
     "SpanEvent",
     "SyncEvent",
+    "aggregate",
     "clear",
     "disable",
     "emit",
@@ -197,8 +238,15 @@ __all__ = [
     "events_snapshot",
     "export",
     "export_jsonl",
+    "fleet_report",
+    "fleet_to_perfetto",
+    "format_fleet_report",
     "format_report",
+    "health",
+    "host_snapshot",
+    "merge_snapshots",
     "prometheus_text",
     "read_jsonl",
     "report",
+    "to_perfetto",
 ]

@@ -1,0 +1,73 @@
+"""``python -m torcheval_tpu.telemetry <report.jsonl>`` — replay a saved
+JSON-lines telemetry dump offline.
+
+Default output is the human-readable health summary
+(:func:`torcheval_tpu.telemetry.report` text); ``--prometheus`` prints
+the text-format counter snapshot instead, and ``--perfetto out.json``
+writes a Chrome/Perfetto trace for ``ui.perfetto.dev``.  Dumps written
+by newer library versions load fine — unknown event kinds are skipped
+with a counted warning (``export.read_jsonl``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torcheval_tpu.telemetry",
+        description="Pretty-print a saved telemetry JSONL report.",
+    )
+    parser.add_argument(
+        "report", help="path to a JSON-lines dump from telemetry.export_jsonl"
+    )
+    parser.add_argument(
+        "--prometheus",
+        action="store_true",
+        help="print the Prometheus text-format snapshot instead",
+    )
+    parser.add_argument(
+        "--perfetto",
+        metavar="OUT.json",
+        help="write a Chrome/Perfetto trace-event JSON file instead",
+    )
+    args = parser.parse_args(argv)
+
+    from torcheval_tpu.telemetry import events as ev
+    from torcheval_tpu.telemetry import export
+
+    loaded = export.read_jsonl(args.report)
+
+    # Replay into a private bus sized to hold everything: re-emitting
+    # rebuilds the exact aggregates (they are pure folds of the events),
+    # and the saved time/callsite/thread stamps are non-defaults so
+    # emit() preserves them.
+    ev.clear()
+    if loaded and ev.capacity() < len(loaded):
+        ev.enable(capacity=len(loaded))
+    for event in loaded:
+        ev.emit(event)
+
+    if args.perfetto:
+        trace = export.to_perfetto(loaded)
+        with open(args.perfetto, "w", encoding="utf-8") as fh:
+            json.dump(trace, fh)
+        print(
+            f"wrote {len(trace['traceEvents'])} trace events "
+            f"({len(loaded)} telemetry events) to {args.perfetto}"
+        )
+    elif args.prometheus:
+        sys.stdout.write(export.prometheus_text())
+    else:
+        import torcheval_tpu.telemetry as telemetry
+
+        sys.stdout.write(telemetry.report(as_text=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""Cross-host telemetry aggregation: serialize one host's snapshot,
+merge snapshots across a :class:`~torcheval_tpu.distributed.CollectiveGroup`,
+and diagnose fleet-level skew.
+
+On a multi-host pod, every ring buffer and counter from
+:mod:`torcheval_tpu.telemetry.events` is process-local — each operator
+console sees 1/N of the picture, and the interesting failures are
+exactly the asymmetric ones: one straggler host stretching every
+collective, one feed pipeline stalling its prefetcher, one host
+retracing in a loop, one host streaming NaNs into the merge.  This
+module closes that gap in three steps:
+
+1. :func:`host_snapshot` — a pickle/JSON-able dict of this host's
+   aggregates (the full :func:`torcheval_tpu.telemetry.report`) plus a
+   bounded sample of recent raw events;
+2. a group collective (``all_gather_object``, or ``gather_object`` for a
+   coordinator-only view) ships the snapshots;
+3. :func:`merge_snapshots` — per-host rollups, fleet totals, and the
+   skew diagnostics: slowest-host sync latency, prefetch-stall and
+   retrace asymmetry, padding-waste variance, and data-health findings
+   pinned to the host that produced them.
+
+The public entry point is :func:`fleet_report` (re-exported as
+``telemetry.fleet_report``).  It degrades gracefully: under
+:class:`~torcheval_tpu.distributed.SingleProcessGroup` or
+:class:`~torcheval_tpu.distributed.NullGroup` no collective is issued
+and the fleet view is this host's snapshot alone — the same code path
+an eval script ships to a pod runs unchanged on a laptop.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Dict, List, Optional, Union
+
+SNAPSHOT_VERSION = 1
+
+DEFAULT_SAMPLE_EVENTS = 256
+
+
+# ------------------------------------------------------------------ snapshot
+def host_snapshot(sample_events: int = DEFAULT_SAMPLE_EVENTS) -> Dict[str, Any]:
+    """This host's telemetry state as one plain dict: identity, the full
+    :func:`torcheval_tpu.telemetry.report`, and the newest
+    ``sample_events`` raw events (the bounded wire sample — aggregates
+    are exact regardless, the sample is for trace stitching and
+    spot-checks).  Everything inside is JSON-able."""
+    import torcheval_tpu.telemetry as telemetry
+    from torcheval_tpu.telemetry.export import event_to_dict
+
+    try:
+        import jax
+
+        process_index = int(jax.process_index())
+    except Exception:
+        process_index = 0
+
+    sample: List[Dict[str, Any]] = []
+    if sample_events > 0:
+        snap = telemetry.events_snapshot()
+        sample = [event_to_dict(e) for e in snap[-int(sample_events):]]
+
+    return {
+        "version": SNAPSHOT_VERSION,
+        "host": {
+            "process_index": process_index,
+            "hostname": socket.gethostname(),
+        },
+        "report": _plain(telemetry.report()),
+        "events": sample,
+    }
+
+
+def _plain(obj: Any) -> Any:
+    """Recursively force JSON-able containers (report dicts keyed by
+    tuples/ints become string-keyed)."""
+    if isinstance(obj, dict):
+        return {_plain_key(k): _plain(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_plain(v) for v in obj]
+    return obj
+
+
+def _plain_key(key: Any) -> str:
+    if isinstance(key, tuple):
+        return "/".join(str(part) for part in key)
+    return str(key)
+
+
+# --------------------------------------------------------------------- merge
+def _host_rollup(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """The per-host row of the fleet report: the handful of scalars the
+    skew diagnostics compare across hosts."""
+    report = snapshot.get("report", {})
+    sync = report.get("sync", {})
+    engine = report.get("engine", {})
+    health = report.get("data_health", {})
+    return {
+        "host": dict(snapshot.get("host", {})),
+        "events_captured": report.get("events_captured", 0),
+        "events_dropped": report.get("events_dropped", 0),
+        "sync_calls": sync.get("calls", 0),
+        "sync_seconds": sync.get("seconds", 0.0),
+        "slowest_sync": (sync.get("slowest") or [{}])[0],
+        "prefetch_stalls": engine.get("prefetch_stalls", 0),
+        "stall_seconds": engine.get("stall_seconds", 0.0),
+        "retrace_total": report.get("retrace", {}).get("total", 0),
+        "pad_waste_pct": report.get("bucket_pad", {}).get("waste_pct", 0.0),
+        "engine_blocks": engine.get("blocks", 0),
+        "engine_batches": engine.get("batches", 0),
+        "data_health_findings": sum(
+            entry.get("count", 0) for entry in health.get("checks", {}).values()
+        ),
+    }
+
+
+def _spread(
+    rollups: List[Dict[str, Any]], key: str
+) -> Dict[str, Any]:
+    """Cross-host asymmetry of one rollup scalar: min/max/mean, the host
+    holding the max, and ``imbalance`` = max/mean (1.0 means perfectly
+    even; the straggler signal)."""
+    values = [float(r[key]) for r in rollups]
+    mean = sum(values) / len(values)
+    hi = max(values)
+    hi_host = rollups[values.index(hi)]["host"]
+    return {
+        "min": min(values),
+        "max": hi,
+        "mean": mean,
+        "max_host": hi_host,
+        "imbalance": (hi / mean) if mean else (1.0 if hi == 0 else float("inf")),
+    }
+
+
+def _variance(values: List[float]) -> float:
+    mean = sum(values) / len(values)
+    return sum((v - mean) ** 2 for v in values) / len(values)
+
+
+def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-host snapshots (any order) into the fleet report dict:
+    ``hosts`` count, ``per_host`` rollups sorted by process index, fleet
+    ``totals``, and the ``skew`` diagnostics."""
+    if not snapshots:
+        raise ValueError("merge_snapshots needs at least one host snapshot")
+    rollups = sorted(
+        (_host_rollup(s) for s in snapshots),
+        key=lambda r: r["host"].get("process_index", 0),
+    )
+
+    totals = {
+        "events_captured": sum(r["events_captured"] for r in rollups),
+        "events_dropped": sum(r["events_dropped"] for r in rollups),
+        "sync_calls": sum(r["sync_calls"] for r in rollups),
+        "sync_seconds": sum(r["sync_seconds"] for r in rollups),
+        "prefetch_stalls": sum(r["prefetch_stalls"] for r in rollups),
+        "stall_seconds": sum(r["stall_seconds"] for r in rollups),
+        "retrace_total": sum(r["retrace_total"] for r in rollups),
+        "engine_blocks": sum(r["engine_blocks"] for r in rollups),
+        "engine_batches": sum(r["engine_batches"] for r in rollups),
+        "data_health_findings": sum(
+            r["data_health_findings"] for r in rollups
+        ),
+    }
+
+    # The straggler diagnostics.  slowest_sync is the single worst
+    # collective across the fleet (on a pod, one slow host stretches
+    # everyone's collectives — its OWN sync spans are the fingerprint).
+    slowest_sync: Dict[str, Any] = {}
+    for r in rollups:
+        cand = dict(r["slowest_sync"])
+        if cand and cand.get("seconds", 0.0) >= slowest_sync.get(
+            "seconds", -1.0
+        ):
+            cand["host"] = r["host"]
+            slowest_sync = cand
+    skew = {
+        "slowest_sync": slowest_sync,
+        "sync_seconds": _spread(rollups, "sync_seconds"),
+        "prefetch_stalls": _spread(rollups, "prefetch_stalls"),
+        "stall_seconds": _spread(rollups, "stall_seconds"),
+        "retrace": _spread(rollups, "retrace_total"),
+        "pad_waste_pct": {
+            **_spread(rollups, "pad_waste_pct"),
+            "variance": _variance(
+                [float(r["pad_waste_pct"]) for r in rollups]
+            ),
+        },
+    }
+
+    # Data-health findings pinned to the host that saw them — the "which
+    # host is feeding NaNs" answer.
+    health_by_host = [
+        {"host": r["host"], "findings": r["data_health_findings"]}
+        for r in rollups
+        if r["data_health_findings"]
+    ]
+
+    return {
+        "hosts": len(rollups),
+        "per_host": rollups,
+        "totals": totals,
+        "skew": skew,
+        "data_health_by_host": health_by_host,
+    }
+
+
+# ------------------------------------------------------------------- report
+def fleet_report(
+    group: Optional[Any] = None,
+    *,
+    dst: Optional[int] = None,
+    sample_events: int = DEFAULT_SAMPLE_EVENTS,
+    as_text: bool = False,
+) -> Union[Dict[str, Any], str, None]:
+    """The fleet-wide telemetry rollup.
+
+    ``group`` is any :class:`~torcheval_tpu.distributed.CollectiveGroup`
+    (default :func:`~torcheval_tpu.distributed.default_group`).  With
+    ``dst=None`` every host gathers every snapshot (``all_gather_object``)
+    and returns the merged report; with ``dst=R`` only rank R merges
+    (``gather_object``) and the other ranks return ``None`` — the
+    coordinator-logs-once pattern.
+
+    World size <= 1 (:class:`SingleProcessGroup`, or a
+    :class:`NullGroup` process that is not part of the group) issues NO
+    collective and reports this host alone, so the same call is safe
+    everywhere.
+    """
+    from torcheval_tpu.distributed import default_group
+    from torcheval_tpu.telemetry.export import format_fleet_report
+
+    if group is None:
+        group = default_group()
+
+    local = host_snapshot(sample_events=sample_events)
+    if group.world_size <= 1:
+        snapshots: Optional[List[Dict[str, Any]]] = [local]
+    elif dst is None:
+        snapshots = group.all_gather_object(local)
+    else:
+        snapshots = group.gather_object(local, dst=dst)
+    if snapshots is None:
+        return None
+    merged = merge_snapshots(snapshots)
+    if as_text:
+        return format_fleet_report(merged)
+    return merged

@@ -7,9 +7,9 @@ Every instrument in the library feeds this one module: retraces
 padding waste (``metrics/_bucket``), donation aborts/restores
 (``metrics/collection`` / ``metrics/_buffer``), collective sync calls
 (``parallel/sync`` / ``distributed``), update/compute/dispatch spans
-(``metrics/metric`` / ``metrics/collection`` / ``metrics/_fuse``), and
-the streaming engine's block dispatches and prefetch stalls
-(``torcheval_tpu/engine``).
+(``metrics/metric`` / ``metrics/collection`` / ``metrics/_fuse``), the streaming engine's block dispatches and prefetch stalls
+(``torcheval_tpu/engine``), and the data-health monitor's findings
+(:mod:`torcheval_tpu.telemetry.health`).
 
 Zero-cost-when-off contract
 ---------------------------
@@ -83,12 +83,15 @@ _dropped: int = 0
 # --------------------------------------------------------------------- events
 @dataclass
 class Event:
-    """Base event: a kind tag, a monotonic timestamp, and the user
-    callsite (``"file:line"``) the emission is attributed to."""
+    """Base event: a kind tag, a monotonic timestamp, the user callsite
+    (``"file:line"``) the emission is attributed to, and the emitting
+    thread's name (the Perfetto track — the prefetch producer and the
+    dispatch loop emit concurrently)."""
 
     kind: str = field(init=False, default="event")
     time_s: float = field(default=0.0)
     callsite: str = field(default="<unknown>:0")
+    thread: str = field(default="")
 
 
 @dataclass
@@ -184,6 +187,23 @@ class PrefetchStallEvent(Event):
 
 
 @dataclass
+class DataHealthEvent(Event):
+    """A data-quality finding from the streaming health monitor
+    (:mod:`torcheval_tpu.telemetry.health`): ``count`` offending
+    elements/batches of ``check`` kind observed in positional update
+    argument ``arg``, attributed to member ``metric`` when the check is
+    member-specific (out-of-range labels vs that member's class count;
+    empty for input-level checks)."""
+
+    kind: str = field(init=False, default="data_health")
+    check: str = ""  # "nan" | "inf" | "constant" | "label_range" | "zero_weight"
+    source: str = ""  # "fused_update" | "engine_block"
+    metric: str = ""
+    arg: int = -1
+    count: int = 0
+
+
+@dataclass
 class SpanEvent(Event):
     """A timed metric phase (``update`` / ``compute`` / ``dispatch``)
     with the metric's state-memory footprint after the phase."""
@@ -209,6 +229,7 @@ KIND_TO_CLASS: Dict[str, type] = {
     "span": SpanEvent,
     "engine_block": EngineBlockEvent,
     "prefetch_stall": PrefetchStallEvent,
+    "data_health": DataHealthEvent,
 }
 
 
@@ -233,6 +254,9 @@ def _zero_aggregates() -> Dict[str, Any]:
             "prefetch_stalls": 0,
             "stall_seconds": 0.0,
         },
+        # (check, metric) -> {"count": offending elements/batches,
+        # "events": emissions}; metric is "" for input-level checks.
+        "data_health": {},
         "emitted": 0,
     }
 
@@ -322,6 +346,9 @@ def aggregates() -> Dict[str, Any]:
             "sync": {k: _copy_hist_entry(v) for k, v in _agg["sync"].items()},
             "spans": {k: _copy_hist_entry(v) for k, v in _agg["spans"].items()},
             "engine": dict(_agg["engine"]),
+            "data_health": {
+                k: dict(v) for k, v in _agg["data_health"].items()
+            },
             "emitted": _agg["emitted"],
         }
 
@@ -342,12 +369,15 @@ def _callsite() -> str:
 
 def emit(event: Event) -> None:
     """Append ``event`` to the ring and fold it into the aggregates.
-    Timestamp/callsite are stamped here when the caller left defaults."""
+    Timestamp/callsite/thread are stamped here when the caller left
+    defaults."""
     global _dropped
     if event.time_s == 0.0:
         event.time_s = time.monotonic()
     if event.callsite == "<unknown>:0":
         event.callsite = _callsite()
+    if not event.thread:
+        event.thread = threading.current_thread().name
     with _lock:
         if len(_events) == _events.maxlen:
             _dropped += 1
@@ -402,6 +432,12 @@ def _fold(event: Event) -> None:
         entry = _agg["engine"]
         entry["prefetch_stalls"] += 1
         entry["stall_seconds"] += event.seconds
+    elif isinstance(event, DataHealthEvent):
+        entry = _agg["data_health"].setdefault(
+            (event.check, event.metric), {"count": 0, "events": 0}
+        )
+        entry["count"] += event.count
+        entry["events"] += 1
     elif isinstance(event, SpanEvent):
         entry = _agg["spans"].setdefault(
             (event.name, event.phase),
@@ -477,6 +513,20 @@ def record_engine_block(
 
 def record_prefetch_stall(seconds: float) -> None:
     emit(PrefetchStallEvent(seconds=float(seconds)))
+
+
+def record_data_health(
+    check: str, source: str, metric: str, arg: int, count: int
+) -> None:
+    emit(
+        DataHealthEvent(
+            check=check,
+            source=source,
+            metric=metric,
+            arg=int(arg),
+            count=int(count),
+        )
+    )
 
 
 def record_span(

@@ -73,14 +73,172 @@ def _write_jsonl(fh: TextIO, snapshot: List["_events.Event"]) -> int:
     return len(snapshot)
 
 
-def read_jsonl(source: Union[str, "os.PathLike", TextIO]) -> List["_events.Event"]:
-    """Parse a JSON-lines dump back into typed events."""
+def read_jsonl(
+    source: Union[str, "os.PathLike", TextIO], *, strict: bool = False
+) -> List["_events.Event"]:
+    """Parse a JSON-lines dump back into typed events.
+
+    Forward-compatible by default: lines whose ``kind`` this build does
+    not know (a dump written by a newer version) are skipped and counted
+    into ONE summary warning instead of raising, so old tooling keeps
+    loading new reports.  Pass ``strict=True`` to raise on the first
+    unknown kind instead.
+    """
     if isinstance(source, (str, os.PathLike)):
         with open(source, "r", encoding="utf-8") as fh:
             lines = fh.readlines()
     else:
         lines = source.readlines()
-    return [event_from_dict(json.loads(line)) for line in lines if line.strip()]
+    out: List["_events.Event"] = []
+    skipped: Dict[str, int] = {}
+    for line in lines:
+        if not line.strip():
+            continue
+        payload = json.loads(line)
+        kind = payload.get("kind")
+        if not strict and kind not in _events.KIND_TO_CLASS:
+            key = str(kind)
+            skipped[key] = skipped.get(key, 0) + 1
+            continue
+        out.append(event_from_dict(payload))
+    if skipped:
+        import warnings
+
+        detail = ", ".join(
+            f"{kind} x{count}" for kind, count in sorted(skipped.items())
+        )
+        warnings.warn(
+            f"read_jsonl skipped {sum(skipped.values())} event(s) of "
+            f"unknown kind ({detail}) — written by a newer "
+            "torcheval_tpu? Pass strict=True to raise instead.",
+            stacklevel=2,
+        )
+    return out
+
+
+# -------------------------------------------------------------------- Perfetto
+# Kinds that carry a duration → their Chrome trace-event name.  Their
+# ``time_s`` stamp is taken at emission (the END of the measured
+# interval), so ts = (time_s - seconds) and dur = seconds.
+_DURATION_NAME = {
+    "span": lambda e: f"{e.name}.{e.phase}",
+    "sync": lambda e: f"sync.{e.op}",
+    "prefetch_stall": lambda e: "prefetch_wait",
+}
+
+
+def _perfetto_args(event: "_events.Event") -> Dict[str, Any]:
+    return {
+        k: v
+        for k, v in event_to_dict(event).items()
+        if k not in ("kind", "time_s", "thread") and v not in ("", None)
+    }
+
+
+def to_perfetto(
+    events: Optional[List["_events.Event"]] = None,
+    *,
+    pid: int = 0,
+    process_name: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Convert captured events into a Chrome/Perfetto trace-event JSON
+    object (load the dumped dict straight into ``ui.perfetto.dev``).
+
+    Timed kinds — metric/engine spans, collective syncs, prefetch
+    stalls — become complete events (``ph:"X"`` with microsecond
+    ``ts``/``dur``); every other kind becomes a thread-scoped instant
+    (``ph:"i"``).  Tracks separate by emitting thread (``tid`` — the
+    engine's prefetch producer renders above/below the dispatch loop)
+    and by host (``pid``) when merging a fleet
+    (:func:`fleet_to_perfetto`).
+
+    ``events=None`` drains the live ring buffer.
+    """
+    if events is None:
+        events = _events.events()
+    trace: List[Dict[str, Any]] = []
+    tids: Dict[str, int] = {}
+
+    if process_name is None:
+        process_name = f"{_PREFIX} host {pid}"
+    trace.append(
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": process_name},
+        }
+    )
+
+    for event in events:
+        thread = event.thread or "MainThread"
+        if thread not in tids:
+            # MainThread pins to track 0 so the primary dispatch loop
+            # always renders first in the viewer.
+            tids[thread] = 0 if thread == "MainThread" else len(tids) + 1
+            trace.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": tids[thread],
+                    "args": {"name": thread},
+                }
+            )
+        tid = tids[thread]
+        namer = _DURATION_NAME.get(event.kind)
+        if namer is not None:
+            seconds = float(getattr(event, "seconds", 0.0))
+            trace.append(
+                {
+                    "ph": "X",
+                    "name": namer(event),
+                    "cat": event.kind,
+                    "ts": (event.time_s - seconds) * 1e6,
+                    "dur": seconds * 1e6,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": _perfetto_args(event),
+                }
+            )
+        else:
+            trace.append(
+                {
+                    "ph": "i",
+                    "name": event.kind,
+                    "cat": event.kind,
+                    "ts": event.time_s * 1e6,
+                    "pid": pid,
+                    "tid": tid,
+                    "s": "t",
+                    "args": _perfetto_args(event),
+                }
+            )
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def fleet_to_perfetto(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """One merged Perfetto trace over per-host snapshots (from
+    :func:`torcheval_tpu.telemetry.aggregate.host_snapshot`): each host
+    becomes a ``pid`` whose process row is named after it, threads
+    within a host keep their own tracks.  Unknown event kinds in a
+    snapshot's sample are skipped (forward compatibility, as
+    :func:`read_jsonl`)."""
+    merged: List[Dict[str, Any]] = []
+    for snapshot in snapshots:
+        host = snapshot.get("host", {})
+        pid = int(host.get("process_index", 0))
+        name = f"host {pid} ({host.get('hostname', '?')})"
+        events = [
+            event_from_dict(payload)
+            for payload in snapshot.get("events", [])
+            if payload.get("kind") in _events.KIND_TO_CLASS
+        ]
+        merged.extend(
+            to_perfetto(events, pid=pid, process_name=name)["traceEvents"]
+        )
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
 
 
 # ------------------------------------------------------------------ Prometheus
@@ -247,6 +405,19 @@ def prometheus_text() -> str:
     )
 
     out.append(
+        f"# HELP {_PREFIX}_data_health_total Offending elements/batches "
+        "found by the data-health monitor, by check and attributed metric."
+    )
+    out.append(f"# TYPE {_PREFIX}_data_health_total counter")
+    for check, metric in sorted(agg["data_health"]):
+        entry = agg["data_health"][(check, metric)]
+        out.append(
+            f"{_PREFIX}_data_health_total"
+            f"{_labels(check=check, metric=metric)} "
+            f"{entry['count']}"
+        )
+
+    out.append(
         f"# HELP {_PREFIX}_sync_seconds Collective merge wall time by op."
     )
     out.append(f"# TYPE {_PREFIX}_sync_seconds histogram")
@@ -352,6 +523,17 @@ def format_report(report: Dict[str, Any]) -> str:
             f"{eng['prefetch_stalls']} prefetch stalls "
             f"({eng['stall_seconds'] * 1e3:.3f} ms)\n"
         )
+    health = report.get("data_health", {})
+    if health.get("findings"):
+        buf.write(
+            f"  DATA HEALTH: {health['findings']} offending "
+            f"elements/batches over {health['events']} findings\n"
+        )
+        for key, entry in sorted(health.get("checks", {}).items()):
+            buf.write(
+                f"    {key}: {entry['count']} "
+                f"(in {entry['events']} findings)\n"
+            )
     slowest = report.get("sync", {}).get("slowest", [])
     if slowest:
         buf.write("  slowest collectives:\n")
@@ -375,4 +557,71 @@ def format_report(report: Dict[str, Any]) -> str:
         f"{report.get('events_dropped', 0)} dropped "
         f"(ring capacity {report.get('ring_capacity', 0)})\n"
     )
+    return buf.getvalue()
+
+
+def format_fleet_report(fleet: Dict[str, Any]) -> str:
+    """Render :func:`torcheval_tpu.telemetry.fleet_report`'s merged dict
+    as the human-readable fleet summary."""
+    buf = io.StringIO()
+    totals = fleet.get("totals", {})
+    buf.write(
+        f"torcheval_tpu fleet telemetry ({fleet.get('hosts', 0)} hosts)\n"
+    )
+    buf.write(
+        f"  totals: {totals.get('events_captured', 0)} events, "
+        f"{totals.get('sync_calls', 0)} collectives "
+        f"({totals.get('sync_seconds', 0.0) * 1e3:.3f} ms), "
+        f"{totals.get('engine_blocks', 0)} engine blocks / "
+        f"{totals.get('engine_batches', 0)} batches, "
+        f"{totals.get('retrace_total', 0)} retraces\n"
+    )
+    for r in fleet.get("per_host", []):
+        host = r.get("host", {})
+        buf.write(
+            f"  host {host.get('process_index', '?')} "
+            f"({host.get('hostname', '?')}): "
+            f"{r.get('events_captured', 0)} events, "
+            f"sync {r.get('sync_seconds', 0.0) * 1e3:.3f} ms / "
+            f"{r.get('sync_calls', 0)} calls, "
+            f"{r.get('prefetch_stalls', 0)} stalls, "
+            f"{r.get('retrace_total', 0)} retraces, "
+            f"pad waste {r.get('pad_waste_pct', 0.0):.1f}%\n"
+        )
+    skew = fleet.get("skew", {})
+    slowest = skew.get("slowest_sync") or {}
+    if slowest.get("op"):
+        host = slowest.get("host", {})
+        buf.write(
+            f"  slowest collective: {slowest.get('seconds', 0.0) * 1e3:.3f}"
+            f" ms {slowest['op']} on host "
+            f"{host.get('process_index', '?')}\n"
+        )
+    for label, key in (
+        ("sync seconds", "sync_seconds"),
+        ("prefetch stalls", "prefetch_stalls"),
+        ("retraces", "retrace"),
+    ):
+        spread = skew.get(key, {})
+        if spread.get("max"):
+            host = spread.get("max_host", {})
+            buf.write(
+                f"  {label} skew: max {spread['max']:.4g} on host "
+                f"{host.get('process_index', '?')} "
+                f"(mean {spread['mean']:.4g}, "
+                f"imbalance {spread['imbalance']:.2f}x)\n"
+            )
+    pad = skew.get("pad_waste_pct", {})
+    if pad:
+        buf.write(
+            f"  pad waste: mean {pad.get('mean', 0.0):.2f}% "
+            f"(variance {pad.get('variance', 0.0):.3f})\n"
+        )
+    for entry in fleet.get("data_health_by_host", []):
+        host = entry.get("host", {})
+        buf.write(
+            f"  DATA HEALTH: host {host.get('process_index', '?')} "
+            f"({host.get('hostname', '?')}) reported "
+            f"{entry.get('findings', 0)} offending elements/batches\n"
+        )
     return buf.getvalue()

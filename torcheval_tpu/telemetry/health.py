@@ -1,0 +1,307 @@
+"""Streaming data-health monitor: NaN/Inf counts, all-constant inputs,
+out-of-range labels, and zero-weight batches, computed as cheap fused
+side-outputs of the update hot paths.
+
+A production eval loop can silently absorb a corrupted feed — one host
+streaming NaNs poisons every counter it merges into, and nothing in the
+*runtime* telemetry (retraces, stalls, cache misses) will say so.  This
+module guards the *data*: when enabled, ``MetricCollection.fused_update``
+and the streaming engine's scan-block program additionally compute a
+handful of masked reductions over the batch arguments **inside the same
+jitted program** (:func:`batch_stats`) — no extra dispatch, no second
+pass over the data — and the host folds the resulting scalars into
+:class:`~torcheval_tpu.telemetry.events.DataHealthEvent` emissions
+(:func:`inspect` / :func:`inspect_block`).
+
+Checks
+------
+* ``nan`` / ``inf`` — non-finite elements in any float argument (masked
+  rows excluded, so bucketing pad rows can never false-positive);
+* ``constant`` — every valid element of a float argument equal (a stuck
+  feature feed), counted in batches;
+* ``label_range`` — negative labels in any integer argument
+  (input-level), plus per-member counts of labels ``>= num_classes``
+  for every member that declares a class count (**per-metric
+  attribution**: a label legal for a 1000-class member is corrupt for a
+  10-class member sharing the batch);
+* ``zero_weight`` — a batch whose validity mask has no live rows, or
+  whose ``weight=`` argument sums to zero over live rows (the engine's
+  deliberate fully-masked pad steps are excluded).
+
+Zero-cost-when-off contract
+---------------------------
+Same one-branch pattern as the event bus (``events.ENABLED``): every
+hook site is ``if _health.ENABLED:`` and the disabled update programs
+are **byte-identical to a build without this module** — no side
+outputs, no retrace, zero extra dispatches
+(``scripts/check_hot_path_overhead.py`` guards this empirically).
+Findings are emitted into the telemetry ring regardless of the wider
+bus flag, so ``health.enable()`` alone is a complete monitor.
+
+Policy
+------
+``enable(raise_on_corrupt=True)`` turns findings in
+:data:`CORRUPT_CHECKS` into a :class:`DataCorruptionError` raised at the
+emitting dispatch site — after the batch was applied (the monitor
+observes, it does not gate), so metric states stay consistent and the
+operator decides whether to quarantine the host.
+
+Example::
+
+    from torcheval_tpu.telemetry import health
+
+    health.enable()                      # or TORCHEVAL_TPU_DATA_HEALTH=1
+    ... run the eval loop ...
+    print(telemetry.report()["data_health"])
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from torcheval_tpu.telemetry import events as _events
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+# Module-level flags: hook sites read these as plain attributes (the
+# one-branch zero-overhead contract, see events.ENABLED).
+ENABLED: bool = (
+    os.environ.get("TORCHEVAL_TPU_DATA_HEALTH", "").lower() in _TRUTHY
+)
+RAISE_ON_CORRUPT: bool = (
+    os.environ.get("TORCHEVAL_TPU_DATA_HEALTH_RAISE", "").lower() in _TRUTHY
+)
+
+# Checks that escalate to DataCorruptionError under raise_on_corrupt.
+# "constant" and "zero_weight" are suspicious, not corrupt — a stuck
+# feed or an empty batch degrades signal but cannot poison a merge.
+CORRUPT_CHECKS = frozenset({"nan", "inf", "label_range"})
+
+
+class DataCorruptionError(RuntimeError):
+    """Raised (under ``enable(raise_on_corrupt=True)``) when a batch
+    carried data in :data:`CORRUPT_CHECKS`; carries the emitted
+    findings on ``.findings``."""
+
+    def __init__(self, source: str, findings: List[Dict[str, Any]]) -> None:
+        self.findings = findings
+        detail = "; ".join(
+            f"{f['check']}"
+            + (f"[{f['metric']}]" if f["metric"] else "")
+            + f" x{f['count']} in arg {f['arg']}"
+            for f in findings
+        )
+        super().__init__(
+            f"data-health monitor found corrupt input at {source}: {detail}"
+        )
+
+
+def enable(*, raise_on_corrupt: Optional[bool] = None) -> None:
+    """Turn the monitor on (equivalently ``TORCHEVAL_TPU_DATA_HEALTH=1``).
+    The next ``fused_update`` / engine dispatch recompiles its program
+    once with the side outputs; steady state is unchanged after that."""
+    global ENABLED, RAISE_ON_CORRUPT
+    if raise_on_corrupt is not None:
+        RAISE_ON_CORRUPT = bool(raise_on_corrupt)
+    ENABLED = True
+
+
+def disable() -> None:
+    """Turn the monitor off — hook sites go back to one cold branch and
+    the side-output-free programs."""
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def label_bounds(
+    metrics: Dict[str, Any],
+) -> Tuple[Tuple[str, int], ...]:
+    """The static (member name, num_classes) pairs the label-range check
+    attributes against — every member declaring an integer class count."""
+    out = []
+    for name, m in metrics.items():
+        nc = getattr(m, "num_classes", None)
+        if isinstance(nc, int) and nc > 0:
+            out.append((name, nc))
+    return tuple(out)
+
+
+# ------------------------------------------------------------ traced side
+def batch_stats(
+    args: Tuple[Any, ...],
+    mask: Optional[Any],
+    bounds: Tuple[Tuple[str, int], ...],
+) -> Dict[str, Any]:
+    """The fused side-output: a small dict of scalar reductions over one
+    batch's positional arguments, traceable inside the update program.
+
+    ``mask`` is the bucketing validity row-mask (or ``None``); masked
+    rows are excluded from every reduction, so edge-replicated pad rows
+    cannot distort counts.  ``bounds`` is the static output of
+    :func:`label_bounds`.  The returned structure is static per call
+    signature (dtype-dependent per arg), so it jits cleanly.
+    """
+    import jax.numpy as jnp
+
+    def row_mask_for(a):
+        if mask is None:
+            return None
+        return mask.reshape(mask.shape + (1,) * (a.ndim - mask.ndim))
+
+    per_arg: List[Optional[Dict[str, Any]]] = []
+    for a in args:
+        if not hasattr(a, "dtype"):
+            per_arg.append(None)
+            continue
+        m = row_mask_for(a)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            nan = jnp.isnan(a)
+            inf = jnp.isinf(a)
+            if m is not None:
+                live = m.astype(jnp.int32)
+                nan_count = jnp.sum(nan * live)
+                inf_count = jnp.sum(inf * live)
+                valid = jnp.sum(
+                    jnp.broadcast_to(live, a.shape).astype(jnp.int32)
+                )
+                big = jnp.asarray(jnp.inf, a.dtype)
+                lo = jnp.min(jnp.where(m > 0, a, big))
+                hi = jnp.max(jnp.where(m > 0, a, -big))
+            else:
+                nan_count = jnp.sum(nan.astype(jnp.int32))
+                inf_count = jnp.sum(inf.astype(jnp.int32))
+                valid = jnp.asarray(a.size, jnp.int32)
+                lo, hi = jnp.min(a), jnp.max(a)
+            # NaN compares unequal, so a NaN-bearing batch is never
+            # "constant"; a single-element batch is trivially not.
+            constant = ((hi == lo) & (valid > 1)).astype(jnp.int32)
+            per_arg.append(
+                {
+                    "nan": nan_count,
+                    "inf": inf_count,
+                    "constant": constant,
+                    "valid": valid,
+                }
+            )
+        elif jnp.issubdtype(a.dtype, jnp.integer):
+            if m is not None:
+                live = jnp.broadcast_to(m, a.shape).astype(jnp.int32)
+                neg = jnp.sum((a < 0).astype(jnp.int32) * live)
+                ge = tuple(
+                    jnp.sum((a >= nc).astype(jnp.int32) * live)
+                    for _name, nc in bounds
+                )
+            else:
+                neg = jnp.sum((a < 0).astype(jnp.int32))
+                ge = tuple(
+                    jnp.sum((a >= nc).astype(jnp.int32))
+                    for _name, nc in bounds
+                )
+            per_arg.append({"neg": neg, "ge": ge})
+        else:
+            per_arg.append(None)
+    out: Dict[str, Any] = {"args": tuple(per_arg)}
+    if mask is not None:
+        out["live_rows"] = jnp.sum(mask.astype(jnp.int32))
+    return out
+
+
+def stats_for_update(
+    args: Tuple[Any, ...],
+    kwargs: Dict[str, Any],
+    bounds: Tuple[Tuple[str, int], ...],
+) -> Dict[str, Any]:
+    """:func:`batch_stats` over one fused-update call, adding the
+    zero-weight reduction when the call carries a ``weight=`` kwarg."""
+    import jax.numpy as jnp
+
+    mask = kwargs.get("mask")
+    out = batch_stats(args, mask, bounds)
+    weight = kwargs.get("weight")
+    if hasattr(weight, "dtype"):
+        w = jnp.abs(weight)
+        if mask is not None:
+            w = w * mask.reshape(
+                mask.shape + (1,) * (w.ndim - mask.ndim)
+            ).astype(w.dtype)
+        out["weight_total"] = jnp.sum(w)
+    return out
+
+
+# ------------------------------------------------------------- host fold
+def _scalar(value: Any, steps: Optional[int], reduce: str) -> float:
+    """Collapse one (possibly step-stacked) device scalar to a float.
+    ``steps`` limits the reduction to the first N scan steps (the real
+    batches; trailing pad steps are deliberate all-masked no-ops)."""
+    import numpy as np
+
+    v = np.asarray(value)
+    if v.ndim == 0:
+        return float(v)
+    v = v[:steps] if steps is not None else v
+    if v.size == 0:
+        return 0.0
+    return float(v.sum() if reduce == "sum" else v.min())
+
+
+def inspect(
+    stats: Dict[str, Any],
+    *,
+    source: str,
+    bounds: Tuple[Tuple[str, int], ...],
+    steps: Optional[int] = None,
+) -> List[Dict[str, Any]]:
+    """Fold one dispatch's side-output stats into findings, emit a
+    :class:`DataHealthEvent` per finding, and apply the raise-on-corrupt
+    policy.  ``steps`` (engine path) is the number of REAL scan steps —
+    stacked leaves are reduced over those only, so fully-masked pad
+    steps never read as zero-weight batches.  Returns the findings."""
+    import jax
+
+    stats = jax.device_get(stats)
+    findings: List[Dict[str, Any]] = []
+
+    def find(check: str, metric: str, arg: int, count: float) -> None:
+        count = int(count)
+        if count > 0:
+            findings.append(
+                {"check": check, "metric": metric, "arg": arg, "count": count}
+            )
+
+    for i, entry in enumerate(stats["args"]):
+        if entry is None:
+            continue
+        if "nan" in entry:
+            find("nan", "", i, _scalar(entry["nan"], steps, "sum"))
+            find("inf", "", i, _scalar(entry["inf"], steps, "sum"))
+            find("constant", "", i, _scalar(entry["constant"], steps, "sum"))
+        else:
+            find("label_range", "", i, _scalar(entry["neg"], steps, "sum"))
+            for (name, _nc), count in zip(bounds, entry["ge"]):
+                find("label_range", name, i, _scalar(count, steps, "sum"))
+    if "live_rows" in stats:
+        # min over real steps: any real batch with zero live rows.
+        if _scalar(stats["live_rows"], steps, "min") == 0:
+            findings.append(
+                {"check": "zero_weight", "metric": "", "arg": -1, "count": 1}
+            )
+    if "weight_total" in stats and _scalar(
+        stats["weight_total"], steps, "min"
+    ) == 0:
+        findings.append(
+            {"check": "zero_weight", "metric": "", "arg": -1, "count": 1}
+        )
+    for f in findings:
+        _events.record_data_health(
+            f["check"], source, f["metric"], f["arg"], f["count"]
+        )
+    if RAISE_ON_CORRUPT:
+        corrupt = [f for f in findings if f["check"] in CORRUPT_CHECKS]
+        if corrupt:
+            raise DataCorruptionError(source, corrupt)
+    return findings
