@@ -1,5 +1,5 @@
-"""Zero-overhead guard for the disabled telemetry bus and the disabled
-data-health monitor.
+"""Zero-overhead guard for the disabled telemetry bus, the disabled
+data-health monitor, and the disarmed fault-injection hooks.
 
 The telemetry contract (``torcheval_tpu/telemetry/events.py``) is that a
 DISABLED bus costs the hot path exactly one module-attribute read and one
@@ -40,6 +40,12 @@ _EXTRA_HOOKS = ("emit", "timed_phase")
 # disabled: the fused programs must carry no side outputs (batch_stats /
 # stats_for_update are traced INTO them), and no host fold may run.
 _HEALTH_HOOKS = ("label_bounds", "batch_stats", "stats_for_update", "inspect")
+
+# Fault-injection entry points (``torcheval_tpu/resilience/faults.py``)
+# make the same promise: with no FaultPlan installed, every hook site is
+# one branch on ``faults.ENABLED`` and ``fire`` never runs — the engine
+# batch/scan/prefetch/sync/checkpoint sites add zero hot-path cost.
+_FAULT_HOOKS = ("fire",)
 
 
 def _hook_names(events_module) -> List[str]:
@@ -123,6 +129,7 @@ def check(verbose: bool = True) -> List[str]:
     """Assert zero hook calls on the disabled path; returns the guarded
     hook names (so the test tier can sanity-check coverage)."""
     from torcheval_tpu import telemetry
+    from torcheval_tpu.resilience import faults as fl
     from torcheval_tpu.telemetry import events as ev
     from torcheval_tpu.telemetry import health as hm
 
@@ -148,6 +155,14 @@ def check(verbose: bool = True) -> List[str]:
                         _counting(getattr(hm, name), counter, f"health.{name}"),
                     )
                 )
+            for name in _FAULT_HOOKS:
+                stack.enter_context(
+                    mock.patch.object(
+                        fl,
+                        name,
+                        _counting(getattr(fl, name), counter, f"faults.{name}"),
+                    )
+                )
             _drive_hot_path()
     finally:
         if was_enabled:
@@ -162,10 +177,14 @@ def check(verbose: bool = True) -> List[str]:
         )
     if verbose:
         print(
-            f"ok: {len(names) + len(_HEALTH_HOOKS)} hook entry points "
-            "stayed cold on the disabled hot path"
+            f"ok: {len(names) + len(_HEALTH_HOOKS) + len(_FAULT_HOOKS)} "
+            "hook entry points stayed cold on the disabled hot path"
         )
-    return names + [f"health.{n}" for n in _HEALTH_HOOKS]
+    return (
+        names
+        + [f"health.{n}" for n in _HEALTH_HOOKS]
+        + [f"faults.{n}" for n in _FAULT_HOOKS]
+    )
 
 
 if __name__ == "__main__":

@@ -122,6 +122,41 @@ class TestMetricCollection(unittest.TestCase):
         for k, v in before.items():
             np.testing.assert_array_equal(np.asarray(after[k]), np.asarray(v))
 
+    def test_load_is_atomic_across_members(self):
+        # A failure on the SECOND member's install (e.g. a checkpoint
+        # whose f1 states are malformed and only caught inside the
+        # member's own load) must roll the first member back — the
+        # collection is never left half-mutated.
+        scores, target = _data(seed=1)
+        coll = _collection().update(scores, target)
+        before = coll.state_dict()
+        donor = _collection().update(*_data(seed=2))
+        snapshot = donor.state_dict()
+
+        from unittest import mock
+
+        member_order = [name for name, _ in coll.items()]
+        second = coll[member_order[1]]
+
+        real_load = type(second).load_state_dict
+
+        def poisoned(self_metric, state, strict=True):
+            # Install the states for real, THEN fail — the worst case:
+            # this member is already mutated when the error surfaces.
+            real_load(self_metric, state, strict=strict)
+            raise RuntimeError("injected mid-install failure")
+
+        with mock.patch.object(
+            type(second), "load_state_dict", poisoned
+        ):
+            with self.assertRaisesRegex(RuntimeError, "mid-install"):
+                coll.load_state_dict(snapshot)
+        after = coll.state_dict()
+        for k, v in before.items():
+            np.testing.assert_array_equal(
+                np.asarray(after[k]), np.asarray(v)
+            )
+
     def test_load_strict_reports_missing_and_unexpected_together(self):
         coll = _collection()
         snapshot = coll.state_dict()

@@ -75,7 +75,12 @@ class TestPallasBinaryAUROC(unittest.TestCase):
             0.5,
         )
 
+    # `slow` as well as `big`: an explicit `-m 'not slow'` on the tier-1
+    # command line replaces the addopts `-m 'not big'` (pytest keeps only
+    # the last -m), which would pull this ~10-minute run back into the
+    # tier-1 budget.
     @pytest.mark.big
+    @pytest.mark.slow
     def test_beyond_2pow24_exactness(self):
         # N = 2^25: beyond the old float32-count limit.  int32 count
         # carries keep tie-group boundaries and totals exact; the result

@@ -1,0 +1,300 @@
+"""Deterministic fault injection (torcheval_tpu/resilience/faults.py):
+rule matching/validation, seeded-schedule reproducibility, env-driven
+plans, and the engine-facing sites — producer kill, NaN batch feeding
+the data-health monitor, and the leaked-prefetch-thread warning."""
+
+import json
+import threading
+import unittest
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu.engine import Evaluator, prefetch
+from torcheval_tpu.engine.prefetch import Prefetcher
+from torcheval_tpu.metrics import MetricCollection, MulticlassAccuracy
+from torcheval_tpu.resilience import FaultPlan, FaultRule, InjectedFault
+from torcheval_tpu.resilience import faults
+from torcheval_tpu.telemetry import events as ev
+from torcheval_tpu.telemetry import health as hm
+
+pytestmark = pytest.mark.chaos
+
+_C = 5
+
+
+def _collection():
+    return MetricCollection(
+        {"acc": MulticlassAccuracy(num_classes=_C, average="macro")},
+        bucket=True,
+    )
+
+
+def _stream(sizes=(16, 16, 16, 16), seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.random((b, _C), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, _C, b).astype(np.int32)),
+        )
+        for b in sizes
+    ]
+
+
+class TestFaultPlanMechanics(unittest.TestCase):
+    def test_rule_validation(self):
+        with self.assertRaises(ValueError):
+            FaultRule(site="x", action="explode")
+        with self.assertRaises(ValueError):
+            FaultRule(site="x", probability=1.5)
+
+    def test_disabled_by_default(self):
+        self.assertFalse(faults.ENABLED)
+        self.assertIsNone(faults.active())
+
+    def test_install_uninstall_flips_flag(self):
+        plan = FaultPlan([{"site": "x"}])
+        with plan:
+            self.assertTrue(faults.ENABLED)
+            self.assertIs(faults.active(), plan)
+        self.assertFalse(faults.ENABLED)
+        self.assertIsNone(faults.active())
+
+    def test_plans_do_not_nest(self):
+        with FaultPlan([{"site": "x"}]):
+            with self.assertRaises(RuntimeError):
+                FaultPlan([{"site": "y"}]).install()
+
+    def test_after_count_and_journal(self):
+        with FaultPlan(
+            [{"site": "x", "after": 2, "count": 2}]
+        ) as plan:
+            fired = []
+            for i in range(6):
+                try:
+                    faults.fire("x", step=i)
+                except InjectedFault:
+                    fired.append(i)
+        self.assertEqual(fired, [2, 3])  # skip 2 hits, then fire twice
+        self.assertEqual(plan.hits, {"x": 6})
+        self.assertEqual([f.hit for f in plan.fired], [2, 3])
+        self.assertEqual(plan.fired[0].context, {"step": 2})
+
+    def test_match_filters_on_context(self):
+        with FaultPlan(
+            [{"site": "x", "match": {"op": "gather"}, "count": None}]
+        ):
+            faults.fire("x", op="broadcast")  # no match, no raise
+            with self.assertRaises(InjectedFault):
+                faults.fire("x", op="gather")
+
+    def test_unmatched_site_is_silent(self):
+        with FaultPlan([{"site": "x"}]):
+            self.assertIsNone(faults.fire("unrelated"))
+
+    def test_seeded_probability_schedule_replays(self):
+        def schedule():
+            hits = []
+            with FaultPlan(
+                [{"site": "x", "probability": 0.4, "count": None}],
+                seed=123,
+            ):
+                for i in range(30):
+                    try:
+                        faults.fire("x")
+                    except InjectedFault:
+                        hits.append(i)
+            return hits
+
+        first, second = schedule(), schedule()
+        self.assertEqual(first, second)
+        self.assertTrue(0 < len(first) < 30)  # actually probabilistic
+
+    def test_delay_action_sleeps_and_returns_none(self):
+        import time
+
+        with FaultPlan([{"site": "x", "action": "delay", "delay_s": 0.02}]):
+            t0 = time.monotonic()
+            self.assertIsNone(faults.fire("x"))
+            self.assertGreaterEqual(time.monotonic() - t0, 0.015)
+
+    def test_corrupt_batch_pokes_nan_into_first_float(self):
+        scores = np.ones((4, 3), np.float32)
+        target = np.zeros(4, np.int32)
+        out = faults.corrupt_batch((target, scores))
+        self.assertTrue(np.array_equal(out[0], target))  # ints untouched
+        self.assertTrue(np.isnan(out[1].reshape(-1)[0]))
+        self.assertFalse(np.isnan(scores).any())  # original unharmed
+
+
+class TestEnvPlan(unittest.TestCase):
+    def _with_env(self, value):
+        import os
+
+        old = os.environ.get("TORCHEVAL_TPU_FAULT_PLAN")
+
+        def restore():
+            if old is None:
+                os.environ.pop("TORCHEVAL_TPU_FAULT_PLAN", None)
+            else:
+                os.environ["TORCHEVAL_TPU_FAULT_PLAN"] = old
+
+        self.addCleanup(restore)
+        os.environ["TORCHEVAL_TPU_FAULT_PLAN"] = value
+
+    def test_env_installs_plan(self):
+        self._with_env(json.dumps({"site": "env.site", "count": 1}))
+        plan = faults.install_from_env()
+        try:
+            self.assertTrue(faults.ENABLED)
+            with self.assertRaises(InjectedFault):
+                faults.fire("env.site")
+        finally:
+            plan.uninstall()
+
+    def test_env_seed_wrapper(self):
+        self._with_env(
+            json.dumps({"seed": 9, "rules": [{"site": "env.site"}]})
+        )
+        plan = faults.install_from_env()
+        try:
+            self.assertEqual(plan.seed, 9)
+        finally:
+            plan.uninstall()
+
+    def test_env_invalid_json_raises(self):
+        self._with_env("{not json")
+        with self.assertRaises(ValueError):
+            faults.install_from_env()
+
+
+class TestEngineSites(unittest.TestCase):
+    def test_prefetch_producer_kill_relays_to_consumer(self):
+        """``prefetch.produce`` after=K kills the producer thread; the
+        consumer sees the typed InjectedFault at its next __next__, like
+        any real source/staging error."""
+        evaluator = Evaluator(_collection(), block_size=2, prefetch=True)
+        with FaultPlan(
+            [{"site": "prefetch.produce", "after": 1, "count": 1}]
+        ) as plan:
+            with self.assertRaises(InjectedFault) as ctx:
+                evaluator.run(_stream((16,) * 8))
+        self.assertEqual(ctx.exception.site, "prefetch.produce")
+        self.assertEqual(plan.fired[0].context["items"], 2)
+
+    def test_nan_batch_caught_by_health_monitor(self):
+        """``engine.batch`` corrupt + the data-health monitor: the
+        injected NaN surfaces as a ``data_health`` finding."""
+        ev.enable()
+        hm.enable()
+        self.addCleanup(hm.disable)
+        self.addCleanup(ev.disable)
+        self.addCleanup(ev.clear)
+        evaluator = Evaluator(_collection(), block_size=2, prefetch=False)
+        with FaultPlan(
+            [
+                {
+                    "site": "engine.batch",
+                    "action": "corrupt",
+                    "match": {"batch": 2},
+                }
+            ]
+        ) as plan:
+            evaluator.run(_stream((16,) * 4))
+        evaluator.result()
+        self.assertEqual(len(plan.fired), 1)
+        findings = ev.aggregates()["data_health"]
+        nan_findings = sum(
+            entry["count"]
+            for (check, _metric), entry in findings.items()
+            if check == "nan"
+        )
+        self.assertGreaterEqual(nan_findings, 1)
+
+    def test_mid_scan_abort_leaves_dispatched_state_applied(self):
+        evaluator = Evaluator(_collection(), block_size=2, prefetch=False)
+        with FaultPlan([{"site": "engine.scan", "after": 1, "count": 1}]):
+            with self.assertRaises(InjectedFault):
+                evaluator.run(_stream((16,) * 8))
+        # The first block dispatched before the abort landed.
+        self.assertEqual(evaluator.blocks_dispatched, 1)
+        self.assertEqual(evaluator.batches_seen, 2)
+
+    def test_prefetch_close_leak_warns_and_reports(self):
+        """A producer wedged past the join budget is reported (warning +
+        ``degraded`` telemetry event), never silently leaked."""
+        release = threading.Event()
+        self.addCleanup(release.set)
+
+        def wedged_source():
+            yield 1
+            release.wait(timeout=10.0)  # simulates a stuck device xfer
+
+        ev.enable()
+        self.addCleanup(ev.disable)
+        self.addCleanup(ev.clear)
+        old = prefetch._JOIN_TIMEOUT_S
+        prefetch._JOIN_TIMEOUT_S = 0.05
+        self.addCleanup(lambda: setattr(prefetch, "_JOIN_TIMEOUT_S", old))
+        p = Prefetcher(wedged_source(), stage=lambda x: x, depth=1)
+        self.assertEqual(next(p), 1)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            p.close()
+        self.assertTrue(
+            any(
+                issubclass(w.category, RuntimeWarning)
+                and "producer thread" in str(w.message)
+                for w in caught
+            )
+        )
+        degraded = ev.aggregates()["resilience"]["degraded"]
+        self.assertEqual(degraded[("prefetch.close", "leaked_thread")], 1)
+
+    def test_clean_close_does_not_warn(self):
+        p = Prefetcher(iter([1, 2, 3]), stage=lambda x: x)
+        self.assertEqual(list(p), [1, 2, 3])
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            p.close()
+        self.assertFalse(
+            [w for w in caught if issubclass(w.category, RuntimeWarning)]
+        )
+
+    def test_stage_retry_absorbs_one_transient_failure(self):
+        failures = {"left": 1}
+
+        def flaky_stage(item):
+            if failures["left"]:
+                failures["left"] -= 1
+                raise RuntimeError("transient transfer failure")
+            return item
+
+        p = Prefetcher(iter([10, 20]), stage=flaky_stage)
+        try:
+            self.assertEqual(list(p), [10, 20])
+        finally:
+            p.close()
+
+
+class TestZeroCostContract(unittest.TestCase):
+    def test_fault_hooks_covered_by_overhead_guard(self):
+        import os
+        import sys
+
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(__file__), "..", "..", "scripts"),
+        )
+        try:
+            import check_hot_path_overhead as guard
+        finally:
+            sys.path.pop(0)
+        self.assertIn("fire", guard._FAULT_HOOKS)
+
+
+if __name__ == "__main__":
+    unittest.main()

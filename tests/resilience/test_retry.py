@@ -1,0 +1,331 @@
+"""Retry/deadline/degrade for object collectives
+(torcheval_tpu/resilience/retry.py): transient failures recover with a
+retry event per failed attempt, exhausted budgets raise a typed
+CollectiveTimeoutError (or degrade to the local view) and never hang
+past the deadline, and the KV-store timeout is env-overridable."""
+
+import threading
+import time
+import unittest
+import warnings
+
+import pytest
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.distributed import (
+    CollectiveGroup,
+    LocalWorld,
+    SingleProcessGroup,
+    kv_timeout_ms,
+)
+from torcheval_tpu.resilience import (
+    CollectiveTimeoutError,
+    FaultPlan,
+    ResilientGroup,
+    RetryPolicy,
+)
+from torcheval_tpu.resilience.retry import retry_call
+from torcheval_tpu.telemetry import events as ev
+
+pytestmark = pytest.mark.chaos
+
+# Fast, jitter-free backoff so the whole suite stays inside tier-1.
+_FAST = RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0)
+
+
+class _AlwaysFailingGroup(CollectiveGroup):
+    """Every collective raises; the error names a slow peer."""
+
+    def __init__(self, peer=None):
+        self.calls = 0
+        self._peer = peer
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def world_size(self):
+        return 4
+
+    def _boom(self):
+        self.calls += 1
+        exc = RuntimeError("coordinator unavailable")
+        if self._peer is not None:
+            exc.peer = self._peer
+        raise exc
+
+    def all_gather_object(self, obj):
+        self._boom()
+
+    def broadcast_object(self, obj, src):
+        self._boom()
+
+    def gather_object(self, obj, dst=0):
+        self._boom()
+
+
+class _HangingGroup(CollectiveGroup):
+    """Every collective blocks forever — the genuine-hang case that only
+    a deadline can cut."""
+
+    def __init__(self):
+        self._release = threading.Event()
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def world_size(self):
+        return 2
+
+    def all_gather_object(self, obj):
+        self._release.wait(timeout=30.0)
+        return [obj]
+
+    def broadcast_object(self, obj, src):
+        self._release.wait(timeout=30.0)
+        return obj
+
+    def gather_object(self, obj, dst=0):
+        self._release.wait(timeout=30.0)
+        return [obj]
+
+
+class TestRetryPolicy(unittest.TestCase):
+    def test_validation(self):
+        with self.assertRaises(ValueError):
+            RetryPolicy(max_attempts=0)
+        with self.assertRaises(ValueError):
+            RetryPolicy(deadline=0.0)
+        with self.assertRaises(ValueError):
+            RetryPolicy(deadline=-1.0)
+
+    def test_backoff_doubles_and_caps(self):
+        import random
+
+        policy = RetryPolicy(base_delay=0.5, max_delay=1.5, jitter=0.0)
+        rng = random.Random(0)
+        self.assertEqual(policy.backoff(1, rng), 0.5)
+        self.assertEqual(policy.backoff(2, rng), 1.0)
+        self.assertEqual(policy.backoff(3, rng), 1.5)  # capped
+        self.assertEqual(policy.backoff(4, rng), 1.5)
+
+    def test_jitter_is_seeded(self):
+        import random
+
+        policy = RetryPolicy(base_delay=0.5, jitter=0.25)
+        a = [policy.backoff(i, random.Random(7)) for i in (1, 2)]
+        b = [policy.backoff(i, random.Random(7)) for i in (1, 2)]
+        self.assertEqual(a, b)
+        self.assertGreaterEqual(a[0], 0.5)
+        self.assertLessEqual(a[0], 0.5 * 1.25)
+
+
+class TestResilientGroup(unittest.TestCase):
+    def test_transient_failure_recovers_with_retry_events(self):
+        """Fail attempts 1 and 2, succeed on 3 — on BOTH ranks of a
+        2-rank world — and assert one retry event per failed attempt."""
+        ev.enable()
+        self.addCleanup(ev.disable)
+        self.addCleanup(ev.clear)
+        world = LocalWorld(2)
+
+        def body(group, rank):
+            return ResilientGroup(group, _FAST).all_gather_object(rank)
+
+        # on_attempt rules fire per-rank deterministically regardless of
+        # thread interleaving; count=2 covers both ranks per attempt.
+        # No rank enters the barrier on a failed attempt, so the retried
+        # collective stays symmetric.
+        with FaultPlan(
+            [
+                {"site": "collective", "on_attempt": 1, "count": 2},
+                {"site": "collective", "on_attempt": 2, "count": 2},
+            ]
+        ) as plan:
+            results = world.run(body)
+        self.assertEqual(results, [[0, 1], [0, 1]])
+        self.assertEqual(len(plan.fired), 4)
+        retries = ev.aggregates()["resilience"]["retries"]
+        self.assertEqual(retries["all_gather_object"]["attempts"], 4)
+        self.assertIn("InjectedFault", retries["all_gather_object"]["last_error"])
+        report = telemetry.report()
+        self.assertEqual(report["resilience"]["retry_attempts"], 4)
+
+    def test_exhausted_raises_typed_error_naming_peer(self):
+        inner = _AlwaysFailingGroup(peer=3)
+        group = ResilientGroup(inner, _FAST)
+        with self.assertRaises(CollectiveTimeoutError) as ctx:
+            group.all_gather_object({"x": 1})
+        err = ctx.exception
+        self.assertEqual(err.op, "all_gather_object")
+        self.assertEqual(err.attempts, 3)
+        self.assertEqual(err.peer, 3)
+        self.assertIn("slowest peer: rank 3", str(err))
+        self.assertEqual(inner.calls, 3)
+        self.assertIsInstance(err.__cause__, RuntimeError)
+
+    def test_deadline_never_hangs(self):
+        """A genuinely wedged collective is cut at the deadline: the
+        caller gets CollectiveTimeoutError in ~deadline wall time, not
+        after the 30s the inner RPC would have blocked."""
+        group = ResilientGroup(
+            _HangingGroup(),
+            RetryPolicy(max_attempts=3, base_delay=0.001, deadline=0.4),
+        )
+        t0 = time.monotonic()
+        with self.assertRaises(CollectiveTimeoutError):
+            group.broadcast_object({"x": 1}, src=0)
+        elapsed = time.monotonic() - t0
+        self.assertLess(elapsed, 5.0)
+
+    def test_degrade_local_serves_local_view(self):
+        ev.enable()
+        self.addCleanup(ev.disable)
+        self.addCleanup(ev.clear)
+        group = ResilientGroup(
+            _AlwaysFailingGroup(), _FAST, degrade="local"
+        )
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            gathered = group.all_gather_object({"rank": 0})
+            broadcast = group.broadcast_object("payload", src=0)
+            gathered_dst = group.gather_object("mine", dst=0)
+        self.assertEqual(gathered, [{"rank": 0}])
+        self.assertEqual(broadcast, "payload")
+        self.assertEqual(gathered_dst, ["mine"])
+        self.assertTrue(
+            any(issubclass(w.category, RuntimeWarning) for w in caught)
+        )
+        degraded = ev.aggregates()["resilience"]["degraded"]
+        self.assertEqual(degraded[("all_gather_object", "local")], 1)
+        self.assertEqual(degraded[("broadcast_object", "local")], 1)
+
+    def test_degrade_validation(self):
+        with self.assertRaises(ValueError):
+            ResilientGroup(SingleProcessGroup(), degrade="nonsense")
+
+    def test_wrapper_preserves_rank_and_world_size(self):
+        world = LocalWorld(3)
+        group = ResilientGroup(world.group(2))
+        self.assertEqual(group.rank, 2)
+        self.assertEqual(group.world_size, 3)
+
+    def test_single_process_group_passthrough(self):
+        group = ResilientGroup(SingleProcessGroup(), _FAST)
+        self.assertEqual(group.all_gather_object(5), [5])
+        self.assertEqual(group.broadcast_object("x", src=0), "x")
+        self.assertEqual(group.gather_object("x"), ["x"])
+
+
+class TestRetryCall(unittest.TestCase):
+    def test_succeeds_after_transient_failures(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+            return "done"
+
+        self.assertEqual(retry_call("op", flaky, _FAST), "done")
+        self.assertEqual(len(attempts), 3)
+
+    def test_exhaustion_translates_to_typed_error(self):
+        def always():
+            raise RuntimeError("down")
+
+        with self.assertRaises(CollectiveTimeoutError) as ctx:
+            retry_call("sync_dispatch", always, _FAST)
+        self.assertEqual(ctx.exception.op, "sync_dispatch")
+        self.assertIsInstance(ctx.exception.__cause__, RuntimeError)
+
+
+class TestSyncedUpdateRetry(unittest.TestCase):
+    def test_transient_dispatch_failure_recovers(self):
+        """``make_synced_update(retry=...)``: the SPMD dispatch fails on
+        attempts 1 and 2 (chaos site ``sync.dispatch``), succeeds on 3,
+        and the result matches the retry-free path exactly."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torcheval_tpu.parallel import make_mesh, make_synced_update, shard_batch
+
+        ev.enable()
+        self.addCleanup(ev.disable)
+        self.addCleanup(ev.clear)
+        mesh = make_mesh()
+        data = jnp.asarray(np.arange(32, dtype=np.float32))
+
+        def kernel(x):
+            return x.sum()
+
+        plain = make_synced_update(kernel, mesh)(shard_batch(mesh, data))
+        step = make_synced_update(kernel, mesh, retry=_FAST)
+        with FaultPlan([{"site": "sync.dispatch", "count": 2}]) as plan:
+            out = step(shard_batch(mesh, data))
+        self.assertEqual(float(out), float(plain))
+        self.assertEqual(len(plan.fired), 2)
+        retries = ev.aggregates()["resilience"]["retries"]
+        self.assertEqual(retries["synced_update:kernel"]["attempts"], 2)
+
+    def test_exhaustion_raises_typed_error(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from torcheval_tpu.parallel import make_mesh, make_synced_update, shard_batch
+
+        mesh = make_mesh()
+        data = jnp.asarray(np.arange(16, dtype=np.float32))
+        step = make_synced_update(lambda x: x.sum(), mesh, retry=_FAST)
+        with FaultPlan([{"site": "sync.dispatch", "count": None}]):
+            with self.assertRaises(CollectiveTimeoutError):
+                step(shard_batch(mesh, data))
+
+
+class TestKvTimeoutEnv(unittest.TestCase):
+    def _set(self, value):
+        import os
+
+        old = os.environ.get("TORCHEVAL_TPU_KV_TIMEOUT_MS")
+
+        def restore():
+            if old is None:
+                os.environ.pop("TORCHEVAL_TPU_KV_TIMEOUT_MS", None)
+            else:
+                os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = old
+
+        self.addCleanup(restore)
+        if value is None:
+            os.environ.pop("TORCHEVAL_TPU_KV_TIMEOUT_MS", None)
+        else:
+            os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = value
+
+    def test_default(self):
+        self._set(None)
+        self.assertEqual(kv_timeout_ms(), 600_000)
+
+    def test_empty_is_default(self):
+        self._set("  ")
+        self.assertEqual(kv_timeout_ms(), 600_000)
+
+    def test_override(self):
+        self._set("120000")
+        self.assertEqual(kv_timeout_ms(), 120_000)
+
+    def test_rejects_non_positive(self):
+        for bad in ("0", "-5"):
+            self._set(bad)
+            with self.assertRaises(ValueError):
+                kv_timeout_ms()
+
+    def test_rejects_non_integer(self):
+        self._set("soon")
+        with self.assertRaises(ValueError):
+            kv_timeout_ms()
+
+
+if __name__ == "__main__":
+    unittest.main()

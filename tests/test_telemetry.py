@@ -150,6 +150,12 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         # data_health — the streaming health monitor's finding (the real
         # fused/engine detection paths are covered by tests/engine/test_health).
         ev.record_data_health("nan", "fused_update", "", 0, 2)
+        # retry / degraded / checkpoint — the resilience subsystem's hooks
+        # (the real retry/checkpoint paths are covered by tests/resilience;
+        # recording directly keeps this round-trip fast and deterministic).
+        ev.record_retry("all_gather_object", 1, 0.05, "RuntimeError('rpc')")
+        ev.record_degraded("all_gather_object", "exhausted", "local")
+        ev.record_checkpoint("save", "/tmp/ckpt-00000000.bin", 0, 128, 0.001)
         # sync — the in-process wire simulation's hook.
         LocalWorld(2).run(lambda g, r: g.all_gather_object({"rank": r}))
         # span — the Metric phase wrapper.

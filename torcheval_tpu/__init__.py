@@ -12,7 +12,15 @@ from torcheval_tpu.ops._flags import configure_persistent_cache as _cfg_cache
 
 _cfg_cache()
 
-from torcheval_tpu import aot, engine, metrics, telemetry, tools
+from torcheval_tpu import aot, engine, metrics, resilience, telemetry, tools
 from torcheval_tpu.version import __version__
 
-__all__ = ["aot", "engine", "metrics", "telemetry", "tools", "__version__"]
+__all__ = [
+    "aot",
+    "engine",
+    "metrics",
+    "resilience",
+    "telemetry",
+    "tools",
+    "__version__",
+]

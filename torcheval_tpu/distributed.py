@@ -27,6 +27,7 @@ path (``psum`` inside ``shard_map``) that never touches this byte layer; see
 
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 import time
@@ -39,7 +40,33 @@ from torcheval_tpu.telemetry import events as _telemetry
 
 # Peer-payload wait budget for the KV-store gather (first compiles and big
 # pickles through the tunnel are slow; generous beats a spurious timeout).
-_KV_TIMEOUT_MS = 600_000
+# Override per deployment with TORCHEVAL_TPU_KV_TIMEOUT_MS, or wrap the
+# group in torcheval_tpu.resilience.ResilientGroup for per-call retry +
+# deadline policy on top of this per-RPC budget.
+_KV_TIMEOUT_MS_DEFAULT = 600_000
+
+
+def kv_timeout_ms() -> int:
+    """The per-RPC wait budget (ms) for KV-store collectives: the value
+    of ``TORCHEVAL_TPU_KV_TIMEOUT_MS`` when set (a positive integer —
+    anything else raises so a typo'd deployment fails loudly instead of
+    silently waiting ten minutes), else :data:`_KV_TIMEOUT_MS_DEFAULT`."""
+    raw = os.environ.get("TORCHEVAL_TPU_KV_TIMEOUT_MS", "").strip()
+    if not raw:
+        return _KV_TIMEOUT_MS_DEFAULT
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            "TORCHEVAL_TPU_KV_TIMEOUT_MS must be a positive integer "
+            f"(milliseconds), got {raw!r}"
+        ) from None
+    if value <= 0:
+        raise ValueError(
+            "TORCHEVAL_TPU_KV_TIMEOUT_MS must be a positive integer "
+            f"(milliseconds), got {raw!r}"
+        )
+    return value
 
 
 class CollectiveGroup(ABC):
@@ -234,6 +261,7 @@ class JaxProcessGroup(CollectiveGroup):
         JaxProcessGroup._gather_gen += 1
         prefix = f"torcheval_tpu/allgather/{gen}"
         rank, world = self.rank, self.world_size
+        timeout_ms = kv_timeout_ms()
         chunks = [
             payload[i : i + self._KV_CHUNK]
             for i in range(0, max(len(payload), 1), self._KV_CHUNK)
@@ -251,14 +279,14 @@ class JaxProcessGroup(CollectiveGroup):
                 continue
             n = int(
                 client.blocking_key_value_get(
-                    f"{prefix}/{peer}/n", _KV_TIMEOUT_MS
+                    f"{prefix}/{peer}/n", timeout_ms
                 )
             )
             out.append(
                 b"".join(
                     base64.b64decode(
                         client.blocking_key_value_get(
-                            f"{prefix}/{peer}/{i}", _KV_TIMEOUT_MS
+                            f"{prefix}/{peer}/{i}", timeout_ms
                         )
                     )
                     for i in range(n)
@@ -266,7 +294,7 @@ class JaxProcessGroup(CollectiveGroup):
             )
         # Every rank has read every peer once it reaches the barrier; each
         # then deletes its own keys (deleting earlier would race readers).
-        client.wait_at_barrier(f"{prefix}-done", _KV_TIMEOUT_MS)
+        client.wait_at_barrier(f"{prefix}-done", timeout_ms)
         client.key_value_delete(f"{prefix}/{rank}/")
         return out
 
@@ -325,6 +353,7 @@ class JaxProcessGroup(CollectiveGroup):
         JaxProcessGroup._gather_gen += 1
         prefix = f"torcheval_tpu/gather/{gen}"
         rank, world = self.rank, self.world_size
+        timeout_ms = kv_timeout_ms()
         if rank != dst:
             payload = pickle.dumps(obj)
             chunks = [
@@ -345,13 +374,13 @@ class JaxProcessGroup(CollectiveGroup):
                 continue
             n = int(
                 client.blocking_key_value_get(
-                    f"{prefix}/{peer}/n", _KV_TIMEOUT_MS
+                    f"{prefix}/{peer}/n", timeout_ms
                 )
             )
             payload = b"".join(
                 base64.b64decode(
                     client.blocking_key_value_get(
-                        f"{prefix}/{peer}/{i}", _KV_TIMEOUT_MS
+                        f"{prefix}/{peer}/{i}", timeout_ms
                     )
                 )
                 for i in range(n)

@@ -52,6 +52,8 @@ from torcheval_tpu.metrics._bucket import (
     pad_to_bucket,
 )
 from torcheval_tpu.metrics.collection import MetricCollection
+from torcheval_tpu.resilience import faults as _faults
+from torcheval_tpu.resilience.checkpoint import CheckpointManager
 from torcheval_tpu.telemetry import events as _telemetry
 from torcheval_tpu.telemetry import health as _health
 
@@ -88,6 +90,20 @@ class Evaluator:
     ``.snapshots`` / ``.last_snapshot``) for online monitoring without
     leaving the stream.
 
+    ``checkpoint_dir`` makes the eval durable: every
+    ``checkpoint_every_blocks`` dispatched blocks, the collection's
+    ``state_dict()`` plus the stream cursor (batches consumed, blocks
+    dispatched) is written atomically through
+    :class:`torcheval_tpu.resilience.CheckpointManager`, and a NEW
+    ``Evaluator`` over the same directory auto-resumes from the newest
+    valid generation — already-consumed batches are skipped on replay,
+    and the final ``compute()`` is bit-identical to an uninterrupted
+    run (each checkpointed state is exactly the sequential fold of the
+    batches the cursor counts, so replaying the remainder in order
+    reproduces the identical values regardless of where the kill
+    landed).  Corrupt/torn generations are hash-detected, quarantined,
+    and the previous generation used instead.
+
     ``step``/``flush``/``run`` must not be called concurrently; the
     prefetch thread only ever runs the engine's own block assembly.
     """
@@ -103,6 +119,9 @@ class Evaluator:
         prefetch_depth: int = DEFAULT_DEPTH,
         snapshot_every: Optional[int] = None,
         on_snapshot: Optional[Callable[[int, Dict[str, Any]], Any]] = None,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every_blocks: Optional[int] = None,
+        checkpoint_keep: int = 2,
     ) -> None:
         if not isinstance(collection, MetricCollection):
             raise TypeError(
@@ -145,6 +164,48 @@ class Evaluator:
         self.snapshots: List[Dict[str, Any]] = []
         self.last_snapshot: Optional[Dict[str, Any]] = None
 
+        # -- durable checkpoint/resume (torcheval_tpu/resilience) -----
+        if checkpoint_every_blocks is not None:
+            if checkpoint_dir is None:
+                raise ValueError(
+                    "checkpoint_every_blocks requires checkpoint_dir."
+                )
+            if int(checkpoint_every_blocks) < 1:
+                raise ValueError(
+                    "checkpoint_every_blocks must be >= 1, got "
+                    f"{checkpoint_every_blocks}"
+                )
+        self._ckpt: Optional[CheckpointManager] = None
+        self._ckpt_every = (
+            int(checkpoint_every_blocks)
+            if checkpoint_every_blocks is not None
+            else None
+        )
+        self._resume_skip = 0
+        self._stream_position = 0
+        self._last_ckpt_blocks = 0
+        self.resumed_from: Optional[str] = None
+        if checkpoint_dir is not None:
+            self._ckpt = CheckpointManager(
+                checkpoint_dir, keep=checkpoint_keep
+            )
+            resumed = self._ckpt.load_latest()
+            if resumed is not None:
+                # Checkpoints hold host numpy; rehydrate to device arrays
+                # (bit-exact — device_put does not touch the payload).
+                collection.load_state_dict(
+                    {k: jnp.asarray(v) for k, v in resumed.state.items()}
+                )
+                self.batches_seen = int(
+                    resumed.cursor.get("batches_seen", 0)
+                )
+                self.blocks_dispatched = int(
+                    resumed.cursor.get("blocks_dispatched", 0)
+                )
+                self._resume_skip = self.batches_seen
+                self._last_ckpt_blocks = self.blocks_dispatched
+                self.resumed_from = resumed.path
+
     # ------------------------------------------------------------ lifecycle
     @property
     def collection(self) -> MetricCollection:
@@ -156,7 +217,10 @@ class Evaluator:
         are buffered (or the batch signature changes)."""
         if not args:
             raise ValueError("step() needs at least one batch array.")
-        for block in self._push(self._normalize(args)):
+        batch = self._admit(args)
+        if batch is None:
+            return self
+        for block in self._push(batch):
             self._dispatch(block)
         return self
 
@@ -237,11 +301,29 @@ class Evaluator:
         return tuple(sweep)
 
     # ------------------------------------------------------ block assembly
+    def _admit(
+        self, args: Tuple[Any, ...]
+    ) -> Optional[Tuple[Any, ...]]:
+        """Count one incoming batch against the resume cursor.  Returns
+        the normalized batch, or ``None`` while the replayed stream is
+        still inside the already-checkpointed prefix."""
+        self._stream_position += 1
+        if self._stream_position <= self._resume_skip:
+            return None
+        return self._normalize(args)
+
     def _normalize(self, args: Tuple[Any, ...]) -> Tuple[Any, ...]:
         # Batches are host data until the block ships: numpy views keep
         # block assembly off the JAX dispatch path entirely (a device
         # array is pulled back once here — sources are host loaders).
-        return tuple(np.asarray(a) for a in args)
+        args = tuple(np.asarray(a) for a in args)
+        if _faults.ENABLED:
+            # Chaos site "engine.batch": a corrupt rule pokes a NaN into
+            # the batch so the data-health monitor has a real finding.
+            rule = _faults.fire("engine.batch", batch=self._stream_position)
+            if rule is not None and rule.action == "corrupt":
+                args = _faults.corrupt_batch(args)
+        return args
 
     def _batch_key(self, args: Tuple[Any, ...]) -> Any:
         # Bucketed blocks share a dispatch across leading-dim raggedness
@@ -321,7 +403,10 @@ class Evaluator:
                 args = tuple(batch)
             else:
                 args = (batch,)
-            for block in self._push(self._normalize(args)):
+            admitted = self._admit(args)
+            if admitted is None:
+                continue
+            for block in self._push(admitted):
                 yield block
         if self._pending:
             yield self._make_block()
@@ -356,6 +441,7 @@ class Evaluator:
                 self._collection.fused_update(*args)
             self.batches_seen += block.batches
             self._maybe_snapshot()
+            self._maybe_checkpoint()
             return
         runner = self._ensure_runner()
         t0 = time.monotonic() if _telemetry.ENABLED else 0.0
@@ -383,6 +469,7 @@ class Evaluator:
                 steps=block.batches,
             )
         self._maybe_snapshot()
+        self._maybe_checkpoint()
 
     def _maybe_snapshot(self) -> None:
         if (
@@ -397,3 +484,41 @@ class Evaluator:
             self.snapshots.append(snap)
             if self._on_snapshot is not None:
                 self._on_snapshot(self.blocks_dispatched, snap)
+
+    # --------------------------------------------------------- checkpoints
+    def save_checkpoint(self, *, flush: bool = True) -> str:
+        """Persist the collection state + stream cursor now (atomic
+        write; see :class:`~torcheval_tpu.resilience.CheckpointManager`).
+        ``flush=True`` (default) dispatches any buffered partial block
+        first so the cursor covers every batch handed to the evaluator —
+        use it for a final checkpoint after :meth:`run`; the periodic
+        in-stream saves use ``flush=False`` (buffered batches are simply
+        replayed on resume)."""
+        if self._ckpt is None:
+            raise RuntimeError(
+                "Evaluator was constructed without checkpoint_dir."
+            )
+        if flush:
+            self.flush()
+        self._last_ckpt_blocks = self.blocks_dispatched
+        return self._ckpt.save(
+            self._collection.state_dict(),
+            {
+                "batches_seen": self.batches_seen,
+                "blocks_dispatched": self.blocks_dispatched,
+            },
+        )
+
+    def _maybe_checkpoint(self) -> None:
+        # The cursor is always safe to take here: ``batches_seen`` counts
+        # exactly the batches whose effect is installed in member states
+        # (buffered/staged-but-undispatched batches are not counted and
+        # get replayed on resume), and the stream is refolded in order,
+        # so resume is bit-identical wherever the kill lands.
+        if self._ckpt_every is None:
+            return
+        if (
+            self.blocks_dispatched - self._last_ckpt_blocks
+            >= self._ckpt_every
+        ):
+            self.save_checkpoint(flush=False)

@@ -18,10 +18,12 @@ down promptly from any state (mid-put included) and joins the thread.
 import queue
 import threading
 import time
+import warnings
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import jax
 
+from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
 
 DEFAULT_DEPTH = 2
@@ -29,6 +31,20 @@ DEFAULT_DEPTH = 2
 # Producer-side poll period for stop-aware blocking puts: close() is
 # observed within one tick even if the consumer never drains the queue.
 _PUT_TICK_S = 0.05
+
+# close() join budget.  A producer still alive past it is a leak —
+# reported via warning + `degraded` telemetry event, never silent.
+_JOIN_TIMEOUT_S = 5.0
+
+# Staging (device_put) gets one bounded retry for transient transfer
+# failures; the stop flag is checked before every attempt so close()
+# never waits out a retry loop on a dead device.
+_STAGE_ATTEMPTS = 2
+_STAGE_RETRY_DELAY_S = 0.02
+
+
+class _Stopped(Exception):
+    """Internal: the producer observed close() mid-item; exit quietly."""
 
 
 class _SourceError:
@@ -93,13 +109,33 @@ class Prefetcher:
                 continue
         return False
 
+    def _stage_with_retry(self, item: Any) -> Any:
+        for attempt in range(1, _STAGE_ATTEMPTS + 1):
+            if self._stop.is_set():
+                raise _Stopped()
+            try:
+                return self._stage(item)
+            except Exception:  # noqa: BLE001 - bounded retry, then relay
+                if attempt >= _STAGE_ATTEMPTS or self._stop.is_set():
+                    raise
+                time.sleep(_STAGE_RETRY_DELAY_S)
+        raise AssertionError("unreachable")  # pragma: no cover
+
     def _produce(self) -> None:
+        produced = 0
         try:
             for item in self._source:
-                staged = self._stage(item)
+                if self._stop.is_set():
+                    return
+                staged = self._stage_with_retry(item)
+                produced += 1
+                if _faults.ENABLED:
+                    _faults.fire("prefetch.produce", items=produced)
                 if not self._put(staged):
                     return
             self._put(_DONE)
+        except _Stopped:
+            return
         except BaseException as exc:  # noqa: BLE001 - relayed to consumer
             self._put(_SourceError(exc))
 
@@ -148,4 +184,22 @@ class Prefetcher:
                 self._queue.get_nowait()
             except queue.Empty:
                 break
-        self._thread.join(timeout=5.0)
+        self._thread.join(timeout=_JOIN_TIMEOUT_S)
+        if self._thread.is_alive():
+            # The producer is wedged (e.g. a device transfer that never
+            # returns).  The thread is a daemon so the process can still
+            # exit, but a silent leak would mask the wedge — report it.
+            if _telemetry.ENABLED:
+                _telemetry.record_degraded(
+                    "prefetch.close",
+                    f"producer thread still alive after "
+                    f"{_JOIN_TIMEOUT_S:g}s join",
+                    "leaked_thread",
+                )
+            warnings.warn(
+                "Prefetcher.close(): producer thread did not exit within "
+                f"{_JOIN_TIMEOUT_S:g}s and was leaked (daemon). A device "
+                "transfer or the batch source is likely wedged.",
+                RuntimeWarning,
+                stacklevel=2,
+            )

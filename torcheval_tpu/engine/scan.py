@@ -27,6 +27,7 @@ import jax
 from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics.collection import MetricCollection, _call_signature
 from torcheval_tpu.ops import _flags
+from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
 from torcheval_tpu.telemetry import health as _health
 
@@ -118,6 +119,11 @@ class ScanRunner:
         runner was built with health, else ``None``."""
         col = self._collection
         key = _call_signature(stacked_args, {"mask": stacked_mask})
+        if _faults.ENABLED:
+            # Chaos site "engine.scan": a mid-stream abort BETWEEN blocks
+            # (before any state is read) — the kill the checkpoint/resume
+            # suite recovers from.
+            _faults.fire("engine.scan", signature=hash(key))
         if key not in self._seen:
             col._check_fusable()
         before = col._read_states()

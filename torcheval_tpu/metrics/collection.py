@@ -393,8 +393,26 @@ class MetricCollection:
                 )
             if problems:
                 raise RuntimeError("; ".join(problems) + ".")
-        for name, metric in self._metrics.items():
-            metric.load_state_dict(per_metric[name], strict=strict)
+        # Atomic install: a failure on ANY member (including a strict
+        # mismatch raised AFTER that member set some of its states) rolls
+        # every already-touched member back to its pre-call arrays, so a
+        # bad checkpoint can never leave the collection half-mutated.
+        snapshots = {
+            name: {
+                s: getattr(metric, s)
+                for s in metric._state_name_to_default
+                if hasattr(metric, s)
+            }
+            for name, metric in self._metrics.items()
+        }
+        try:
+            for name, metric in self._metrics.items():
+                metric.load_state_dict(per_metric[name], strict=strict)
+        except BaseException:
+            for name, metric in self._metrics.items():
+                for s, value in snapshots[name].items():
+                    setattr(metric, s, value)
+            raise
 
     def to(self, device: Any) -> "MetricCollection":
         for metric in self._metrics.values():

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec
 from torcheval_tpu.parallel._compat import shard_map
 from torcheval_tpu.parallel._compile_cache import compiled_spmd
 from torcheval_tpu.parallel.mesh import AxisSpec, _axis_size
+from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
 
 Reduction = Union[str, Any]  # 'sum' | 'max' | 'min' | 'mean' | 'concat' | pytree
@@ -91,6 +92,7 @@ def make_synced_update(
     axis: AxisSpec = "dp",
     reductions: Reduction = "sum",
     in_specs: Optional[Sequence[PartitionSpec]] = None,
+    retry: Optional[Any] = None,
 ) -> Callable[..., Any]:
     """Wrap a functional sufficient-statistic kernel into a jitted SPMD
     update with one fused cross-device merge.
@@ -106,6 +108,13 @@ def make_synced_update(
     This replaces the reference's per-rank ``metric.update`` +
     ``sync_and_compute`` round (reference ``toolkit.py:24-78``) with a path
     that never leaves the device.
+
+    ``retry`` (a :class:`torcheval_tpu.resilience.RetryPolicy`) re-issues
+    the dispatch on transient failure with backoff, raising
+    :class:`~torcheval_tpu.resilience.CollectiveTimeoutError` on
+    exhaustion — the retry is symmetric across hosts because every host
+    runs the same policy over the same SPMD program.  Each failed
+    attempt emits a ``retry`` telemetry event when the bus is on.
     """
     if in_specs is None:
         specs: Any = PartitionSpec(axis)
@@ -133,11 +142,33 @@ def make_synced_update(
     )
     op = f"synced_update:{getattr(kernel, '__name__', str(kernel))}"
 
+    def attempt_call(*batch):
+        # Chaos site "sync.dispatch" fires per attempt (inside the retry
+        # loop) so injected transient failures are retried like real ones.
+        if _faults.ENABLED:
+            _faults.fire("sync.dispatch", op=op)
+        return jitted(*batch)
+
+    if retry is not None:
+        import random as _random
+
+        from torcheval_tpu.resilience.retry import retry_call as _retry_call
+
+        _rng = _random.Random(retry.seed)
+
+        def dispatch(*batch):
+            return _retry_call(
+                op, lambda: attempt_call(*batch), retry, rng=_rng
+            )
+
+    else:
+        dispatch = attempt_call
+
     def synced(*batch):
         if not _telemetry.ENABLED:
-            return jitted(*batch)
+            return dispatch(*batch)
         t0 = time.monotonic()
-        out = jitted(*batch)
+        out = dispatch(*batch)
         jax.block_until_ready(out)
         # The merged state pytree IS the collective's payload (every
         # device ends up holding the full value).

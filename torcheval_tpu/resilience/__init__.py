@@ -1,0 +1,49 @@
+"""Fault tolerance for streaming evals: durable checkpoint/resume,
+collective retry/timeout/backoff, and deterministic fault injection.
+
+The reference toolkit assumes every rank survives the whole eval
+(``toolkit.sync_and_compute`` gathers once and merges); on a multi-host
+TPU fleet that means one preempted host or one stalled coordinator RPC
+kills the run and all accumulated state.  This package is the
+fail-operational layer on top of PRs 2–4's observability:
+
+- :mod:`~torcheval_tpu.resilience.checkpoint` —
+  :class:`CheckpointManager`: atomic (tmp+fsync+rename, SHA-256
+  manifest) generations of the collection's ``state_dict()`` plus the
+  stream cursor; ``engine.Evaluator(checkpoint_dir=...)`` auto-resumes
+  bit-identically.
+- :mod:`~torcheval_tpu.resilience.retry` — :class:`RetryPolicy` /
+  :class:`ResilientGroup`: backoff-retried object collectives with a
+  hard deadline, typed :class:`CollectiveTimeoutError`, and optional
+  ``degrade="local"`` single-host fallback.
+- :mod:`~torcheval_tpu.resilience.faults` — :class:`FaultPlan`: seeded,
+  site-named fault injection behind the same one-branch zero-cost-off
+  guards as the telemetry bus (``scripts/check_hot_path_overhead.py``
+  enforces it).
+
+See ``docs/source/resilience.rst`` for the checkpoint format, retry
+policy guidance, and the fault-plan cookbook.
+"""
+
+from torcheval_tpu.resilience import checkpoint, faults, retry
+from torcheval_tpu.resilience.checkpoint import Checkpoint, CheckpointManager
+from torcheval_tpu.resilience.faults import FaultPlan, FaultRule, InjectedFault
+from torcheval_tpu.resilience.retry import (
+    CollectiveTimeoutError,
+    ResilientGroup,
+    RetryPolicy,
+)
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointManager",
+    "CollectiveTimeoutError",
+    "FaultPlan",
+    "FaultRule",
+    "InjectedFault",
+    "ResilientGroup",
+    "RetryPolicy",
+    "checkpoint",
+    "faults",
+    "retry",
+]

@@ -1,0 +1,244 @@
+"""Durable checkpoint/resume for streaming evals.
+
+An eval that streams millions of batches through ``engine.Evaluator``
+loses *all* accumulated metric state when the host is preempted.  This
+module makes that state durable with the classic atomic-write recipe:
+
+- payload = pickle of ``{"state": <flat orbax-style mapping>, "cursor":
+  {"batches_seen", "blocks_dispatched"}}`` with every array forced to
+  host numpy (``MetricCollection.state_dict`` already returns the flat
+  ``"{member}/{state}"`` mapping of fresh buffers, so a checkpoint is
+  RNG-free and donation-safe by construction);
+- written to ``ckpt-<generation>.bin.tmp``, flushed, ``os.fsync``-ed,
+  then ``os.rename``-d into place (atomic on POSIX);
+- a sidecar manifest ``ckpt-<generation>.manifest.json`` (same
+  tmp+fsync+rename dance, written *after* the data file) records the
+  payload's SHA-256, byte length, and the cursor, so a reader can
+  validate without unpickling.
+
+``load_latest`` walks generations newest-first: a checkpoint whose
+manifest is missing/unreadable or whose data hash/length mismatches is
+*quarantined* (both files renamed with a ``.corrupt`` suffix, a
+``checkpoint``/``quarantine`` telemetry event emitted) and the previous
+generation is tried — a torn write never poisons resume, it just costs
+one generation of progress.
+
+The cursor is taken at block boundaries only (``Evaluator`` saves when
+no partially-filled block is pending), so replaying the stream and
+skipping ``batches_seen`` batches reproduces the exact block grouping —
+that is what makes resume bit-identical to an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import re
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Mapping, Optional
+
+import numpy as np
+
+from torcheval_tpu.resilience import faults as _faults
+from torcheval_tpu.telemetry import events as _telemetry
+
+_DATA_RE = re.compile(r"^ckpt-(\d{8})\.bin$")
+_MANIFEST_VERSION = 1
+
+
+@dataclass
+class Checkpoint:
+    """One loaded-and-validated checkpoint generation."""
+
+    generation: int
+    path: str
+    state: Dict[str, np.ndarray]
+    cursor: Dict[str, int]
+    nbytes: int
+
+
+def _fsync_write(path: str, data: bytes) -> None:
+    """tmp-file + flush + fsync + atomic rename into ``path``."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.rename(tmp, path)
+
+
+class CheckpointManager:
+    """Generation-numbered atomic checkpoints in one directory.
+
+    ``keep`` bounds disk use: after each successful save, valid
+    generations beyond the newest ``keep`` are deleted (quarantined
+    ``.corrupt`` files are left for post-mortems).
+    """
+
+    def __init__(self, directory: str, *, keep: int = 2) -> None:
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.directory = str(directory)
+        self.keep = keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- paths -----------------------------------------------------------
+    def _data_path(self, generation: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{generation:08d}.bin")
+
+    def _manifest_path(self, generation: int) -> str:
+        return os.path.join(
+            self.directory, f"ckpt-{generation:08d}.manifest.json"
+        )
+
+    def generations(self) -> List[int]:
+        """Generation numbers with a data file present, ascending."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = _DATA_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    # -- write -----------------------------------------------------------
+    def save(
+        self,
+        state: Mapping[str, Any],
+        cursor: Mapping[str, int],
+    ) -> str:
+        """Atomically persist one generation; returns the data path.
+
+        ``state`` is the collection's flat ``state_dict()`` mapping;
+        every leaf is forced to host numpy so the payload is
+        device-free and bit-exact on reload.
+        """
+        t0 = time.monotonic()
+        host_state = {k: np.asarray(v) for k, v in state.items()}
+        payload = pickle.dumps(
+            {"state": host_state, "cursor": dict(cursor)},
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        gens = self.generations()
+        generation = (gens[-1] + 1) if gens else 0
+        data_path = self._data_path(generation)
+
+        if _faults.ENABLED:
+            rule = _faults.fire(
+                "checkpoint.write",
+                generation=generation,
+                nbytes=len(payload),
+            )
+            if rule is not None and rule.action == "tear":
+                # Simulate a crash that left a torn data file on disk
+                # (power loss after a non-atomic writer, fsync lost):
+                # the manifest records the full payload's hash, so
+                # load_latest must quarantine this generation.
+                with open(data_path, "wb") as fh:
+                    fh.write(payload[: rule.offset])
+                self._write_manifest(generation, payload, cursor)
+                raise _faults.InjectedFault(
+                    "checkpoint.write",
+                    f"torn checkpoint write at byte {rule.offset}",
+                )
+
+        _fsync_write(data_path, payload)
+        self._write_manifest(generation, payload, cursor)
+        self._prune()
+        if _telemetry.ENABLED:
+            _telemetry.record_checkpoint(
+                "save",
+                data_path,
+                generation,
+                len(payload),
+                time.monotonic() - t0,
+            )
+        return data_path
+
+    def _write_manifest(
+        self, generation: int, payload: bytes, cursor: Mapping[str, int]
+    ) -> None:
+        manifest = {
+            "version": _MANIFEST_VERSION,
+            "generation": generation,
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "nbytes": len(payload),
+            "cursor": dict(cursor),
+        }
+        _fsync_write(
+            self._manifest_path(generation),
+            json.dumps(manifest, sort_keys=True).encode("utf-8"),
+        )
+
+    def _prune(self) -> None:
+        for generation in self.generations()[: -self.keep]:
+            for path in (
+                self._data_path(generation),
+                self._manifest_path(generation),
+            ):
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover - concurrent cleanup
+                    pass
+
+    # -- read ------------------------------------------------------------
+    def load_latest(self) -> Optional[Checkpoint]:
+        """Newest checkpoint that validates; corrupt generations are
+        quarantined and older ones tried.  None when nothing valid."""
+        for generation in reversed(self.generations()):
+            t0 = time.monotonic()
+            loaded = self._load_one(generation)
+            if loaded is None:
+                self._quarantine(generation)
+                continue
+            if _telemetry.ENABLED:
+                _telemetry.record_checkpoint(
+                    "restore",
+                    loaded.path,
+                    generation,
+                    loaded.nbytes,
+                    time.monotonic() - t0,
+                )
+            return loaded
+        return None
+
+    def _load_one(self, generation: int) -> Optional[Checkpoint]:
+        data_path = self._data_path(generation)
+        try:
+            with open(self._manifest_path(generation), "rb") as fh:
+                manifest = json.loads(fh.read().decode("utf-8"))
+            with open(data_path, "rb") as fh:
+                payload = fh.read()
+        except (OSError, ValueError, UnicodeDecodeError):
+            return None
+        if (
+            len(payload) != manifest.get("nbytes")
+            or hashlib.sha256(payload).hexdigest() != manifest.get("sha256")
+        ):
+            return None
+        try:
+            record = pickle.loads(payload)
+        except Exception:  # noqa: BLE001 - hash passed but unpicklable
+            return None
+        return Checkpoint(
+            generation=generation,
+            path=data_path,
+            state=record["state"],
+            cursor=dict(record["cursor"]),
+            nbytes=len(payload),
+        )
+
+    def _quarantine(self, generation: int) -> None:
+        data_path = self._data_path(generation)
+        for path in (data_path, self._manifest_path(generation)):
+            if os.path.exists(path):
+                try:
+                    os.rename(path, path + ".corrupt")
+                except OSError:  # pragma: no cover - concurrent cleanup
+                    pass
+        if _telemetry.ENABLED:
+            _telemetry.record_checkpoint(
+                "quarantine", data_path + ".corrupt", generation, 0, 0.0
+            )

@@ -1,0 +1,275 @@
+"""Deterministic fault injection for the chaos suite.
+
+A :class:`FaultPlan` is a set of :class:`FaultRule` entries keyed by
+*site* name.  Hook sites live inside the library at the exact places a
+real fault would land — each is the same one-branch guard the telemetry
+bus and data-health monitor use (``if _faults.ENABLED: _faults.fire``),
+so with no plan installed (the default) the hot path pays one module
+attribute read and one branch and never calls into this module.
+``scripts/check_hot_path_overhead.py`` asserts that empirically, and
+because every hook is host-side Python, the jitted programs are
+byte-identical with the subsystem on or off.
+
+Named sites (the cookbook in ``docs/source/resilience.rst`` shows each
+in action):
+
+``collective``
+    Each attempt of an object collective inside
+    :class:`~torcheval_tpu.resilience.retry.ResilientGroup` (context:
+    ``op``, ``attempt``).  ``action="raise"`` drops the attempt,
+    ``action="delay"`` stalls it.
+``prefetch.produce``
+    After the engine's prefetch producer stages an item (context:
+    ``items`` staged so far).  ``after=K`` kills the producer after K
+    items, exercising the consumer-side error relay.
+``engine.scan``
+    At the top of ``ScanRunner.dispatch``, before any state is read —
+    a mid-stream abort between blocks (context: ``signature``).
+``engine.batch``
+    Per batch admitted by the ``Evaluator`` (context: ``batch``).
+    ``action="corrupt"`` pokes a NaN into the first float argument so
+    the data-health monitor has something to catch.
+``checkpoint.write``
+    Inside ``CheckpointManager.save`` (context: ``generation``,
+    ``nbytes``).  ``action="tear"`` simulates a crash that left a torn
+    data file of ``offset`` bytes behind, then raises.
+``sync.dispatch``
+    Per synced-update dispatch in ``parallel/sync.py`` (context:
+    ``op``).
+
+Reproducibility: probabilistic rules (``probability < 1``) draw from a
+``numpy`` generator seeded by ``FaultPlan(seed=)``; draws are consumed
+in site-hit order under a lock, so the same plan over the same workload
+fires at the same hit indices every run.
+
+Plans activate as context managers (``with FaultPlan([...]):``) or from
+the environment: ``TORCHEVAL_TPU_FAULT_PLAN='[{"site": "collective",
+"on_attempt": 1}]'`` installs a plan at import (one JSON object or a
+list of them; keys mirror the :class:`FaultRule` fields).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+# The one-branch guard flag.  True exactly while a plan is installed.
+ENABLED: bool = False
+
+_ACTIVE: Optional["FaultPlan"] = None
+_lock = threading.Lock()
+
+_ACTIONS = ("raise", "delay", "tear", "corrupt")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an ``action="raise"`` (or ``"tear"``) rule — typed so
+    chaos tests can tell injected failures from real ones."""
+
+    def __init__(self, site: str, message: str = "") -> None:
+        self.site = site
+        super().__init__(message or f"injected fault at site {site!r}")
+
+
+@dataclass
+class FaultRule:
+    """One injection rule.  A rule matches a :func:`fire` call when the
+    site names are equal, every provided context filter (``on_attempt``,
+    ``match``) agrees, the hit index at that site is past ``after``, the
+    rule has fired fewer than ``count`` times, and the seeded coin
+    (``probability``) lands."""
+
+    site: str
+    action: str = "raise"       # "raise" | "delay" | "tear" | "corrupt"
+    after: int = 0              # skip the first `after` matching hits
+    count: Optional[int] = 1    # max firings (None = unlimited)
+    on_attempt: Optional[int] = None  # only when ctx["attempt"] == this
+    match: Dict[str, Any] = field(default_factory=dict)  # ctx equality
+    probability: float = 1.0    # seeded draw per eligible hit
+    delay_s: float = 0.01       # action="delay"
+    offset: int = 0             # action="tear": torn-write byte offset
+    message: str = ""
+
+    def __post_init__(self) -> None:
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"action must be one of {_ACTIONS}, got {self.action!r}"
+            )
+        if not 0.0 <= self.probability <= 1.0:
+            raise ValueError(
+                f"probability must be in [0, 1], got {self.probability}"
+            )
+
+
+@dataclass
+class FiredFault:
+    """Journal entry: one rule firing (``FaultPlan.fired``)."""
+
+    site: str
+    action: str
+    hit: int                    # per-site hit index (0-based)
+    context: Dict[str, Any]
+
+
+class FaultPlan:
+    """A seeded set of rules, installable as a context manager.
+
+    Only one plan can be active at a time (nesting would make the
+    seeded schedule ambiguous).  The plan journals every firing in
+    ``self.fired`` and counts site hits in ``self.hits`` so tests can
+    assert exactly what chaos happened.
+    """
+
+    def __init__(
+        self,
+        rules: Sequence[Union[FaultRule, Dict[str, Any]]],
+        *,
+        seed: int = 0,
+    ) -> None:
+        self.rules: List[FaultRule] = [
+            r if isinstance(r, FaultRule) else FaultRule(**r) for r in rules
+        ]
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+        self.hits: Dict[str, int] = {}
+        self.fired: List[FiredFault] = []
+        self._fired_counts: Dict[int, int] = {}
+
+    # -- installation ----------------------------------------------------
+    def install(self) -> "FaultPlan":
+        global ENABLED, _ACTIVE
+        with _lock:
+            if _ACTIVE is not None:
+                raise RuntimeError(
+                    "a FaultPlan is already active; plans do not nest"
+                )
+            _ACTIVE = self
+            ENABLED = True
+        return self
+
+    def uninstall(self) -> None:
+        global ENABLED, _ACTIVE
+        with _lock:
+            if _ACTIVE is self:
+                _ACTIVE = None
+                ENABLED = False
+
+    def __enter__(self) -> "FaultPlan":
+        return self.install()
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.uninstall()
+
+    # -- matching --------------------------------------------------------
+    def _match(
+        self, site: str, ctx: Dict[str, Any]
+    ) -> Optional[FaultRule]:
+        """One site hit: bump the hit counter, return the firing rule
+        (first match wins) or None.  Caller holds ``_lock``."""
+        hit = self.hits.get(site, 0)
+        self.hits[site] = hit + 1
+        for idx, rule in enumerate(self.rules):
+            if rule.site != site:
+                continue
+            if rule.on_attempt is not None and (
+                ctx.get("attempt") != rule.on_attempt
+            ):
+                continue
+            if any(ctx.get(k) != v for k, v in rule.match.items()):
+                continue
+            if hit < rule.after:
+                continue
+            if (
+                rule.count is not None
+                and self._fired_counts.get(idx, 0) >= rule.count
+            ):
+                continue
+            if rule.probability < 1.0 and (
+                self._rng.random() >= rule.probability
+            ):
+                continue
+            self._fired_counts[idx] = self._fired_counts.get(idx, 0) + 1
+            self.fired.append(
+                FiredFault(
+                    site=site, action=rule.action, hit=hit, context=dict(ctx)
+                )
+            )
+            return rule
+        return None
+
+
+def active() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def fire(site: str, **ctx: Any) -> Optional[FaultRule]:
+    """The hook-site entry point.  Callers MUST branch on :data:`ENABLED`
+    first (the zero-cost contract); this function does not re-check.
+
+    ``action="raise"`` raises :class:`InjectedFault`; ``"delay"`` sleeps
+    ``delay_s`` and returns None; ``"tear"``/``"corrupt"`` return the
+    matched rule so the site applies the data transformation itself.
+    """
+    plan = _ACTIVE
+    if plan is None:  # pragma: no cover - uninstall race
+        return None
+    with _lock:
+        rule = plan._match(site, ctx)
+    if rule is None:
+        return None
+    if rule.action == "raise":
+        raise InjectedFault(site, rule.message)
+    if rule.action == "delay":
+        import time
+
+        time.sleep(rule.delay_s)
+        return None
+    return rule  # "tear" / "corrupt": the caller transforms its data
+
+
+def corrupt_batch(args: Sequence[Any]) -> tuple:
+    """Apply an ``action="corrupt"`` rule: return ``args`` with a NaN
+    poked into element 0 of the first floating-point array (host-side
+    numpy copy; the caller feeds it onward like any other batch)."""
+    out = list(args)
+    for i, a in enumerate(out):
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating) and arr.size:
+            arr = np.array(arr)  # owned copy
+            arr.reshape(-1)[0] = np.nan
+            out[i] = arr
+            break
+    return tuple(out)
+
+
+def install_from_env() -> Optional[FaultPlan]:
+    """Install a plan from ``TORCHEVAL_TPU_FAULT_PLAN`` (JSON: one rule
+    object or a list of them; an object may carry a ``seed`` key when
+    wrapped as ``{"seed": n, "rules": [...]}``).  Returns the installed
+    plan, or None when the variable is unset/empty."""
+    raw = os.environ.get("TORCHEVAL_TPU_FAULT_PLAN", "").strip()
+    if not raw:
+        return None
+    try:
+        spec = json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise ValueError(
+            f"TORCHEVAL_TPU_FAULT_PLAN is not valid JSON: {exc}"
+        ) from exc
+    seed = 0
+    if isinstance(spec, dict) and "rules" in spec:
+        seed = int(spec.get("seed", 0))
+        spec = spec["rules"]
+    if isinstance(spec, dict):
+        spec = [spec]
+    return FaultPlan(spec, seed=seed).install()
+
+
+# Env-driven activation at import so `TORCHEVAL_TPU_FAULT_PLAN=... python
+# eval.py` needs no code change (mirrors TORCHEVAL_TPU_TELEMETRY).
+install_from_env()

@@ -54,12 +54,15 @@ from torcheval_tpu.telemetry.aggregate import (
 from torcheval_tpu.telemetry.events import (
     BucketPadEvent,
     CacheEvent,
+    CheckpointEvent,
     DataHealthEvent,
+    DegradedEvent,
     DonationEvent,
     EngineBlockEvent,
     Event,
     PrefetchStallEvent,
     RetraceEvent,
+    RetryEvent,
     RouteDowngradeEvent,
     SpanEvent,
     SyncEvent,
@@ -173,6 +176,24 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         "checks": health_checks,
     }
 
+    res = agg["resilience"]
+    resilience_section = {
+        "retries": {
+            op: dict(entry) for op, entry in res["retries"].items()
+        },
+        "retry_attempts": sum(
+            e["attempts"] for e in res["retries"].values()
+        ),
+        "degraded": {
+            f"{op}->{fallback}": count
+            for (op, fallback), count in res["degraded"].items()
+        },
+        "checkpoint": {
+            action: dict(entry)
+            for action, entry in res["checkpoint"].items()
+        },
+    }
+
     spans = {
         f"{name}.{phase}": {
             "calls": entry["calls"],
@@ -204,6 +225,7 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         "sync": sync_totals,
         "engine": engine_section,
         "data_health": health_section,
+        "resilience": resilience_section,
         "spans": spans,
         "events_captured": agg["emitted"],
         "events_dropped": events.dropped(),
@@ -217,12 +239,15 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
 __all__ = [
     "BucketPadEvent",
     "CacheEvent",
+    "CheckpointEvent",
     "DataHealthEvent",
+    "DegradedEvent",
     "DonationEvent",
     "EngineBlockEvent",
     "Event",
     "PrefetchStallEvent",
     "RetraceEvent",
+    "RetryEvent",
     "RouteDowngradeEvent",
     "SpanEvent",
     "SyncEvent",

@@ -8,8 +8,9 @@ padding waste (``metrics/_bucket``), donation aborts/restores
 (``metrics/collection`` / ``metrics/_buffer``), collective sync calls
 (``parallel/sync`` / ``distributed``), update/compute/dispatch spans
 (``metrics/metric`` / ``metrics/collection`` / ``metrics/_fuse``), the streaming engine's block dispatches and prefetch stalls
-(``torcheval_tpu/engine``), and the data-health monitor's findings
-(:mod:`torcheval_tpu.telemetry.health`).
+(``torcheval_tpu/engine``), the data-health monitor's findings
+(:mod:`torcheval_tpu.telemetry.health`), and the fault-tolerance layer's
+retry/degraded/checkpoint lifecycle (:mod:`torcheval_tpu.resilience`).
 
 Zero-cost-when-off contract
 ---------------------------
@@ -204,6 +205,50 @@ class DataHealthEvent(Event):
 
 
 @dataclass
+class RetryEvent(Event):
+    """One failed attempt of a retried operation (a collective under
+    :class:`torcheval_tpu.resilience.ResilientGroup`, or a retried
+    synced dispatch): the attempt number that failed, the backoff delay
+    chosen before the next attempt, and the error text."""
+
+    kind: str = field(init=False, default="retry")
+    op: str = ""
+    attempt: int = 0
+    delay_s: float = 0.0
+    error: str = ""
+
+
+@dataclass
+class DegradedEvent(Event):
+    """A resilience fallback fired: after exhausted retries the wrapper
+    served the local single-host view instead of the fleet collective
+    (``fallback="local"``), or a component shed work to stay live (e.g.
+    a prefetch producer thread leaked past its join deadline).  Never
+    silent — every degradation is one of these."""
+
+    kind: str = field(init=False, default="degraded")
+    op: str = ""
+    reason: str = ""
+    fallback: str = "local"
+
+
+@dataclass
+class CheckpointEvent(Event):
+    """One durable-checkpoint lifecycle step from
+    :mod:`torcheval_tpu.resilience.checkpoint`: ``action`` is ``save``
+    (atomic write landed), ``restore`` (auto-resume loaded a valid
+    generation), or ``quarantine`` (hash/manifest validation failed and
+    the generation was set aside)."""
+
+    kind: str = field(init=False, default="checkpoint")
+    action: str = "save"  # "save" | "restore" | "quarantine"
+    path: str = ""
+    generation: int = 0
+    nbytes: int = 0
+    seconds: float = 0.0
+
+
+@dataclass
 class SpanEvent(Event):
     """A timed metric phase (``update`` / ``compute`` / ``dispatch``)
     with the metric's state-memory footprint after the phase."""
@@ -230,6 +275,9 @@ KIND_TO_CLASS: Dict[str, type] = {
     "engine_block": EngineBlockEvent,
     "prefetch_stall": PrefetchStallEvent,
     "data_health": DataHealthEvent,
+    "retry": RetryEvent,
+    "degraded": DegradedEvent,
+    "checkpoint": CheckpointEvent,
 }
 
 
@@ -257,6 +305,16 @@ def _zero_aggregates() -> Dict[str, Any]:
         # (check, metric) -> {"count": offending elements/batches,
         # "events": emissions}; metric is "" for input-level checks.
         "data_health": {},
+        # Fault-tolerance accounting (torcheval_tpu/resilience):
+        # retries:    op -> {"attempts": failed attempts, "last_error": str}
+        # degraded:   (op, fallback) -> count
+        # checkpoint: action -> {"count": n, "seconds": total,
+        #                        "nbytes": last payload size}
+        "resilience": {
+            "retries": {},
+            "degraded": {},
+            "checkpoint": {},
+        },
         "emitted": 0,
     }
 
@@ -349,6 +407,17 @@ def aggregates() -> Dict[str, Any]:
             "data_health": {
                 k: dict(v) for k, v in _agg["data_health"].items()
             },
+            "resilience": {
+                "retries": {
+                    k: dict(v)
+                    for k, v in _agg["resilience"]["retries"].items()
+                },
+                "degraded": dict(_agg["resilience"]["degraded"]),
+                "checkpoint": {
+                    k: dict(v)
+                    for k, v in _agg["resilience"]["checkpoint"].items()
+                },
+            },
             "emitted": _agg["emitted"],
         }
 
@@ -438,6 +507,24 @@ def _fold(event: Event) -> None:
         )
         entry["count"] += event.count
         entry["events"] += 1
+    elif isinstance(event, RetryEvent):
+        entry = _agg["resilience"]["retries"].setdefault(
+            event.op, {"attempts": 0, "last_error": ""}
+        )
+        entry["attempts"] += 1
+        entry["last_error"] = event.error
+    elif isinstance(event, DegradedEvent):
+        key = (event.op, event.fallback)
+        _agg["resilience"]["degraded"][key] = (
+            _agg["resilience"]["degraded"].get(key, 0) + 1
+        )
+    elif isinstance(event, CheckpointEvent):
+        entry = _agg["resilience"]["checkpoint"].setdefault(
+            event.action, {"count": 0, "seconds": 0.0, "nbytes": 0}
+        )
+        entry["count"] += 1
+        entry["seconds"] += event.seconds
+        entry["nbytes"] = event.nbytes  # last observed payload size
     elif isinstance(event, SpanEvent):
         entry = _agg["spans"].setdefault(
             (event.name, event.phase),
@@ -525,6 +612,35 @@ def record_data_health(
             metric=metric,
             arg=int(arg),
             count=int(count),
+        )
+    )
+
+
+def record_retry(op: str, attempt: int, delay_s: float, error: str) -> None:
+    emit(
+        RetryEvent(
+            op=op,
+            attempt=int(attempt),
+            delay_s=float(delay_s),
+            error=error,
+        )
+    )
+
+
+def record_degraded(op: str, reason: str, fallback: str = "local") -> None:
+    emit(DegradedEvent(op=op, reason=reason, fallback=fallback))
+
+
+def record_checkpoint(
+    action: str, path: str, generation: int, nbytes: int, seconds: float
+) -> None:
+    emit(
+        CheckpointEvent(
+            action=action,
+            path=path,
+            generation=int(generation),
+            nbytes=int(nbytes),
+            seconds=float(seconds),
         )
     )
 

@@ -124,6 +124,9 @@ _DURATION_NAME = {
     "span": lambda e: f"{e.name}.{e.phase}",
     "sync": lambda e: f"sync.{e.op}",
     "prefetch_stall": lambda e: "prefetch_wait",
+    # Checkpoint save/restore are timed I/O phases; quarantines carry
+    # seconds=0 and render as zero-width slices at the discovery point.
+    "checkpoint": lambda e: f"checkpoint.{e.action}",
 }
 
 
@@ -417,6 +420,48 @@ def prometheus_text() -> str:
             f"{entry['count']}"
         )
 
+    res = agg["resilience"]
+    out.append(
+        f"# HELP {_PREFIX}_retry_attempts_total Failed-and-retried "
+        "attempts of resilient operations, by op."
+    )
+    out.append(f"# TYPE {_PREFIX}_retry_attempts_total counter")
+    for op in sorted(res["retries"]):
+        out.append(
+            f"{_PREFIX}_retry_attempts_total{_labels(op=op)} "
+            f"{res['retries'][op]['attempts']}"
+        )
+
+    out.append(
+        f"# HELP {_PREFIX}_degraded_total Resilience fallbacks served "
+        "(e.g. local view after exhausted collective retries), by op "
+        "and fallback."
+    )
+    out.append(f"# TYPE {_PREFIX}_degraded_total counter")
+    for op, fallback in sorted(res["degraded"]):
+        out.append(
+            f"{_PREFIX}_degraded_total"
+            f"{_labels(op=op, fallback=fallback)} "
+            f"{res['degraded'][(op, fallback)]}"
+        )
+
+    out.append(
+        f"# HELP {_PREFIX}_checkpoint_total Durable-checkpoint lifecycle "
+        "steps (save/restore/quarantine)."
+    )
+    out.append(f"# TYPE {_PREFIX}_checkpoint_total counter")
+    for action in sorted(res["checkpoint"]):
+        out.append(
+            f"{_PREFIX}_checkpoint_total{_labels(action=action)} "
+            f"{res['checkpoint'][action]['count']}"
+        )
+    out.append(f"# TYPE {_PREFIX}_checkpoint_seconds_total counter")
+    for action in sorted(res["checkpoint"]):
+        out.append(
+            f"{_PREFIX}_checkpoint_seconds_total{_labels(action=action)} "
+            f"{_fmt(res['checkpoint'][action]['seconds'])}"
+        )
+
     out.append(
         f"# HELP {_PREFIX}_sync_seconds Collective merge wall time by op."
     )
@@ -533,6 +578,26 @@ def format_report(report: Dict[str, Any]) -> str:
             buf.write(
                 f"    {key}: {entry['count']} "
                 f"(in {entry['events']} findings)\n"
+            )
+    res = report.get("resilience", {})
+    if (
+        res.get("retry_attempts")
+        or res.get("degraded")
+        or res.get("checkpoint")
+    ):
+        buf.write("  resilience:\n")
+        for op, entry in sorted(res.get("retries", {}).items()):
+            buf.write(
+                f"    retried {op}: {entry['attempts']} failed attempt(s) "
+                f"(last error: {entry['last_error']})\n"
+            )
+        for key, count in sorted(res.get("degraded", {}).items()):
+            buf.write(f"    DEGRADED {key}: {count}x\n")
+        for action, entry in sorted(res.get("checkpoint", {}).items()):
+            buf.write(
+                f"    checkpoint {action}: {entry['count']}x "
+                f"({entry['seconds'] * 1e3:.3f} ms total, "
+                f"last {entry['nbytes']} B)\n"
             )
     slowest = report.get("sync", {}).get("slowest", [])
     if slowest:
