@@ -387,6 +387,9 @@ def _make_row(name: str, ours: float, ref, extras: dict) -> dict:
         # The fleet rollup rides alongside (sample_events=0 keeps rows
         # compact; single-process runs degrade to a one-host fleet).
         row["fleet"] = telemetry.fleet_report(sample_events=0)
+        # Perfscope roofline rows: empty routes unless the workload ran
+        # with the accounting layer on (TORCHEVAL_TPU_PERFSCOPE=1).
+        row["perfscope"] = telemetry.explain_perf()
     except Exception:  # pragma: no cover - report must never sink a row
         pass
     return row

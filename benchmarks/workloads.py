@@ -1347,6 +1347,19 @@ def bench_collection_scan_stream() -> Tuple[str, float, Optional[float]]:
         if not health_was_enabled:
             _health.disable()
 
+    # Perfscope pass: roofline accounting on the scan path (one shadow
+    # compile per program signature up front, a set lookup per dispatch
+    # after).  Same <=5% acceptance bar as the health monitor.
+    from torcheval_tpu.telemetry import perfscope as _perfscope
+
+    perfscope_was_enabled = _perfscope.enabled()
+    _perfscope.enable()
+    try:
+        sec_perfscope = _time_steps(step)
+    finally:
+        if not perfscope_was_enabled:
+            _perfscope.disable()
+
     extras = {
         "blocks_per_sec": round(eng["blocks"] / sec, 1),
         "dispatches_per_batch": round(eng["dispatches_per_batch"], 4),
@@ -1356,9 +1369,12 @@ def bench_collection_scan_stream() -> Tuple[str, float, Optional[float]]:
         "speedup_vs_perbatch": round(ours / ref, 2) if ref else None,
         "steady_state_ms_per_stream": round(sec * 1e3, 3),
         "health_overhead_pct": round(100.0 * (sec_health - sec) / sec, 2),
+        "perfscope_overhead_pct": round(
+            100.0 * (sec_perfscope - sec) / sec, 2
+        ),
         "roofline_note": "ref column is the per-batch fused_update loop "
         "on the same ragged stream; acceptance bar is >=1.5x engine "
-        "speedup and <=5% health-monitor overhead",
+        "speedup and <=5% health-monitor and perfscope overhead",
     }
     return "collection_scan_stream", ours, ref, extras
 

@@ -1,5 +1,6 @@
 """Zero-overhead guard for the disabled telemetry bus, the disabled
-data-health monitor, and the disarmed fault-injection hooks.
+data-health monitor, the disarmed fault-injection hooks, and the
+disabled perfscope accounting layer.
 
 The telemetry contract (``torcheval_tpu/telemetry/events.py``) is that a
 DISABLED bus costs the hot path exactly one module-attribute read and one
@@ -46,6 +47,18 @@ _HEALTH_HOOKS = ("label_bounds", "batch_stats", "stats_for_update", "inspect")
 # one branch on ``faults.ENABLED`` and ``fire`` never runs — the engine
 # batch/scan/prefetch/sync/checkpoint sites add zero hot-path cost.
 _FAULT_HOOKS = ("fire",)
+
+# Perfscope entry points (``torcheval_tpu/telemetry/perfscope.py``):
+# disabled, no program pricing (shadow compiles!), no SLO evaluation,
+# and no batch-bytes walks may run — the fused/scan/SPMD build sites
+# and the engine dispatch loop each pay one branch on
+# ``perfscope.ENABLED``.
+_PERFSCOPE_HOOKS = (
+    "profile_program",
+    "maybe_evaluate_slo",
+    "evaluate_slo",
+    "batch_nbytes",
+)
 
 
 def _hook_names(events_module) -> List[str]:
@@ -132,11 +145,14 @@ def check(verbose: bool = True) -> List[str]:
     from torcheval_tpu.resilience import faults as fl
     from torcheval_tpu.telemetry import events as ev
     from torcheval_tpu.telemetry import health as hm
+    from torcheval_tpu.telemetry import perfscope as ps
 
     was_enabled = telemetry.enabled()
     health_was_enabled = hm.enabled()
+    perfscope_was_enabled = ps.enabled()
     telemetry.disable()
     hm.disable()
+    ps.disable()
     counter: Dict[str, int] = {}
     names = _hook_names(ev)
     try:
@@ -163,12 +179,24 @@ def check(verbose: bool = True) -> List[str]:
                         _counting(getattr(fl, name), counter, f"faults.{name}"),
                     )
                 )
+            for name in _PERFSCOPE_HOOKS:
+                stack.enter_context(
+                    mock.patch.object(
+                        ps,
+                        name,
+                        _counting(
+                            getattr(ps, name), counter, f"perfscope.{name}"
+                        ),
+                    )
+                )
             _drive_hot_path()
     finally:
         if was_enabled:
             telemetry.enable()
         if health_was_enabled:
             hm.enable()
+        if perfscope_was_enabled:
+            ps.enable()
     fired = {k: v for k, v in counter.items() if v}
     if fired:
         raise AssertionError(
@@ -176,14 +204,21 @@ def check(verbose: bool = True) -> List[str]:
             f"zero-overhead contract is broken): {fired}"
         )
     if verbose:
+        total = (
+            len(names)
+            + len(_HEALTH_HOOKS)
+            + len(_FAULT_HOOKS)
+            + len(_PERFSCOPE_HOOKS)
+        )
         print(
-            f"ok: {len(names) + len(_HEALTH_HOOKS) + len(_FAULT_HOOKS)} "
+            f"ok: {total} "
             "hook entry points stayed cold on the disabled hot path"
         )
     return (
         names
         + [f"health.{n}" for n in _HEALTH_HOOKS]
         + [f"faults.{n}" for n in _FAULT_HOOKS]
+        + [f"perfscope.{n}" for n in _PERFSCOPE_HOOKS]
     )
 
 

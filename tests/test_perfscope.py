@@ -1,0 +1,551 @@
+"""Perfscope: program pricing at the hot-path build sites, explain_perf
+rooflines, donation verification, SLO alert rules, merged host+device
+Perfetto traces, the Prometheus endpoint, and the CLI alert gate
+(torcheval_tpu/telemetry/perfscope.py, torcheval_tpu/tools/roofline.py)."""
+
+import contextlib
+import io
+import json
+import os
+import tempfile
+import unittest
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.metrics import (
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+)
+from torcheval_tpu.telemetry import events as ev, export, perfscope
+from torcheval_tpu.tools import roofline
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.perfscope]
+
+_C = 7
+
+
+class PerfscopeIsolation(unittest.TestCase):
+    """Every test starts from a cleared bus with perfscope off and no
+    installed rules, and leaves the process the same way."""
+
+    def setUp(self):
+        self._capacity = ev.capacity()
+        self._was_on = perfscope.enabled()
+        telemetry.disable()
+        telemetry.clear()
+        perfscope.disable()
+        perfscope.reset()
+
+    def tearDown(self):
+        ev.enable(capacity=self._capacity)
+        telemetry.disable()
+        telemetry.clear()
+        perfscope.disable()
+        perfscope.reset()
+        if self._was_on:
+            perfscope.enable()
+
+
+def _collection():
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+            "f1": MulticlassF1Score(num_classes=_C, average="macro"),
+        },
+        bucket=True,
+    )
+
+
+def _stream(sizes, seed=3):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.random((b, _C), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, _C, b).astype(np.int32)),
+        )
+        for b in sizes
+    ]
+
+
+class TestZeroCostOff(PerfscopeIsolation):
+    def test_disabled_prices_nothing(self):
+        telemetry.enable()
+        col = _collection()
+        for args in _stream((40, 40, 100)):
+            col.fused_update(*args)
+        col.compute()
+        self.assertEqual(ev.events("program_profile"), [])
+        self.assertNotIn("perf", telemetry.report())
+
+
+class TestFusedAccounting(PerfscopeIsolation):
+    def test_reread_multiplier_and_result_parity(self):
+        """The acceptance-criteria workload: a multi-metric ragged
+        stream reports a reread multiplier > 1 from cost_analysis()
+        bytes — and pricing must not corrupt the live metric states
+        (the shadow compile re-traces the fused closure; states are
+        re-installed after a priced dispatch)."""
+        batches = _stream((40, 100, 200, 130))
+        want = _collection()
+        for args in batches:
+            want.fused_update(*args)
+        expected = {k: float(v) for k, v in want.compute().items()}
+
+        telemetry.enable()
+        perfscope.enable()
+        col = _collection()
+        for args in batches:
+            col.fused_update(*args)
+        got = {k: float(v) for k, v in col.compute().items()}
+        self.assertEqual(got, expected)
+
+        profiles = ev.events("program_profile")
+        self.assertTrue(profiles)
+        self.assertTrue(
+            all(e.program == "fused_collection" for e in profiles)
+        )
+        # Bucketing pads the four sizes onto two shapes -> two priced
+        # signatures, NOT four (the steady state is a set lookup).
+        self.assertEqual(len(profiles), 2)
+        for e in profiles:
+            self.assertGreater(e.bytes_accessed, 0)
+            self.assertGreater(e.batch_bytes, 0)
+
+        perf = telemetry.explain_perf()
+        route = perf["routes"]["fused_collection"]
+        self.assertGreater(route["reread_multiplier"], 1.0)
+        self.assertGreater(route["achieved_gbps"], 0.0)
+        self.assertEqual(route["dispatches"], len(batches))
+        self.assertIn(
+            route["bound"], ("bandwidth", "compute", "dispatch")
+        )
+        text = telemetry.explain_perf(as_text=True)
+        self.assertIn("fused_collection", text)
+        self.assertIn("reread", text)
+
+    def test_report_and_prometheus_surface_perf(self):
+        telemetry.enable()
+        perfscope.enable()
+        col = _collection()
+        for args in _stream((64, 64)):
+            col.fused_update(*args)
+        rep = telemetry.report()
+        self.assertIn("fused_collection", rep["perf"]["routes"])
+        text = export.prometheus_text()
+        self.assertIn(
+            'torcheval_tpu_program_bytes_accessed_total'
+            '{program="fused_collection"}',
+            text,
+        )
+        self.assertIn("# TYPE torcheval_tpu_alerts_total counter", text)
+
+
+class TestProfileProgram(PerfscopeIsolation):
+    def test_signature_gate_prices_once(self):
+        telemetry.enable()
+        fn = jax.jit(lambda x: x * 2.0)
+        x = jnp.ones((8,), jnp.float32)
+        first = perfscope.profile_program("spmd:test", fn, (x,), batch_args=(x,))
+        again = perfscope.profile_program("spmd:test", fn, (x,), batch_args=(x,))
+        self.assertIsNotNone(first)
+        self.assertIsNone(again)
+        self.assertEqual(len(ev.events("program_profile")), 1)
+        self.assertEqual(first["batch_bytes"], x.nbytes)
+
+    def test_donation_verify_warns_when_not_aliased(self):
+        from torcheval_tpu.routing import RouteDowngradeWarning
+
+        telemetry.enable()
+        # No donate_argnums on the jit -> the compiled program cannot
+        # carry input-output aliasing -> the donation promise is broken.
+        fn = jax.jit(lambda x: x + 1.0)
+        x = jnp.ones((16,), jnp.float32)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            profile = perfscope.profile_program(
+                "fused_collection", fn, (x,), batch_args=(x,), donate=True
+            )
+        self.assertIsNotNone(profile)
+        self.assertTrue(profile["donated"])
+        self.assertFalse(profile["aliased"])
+        downgrade = [
+            w
+            for w in caught
+            if issubclass(w.category, RouteDowngradeWarning)
+        ]
+        self.assertEqual(len(downgrade), 1)
+        self.assertIn("no input-output aliasing", str(downgrade[0].message))
+        events = ev.events("route_downgrade")
+        self.assertEqual(len(events), 1)
+        self.assertEqual(events[0].route_kind, "donation-verify")
+
+    def test_failed_pricing_degrades_and_is_not_retried(self):
+        telemetry.enable()
+        calls = []
+
+        class Broken:
+            def lower(self, *args):
+                calls.append(args)
+                raise RuntimeError("no cost model on this backend")
+
+        x = jnp.ones((4,), jnp.float32)
+        self.assertIsNone(
+            perfscope.profile_program("engine_scan", Broken(), (x,))
+        )
+        self.assertIsNone(
+            perfscope.profile_program("engine_scan", Broken(), (x,))
+        )
+        self.assertEqual(len(calls), 1)  # gate holds failures too
+        self.assertEqual(ev.events("program_profile"), [])
+
+
+class TestRoofline(PerfscopeIsolation):
+    def test_unknown_kind_falls_back_conservatively(self):
+        peaks = roofline.device_peaks("TPU v99 imaginary")
+        self.assertFalse(peaks["exact"])
+        self.assertEqual(peaks["device_kind"], "TPU v99 imaginary")
+        self.assertEqual(
+            peaks["hbm_gbps"], roofline.device_peaks("cpu")["hbm_gbps"]
+        )
+
+    def test_register_device_peaks(self):
+        self.assertNotIn("test-kind", roofline.known_device_kinds())
+        roofline.register_device_peaks(
+            "test-kind", hbm_gbps=100.0, flops=1e12
+        )
+        try:
+            peaks = roofline.device_peaks("test-kind")
+            self.assertTrue(peaks["exact"])
+            self.assertEqual(peaks["hbm_gbps"], 100.0)
+        finally:
+            roofline._DEVICE_PEAKS.pop("test-kind", None)
+        with self.assertRaises(ValueError):
+            roofline.register_device_peaks("bad", hbm_gbps=0, flops=1e12)
+
+    def test_roofline_arithmetic(self):
+        peaks = {"device_kind": "x", "hbm_gbps": 100.0, "flops": 1e12}
+        roof = roofline.roofline(
+            flops=1e9, bytes_accessed=1e9, seconds=0.01, peaks=peaks
+        )
+        self.assertAlmostEqual(roof["achieved_gbps"], 100.0)
+        self.assertAlmostEqual(roof["hbm_pct"], 100.0)
+        self.assertAlmostEqual(roof["achieved_gflops"], 100.0)
+        self.assertAlmostEqual(roof["flops_pct"], 10.0)
+        self.assertEqual(roof["bound"], "bandwidth")
+        self.assertAlmostEqual(roof["device_seconds_floor"], 0.01)
+
+    def test_reread_multiplier_edges(self):
+        self.assertEqual(roofline.reread_multiplier(1000.0, 0.0), 0.0)
+        self.assertAlmostEqual(roofline.reread_multiplier(500.0, 100.0), 5.0)
+
+
+class TestSloRules(PerfscopeIsolation):
+    def test_rule_validation(self):
+        with self.assertRaises(ValueError):
+            perfscope.SloRule("r", "retrace_total", ">=", 1.0)
+        with self.assertRaises(ValueError):
+            perfscope.SloRule("r", "no_such_metric", ">", 1.0)
+
+    def test_evaluate_fires_alert_events(self):
+        telemetry.enable()
+        for _ in range(5):
+            ev.record_retrace("slo-test")
+        rules = (
+            perfscope.SloRule(
+                "retrace_storm", "retrace_total", ">", 3.0, "too churny"
+            ),
+        )
+        fired = perfscope.evaluate_slo(rules)
+        self.assertEqual(len(fired), 1)
+        self.assertEqual(fired[0]["rule"], "retrace_storm")
+        self.assertEqual(fired[0]["value"], 5.0)
+        alerts = ev.aggregates()["alerts"]
+        self.assertEqual(alerts["retrace_storm"]["count"], 1)
+        self.assertIn("too churny", alerts["retrace_storm"]["message"])
+        self.assertIn(
+            'torcheval_tpu_alerts_total{rule="retrace_storm"} 1',
+            export.prometheus_text(),
+        )
+
+    def test_floor_rules_skip_missing_signal(self):
+        telemetry.enable()
+        rules = (
+            perfscope.SloRule(
+                "floor", "throughput_batches_per_sec", "<", 1e9
+            ),
+        )
+        # No engine block has run -> the signal is 0.0 -> no fire.
+        self.assertEqual(perfscope.evaluate_slo(rules), [])
+
+    def test_default_rules_floors_opt_in(self):
+        names = {r.name for r in perfscope.default_rules()}
+        self.assertEqual(
+            names,
+            {
+                "retrace_storm",
+                "prefetch_starved",
+                "sync_imbalance",
+                "data_corrupt",
+            },
+        )
+        names = {
+            r.name
+            for r in perfscope.default_rules(
+                throughput_floor=10.0, roofline_floor_pct=1.0
+            )
+        }
+        self.assertIn("throughput_floor", names)
+        self.assertIn("roofline_floor", names)
+
+    def test_evaluator_runs_slo_every_n_blocks(self):
+        from torcheval_tpu.engine import Evaluator
+
+        telemetry.enable()
+        perfscope.enable(
+            rules=(
+                perfscope.SloRule(
+                    "always",
+                    "prefetch_stall_ratio",
+                    ">",
+                    -1.0,
+                    "fires every evaluation",
+                ),
+            ),
+            slo_every_blocks=1,
+        )
+        Evaluator(_collection(), block_size=4, prefetch=False).run(
+            _stream((16,) * 8)
+        ).result()
+        alerts = ev.aggregates()["alerts"]
+        self.assertIn("always", alerts)
+        self.assertGreaterEqual(alerts["always"]["count"], 1)
+
+    def test_enable_rejects_bad_interval(self):
+        with self.assertRaises(ValueError):
+            perfscope.enable(slo_every_blocks=0)
+
+
+class TestServePrometheus(PerfscopeIsolation):
+    def test_scrape_and_404(self):
+        telemetry.enable()
+        ev.record_alert("scrape_rule", 2.0, 1.0, "served")
+        server = telemetry.serve_prometheus(port=0)
+        try:
+            base = f"http://127.0.0.1:{server.server_port}"
+            with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+                body = r.read().decode("utf-8")
+            self.assertIn(
+                'torcheval_tpu_alerts_total{rule="scrape_rule"} 1', body
+            )
+            with self.assertRaises(urllib.error.HTTPError) as ctx:
+                urllib.request.urlopen(f"{base}/nope", timeout=5)
+            self.assertEqual(ctx.exception.code, 404)
+        finally:
+            server.shutdown()
+
+
+class TestMergedTrace(PerfscopeIsolation):
+    def test_merged_trace_is_schema_valid(self):
+        """The merged host+device file must satisfy the same Perfetto
+        schema invariants test_fleet.py asserts on to_perfetto()."""
+        telemetry.enable()
+        with tempfile.TemporaryDirectory() as td:
+            with telemetry.profile(td) as capture:
+                ev.record_span("update", "BinaryAccuracy", 0.002, 64)
+                ev.record_sync("all_gather_object", 0.010, 128)
+                jnp.sum(jnp.ones((32, 32))).block_until_ready()
+            self.assertIsNotNone(capture["merged"])
+            self.assertGreaterEqual(capture["events"], 2)
+            with open(capture["merged"], "r", encoding="utf-8") as fh:
+                trace = json.load(fh)
+        rows = trace["traceEvents"]
+        meta = [
+            r
+            for r in rows
+            if r.get("ph") == "M" and r.get("name") == "process_name"
+        ]
+        host_pid = next(
+            r["pid"]
+            for r in meta
+            if r["args"]["name"] == "torcheval_tpu telemetry"
+        )
+        # The merged-in host rows must satisfy the same Perfetto schema
+        # invariants as to_perfetto() output (device rows keep whatever
+        # shape the profiler wrote them in).
+        host_rows = [r for r in rows if r.get("pid") == host_pid]
+        self.assertTrue(host_rows)
+        for row in host_rows:
+            self.assertIn(row["ph"], {"M", "X", "i"})
+            self.assertIsInstance(row["pid"], int)
+            self.assertIsInstance(row["tid"], int)
+            if row["ph"] == "X":
+                self.assertGreaterEqual(row["ts"], 0.0)
+                self.assertGreaterEqual(row["dur"], 0.0)
+                self.assertTrue(row["name"])
+            elif row["ph"] == "i":
+                self.assertEqual(row["s"], "t")
+        x_names = {r["name"] for r in host_rows if r["ph"] == "X"}
+        self.assertIn("BinaryAccuracy.update", x_names)
+        # When a device trace landed, the host rows live on their own
+        # pid above every device pid.
+        if capture["device_trace"] is not None:
+            device_pids = {
+                int(r["pid"])
+                for r in rows
+                if isinstance(r.get("pid"), int) and r["pid"] != host_pid
+            }
+            if device_pids:
+                self.assertGreater(host_pid, max(device_pids))
+
+
+class TestJsonlRoundTrip(PerfscopeIsolation):
+    def test_perf_and_alert_events_round_trip(self):
+        telemetry.enable()
+        ev.record_program_profile(
+            program="fused_collection",
+            flops=1000,
+            bytes_accessed=4096,
+            peak_bytes=2048,
+            temp_bytes=512,
+            argument_bytes=1024,
+            output_bytes=256,
+            batch_bytes=1024,
+            donated=True,
+            aliased=False,
+        )
+        ev.record_alert("rt_rule", 5.0, 3.0, "round trip")
+        before = ev.aggregates()
+        buf = io.StringIO()
+        telemetry.export_jsonl(buf)
+        buf.seek(0)
+        loaded = telemetry.read_jsonl(buf, strict=False)
+        self.assertEqual(
+            [e.kind for e in loaded], ["program_profile", "alert"]
+        )
+        telemetry.clear()
+        telemetry.enable()
+        for event in loaded:
+            ev.emit(event)
+        after = ev.aggregates()
+        self.assertEqual(after["perf"], before["perf"])
+        self.assertEqual(after["alerts"], before["alerts"])
+        self.assertEqual(
+            after["perf"]["fused_collection"]["bytes_accessed"], 4096
+        )
+
+
+class TestCLI(PerfscopeIsolation):
+    def _main(self, argv):
+        from torcheval_tpu.telemetry.__main__ import main
+
+        out = io.StringIO()
+        err = io.StringIO()
+        with contextlib.redirect_stdout(out), contextlib.redirect_stderr(
+            err
+        ):
+            code = main(argv)
+        return code, out.getvalue(), err.getvalue()
+
+    def _write_dump(self, td, *, with_alert):
+        telemetry.enable()
+        ev.record_program_profile(
+            program="fused_collection",
+            flops=100,
+            bytes_accessed=800,
+            peak_bytes=400,
+            temp_bytes=0,
+            argument_bytes=300,
+            output_bytes=100,
+            batch_bytes=200,
+            donated=False,
+            aliased=False,
+        )
+        if with_alert:
+            ev.record_alert("cli_rule", 9.0, 1.0, "breached in CI")
+        path = os.path.join(td, "report.jsonl")
+        telemetry.export_jsonl(path)
+        telemetry.disable()
+        telemetry.clear()
+        return path
+
+    def test_alerts_fired_exits_nonzero(self):
+        with tempfile.TemporaryDirectory() as td:
+            dump = self._write_dump(td, with_alert=True)
+            code, out, _ = self._main([dump, "--alerts"])
+        self.assertEqual(code, 1)
+        self.assertIn("cli_rule", out)
+        self.assertIn("breached in CI", out)
+
+    def test_no_alerts_exits_zero(self):
+        with tempfile.TemporaryDirectory() as td:
+            dump = self._write_dump(td, with_alert=False)
+            code, out, _ = self._main([dump, "--alerts"])
+        self.assertEqual(code, 0)
+        self.assertIn("no alerts fired", out)
+
+    def test_missing_file_exits_two(self):
+        code, _, err = self._main(
+            ["/nonexistent/report.jsonl", "--alerts"]
+        )
+        self.assertEqual(code, 2)
+        self.assertIn("cannot read report", err)
+
+    def test_unknown_kind_skipped_with_warning(self):
+        with tempfile.TemporaryDirectory() as td:
+            dump = self._write_dump(td, with_alert=False)
+            with open(dump, "a", encoding="utf-8") as fh:
+                fh.write(
+                    json.dumps({"kind": "from_the_future", "zap": 1})
+                )
+                fh.write("\n")
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                code, out, _ = self._main([dump, "--perf"])
+        self.assertEqual(code, 0)
+        self.assertIn("fused_collection", out)
+        self.assertTrue(
+            any("unknown kind" in str(w.message) for w in caught)
+        )
+
+
+class TestToolsSatellites(PerfscopeIsolation):
+    def test_peak_memory_of(self):
+        from torcheval_tpu.tools.flops import peak_memory_of
+
+        peak = peak_memory_of(
+            lambda x: jnp.sum(x * 2.0), jnp.ones((128,), jnp.float32)
+        )
+        self.assertGreater(peak, 0)
+
+    def test_spmd_cache_info_carries_peak_bytes(self):
+        from torcheval_tpu.parallel import spmd_cache_info
+
+        info = spmd_cache_info()
+        self.assertEqual(info.peak_bytes, 0)
+        telemetry.enable()
+        ev.record_program_profile(
+            program="spmd:binary_hist_counts",
+            flops=10,
+            bytes_accessed=100,
+            peak_bytes=12345,
+            temp_bytes=0,
+            argument_bytes=80,
+            output_bytes=20,
+            batch_bytes=80,
+            donated=False,
+            aliased=False,
+        )
+        self.assertEqual(spmd_cache_info().peak_bytes, 12345)
+
+
+if __name__ == "__main__":
+    unittest.main()

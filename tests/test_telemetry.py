@@ -162,6 +162,26 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         m = BinaryAccuracy()
         m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
         m.compute()
+        # program_profile / alert — the perfscope pricing and SLO hooks
+        # (the retrace recorded above makes the rule fire).
+        import jax
+
+        from torcheval_tpu.telemetry import perfscope
+
+        perfscope._seen.discard(("rt-perfscope", None))
+        perfscope.profile_program(
+            "rt-perfscope",
+            jax.jit(lambda x: x * 2.0),
+            (jnp.ones((4,), jnp.float32),),
+            batch_args=(jnp.ones((4,), jnp.float32),),
+        )
+        perfscope.evaluate_slo(
+            (
+                perfscope.SloRule(
+                    "rt-alert", "retrace_total", ">", 0.0, "round trip"
+                ),
+            )
+        )
 
     def test_every_kind_round_trips(self):
         self._generate_all_kinds()

@@ -56,6 +56,7 @@ from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.resilience.checkpoint import CheckpointManager
 from torcheval_tpu.telemetry import events as _telemetry
 from torcheval_tpu.telemetry import health as _health
+from torcheval_tpu.telemetry import perfscope as _perfscope
 
 __all__ = ["Evaluator", "Prefetcher", "ScanRunner"]
 
@@ -468,6 +469,8 @@ class Evaluator:
                 bounds=runner.bounds,
                 steps=block.batches,
             )
+        if _perfscope.ENABLED:
+            _perfscope.maybe_evaluate_slo(self.blocks_dispatched)
         self._maybe_snapshot()
         self._maybe_checkpoint()
 

@@ -30,6 +30,7 @@ from torcheval_tpu.ops import _flags
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
 from torcheval_tpu.telemetry import health as _health
+from torcheval_tpu.telemetry import perfscope as _perfscope
 
 
 def _build_apply(
@@ -140,6 +141,20 @@ class ScanRunner:
         else:
             new_states, stats = out, None
         col._install_states(new_states)
+        if _perfscope.ENABLED:
+            # See the fused_update hook: the shadow re-trace leaves
+            # tracer attrs on the live members — re-install the concrete
+            # states whenever pricing actually ran (once per signature).
+            profiled = _perfscope.profile_program(
+                "engine_scan",
+                self._apply,
+                (before, stacked_args, stacked_mask),
+                batch_args=(stacked_args, stacked_mask),
+                donate=self._donate,
+                signature=(key, self._donate, self._health),
+            )
+            if profiled is not None:
+                col._install_states(new_states)
         return stats
 
 

@@ -23,6 +23,7 @@ from torcheval_tpu.metrics.metric import Metric, _move_state
 from torcheval_tpu.ops import _flags
 from torcheval_tpu.telemetry import events as _telemetry
 from torcheval_tpu.telemetry import health as _health
+from torcheval_tpu.telemetry import perfscope as _perfscope
 
 
 def _call_signature(args: Tuple[Any, ...], kwargs: Dict[str, Any]) -> Any:
@@ -237,6 +238,22 @@ class MetricCollection:
         else:
             new_states, health_stats = out, None
         self._install_states(new_states)
+        if _perfscope.ENABLED:
+            # Priced once per (signature, build flags); the steady state
+            # pays one set lookup.  Shadow lowering works from avals, so
+            # donated-and-deleted `before` entries are fine — but the
+            # re-trace setattrs tracers onto the live members, so the
+            # concrete states must be re-installed when pricing ran.
+            profiled = _perfscope.profile_program(
+                "fused_collection",
+                self._fused_apply,
+                (before, args, kwargs),
+                batch_args=(args, kwargs),
+                donate=donate,
+                signature=(key, donate, health),
+            )
+            if profiled is not None:
+                self._install_states(new_states)
         if _telemetry.ENABLED:
             _telemetry.record_span(
                 "update",

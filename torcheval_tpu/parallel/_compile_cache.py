@@ -19,10 +19,24 @@ diffed around the memoized call; disabled, the lookup is the bare
 from __future__ import annotations
 
 from functools import lru_cache
+from typing import NamedTuple
 
 from jax.sharding import Mesh
 
 from torcheval_tpu.telemetry import events as _telemetry
+
+
+class SpmdCacheInfo(NamedTuple):
+    """``functools.CacheInfo`` plus the memory footprint of the cached
+    programs: ``peak_bytes`` is the largest ``memory_analysis()`` peak
+    perfscope priced across the ``spmd:*`` programs (0 until perfscope
+    has profiled one — enable with ``TORCHEVAL_TPU_PERFSCOPE=1``)."""
+
+    hits: int
+    misses: int
+    maxsize: int
+    currsize: int
+    peak_bytes: int = 0
 
 
 @lru_cache(maxsize=256)
@@ -47,14 +61,23 @@ compiled_spmd.cache_info = _compiled_spmd_cached.cache_info
 compiled_spmd.cache_clear = _compiled_spmd_cached.cache_clear
 
 
-def spmd_cache_info():
+def spmd_cache_info() -> SpmdCacheInfo:
     """Hit/miss counters of the shared sharded-program memoizer — a
-    ``functools.CacheInfo`` ``(hits, misses, maxsize, currsize)``.  A
-    steady-state eval loop should show hits climbing and misses flat;
-    climbing misses mean program churn (e.g. rebuilding meshes per step,
-    which keys a fresh entry every call).  Surfaced by
+    :class:`SpmdCacheInfo` ``(hits, misses, maxsize, currsize,
+    peak_bytes)``.  A steady-state eval loop should show hits climbing
+    and misses flat; climbing misses mean program churn (e.g. rebuilding
+    meshes per step, which keys a fresh entry every call).
+    ``peak_bytes`` reports the largest perfscope-priced memory peak
+    among the cached programs.  Surfaced by
     :func:`torcheval_tpu.routing.hot_path_stats`."""
-    return _compiled_spmd_cached.cache_info()
+    info = _compiled_spmd_cached.cache_info()
+    peak = 0
+    for program, entry in _telemetry.aggregates()["perf"].items():
+        if program.startswith("spmd:"):
+            peak = max(peak, entry["peak_bytes"])
+    return SpmdCacheInfo(
+        info.hits, info.misses, info.maxsize, info.currsize, peak
+    )
 
 
 def spmd_cache_clear() -> None:

@@ -34,6 +34,7 @@ from torcheval_tpu.parallel._compile_cache import compiled_spmd
 from torcheval_tpu.parallel.mesh import AxisSpec, _axis_size
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import perfscope as _perfscope
 
 Reduction = Union[str, Any]  # 'sum' | 'max' | 'min' | 'mean' | 'concat' | pytree
 
@@ -56,11 +57,27 @@ def _reduce_leaf(value: jax.Array, how: str, axis: str) -> jax.Array:
 
 
 def _timed_dispatch(fn, op: str, payload_bytes: int, *args):
-    """Telemetry-on dispatch wrapper for the sharded histogram programs:
-    wall time (blocked to completion — the collective rides inside the
-    program, so this bounds it from above) plus the merge's wire payload
-    estimate, emitted as ONE ``sync`` event.  Callers branch on
-    ``_telemetry.ENABLED`` so the disabled path stays a bare call."""
+    """Instrumented dispatch wrapper for the sharded histogram programs.
+    With the telemetry bus on: wall time (blocked to completion — the
+    collective rides inside the program, so this bounds it from above)
+    plus the merge's wire payload estimate, emitted as ONE ``sync``
+    event.  With perfscope on: the program is priced once per argument
+    signature (``spmd:<op>``).  Callers branch on ``_telemetry.ENABLED
+    or _perfscope.ENABLED`` so the fully-disabled path stays a bare
+    call."""
+    if _perfscope.ENABLED:
+        _perfscope.profile_program(
+            f"spmd:{op}",
+            fn,
+            args,
+            batch_args=args,
+            signature=tuple(
+                (tuple(leaf.shape), str(leaf.dtype))
+                for leaf in jax.tree.leaves(args)
+            ),
+        )
+    if not _telemetry.ENABLED:
+        return fn(*args)
     t0 = time.monotonic()
     out = fn(*args)
     jax.block_until_ready(out)
@@ -591,7 +608,7 @@ def _run_sharded_binary(
         fn = compiled_spmd(
             _build_hist_spmd, (counts_builder, (num_bins, route)), mesh, axis
         )
-        if _telemetry.ENABLED:
+        if _telemetry.ENABLED or _perfscope.ENABLED:
             # Wire payload of the psum merge: 2 × num_bins f32 counters.
             return _timed_dispatch(
                 fn, "binary_hist_counts", 2 * num_bins * 4, scores, targets
@@ -612,7 +629,7 @@ def _run_sharded_binary(
                 mesh,
                 axis,
             )
-            if _telemetry.ENABLED:
+            if _telemetry.ENABLED or _perfscope.ENABLED:
                 return _timed_dispatch(
                     fn,
                     "binary_hist_wcounts",
@@ -627,7 +644,7 @@ def _run_sharded_binary(
     fn = compiled_spmd(
         _build_hist_spmd, (weighted_builder, (num_bins,)), mesh, axis
     )
-    if _telemetry.ENABLED:
+    if _telemetry.ENABLED or _perfscope.ENABLED:
         return _timed_dispatch(
             fn, "binary_hist_scatter", 2 * num_bins * 4, scores, targets, weights
         )
@@ -802,7 +819,7 @@ def sharded_multiclass_auroc_histogram(
         fn = compiled_spmd(
             _build_hist_spmd, (builder, statics), mesh, axis
         )
-        if _telemetry.ENABLED:
+        if _telemetry.ENABLED or _perfscope.ENABLED:
             # psum payload: (C, 2 × num_bins) f32 per-class counters.
             return _timed_dispatch(
                 fn,
@@ -820,7 +837,7 @@ def sharded_multiclass_auroc_histogram(
         mesh,
         axis,
     )
-    if _telemetry.ENABLED:
+    if _telemetry.ENABLED or _perfscope.ENABLED:
         return _timed_dispatch(
             fn,
             "multiclass_hist_counts",

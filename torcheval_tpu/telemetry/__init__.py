@@ -22,7 +22,13 @@ module flag — see :mod:`torcheval_tpu.telemetry.events`).  Enable with
   batches) fused into the update programs, reported here under
   ``data_health``;
 * :func:`to_perfetto` — the span stream as Chrome/Perfetto trace-event
-  JSON for ``ui.perfetto.dev``.
+  JSON for ``ui.perfetto.dev``;
+* :mod:`~torcheval_tpu.telemetry.perfscope` — live roofline accounting
+  over the compiled hot-path programs: :func:`explain_perf` (achieved
+  GB/s / GFLOP/s vs device peaks, reread multiplier, donation
+  verification), :func:`profile` (one merged host+device Perfetto
+  trace), SLO alert rules, and :func:`serve_prometheus` (live pull
+  endpoint).
 
 Example::
 
@@ -45,13 +51,14 @@ from __future__ import annotations
 
 from typing import Any, Dict, Union
 
-from torcheval_tpu.telemetry import aggregate, events, export, health
+from torcheval_tpu.telemetry import aggregate, events, export, health, perfscope
 from torcheval_tpu.telemetry.aggregate import (
     fleet_report,
     host_snapshot,
     merge_snapshots,
 )
 from torcheval_tpu.telemetry.events import (
+    AlertEvent,
     BucketPadEvent,
     CacheEvent,
     CheckpointEvent,
@@ -61,6 +68,7 @@ from torcheval_tpu.telemetry.events import (
     EngineBlockEvent,
     Event,
     PrefetchStallEvent,
+    ProgramProfileEvent,
     RetraceEvent,
     RetryEvent,
     RouteDowngradeEvent,
@@ -78,11 +86,19 @@ from torcheval_tpu.telemetry.export import (
     event_to_dict,
     export_jsonl,
     fleet_to_perfetto,
+    format_explain_perf,
     format_fleet_report,
     format_report,
     prometheus_text,
     read_jsonl,
+    serve_prometheus,
     to_perfetto,
+)
+from torcheval_tpu.telemetry.perfscope import (
+    SloRule,
+    default_rules,
+    explain_perf,
+    profile,
 )
 
 # Re-export the snapshot accessor under its natural name without shadowing
@@ -231,12 +247,23 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         "events_dropped": events.dropped(),
         "ring_capacity": events.capacity(),
     }
+    if agg["perf"]:
+        perf = explain_perf()
+        result["perf"] = {
+            "device_kind": perf["device_kind"],
+            "routes": perf["routes"],
+        }
+    if agg["alerts"]:
+        result["alerts"] = {
+            rule: dict(entry) for rule, entry in agg["alerts"].items()
+        }
     if as_text:
         return format_report(result)
     return result
 
 
 __all__ = [
+    "AlertEvent",
     "BucketPadEvent",
     "CacheEvent",
     "CheckpointEvent",
@@ -246,13 +273,16 @@ __all__ = [
     "EngineBlockEvent",
     "Event",
     "PrefetchStallEvent",
+    "ProgramProfileEvent",
     "RetraceEvent",
     "RetryEvent",
     "RouteDowngradeEvent",
+    "SloRule",
     "SpanEvent",
     "SyncEvent",
     "aggregate",
     "clear",
+    "default_rules",
     "disable",
     "emit",
     "enable",
@@ -261,17 +291,22 @@ __all__ = [
     "event_to_dict",
     "events",
     "events_snapshot",
+    "explain_perf",
     "export",
     "export_jsonl",
     "fleet_report",
     "fleet_to_perfetto",
+    "format_explain_perf",
     "format_fleet_report",
     "format_report",
     "health",
     "host_snapshot",
     "merge_snapshots",
+    "perfscope",
+    "profile",
     "prometheus_text",
     "read_jsonl",
     "report",
+    "serve_prometheus",
     "to_perfetto",
 ]

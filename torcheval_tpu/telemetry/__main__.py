@@ -3,10 +3,13 @@ JSON-lines telemetry dump offline.
 
 Default output is the human-readable health summary
 (:func:`torcheval_tpu.telemetry.report` text); ``--prometheus`` prints
-the text-format counter snapshot instead, and ``--perfetto out.json``
-writes a Chrome/Perfetto trace for ``ui.perfetto.dev``.  Dumps written
-by newer library versions load fine — unknown event kinds are skipped
-with a counted warning (``export.read_jsonl``).
+the text-format counter snapshot instead, ``--perfetto out.json``
+writes a Chrome/Perfetto trace for ``ui.perfetto.dev``, ``--perf``
+prints the perfscope roofline table, and ``--alerts`` renders the fired
+SLO rules and exits nonzero when any fired (CI gate: pipe an eval run's
+dump through ``--alerts`` to fail the job on an SLO breach).  Dumps
+written by newer library versions load fine — unknown event kinds are
+skipped with a counted warning (``export.read_jsonl``).
 """
 
 from __future__ import annotations
@@ -35,12 +38,30 @@ def main(argv: Optional[List[str]] = None) -> int:
         metavar="OUT.json",
         help="write a Chrome/Perfetto trace-event JSON file instead",
     )
+    parser.add_argument(
+        "--perf",
+        action="store_true",
+        help="print the perfscope per-route roofline table instead",
+    )
+    parser.add_argument(
+        "--alerts",
+        action="store_true",
+        help="render fired SLO alert rules; exit 1 when any fired "
+        "(for CI consumption)",
+    )
     args = parser.parse_args(argv)
 
     from torcheval_tpu.telemetry import events as ev
     from torcheval_tpu.telemetry import export
 
-    loaded = export.read_jsonl(args.report)
+    try:
+        loaded = export.read_jsonl(args.report)
+    except OSError as exc:
+        print(
+            f"error: cannot read report {args.report!r}: {exc}",
+            file=sys.stderr,
+        )
+        return 2
 
     # Replay into a private bus sized to hold everything: re-emitting
     # rebuilds the exact aggregates (they are pure folds of the events),
@@ -52,6 +73,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     for event in loaded:
         ev.emit(event)
 
+    if args.alerts:
+        alerts = ev.aggregates()["alerts"]
+        if not alerts:
+            print("no alerts fired")
+            return 0
+        total = sum(entry["count"] for entry in alerts.values())
+        print(f"{total} alert(s) fired across {len(alerts)} rule(s):")
+        for rule, entry in sorted(alerts.items()):
+            print(
+                f"  {rule}: {entry['count']}x "
+                f"(last value {entry['value']:.4g} vs threshold "
+                f"{entry['threshold']:.4g}) — {entry['message']}"
+            )
+        return 1
     if args.perfetto:
         trace = export.to_perfetto(loaded)
         with open(args.perfetto, "w", encoding="utf-8") as fh:
@@ -62,6 +97,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
     elif args.prometheus:
         sys.stdout.write(export.prometheus_text())
+    elif args.perf:
+        import torcheval_tpu.telemetry as telemetry
+
+        sys.stdout.write(telemetry.explain_perf(as_text=True))
     else:
         import torcheval_tpu.telemetry as telemetry
 

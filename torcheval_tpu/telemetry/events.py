@@ -249,6 +249,44 @@ class CheckpointEvent(Event):
 
 
 @dataclass
+class ProgramProfileEvent(Event):
+    """One compiled hot-path program priced by XLA at a build site
+    (:mod:`torcheval_tpu.telemetry.perfscope`): ``cost_analysis()``
+    flops / bytes-accessed, ``memory_analysis()`` peak/temp/argument/
+    output bytes, the batch payload bytes of the profiled call (so the
+    reread multiplier ``bytes_accessed / batch_bytes`` is derivable),
+    and the donation verification verdict (``donated`` requested vs
+    ``aliased`` actually present in the program)."""
+
+    kind: str = field(init=False, default="program_profile")
+    program: str = ""  # "fused_collection" | "engine_scan" | "spmd:<op>"
+    flops: int = 0
+    bytes_accessed: int = 0
+    peak_bytes: int = 0
+    temp_bytes: int = 0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    batch_bytes: int = 0
+    donated: bool = False
+    aliased: bool = False
+
+
+@dataclass
+class AlertEvent(Event):
+    """One SLO rule violation from the perfscope alert evaluator
+    (:func:`torcheval_tpu.telemetry.perfscope.evaluate_slo`): the rule
+    name, the observed value vs its threshold, and the rendered
+    message.  Fired every evaluation interval the rule stays violated
+    — ``alerts_total{rule=...}`` counts re-fires."""
+
+    kind: str = field(init=False, default="alert")
+    rule: str = ""
+    value: float = 0.0
+    threshold: float = 0.0
+    message: str = ""
+
+
+@dataclass
 class SpanEvent(Event):
     """A timed metric phase (``update`` / ``compute`` / ``dispatch``)
     with the metric's state-memory footprint after the phase."""
@@ -278,6 +316,8 @@ KIND_TO_CLASS: Dict[str, type] = {
     "retry": RetryEvent,
     "degraded": DegradedEvent,
     "checkpoint": CheckpointEvent,
+    "program_profile": ProgramProfileEvent,
+    "alert": AlertEvent,
 }
 
 
@@ -315,6 +355,14 @@ def _zero_aggregates() -> Dict[str, Any]:
             "degraded": {},
             "checkpoint": {},
         },
+        # Perfscope program accounting: program -> {"profiles": distinct
+        # compiled signatures priced, "flops"/"bytes_accessed"/
+        # "batch_bytes": sums over them, memory fields: max observed,
+        # "donated"/"aliased": last verdict}.
+        "perf": {},
+        # SLO alerting: rule -> {"count": fires, "value": last observed,
+        # "threshold": rule bound, "message": last rendered text}.
+        "alerts": {},
         "emitted": 0,
     }
 
@@ -418,6 +466,8 @@ def aggregates() -> Dict[str, Any]:
                     for k, v in _agg["resilience"]["checkpoint"].items()
                 },
             },
+            "perf": {k: dict(v) for k, v in _agg["perf"].items()},
+            "alerts": {k: dict(v) for k, v in _agg["alerts"].items()},
             "emitted": _agg["emitted"],
         }
 
@@ -525,6 +575,45 @@ def _fold(event: Event) -> None:
         entry["count"] += 1
         entry["seconds"] += event.seconds
         entry["nbytes"] = event.nbytes  # last observed payload size
+    elif isinstance(event, ProgramProfileEvent):
+        entry = _agg["perf"].setdefault(
+            event.program,
+            {
+                "profiles": 0,
+                "flops": 0,
+                "bytes_accessed": 0,
+                "batch_bytes": 0,
+                "peak_bytes": 0,
+                "temp_bytes": 0,
+                "argument_bytes": 0,
+                "output_bytes": 0,
+                "donated": False,
+                "aliased": False,
+            },
+        )
+        entry["profiles"] += 1
+        entry["flops"] += event.flops
+        entry["bytes_accessed"] += event.bytes_accessed
+        entry["batch_bytes"] += event.batch_bytes
+        entry["peak_bytes"] = max(entry["peak_bytes"], event.peak_bytes)
+        entry["temp_bytes"] = max(entry["temp_bytes"], event.temp_bytes)
+        entry["argument_bytes"] = max(
+            entry["argument_bytes"], event.argument_bytes
+        )
+        entry["output_bytes"] = max(
+            entry["output_bytes"], event.output_bytes
+        )
+        entry["donated"] = event.donated
+        entry["aliased"] = event.aliased
+    elif isinstance(event, AlertEvent):
+        entry = _agg["alerts"].setdefault(
+            event.rule,
+            {"count": 0, "value": 0.0, "threshold": 0.0, "message": ""},
+        )
+        entry["count"] += 1
+        entry["value"] = event.value
+        entry["threshold"] = event.threshold
+        entry["message"] = event.message
     elif isinstance(event, SpanEvent):
         entry = _agg["spans"].setdefault(
             (event.name, event.phase),
@@ -641,6 +730,47 @@ def record_checkpoint(
             generation=int(generation),
             nbytes=int(nbytes),
             seconds=float(seconds),
+        )
+    )
+
+
+def record_program_profile(
+    program: str,
+    flops: int,
+    bytes_accessed: int,
+    peak_bytes: int,
+    temp_bytes: int,
+    argument_bytes: int,
+    output_bytes: int,
+    batch_bytes: int,
+    donated: bool,
+    aliased: bool,
+) -> None:
+    emit(
+        ProgramProfileEvent(
+            program=program,
+            flops=int(flops),
+            bytes_accessed=int(bytes_accessed),
+            peak_bytes=int(peak_bytes),
+            temp_bytes=int(temp_bytes),
+            argument_bytes=int(argument_bytes),
+            output_bytes=int(output_bytes),
+            batch_bytes=int(batch_bytes),
+            donated=bool(donated),
+            aliased=bool(aliased),
+        )
+    )
+
+
+def record_alert(
+    rule: str, value: float, threshold: float, message: str
+) -> None:
+    emit(
+        AlertEvent(
+            rule=rule,
+            value=float(value),
+            threshold=float(threshold),
+            message=message,
         )
     )
 

@@ -503,7 +503,100 @@ def prometheus_text() -> str:
             f"{agg['spans'][(name, phase)]['state_bytes']}"
         )
 
+    if agg["perf"]:
+        out.append(
+            f"# HELP {_PREFIX}_program_flops_total XLA cost-analysis "
+            "FLOPs summed over priced signatures, by program "
+            "(perfscope)."
+        )
+        out.append(f"# TYPE {_PREFIX}_program_flops_total counter")
+        for program in sorted(agg["perf"]):
+            out.append(
+                f"{_PREFIX}_program_flops_total"
+                f"{_labels(program=program)} "
+                f"{agg['perf'][program]['flops']}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_program_bytes_accessed_total XLA "
+            "cost-analysis bytes-accessed summed over priced "
+            "signatures, by program (perfscope)."
+        )
+        out.append(
+            f"# TYPE {_PREFIX}_program_bytes_accessed_total counter"
+        )
+        for program in sorted(agg["perf"]):
+            out.append(
+                f"{_PREFIX}_program_bytes_accessed_total"
+                f"{_labels(program=program)} "
+                f"{agg['perf'][program]['bytes_accessed']}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_program_peak_bytes Largest "
+            "memory-analysis peak over priced signatures, by program."
+        )
+        out.append(f"# TYPE {_PREFIX}_program_peak_bytes gauge")
+        for program in sorted(agg["perf"]):
+            out.append(
+                f"{_PREFIX}_program_peak_bytes"
+                f"{_labels(program=program)} "
+                f"{agg['perf'][program]['peak_bytes']}"
+            )
+
+    out.append(
+        f"# HELP {_PREFIX}_alerts_total SLO rule violations recorded by "
+        "the perfscope alert evaluator, by rule."
+    )
+    out.append(f"# TYPE {_PREFIX}_alerts_total counter")
+    for rule in sorted(agg["alerts"]):
+        out.append(
+            f"{_PREFIX}_alerts_total{_labels(rule=rule)} "
+            f"{agg['alerts'][rule]['count']}"
+        )
+
     return "\n".join(out) + "\n"
+
+
+# ------------------------------------------------------------- pull endpoint
+def serve_prometheus(port: int = 9464, *, host: str = "127.0.0.1"):
+    """Serve :func:`prometheus_text` on ``http://host:port/metrics`` from
+    a stdlib ``http.server`` daemon thread — the pull endpoint that makes
+    a fleet of evaluators scrapeable live (point a Prometheus
+    ``scrape_config`` at each host).
+
+    Every scrape renders a fresh snapshot of the live aggregates; no
+    state is retained per request.  Returns the started server (its
+    ``server_port`` reports the bound port when ``port=0``); call
+    ``.shutdown()`` to stop it.  ``/`` answers 200 for liveness probes;
+    other paths 404.
+    """
+    import http.server
+    import threading
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802  (http.server naming)
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = prometheus_text().encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, format, *args):  # noqa: A002
+            pass  # scrapes must not spam the evaluator's stderr
+
+    server = http.server.ThreadingHTTPServer((host, port), _Handler)
+    thread = threading.Thread(
+        target=server.serve_forever,
+        name="torcheval-tpu-prometheus",
+        daemon=True,
+    )
+    thread.start()
+    return server
 
 
 # --------------------------------------------------------------------- report
@@ -617,11 +710,85 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"{entry['seconds'] * 1e3:.3f} ms total, "
                 f"state {entry['state_bytes']} B\n"
             )
+    perf = report.get("perf", {})
+    if perf.get("routes"):
+        buf.write(
+            f"  perfscope (device {perf.get('device_kind', '?')}):\n"
+        )
+        for program, route in sorted(perf["routes"].items()):
+            buf.write(f"    {_format_perf_route(program, route)}\n")
+    alerts = report.get("alerts", {})
+    if alerts:
+        buf.write("  ALERTS:\n")
+        for rule, entry in sorted(alerts.items()):
+            buf.write(
+                f"    {rule}: fired {entry['count']}x "
+                f"(last value {entry['value']:.4g} vs threshold "
+                f"{entry['threshold']:.4g})\n"
+            )
     buf.write(
         f"  events: {report.get('events_captured', 0)} captured, "
         f"{report.get('events_dropped', 0)} dropped "
         f"(ring capacity {report.get('ring_capacity', 0)})\n"
     )
+    return buf.getvalue()
+
+
+def _format_perf_route(program: str, route: Dict[str, Any]) -> str:
+    """One report line for a profiled route (shared by the telemetry
+    report and :func:`format_explain_perf`)."""
+    parts = [
+        f"{program}: {route['flops'] / 1e6:.3f} MFLOP, "
+        f"{route['bytes_accessed'] / 1e6:.3f} MB accessed"
+    ]
+    if route.get("reread_multiplier"):
+        parts.append(f"reread x{route['reread_multiplier']:.2f}")
+    if "achieved_gbps" in route:
+        parts.append(
+            f"{route['achieved_gbps']:.2f} GB/s "
+            f"({route['hbm_pct']:.2f}% HBM roof), "
+            f"{route['achieved_gflops']:.2f} GFLOP/s "
+            f"({route['flops_pct']:.2f}% compute roof), "
+            f"{route['bound']}-bound"
+        )
+        parts.append(
+            f"dispatch overhead "
+            f"{route['dispatch_overhead_seconds'] * 1e6:.1f} us/call "
+            f"({route['dispatch_overhead_pct']:.1f}% of wall) over "
+            f"{route['dispatches']} dispatches"
+        )
+    parts.append(f"peak {route['peak_bytes']} B (temp {route['temp_bytes']} B)")
+    if route.get("donated"):
+        verdict = "verified" if route.get("aliased") else "NOT ALIASED"
+        parts.append(f"donation {verdict}")
+    return "; ".join(parts)
+
+
+def format_explain_perf(result: Dict[str, Any]) -> str:
+    """Render :func:`torcheval_tpu.telemetry.explain_perf`'s dict as the
+    per-route roofline table."""
+    buf = io.StringIO()
+    peaks = result.get("peaks", {})
+    exact = "" if peaks.get("exact", True) else " (fallback peaks)"
+    buf.write(
+        f"torcheval_tpu perfscope — device {result.get('device_kind', '?')}"
+        f"{exact}: {peaks.get('hbm_gbps', 0.0):.0f} GB/s HBM, "
+        f"{peaks.get('flops', 0.0) / 1e12:.1f} TFLOP/s\n"
+    )
+    routes = result.get("routes", {})
+    if not routes:
+        buf.write(
+            "  no profiled programs — enable perfscope before dispatching "
+            "(TORCHEVAL_TPU_PERFSCOPE=1 or perfscope.enable())\n"
+        )
+    for program, route in sorted(routes.items()):
+        buf.write(f"  {_format_perf_route(program, route)}\n")
+    alerts = result.get("alerts", {})
+    for rule, entry in sorted(alerts.items()):
+        buf.write(
+            f"  ALERT {rule}: fired {entry['count']}x — "
+            f"{entry.get('message', '')}\n"
+        )
     return buf.getvalue()
 
 

@@ -1,0 +1,709 @@
+"""Perfscope: live roofline accounting, donation verification, unified
+device-trace capture, and SLO alerting for the eval hot path.
+
+``bench.py`` can say *offline* that a route sustains 0.1% of HBM peak;
+nothing in the library could say it *at runtime* — which is exactly the
+evidence the collection-megakernel and execution-plan ROADMAP items
+need.  XLA hands the numbers over for free: every jitted program carries
+``cost_analysis()`` (flops, bytes accessed) and ``memory_analysis()``
+(argument/output/temp/alias bytes).  This module prices each hot-path
+program ONCE per compiled signature at its build site and folds the
+result into the telemetry ring as a
+:class:`~torcheval_tpu.telemetry.events.ProgramProfileEvent`.
+
+Instrumented build sites (same one-branch ``if _perfscope.ENABLED:``
+zero-cost-when-off contract as the event bus, the health monitor, and
+the fault hooks — guarded empirically by
+``scripts/check_hot_path_overhead.py``):
+
+* ``MetricCollection.fused_update`` — program ``"fused_collection"``;
+* the engine scan block (``engine/scan.py``) — ``"engine_scan"``;
+* the SPMD sharded dispatches (``parallel/sync.py``) — ``"spmd:<op>"``.
+
+What you get out:
+
+* :func:`explain_perf` — the per-route report table: achieved GB/s and
+  GFLOP/s against the device-kind peak table
+  (:mod:`torcheval_tpu.tools.roofline`), the **reread multiplier**
+  (program bytes-accessed over batch bytes — the live megakernel
+  opportunity), dispatch overhead vs the bandwidth-floor device time,
+  and memory peaks.  Rendered in ``telemetry.report()``, the Prometheus
+  families, and the offline CLI.
+* **Donation verification** — when a program was built with donation
+  requested but XLA established no input-output aliasing (e.g. on CPU,
+  where donation is unusable), a ``route_downgrade``-style warning fires
+  through :func:`torcheval_tpu.routing.warn_route_downgrade` (kind
+  ``"donation-verify"``) and the profile records ``donated=True,
+  aliased=False``.
+* :func:`profile` — a context manager wrapping ``jax.profiler`` capture
+  around Evaluator blocks and clock-aligning the telemetry host spans
+  into the device Perfetto trace: one merged ``ui.perfetto.dev`` file
+  showing host dispatch gaps against device ops.
+* **SLO alerting** — declarative threshold rules
+  (:class:`SloRule` / :func:`default_rules`) evaluated every N Evaluator
+  blocks (:func:`maybe_evaluate_slo` from the engine dispatch loop, or
+  :func:`evaluate_slo` by hand), emitting
+  :class:`~torcheval_tpu.telemetry.events.AlertEvent`\\ s into the ring
+  and the ``alerts_total{rule=...}`` Prometheus family;
+  :func:`torcheval_tpu.telemetry.serve_prometheus` makes a fleet of
+  evaluators scrapeable live.
+
+Cost model: enabling perfscope costs one shadow
+``jit.lower(...).compile()`` per NEW program signature (absorbed by the
+persistent compile cache when configured) and a set lookup per dispatch
+on the steady state — measured under the 5% bar by the
+``perfscope_overhead_pct`` extra in ``benchmarks/workloads.py``.
+
+Example::
+
+    from torcheval_tpu.telemetry import perfscope
+
+    perfscope.enable(rules=perfscope.default_rules())
+    ... run the eval loop ...
+    print(telemetry.explain_perf(as_text=True))
+    with perfscope.profile("/tmp/trace") as capture:
+        evaluator.run(stream)
+    print(capture["merged"])   # one host+device Perfetto JSON
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from torcheval_tpu.telemetry import events as _events
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+# Module-level flag: hook sites read this as a plain attribute (the
+# one-branch zero-overhead contract, see events.ENABLED).
+ENABLED: bool = (
+    os.environ.get("TORCHEVAL_TPU_PERFSCOPE", "").lower() in _TRUTHY
+)
+
+# How many dispatched Evaluator blocks between SLO evaluations.
+DEFAULT_SLO_EVERY_BLOCKS = 8
+
+
+def _env_slo_every() -> int:
+    raw = os.environ.get("TORCHEVAL_TPU_PERFSCOPE_SLO_EVERY", "")
+    try:
+        n = int(raw)
+        return n if n > 0 else DEFAULT_SLO_EVERY_BLOCKS
+    except ValueError:
+        return DEFAULT_SLO_EVERY_BLOCKS
+
+
+SLO_EVERY_BLOCKS: int = _env_slo_every()
+
+# (program, signature) pairs already priced — the steady-state gate: a
+# hit costs one set lookup, and a failed pricing attempt is not retried
+# every dispatch.
+_seen: set = set()
+
+# Installed SLO rules; empty means the evaluator is a no-op.
+_rules: Tuple["SloRule", ...] = ()
+_last_slo_blocks: int = 0
+
+
+# ------------------------------------------------------------------- control
+def enable(
+    *,
+    rules: Optional[Tuple["SloRule", ...]] = None,
+    slo_every_blocks: Optional[int] = None,
+) -> None:
+    """Turn perfscope on (equivalently ``TORCHEVAL_TPU_PERFSCOPE=1``).
+    ``rules`` installs the SLO rule set (see :func:`default_rules`);
+    ``slo_every_blocks`` changes the evaluation interval."""
+    global ENABLED, SLO_EVERY_BLOCKS, _rules
+    if rules is not None:
+        _rules = tuple(rules)
+    if slo_every_blocks is not None:
+        if int(slo_every_blocks) < 1:
+            raise ValueError(
+                f"slo_every_blocks must be >= 1, got {slo_every_blocks}"
+            )
+        SLO_EVERY_BLOCKS = int(slo_every_blocks)
+    ENABLED = True
+
+
+def disable() -> None:
+    """Turn perfscope off — hook sites go back to one cold branch."""
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def reset() -> None:
+    """Drop the seen-signature gate, installed rules, and the SLO block
+    cursor (test isolation hook — profile events live in the telemetry
+    ring and are cleared by ``telemetry.clear()``)."""
+    global _rules, _last_slo_blocks
+    _seen.clear()
+    _rules = ()
+    _last_slo_blocks = 0
+
+
+def rules() -> Tuple["SloRule", ...]:
+    return _rules
+
+
+def install_rules(rules: Tuple["SloRule", ...]) -> None:
+    """Replace the installed SLO rule set (works before :func:`enable`)."""
+    global _rules
+    _rules = tuple(rules)
+
+
+# -------------------------------------------------------------- accounting
+def _aval_of(leaf: Any) -> Any:
+    """Shape/dtype aval for lowering — robust to donated-and-deleted
+    arrays (their metadata survives buffer deletion)."""
+    import jax
+
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return leaf
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _leaf_nbytes(leaf: Any) -> int:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * int(getattr(dtype, "itemsize", 0) or 0)
+
+
+def batch_nbytes(tree: Any) -> int:
+    """Total bytes of the array leaves of one batch pytree (the reread
+    denominator) — metadata-only, safe on deleted/donated arrays."""
+    import jax
+
+    return sum(_leaf_nbytes(leaf) for leaf in jax.tree.leaves(tree))
+
+
+def profile_program(
+    program: str,
+    jitted: Callable[..., Any],
+    args: Tuple[Any, ...],
+    *,
+    batch_args: Any = (),
+    donate: bool = False,
+    signature: Any = None,
+) -> Optional[Dict[str, Any]]:
+    """Price one hot-path program at its build site: ``cost_analysis``
+    flops / bytes-accessed, ``memory_analysis`` peaks, the batch payload
+    bytes, and the donation verification verdict — emitted as ONE
+    :class:`ProgramProfileEvent`.  Gated once per ``(program,
+    signature)``; the steady state pays a set lookup.  Only called from
+    hook sites after their ``ENABLED`` branch.
+
+    The pricing runs a shadow ``jitted.lower(avals).compile()`` — shape
+    work only, no execution, no device data touched — so a failure
+    (e.g. a backend without a cost model) degrades to a skipped profile,
+    never to a broken dispatch.  Returns the profile dict, or ``None``
+    on a gate hit / failed pricing.
+    """
+    key = (program, signature)
+    if key in _seen:
+        return None
+    _seen.add(key)  # failures are not retried every dispatch
+    try:
+        import warnings
+
+        import jax
+
+        from torcheval_tpu.tools.flops import (
+            memory_stats_of,
+            normalize_cost_analysis,
+        )
+
+        avals = jax.tree.map(_aval_of, args)
+        with warnings.catch_warnings():
+            # The shadow compile re-raises jax's "donated buffers were
+            # not usable" chatter; the REAL dispatch already surfaced
+            # it, and the verdict below reports it structurally.
+            warnings.simplefilter("ignore")
+            compiled = jitted.lower(*avals).compile()
+        cost = normalize_cost_analysis(compiled.cost_analysis())
+        memory = memory_stats_of(compiled)
+        aliased = memory["alias_bytes"] > 0
+        profile = {
+            "program": program,
+            "flops": int(cost.get("flops", 0) or 0),
+            "bytes_accessed": int(cost.get("bytes accessed", 0) or 0),
+            "peak_bytes": memory["peak_bytes"],
+            "temp_bytes": memory["temp_bytes"],
+            "argument_bytes": memory["argument_bytes"],
+            "output_bytes": memory["output_bytes"],
+            "batch_bytes": batch_nbytes(batch_args),
+            "donated": bool(donate),
+            "aliased": aliased,
+        }
+    except Exception:
+        return None
+    _events.record_program_profile(**profile)
+    if donate and not aliased:
+        # Donation was requested but the compiled program carries no
+        # input-output aliasing — the state-HBM-traffic halving the
+        # flag promises is NOT happening (expected on CPU, where
+        # donation is unusable; a real finding on TPU).
+        from torcheval_tpu.routing import warn_route_downgrade
+
+        warn_route_downgrade(
+            "donation-verify",
+            f"donation is on but the compiled {program!r} program has no "
+            "input-output aliasing — XLA could not donate the state "
+            "buffers (normal on CPU; on TPU check for dtype/layout "
+            "mismatches between old and new states).",
+        )
+    return profile
+
+
+# ------------------------------------------------------------ explain_perf
+# Program name -> the span aggregate key measuring its dispatch wall
+# clock ((name, phase) in agg["spans"]).
+_PROGRAM_TO_SPAN = {
+    "fused_collection": ("MetricCollection.fused", "update"),
+    "engine_scan": ("Evaluator", "engine_block"),
+}
+
+
+def explain_perf(
+    *, device_kind: Optional[str] = None, as_text: bool = False
+) -> Any:
+    """The per-route performance report: for every profiled program,
+    its cost/memory figures, the reread multiplier, and — when the
+    telemetry bus also captured dispatch spans — achieved GB/s and
+    GFLOP/s against the device peak table, roofline percentages, and
+    the dispatch-overhead split (measured wall clock per dispatch vs
+    the bandwidth-floor device time).
+
+    Returns a JSON-able dict (``as_text=True`` renders the table via
+    :func:`torcheval_tpu.telemetry.export.format_explain_perf`).
+    Cross-wired with :func:`torcheval_tpu.routing.explain_route`: that
+    explains which formulation a call WOULD take, this measures what
+    the taken formulations actually sustained.
+    """
+    from torcheval_tpu.tools import roofline as _roofline
+
+    peaks = _roofline.device_peaks(device_kind)
+    agg = _events.aggregates()
+    routes: Dict[str, Dict[str, Any]] = {}
+    for program, entry in sorted(agg["perf"].items()):
+        profiles = max(entry["profiles"], 1)
+        # Per-program means over the priced signatures: a program family
+        # (e.g. two bucket shapes) reports the average signature cost.
+        flops = entry["flops"] / profiles
+        nbytes = entry["bytes_accessed"] / profiles
+        batch = entry["batch_bytes"] / profiles
+        route: Dict[str, Any] = {
+            "profiles": entry["profiles"],
+            "flops": flops,
+            "bytes_accessed": nbytes,
+            "batch_bytes": batch,
+            "reread_multiplier": _roofline.reread_multiplier(nbytes, batch),
+            "peak_bytes": entry["peak_bytes"],
+            "temp_bytes": entry["temp_bytes"],
+            "argument_bytes": entry["argument_bytes"],
+            "output_bytes": entry["output_bytes"],
+            "donated": entry["donated"],
+            "aliased": entry["aliased"],
+        }
+        span = _span_for_program(program, agg)
+        if span is not None and span["calls"]:
+            wall = span["seconds"] / span["calls"]
+            roof = _roofline.roofline(
+                flops=flops, bytes_accessed=nbytes, seconds=wall, peaks=peaks
+            )
+            overhead = max(wall - roof["device_seconds_floor"], 0.0)
+            route.update(roof)
+            route.update(
+                {
+                    "dispatches": span["calls"],
+                    "wall_seconds_per_dispatch": wall,
+                    "dispatch_overhead_seconds": overhead,
+                    "dispatch_overhead_pct": 100.0 * overhead / wall
+                    if wall
+                    else 0.0,
+                }
+            )
+            if roof["hbm_pct"] < 1.0 and roof["flops_pct"] < 1.0:
+                route["bound"] = "dispatch"
+        routes[program] = route
+    result = {
+        "device_kind": peaks["device_kind"],
+        "peaks": peaks,
+        "routes": routes,
+        "alerts": {rule: dict(e) for rule, e in agg["alerts"].items()},
+    }
+    if as_text:
+        from torcheval_tpu.telemetry.export import format_explain_perf
+
+        return format_explain_perf(result)
+    return result
+
+
+def _span_for_program(
+    program: str, agg: Dict[str, Any]
+) -> Optional[Dict[str, Any]]:
+    """The wall-clock aggregate measuring ``program``'s dispatches:
+    a telemetry span for the fused/scan paths, the sync entry for
+    ``spmd:<op>`` programs (their dispatch wrapper times the collective
+    to completion)."""
+    if program.startswith("spmd:"):
+        return agg["sync"].get(program[len("spmd:"):])
+    key = _PROGRAM_TO_SPAN.get(program)
+    if key is None:
+        return None
+    return agg["spans"].get(key)
+
+
+# ------------------------------------------------------------- SLO alerting
+@dataclass(frozen=True)
+class SloRule:
+    """One declarative threshold rule: fire when ``metric``'s current
+    value compares ``op`` (``">"`` or ``"<"``) against ``threshold``.
+    ``metric`` names a builtin extractor (:data:`SLO_METRICS`)."""
+
+    name: str
+    metric: str
+    op: str
+    threshold: float
+    message: str = ""
+
+    def __post_init__(self) -> None:
+        if self.op not in (">", "<"):
+            raise ValueError(f"SloRule op must be '>' or '<', got {self.op!r}")
+        if self.metric not in SLO_METRICS:
+            raise ValueError(
+                f"unknown SLO metric {self.metric!r}; expected one of "
+                f"{sorted(SLO_METRICS)}"
+            )
+
+    def violated(self, value: float) -> bool:
+        return value > self.threshold if self.op == ">" else value < self.threshold
+
+
+def _metric_retrace_total(agg: Dict[str, Any]) -> float:
+    return float(sum(agg["retrace"].values()))
+
+
+def _metric_prefetch_stall_ratio(agg: Dict[str, Any]) -> float:
+    blocks = agg["engine"]["blocks"]
+    return agg["engine"]["prefetch_stalls"] / blocks if blocks else 0.0
+
+
+def _metric_sync_imbalance(agg: Dict[str, Any]) -> float:
+    """Single-host proxy for collective skew: the slowest op family's
+    mean seconds over the fastest's (cross-host skew lives in
+    ``fleet_report()['skew']``)."""
+    means = [
+        e["seconds"] / e["calls"]
+        for e in agg["sync"].values()
+        if e["calls"]
+    ]
+    if len(means) < 2 or min(means) <= 0:
+        return 1.0 if means else 0.0
+    return max(means) / min(means)
+
+
+def _metric_data_health_corrupt(agg: Dict[str, Any]) -> float:
+    from torcheval_tpu.telemetry.health import CORRUPT_CHECKS
+
+    return float(
+        sum(
+            entry["count"]
+            for (check, _metric), entry in agg["data_health"].items()
+            if check in CORRUPT_CHECKS
+        )
+    )
+
+
+def _metric_throughput(agg: Dict[str, Any]) -> float:
+    """Engine batches per second of measured block-dispatch wall clock
+    (0.0 until the first block span lands — floor rules skip then)."""
+    span = agg["spans"].get(("Evaluator", "engine_block"))
+    if span is None or span["seconds"] <= 0:
+        return 0.0
+    return agg["engine"]["batches"] / span["seconds"]
+
+
+def _metric_roofline_pct(agg: Dict[str, Any]) -> float:
+    """Best achieved HBM-roof percentage across profiled routes with
+    measured dispatches (0.0 until both sides exist)."""
+    best = 0.0
+    from torcheval_tpu.tools import roofline as _roofline
+
+    peaks = _roofline.device_peaks()
+    for program, entry in agg["perf"].items():
+        span = _span_for_program(program, agg)
+        if span is None or not span["calls"]:
+            continue
+        profiles = max(entry["profiles"], 1)
+        wall = span["seconds"] / span["calls"]
+        roof = _roofline.roofline(
+            flops=entry["flops"] / profiles,
+            bytes_accessed=entry["bytes_accessed"] / profiles,
+            seconds=wall,
+            peaks=peaks,
+        )
+        best = max(best, roof["hbm_pct"])
+    return best
+
+
+SLO_METRICS: Dict[str, Callable[[Dict[str, Any]], float]] = {
+    "retrace_total": _metric_retrace_total,
+    "prefetch_stall_ratio": _metric_prefetch_stall_ratio,
+    "sync_imbalance": _metric_sync_imbalance,
+    "data_health_corrupt": _metric_data_health_corrupt,
+    "throughput_batches_per_sec": _metric_throughput,
+    "roofline_hbm_pct": _metric_roofline_pct,
+}
+
+# Floor rules stay quiet until their signal exists at all (a throughput
+# floor cannot fire before the first measured block).
+_FLOOR_METRICS = frozenset(
+    {"throughput_batches_per_sec", "roofline_hbm_pct"}
+)
+
+
+def default_rules(
+    *,
+    retrace_max: float = 32,
+    prefetch_stall_ratio_max: float = 0.5,
+    sync_imbalance_max: float = 4.0,
+    data_health_corrupt_max: float = 0,
+    throughput_floor: float = 0.0,
+    roofline_floor_pct: float = 0.0,
+) -> Tuple[SloRule, ...]:
+    """A conservative starter rule set; floors default to 0 (disabled —
+    pass your workload's numbers).  See ``docs/source/perfscope.rst``
+    for the cookbook."""
+    out = [
+        SloRule(
+            "retrace_storm",
+            "retrace_total",
+            ">",
+            retrace_max,
+            "program (re)traces exceed the budget — the stream is "
+            "churning shapes (bucket it, or aot.warmup the sweep)",
+        ),
+        SloRule(
+            "prefetch_starved",
+            "prefetch_stall_ratio",
+            ">",
+            prefetch_stall_ratio_max,
+            "the dispatch loop is outrunning the prefetch thread on "
+            "most blocks — the host/H2D side is the bottleneck",
+        ),
+        SloRule(
+            "sync_imbalance",
+            "sync_imbalance",
+            ">",
+            sync_imbalance_max,
+            "collective op families differ widely in mean wall clock — "
+            "check fleet_report() skew for the slow host",
+        ),
+        SloRule(
+            "data_corrupt",
+            "data_health_corrupt",
+            ">",
+            data_health_corrupt_max,
+            "the data-health monitor found corrupt input "
+            "(NaN/Inf/label-range) — quarantine the feed",
+        ),
+    ]
+    if throughput_floor > 0:
+        out.append(
+            SloRule(
+                "throughput_floor",
+                "throughput_batches_per_sec",
+                "<",
+                throughput_floor,
+                "engine throughput fell under the floor",
+            )
+        )
+    if roofline_floor_pct > 0:
+        out.append(
+            SloRule(
+                "roofline_floor",
+                "roofline_hbm_pct",
+                "<",
+                roofline_floor_pct,
+                "no route sustains the HBM-utilization floor — the hot "
+                "path is dispatch/reread-bound",
+            )
+        )
+    return tuple(out)
+
+
+def evaluate_slo(
+    rules: Optional[Tuple[SloRule, ...]] = None,
+) -> List[Dict[str, Any]]:
+    """Evaluate ``rules`` (default: the installed set) against the
+    current aggregates; emit one :class:`AlertEvent` per violated rule.
+    Returns the fired findings."""
+    active = _rules if rules is None else tuple(rules)
+    if not active:
+        return []
+    agg = _events.aggregates()
+    fired: List[Dict[str, Any]] = []
+    for rule in active:
+        value = SLO_METRICS[rule.metric](agg)
+        if rule.metric in _FLOOR_METRICS and value == 0.0:
+            continue
+        if rule.violated(value):
+            message = (
+                f"{rule.message or rule.name}: {rule.metric}={value:.4g} "
+                f"{rule.op} {rule.threshold:.4g}"
+            )
+            _events.record_alert(rule.name, value, rule.threshold, message)
+            fired.append(
+                {
+                    "rule": rule.name,
+                    "value": value,
+                    "threshold": rule.threshold,
+                    "message": message,
+                }
+            )
+    return fired
+
+
+def maybe_evaluate_slo(blocks_dispatched: int) -> None:
+    """Engine hook: run the rule set every :data:`SLO_EVERY_BLOCKS`
+    dispatched blocks.  Only called after the ``ENABLED`` branch."""
+    global _last_slo_blocks
+    if not _rules:
+        return
+    if blocks_dispatched - _last_slo_blocks >= SLO_EVERY_BLOCKS:
+        _last_slo_blocks = blocks_dispatched
+        evaluate_slo()
+
+
+# ------------------------------------------------------- unified timeline
+@contextlib.contextmanager
+def profile(trace_dir: str, *, merged_name: str = "merged_trace.json"):
+    """Capture a ``jax.profiler`` device trace around the enclosed block
+    and merge the telemetry host spans into it on exit, clock-aligned,
+    as ONE Perfetto JSON (``<trace_dir>/<merged_name>``) — host dispatch
+    gaps and device ops on a single ``ui.perfetto.dev`` view.
+
+    Yields a dict filled at exit: ``"merged"`` (the merged trace path,
+    or ``None`` when no device trace landed — the merge then degrades
+    to host spans only), ``"device_trace"`` (the raw profiler artifact
+    found), and ``"events"`` (telemetry events merged).
+
+    Clock alignment: the device trace stamps microseconds relative to
+    profiler start; telemetry spans stamp ``time.monotonic()``.  Both
+    captures begin at the same instant here, so host timestamps are
+    shifted by ``min(device ts) - capture_start_monotonic``.
+    """
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    capture: Dict[str, Any] = {
+        "merged": None,
+        "device_trace": None,
+        "events": 0,
+    }
+    started = False
+    try:
+        try:
+            jax.profiler.start_trace(
+                trace_dir,
+                create_perfetto_link=False,
+                create_perfetto_trace=True,
+            )
+        except TypeError:  # older signature without the perfetto kwargs
+            jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception:
+        # A concurrent capture (or an unavailable profiler plugin)
+        # degrades to host-spans-only — the eval loop must never break.
+        pass
+    t0 = time.monotonic()
+    ring_start = len(_events.events())
+    try:
+        yield capture
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+        try:
+            _merge_trace(trace_dir, merged_name, t0, ring_start, capture)
+        except Exception:
+            pass
+
+
+def _find_device_trace(trace_dir: str) -> Optional[str]:
+    candidates = sorted(
+        glob.glob(
+            os.path.join(
+                trace_dir, "plugins", "profile", "*", "perfetto_trace.json.gz"
+            )
+        ),
+        key=os.path.getmtime,
+    )
+    return candidates[-1] if candidates else None
+
+
+def _merge_trace(
+    trace_dir: str,
+    merged_name: str,
+    t0: float,
+    ring_start: int,
+    capture: Dict[str, Any],
+) -> None:
+    from torcheval_tpu.telemetry.export import to_perfetto
+
+    device_rows: List[Dict[str, Any]] = []
+    display_unit = "ms"
+    path = _find_device_trace(trace_dir)
+    if path is not None:
+        capture["device_trace"] = path
+        with gzip.open(path, "rt", encoding="utf-8") as fh:
+            device = json.load(fh)
+        device_rows = device.get("traceEvents", [])
+        display_unit = device.get("displayTimeUnit", display_unit)
+
+    stamps = [r["ts"] for r in device_rows if "ts" in r]
+    # Device ts are µs since profiler start; shift host spans into that
+    # domain (no device trace -> host spans start at 0).
+    offset_us = (min(stamps) if stamps else 0.0) - t0 * 1e6
+    host_events = [
+        e for e in _events.events()[ring_start:] if e.time_s >= t0
+    ]
+    capture["events"] = len(host_events)
+    host_pid = (
+        max((int(r.get("pid", 0)) for r in device_rows), default=0) + 1
+    )
+    host = to_perfetto(
+        host_events, pid=host_pid, process_name="torcheval_tpu telemetry"
+    )
+    for row in host["traceEvents"]:
+        if "ts" in row:
+            row["ts"] = max(row["ts"] + offset_us, 0.0)
+    merged = {
+        "displayTimeUnit": display_unit,
+        "traceEvents": device_rows + host["traceEvents"],
+    }
+    out_path = os.path.join(trace_dir, merged_name)
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(merged, fh)
+    capture["merged"] = out_path

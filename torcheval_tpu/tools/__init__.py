@@ -1,11 +1,17 @@
 """Model-evaluation tools (reference ``torcheval/tools/__init__.py:7-19``):
 module summaries and FLOP counting, re-based on flax module trees and XLA
-cost analysis instead of torch hooks and a dispatcher interposer."""
+cost analysis instead of torch hooks and a dispatcher interposer; plus
+the roofline device-peak table backing the perfscope runtime accounting
+(:mod:`torcheval_tpu.telemetry.perfscope`)."""
 
+from torcheval_tpu.tools import profiling, roofline
 from torcheval_tpu.tools.flops import (
     cost_summary,
     flops_of,
     forward_backward_flops,
+    memory_stats_of,
+    normalize_cost_analysis,
+    peak_memory_of,
 )
 from torcheval_tpu.tools.module_summary import (
     get_module_summary,
@@ -14,19 +20,30 @@ from torcheval_tpu.tools.module_summary import (
     ModuleSummary,
     prune_module_summary,
 )
-from torcheval_tpu.tools import profiling
 from torcheval_tpu.tools.profiling import ProfiledMetric, profile_summary_table
+from torcheval_tpu.tools.roofline import (
+    device_peaks,
+    register_device_peaks,
+    reread_multiplier,
+)
 
 __all__ = [
     "cost_summary",
+    "device_peaks",
     "flops_of",
     "forward_backward_flops",
     "get_module_summary",
     "get_params_summary",
     "get_summary_table",
+    "memory_stats_of",
     "ModuleSummary",
+    "normalize_cost_analysis",
+    "peak_memory_of",
     "ProfiledMetric",
     "profile_summary_table",
     "profiling",
     "prune_module_summary",
+    "register_device_peaks",
+    "reread_multiplier",
+    "roofline",
 ]

@@ -26,6 +26,60 @@ import jax.numpy as jnp
 UNKNOWN_FLOPS = -1
 
 
+def normalize_cost_analysis(analyses: Any) -> Mapping[str, float]:
+    """One shape for ``compiled.cost_analysis()`` across jax versions:
+    newer backends report a single analysis mapping, older APIs a
+    one-element list of them (and some report ``None``).  Returns the
+    mapping, or an empty dict when the backend has no cost model.
+    Shared by :func:`flops_of`, :func:`cost_summary`, and the perfscope
+    roofline accounting (:mod:`torcheval_tpu.tools.roofline`)."""
+    if isinstance(analyses, (list, tuple)):
+        analyses = analyses[0] if analyses else None
+    return analyses if analyses is not None else {}
+
+
+def memory_stats_of(compiled: Any) -> Mapping[str, int]:
+    """``compiled.memory_analysis()`` flattened to plain ints: peak,
+    temp, argument, output, alias, and generated-code bytes.  ``peak``
+    is the live-set estimate ``argument + output + temp - alias`` (the
+    donated/aliased slice is not double counted).  Backends without a
+    memory model yield all zeros."""
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:
+        stats = None
+
+    def grab(name: str) -> int:
+        return int(getattr(stats, name, 0) or 0)
+
+    out = {
+        "argument_bytes": grab("argument_size_in_bytes"),
+        "output_bytes": grab("output_size_in_bytes"),
+        "temp_bytes": grab("temp_size_in_bytes"),
+        "alias_bytes": grab("alias_size_in_bytes"),
+        "generated_code_bytes": grab("generated_code_size_in_bytes"),
+    }
+    out["peak_bytes"] = max(
+        out["argument_bytes"]
+        + out["output_bytes"]
+        + out["temp_bytes"]
+        - out["alias_bytes"],
+        0,
+    )
+    return out
+
+
+def peak_memory_of(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> int:
+    """Live-set peak bytes of ``jit(fn)(*args, **kwargs)`` per XLA's
+    memory analysis (see :func:`memory_stats_of`).  Args may be avals;
+    nothing executes.  Returns -1 when the backend has no memory model."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    stats = memory_stats_of(compiled)
+    if not any(stats.values()):
+        return UNKNOWN_FLOPS
+    return stats["peak_bytes"]
+
+
 def flops_of(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> int:
     """FLOPs of ``jit(fn)(*args, **kwargs)`` per XLA's cost analysis.
 
@@ -34,10 +88,7 @@ def flops_of(fn: Callable[..., Any], *args: Any, **kwargs: Any) -> int:
     ``UNKNOWN_FLOPS`` (-1) if the backend reports no cost model.
     """
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-    analyses = compiled.cost_analysis()
-    # Single-module programs report one analysis dict; older APIs a list.
-    if isinstance(analyses, (list, tuple)):
-        analyses = analyses[0] if analyses else {}
+    analyses = normalize_cost_analysis(compiled.cost_analysis())
     flops = analyses.get("flops")
     if flops is None:
         return UNKNOWN_FLOPS
@@ -97,7 +148,5 @@ def cost_summary(
     ``jit(fn)`` — the TPU replacement for the reference's per-op
     ``flop_counts`` breakdown (reference ``flops.py:204-233``)."""
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-    analyses = compiled.cost_analysis()
-    if isinstance(analyses, (list, tuple)):
-        analyses = analyses[0] if analyses else None
-    return analyses
+    analyses = normalize_cost_analysis(compiled.cost_analysis())
+    return analyses or None

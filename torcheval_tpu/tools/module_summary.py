@@ -21,7 +21,11 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import jax
 
-from torcheval_tpu.tools.flops import UNKNOWN_FLOPS, forward_backward_flops
+from torcheval_tpu.tools.flops import (
+    UNKNOWN_FLOPS,
+    forward_backward_flops,
+    peak_memory_of,
+)
 
 _PARAMETER_NUM_UNITS = [" ", "K", "M", "B", "T"]
 _FLOP_UNITS = [" ", "K", "M", "G", "T"]
@@ -34,6 +38,7 @@ _ATTRIBS: List[str] = [
     "size_bytes",
     "flops_forward",
     "flops_backward",
+    "peak_memory_bytes",
 ]
 _ATTRIB_TO_COL_HEADER: Dict[str, str] = {
     "module_name": "Name",
@@ -43,6 +48,7 @@ _ATTRIB_TO_COL_HEADER: Dict[str, str] = {
     "size_bytes": "Size (bytes)",
     "flops_forward": "Forward FLOPs",
     "flops_backward": "Backward FLOPs",
+    "peak_memory_bytes": "Peak Memory (bytes)",
 }
 
 
@@ -59,6 +65,7 @@ class ModuleSummary:
         self._size_bytes: int = 0
         self._flops_forward: int = UNKNOWN_FLOPS
         self._flops_backward: int = UNKNOWN_FLOPS
+        self._peak_memory_bytes: int = UNKNOWN_FLOPS
         self._has_uninitialized_param: bool = False
         self._submodule_summaries: Dict[str, "ModuleSummary"] = {}
 
@@ -95,6 +102,14 @@ class ModuleSummary:
     def flops_backward(self) -> int:
         """Backward FLOPs (cost of grad minus forward); -1 when unknown."""
         return self._flops_backward
+
+    @property
+    def peak_memory_bytes(self) -> int:
+        """Largest XLA ``memory_analysis()`` live-set peak across this
+        module's captured forward calls — what the compiled apply needs
+        resident (arguments + outputs + temporaries, aliased slices not
+        double counted); -1 when unknown."""
+        return self._peak_memory_bytes
 
     @property
     def size_bytes(self) -> int:
@@ -248,24 +263,30 @@ def get_module_summary(
         s._size_bytes = total_bytes
         if compute_flops and path in records:
             fwd = bwd = 0
+            peak = UNKNOWN_FLOPS
             for clone, args, kwargs in records[path]:
                 sub_vars = {
                     col: _tree_at(tree, path) or {}
                     for col, tree in variables.items()
                 }
+                apply = lambda v, *a, _m=clone, **kw: _m.apply(v, *a, **kw)
                 try:
                     f, b = forward_backward_flops(
-                        lambda v, *a, _m=clone, **kw: _m.apply(v, *a, **kw),
-                        sub_vars,
-                        *args,
-                        **kwargs,
+                        apply, sub_vars, *args, **kwargs
                     )
                 except Exception:
                     f = b = UNKNOWN_FLOPS
                 fwd = UNKNOWN_FLOPS if f == UNKNOWN_FLOPS else fwd + f
                 bwd = UNKNOWN_FLOPS if b == UNKNOWN_FLOPS else bwd + b
+                try:
+                    peak = max(
+                        peak, peak_memory_of(apply, sub_vars, *args, **kwargs)
+                    )
+                except Exception:
+                    pass
             s._flops_forward = fwd
             s._flops_backward = bwd
+            s._peak_memory_bytes = peak
         return s
 
     root = make_node(())
@@ -329,6 +350,15 @@ def get_params_summary(
             fwd = bwd = UNKNOWN_FLOPS
         root._flops_forward = fwd
         root._flops_backward = bwd
+        try:
+            root._peak_memory_bytes = peak_memory_of(
+                lambda v, *a, **kw: apply_fn(v["params"], *a, **kw),
+                {"params": params},
+                *example_args,
+                **(example_kwargs or {}),
+            )
+        except Exception:
+            pass
     return root
 
 
@@ -356,6 +386,8 @@ def get_summary_table(
         stop_attr.add("flops_forward")
     if module_summary.flops_backward == UNKNOWN_FLOPS:
         stop_attr.add("flops_backward")
+    if module_summary.peak_memory_bytes == UNKNOWN_FLOPS:
+        stop_attr.add("peak_memory_bytes")
     attribs = [a for a in _ATTRIBS if a not in stop_attr]
 
     rows: List[List[str]] = []
@@ -367,7 +399,7 @@ def get_summary_table(
             return str(value)
         if value < 0:
             return "?"
-        if attr == "size_bytes":
+        if attr in ("size_bytes", "peak_memory_bytes"):
             return _readable_size(value)
         units = _FLOP_UNITS if attr.startswith("flops") else _PARAMETER_NUM_UNITS
         return _get_human_readable_count(value, labels=units)

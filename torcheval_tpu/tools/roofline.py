@@ -1,0 +1,136 @@
+"""Device-kind peak table and roofline arithmetic for the perfscope
+accounting layer (:mod:`torcheval_tpu.telemetry.perfscope`).
+
+``bench.py`` has always computed HBM-utilization lower bounds offline
+from hand models; this module gives the *runtime* the same vocabulary:
+every compiled hot-path program's ``cost_analysis()`` flops /
+bytes-accessed divided by its measured dispatch wall clock yields an
+achieved GFLOP/s and GB/s, compared against the peaks of whatever
+device the process actually runs on (``jax.devices()[0].device_kind``).
+
+The table ships the TPU generations this codebase is tuned for (the
+v5e numbers match ``benchmarks/workloads.py``'s ledger constants) plus
+a deliberately conservative CPU fallback — an unknown device kind maps
+onto the fallback rather than raising, so the accounting layer degrades
+to "relative" rooflines instead of breaking the eval loop.  Register
+real numbers for a new device kind with :func:`register_device_peaks`::
+
+    from torcheval_tpu.tools import roofline
+    roofline.register_device_peaks(
+        "TPU v6e", hbm_gbps=1640.0, flops=918e12
+    )
+
+(See ``docs/source/perfscope.rst`` for the cookbook.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+# samples-per-second peaks keyed on jax's ``device.device_kind`` string.
+# ``hbm_gbps`` is the memory-bandwidth roof (GB/s), ``flops`` the dense
+# compute roof (FLOP/s, bf16 MXU for TPUs).  v5e matches the published
+# single-chip numbers already used by benchmarks/workloads.py
+# (V5E_HBM_GBPS / V5E_BF16_FLOPS); v4/v5p/v6e are the published specs.
+_DEVICE_PEAKS: Dict[str, Dict[str, float]] = {
+    "TPU v4": {"hbm_gbps": 1228.0, "flops": 275e12},
+    "TPU v5e": {"hbm_gbps": 819.0, "flops": 197e12},
+    "TPU v5 lite": {"hbm_gbps": 819.0, "flops": 197e12},
+    "TPU v5p": {"hbm_gbps": 2765.0, "flops": 459e12},
+    "TPU v6e": {"hbm_gbps": 1640.0, "flops": 918e12},
+    # Conservative single-socket CPU fallback: ~50 GB/s DDR stream,
+    # ~0.5 TFLOP/s vectorized f32.  Deliberately low — on an unknown
+    # device the roofline percentages read as upper bounds, which is
+    # the safe direction for an alert on a utilization floor.
+    "cpu": {"hbm_gbps": 50.0, "flops": 5e11},
+}
+
+_FALLBACK_KIND = "cpu"
+
+
+def register_device_peaks(
+    device_kind: str, *, hbm_gbps: float, flops: float
+) -> None:
+    """Add (or override) the peak row for ``device_kind``.  Takes effect
+    for every subsequent :func:`device_peaks` / ``explain_perf`` call."""
+    if hbm_gbps <= 0 or flops <= 0:
+        raise ValueError(
+            f"peaks must be positive, got hbm_gbps={hbm_gbps} flops={flops}"
+        )
+    _DEVICE_PEAKS[device_kind] = {
+        "hbm_gbps": float(hbm_gbps),
+        "flops": float(flops),
+    }
+
+
+def known_device_kinds() -> tuple:
+    """The device kinds with registered peak rows."""
+    return tuple(sorted(_DEVICE_PEAKS))
+
+
+def current_device_kind() -> str:
+    """``jax.devices()[0].device_kind``, or the fallback when jax has no
+    devices to report (never raises on the accounting path)."""
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return _FALLBACK_KIND
+
+
+def device_peaks(device_kind: Optional[str] = None) -> Dict[str, Any]:
+    """The peak row for ``device_kind`` (default: the current process
+    device).  Unknown kinds degrade to the conservative CPU fallback —
+    the returned dict says so via ``"exact": False``."""
+    kind = device_kind if device_kind is not None else current_device_kind()
+    row = _DEVICE_PEAKS.get(kind)
+    exact = row is not None
+    if row is None:
+        row = _DEVICE_PEAKS[_FALLBACK_KIND]
+    return {
+        "device_kind": kind,
+        "hbm_gbps": row["hbm_gbps"],
+        "flops": row["flops"],
+        "exact": exact,
+    }
+
+
+def roofline(
+    *,
+    flops: float,
+    bytes_accessed: float,
+    seconds: float,
+    peaks: Optional[Mapping[str, Any]] = None,
+) -> Dict[str, float]:
+    """Achieved throughput vs the device roofs for one program dispatch
+    (or a mean over dispatches): achieved GB/s and GFLOP/s, the percent
+    of each roof sustained, the bandwidth-floor device seconds (the time
+    the program's bytes would take at peak HBM — everything above it is
+    dispatch/compute), and which roof binds."""
+    peaks = dict(peaks) if peaks is not None else device_peaks()
+    sec = max(float(seconds), 1e-12)
+    achieved_gbps = float(bytes_accessed) / sec / 1e9
+    achieved_gflops = float(flops) / sec / 1e9
+    hbm_pct = 100.0 * achieved_gbps / peaks["hbm_gbps"]
+    flops_pct = 100.0 * achieved_gflops / (peaks["flops"] / 1e9)
+    return {
+        "achieved_gbps": achieved_gbps,
+        "achieved_gflops": achieved_gflops,
+        "hbm_pct": hbm_pct,
+        "flops_pct": flops_pct,
+        "device_seconds_floor": float(bytes_accessed)
+        / (peaks["hbm_gbps"] * 1e9),
+        "bound": "compute" if flops_pct > hbm_pct else "bandwidth",
+    }
+
+
+def reread_multiplier(bytes_accessed: float, batch_bytes: float) -> float:
+    """Program bytes-accessed over the batch's own bytes — the live
+    version of the collection-megakernel opportunity (ROADMAP item 2).
+    A five-member fused collection whose kernels each re-read the batch
+    reports ~5x the single-pass floor; 0.0 when the batch size is
+    unknown."""
+    if batch_bytes <= 0:
+        return 0.0
+    return float(bytes_accessed) / float(batch_bytes)
