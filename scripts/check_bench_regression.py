@@ -23,6 +23,13 @@ Rows are skipped (never failed) when either side is missing the metric,
 is zero/absent (a worker that never produced a number), or is marked
 ``degraded`` (CPU-fallback instances measure a different machine).
 Improvements and new workloads pass.
+
+Beyond the relative throughput comparison, a few rows carry **absolute
+bars** on their extras (``EXTRA_BARS``), checked on the fresh artifact
+alone: the live-monitor stack must stay under 5% on the sliced stream,
+and the sliced collection must dispatch exactly as many host programs
+as the unsliced one.  A missing row or key skips the bar (the workload
+did not run), it never fails it.
 """
 
 from __future__ import annotations
@@ -36,6 +43,22 @@ import sys
 from typing import Any, Dict, List, Optional
 
 DEFAULT_THRESHOLD = 0.10
+
+# (metric row, extras key, max allowed value) — absolute ceilings on
+# overhead-style extras, independent of any baseline.
+EXTRA_BARS = (
+    ("collection_sliced_stream", "monitor_overhead_pct", 5.0),
+)
+
+# (metric row, extras key, extras key) — pairs that must be EQUAL, for
+# parity claims (the sliced stream's dispatch count vs the unsliced).
+EXTRA_PARITY = (
+    (
+        "collection_sliced_stream",
+        "dispatches_per_batch",
+        "dispatches_per_batch_unsliced",
+    ),
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "BENCH_ALL.json")
@@ -92,6 +115,36 @@ def compare(
     return regressions
 
 
+def check_extras(fresh_doc: Dict[str, Any]) -> List[str]:
+    """Violations of the absolute extras bars on the fresh artifact.
+    Rows or keys that are absent skip their bar — a workload that did
+    not run cannot fail it."""
+    rows = _rows_by_metric(fresh_doc)
+    violations: List[str] = []
+    for metric, key, ceiling in EXTRA_BARS:
+        row = rows.get(metric)
+        value = row.get(key) if row else None
+        if value is None:
+            continue
+        if float(value) > ceiling:
+            violations.append(
+                f"{metric}: {key}={float(value):.2f} exceeds the "
+                f"{ceiling:g} bar"
+            )
+    for metric, key_a, key_b in EXTRA_PARITY:
+        row = rows.get(metric)
+        a = row.get(key_a) if row else None
+        b = row.get(key_b) if row else None
+        if a is None or b is None:
+            continue
+        if float(a) != float(b):
+            violations.append(
+                f"{metric}: {key_a}={float(a):g} != {key_b}={float(b):g} "
+                "(dispatch parity broken)"
+            )
+    return violations
+
+
 def _load(path: str) -> Dict[str, Any]:
     with open(path, "r", encoding="utf-8") as fh:
         return json.load(fh)
@@ -142,6 +195,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2  # unreachable; parser.error exits
 
     regressions = compare(baseline_doc, fresh_doc, threshold=args.threshold)
+    bar_violations = check_extras(fresh_doc)
     compared = sum(
         1
         for metric, row in _rows_by_metric(baseline_doc).items()
@@ -157,10 +211,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"  {r['metric']}: {r['baseline']:.1f} -> {r['fresh']:.1f} "
                 f"samples/sec (-{r['drop_pct']}%)"
             )
+    if bar_violations:
+        print(f"BAR VIOLATION: {len(bar_violations)} absolute bar(s) broken:")
+        for v in bar_violations:
+            print(f"  {v}")
+    if regressions or bar_violations:
         return 1
     print(
         f"ok: {compared} workload(s) compared, none dropped "
-        f">{args.threshold:.0%}"
+        f">{args.threshold:.0%}; extras bars hold"
     )
     return 0
 

@@ -60,6 +60,13 @@ _PERFSCOPE_HOOKS = (
     "batch_nbytes",
 )
 
+# Live quality monitor (``torcheval_tpu/monitor/quality.py``): the
+# engine's snapshot hook gates ``publish`` on ``telemetry.events.ENABLED``
+# — with the bus off, no quality event is built and no per-slice
+# ``compute`` runs.  The decayed/windowed/sliced members themselves add
+# no hooks: their extra work is traced INTO the one update program.
+_MONITOR_HOOKS = ("publish",)
+
 
 def _hook_names(events_module) -> List[str]:
     names = sorted(
@@ -137,11 +144,45 @@ def _drive_hot_path() -> None:
         list(evaluator.result().values())[0]
     ).block_until_ready()
 
+    # The live quality monitor's hot path: a SLICED collection of
+    # decayed/windowed members driven through a SNAPSHOTTING evaluator —
+    # the densest monitor configuration.  Disabled, the snapshot hook's
+    # quality publish must never run.
+    from torcheval_tpu.monitor import Decayed, SlidingWindow
+
+    col3 = MetricCollection(
+        {
+            "acc": Decayed(
+                MulticlassAccuracy(num_classes=c, average="macro"),
+                half_life_updates=16,
+            ),
+            "f1": SlidingWindow(
+                MulticlassF1Score(num_classes=c, average="macro"), buckets=4
+            ),
+        },
+        bucket=True,
+        slices=4,
+    )
+    sliced_stream = [
+        (
+            jnp.asarray(rng.random((b, c), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, c, b).astype(np.int32)),
+            jnp.asarray(rng.integers(0, 4, b).astype(np.int32)),
+        )
+        for b in (33, 70, 150, 97)
+    ]
+    evaluator3 = Evaluator(col3, block_size=2, snapshot_every=1)
+    evaluator3.run(sliced_stream)
+    jnp.asarray(
+        list(evaluator3.result().values())[0]
+    ).block_until_ready()
+
 
 def check(verbose: bool = True) -> List[str]:
     """Assert zero hook calls on the disabled path; returns the guarded
     hook names (so the test tier can sanity-check coverage)."""
     from torcheval_tpu import telemetry
+    from torcheval_tpu.monitor import quality as mq
     from torcheval_tpu.resilience import faults as fl
     from torcheval_tpu.telemetry import events as ev
     from torcheval_tpu.telemetry import health as hm
@@ -189,6 +230,16 @@ def check(verbose: bool = True) -> List[str]:
                         ),
                     )
                 )
+            for name in _MONITOR_HOOKS:
+                stack.enter_context(
+                    mock.patch.object(
+                        mq,
+                        name,
+                        _counting(
+                            getattr(mq, name), counter, f"monitor.{name}"
+                        ),
+                    )
+                )
             _drive_hot_path()
     finally:
         if was_enabled:
@@ -209,6 +260,7 @@ def check(verbose: bool = True) -> List[str]:
             + len(_HEALTH_HOOKS)
             + len(_FAULT_HOOKS)
             + len(_PERFSCOPE_HOOKS)
+            + len(_MONITOR_HOOKS)
         )
         print(
             f"ok: {total} "
@@ -219,6 +271,7 @@ def check(verbose: bool = True) -> List[str]:
         + [f"health.{n}" for n in _HEALTH_HOOKS]
         + [f"faults.{n}" for n in _FAULT_HOOKS]
         + [f"perfscope.{n}" for n in _PERFSCOPE_HOOKS]
+        + [f"monitor.{n}" for n in _MONITOR_HOOKS]
     )
 
 

@@ -1,0 +1,213 @@
+"""Decayed wrapper: exponential time-decay folded into existing states
+inside the traced update (torcheval_tpu/monitor/decay.py) — closed-form
+weighting, masked pad-step bit-exactness, fused/scan parity, and
+state_dict / pickle round trips."""
+
+import copy
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu.engine import Evaluator
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    MetricCollection,
+    MulticlassAccuracy,
+)
+from torcheval_tpu.monitor import Decayed
+
+pytestmark = pytest.mark.monitor
+
+_C = 4
+
+
+def _acc():
+    return MulticlassAccuracy(num_classes=_C)
+
+
+def _batch(rng, n):
+    return (
+        jnp.asarray(rng.random((n, _C), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, _C, n).astype(np.int32)),
+    )
+
+
+class TestValidation:
+    def test_exactly_one_of_decay_and_half_life(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            Decayed(_acc())
+        with pytest.raises(ValueError, match="exactly one"):
+            Decayed(_acc(), decay=0.9, half_life_updates=4)
+
+    @pytest.mark.parametrize("bad", [0.0, 1.0, -0.5, 1.5])
+    def test_decay_range(self, bad):
+        with pytest.raises(ValueError, match="decay"):
+            Decayed(_acc(), decay=bad)
+
+    def test_half_life_positive(self):
+        with pytest.raises(ValueError, match="half_life_updates"):
+            Decayed(_acc(), half_life_updates=0)
+
+    def test_wraps_metrics_only(self):
+        with pytest.raises(TypeError, match="Metric instance"):
+            Decayed(object(), decay=0.9)
+
+    def test_buffer_state_metrics_rejected(self):
+        # BinaryAUROC buffers raw scores in host lists — there is no
+        # accumulated statistic to decay.
+        with pytest.raises(TypeError, match="array states"):
+            Decayed(BinaryAUROC(), decay=0.9)
+
+    def test_half_life_factor(self):
+        d = Decayed(_acc(), half_life_updates=3)
+        assert d.decay == pytest.approx(0.5 ** (1 / 3))
+
+
+class TestSemantics:
+    def test_compute_matches_closed_form(self):
+        # s_n = sum_i d^(n-i) x_i on both sufficient statistics, so the
+        # reading is the d-weighted accuracy over the batch history.
+        rng = np.random.default_rng(3)
+        d = 0.5
+        m = Decayed(_acc(), decay=d)
+        per_batch = []
+        for n in (5, 9, 3, 7):
+            scores, target = _batch(rng, n)
+            m.update(scores, target)
+            correct = float(
+                (np.argmax(np.asarray(scores), axis=1) == np.asarray(target)).sum()
+            )
+            per_batch.append((correct, float(n)))
+        k = len(per_batch)
+        num = sum(d ** (k - 1 - i) * c for i, (c, _) in enumerate(per_batch))
+        den = sum(d ** (k - 1 - i) * t for i, (_, t) in enumerate(per_batch))
+        assert float(m.compute()) == pytest.approx(num / den, rel=1e-6)
+
+    def test_recent_batches_dominate(self):
+        # All-wrong history, one all-right batch: the decayed reading
+        # sits far above the lifetime one.
+        scores_wrong = jnp.asarray([[1.0, 0.0, 0.0, 0.0]] * 8)
+        target_one = jnp.asarray([1] * 8)
+        scores_right = jnp.asarray([[0.0, 1.0, 0.0, 0.0]] * 8)
+        decayed = Decayed(_acc(), decay=0.25)
+        lifetime = _acc()
+        for m in (decayed, lifetime):
+            for _ in range(4):
+                m.update(scores_wrong, target_one)
+            m.update(scores_right, target_one)
+        assert float(lifetime.compute()) == pytest.approx(0.2)
+        assert float(decayed.compute()) > 0.7
+
+    def test_fully_masked_update_is_bit_exact_noop(self):
+        rng = np.random.default_rng(4)
+        m = Decayed(_acc(), decay=0.9)
+        m.update(*_batch(rng, 6))
+        before = {k: np.asarray(v) for k, v in m.state_dict().items()}
+        scores, target = _batch(rng, 6)
+        m.update(scores, target, mask=jnp.zeros(6, jnp.int32))
+        after = m.state_dict()
+        for k, v in before.items():
+            np.testing.assert_array_equal(v, np.asarray(after[k]))
+
+    def test_masked_update_decays_once(self):
+        # A partially-masked update applies the factor exactly once and
+        # accumulates only the valid rows — same as the unmasked update
+        # on the valid prefix.
+        rng = np.random.default_rng(5)
+        scores, target = _batch(rng, 8)
+        mask = jnp.asarray([1, 1, 1, 1, 1, 0, 0, 0], jnp.int32)
+        a = Decayed(_acc(), decay=0.5)
+        b = Decayed(_acc(), decay=0.5)
+        seed = _batch(rng, 4)
+        a.update(*seed)
+        b.update(*seed)
+        a.update(scores, target, mask=mask)
+        b.update(scores[:5], target[:5])
+        np.testing.assert_array_equal(
+            np.asarray(a.compute()), np.asarray(b.compute())
+        )
+
+
+class TestCollectionAndEngine:
+    def test_fused_matches_unfused(self):
+        rng = np.random.default_rng(6)
+        batches = [_batch(rng, n) for n in (20, 33, 7)]
+        fused = MetricCollection(
+            {"dacc": Decayed(_acc(), decay=0.75)}, bucket=True
+        )
+        plain = copy.deepcopy(fused)
+        for scores, target in batches:
+            fused.fused_update(scores, target)
+            plain.update(scores, target)
+        np.testing.assert_allclose(
+            np.asarray(fused.compute()["dacc"]),
+            np.asarray(plain.compute()["dacc"]),
+            rtol=1e-6,
+        )
+
+    def test_engine_scan_bit_identical_to_perbatch(self):
+        # The scan path runs fully-masked pad steps the per-batch path
+        # never sees; the where(any_valid, d, 1.0) factor makes them
+        # exact no-ops, so a ragged stream with a partial tail matches
+        # bit for bit.
+        rng = np.random.default_rng(7)
+        batches = [_batch(rng, n) for n in (20, 33, 7, 41, 12, 9)]
+        scan_col = MetricCollection(
+            {"dacc": Decayed(_acc(), decay=0.9)}, bucket=True
+        )
+        ref_col = copy.deepcopy(scan_col)
+        Evaluator(scan_col, block_size=4, prefetch=False).run(batches).flush()
+        for scores, target in batches:
+            ref_col.fused_update(scores, target)
+        np.testing.assert_array_equal(
+            np.asarray(scan_col.compute()["dacc"]),
+            np.asarray(ref_col.compute()["dacc"]),
+        )
+
+
+class TestRoundTrips:
+    def test_state_dict_round_trip(self):
+        rng = np.random.default_rng(8)
+        a = Decayed(_acc(), decay=0.8)
+        a.update(*_batch(rng, 10))
+        b = Decayed(_acc(), decay=0.8)
+        b.load_state_dict(a.state_dict())
+        np.testing.assert_array_equal(
+            np.asarray(a.compute()), np.asarray(b.compute())
+        )
+        # Post-restore updates stay in lockstep (shared-registry check).
+        nxt = _batch(rng, 5)
+        a.update(*nxt)
+        b.update(*nxt)
+        np.testing.assert_array_equal(
+            np.asarray(a.compute()), np.asarray(b.compute())
+        )
+
+    def test_pickle_reshares_registry(self):
+        rng = np.random.default_rng(9)
+        a = Decayed(_acc(), decay=0.8)
+        a.update(*_batch(rng, 10))
+        b = pickle.loads(pickle.dumps(a))
+        assert b._state_name_to_default is b.inner._state_name_to_default
+        np.testing.assert_array_equal(
+            np.asarray(a.compute()), np.asarray(b.compute())
+        )
+        b.reset()
+        assert float(b.num_total) == 0.0
+
+    def test_integer_states_cast_to_float(self):
+        d = Decayed(_acc(), decay=0.9)
+        for name in d._state_name_to_default:
+            assert jnp.issubdtype(
+                jnp.asarray(getattr(d, name)).dtype, jnp.floating
+            )
+
+    def test_merge_requires_matching_decay(self):
+        a = Decayed(_acc(), decay=0.9)
+        with pytest.raises(ValueError, match="same"):
+            a.merge_state([Decayed(_acc(), decay=0.5)])
+        with pytest.raises(ValueError, match="same"):
+            a.merge_state([_acc()])

@@ -1,0 +1,203 @@
+"""The streaming quality exporter (torcheval_tpu/monitor/quality.py)
+and its downstream surfaces: window_kind labels, publish() fan-out over
+global + per-slice figures, the engine's snapshot hook, report() /
+Prometheus rendering, and the quality SLO extractors in perfscope."""
+
+import unittest
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.engine import Evaluator
+from torcheval_tpu.metrics import (
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+)
+from torcheval_tpu.monitor import Decayed, SlidingWindow, publish, window_kind
+from torcheval_tpu.telemetry import events as ev
+from torcheval_tpu.telemetry import perfscope
+
+pytestmark = pytest.mark.monitor
+
+_C = 4
+
+
+def _batch(rng, n, slices=None):
+    out = [
+        jnp.asarray(rng.random((n, _C), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, _C, n).astype(np.int32)),
+    ]
+    if slices is not None:
+        out.append(jnp.asarray(rng.integers(0, slices, n).astype(np.int32)))
+    return tuple(out)
+
+
+def _monitored_collection(slices=None):
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+            "dacc": Decayed(
+                MulticlassAccuracy(num_classes=_C, average="macro"),
+                decay=0.8,
+            ),
+            "wf1": SlidingWindow(
+                MulticlassF1Score(num_classes=_C, average="macro"),
+                buckets=2,
+            ),
+            "cm": MulticlassConfusionMatrix(num_classes=_C),
+        },
+        bucket=True,
+        slices=slices,
+    )
+
+
+class QualityIsolation(unittest.TestCase):
+    def setUp(self):
+        self._capacity = ev.capacity()
+        telemetry.disable()
+        telemetry.clear()
+        perfscope.reset()
+
+    def tearDown(self):
+        perfscope.reset()
+        ev.enable(capacity=self._capacity)
+        telemetry.disable()
+        telemetry.clear()
+
+
+class TestWindowKind(unittest.TestCase):
+    def test_mapping(self):
+        acc = MulticlassAccuracy(num_classes=_C)
+        self.assertEqual(window_kind(acc), "lifetime")
+        self.assertEqual(
+            window_kind(Decayed(MulticlassAccuracy(num_classes=_C), decay=0.5)),
+            "decayed",
+        )
+        self.assertEqual(
+            window_kind(SlidingWindow(MulticlassAccuracy(num_classes=_C), buckets=2)),
+            "window",
+        )
+
+
+class TestPublish(QualityIsolation):
+    def test_global_and_per_slice_events(self):
+        telemetry.enable()
+        rng = np.random.default_rng(0)
+        col = _monitored_collection(slices=3)
+        scores, target, sids = _batch(rng, 24, slices=3)
+        col.fused_update(scores, target, slice_ids=sids)
+        emitted = publish(col, step=7)
+        # Three scalar members (the confusion matrix is skipped), once
+        # globally and once per slice.
+        self.assertEqual(emitted, 3 * (1 + 3))
+        events = telemetry.events_snapshot("quality")
+        self.assertEqual(len(events), emitted)
+        keys = {(e.metric, e.slice_label, e.window) for e in events}
+        self.assertIn(("acc", "", "lifetime"), keys)
+        self.assertIn(("dacc", "2", "decayed"), keys)
+        self.assertIn(("wf1", "1", "window"), keys)
+        self.assertTrue(all(e.step == 7 for e in events))
+        self.assertFalse(any(e.metric == "cm" for e in events))
+
+    def test_precomputed_values_skip_compute(self):
+        telemetry.enable()
+        col = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=_C)}
+        )
+        emitted = publish(col, step=1, values={"acc": 0.5})
+        self.assertEqual(emitted, 1)
+        (event,) = telemetry.events_snapshot("quality")
+        self.assertEqual(event.value, 0.5)
+
+
+class TestEngineSnapshotHook(QualityIsolation):
+    def _run(self):
+        rng = np.random.default_rng(1)
+        batches = [_batch(rng, n, slices=2) for n in (20, 33, 7, 41)]
+        col = _monitored_collection(slices=2)
+        Evaluator(
+            col, block_size=2, prefetch=False, snapshot_every=1
+        ).run(batches).flush()
+
+    def test_snapshots_stream_quality_events(self):
+        telemetry.enable()
+        self._run()
+        events = telemetry.events_snapshot("quality")
+        self.assertGreater(len(events), 0)
+        rep = telemetry.report()["quality"]
+        self.assertGreater(len(rep["entries"]), 0)
+        self.assertIsNotNone(rep["worst_slice"])
+        # The worst slice is a per-slice reading, never the global row.
+        self.assertNotEqual(rep["worst_slice"]["slice"], "")
+        text = telemetry.prometheus_text()
+        self.assertIn("torcheval_tpu_quality{", text)
+        self.assertIn('window="decayed"', text)
+        self.assertIn('window="window"', text)
+
+    def test_disabled_bus_stays_silent(self):
+        self._run()
+        self.assertEqual(telemetry.events_snapshot("quality"), [])
+
+
+class TestQualitySlo(QualityIsolation):
+    def _seed_agg(self):
+        telemetry.enable()
+        ev.record_quality("acc", "a", "lifetime", 0.95, 4)
+        ev.record_quality("acc", "a", "decayed", 0.62, 4)
+        ev.record_quality("acc", "b", "lifetime", 0.40, 4)
+
+    def test_extractors(self):
+        self._seed_agg()
+        agg = ev.aggregates()
+        self.assertAlmostEqual(
+            perfscope.SLO_METRICS["quality_min"](agg), 0.40
+        )
+        self.assertAlmostEqual(
+            perfscope.SLO_METRICS["quality_worst_drop"](agg),
+            0.95 - 0.62,
+            places=6,
+        )
+
+    def test_extractors_quiet_on_empty_aggregate(self):
+        telemetry.enable()
+        agg = ev.aggregates()
+        self.assertEqual(
+            perfscope.SLO_METRICS["quality_min"](agg), float("inf")
+        )
+        self.assertEqual(
+            perfscope.SLO_METRICS["quality_worst_drop"](agg), 0.0
+        )
+        rules = perfscope.default_rules(
+            quality_floor=0.5, quality_drop_max=0.1
+        )
+        self.assertEqual(perfscope.evaluate_slo(rules), [])
+
+    def test_default_rules_opt_in(self):
+        base = {r.name for r in perfscope.default_rules()}
+        self.assertNotIn("quality_floor", base)
+        self.assertNotIn("quality_drop", base)
+        armed = {
+            r.name
+            for r in perfscope.default_rules(
+                quality_floor=0.5, quality_drop_max=0.1
+            )
+        }
+        self.assertIn("quality_floor", armed)
+        self.assertIn("quality_drop", armed)
+
+    def test_rules_fire_on_regression(self):
+        self._seed_agg()
+        rules = perfscope.default_rules(
+            quality_floor=0.5, quality_drop_max=0.25
+        )
+        fired = {f["rule"]: f for f in perfscope.evaluate_slo(rules)}
+        self.assertIn("quality_floor", fired)
+        self.assertAlmostEqual(fired["quality_floor"]["value"], 0.40)
+        self.assertIn("quality_drop", fired)
+        alerts = ev.aggregates()["alerts"]
+        self.assertEqual(alerts["quality_floor"]["count"], 1)

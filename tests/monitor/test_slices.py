@@ -1,0 +1,255 @@
+"""Slice axis on MetricCollection (slices= / slice_ids=): masked
+segment reductions inside the one fused program.  Headline acceptance
+checks — slices=16 results bit-identical to 16 separate masked
+collections, and dispatch count unchanged vs the unsliced run."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.engine import Evaluator
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    MetricCollection,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+)
+from torcheval_tpu.telemetry import events as ev
+
+pytestmark = pytest.mark.monitor
+
+_C = 6
+_K = 16
+
+
+def _metrics():
+    return {
+        "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+        "f1": MulticlassF1Score(num_classes=_C, average="macro"),
+    }
+
+
+def _sliced(slices=_K, **kw):
+    return MetricCollection(_metrics(), bucket=True, slices=slices, **kw)
+
+
+def _batch(rng, n, slices=_K):
+    return (
+        jnp.asarray(rng.random((n, _C), dtype=np.float32)),
+        jnp.asarray(rng.integers(0, _C, n).astype(np.int32)),
+        jnp.asarray(rng.integers(0, slices, n).astype(np.int32)),
+    )
+
+
+def _stream(sizes, seed=0, slices=_K):
+    rng = np.random.default_rng(seed)
+    return [_batch(rng, n, slices) for n in sizes]
+
+
+class TestConstruction:
+    def test_labels_require_slices(self):
+        with pytest.raises(ValueError, match="slice_labels requires"):
+            MetricCollection(_metrics(), slice_labels=["a", "b"])
+
+    def test_slices_at_least_one(self):
+        with pytest.raises(ValueError, match="slices must be >= 1"):
+            MetricCollection(_metrics(), slices=0)
+
+    def test_labels_must_cover_all_slices(self):
+        with pytest.raises(ValueError, match="name all"):
+            MetricCollection(_metrics(), slices=3, slice_labels=["a", "b"])
+
+    def test_labels_must_be_unique(self):
+        with pytest.raises(ValueError, match="unique"):
+            MetricCollection(_metrics(), slices=2, slice_labels=["a", "a"])
+
+    def test_at_sign_reserved_in_names(self):
+        with pytest.raises(ValueError, match="@"):
+            MetricCollection(
+                {"acc@0": MulticlassAccuracy(num_classes=_C)}, slices=2
+            )
+
+    def test_members_must_support_mask(self):
+        with pytest.raises(ValueError, match="mask-aware"):
+            MetricCollection({"auroc": BinaryAUROC()}, slices=2)
+
+    def test_repr_mentions_slices(self):
+        assert "slices=4" in repr(_sliced(4))
+
+    def test_default_and_custom_labels(self):
+        assert _sliced(3).slice_labels == ("0", "1", "2")
+        col = _sliced(2, slice_labels=["mobile", "desktop"])
+        assert tuple(col.compute_slices()) == ("mobile", "desktop")
+
+
+class TestUpdateContract:
+    def test_sliced_update_requires_slice_ids(self):
+        col = _sliced(2)
+        rng = np.random.default_rng(0)
+        scores, target, _ = _batch(rng, 8, 2)
+        with pytest.raises(TypeError, match="slice_ids"):
+            col.update(scores, target)
+        with pytest.raises(TypeError, match="slice_ids"):
+            col.fused_update(scores, target)
+
+    def test_unsliced_update_rejects_slice_ids(self):
+        col = MetricCollection(_metrics(), bucket=True)
+        rng = np.random.default_rng(0)
+        scores, target, sids = _batch(rng, 8, 2)
+        with pytest.raises(TypeError, match="unsliced"):
+            col.update(scores, target, slice_ids=sids)
+
+    def test_compute_slices_requires_sliced_collection(self):
+        with pytest.raises(ValueError, match="compute_slices"):
+            MetricCollection(_metrics()).compute_slices()
+
+    def test_engine_batch_must_carry_slice_ids(self):
+        # A one-positional batch cannot possibly carry the slice-id
+        # vector; the engine rejects it before any dispatch.
+        rng = np.random.default_rng(0)
+        scores, _, _ = _batch(rng, 8)
+        with pytest.raises(ValueError, match="slice-id"):
+            Evaluator(_sliced(), block_size=2, prefetch=False).run(
+                [(scores,)]
+            )
+
+
+class TestBitIdentity:
+    def test_sliced_matches_16_separate_masked_collections(self):
+        # The acceptance criterion: one sliced collection == K separate
+        # collections each fed the same batches under mask=(ids == k),
+        # bit for bit — the slice members run the identical masked
+        # update body, just inside one program.
+        batches = _stream((40, 33, 7, 51), seed=1)
+        col = _sliced()
+        separate = [
+            MetricCollection(_metrics(), bucket=True) for _ in range(_K)
+        ]
+        for scores, target, sids in batches:
+            col.fused_update(scores, target, slice_ids=sids)
+            for k, ref in enumerate(separate):
+                ref.fused_update(
+                    scores, target, mask=(sids == k).astype(jnp.int32)
+                )
+        per_slice = col.compute_slices()
+        assert list(per_slice) == [str(k) for k in range(_K)]
+        for k, ref in enumerate(separate):
+            expect = ref.compute()
+            for name, value in per_slice[str(k)].items():
+                np.testing.assert_array_equal(
+                    np.asarray(value),
+                    np.asarray(expect[name]),
+                    err_msg=f"slice {k} metric {name}",
+                )
+
+    def test_global_figures_match_unsliced(self):
+        batches = _stream((24, 18, 40), seed=2)
+        col = _sliced()
+        plain = MetricCollection(_metrics(), bucket=True)
+        for scores, target, sids in batches:
+            col.fused_update(scores, target, slice_ids=sids)
+            plain.fused_update(scores, target)
+        got, want = col.compute(), plain.compute()
+        for name in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[name]), np.asarray(want[name])
+            )
+
+    def test_engine_scan_matches_perbatch_fused(self):
+        batches = _stream((40, 33, 7, 51, 12, 9), seed=3)
+        scan_col = _sliced()
+        ref_col = _sliced()
+        Evaluator(scan_col, block_size=4, prefetch=False).run(
+            batches
+        ).flush()
+        for scores, target, sids in batches:
+            ref_col.fused_update(scores, target, slice_ids=sids)
+        for col_slices, ref_slices in (
+            (scan_col.compute_slices(), ref_col.compute_slices()),
+            ({"": scan_col.compute()}, {"": ref_col.compute()}),
+        ):
+            for label, per_metric in ref_slices.items():
+                for name, want in per_metric.items():
+                    np.testing.assert_array_equal(
+                        np.asarray(col_slices[label][name]),
+                        np.asarray(want),
+                        err_msg=f"slice {label!r} metric {name}",
+                    )
+
+
+class TestDispatchParity(object):
+    def _engine_counts(self, col, batches):
+        telemetry.enable()
+        telemetry.clear()
+        try:
+            Evaluator(col, block_size=4, prefetch=False).run(batches).flush()
+            engine = telemetry.report()["engine"]
+            return engine["blocks"], engine["batches"]
+        finally:
+            telemetry.disable()
+            telemetry.clear()
+
+    def test_slices_16_costs_zero_extra_dispatches(self):
+        # Same stream (modulo the slice-id vector), same block shape:
+        # the sliced run must dispatch exactly as many host programs.
+        sizes = (40, 33, 7, 51, 12, 9, 27)
+        sliced_batches = _stream(sizes, seed=4)
+        plain_batches = [b[:2] for b in sliced_batches]
+        sliced_blocks, sliced_batches_n = self._engine_counts(
+            _sliced(), sliced_batches
+        )
+        plain_blocks, plain_batches_n = self._engine_counts(
+            MetricCollection(_metrics(), bucket=True), plain_batches
+        )
+        assert sliced_blocks == plain_blocks
+        assert sliced_batches_n == plain_batches_n == len(sizes)
+
+
+class TestStateAndMerge:
+    def test_state_dict_round_trip_with_slice_keys(self):
+        batches = _stream((20, 31), seed=5, slices=4)
+        a = _sliced(4)
+        for scores, target, sids in batches:
+            a.fused_update(scores, target, slice_ids=sids)
+        sd = a.state_dict()
+        assert any("@2/" in key for key in sd)
+        b = _sliced(4)
+        b.load_state_dict(sd)
+        got, want = b.compute_slices(), a.compute_slices()
+        for label in want:
+            for name in want[label]:
+                np.testing.assert_array_equal(
+                    np.asarray(got[label][name]),
+                    np.asarray(want[label][name]),
+                )
+
+    def test_merge_state_adds_per_slice(self):
+        first = _stream((22, 13), seed=6, slices=4)
+        second = _stream((17, 29), seed=7, slices=4)
+        a, b, ref = _sliced(4), _sliced(4), _sliced(4)
+        for scores, target, sids in first:
+            a.fused_update(scores, target, slice_ids=sids)
+            ref.fused_update(scores, target, slice_ids=sids)
+        for scores, target, sids in second:
+            b.fused_update(scores, target, slice_ids=sids)
+            ref.fused_update(scores, target, slice_ids=sids)
+        a.merge_state([b])
+        got, want = a.compute_slices(), ref.compute_slices()
+        for label in want:
+            for name in want[label]:
+                np.testing.assert_array_equal(
+                    np.asarray(got[label][name]),
+                    np.asarray(want[label][name]),
+                )
+
+    def test_merge_requires_matching_slicing(self):
+        with pytest.raises(ValueError, match="slices"):
+            _sliced(2).merge_state([_sliced(3)])
+        with pytest.raises(ValueError, match="slices"):
+            _sliced(2).merge_state([MetricCollection(_metrics())])
+        with pytest.raises(ValueError, match="labels"):
+            _sliced(2, slice_labels=["a", "b"]).merge_state(
+                [_sliced(2, slice_labels=["x", "y"])]
+            )

@@ -287,6 +287,12 @@ class TestMergeSnapshots(FleetIsolation):
         with self.assertRaises(ValueError):
             aggregate.merge_snapshots([])
 
+    def test_snapshots_without_quality_merge_clean(self):
+        merged = aggregate.merge_snapshots(self._three_hosts())
+        self.assertEqual(
+            merged["quality"], {"per_metric": [], "worst_slice": None}
+        )
+
     def test_format_fleet_report_renders(self):
         text = export.format_fleet_report(
             aggregate.merge_snapshots(self._three_hosts())
@@ -295,6 +301,122 @@ class TestMergeSnapshots(FleetIsolation):
         self.assertIn("slowest collective", text)
         self.assertIn("on host 1", text)
         self.assertIn("DATA HEALTH: host 2", text)
+
+
+class TestQualityRollup(FleetIsolation):
+    """Per-slice quality figures across hosts: the cross-host min/mean/max
+    rollup and the worst-slice-pinned-to-host diagnostic (the quality
+    mirror of the slowest-collective pin)."""
+
+    @staticmethod
+    def _with_quality(snapshot, entries):
+        sliced = [e for e in entries if e["slice"]]
+        snapshot["report"]["quality"] = {
+            "entries": entries,
+            "worst_slice": (
+                min(sliced, key=lambda e: e["value"]) if sliced else None
+            ),
+        }
+        return snapshot
+
+    @staticmethod
+    def _entry(metric, slice_label, window, value, count=1, step=4):
+        return {
+            "metric": metric,
+            "slice": slice_label,
+            "window": window,
+            "value": value,
+            "count": count,
+            "min": value,
+            "max": value,
+            "step": step,
+        }
+
+    def _hosts(self):
+        # Host 1 serves the degraded cohort: its acc[b] decayed reading
+        # is the fleet-wide worst slice figure.
+        h0 = self._with_quality(
+            _synthetic_snapshot(0),
+            [
+                self._entry("acc", "", "lifetime", 0.90),
+                self._entry("acc", "a", "decayed", 0.85),
+                self._entry("acc", "b", "decayed", 0.80),
+            ],
+        )
+        h1 = self._with_quality(
+            _synthetic_snapshot(1),
+            [
+                self._entry("acc", "", "lifetime", 0.88),
+                self._entry("acc", "a", "decayed", 0.83),
+                self._entry("acc", "b", "decayed", 0.30),
+            ],
+        )
+        return [h0, h1]
+
+    def test_per_metric_rollup(self):
+        merged = aggregate.merge_snapshots(self._hosts())
+        rows = {
+            (r["metric"], r["slice"], r["window"]): r
+            for r in merged["quality"]["per_metric"]
+        }
+        self.assertEqual(
+            set(rows),
+            {
+                ("acc", "", "lifetime"),
+                ("acc", "a", "decayed"),
+                ("acc", "b", "decayed"),
+            },
+        )
+        b = rows[("acc", "b", "decayed")]
+        self.assertEqual(b["hosts"], 2)
+        self.assertAlmostEqual(b["min"], 0.30)
+        self.assertAlmostEqual(b["max"], 0.80)
+        self.assertAlmostEqual(b["mean"], 0.55)
+        # Sorted by (metric, slice, window) — stable render order.
+        keys = [
+            (r["metric"], r["slice"], r["window"])
+            for r in merged["quality"]["per_metric"]
+        ]
+        self.assertEqual(keys, sorted(keys))
+
+    def test_worst_slice_pinned_to_host(self):
+        merged = aggregate.merge_snapshots(self._hosts())
+        worst = merged["quality"]["worst_slice"]
+        self.assertEqual(worst["metric"], "acc")
+        self.assertEqual(worst["slice"], "b")
+        self.assertAlmostEqual(worst["value"], 0.30)
+        self.assertEqual(worst["host"]["process_index"], 1)
+        # Global ("" slice) readings never win the worst-slice pin even
+        # when they are numerically lowest.
+        hosts = self._hosts()
+        hosts[0]["report"]["quality"]["entries"].append(
+            self._entry("f1", "", "lifetime", 0.01)
+        )
+        merged = aggregate.merge_snapshots(hosts)
+        self.assertEqual(merged["quality"]["worst_slice"]["slice"], "b")
+
+    def test_fleet_text_renders_quality(self):
+        text = export.format_fleet_report(
+            aggregate.merge_snapshots(self._hosts())
+        )
+        self.assertIn("quality acc[b] (decayed)", text)
+        self.assertIn("WORST SLICE: acc[b] (decayed)", text)
+        self.assertIn("on host 1", text)
+
+    def test_live_snapshot_round_trip(self):
+        # A REAL host_snapshot (through report() and _plain) carries the
+        # quality section intact into the merge.
+        telemetry.enable()
+        ev.record_quality("acc", "cohort", "window", 0.7, step=2)
+        snap = aggregate.host_snapshot(sample_events=0)
+        json.dumps(snap)  # wire-safe
+        merged = aggregate.merge_snapshots([snap])
+        worst = merged["quality"]["worst_slice"]
+        self.assertEqual(
+            (worst["metric"], worst["slice"], worst["window"]),
+            ("acc", "cohort", "window"),
+        )
+        self.assertAlmostEqual(worst["value"], 0.7)
 
 
 class TestFleetReportCollectives(FleetIsolation):

@@ -162,6 +162,14 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         m = BinaryAccuracy()
         m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]))
         m.compute()
+        # quality — the live-monitor publish hook (values= precomputed,
+        # like the engine's snapshot path, so the span counts above stay
+        # exact; the live compute paths are covered by tests/monitor).
+        from torcheval_tpu.metrics import MetricCollection
+        from torcheval_tpu.monitor import quality as mq
+
+        qcol = MetricCollection({"qacc": BinaryAccuracy()})
+        mq.publish(qcol, step=1, values={"qacc": 1.0})
         # program_profile / alert — the perfscope pricing and SLO hooks
         # (the retrace recorded above makes the rule fire).
         import jax
@@ -243,6 +251,16 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
             text,
         )
         self.assertIn("torcheval_tpu_span_state_bytes", text)
+        self.assertIn(
+            'torcheval_tpu_quality'
+            '{metric="qacc",slice="",window="lifetime"} 1',
+            text,
+        )
+        self.assertIn(
+            'torcheval_tpu_quality_readings_total'
+            '{metric="qacc",slice="",window="lifetime"} 1',
+            text,
+        )
 
         # report(): every section populated from the same capture.
         rep = telemetry.report()
@@ -270,6 +288,10 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         self.assertTrue(rep["sync"]["slowest"])
         self.assertIn("BinaryAccuracy.update", rep["spans"])
         self.assertIn("BinaryAccuracy.compute", rep["spans"])
+        self.assertEqual(
+            [(e["metric"], e["slice"], e["window"]) for e in rep["quality"]["entries"]],
+            [("qacc", "", "lifetime")],
+        )
         self.assertEqual(rep["events_captured"], len(captured))
 
         # The text rendering carries the headline numbers.
@@ -296,6 +318,85 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         self.assertTrue(
             all(e.op == "local_all_gather_object" for e in back)
         )
+
+
+class TestQualityStream(TelemetryIsolation):
+    """The labeled quality families (satellites of the live-monitor PR):
+    Prometheus label escaping, stable family/label ordering, and the
+    forward-compatible JSONL round trip for QualityEvent."""
+
+    def test_prometheus_label_escaping(self):
+        telemetry.enable()
+        nasty = 'sl"ce\\with\nnewline'
+        ev.record_quality("acc", nasty, "decayed", 0.5, step=2)
+        text = telemetry.prometheus_text()
+        self.assertIn(
+            'torcheval_tpu_quality{metric="acc",'
+            'slice="sl\\"ce\\\\with\\nnewline",window="decayed"} 0.5',
+            text,
+        )
+
+    def test_family_and_label_ordering_is_stable(self):
+        telemetry.enable()
+        # Emit in scrambled order; output must sort by label tuple.
+        ev.record_quality("f1", "b", "lifetime", 0.2)
+        ev.record_quality("acc", "", "window", 0.9)
+        ev.record_quality("acc", "", "decayed", 0.8)
+        ev.record_quality("f1", "a", "lifetime", 0.1)
+        text = telemetry.prometheus_text()
+        lines = [
+            l
+            for l in text.splitlines()
+            if l.startswith("torcheval_tpu_quality{")
+        ]
+        self.assertEqual(lines, sorted(lines))
+        labels = [
+            l.split("{")[1].split("}")[0] for l in lines
+        ]
+        self.assertEqual(
+            labels,
+            [
+                'metric="acc",slice="",window="decayed"',
+                'metric="acc",slice="",window="window"',
+                'metric="f1",slice="a",window="lifetime"',
+                'metric="f1",slice="b",window="lifetime"',
+            ],
+        )
+        # The gauge family renders before its readings counter, each with
+        # exactly one HELP/TYPE header.
+        self.assertEqual(text.count("# TYPE torcheval_tpu_quality gauge"), 1)
+        self.assertEqual(
+            text.count("# TYPE torcheval_tpu_quality_readings_total counter"),
+            1,
+        )
+        self.assertLess(
+            text.index("# TYPE torcheval_tpu_quality gauge"),
+            text.index("# TYPE torcheval_tpu_quality_readings_total counter"),
+        )
+
+    def test_quality_event_jsonl_round_trip_lenient(self):
+        telemetry.enable()
+        ev.record_quality("acc", "cohort/7", "window", 0.625, step=41)
+        buf = io.StringIO()
+        telemetry.export_jsonl(buf)
+        buf.seek(0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # lenient path must not warn here
+            back = telemetry.read_jsonl(buf, strict=False)
+        self.assertEqual(len(back), 1)
+        e = back[0]
+        self.assertEqual(e.kind, "quality")
+        self.assertEqual(
+            (e.metric, e.slice_label, e.window, e.value, e.step),
+            ("acc", "cohort/7", "window", 0.625, 41),
+        )
+        self.assertEqual(back, ev.events())
+
+    def test_non_finite_values_render(self):
+        telemetry.enable()
+        ev.record_quality("acc", "", "decayed", float("nan"))
+        text = telemetry.prometheus_text()  # must not raise on NaN
+        self.assertIn("nan", text)
 
 
 class TestRingBuffer(TelemetryIsolation):

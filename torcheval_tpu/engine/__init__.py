@@ -52,6 +52,7 @@ from torcheval_tpu.metrics._bucket import (
     pad_to_bucket,
 )
 from torcheval_tpu.metrics.collection import MetricCollection
+from torcheval_tpu.monitor import quality as _quality
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.resilience.checkpoint import CheckpointManager
 from torcheval_tpu.telemetry import events as _telemetry
@@ -215,7 +216,9 @@ class Evaluator:
     def step(self, *args: Any) -> "Evaluator":
         """Buffer one batch (positional update args, e.g. ``(scores,
         target)``); dispatches automatically once ``block_size`` batches
-        are buffered (or the batch signature changes)."""
+        are buffered (or the batch signature changes).  For a sliced
+        collection (``slices=K``) the LAST positional is the batch's
+        per-row slice-id vector."""
         if not args:
             raise ValueError("step() needs at least one batch array.")
         batch = self._admit(args)
@@ -318,6 +321,12 @@ class Evaluator:
         # block assembly off the JAX dispatch path entirely (a device
         # array is pulled back once here — sources are host loaders).
         args = tuple(np.asarray(a) for a in args)
+        if self._collection._slices is not None and len(args) < 2:
+            raise ValueError(
+                "The collection is sliced (slices="
+                f"{self._collection._slices}); each batch must carry its "
+                "per-row slice-id vector as the last positional arg."
+            )
         if _faults.ENABLED:
             # Chaos site "engine.batch": a corrupt rule pokes a NaN into
             # the batch so the data-health monitor has a real finding.
@@ -438,8 +447,16 @@ class Evaluator:
         if block.perbatch:
             # The per-batch tail goes through fused_update, which carries
             # its own health side-outputs — every batch stays monitored.
+            # A sliced collection's trailing slice-id vector moves to its
+            # keyword seat.
+            sliced = self._collection._slices is not None
             for args in block.perbatch:
-                self._collection.fused_update(*args)
+                if sliced:
+                    self._collection.fused_update(
+                        *args[:-1], slice_ids=args[-1]
+                    )
+                else:
+                    self._collection.fused_update(*args)
             self.batches_seen += block.batches
             self._maybe_snapshot()
             self._maybe_checkpoint()
@@ -485,6 +502,16 @@ class Evaluator:
             snap = self._collection.compute()
             self.last_snapshot = snap
             self.snapshots.append(snap)
+            if _telemetry.ENABLED:
+                # The live quality stream: every snapshot's figures
+                # (global + all slices, per window kind) become
+                # QualityEvents — the Prometheus / report() / fleet
+                # feed.  One branch, cold when the bus is off.
+                _quality.publish(
+                    self._collection,
+                    step=self.blocks_dispatched,
+                    values=snap,
+                )
             if self._on_snapshot is not None:
                 self._on_snapshot(self.blocks_dispatched, snap)
 

@@ -45,22 +45,29 @@ def _build_apply(
     ``health`` the scan additionally stacks per-step
     :func:`~torcheval_tpu.telemetry.health.batch_stats` as its ys and
     returns ``(states, stats)`` — the data-health side output, fused
-    into the same dispatch."""
-    metrics = collection._metrics
+    into the same dispatch.
+
+    For a sliced collection the LAST stacked positional is the per-row
+    slice-id vector; the step body hands it to the collection's shared
+    ``_trace_update``, so the per-slice masked reductions fold into the
+    SAME scan program — slices add zero dispatches."""
+    members = collection._all_members
+    sliced = collection._slices is not None
 
     def apply(states, stacked_args, stacked_mask):
         bump_trace("engine_scan")
 
         def body(carry, xs):
             step_args, step_mask = xs
-            for name, m in metrics.items():
+            for name, m in members.items():
                 for s, v in carry[name].items():
                     setattr(m, s, v)
-            for m in metrics.values():
-                if step_mask is None:
-                    m.update(*step_args)
-                else:
-                    m.update(*step_args, mask=step_mask)
+            kw = {}
+            if sliced:
+                step_args, kw["slice_ids"] = step_args[:-1], step_args[-1]
+            if step_mask is not None:
+                kw["mask"] = step_mask
+            collection._trace_update(step_args, kw)
             ys = (
                 _health.batch_stats(step_args, step_mask, bounds)
                 if health
@@ -171,9 +178,10 @@ def resolve_donate(
 
 
 def states_nbytes(collection: MetricCollection) -> int:
-    """Total member state bytes (span payload for engine_block spans)."""
+    """Total member state bytes (span payload for engine_block spans),
+    slice clones included."""
     return sum(
-        _telemetry.state_nbytes(m) for m in collection._metrics.values()
+        _telemetry.state_nbytes(m) for m in collection._all_members.values()
     )
 
 

@@ -12,10 +12,21 @@ State-dict keys are namespaced ``"{name}/{state}"`` so a collection
 checkpoints like any single metric (orbax-compatible flat mapping).
 """
 
+import copy
 import time
-from typing import Any, Dict, Iterable, Iterator, Mapping, Optional, Tuple
+from typing import (
+    Any,
+    Dict,
+    Iterable,
+    Iterator,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import jax
+import jax.numpy as jnp
 
 from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics._bucket import DEFAULT_MIN_BUCKET, pad_to_bucket
@@ -65,6 +76,17 @@ class MetricCollection:
     (``None`` follows :func:`torcheval_tpu.ops._flags.donation_enabled`):
     XLA aliases old→new member states in place, halving state HBM
     traffic per batch.
+
+    ``slices=K`` adds a slice axis: every update additionally carries a
+    per-row ``slice_ids=`` int vector (values in ``[0, K)``), and the
+    collection maintains K per-slice clones of each member alongside the
+    global one.  Slice restriction is a masked segment reduction *inside
+    the same traced program* — clone ``k`` updates with
+    ``mask * (slice_ids == k)``, reusing the validity-mask plumbing of
+    ``metrics/_bucket.py`` — so ONE fused/scan dispatch computes the
+    global figures and all K slices with no extra HBM passes over the
+    batch.  Read per-slice results with :meth:`compute_slices`.  Every
+    member must be mask-aware.
     """
 
     def __init__(
@@ -74,9 +96,15 @@ class MetricCollection:
         bucket: bool = False,
         min_bucket: int = DEFAULT_MIN_BUCKET,
         donate: Optional[bool] = None,
+        slices: Optional[int] = None,
+        slice_labels: Optional[Sequence[str]] = None,
     ) -> None:
         if not metrics:
             raise ValueError("MetricCollection requires at least one metric.")
+        if slices is None and slice_labels is not None:
+            raise ValueError("slice_labels requires slices=.")
+        if slices is not None and slices < 1:
+            raise ValueError(f"slices must be >= 1, got {slices}.")
         for name, metric in metrics.items():
             if not isinstance(metric, Metric):
                 raise TypeError(
@@ -89,9 +117,24 @@ class MetricCollection:
                 raise ValueError(
                     f"Metric names must not contain '/', got {name!r}."
                 )
+            if slices is not None and "@" in name:
+                # "@" namespaces per-slice clones in state_dict keys
+                # ("name@k/state"); a member name containing it could
+                # not round-trip.
+                raise ValueError(
+                    f"Metric names must not contain '@' when slices= is "
+                    f"set, got {name!r}."
+                )
             if bucket and not metric._supports_mask:
                 raise ValueError(
                     f"bucket=True requires mask-aware members; "
+                    f"{name}={type(metric).__name__} does not support "
+                    f"update(..., mask=)."
+                )
+            if slices is not None and not metric._supports_mask:
+                raise ValueError(
+                    f"slices= requires mask-aware members (slice "
+                    f"restriction is a masked reduction); "
                     f"{name}={type(metric).__name__} does not support "
                     f"update(..., mask=)."
                 )
@@ -99,6 +142,34 @@ class MetricCollection:
         self._bucket = bool(bucket)
         self._min_bucket = int(min_bucket)
         self._donate = donate
+        self._slices: Optional[int] = None if slices is None else int(slices)
+        if slices is None:
+            self._slice_labels: Tuple[str, ...] = ()
+            self._slice_members: Dict[str, Metric] = {}
+        else:
+            labels = (
+                tuple(str(v) for v in slice_labels)
+                if slice_labels is not None
+                else tuple(str(k) for k in range(slices))
+            )
+            if len(labels) != slices:
+                raise ValueError(
+                    f"slice_labels must name all {slices} slices; got "
+                    f"{len(labels)}."
+                )
+            if len(set(labels)) != len(labels):
+                raise ValueError(f"slice_labels must be unique; got {labels}.")
+            self._slice_labels = labels
+            # Per-slice clones: independent state, identical config.
+            self._slice_members = {
+                f"{name}@{k}": copy.deepcopy(metric)
+                for name, metric in self._metrics.items()
+                for k in range(slices)
+            }
+        # Every state-carrying member — plain metrics plus slice clones —
+        # under its state_dict namespace key.
+        self._all_members: Dict[str, Metric] = dict(self._metrics)
+        self._all_members.update(self._slice_members)
         self._fused_apply: Optional[Any] = None
         self._fused_apply_donated: Optional[bool] = None
         self._fused_apply_health: Optional[bool] = None
@@ -111,7 +182,7 @@ class MetricCollection:
         self._state_layout: Tuple[Tuple[str, Metric, Tuple[str, ...]], ...] = (
             tuple(
                 (name, m, tuple(m._state_name_to_default))
-                for name, m in self._metrics.items()
+                for name, m in self._all_members.items()
             )
         )
         # Call signatures fused_update has already executed.  A hit means
@@ -129,9 +200,19 @@ class MetricCollection:
             return args, kwargs
         kwargs = dict(kwargs)
         mask = kwargs.pop("mask", None)
-        args, mask = pad_to_bucket(
-            *args, mask=mask, min_bucket=self._min_bucket
-        )
+        slice_ids = kwargs.pop("slice_ids", None)
+        if slice_ids is not None:
+            # The slice-id vector is a per-row array: pad it alongside
+            # the batch (edge-replicated pad rows are harmless — the
+            # mask zeroes them out of every slice).
+            padded, mask = pad_to_bucket(
+                *args, slice_ids, mask=mask, min_bucket=self._min_bucket
+            )
+            args, kwargs["slice_ids"] = padded[:-1], padded[-1]
+        else:
+            args, mask = pad_to_bucket(
+                *args, mask=mask, min_bucket=self._min_bucket
+            )
         kwargs["mask"] = mask
         return args, kwargs
 
@@ -149,10 +230,59 @@ class MetricCollection:
         return self._metrics.items()
 
     # ------------------------------------------------------------- lifecycle
+    @property
+    def slices(self) -> Optional[int]:
+        """Number of slices, or ``None`` for an unsliced collection."""
+        return self._slices
+
+    @property
+    def slice_labels(self) -> Tuple[str, ...]:
+        """Slice labels in slice-id order (empty when unsliced)."""
+        return self._slice_labels
+
+    def _trace_update(
+        self, args: Tuple[Any, ...], kwargs: Dict[str, Any]
+    ) -> None:
+        """The one update body shared by every path — plain ``update``,
+        the fused program, and the engine scan step.  Global members see
+        the base validity mask; slice clone ``k`` sees
+        ``mask * (slice_ids == k)`` — a masked segment reduction, so the
+        slice axis adds arithmetic to the SAME program instead of extra
+        dispatches or HBM passes."""
+        kwargs = dict(kwargs)
+        slice_ids = kwargs.pop("slice_ids", None)
+        if self._slices is None:
+            if slice_ids is not None:
+                raise TypeError(
+                    "slice_ids= passed to an unsliced MetricCollection; "
+                    "construct it with slices=K first."
+                )
+            for m in self._metrics.values():
+                m.update(*args, **kwargs)
+            return
+        if slice_ids is None:
+            raise TypeError(
+                f"This MetricCollection has slices={self._slices}; every "
+                "update must carry slice_ids= (per-row int vector in "
+                f"[0, {self._slices}))."
+            )
+        base_mask = kwargs.pop("mask", None)
+        sids = jnp.asarray(slice_ids)
+        if base_mask is not None:
+            kwargs["mask"] = base_mask
+        for m in self._metrics.values():
+            m.update(*args, **kwargs)
+        for k in range(self._slices):
+            smask = (sids == k).astype(jnp.int32)
+            if base_mask is not None:
+                smask = smask * base_mask
+            kwargs["mask"] = smask
+            for name in self._metrics:
+                self._slice_members[f"{name}@{k}"].update(*args, **kwargs)
+
     def update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
         args, kwargs = self._bucket_args(args, kwargs)
-        for metric in self._metrics.values():
-            metric.update(*args, **kwargs)
+        self._trace_update(args, kwargs)
         return self
 
     def fused_update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
@@ -192,11 +322,10 @@ class MetricCollection:
 
             def apply(states, a, kw):
                 bump_trace("fused_collection")
-                for name, m in metrics.items():
+                for name, m in self._all_members.items():
                     for s, v in states[name].items():
                         setattr(m, s, v)
-                for m in metrics.values():
-                    m.update(*a, **kw)
+                self._trace_update(a, kw)
                 if health:
                     return (
                         self._read_states(),
@@ -261,7 +390,7 @@ class MetricCollection:
                 time.monotonic() - t0,
                 sum(
                     _telemetry.state_nbytes(m)
-                    for m in self._metrics.values()
+                    for m in self._all_members.values()
                 ),
             )
         if health_stats is not None:
@@ -278,7 +407,7 @@ class MetricCollection:
     def _check_fusable(self) -> None:
         from torcheval_tpu.metrics._buffer import RingWindowMixin
 
-        for name, m in self._metrics.items():
+        for name, m in self._all_members.items():
             if isinstance(m, RingWindowMixin):
                 raise ValueError(
                     f"fused_update does not support windowed member {name!r}: "
@@ -302,7 +431,7 @@ class MetricCollection:
         self, states: Dict[str, Dict[str, Any]], guard_deleted: bool = False
     ) -> None:
         for name, per_state in states.items():
-            m = self._metrics[name]
+            m = self._all_members[name]
             for s, v in per_state.items():
                 if (
                     guard_deleted
@@ -325,8 +454,25 @@ class MetricCollection:
         # count every member.
         return {name: m.compute() for name, m in self._metrics.items()}
 
+    def compute_slices(self) -> Dict[str, Dict[str, Any]]:
+        """Per-slice results: ``{slice_label: {metric_name: value}}``,
+        labels in slice-id order.  The global (unsliced) figures stay in
+        :meth:`compute`."""
+        if self._slices is None:
+            raise ValueError(
+                "compute_slices() on an unsliced MetricCollection; "
+                "construct it with slices=K first."
+            )
+        return {
+            label: {
+                name: self._slice_members[f"{name}@{k}"].compute()
+                for name in self._metrics
+            }
+            for k, label in enumerate(self._slice_labels)
+        }
+
     def reset(self) -> "MetricCollection":
-        for metric in self._metrics.values():
+        for metric in self._all_members.values():
             metric.reset()
         return self
 
@@ -347,6 +493,15 @@ class MetricCollection:
                     "Merged collections must hold the same metric names; got "
                     f"{sorted(self._metrics)} vs {sorted(other._metrics)}."
                 )
+            if (
+                other._slices != self._slices
+                or other._slice_labels != self._slice_labels
+            ):
+                raise ValueError(
+                    "Merged collections must share the slice axis; got "
+                    f"slices={self._slices} labels={self._slice_labels} vs "
+                    f"slices={other._slices} labels={other._slice_labels}."
+                )
             for name, metric in self._metrics.items():
                 if type(other._metrics[name]) is not type(metric):
                     raise ValueError(
@@ -354,8 +509,10 @@ class MetricCollection:
                         f"{type(other._metrics[name]).__name__} in a merged "
                         "collection."
                     )
-        for name, metric in self._metrics.items():
-            metric.merge_state([other._metrics[name] for other in collections])
+        for name, metric in self._all_members.items():
+            metric.merge_state(
+                [other._all_members[name] for other in collections]
+            )
         return self
 
     # ------------------------------------------------------- toolkit compat
@@ -367,13 +524,16 @@ class MetricCollection:
         return next(iter(self._metrics.values())).device
 
     def _prepare_for_merge_state(self) -> None:
-        for metric in self._metrics.values():
+        for metric in self._all_members.values():
             metric._prepare_for_merge_state()
 
     # ----------------------------------------------------------- checkpoint
     def state_dict(self) -> Dict[str, Any]:
+        # Slice clones checkpoint under "name@k/state" alongside the
+        # global "name/state" keys, so a sliced collection round-trips
+        # through the same flat mapping.
         out: Dict[str, Any] = {}
-        for name, metric in self._metrics.items():
+        for name, metric in self._all_members.items():
             for key, value in metric.state_dict().items():
                 out[f"{name}/{key}"] = value
         return out
@@ -381,7 +541,9 @@ class MetricCollection:
     def load_state_dict(
         self, state_dict: Mapping[str, Any], strict: bool = True
     ) -> None:
-        per_metric: Dict[str, Dict[str, Any]] = {name: {} for name in self._metrics}
+        per_metric: Dict[str, Dict[str, Any]] = {
+            name: {} for name in self._all_members
+        }
         unexpected = []
         for key, value in state_dict.items():
             name, _, state_key = key.partition("/")
@@ -401,7 +563,8 @@ class MetricCollection:
             missing_members = sorted(
                 name
                 for name, states in per_metric.items()
-                if not states and self._metrics[name]._state_name_to_default
+                if not states
+                and self._all_members[name]._state_name_to_default
             )
             if missing_members:
                 problems.append(
@@ -420,19 +583,19 @@ class MetricCollection:
                 for s in metric._state_name_to_default
                 if hasattr(metric, s)
             }
-            for name, metric in self._metrics.items()
+            for name, metric in self._all_members.items()
         }
         try:
-            for name, metric in self._metrics.items():
+            for name, metric in self._all_members.items():
                 metric.load_state_dict(per_metric[name], strict=strict)
         except BaseException:
-            for name, metric in self._metrics.items():
+            for name, metric in self._all_members.items():
                 for s, value in snapshots[name].items():
                     setattr(metric, s, value)
             raise
 
     def to(self, device: Any) -> "MetricCollection":
-        for metric in self._metrics.values():
+        for metric in self._all_members.values():
             metric.to(device)
         return self
 
@@ -454,4 +617,6 @@ class MetricCollection:
         inner = ", ".join(
             f"{name}={type(m).__name__}" for name, m in self._metrics.items()
         )
+        if self._slices is not None:
+            inner += f", slices={self._slices}"
         return f"MetricCollection({inner})"

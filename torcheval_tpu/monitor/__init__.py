@@ -1,0 +1,26 @@
+"""Live model-quality monitor: decayed / windowed metric variants,
+slice-wise computation, and the streaming quality exporter.
+
+The three pieces (see ``docs/source/monitor.rst`` for the cookbook):
+
+* :class:`Decayed` — exponential time-decay folded into an existing
+  metric's counter/binned states, inside the same fused update (no ring
+  buffers on the hot path).
+* :class:`SlidingWindow` — a tumbling/sliding bucket-of-epochs window
+  over the same states; ``advance()`` rotates epochs off the hot path.
+* ``slices=K`` on :class:`~torcheval_tpu.metrics.MetricCollection` —
+  per-slice figures via masked segment reductions inside the one fused
+  or engine-scan dispatch.
+* :func:`~torcheval_tpu.monitor.quality.publish` — streams every figure
+  into the telemetry ring as :class:`QualityEvent`s (Prometheus gauges,
+  ``report()``, fleet rollups, quality SLOs).
+
+All of it composes: a sliced collection of ``Decayed``/``SlidingWindow``
+members still runs ONE dispatch per batch/block.
+"""
+
+from torcheval_tpu.monitor.decay import Decayed
+from torcheval_tpu.monitor.window import SlidingWindow
+from torcheval_tpu.monitor.quality import publish, window_kind
+
+__all__ = ["Decayed", "SlidingWindow", "publish", "window_kind"]

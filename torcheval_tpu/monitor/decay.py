@@ -1,0 +1,200 @@
+"""Exponential time-decay folded into existing counter/binned states.
+
+:class:`Decayed` wraps any array-state metric and multiplies every state
+by a constant factor ``decay`` *inside the same traced update* before the
+inner metric's accumulation runs — the decay is one fused multiply on
+state already resident in registers, NOT a ring buffer: the hot path
+stays a single dispatch and the state footprint is unchanged.
+
+The recurrence after ``n`` updates is
+
+.. math::
+
+    s_n = d \\cdot s_{n-1} + x_n = \\sum_{i=1}^{n} d^{\\,n-i} x_i
+
+so a reading computed from the decayed sufficient statistics weights the
+most recent batch at 1 and a batch ``k`` updates old at ``d^k`` — an
+exponentially-weighted moving version of the same metric.  With
+``half_life_updates=N`` the factor is ``0.5 ** (1/N)``: a batch's
+contribution halves every ``N`` updates.
+
+Fused/scan exactness: when an ``update`` carries a validity ``mask``
+(the bucketing / engine-scan plumbing of ``metrics/_bucket.py``), the
+decay factor is ``where(any_valid, d, 1.0)`` — a fully-masked step (an
+engine pad step) multiplies by exactly ``1.0``, which is bit-exact, so
+the scan path with pad steps stays bit-identical to the per-batch path
+without them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics.metric import (
+    DeviceLike,
+    Metric,
+    _is_array,
+)
+
+__all__ = ["Decayed"]
+
+
+def _resolve_decay(
+    decay: Optional[float], half_life_updates: Optional[float]
+) -> float:
+    if (decay is None) == (half_life_updates is None):
+        raise ValueError(
+            "Pass exactly one of `decay=` (per-update factor in (0, 1)) "
+            "or `half_life_updates=` (updates until a batch's weight "
+            f"halves); got decay={decay!r}, "
+            f"half_life_updates={half_life_updates!r}."
+        )
+    if decay is not None:
+        if not 0.0 < decay < 1.0:
+            raise ValueError(
+                f"`decay` must lie in (0, 1); got {decay!r}. decay=1 is a "
+                "plain lifetime metric — drop the wrapper instead."
+            )
+        return float(decay)
+    if half_life_updates <= 0:
+        raise ValueError(
+            f"`half_life_updates` must be positive; got {half_life_updates!r}."
+        )
+    return float(0.5 ** (1.0 / float(half_life_updates)))
+
+
+class Decayed(Metric):
+    """Exponentially time-decayed view of ``metric``.
+
+    The wrapper owns no state of its own: it *shares* the inner metric's
+    state registry, decays those states in the traced update, and
+    delegates ``compute``/``merge_state``/checkpointing.  It therefore
+    composes with every existing code path — ``MetricCollection`` fusion,
+    the engine scan, ``state_dict`` round-trips — with zero extra HBM.
+
+    Only metrics whose states are all plain arrays are supported (buffer
+    metrics defer their math to ``compute`` where a decay multiply has
+    nothing to fold into).  Integer counter states are cast to float32 at
+    wrap time so the fractional decay is representable.
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        *,
+        decay: Optional[float] = None,
+        half_life_updates: Optional[float] = None,
+        device: DeviceLike = None,
+    ) -> None:
+        if not isinstance(metric, Metric):
+            raise TypeError(
+                f"Decayed wraps a Metric instance; got {type(metric).__name__}."
+            )
+        for name, default in metric._state_name_to_default.items():
+            if not _is_array(default):
+                raise TypeError(
+                    f"Decayed requires array states; {type(metric).__name__}"
+                    f".{name} is a {type(default).__name__} (buffer-style "
+                    "metrics have no accumulated statistic to decay)."
+                )
+        super().__init__(device=device)
+        self._decay = _resolve_decay(decay, half_life_updates)
+        self._inner = metric
+        # Share the inner registry: the wrapper's Metric-inherited
+        # state_dict/reset/load walk the same names, and attribute
+        # forwarding (below) makes the inner's live arrays *be* the
+        # wrapper's states.
+        self._state_name_to_default = metric._state_name_to_default
+        self._device = metric._device
+        self._supports_mask = bool(type(metric)._supports_mask)
+        # Fractional decay needs float state; patch integer counters
+        # (live state AND the shared registry default) to float32.
+        for name, default in list(metric._state_name_to_default.items()):
+            if jnp.issubdtype(jnp.asarray(default).dtype, jnp.integer):
+                metric._state_name_to_default[name] = jnp.asarray(
+                    default, dtype=jnp.float32
+                )
+                setattr(
+                    metric, name, getattr(metric, name).astype(jnp.float32)
+                )
+
+    # ------------------------------------------------------- forwarding
+    # States live on the inner metric.  Writes to registered state names
+    # land there (the fused collection installs traced states via
+    # setattr); reads of anything the wrapper lacks (states,
+    # ``num_classes`` for health label bounds, ...) fall through.
+    def __setattr__(self, name: str, value: Any) -> None:
+        inner = self.__dict__.get("_inner")
+        if inner is not None and name in inner._state_name_to_default:
+            setattr(inner, name, value)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("__") or name == "_inner":
+            raise AttributeError(name)
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+    # -------------------------------------------------------- lifecycle
+    @property
+    def decay(self) -> float:
+        """The per-update multiplicative factor."""
+        return self._decay
+
+    @property
+    def inner(self) -> Metric:
+        """The wrapped metric (shares its states with this wrapper)."""
+        return self._inner
+
+    def update(self, *args: Any, **kwargs: Any) -> "Decayed":
+        inner = self._inner
+        mask = kwargs.get("mask")
+        if mask is None:
+            factor: Any = self._decay
+        else:
+            # A fully-masked step (engine pad step) must be an exact
+            # no-op: x * 1.0 is bit-identical to x, so the scan path
+            # (which runs pad steps) matches the per-batch path (which
+            # never sees them) bit for bit.
+            factor = jnp.where(
+                jnp.sum(mask) > 0,
+                jnp.float32(self._decay),
+                jnp.float32(1.0),
+            )
+        for name in inner._state_name_to_default:
+            setattr(inner, name, getattr(inner, name) * factor)
+        inner.update(*args, **kwargs)
+        return self
+
+    def compute(self) -> Any:
+        return self._inner.compute()
+
+    def merge_state(self, metrics: Iterable["Decayed"]) -> "Decayed":
+        metrics = list(metrics)
+        for m in metrics:
+            if not isinstance(m, Decayed) or m._decay != self._decay:
+                raise ValueError(
+                    "merge_state requires Decayed peers with the same "
+                    f"decay factor {self._decay!r}; got {m!r}."
+                )
+        self._inner.merge_state([m._inner for m in metrics])
+        return self
+
+    def to(self, device: DeviceLike, *args: Any, **kwargs: Any) -> "Decayed":
+        self._inner.to(device, *args, **kwargs)
+        object.__setattr__(self, "_device", self._inner._device)
+        return self
+
+    def __setstate__(self, state: Any) -> None:
+        super().__setstate__(state)
+        # Pickling snapshots the shared registry into two independent
+        # dicts (one per object); re-establish sharing so post-restore
+        # state_dict/reset on either object stay in lockstep.
+        object.__setattr__(
+            self, "_state_name_to_default", self._inner._state_name_to_default
+        )

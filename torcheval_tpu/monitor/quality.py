@@ -1,0 +1,100 @@
+"""Streaming quality exporter: collection figures → telemetry events.
+
+:func:`publish` turns one reading of a :class:`MetricCollection` —
+global figures plus every slice of a sliced collection — into typed
+:class:`~torcheval_tpu.telemetry.events.QualityEvent`s on the telemetry
+ring, labeled with the member name, the slice label ("" for the global
+figure), and the window kind (``lifetime`` / ``decayed`` / ``window``,
+derived from the member's monitor wrapper).  Downstream they surface as
+the ``torcheval_tpu_quality`` Prometheus gauge family, the ``quality``
+section of :func:`telemetry.report`, the offline CLI, fleet rollups,
+and the quality SLO extractors in perfscope.
+
+Callers gate on ``telemetry.events.ENABLED`` (the one-branch
+zero-cost-when-off contract — the engine's snapshot hook does exactly
+that); ``publish`` itself assumes the bus is on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from torcheval_tpu.metrics.collection import MetricCollection
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.monitor.decay import Decayed
+from torcheval_tpu.monitor.window import SlidingWindow
+from torcheval_tpu.telemetry import events as _telemetry
+
+__all__ = ["publish", "window_kind"]
+
+
+def window_kind(metric: Metric) -> str:
+    """The ``window`` label a member's readings carry: ``"decayed"`` for
+    :class:`~torcheval_tpu.monitor.Decayed`, ``"window"`` for
+    :class:`~torcheval_tpu.monitor.SlidingWindow`, else ``"lifetime"``."""
+    if isinstance(metric, Decayed):
+        return "decayed"
+    if isinstance(metric, SlidingWindow):
+        return "window"
+    return "lifetime"
+
+
+def _as_scalar(value: Any) -> Optional[float]:
+    """A finite-or-not float for size-1 results; ``None`` for anything
+    an event/gauge can't carry (confusion matrices, per-class vectors,
+    tuples)."""
+    if isinstance(value, tuple):
+        return None
+    try:
+        arr = np.asarray(value)
+    except Exception:
+        return None
+    if arr.size != 1 or arr.dtype == object:
+        return None
+    return float(arr.reshape(()))
+
+
+def publish(
+    collection: MetricCollection,
+    *,
+    step: int = 0,
+    values: Optional[Dict[str, Any]] = None,
+) -> int:
+    """Emit one :class:`QualityEvent` per scalar figure the collection
+    currently holds — each member globally, and per slice for a sliced
+    collection.  ``values`` short-circuits the global ``compute()`` when
+    the caller already has it (the engine's snapshot path).  ``step`` is
+    the publisher's progress cursor (engine blocks dispatched, or the
+    caller's own counter).  Returns the number of events emitted;
+    non-scalar members (confusion matrices, curves) are skipped."""
+    emitted = 0
+    if values is None:
+        values = collection.compute()
+    scalar_names = []
+    for name, value in values.items():
+        scalar = _as_scalar(value)
+        if scalar is None:
+            continue
+        scalar_names.append(name)
+        _telemetry.record_quality(
+            name, "", window_kind(collection[name]), scalar, step
+        )
+        emitted += 1
+    if collection.slices is not None and scalar_names:
+        # Only the members whose global figure was scalar — a member
+        # that publishes nothing (confusion matrix, curve) would have
+        # its K slice computes dispatched and thrown away.
+        for k, label in enumerate(collection.slice_labels):
+            for name in scalar_names:
+                scalar = _as_scalar(
+                    collection._slice_members[f"{name}@{k}"].compute()
+                )
+                if scalar is None:
+                    continue
+                _telemetry.record_quality(
+                    name, label, window_kind(collection[name]), scalar, step
+                )
+                emitted += 1
+    return emitted

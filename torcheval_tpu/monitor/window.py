@@ -1,0 +1,201 @@
+"""Tumbling / sliding bucket-of-epochs windows built on existing states.
+
+:class:`SlidingWindow` wraps an array-state metric and keeps ``buckets``
+copies of each sufficient statistic, stacked on a new leading axis.
+Every ``update`` accumulates into bucket 0 *through the inner metric's
+own kernel* — inside the same traced program, so the fused-collection
+and engine-scan paths still run one dispatch.  Off the hot path,
+:meth:`SlidingWindow.advance` rotates the buckets (host-side, e.g. once
+per epoch or per wall-clock minute): bucket 0 becomes bucket 1, the
+oldest bucket falls off, and a fresh zero bucket opens.
+
+``compute()`` sums the buckets and evaluates the inner metric on the
+sum — the reading always covers the last ``buckets`` epochs (a sliding
+window with bucket granularity).  ``buckets=1`` is a tumbling window:
+``advance()`` simply resets the statistics.
+
+Unlike the per-sample ring buffers of the ``window/`` namespace
+(:class:`~torcheval_tpu.metrics._buffer.RingWindowMixin`, whose
+host-side cursors make them unfusable), the bucket states here are plain
+fixed-shape arrays and the update is pure traced arithmetic — the
+wrapper passes ``MetricCollection._check_fusable`` and is bit-identical
+between the fused and unfused paths.
+
+Requirements on the inner metric: all states are fixed-shape arrays and
+*additive* — ``merge_state`` semantics are elementwise addition of
+states (true of every counter/binned metric: accuracy, F1, confusion
+matrix, histogram-binned AUROC/calibration, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics.metric import (
+    DeviceLike,
+    Metric,
+    _is_array,
+)
+
+__all__ = ["SlidingWindow"]
+
+
+class SlidingWindow(Metric):
+    """Sliding window of ``buckets`` epochs over ``metric``'s statistics.
+
+    The window states are registered on the wrapper itself (same names
+    as the inner metric, with a leading ``(buckets,)`` axis), so
+    ``state_dict`` / checkpoint-resume round-trips the whole window; the
+    epoch cursor rides along under the ``"window_epochs"`` extra key,
+    mirroring the ring-window bookkeeping convention.
+    """
+
+    _EPOCH_META_KEY = "window_epochs"
+
+    def __init__(
+        self,
+        metric: Metric,
+        *,
+        buckets: int,
+        device: DeviceLike = None,
+    ) -> None:
+        if not isinstance(metric, Metric):
+            raise TypeError(
+                f"SlidingWindow wraps a Metric instance; got "
+                f"{type(metric).__name__}."
+            )
+        if buckets < 1:
+            raise ValueError(f"`buckets` must be >= 1; got {buckets}.")
+        for name, default in metric._state_name_to_default.items():
+            if not _is_array(default):
+                raise TypeError(
+                    "SlidingWindow requires fixed-shape array states; "
+                    f"{type(metric).__name__}.{name} is a "
+                    f"{type(default).__name__}."
+                )
+        super().__init__(device=device)
+        self._inner = metric
+        self.buckets = int(buckets)
+        self._epochs = 0
+        self._supports_mask = bool(type(metric)._supports_mask)
+        for name, default in metric._state_name_to_default.items():
+            default = jnp.asarray(default)
+            self._add_state(
+                name,
+                jnp.zeros((self.buckets,) + default.shape, default.dtype),
+            )
+
+    def __getattr__(self, name: str) -> Any:
+        # Config attributes (``num_classes`` for health label bounds,
+        # ``average``, ...) read through to the inner metric; window
+        # states live on the wrapper and never reach here.
+        if name.startswith("__") or name == "_inner":
+            raise AttributeError(name)
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+    # -------------------------------------------------------- lifecycle
+    @property
+    def inner(self) -> Metric:
+        """The wrapped metric (used as compute/update scratch)."""
+        return self._inner
+
+    @property
+    def epochs_advanced(self) -> int:
+        """How many times :meth:`advance` has rotated the window."""
+        return self._epochs
+
+    def update(self, *args: Any, **kwargs: Any) -> "SlidingWindow":
+        # Route the batch through the inner metric's own update kernel
+        # with bucket 0 installed as its live state, then write the
+        # result back into row 0 — pure traced array ops, one program.
+        inner = self._inner
+        names = list(self._state_name_to_default)
+        for name in names:
+            setattr(inner, name, getattr(self, name)[0])
+        inner.update(*args, **kwargs)
+        for name in names:
+            setattr(
+                self, name, getattr(self, name).at[0].set(getattr(inner, name))
+            )
+        return self
+
+    def advance(self) -> "SlidingWindow":
+        """Rotate the window one epoch: open a fresh bucket 0, drop the
+        oldest.  Host-side — call between epochs, never on the hot path."""
+        for name in self._state_name_to_default:
+            st = getattr(self, name)
+            setattr(
+                self,
+                name,
+                jnp.concatenate([jnp.zeros_like(st[:1]), st[:-1]], axis=0),
+            )
+        self._epochs += 1
+        return self
+
+    def compute(self) -> Any:
+        inner = self._inner
+        for name in self._state_name_to_default:
+            setattr(inner, name, getattr(self, name).sum(axis=0))
+        return inner.compute()
+
+    def merge_state(self, metrics: Iterable["SlidingWindow"]) -> "SlidingWindow":
+        # Elementwise addition per bucket — the additive-state contract
+        # that also underlies compute()'s bucket sum.
+        metrics = list(metrics)
+        for m in metrics:
+            if not isinstance(m, SlidingWindow) or m.buckets != self.buckets:
+                raise ValueError(
+                    "merge_state requires SlidingWindow peers with "
+                    f"buckets={self.buckets}; got {m!r}."
+                )
+        import jax
+
+        for name in self._state_name_to_default:
+            acc = getattr(self, name)
+            for m in metrics:
+                acc = acc + jax.device_put(getattr(m, name), self.device)
+            setattr(self, name, acc)
+        return self
+
+    def reset(self) -> "SlidingWindow":
+        super().reset()
+        self._inner.reset()
+        self._epochs = 0
+        return self
+
+    def to(self, device: DeviceLike, *args: Any, **kwargs: Any) -> "SlidingWindow":
+        super().to(device, *args, **kwargs)
+        self._inner.to(device, *args, **kwargs)
+        return self
+
+    # ------------------------------------------------------- checkpoint
+    # The epoch cursor is host-side bookkeeping; it rides state_dict
+    # under an extra key (the RingWindowMixin convention) so
+    # checkpoint-resume restores the rotation count.
+    def state_dict(self):
+        out = super().state_dict()
+        out[self._EPOCH_META_KEY] = np.asarray(
+            [self.buckets, self._epochs], dtype=np.int64
+        )
+        return out
+
+    def load_state_dict(self, state_dict, strict: bool = True) -> None:
+        import jax
+
+        state_dict = dict(state_dict)
+        meta = state_dict.pop(self._EPOCH_META_KEY, None)
+        if meta is not None:
+            buckets, epochs = (int(v) for v in jax.device_get(meta))
+            if buckets != self.buckets:
+                raise RuntimeError(
+                    f"Checkpoint was written with buckets={buckets}; this "
+                    f"SlidingWindow has buckets={self.buckets}."
+                )
+            self._epochs = epochs
+        super().load_state_dict(state_dict, strict=strict)

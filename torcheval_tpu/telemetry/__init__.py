@@ -69,6 +69,7 @@ from torcheval_tpu.telemetry.events import (
     Event,
     PrefetchStallEvent,
     ProgramProfileEvent,
+    QualityEvent,
     RetraceEvent,
     RetryEvent,
     RouteDowngradeEvent,
@@ -257,6 +258,34 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         result["alerts"] = {
             rule: dict(entry) for rule, entry in agg["alerts"].items()
         }
+    if agg["quality"]:
+        # Structured as a list of dicts (NOT tuple-keyed) so the section
+        # survives aggregate._plain's key stringification in fleet
+        # snapshots unchanged.
+        entries = sorted(
+            (
+                {
+                    "metric": metric,
+                    "slice": slice_label,
+                    "window": window,
+                    **dict(entry),
+                }
+                for (metric, slice_label, window), entry in agg[
+                    "quality"
+                ].items()
+            ),
+            key=lambda item: (item["metric"], item["window"], item["slice"]),
+        )
+        sliced = [e for e in entries if e["slice"]]
+        result["quality"] = {
+            "entries": entries,
+            # The single most suspect figure: the lowest-valued slice
+            # reading (the fleet rollup pins its cross-host analog to a
+            # host, mirroring the slowest-collective pin).
+            "worst_slice": (
+                min(sliced, key=lambda e: e["value"]) if sliced else None
+            ),
+        }
     if as_text:
         return format_report(result)
     return result
@@ -274,6 +303,7 @@ __all__ = [
     "Event",
     "PrefetchStallEvent",
     "ProgramProfileEvent",
+    "QualityEvent",
     "RetraceEvent",
     "RetryEvent",
     "RouteDowngradeEvent",

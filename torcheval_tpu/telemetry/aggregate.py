@@ -95,7 +95,12 @@ def _host_rollup(snapshot: Dict[str, Any]) -> Dict[str, Any]:
     sync = report.get("sync", {})
     engine = report.get("engine", {})
     health = report.get("data_health", {})
+    quality = report.get("quality", {})
     return {
+        # The live model-quality figures (list-of-dict entries survive
+        # _plain untouched) and this host's worst slice reading.
+        "quality_entries": list(quality.get("entries", [])),
+        "quality_worst": quality.get("worst_slice"),
         "host": dict(snapshot.get("host", {})),
         "events_captured": report.get("events_captured", 0),
         "events_dropped": report.get("events_dropped", 0),
@@ -197,12 +202,53 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
         if r["data_health_findings"]
     ]
 
+    # Per-slice quality rollup across hosts: one row per (metric, slice,
+    # window) with min/mean/max of the hosts' last readings, plus the
+    # single worst slice reading fleet-wide pinned to its host — the
+    # "which host serves the degraded cohort" answer, mirroring the
+    # slowest-collective pin above.
+    by_key: Dict[Any, Dict[str, Any]] = {}
+    worst_slice: Dict[str, Any] = {}
+    for r in rollups:
+        for entry in r.get("quality_entries", []):
+            key = (entry["metric"], entry["slice"], entry["window"])
+            row = by_key.setdefault(
+                key,
+                {
+                    "metric": entry["metric"],
+                    "slice": entry["slice"],
+                    "window": entry["window"],
+                    "min": float("inf"),
+                    "max": float("-inf"),
+                    "_sum": 0.0,
+                    "hosts": 0,
+                },
+            )
+            value = float(entry["value"])
+            row["min"] = min(row["min"], value)
+            row["max"] = max(row["max"], value)
+            row["_sum"] += value
+            row["hosts"] += 1
+            if entry["slice"] and (
+                not worst_slice or value < worst_slice.get("value", 0.0)
+            ):
+                worst_slice = {**entry, "host": r["host"]}
+    per_metric = []
+    for key in sorted(by_key):
+        row = by_key[key]
+        row["mean"] = row.pop("_sum") / row["hosts"]
+        per_metric.append(row)
+
     return {
         "hosts": len(rollups),
         "per_host": rollups,
         "totals": totals,
         "skew": skew,
         "data_health_by_host": health_by_host,
+        "quality": {
+            "per_metric": per_metric,
+            "worst_slice": worst_slice or None,
+        },
     }
 
 

@@ -287,6 +287,23 @@ class AlertEvent(Event):
 
 
 @dataclass
+class QualityEvent(Event):
+    """One model-quality reading from the live monitor
+    (:mod:`torcheval_tpu.monitor`): member ``metric``'s computed value
+    over ``window`` (``"lifetime"`` | ``"decayed"`` | ``"window"``),
+    restricted to ``slice_label`` ("" for the global, unsliced figure).
+    ``step`` is the publisher's progress cursor (engine blocks
+    dispatched, or the caller's own counter)."""
+
+    kind: str = field(init=False, default="quality")
+    metric: str = ""
+    slice_label: str = ""
+    window: str = "lifetime"
+    value: float = 0.0
+    step: int = 0
+
+
+@dataclass
 class SpanEvent(Event):
     """A timed metric phase (``update`` / ``compute`` / ``dispatch``)
     with the metric's state-memory footprint after the phase."""
@@ -318,6 +335,7 @@ KIND_TO_CLASS: Dict[str, type] = {
     "checkpoint": CheckpointEvent,
     "program_profile": ProgramProfileEvent,
     "alert": AlertEvent,
+    "quality": QualityEvent,
 }
 
 
@@ -363,6 +381,11 @@ def _zero_aggregates() -> Dict[str, Any]:
         # SLO alerting: rule -> {"count": fires, "value": last observed,
         # "threshold": rule bound, "message": last rendered text}.
         "alerts": {},
+        # Live model-quality readings (torcheval_tpu/monitor):
+        # (metric, slice_label, window) -> {"value": last, "count":
+        # emissions, "min"/"max": extrema observed since clear, "step":
+        # last publisher cursor}.
+        "quality": {},
         "emitted": 0,
     }
 
@@ -468,6 +491,7 @@ def aggregates() -> Dict[str, Any]:
             },
             "perf": {k: dict(v) for k, v in _agg["perf"].items()},
             "alerts": {k: dict(v) for k, v in _agg["alerts"].items()},
+            "quality": {k: dict(v) for k, v in _agg["quality"].items()},
             "emitted": _agg["emitted"],
         }
 
@@ -614,6 +638,22 @@ def _fold(event: Event) -> None:
         entry["value"] = event.value
         entry["threshold"] = event.threshold
         entry["message"] = event.message
+    elif isinstance(event, QualityEvent):
+        entry = _agg["quality"].setdefault(
+            (event.metric, event.slice_label, event.window),
+            {
+                "value": 0.0,
+                "count": 0,
+                "min": float("inf"),
+                "max": float("-inf"),
+                "step": 0,
+            },
+        )
+        entry["value"] = event.value
+        entry["count"] += 1
+        entry["min"] = min(entry["min"], event.value)
+        entry["max"] = max(entry["max"], event.value)
+        entry["step"] = event.step
     elif isinstance(event, SpanEvent):
         entry = _agg["spans"].setdefault(
             (event.name, event.phase),
@@ -771,6 +811,24 @@ def record_alert(
             value=float(value),
             threshold=float(threshold),
             message=message,
+        )
+    )
+
+
+def record_quality(
+    metric: str,
+    slice_label: str,
+    window: str,
+    value: float,
+    step: int = 0,
+) -> None:
+    emit(
+        QualityEvent(
+            metric=metric,
+            slice_label=slice_label,
+            window=window,
+            value=float(value),
+            step=int(step),
         )
     )
 

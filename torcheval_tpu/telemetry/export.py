@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import json
+import math
 import os
 from typing import Any, Dict, List, Optional, TextIO, Union
 
@@ -263,9 +264,13 @@ def _labels(**labels: Any) -> str:
 
 def _fmt(value: float) -> str:
     # Prometheus floats: integers render without the trailing .0 noise.
-    if float(value) == int(value):
+    # NaN/Inf (a 0/0 quality reading) render as Go-parseable literals.
+    value = float(value)
+    if not math.isfinite(value):
+        return repr(value)
+    if value == int(value):
         return str(int(value))
-    return repr(float(value))
+    return repr(value)
 
 
 def _histogram_lines(
@@ -542,6 +547,37 @@ def prometheus_text() -> str:
                 f"{agg['perf'][program]['peak_bytes']}"
             )
 
+    if agg["quality"]:
+        # Grafana-ready live model-quality gauges from the monitor
+        # (torcheval_tpu/monitor): one series per (metric, slice,
+        # window), slice="" for the global figure.  Sorted keys keep
+        # family/label ordering stable across scrapes.
+        out.append(
+            f"# HELP {_PREFIX}_quality Last model-quality reading from "
+            "the live monitor, by metric, slice, and window kind."
+        )
+        out.append(f"# TYPE {_PREFIX}_quality gauge")
+        for metric, slice_label, window in sorted(agg["quality"]):
+            entry = agg["quality"][(metric, slice_label, window)]
+            out.append(
+                f"{_PREFIX}_quality"
+                f"{_labels(metric=metric, slice=slice_label, window=window)} "
+                f"{_fmt(entry['value'])}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_quality_readings_total Quality readings "
+            "published since the last clear, by metric, slice, and "
+            "window kind."
+        )
+        out.append(f"# TYPE {_PREFIX}_quality_readings_total counter")
+        for metric, slice_label, window in sorted(agg["quality"]):
+            entry = agg["quality"][(metric, slice_label, window)]
+            out.append(
+                f"{_PREFIX}_quality_readings_total"
+                f"{_labels(metric=metric, slice=slice_label, window=window)} "
+                f"{entry['count']}"
+            )
+
     out.append(
         f"# HELP {_PREFIX}_alerts_total SLO rule violations recorded by "
         "the perfscope alert evaluator, by rule."
@@ -717,6 +753,23 @@ def format_report(report: Dict[str, Any]) -> str:
         )
         for program, route in sorted(perf["routes"].items()):
             buf.write(f"    {_format_perf_route(program, route)}\n")
+    quality = report.get("quality", {})
+    if quality.get("entries"):
+        buf.write("  quality:\n")
+        for entry in quality["entries"]:
+            where = f"[{entry['slice']}]" if entry["slice"] else "[global]"
+            buf.write(
+                f"    {entry['metric']}{where} ({entry['window']}): "
+                f"{entry['value']:.6g} "
+                f"(min {entry['min']:.6g}, max {entry['max']:.6g}, "
+                f"{entry['count']} readings, step {entry['step']})\n"
+            )
+        worst = quality.get("worst_slice")
+        if worst:
+            buf.write(
+                f"    worst slice: {worst['metric']}[{worst['slice']}] "
+                f"({worst['window']}) = {worst['value']:.6g}\n"
+            )
     alerts = report.get("alerts", {})
     if alerts:
         buf.write("  ALERTS:\n")
@@ -855,5 +908,22 @@ def format_fleet_report(fleet: Dict[str, Any]) -> str:
             f"  DATA HEALTH: host {host.get('process_index', '?')} "
             f"({host.get('hostname', '?')}) reported "
             f"{entry.get('findings', 0)} offending elements/batches\n"
+        )
+    quality = fleet.get("quality", {})
+    for entry in quality.get("per_metric", []):
+        where = f"[{entry['slice']}]" if entry["slice"] else "[global]"
+        buf.write(
+            f"  quality {entry['metric']}{where} ({entry['window']}): "
+            f"min {entry['min']:.6g} / mean {entry['mean']:.6g} / "
+            f"max {entry['max']:.6g} over {entry['hosts']} host(s)\n"
+        )
+    worst = quality.get("worst_slice") or {}
+    if worst.get("metric"):
+        host = worst.get("host", {})
+        buf.write(
+            f"  WORST SLICE: {worst['metric']}[{worst['slice']}] "
+            f"({worst['window']}) = {worst['value']:.6g} on host "
+            f"{host.get('process_index', '?')} "
+            f"({host.get('hostname', '?')})\n"
         )
     return buf.getvalue()

@@ -465,6 +465,38 @@ def _metric_roofline_pct(agg: Dict[str, Any]) -> float:
     return best
 
 
+def _metric_quality_min(agg: Dict[str, Any]) -> float:
+    """Lowest current quality reading across every (metric, slice,
+    window) the monitor has published — the "no cohort below the floor"
+    signal.  ``inf`` until the first reading lands, so a ``"<"`` floor
+    rule can never fire on no data."""
+    values = [entry["value"] for entry in agg["quality"].values()]
+    return min(values) if values else float("inf")
+
+
+def _metric_quality_worst_drop(agg: Dict[str, Any]) -> float:
+    """Largest (lifetime − decayed/window) gap over matching (metric,
+    slice) pairs: how far the freshest readings have fallen under the
+    run-so-far figure.  Positive means recent quality regressed — pair a
+    rule on this with the ``data_corrupt`` rule to tell input drift
+    from model drift.  0.0 when no windowed reading has a lifetime
+    counterpart yet."""
+    lifetime = {
+        (metric, slice_label): entry["value"]
+        for (metric, slice_label, window), entry in agg["quality"].items()
+        if window == "lifetime"
+    }
+    worst = 0.0
+    for (metric, slice_label, window), entry in agg["quality"].items():
+        if window == "lifetime":
+            continue
+        base = lifetime.get((metric, slice_label))
+        if base is None:
+            continue
+        worst = max(worst, base - entry["value"])
+    return worst
+
+
 SLO_METRICS: Dict[str, Callable[[Dict[str, Any]], float]] = {
     "retrace_total": _metric_retrace_total,
     "prefetch_stall_ratio": _metric_prefetch_stall_ratio,
@@ -472,6 +504,8 @@ SLO_METRICS: Dict[str, Callable[[Dict[str, Any]], float]] = {
     "data_health_corrupt": _metric_data_health_corrupt,
     "throughput_batches_per_sec": _metric_throughput,
     "roofline_hbm_pct": _metric_roofline_pct,
+    "quality_min": _metric_quality_min,
+    "quality_worst_drop": _metric_quality_worst_drop,
 }
 
 # Floor rules stay quiet until their signal exists at all (a throughput
@@ -489,6 +523,8 @@ def default_rules(
     data_health_corrupt_max: float = 0,
     throughput_floor: float = 0.0,
     roofline_floor_pct: float = 0.0,
+    quality_floor: float = 0.0,
+    quality_drop_max: float = 0.0,
 ) -> Tuple[SloRule, ...]:
     """A conservative starter rule set; floors default to 0 (disabled —
     pass your workload's numbers).  See ``docs/source/perfscope.rst``
@@ -546,6 +582,31 @@ def default_rules(
                 roofline_floor_pct,
                 "no route sustains the HBM-utilization floor — the hot "
                 "path is dispatch/reread-bound",
+            )
+        )
+    if quality_floor > 0:
+        out.append(
+            SloRule(
+                "quality_floor",
+                "quality_min",
+                "<",
+                quality_floor,
+                "a monitored metric (some slice/window) fell under the "
+                "quality floor — check report()['quality']['worst_slice'] "
+                "and the data-health findings for input drift",
+            )
+        )
+    if quality_drop_max > 0:
+        out.append(
+            SloRule(
+                "quality_drop",
+                "quality_worst_drop",
+                ">",
+                quality_drop_max,
+                "a decayed/windowed reading dropped this far below its "
+                "lifetime figure — recent quality regressed (cross-check "
+                "data_corrupt / data-health drift to separate feed issues "
+                "from model issues)",
             )
         )
     return tuple(out)
