@@ -1535,6 +1535,102 @@ def bench_collection_sliced_stream() -> Tuple[str, float, Optional[float]]:
     return "collection_sliced_stream", ours, ref, extras
 
 
+def bench_fleet_merge_scaling() -> Tuple[str, float, Optional[float]]:
+    """Hierarchical fleet merge vs flat gather over threaded LocalWorlds
+    (worlds 8/64/256): root-inbox fan-in reduction from the binary tree
+    and state-byte reduction from sketch-compressed payloads, with the
+    sketch value checked against the exact merge."""
+    import threading
+
+    from torcheval_tpu.distributed import LocalWorld
+    from torcheval_tpu.metrics import BinaryAUROC
+    from torcheval_tpu.metrics._sketch import state_nbytes
+    from torcheval_tpu.metrics.toolkit import get_synced_metric
+    from torcheval_tpu.parallel.fleet_merge import MergePolicy, fleet_merge
+
+    import jax.numpy as jnp
+
+    per_rank = 512
+    policy = MergePolicy(level_deadline=30.0)
+
+    def build(world):
+        rng = np.random.default_rng(7)
+        metrics = []
+        for _ in range(world):
+            scores = rng.random(per_rank)
+            targets = (rng.random(per_rank) < scores).astype(np.float64)
+            m = BinaryAUROC()
+            m.update(jnp.asarray(scores), jnp.asarray(targets))
+            metrics.append(m)
+        return metrics
+
+    def run(world, metrics, fn):
+        outs = [None] * world
+        w = LocalWorld(world)
+
+        def worker(rank):
+            outs[rank] = fn(metrics[rank], w.group(rank), rank)
+
+        threads = [
+            threading.Thread(target=worker, args=(r,)) for r in range(world)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return outs, time.perf_counter() - t0
+
+    def tree(sketch=None):
+        return lambda m, g, r: fleet_merge(
+            m, g, topology="tree", sketch=sketch, policy=policy
+        )
+
+    def flat(m, g, r):
+        synced = get_synced_metric(m, g, 0)
+        return synced.compute() if synced is not None else None
+
+    times = {}
+    for world in (8, 64):
+        metrics = build(world)
+        _, times[f"flat_ms_w{world}"] = run(world, metrics, flat)
+        outs, times[f"tree_ms_w{world}"] = run(world, metrics, tree())
+        assert not outs[0].partial
+    ours = 1.0 / times["tree_ms_w64"]
+
+    world = 256
+    metrics = build(world)
+    exact_outs, times["tree_ms_w256"] = run(world, metrics, tree())
+    sketch_outs, times["tree_sketch_ms_w256"] = run(
+        world, metrics, tree(sketch="histogram")
+    )
+    exact_root, sketch_root = exact_outs[0], sketch_outs[0]
+    sketch_err = abs(float(sketch_root.value) - float(exact_root.value))
+
+    state_bytes_total = sum(state_nbytes(m) for m in metrics)
+    extras = {
+        # The tree root hears from 2 children per round; the flat gather
+        # from world-1 peers at once.
+        "root_inbox_reduction_x": round((world - 1) / 2.0, 1),
+        "exact_root_payload_bytes": exact_root.payload_bytes_at_root,
+        "sketch_root_payload_bytes": sketch_root.payload_bytes_at_root,
+        "sketch_bytes_reduction_x": round(
+            exact_root.payload_bytes_at_root
+            / max(1, sketch_root.payload_bytes_at_root),
+            1,
+        ),
+        "sketch_auroc_abs_err": round(sketch_err, 5),
+        "exact_state_bytes_w256": state_bytes_total,
+        "world_effective_w256": exact_root.world_effective,
+        "roofline_note": "host-wire robustness workload (no device "
+        "kernel): ours = tree merges/sec at world 64; the extras bars "
+        "hold the fan-in and sketch-compression claims",
+    }
+    for key, seconds in times.items():
+        extras[key] = round(seconds * 1e3, 1)
+    return "fleet_merge_scaling", ours, None, extras
+
+
 ALL_WORKLOADS = [
     bench_accuracy,
     bench_binary_auroc,
@@ -1554,4 +1650,5 @@ ALL_WORKLOADS = [
     bench_perplexity,
     bench_windowed_auroc,
     bench_weighted_histogram,
+    bench_fleet_merge_scaling,
 ]

@@ -25,11 +25,15 @@ is zero/absent (a worker that never produced a number), or is marked
 Improvements and new workloads pass.
 
 Beyond the relative throughput comparison, a few rows carry **absolute
-bars** on their extras (``EXTRA_BARS``), checked on the fresh artifact
-alone: the live-monitor stack must stay under 5% on the sliced stream,
-and the sliced collection must dispatch exactly as many host programs
-as the unsliced one.  A missing row or key skips the bar (the workload
-did not run), it never fails it.
+bars** on their extras (``EXTRA_BARS`` ceilings, ``EXTRA_FLOORS``
+floors), checked on the fresh artifact alone: the live-monitor stack
+must stay under 5% on the sliced stream, the sliced collection must
+dispatch exactly as many host programs as the unsliced one, and the
+hierarchical fleet merge must keep its world=256 claims (root inbox
+fan-in reduced >=8x vs the flat gather, sketch payloads >=10x smaller
+than exact state with AUROC error inside the documented bound).  A
+missing row or key skips the bar (the workload did not run), it never
+fails it.
 """
 
 from __future__ import annotations
@@ -48,6 +52,15 @@ DEFAULT_THRESHOLD = 0.10
 # overhead-style extras, independent of any baseline.
 EXTRA_BARS = (
     ("collection_sliced_stream", "monitor_overhead_pct", 5.0),
+    ("fleet_merge_scaling", "sketch_auroc_abs_err", 0.02),
+)
+
+# (metric row, extras key, min required value) — absolute floors, for
+# claims that must stay TRUE at scale (the hierarchical merge's fan-in
+# and sketch-compression wins at world=256).
+EXTRA_FLOORS = (
+    ("fleet_merge_scaling", "root_inbox_reduction_x", 8.0),
+    ("fleet_merge_scaling", "sketch_bytes_reduction_x", 10.0),
 )
 
 # (metric row, extras key, extras key) — pairs that must be EQUAL, for
@@ -130,6 +143,16 @@ def check_extras(fresh_doc: Dict[str, Any]) -> List[str]:
             violations.append(
                 f"{metric}: {key}={float(value):.2f} exceeds the "
                 f"{ceiling:g} bar"
+            )
+    for metric, key, floor in EXTRA_FLOORS:
+        row = rows.get(metric)
+        value = row.get(key) if row else None
+        if value is None:
+            continue
+        if float(value) < floor:
+            violations.append(
+                f"{metric}: {key}={float(value):.2f} is under the "
+                f"{floor:g} floor"
             )
     for metric, key_a, key_b in EXTRA_PARITY:
         row = rows.get(metric)

@@ -1,0 +1,510 @@
+"""Elastic hierarchical fleet merge
+(torcheval_tpu/parallel/fleet_merge.py): clean tree/ring reductions are
+bit-identical to the flat gather, a rank dropped at any level is excised
+and re-parented around (the result goes partial instead of the run
+dying), no rank hangs past its deadline budget, no peer failure raises
+past the root, and the sketch-compressed payloads stay inside their
+documented error bounds."""
+
+import threading
+import time
+import unittest
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu.distributed import LocalWorld, PeerTimeoutError
+from torcheval_tpu.metrics import BinaryAUPRC, BinaryAUROC, MetricCollection
+from torcheval_tpu.metrics.toolkit import sync_and_compute
+from torcheval_tpu.parallel.fleet_merge import (
+    MergeOutcome,
+    MergePolicy,
+    PendingMerge,
+    fleet_merge,
+)
+from torcheval_tpu.resilience import FaultPlan, MembershipView
+from torcheval_tpu.resilience.faults import FaultRule
+
+pytestmark = pytest.mark.chaos
+
+# Small unit deadline keeps the drop scenarios (whose detection times
+# scale exponentially with subtree height) inside the tier-1 budget.
+_FAST = MergePolicy(level_deadline=0.25, poll_slice=0.01)
+_DROP = MergePolicy(level_deadline=0.1, poll_slice=0.01)
+
+
+def _data(rank, n=200):
+    rng = np.random.default_rng(100 + rank)
+    scores = rng.random(n)
+    targets = (rng.random(n) < scores).astype(np.float64)
+    return scores, targets
+
+
+def _metric(rank, cls=BinaryAUROC):
+    m = cls()
+    scores, targets = _data(rank)
+    m.update(jnp.asarray(scores), jnp.asarray(targets))
+    return m
+
+
+def _flat_value(ranks, cls=BinaryAUROC):
+    """The flat path's exact merge order: clone rank-0's state, fold the
+    rest in rank order."""
+    metrics = [_metric(r, cls) for r in ranks]
+    for m in metrics:
+        m._prepare_for_merge_state()
+    metrics[0].merge_state(metrics[1:])
+    return float(metrics[0].compute())
+
+
+def _run_merge(world, topology, policy, plan=None, dst=0, recipient=None,
+               sketch=None, make=None, join_timeout=90.0):
+    """One merge round over a LocalWorld, one thread per rank.  Returns
+    (outcomes, wall_seconds); asserts no thread hangs."""
+    w = LocalWorld(world)
+    outs = [None] * world
+    errors = []
+
+    def worker(rank):
+        try:
+            m = make(rank) if make is not None else _metric(rank)
+            outs[rank] = fleet_merge(
+                m, w.group(rank), topology=topology, dst=dst,
+                recipient=recipient, sketch=sketch, policy=policy,
+            )
+        except BaseException as exc:  # noqa: BLE001 - asserted below
+            errors.append((rank, exc))
+
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(world)
+    ]
+    started = time.monotonic()
+    if plan is not None:
+        plan.install()
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=join_timeout)
+        assert not any(t.is_alive() for t in threads), "merge hung"
+    finally:
+        if plan is not None:
+            plan.uninstall()
+    assert not errors, errors
+    return outs, time.monotonic() - started
+
+
+def _drop(rank, role="recv"):
+    return FaultPlan(
+        rules=(
+            FaultRule(
+                site="merge.level",
+                action="drop_rank",
+                match={"rank": rank, "role": role},
+            ),
+        ),
+        seed=0,
+    )
+
+
+class CleanRunBitIdentity(unittest.TestCase):
+    def test_tree_and_ring_match_flat_exactly(self):
+        for world in (2, 3, 5, 8):
+            reference = _flat_value(range(world))
+            for topology in ("tree", "ring"):
+                outs, _ = _run_merge(world, topology, _FAST)
+                root = outs[0]
+                self.assertEqual(float(root.value), reference,
+                                 (world, topology))
+                self.assertFalse(root.partial)
+                self.assertEqual(root.world_effective, world)
+                self.assertEqual(root.lost_ranks, ())
+                self.assertTrue(root.delivered)
+
+    def test_auprc_tree_matches_flat(self):
+        reference = _flat_value(range(4), BinaryAUPRC)
+        outs, _ = _run_merge(
+            4, "tree", _FAST, make=lambda r: _metric(r, BinaryAUPRC)
+        )
+        self.assertEqual(float(outs[0].value), reference)
+
+    def test_nonzero_dst_root(self):
+        reference = _flat_value(range(5))
+        outs, _ = _run_merge(5, "tree", _FAST, dst=3)
+        self.assertIsNone(outs[0].value)
+        self.assertEqual(float(outs[3].value), reference)
+
+    def test_recipient_all_delivers_value_everywhere(self):
+        reference = _flat_value(range(4))
+        outs, _ = _run_merge(4, "tree", _FAST, recipient="all")
+        for rank, out in enumerate(outs):
+            self.assertEqual(float(np.asarray(out.value)), reference, rank)
+            self.assertFalse(out.partial)
+
+    def test_world_one_short_circuits(self):
+        m = _metric(0)
+        out = fleet_merge(m, LocalWorld(1).group(0), topology="tree")
+        self.assertTrue(out.delivered)
+        self.assertEqual(out.world_effective, 1)
+        self.assertEqual(float(out.value), float(_metric(0).compute()))
+
+    def test_repeated_rounds_use_fresh_tags(self):
+        w = LocalWorld(2)
+        reference = _flat_value(range(2))
+        for _ in range(3):
+            outs = [None, None]
+
+            def worker(rank):
+                outs[rank] = fleet_merge(
+                    _metric(rank), w.group(rank),
+                    topology="tree", policy=_FAST,
+                )
+
+            threads = [
+                threading.Thread(target=worker, args=(r,)) for r in (0, 1)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            self.assertEqual(float(outs[0].value), reference)
+
+
+class DropScenarios(unittest.TestCase):
+    def test_leaf_drop_goes_partial_not_fatal(self):
+        world = 8  # rank 7 sits at leaf position 7
+        outs, _ = _run_merge(world, "tree", _DROP, plan=_drop(7, "start"))
+        root = outs[0]
+        self.assertEqual(root.lost_ranks, (7,))
+        self.assertEqual(root.world_effective, world - 1)
+        self.assertTrue(root.partial)
+        self.assertTrue(outs[7].dropped)
+        self.assertEqual(
+            float(root.value), _flat_value([0, 1, 2, 3, 4, 5, 6])
+        )
+
+    def test_inner_node_drop_reparents_subtree(self):
+        # Rank 1 roots the subtree {1, 3, 4, 7}; dropping it must lose
+        # ONLY rank 1 — ranks 3 and 4 climb to the root, 7 rides 3.
+        world = 8
+        outs, _ = _run_merge(world, "tree", _DROP, plan=_drop(1))
+        root = outs[0]
+        self.assertEqual(root.lost_ranks, (1,))
+        self.assertEqual(root.world_effective, world - 1)
+        self.assertTrue(root.partial)
+        self.assertEqual(
+            float(root.value), _flat_value([0, 2, 3, 4, 5, 6, 7])
+        )
+
+    def test_root_drop_never_hangs_and_delivers_nothing(self):
+        world = 8
+        outs, _ = _run_merge(world, "tree", _DROP, plan=_drop(0))
+        self.assertTrue(outs[0].dropped)
+        for rank in range(1, world):
+            self.assertIsNone(outs[rank].value, rank)
+        # The dead root's direct children exhaust every ancestor:
+        # partition, honestly reported as undelivered.
+        for rank in (1, 2):
+            self.assertFalse(outs[rank].delivered, rank)
+
+    def test_ring_middle_drop_skips_past_dead_rank(self):
+        world = 8
+        outs, _ = _run_merge(world, "ring", _DROP, plan=_drop(3))
+        root = outs[0]
+        self.assertIn(3, root.lost_ranks)
+        self.assertTrue(root.partial)
+        survivors = [r for r in range(world) if r not in root.lost_ranks]
+        self.assertEqual(float(root.value), _flat_value(survivors))
+
+    def test_drop_completes_within_deadline_budget(self):
+        # Worst-case detection chains are geometric in the unit budget;
+        # a generous multiple of the policy's own bounds must still hold.
+        world = 8
+        policy = _DROP
+        unit = policy.ack() + policy.grace()
+        budget = policy.poll_window(3) + policy.ack_wait(4) + 16 * unit
+        _, seconds = _run_merge(world, "tree", policy, plan=_drop(1))
+        self.assertLess(seconds, budget)
+
+    def test_no_exception_escapes_any_rank(self):
+        # _run_merge collects worker exceptions; a drop at every role on
+        # two different ranks must still produce MergeOutcomes all round.
+        plan = FaultPlan(
+            rules=(
+                FaultRule(site="merge.level", action="drop_rank",
+                          match={"rank": 5}),
+                FaultRule(site="merge.level", action="drop_rank",
+                          match={"rank": 6}),
+            ),
+            seed=0,
+        )
+        outs, _ = _run_merge(8, "tree", _DROP, plan=plan)
+        for out in outs:
+            self.assertIsInstance(out, MergeOutcome)
+        root = outs[0]
+        self.assertTrue(root.partial)
+        self.assertEqual(root.world_effective, 8 - len(root.lost_ranks))
+        for lost in root.lost_ranks:
+            self.assertIn(lost, (5, 6))
+
+    def test_slow_rank_straggler_still_completes_clean(self):
+        plan = FaultPlan(
+            rules=(
+                FaultRule(site="merge.level", action="slow_rank",
+                          match={"rank": 3, "role": "send"},
+                          delay_s=0.05),
+            ),
+            seed=0,
+        )
+        outs, _ = _run_merge(4, "tree", _FAST, plan=plan)
+        root = outs[0]
+        self.assertFalse(root.partial)
+        self.assertEqual(float(root.value), _flat_value(range(4)))
+
+
+class SketchMerge(unittest.TestCase):
+    def _exact(self, cls=BinaryAUROC):
+        return _flat_value(range(4), cls)
+
+    def _sketch_value(self, kind, cls=BinaryAUROC, **options):
+        sketches = []
+        for rank in range(4):
+            opts = dict(options)
+            if kind == "reservoir":
+                opts["salt"] = rank
+            sketches.append(_metric(rank, cls).sketch_state(kind, **opts))
+        base = sketches[0]
+        for other in sketches[1:]:
+            base.merge(other)
+        return float(base.compute())
+
+    def test_reservoir_error_bound(self):
+        # O(1/sqrt(capacity)); capacity 2048 over 800 total samples is
+        # lossless-ish, small capacity stays within a loose bound.
+        self.assertAlmostEqual(
+            self._sketch_value("reservoir", capacity=2048), self._exact(),
+            places=12,
+        )
+        err = abs(
+            self._sketch_value("reservoir", capacity=256) - self._exact()
+        )
+        self.assertLess(err, 0.08)
+
+    def test_histogram_error_bound(self):
+        err = abs(
+            self._sketch_value("histogram", bins=2048) - self._exact()
+        )
+        self.assertLess(err, 0.02)
+        ap_err = abs(
+            self._sketch_value("histogram", BinaryAUPRC, bins=2048)
+            - self._exact(BinaryAUPRC)
+        )
+        self.assertLess(ap_err, 0.02)
+
+    def test_count_sketch_error_bound(self):
+        err = abs(
+            self._sketch_value("count", width=8192, depth=5)
+            - self._exact()
+        )
+        self.assertLess(err, 0.05)
+
+    def test_tree_sketch_equals_flat_sketch_merge(self):
+        # Reservoir keeps canonical key order, so merge order (tree vs
+        # flat) cannot change the surviving sample set or its layout.
+        outs, _ = _run_merge(8, "tree", _FAST, sketch="reservoir")
+        sketches = []
+        for rank in range(8):
+            sketches.append(
+                _metric(rank).sketch_state("reservoir", salt=rank)
+            )
+        base = sketches[0]
+        for other in sketches[1:]:
+            base.merge(other)
+        self.assertEqual(float(outs[0].value), float(base.compute()))
+        self.assertGreater(outs[0].payload_bytes_at_root, 0)
+
+    def test_sketch_bytes_beat_exact_state_bytes(self):
+        from torcheval_tpu.metrics._sketch import state_nbytes
+
+        m = BinaryAUROC()
+        scores, targets = _data(0, n=50_000)
+        m.update(jnp.asarray(scores), jnp.asarray(targets))
+        m._prepare_for_merge_state()
+        exact_bytes = state_nbytes(m)
+        hist_bytes = m.sketch_state("histogram", bins=1024).nbytes()
+        self.assertGreater(exact_bytes / hist_bytes, 10.0)
+
+
+class ToolkitFrontDoor(unittest.TestCase):
+    def test_sync_and_compute_tree_returns_outcome(self):
+        w = LocalWorld(2)
+        outs = [None, None]
+
+        def worker(rank):
+            outs[rank] = sync_and_compute(
+                _metric(rank), w.group(rank),
+                topology="tree", merge_policy=_FAST,
+            )
+
+        threads = [
+            threading.Thread(target=worker, args=(r,)) for r in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        self.assertIsInstance(outs[0], MergeOutcome)
+        self.assertEqual(float(outs[0].value), _flat_value(range(2)))
+        self.assertIsNone(outs[1].value)
+
+    def test_sync_and_compute_flat_sketch_path(self):
+        w = LocalWorld(2)
+        outs = [None, None]
+
+        def worker(rank):
+            outs[rank] = sync_and_compute(
+                _metric(rank), w.group(rank),
+                topology="flat", sketch="histogram",
+                sketch_options={"bins": 2048},
+            )
+
+        threads = [
+            threading.Thread(target=worker, args=(r,)) for r in (0, 1)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        self.assertIsNone(outs[1])
+        self.assertLess(abs(float(outs[0]) - _flat_value(range(2))), 0.02)
+
+    def test_no_p2p_group_falls_back_to_flat_with_warning(self):
+        from torcheval_tpu.distributed import CollectiveGroup
+
+        class NoP2P(CollectiveGroup):
+            @property
+            def rank(self):
+                return 0
+
+            @property
+            def world_size(self):
+                return 2
+
+            def all_gather_object(self, obj):
+                return [obj, _prepared()]
+
+            def broadcast_object(self, obj, src):
+                return obj
+
+        def _prepared():
+            m = _metric(1)
+            m._prepare_for_merge_state()
+            return m
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            value = sync_and_compute(_metric(0), NoP2P(), topology="tree")
+        self.assertTrue(
+            any("point-to-point" in str(w.message) for w in caught)
+        )
+        self.assertEqual(float(value), _flat_value(range(2)))
+
+    def test_bad_topology_rejected(self):
+        with self.assertRaises(ValueError):
+            sync_and_compute(_metric(0), LocalWorld(1).group(0),
+                             topology="mesh")
+
+
+class MembershipUnit(unittest.TestCase):
+    def test_observe_excise_and_gossip(self):
+        view = MembershipView(8, rank=0)
+        self.assertEqual(view.world_effective, 8)
+        self.assertTrue(view.excise(3, reason="test"))
+        self.assertFalse(view.excise(3, reason="again"))  # idempotent
+        self.assertFalse(view.is_alive(3))
+        self.assertEqual(view.world_effective, 7)
+        view.merge_gossip([5, 6])
+        self.assertEqual(sorted(view.dead), [3, 5, 6])
+        self.assertEqual(view.survivors_label(), "0,1,2,4,7")
+
+    def test_observe_does_not_resurrect(self):
+        view = MembershipView(4, rank=0)
+        view.excise(2, reason="dead")
+        view.observe(2)
+        self.assertFalse(view.is_alive(2))
+
+
+class P2PTransport(unittest.TestCase):
+    def test_local_world_send_recv_roundtrip(self):
+        w = LocalWorld(2)
+        g0, g1 = w.group(0), w.group(1)
+        self.assertTrue(g0.supports_p2p)
+        g0.send_object({"x": 1}, dst=1, tag="t/0")
+        self.assertEqual(g1.recv_object(0, "t/0", timeout=1.0), {"x": 1})
+
+    def test_recv_timeout_raises_peer_timeout(self):
+        g = LocalWorld(2).group(0)
+        started = time.monotonic()
+        with self.assertRaises(PeerTimeoutError) as ctx:
+            g.recv_object(1, "never", timeout=0.05)
+        self.assertLess(time.monotonic() - started, 1.0)
+        self.assertEqual(ctx.exception.peer, 1)
+
+
+class EngineOverlap(unittest.TestCase):
+    def test_start_fleet_merge_overlaps_and_joins(self):
+        # The engine's fused path needs array-state members, so the
+        # overlap test rides an accuracy metric rather than AUROC.
+        from torcheval_tpu.engine import Evaluator
+        from torcheval_tpu.metrics import MulticlassAccuracy
+
+        w = LocalWorld(2)
+
+        def batch(rank):
+            rng = np.random.default_rng(200 + rank)
+            preds = rng.integers(0, 3, 64)
+            targets = rng.integers(0, 3, 64)
+            return jnp.asarray(preds), jnp.asarray(targets)
+
+        collection = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3)}
+        )
+        engine = Evaluator(collection, prefetch=False)
+        engine.step(*batch(0))
+
+        def peer():
+            other = MetricCollection(
+                {"acc": MulticlassAccuracy(num_classes=3)}
+            )
+            other.update(*batch(1))
+            fleet_merge(other, w.group(1), topology="tree", policy=_FAST)
+
+        peer_thread = threading.Thread(target=peer)
+        peer_thread.start()
+        pending = engine.start_fleet_merge(
+            w.group(0), topology="tree", policy=_FAST
+        )
+        self.assertIsInstance(pending, PendingMerge)
+        # The merge runs on its own thread; the engine keeps stepping
+        # (the post-snapshot step must not perturb the merged snapshot).
+        engine.step(*batch(0))
+        outcome = pending.result(timeout=30)
+        peer_thread.join(timeout=30)
+        self.assertTrue(outcome.delivered)
+        self.assertFalse(outcome.partial)
+
+        expected = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3)}
+        )
+        expected.update(*batch(0))
+        expected.update(*batch(1))
+        self.assertEqual(
+            float(outcome.value["acc"]), float(expected.compute()["acc"])
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -69,6 +69,22 @@ def kv_timeout_ms() -> int:
     return value
 
 
+class PeerTimeoutError(TimeoutError):
+    """A point-to-point receive waited past its budget.  Carries the
+    ``peer`` rank so the retry layer (``resilience.retry._peer_of``) and
+    the hierarchical merge (``parallel.fleet_merge``) can attribute the
+    silence to a specific host."""
+
+    def __init__(self, peer: int, tag: str, timeout: Optional[float]) -> None:
+        self.peer = peer
+        self.tag = tag
+        self.timeout = timeout
+        budget = f" after {timeout:g}s" if timeout is not None else ""
+        super().__init__(
+            f"no message from rank {peer} for tag {tag!r}{budget}"
+        )
+
+
 class CollectiveGroup(ABC):
     """Process-group abstraction (reference ``PGWrapper``, ``toolkit.py:16``)."""
 
@@ -79,6 +95,32 @@ class CollectiveGroup(ABC):
     @property
     @abstractmethod
     def world_size(self) -> int: ...
+
+    @property
+    def supports_p2p(self) -> bool:
+        """Whether :meth:`send_object`/:meth:`recv_object` work on this
+        group.  The hierarchical merge (``parallel.fleet_merge``) needs
+        them; groups without p2p fall back to the flat gather path."""
+        return False
+
+    def send_object(self, obj: Any, dst: int, tag: str) -> None:
+        """Ship one picklable object to rank ``dst`` under ``tag``
+        (fire-and-forget; pairing with :meth:`recv_object` is the
+        caller's protocol).  Tags must be unique per logical message —
+        the hierarchical merge derives them from (round, level, rank)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no point-to-point object channel."
+        )
+
+    def recv_object(
+        self, src: int, tag: str, timeout: Optional[float] = None
+    ) -> Any:
+        """Receive the object rank ``src`` sent under ``tag``; raises
+        :class:`PeerTimeoutError` (carrying ``src``) when nothing
+        arrives within ``timeout`` seconds (None = backend default)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no point-to-point object channel."
+        )
 
     @abstractmethod
     def all_gather_object(self, obj: Any) -> List[Any]:
@@ -389,6 +431,66 @@ class JaxProcessGroup(CollectiveGroup):
             client.key_value_delete(f"{prefix}/{peer}/")
         return out
 
+    # ------------------------------------------------------------ p2p
+    @property
+    def supports_p2p(self) -> bool:
+        return self._kv_client() is not None
+
+    def send_object(self, obj: Any, dst: int, tag: str) -> None:
+        """Point-to-point object send over the coordination-service KV
+        store (the same chunked-b64 wire as ``gather_object``).  The
+        receiver deletes the keys after reading; an unclaimed message
+        (receiver excised the sender first) leaks its keys until the
+        coordinator exits — bounded by the merge payload, and why tags
+        must be unique per logical message."""
+        client = self._kv_client()
+        if client is None:
+            raise NotImplementedError(
+                "JaxProcessGroup point-to-point needs the coordination "
+                "service (jax.distributed.initialize)."
+            )
+        import base64
+
+        payload = pickle.dumps(obj)
+        prefix = f"torcheval_tpu/p2p/{tag}/{self.rank}->{dst}"
+        chunks = [
+            payload[i : i + self._KV_CHUNK]
+            for i in range(0, max(len(payload), 1), self._KV_CHUNK)
+        ]
+        for i, chunk in enumerate(chunks):
+            client.key_value_set(
+                f"{prefix}/{i}", base64.b64encode(chunk).decode("ascii")
+            )
+        client.key_value_set(f"{prefix}/n", str(len(chunks)))
+
+    def recv_object(
+        self, src: int, tag: str, timeout: Optional[float] = None
+    ) -> Any:
+        client = self._kv_client()
+        if client is None:
+            raise NotImplementedError(
+                "JaxProcessGroup point-to-point needs the coordination "
+                "service (jax.distributed.initialize)."
+            )
+        import base64
+
+        prefix = f"torcheval_tpu/p2p/{tag}/{src}->{self.rank}"
+        timeout_ms = (
+            kv_timeout_ms() if timeout is None else max(1, int(timeout * 1e3))
+        )
+        try:
+            n = int(client.blocking_key_value_get(f"{prefix}/n", timeout_ms))
+            payload = b"".join(
+                base64.b64decode(
+                    client.blocking_key_value_get(f"{prefix}/{i}", timeout_ms)
+                )
+                for i in range(n)
+            )
+        except Exception as exc:
+            raise PeerTimeoutError(src, tag, timeout) from exc
+        client.key_value_delete(f"{prefix}/")
+        return pickle.loads(payload)
+
     @staticmethod
     def _kv_client():
         try:
@@ -413,6 +515,12 @@ class LocalWorld:
         self._world_size = world_size
         self._barrier = threading.Barrier(world_size)
         self._slots: List[Any] = [None] * world_size
+        # Point-to-point mailboxes: (dst, src, tag) -> pickled payload.
+        # Condition-based (no barrier) so a vanished rank can never hang
+        # its peers — receivers time out instead (PeerTimeoutError), the
+        # failure mode the elastic merge is built around.
+        self._mail: dict = {}
+        self._mail_cv = threading.Condition()
 
     @property
     def world_size(self) -> int:
@@ -521,6 +629,44 @@ class LocalGroup(CollectiveGroup):
                 "local_gather_object", time.monotonic() - t0, len(payload)
             )
         return result
+
+    # ------------------------------------------------------------ p2p
+    @property
+    def supports_p2p(self) -> bool:
+        return True
+
+    def send_object(self, obj: Any, dst: int, tag: str) -> None:
+        if not 0 <= dst < self.world_size:
+            raise ValueError(
+                f"dst must be a rank in [0, {self.world_size}), got {dst}."
+            )
+        # Pickle on the sender like the pod wire; delivery is a mailbox
+        # put, so sending to a dead rank cannot block (the payload just
+        # goes unclaimed — its contribution is what the receiver's
+        # timeout path accounts as lost).
+        payload = pickle.dumps(obj)
+        cv = self._world._mail_cv
+        with cv:
+            self._world._mail[(dst, self._rank, tag)] = payload
+            cv.notify_all()
+
+    def recv_object(
+        self, src: int, tag: str, timeout: Optional[float] = None
+    ) -> Any:
+        key = (self._rank, src, tag)
+        cv = self._world._mail_cv
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with cv:
+            while key not in self._world._mail:
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise PeerTimeoutError(src, tag, timeout)
+                if not cv.wait(remaining):
+                    raise PeerTimeoutError(src, tag, timeout)
+            payload = self._world._mail.pop(key)
+        return pickle.loads(payload)
 
 
 def default_group() -> CollectiveGroup:

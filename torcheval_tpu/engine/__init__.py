@@ -259,6 +259,51 @@ class Evaluator:
         self.flush()
         return self._collection.compute()
 
+    def start_fleet_merge(
+        self,
+        group: Any,
+        *,
+        topology: str = "tree",
+        sketch: Optional[str] = None,
+        sketch_options: Optional[Dict[str, Any]] = None,
+        recipient: Any = None,
+        policy: Any = None,
+        membership: Any = None,
+    ) -> Any:
+        """Overlap a cross-host fleet merge with further eval work.
+
+        Flushes any buffered partial block, snapshots the collection,
+        and runs :func:`torcheval_tpu.parallel.fleet_merge.fleet_merge`
+        over the snapshot on a daemon thread — the caller keeps feeding
+        :meth:`step`/:meth:`run` while the merge's per-level hops (and
+        their retry deadlines) proceed in the background.  Returns a
+        :class:`~torcheval_tpu.parallel.fleet_merge.PendingMerge`;
+        ``.result()`` joins and yields the
+        :class:`~torcheval_tpu.parallel.fleet_merge.MergeOutcome`
+        (partial-result semantics included — a lost host degrades the
+        outcome, it never raises into the eval loop)."""
+        from copy import deepcopy
+
+        from torcheval_tpu.parallel.fleet_merge import (
+            PendingMerge,
+            fleet_merge,
+        )
+
+        self.flush()
+        snapshot = deepcopy(self._collection)
+        return PendingMerge(
+            fleet_merge,
+            (snapshot, group),
+            {
+                "topology": topology,
+                "sketch": sketch,
+                "sketch_options": sketch_options,
+                "recipient": recipient,
+                "policy": policy,
+                "membership": membership,
+            },
+        )
+
     def warmup(
         self,
         example_batch: Iterable[Any],

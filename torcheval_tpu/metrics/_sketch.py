@@ -1,0 +1,517 @@
+"""Mergeable sketch states — O(bins) merge payloads for sample-buffer
+metrics.
+
+The hierarchical fleet merge (:mod:`torcheval_tpu.parallel.fleet_merge`)
+ships a payload per tree level; for counter metrics that payload is a
+few scalars, but buffer metrics (AUROC, AUPRC, PR curves) carry every
+sample, so the bytes through the root grow O(total samples).  A *sketch*
+is a fixed-size summary of a buffer with two properties: it **merges by
+a commutative, associative operation** (so tree order doesn't matter)
+and its compute error is **bounded as a function of the sketch size
+only** (so the accuracy/bytes trade is explicit).
+
+Four kinds, selected by ``Metric.sketch_state(kind=...)``:
+
+``"exact"`` — :class:`ExactSketch`
+    The whole prepared metric; lossless, payload O(samples).  The
+    default for every metric; the only kind the base class supports.
+``"reservoir"`` — :class:`ReservoirSketch`
+    Bottom-k priority sampling over (score, target) pairs: each sample
+    draws a uniform key from a seeded stream; merge keeps the k smallest
+    keys from either side.  Order-independent, so tree and flat merges
+    keep the *same* k samples.  A u-statistic over the kept samples
+    (AUROC is one) has standard error **O(1/sqrt(capacity))** —
+    capacity 4096 gives ~0.016 one-sigma on AUROC.
+``"histogram"`` — :class:`HistogramSketch`
+    Per-class binned score counts over [0, 1] (scores clipped); merge
+    is elementwise addition.  Rank-based curve metrics computed from the
+    bins are off by at most the within-bin rank ambiguity: absolute
+    error **O(1/bins)** for AUROC/AUPRC — 1024 bins gives < 1e-3.
+``"count"`` — :class:`CountSketchState`
+    A signed count-sketch (depth x width hashed counters) over the
+    discretized score distribution, one sheet per class.  Per-bin count
+    estimates err by at most **n / sqrt(width)** with probability
+    1 - 2^-depth (median-of-depth estimator); useful when the score
+    distribution is heavy-hitter dominated and width << bins.  Curve
+    metrics inherit the per-bin count error on top of the histogram's
+    O(1/bins) discretization.
+
+Sketches travel pickled (numpy arrays only — no device state), merge in
+place via :meth:`Sketch.merge`, report their wire size via
+:meth:`Sketch.nbytes`, and produce the final metric value via
+:meth:`Sketch.compute`.  ``ExactSketch`` and ``ReservoirSketch`` also
+restore into a live metric (``Metric.merge_sketch``); the bin-domain
+kinds are terminal — their samples are gone, use ``.compute()``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+
+def _auc_from_histogram(pos: np.ndarray, neg: np.ndarray) -> float:
+    """AUROC from per-bin positive/negative counts (ascending score
+    order): each positive beats every negative in a strictly lower bin
+    and ties (0.5) the negatives sharing its bin."""
+    p, n = float(pos.sum()), float(neg.sum())
+    if p == 0.0 or n == 0.0:
+        return 0.0
+    neg_below = np.concatenate(([0.0], np.cumsum(neg)[:-1]))
+    wins = float((pos * neg_below).sum()) + 0.5 * float((pos * neg).sum())
+    return wins / (p * n)
+
+
+def _ap_from_histogram(pos: np.ndarray, neg: np.ndarray) -> float:
+    """Average precision from per-bin counts: sweep bins in descending
+    score order, accumulate (recall delta x precision) per bin."""
+    p = float(pos.sum())
+    if p == 0.0:
+        return 0.0
+    pos_d, neg_d = pos[::-1].astype(np.float64), neg[::-1].astype(np.float64)
+    tp = np.cumsum(pos_d)
+    fp = np.cumsum(neg_d)
+    denom = np.maximum(tp + fp, 1e-12)
+    precision = tp / denom
+    return float((pos_d * precision).sum() / p)
+
+
+def _compute_from_samples(metric_kind: str, scores, targets) -> Any:
+    import jax.numpy as jnp
+
+    from torcheval_tpu.metrics.functional.classification.auprc import (
+        _binary_auprc_compute,
+    )
+    from torcheval_tpu.metrics.functional.classification.auroc import (
+        _binary_auroc_compute,
+    )
+
+    scores = jnp.asarray(np.asarray(scores))
+    targets = jnp.asarray(np.asarray(targets))
+    if metric_kind == "binary_auroc":
+        return _binary_auroc_compute(scores, targets, False)
+    if metric_kind == "binary_auprc":
+        return _binary_auprc_compute(scores, targets)
+    raise ValueError(f"unknown sketched metric kind {metric_kind!r}")
+
+
+def _compute_from_bins(metric_kind: str, pos: np.ndarray, neg: np.ndarray):
+    import jax.numpy as jnp
+
+    if metric_kind == "binary_auroc":
+        return jnp.asarray(_auc_from_histogram(pos, neg))
+    if metric_kind == "binary_auprc":
+        return jnp.asarray(_ap_from_histogram(pos, neg))
+    raise ValueError(f"unknown sketched metric kind {metric_kind!r}")
+
+
+class Sketch:
+    """Interface every sketch kind implements; see the module docstring
+    for the merge/size/error contract."""
+
+    kind: str = ""
+    metric_kind: str = ""
+
+    def merge(self, other: "Sketch") -> "Sketch":
+        raise NotImplementedError
+
+    def nbytes(self) -> int:
+        raise NotImplementedError
+
+    def compute(self) -> Any:
+        raise NotImplementedError
+
+    def merge_into(self, metric: Any) -> None:
+        """Restore this (merged) sketch into a live metric, when the
+        sketch domain permits it."""
+        raise NotImplementedError(
+            f"{type(self).__name__} is bin-domain: its samples are gone, "
+            "so it cannot repopulate a buffer metric. Read the fleet "
+            "value from sketch.compute() instead."
+        )
+
+    def _check_mergeable(self, other: "Sketch") -> None:
+        if type(other) is not type(self):
+            raise TypeError(
+                f"cannot merge {type(other).__name__} into "
+                f"{type(self).__name__}"
+            )
+        if other.metric_kind != self.metric_kind:
+            raise ValueError(
+                f"cannot merge a {other.metric_kind!r} sketch into a "
+                f"{self.metric_kind!r} sketch"
+            )
+
+
+class ExactSketch(Sketch):
+    """The identity sketch: the whole prepared metric rides the wire.
+
+    Lossless — merge is ``merge_state`` in arrival order, so a tree
+    merge that delivers envelopes in rank order is bit-identical to the
+    flat gather-and-merge.  Payload is O(samples); this is the baseline
+    the compressed kinds are measured against."""
+
+    kind = "exact"
+
+    def __init__(self, metric: Any) -> None:
+        self.metric = metric
+
+    @classmethod
+    def from_metric(cls, metric: Any) -> "ExactSketch":
+        metric._prepare_for_merge_state()
+        return cls(copy.deepcopy(metric))
+
+    def merge(self, other: "Sketch") -> "ExactSketch":
+        if not isinstance(other, ExactSketch):
+            raise TypeError(
+                f"cannot merge {type(other).__name__} into ExactSketch"
+            )
+        self.metric.merge_state([other.metric])
+        return self
+
+    def nbytes(self) -> int:
+        return state_nbytes(self.metric)
+
+    def compute(self) -> Any:
+        return self.metric.compute()
+
+    def merge_into(self, metric: Any) -> None:
+        metric.merge_state([self.metric])
+
+
+class ReservoirSketch(Sketch):
+    """Mergeable uniform sample of (score, target) pairs, bottom-k by
+    seeded key.
+
+    Each source sample draws a key from ``default_rng((seed, salt))`` —
+    ``salt`` MUST differ per producing rank (the fleet merge passes the
+    rank) or two ranks' streams collide and the joint sample is no
+    longer uniform.  Merge concatenates and keeps the ``capacity``
+    smallest keys, which commutes and associates: any merge order keeps
+    the same sample.  Error: a u-statistic over k uniform samples has
+    standard error O(1/sqrt(k))."""
+
+    kind = "reservoir"
+
+    def __init__(
+        self,
+        metric_kind: str,
+        capacity: int,
+        keys: np.ndarray,
+        scores: np.ndarray,
+        targets: np.ndarray,
+        total_seen: int,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.metric_kind = metric_kind
+        self.capacity = int(capacity)
+        self.keys = keys
+        self.scores = scores
+        self.targets = targets
+        self.total_seen = int(total_seen)
+
+    @classmethod
+    def from_samples(
+        cls,
+        metric_kind: str,
+        scores: np.ndarray,
+        targets: np.ndarray,
+        *,
+        capacity: int = 4096,
+        seed: int = 0,
+        salt: int = 0,
+    ) -> "ReservoirSketch":
+        scores = np.asarray(scores, dtype=np.float32).reshape(-1)
+        targets = np.asarray(targets, dtype=np.float32).reshape(-1)
+        rng = np.random.default_rng((int(seed), int(salt)))
+        keys = rng.random(scores.shape[0])
+        sketch = cls(
+            metric_kind,
+            capacity,
+            keys,
+            scores,
+            targets,
+            total_seen=scores.shape[0],
+        )
+        sketch._shrink()
+        return sketch
+
+    def _shrink(self) -> None:
+        # Canonical order: ALWAYS sorted by key (not just when over
+        # capacity), so any merge order — flat, tree, ring — leaves the
+        # identical array in the identical order and downstream compute
+        # is bit-reproducible across topologies.
+        order = np.argsort(self.keys, kind="stable")[: self.capacity]
+        self.keys = self.keys[order]
+        self.scores = self.scores[order]
+        self.targets = self.targets[order]
+
+    def merge(self, other: "Sketch") -> "ReservoirSketch":
+        self._check_mergeable(other)
+        self.capacity = min(self.capacity, other.capacity)
+        self.keys = np.concatenate([self.keys, other.keys])
+        self.scores = np.concatenate([self.scores, other.scores])
+        self.targets = np.concatenate([self.targets, other.targets])
+        self.total_seen += other.total_seen
+        self._shrink()
+        return self
+
+    def nbytes(self) -> int:
+        return int(
+            self.keys.nbytes + self.scores.nbytes + self.targets.nbytes
+        )
+
+    def compute(self) -> Any:
+        return _compute_from_samples(
+            self.metric_kind, self.scores, self.targets
+        )
+
+    def merge_into(self, metric: Any) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        # Sample-domain: repopulate the metric's buffers with the kept
+        # sample (the fleet-wide approximation of its merged state).
+        metric.inputs = [
+            jax.device_put(jnp.asarray(self.scores), metric.device)
+        ]
+        metric.targets = [
+            jax.device_put(jnp.asarray(self.targets), metric.device)
+        ]
+
+
+class HistogramSketch(Sketch):
+    """Per-class binned score counts over [0, 1]; merge is addition.
+
+    Scores are clipped into [0, 1] (probability-scale metrics) and
+    counted into ``bins`` uniform bins per class.  Rank statistics
+    computed from the bins treat within-bin order as ties, so AUROC /
+    average-precision error is bounded by the within-bin mass:
+    absolute error O(1/bins)."""
+
+    kind = "histogram"
+
+    def __init__(
+        self, metric_kind: str, pos: np.ndarray, neg: np.ndarray
+    ) -> None:
+        self.metric_kind = metric_kind
+        self.pos = pos
+        self.neg = neg
+
+    @classmethod
+    def from_samples(
+        cls,
+        metric_kind: str,
+        scores: np.ndarray,
+        targets: np.ndarray,
+        *,
+        bins: int = 1024,
+    ) -> "HistogramSketch":
+        if bins < 2:
+            raise ValueError(f"bins must be >= 2, got {bins}")
+        scores = np.asarray(scores, dtype=np.float64).reshape(-1)
+        targets = np.asarray(targets).reshape(-1)
+        idx = np.clip((scores * bins).astype(np.int64), 0, bins - 1)
+        is_pos = targets > 0.5
+        pos = np.bincount(idx[is_pos], minlength=bins).astype(np.int64)
+        neg = np.bincount(idx[~is_pos], minlength=bins).astype(np.int64)
+        return cls(metric_kind, pos, neg)
+
+    def merge(self, other: "Sketch") -> "HistogramSketch":
+        self._check_mergeable(other)
+        if other.pos.shape != self.pos.shape:
+            raise ValueError(
+                f"bin-count mismatch: {self.pos.shape[0]} vs "
+                f"{other.pos.shape[0]}"
+            )
+        self.pos = self.pos + other.pos
+        self.neg = self.neg + other.neg
+        return self
+
+    def nbytes(self) -> int:
+        return int(self.pos.nbytes + self.neg.nbytes)
+
+    def compute(self) -> Any:
+        return _compute_from_bins(self.metric_kind, self.pos, self.neg)
+
+
+class CountSketchState(Sketch):
+    """Signed count-sketch over the discretized score distribution.
+
+    Two depth x width counter sheets (one per class); each of the
+    ``bins`` score cells hashes to one column per row with a +/-1 sign
+    (multiply-shift hashing seeded from ``seed``, so every rank builds
+    the same hash family and merge stays elementwise addition).  A
+    cell's count is recovered as the median of its depth signed
+    readings: error <= n/sqrt(width) with probability 1 - 2^-depth.
+    Curve metrics are computed from the recovered histogram and add
+    that count error to the histogram's O(1/bins) discretization."""
+
+    kind = "count"
+    _MASK = (1 << 61) - 1
+
+    def __init__(
+        self,
+        metric_kind: str,
+        pos: np.ndarray,
+        neg: np.ndarray,
+        bins: int,
+        seed: int,
+    ) -> None:
+        self.metric_kind = metric_kind
+        self.pos = pos            # (depth, width) signed counts
+        self.neg = neg
+        self.bins = int(bins)
+        self.seed = int(seed)
+
+    @classmethod
+    def _hash_family(
+        cls, depth: int, bins: int, width: int, seed: int
+    ) -> tuple:
+        rng = np.random.default_rng(int(seed))
+        a = rng.integers(1, cls._MASK, size=(depth, 1), dtype=np.int64) | 1
+        b = rng.integers(0, cls._MASK, size=(depth, 1), dtype=np.int64)
+        cells = np.arange(bins, dtype=np.int64)[None, :]
+        h = (a * cells + b) & cls._MASK
+        cols = (h % width).astype(np.int64)                 # (depth, bins)
+        signs = (((h >> 32) & 1) * 2 - 1).astype(np.int64)  # (depth, bins)
+        return cols, signs
+
+    @classmethod
+    def from_samples(
+        cls,
+        metric_kind: str,
+        scores: np.ndarray,
+        targets: np.ndarray,
+        *,
+        width: int = 1024,
+        depth: int = 5,
+        bins: int = 8192,
+        seed: int = 0,
+    ) -> "CountSketchState":
+        if width < 1 or depth < 1:
+            raise ValueError(
+                f"width/depth must be >= 1, got {width}/{depth}"
+            )
+        scores = np.asarray(scores, dtype=np.float64).reshape(-1)
+        targets = np.asarray(targets).reshape(-1)
+        idx = np.clip((scores * bins).astype(np.int64), 0, bins - 1)
+        is_pos = targets > 0.5
+        pos_counts = np.bincount(idx[is_pos], minlength=bins)
+        neg_counts = np.bincount(idx[~is_pos], minlength=bins)
+        cols, signs = cls._hash_family(depth, bins, width, seed)
+        pos = np.zeros((depth, width), dtype=np.int64)
+        neg = np.zeros((depth, width), dtype=np.int64)
+        for r in range(depth):
+            np.add.at(pos[r], cols[r], signs[r] * pos_counts)
+            np.add.at(neg[r], cols[r], signs[r] * neg_counts)
+        return cls(metric_kind, pos, neg, bins, seed)
+
+    def merge(self, other: "Sketch") -> "CountSketchState":
+        self._check_mergeable(other)
+        if (
+            other.pos.shape != self.pos.shape
+            or other.bins != self.bins
+            or other.seed != self.seed
+        ):
+            raise ValueError(
+                "count-sketch geometry mismatch: both sides must share "
+                "width/depth/bins/seed"
+            )
+        self.pos = self.pos + other.pos
+        self.neg = self.neg + other.neg
+        return self
+
+    def nbytes(self) -> int:
+        return int(self.pos.nbytes + self.neg.nbytes)
+
+    def _recover(self, mat: np.ndarray) -> np.ndarray:
+        depth, width = mat.shape
+        cols, signs = self._hash_family(depth, self.bins, width, self.seed)
+        readings = signs * np.take_along_axis(mat, cols, axis=1)
+        return np.maximum(np.median(readings, axis=0), 0.0)
+
+    def compute(self) -> Any:
+        return _compute_from_bins(
+            self.metric_kind, self._recover(self.pos), self._recover(self.neg)
+        )
+
+
+def state_nbytes(metric: Any) -> int:
+    """Wire-size proxy for a metric: total bytes of its state arrays."""
+    total = 0
+    for value in metric.state_dict().values():
+        if isinstance(value, (list, tuple)):
+            total += sum(int(np.asarray(v).nbytes) for v in value)
+        elif isinstance(value, dict):
+            total += sum(int(np.asarray(v).nbytes) for v in value.values())
+        else:
+            total += int(np.asarray(value).nbytes)
+    return total
+
+
+_SAMPLE_KINDS = ("exact", "reservoir", "histogram", "count")
+
+
+def sketch_from_buffers(
+    metric: Any,
+    metric_kind: str,
+    kind: str,
+    *,
+    capacity: int = 4096,
+    bins: int = 1024,
+    width: int = 1024,
+    depth: int = 5,
+    seed: int = 0,
+    salt: int = 0,
+) -> Sketch:
+    """Build a sketch from a buffer metric's ``inputs``/``targets`` lists
+    — the shared implementation behind the BinaryAUROC/BinaryAUPRC
+    ``sketch_state`` overrides."""
+    if kind not in _SAMPLE_KINDS:
+        raise ValueError(
+            f"sketch kind must be one of {_SAMPLE_KINDS}, got {kind!r}"
+        )
+    if kind == "exact":
+        return ExactSketch.from_metric(metric)
+    if getattr(metric, "num_tasks", 1) != 1:
+        raise ValueError(
+            "compressed sketches support num_tasks=1 only; "
+            "use kind='exact' for multi-task buffers"
+        )
+    if metric.inputs:
+        scores = np.concatenate(
+            [np.asarray(v).reshape(-1) for v in metric.inputs]
+        )
+        targets = np.concatenate(
+            [np.asarray(v).reshape(-1) for v in metric.targets]
+        )
+    else:
+        scores = np.zeros(0, dtype=np.float32)
+        targets = np.zeros(0, dtype=np.float32)
+    if kind == "reservoir":
+        return ReservoirSketch.from_samples(
+            metric_kind, scores, targets,
+            capacity=capacity, seed=seed, salt=salt,
+        )
+    if kind == "histogram":
+        return HistogramSketch.from_samples(
+            metric_kind, scores, targets, bins=bins
+        )
+    return CountSketchState.from_samples(
+        metric_kind, scores, targets,
+        width=width, depth=depth, seed=seed,
+    )
+
+
+def merge_sketches(
+    base: Sketch, others: Iterable[Optional[Sketch]]
+) -> Sketch:
+    """Fold ``others`` (Nones skipped — excised ranks) into ``base``."""
+    for other in others:
+        if other is not None:
+            base.merge(other)
+    return base

@@ -64,6 +64,15 @@ class BinaryAUPRC(Metric[jax.Array]):
     def _prepare_for_merge_state(self) -> None:
         prepare_concat_buffers(self, "inputs", "targets", dim=-1)
 
+    def sketch_state(self, kind: str = "exact", **options):
+        """O(bins) mergeable summaries of the sample buffers for the
+        hierarchical fleet merge — same kinds and bounds as
+        :meth:`BinaryAUROC.sketch_state`
+        (:mod:`torcheval_tpu.metrics._sketch`)."""
+        from torcheval_tpu.metrics._sketch import sketch_from_buffers
+
+        return sketch_from_buffers(self, "binary_auprc", kind, **options)
+
 
 class MulticlassAUPRC(Metric[jax.Array]):
     """One-vs-rest average precision with macro/None averaging."""

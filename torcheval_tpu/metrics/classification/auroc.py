@@ -73,6 +73,17 @@ class BinaryAUROC(Metric[jax.Array]):
     def _prepare_for_merge_state(self) -> None:
         prepare_concat_buffers(self, "inputs", "targets", dim=-1)
 
+    def sketch_state(self, kind: str = "exact", **options):
+        """O(bins) mergeable summaries of the sample buffers for the
+        hierarchical fleet merge: ``"reservoir"`` (``capacity=``, error
+        O(1/sqrt(capacity))), ``"histogram"`` (``bins=``, error
+        O(1/bins)), ``"count"`` (``width=``/``depth=``, per-bin count
+        error n/sqrt(width)), or lossless ``"exact"``.  See
+        :mod:`torcheval_tpu.metrics._sketch`."""
+        from torcheval_tpu.metrics._sketch import sketch_from_buffers
+
+        return sketch_from_buffers(self, "binary_auroc", kind, **options)
+
 
 class MulticlassAUROC(Metric[jax.Array]):
     """One-vs-rest multiclass AUROC (reference ``auroc.py:93-229``)."""

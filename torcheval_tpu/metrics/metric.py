@@ -260,6 +260,37 @@ class Metric(Generic[TComputeReturn], ABC):
         """Optional pre-sync hook: canonicalize list-states to a single array
         so cross-process gather ships one buffer (reference ``metric.py:112-121``)."""
 
+    # ---------------------------------------------------------------- sketch
+    def sketch_state(self, kind: str = "exact", **options: Any) -> Any:
+        """Compress this metric's state into a mergeable sketch for the
+        hierarchical fleet merge (:mod:`torcheval_tpu.metrics._sketch`).
+
+        The base class supports only ``kind="exact"`` — the whole
+        prepared metric, lossless, payload O(samples).  Buffer metrics
+        with compressible state (BinaryAUROC, BinaryAUPRC) override this
+        to also offer ``"reservoir"`` / ``"histogram"`` / ``"count"``
+        with documented error bounds; see the ``_sketch`` module
+        docstring for the bounds and ``docs/source/fleet.rst`` for
+        selection guidance.
+        """
+        from torcheval_tpu.metrics._sketch import ExactSketch
+
+        if kind != "exact":
+            raise ValueError(
+                f"{type(self).__name__} supports only kind='exact' "
+                f"sketches, got {kind!r}"
+            )
+        return ExactSketch.from_metric(self)
+
+    def merge_sketch(self: TSelf, sketch: Any) -> TSelf:
+        """Absorb a (merged) sketch back into this metric so a following
+        ``compute()`` reflects the fleet.  Sample-domain sketches (exact,
+        reservoir) restore; bin-domain sketches (histogram, count) are
+        terminal and raise — read their value from ``sketch.compute()``.
+        """
+        sketch.merge_into(self)
+        return self
+
     def reset(self: TSelf) -> TSelf:
         """Re-initialize every state from its default on the current device
         (reference ``metric.py:123-156``)."""

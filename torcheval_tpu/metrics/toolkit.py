@@ -38,6 +38,7 @@ its member *names*, so call its own ``reset()``/``to()`` instead.
 from __future__ import annotations
 
 import logging
+import warnings
 from copy import deepcopy
 from typing import Any, Dict, Iterable, List, Optional, TypeVar, Union
 
@@ -61,12 +62,105 @@ def sync_and_compute(
     metric: Metric,
     process_group: Optional[CollectiveGroup] = None,
     recipient_rank: Union[int, Literal["all"]] = 0,
+    *,
+    topology: Literal["flat", "tree", "ring"] = "flat",
+    sketch: Optional[str] = None,
+    sketch_options: Optional[Dict[str, Any]] = None,
+    merge_policy: Optional[Any] = None,
+    membership: Optional[Any] = None,
 ) -> Optional[Any]:
     """Sync metric states and return ``metric.compute()`` of the synced metric
     on the recipient rank; ``None`` on other ranks
-    (reference ``toolkit.py:24-78``)."""
+    (reference ``toolkit.py:24-78``).
+
+    ``topology`` selects the reduction shape.  ``"flat"`` (default) is
+    the reference-parity single gather.  ``"tree"`` / ``"ring"`` run
+    the elastic hierarchical merge
+    (:func:`torcheval_tpu.parallel.fleet_merge.fleet_merge`): per-level
+    retry deadlines, live membership with excision of unresponsive
+    hosts, and a :class:`~torcheval_tpu.parallel.fleet_merge
+    .MergeOutcome` **return value on every rank** — ``outcome.value``
+    holds the computed result on the recipient rank(s) and
+    ``outcome.partial`` / ``outcome.world_effective`` label host-loss
+    degradation instead of the call raising.  On a clean run the
+    tree/ring value is bit-identical to the flat one.  A group without
+    point-to-point transport falls back to flat with a warning.
+
+    ``sketch`` (``"reservoir"`` / ``"histogram"`` / ``"count"``) ships
+    O(bins) mergeable summaries instead of raw sample buffers — see
+    :meth:`BinaryAUROC.sketch_state` for kinds and error bounds; with
+    ``topology="flat"`` the sketches ride the ordinary gather and the
+    recipient returns the merged sketch's value directly.
+    """
+    if topology not in ("flat", "tree", "ring"):
+        raise ValueError(
+            f"topology must be 'flat', 'tree' or 'ring', got {topology!r}"
+        )
+    group = process_group if process_group is not None else default_group()
+    if topology != "flat":
+        if group.world_size > 1 and not group.supports_p2p:
+            warnings.warn(
+                f"collective group {type(group).__name__} has no "
+                "point-to-point transport; falling back to topology='flat'",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        else:
+            from torcheval_tpu.parallel.fleet_merge import fleet_merge
+
+            dst = 0 if recipient_rank == "all" else recipient_rank
+            return fleet_merge(
+                metric,
+                group,
+                topology=topology,
+                sketch=sketch,
+                sketch_options=sketch_options,
+                dst=dst,
+                recipient=recipient_rank,
+                policy=merge_policy,
+                membership=membership,
+            )
+    if sketch is not None and sketch != "exact":
+        return _flat_sketch_compute(
+            metric, group, recipient_rank, sketch, sketch_options
+        )
     synced_metric = get_synced_metric(metric, process_group, recipient_rank)
     return synced_metric.compute() if synced_metric is not None else None
+
+
+def _flat_sketch_compute(
+    metric: Metric,
+    group: CollectiveGroup,
+    recipient_rank: Union[int, Literal["all"]],
+    kind: str,
+    sketch_options: Optional[Dict[str, Any]],
+) -> Optional[Any]:
+    """Flat-gather variant of the sketch path: every rank builds its
+    O(bins) sketch, the sketches ride the ordinary object collective,
+    and the recipient merges them in rank order and computes."""
+    world_size = group.world_size
+    opts = dict(sketch_options or {})
+    if kind == "reservoir":
+        opts.setdefault("salt", group.rank if world_size > 1 else 0)
+    local = metric.sketch_state(kind, **opts)
+    if world_size == 1:
+        return local.compute()
+    if world_size == -1:
+        log.warning(
+            "collective group reports world size -1 (this process appears "
+            "to be outside the group); sync_and_compute() yields None."
+        )
+        return None
+    if recipient_rank == "all":
+        gathered = group.all_gather_object(local)
+    else:
+        gathered = group.gather_object(local, dst=recipient_rank)
+    if gathered is None:
+        return None
+    base = gathered[0]
+    for other in gathered[1:]:
+        base.merge(other)
+    return base.compute()
 
 
 def get_synced_state_dict(

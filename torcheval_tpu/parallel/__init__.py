@@ -24,6 +24,12 @@ This package provides that layer:
   the gather-exact family (bit-for-bit equal to the single-device
   kernels) and the Mann-Whitney ustat family (ships only the minority
   class — O(min(#pos, #neg)) wire).
+* :mod:`torcheval_tpu.parallel.fleet_merge` — the elastic hierarchical
+  state merge over the host wire: tree/ring reduction with per-level
+  retry deadlines, live membership (unresponsive hosts are excised and
+  the result labelled partial instead of the run dying), and optional
+  sketch-compressed payloads; the front door is
+  ``toolkit.sync_and_compute(..., topology="tree")``.
 
 Note the *implicit* path needs no code at all: class metrics already accept
 mesh-sharded inputs — their update kernels are jitted pure functions, so
@@ -53,6 +59,12 @@ from torcheval_tpu.parallel.exact import (
     sharded_multitask_auprc_exact,
     sharded_multitask_auroc_exact,
 )
+from torcheval_tpu.parallel.fleet_merge import (
+    MergeOutcome,
+    MergePolicy,
+    PendingMerge,
+    fleet_merge,
+)
 from torcheval_tpu.parallel.sync import (
     make_synced_update,
     mesh_merge_states,
@@ -62,8 +74,12 @@ from torcheval_tpu.parallel.sync import (
 )
 
 __all__ = [
+    "MergeOutcome",
+    "MergePolicy",
+    "PendingMerge",
     "bucket_shard_batch",
     "device_count",
+    "fleet_merge",
     "make_mesh",
     "make_synced_update",
     "mesh_merge_states",

@@ -1,0 +1,927 @@
+"""Elastic hierarchical fleet merge: tree/ring reduction with per-level
+retry, live membership, and sketch-compressed payloads.
+
+The flat sync path (``toolkit._sync_metric_object``) is one
+world-sized gather into the destination rank: every host's state lands
+in one inbox in one step, and one unresponsive host stalls (or kills)
+the whole collective.  This module reduces the same state
+**hierarchically** over the point-to-point API any
+:class:`~torcheval_tpu.distributed.CollectiveGroup` with
+``supports_p2p`` offers, with three properties the flat path lacks:
+
+* **Bounded fan-in** — ``topology="tree"`` reduces over a fixed binary
+  heap tree rooted at ``dst`` (position ``(rank - dst) % world``,
+  parent ``(pos - 1) // 2``): the root's inbox is 2 envelopes per
+  round instead of ``world - 1``, and each of the O(log world) levels
+  ships already-merged state.  ``topology="ring"`` is the 1-fanout
+  chain variant (O(world) levels, minimal per-hop payload).
+* **Per-level resilience** — every hop runs under its own
+  :class:`~torcheval_tpu.resilience.retry.ResilientGroup` with a
+  deadline scaled to the subtree depth beneath it.  A hop that
+  exhausts its budget *excises* the peer in this rank's
+  :class:`~torcheval_tpu.resilience.membership.MembershipView` (one
+  ``degraded`` telemetry event carrying the surviving-rank set) and the
+  protocol routes around it: an orphaned child re-sends its envelope to
+  its grandparent (climbing further dead ancestors), and a parent that
+  excised a child polls re-parent tags for that child's whole subtree
+  during a grace window, so a mid-tree death loses at most the dead
+  host's own contribution.  The final result is labelled **partial**
+  (``world_effective = len(contributors) < world_size``) instead of the
+  run dying — no failure propagates past the root as an exception.
+* **O(bins) payloads** — ``sketch="reservoir" | "histogram" | "count"``
+  ships :mod:`torcheval_tpu.metrics._sketch` summaries instead of raw
+  sample buffers; their merges are commutative/associative so tree
+  order cannot change the result, and their error bounds are documented
+  per kind.  ``sketch=None`` ships whole per-rank prepared states keyed
+  by rank, reassembled in rank order at the root — bit-identical to the
+  flat gather-and-merge on a clean run.
+
+Heartbeats ride the merge itself: every envelope and ack refreshes the
+sender in the receiver's membership view and carries the sender's
+dead-rank gossip, so discoveries propagate without extra traffic.
+
+Chaos hooks: the ``merge.level`` fault site fires at every
+participation step with ``rank``/``level``/``round``/``topology``/
+``role`` context; ``action="drop_rank"`` makes the matched rank vanish
+mid-merge, ``action="slow_rank"`` makes it a straggler.  Telemetry:
+each hop emits a ``sync`` event with ``level``/``fanout``/
+``payload_bytes`` (the ``merge_level_seconds`` Prometheus family and
+the fleet report's merge-depth table are views over these).
+
+Front door: ``toolkit.sync_and_compute(metric, group,
+topology="tree", sketch=...)``; the engine overlap hook is
+``Evaluator.start_fleet_merge``.  See ``docs/source/fleet.rst`` for
+topology selection and the host-loss runbook.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Set, Tuple
+
+from torcheval_tpu.distributed import CollectiveGroup, PeerTimeoutError
+from torcheval_tpu.resilience import faults as _faults
+from torcheval_tpu.resilience.faults import DroppedRank
+from torcheval_tpu.resilience.membership import (
+    MembershipView,
+    resolve_membership,
+)
+from torcheval_tpu.resilience.retry import (
+    CollectiveTimeoutError,
+    ResilientGroup,
+    RetryPolicy,
+)
+from torcheval_tpu.telemetry import events as _telemetry
+
+TOPOLOGIES = ("flat", "tree", "ring")
+_FAULT_SITE = "merge.level"
+
+
+@dataclass(frozen=True)
+class MergePolicy:
+    """Budgets for one hierarchical merge round.
+
+    ``level_deadline`` is the per-level unit budget: a hop expecting a
+    subtree of height ``h`` beneath the sender waits up to
+    ``h * level_deadline``.  ``attempts`` retries within each hop's
+    budget (the per-level ResilientGroup's ``max_attempts``).
+    ``ack_deadline`` bounds the wait for a receipt acknowledgement
+    before the sender declares its parent dead and re-parents;
+    ``reparent_grace`` bounds how long an ancestor polls for orphans of
+    an excised child; ``result_deadline`` bounds a non-root rank's wait
+    for the root's result under ``recipient="all"`` (defaults scale
+    from ``level_deadline``).  ``poll_slice`` is the orphan-poll /
+    ring-scan granularity."""
+
+    level_deadline: float = 2.0
+    attempts: int = 2
+    ack_deadline: Optional[float] = None
+    reparent_grace: Optional[float] = None
+    result_deadline: Optional[float] = None
+    poll_slice: float = 0.02
+
+    def __post_init__(self) -> None:
+        if self.level_deadline <= 0:
+            raise ValueError(
+                f"level_deadline must be positive, got {self.level_deadline}"
+            )
+        if self.attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+
+    def ack(self) -> float:
+        return (
+            self.ack_deadline
+            if self.ack_deadline is not None
+            else self.level_deadline
+        )
+
+    def grace(self) -> float:
+        return (
+            self.reparent_grace
+            if self.reparent_grace is not None
+            else self.level_deadline
+        )
+
+    def result(self, levels: int) -> float:
+        return (
+            self.result_deadline
+            if self.result_deadline is not None
+            else self.level_deadline * (2 * levels + 2)
+        )
+
+    def ack_wait(self, target_height: int) -> float:
+        """How long a sender waits for its (grand)parent's receipt.
+
+        Two constraints pull in opposite directions: a *busy* target may
+        legitimately spend its dead sibling subtree's full recv deadline
+        plus the orphan-poll grace before acking (so the wait must grow
+        with the target's subtree height), while a *dead* target must be
+        detected before the next live ancestor's orphan-poll window
+        closes.  Exponential scaling in the target height satisfies
+        both: the sum of detection times over any chain of dead
+        ancestors below height ``h`` stays under :meth:`poll_window`
+        of ``h`` (geometric series)."""
+        unit = self.ack() + self.grace()
+        return 1.5 * unit * (2 ** max(0, target_height - 1))
+
+    def poll_window(self, dead_child_height: int) -> float:
+        """How long an ancestor polls re-parent tags after excising a
+        child of the given subtree height: covers every descendant's
+        worst-case chain of dead-ancestor detections
+        (``sum ack_wait(i) for i <= h`` is under ``2 * unit * 2**h``)."""
+        unit = self.ack() + self.grace()
+        return 2.0 * unit * (2 ** dead_child_height)
+
+
+@dataclass
+class MergeOutcome:
+    """What a fleet merge returns on every rank — never an exception.
+
+    ``value`` is the computed metric value (on the recipient rank(s));
+    ``metric`` is the reassembled merged metric (root, exact mode
+    only).  ``partial`` is True when any initial rank's contribution is
+    missing: ``world_effective = world_size - len(lost_ranks)``.
+    ``delivered`` is False on a rank whose envelope never reached the
+    root (partition) or that was fault-dropped (``dropped=True``)."""
+
+    value: Any
+    metric: Any
+    world_size: int
+    world_effective: int
+    lost_ranks: Tuple[int, ...]
+    partial: bool
+    topology: str
+    levels: int
+    rank: int
+    delivered: bool
+    dropped: bool = False
+    sketch: Optional[str] = None
+    payload_bytes_at_root: int = 0
+    overlap_skips: int = 0
+
+
+@dataclass
+class Envelope:
+    """One hop's payload: merged state plus the membership piggyback."""
+
+    sender: int
+    level: int
+    contributors: FrozenSet[int]
+    dead: FrozenSet[int]
+    mode: str                                   # "exact" | "sketch"
+    parts: Dict[int, Any] = field(default_factory=dict)
+    part_bytes: Dict[int, int] = field(default_factory=dict)
+    sketch: Optional[Any] = None
+
+    def payload_nbytes(self) -> int:
+        if self.mode == "exact":
+            return sum(self.part_bytes.values())
+        return int(self.sketch.nbytes()) if self.sketch is not None else 0
+
+
+class _Acc:
+    """This rank's running reduction: per-rank parts (exact mode, keyed
+    by rank so duplicate delivery dedups for free) or one commutative
+    sketch (overlapping sketch envelopes are skipped and counted)."""
+
+    def __init__(self, mode: str) -> None:
+        self.mode = mode
+        self.parts: Dict[int, Any] = {}
+        self.part_bytes: Dict[int, int] = {}
+        self.sketch: Optional[Any] = None
+        self.contributors: Set[int] = set()
+        self.overlap_skips = 0
+
+    def add_local(
+        self, rank: int, part: Any = None, nbytes: int = 0, sketch: Any = None
+    ) -> None:
+        if self.mode == "exact":
+            self.parts[rank] = part
+            self.part_bytes[rank] = nbytes
+        else:
+            self.sketch = sketch
+        self.contributors.add(rank)
+
+    def absorb(self, env: Envelope, view: MembershipView) -> bool:
+        view.merge_gossip(env.dead, reason="gossip")
+        view.observe(env.sender, level=env.level)
+        if self.mode == "exact":
+            for r, part in env.parts.items():
+                if r not in self.parts:
+                    self.parts[r] = part
+                    self.part_bytes[r] = env.part_bytes.get(r, 0)
+                    self.contributors.add(r)
+            return True
+        incoming = set(env.contributors)
+        if incoming & self.contributors:
+            # A duplicate or partially-overlapping sketch cannot be
+            # subtracted; skip the whole envelope (its non-overlapping
+            # contributors show up as lost, which partial accounting
+            # surfaces honestly).
+            if not incoming <= self.contributors:
+                self.overlap_skips += 1
+            return False
+        if self.sketch is None:
+            self.sketch = env.sketch
+        else:
+            self.sketch.merge(env.sketch)
+        self.contributors |= incoming
+        return True
+
+    def to_envelope(
+        self, sender: int, level: int, view: MembershipView
+    ) -> Envelope:
+        return Envelope(
+            sender=sender,
+            level=level,
+            contributors=frozenset(self.contributors),
+            dead=frozenset(view.dead),
+            mode=self.mode,
+            parts=dict(self.parts),
+            part_bytes=dict(self.part_bytes),
+            sketch=self.sketch,
+        )
+
+
+# ------------------------------------------------------------ tree shape
+def _heights(world: int) -> List[int]:
+    """Height of the heap subtree rooted at each position (leaf = 1);
+    a node *sends up* at level == its height, so the root's height is
+    the level count of the whole merge."""
+    h = [1] * world
+    for pos in range(world - 1, -1, -1):
+        left, right = 2 * pos + 1, 2 * pos + 2
+        if left < world:
+            h[pos] = 1 + max(
+                h[left], h[right] if right < world else 0
+            )
+    return h
+
+
+def _subtree(pos: int, world: int) -> List[int]:
+    out, frontier = [], [pos]
+    while frontier:
+        p = frontier.pop()
+        out.append(p)
+        for c in (2 * p + 1, 2 * p + 2):
+            if c < world:
+                frontier.append(c)
+    return sorted(out)
+
+
+def _next_round(group: CollectiveGroup) -> int:
+    """Per-group monotonically increasing round id, attached to the
+    innermost transport so repeated merges over re-wrapped groups keep
+    distinct wire tags.  All ranks must call merges in the same order
+    (the standing collective-ordering requirement)."""
+    inner = group
+    while hasattr(inner, "inner"):
+        inner = inner.inner
+    n = int(getattr(inner, "_fleet_merge_round", 0))
+    try:
+        inner._fleet_merge_round = n + 1
+    except (AttributeError, TypeError):  # pragma: no cover - frozen group
+        pass
+    return n
+
+
+def _fire(
+    role: str, rank: int, level: int, round_id: int, topology: str
+) -> None:
+    if _faults.ENABLED:
+        _faults.fire(
+            _FAULT_SITE,
+            rank=rank,
+            level=level,
+            round=round_id,
+            topology=topology,
+            role=role,
+        )
+
+
+def _level_group(
+    group: CollectiveGroup,
+    view: MembershipView,
+    deadline: float,
+    attempts: int,
+) -> ResilientGroup:
+    policy = RetryPolicy(
+        max_attempts=attempts,
+        base_delay=0.005,
+        max_delay=0.05,
+        jitter=0.0,
+        deadline=deadline,
+    )
+    return ResilientGroup(group, policy, membership=view)
+
+
+def _recv_hop(
+    group: CollectiveGroup,
+    view: MembershipView,
+    src: int,
+    tag: str,
+    deadline: float,
+    attempts: int,
+) -> Envelope:
+    rg = _level_group(group, view, deadline, attempts)
+    per_attempt = max(0.001, deadline / attempts)
+    return rg.recv_object(src, tag, timeout=per_attempt)
+
+
+def _send_hop(
+    group: CollectiveGroup,
+    view: MembershipView,
+    obj: Any,
+    dst: int,
+    tag: str,
+    deadline: float,
+    attempts: int,
+) -> None:
+    rg = _level_group(group, view, deadline, attempts)
+    rg.send_object(obj, dst, tag)
+
+
+def _record_level(
+    seconds: float, payload_bytes: int, level: int, fanout: int
+) -> None:
+    if _telemetry.ENABLED:
+        _telemetry.record_sync(
+            "fleet_merge", seconds, payload_bytes, level=level, fanout=fanout
+        )
+
+
+# --------------------------------------------------------- tree protocol
+def _tree_round(
+    group: CollectiveGroup,
+    view: MembershipView,
+    acc: _Acc,
+    dst: int,
+    policy: MergePolicy,
+    rid: str,
+    round_id: int,
+) -> bool:
+    """Run this rank's part of one tree reduction.  Returns ``delivered``
+    (True on the root, or once an ancestor acked this rank's envelope)."""
+    me, world = group.rank, group.world_size
+    my_pos = (me - dst) % world
+    heights = _heights(world)
+    rank_of = lambda pos: (dst + pos) % world  # noqa: E731
+
+    # 1. Receive (and ack) each child subtree's merged envelope.
+    for child_pos in (2 * my_pos + 1, 2 * my_pos + 2):
+        if child_pos >= world:
+            continue
+        child_rank = rank_of(child_pos)
+        level = heights[child_pos]
+        _fire("recv", me, level, round_id, "tree")
+        hop_deadline = policy.level_deadline * level
+        started = time.monotonic()
+        try:
+            env = _recv_hop(
+                group,
+                view,
+                child_rank,
+                f"{rid}/up/{child_pos}",
+                hop_deadline,
+                policy.attempts,
+            )
+            acc.absorb(env, view)
+            _send_hop(
+                group,
+                view,
+                ("ack", me, tuple(view.dead)),
+                child_rank,
+                f"{rid}/ack/{child_pos}",
+                policy.ack(),
+                policy.attempts,
+            )
+            _record_level(
+                time.monotonic() - started, env.payload_nbytes(), level, 2
+            )
+        except (CollectiveTimeoutError, PeerTimeoutError) as exc:
+            view.excise(
+                child_rank,
+                reason=f"no envelope at level {level}: {exc}",
+            )
+            _record_level(time.monotonic() - started, 0, level, 2)
+            _poll_orphans(
+                group, view, acc, child_pos, dst, policy, rid, heights
+            )
+
+    if my_pos == 0:
+        return True
+
+    # 2. Send the merged envelope up, climbing past dead ancestors.
+    level = heights[my_pos]
+    _fire("send", me, level, round_id, "tree")
+    env = acc.to_envelope(me, level, view)
+    target_pos = (my_pos - 1) // 2
+    tag_kind = "up"
+    while True:
+        target_rank = rank_of(target_pos)
+        if view.is_alive(target_rank):
+            started = time.monotonic()
+            try:
+                _send_hop(
+                    group,
+                    view,
+                    env,
+                    target_rank,
+                    f"{rid}/{tag_kind}/{my_pos}",
+                    policy.ack(),
+                    policy.attempts,
+                )
+                ack = _recv_hop(
+                    group,
+                    view,
+                    target_rank,
+                    f"{rid}/ack/{my_pos}",
+                    policy.ack_wait(heights[target_pos]),
+                    policy.attempts,
+                )
+                view.observe(target_rank, level=level)
+                if isinstance(ack, tuple) and len(ack) == 3:
+                    view.merge_gossip(ack[2], reason="ack gossip")
+                _record_level(
+                    time.monotonic() - started, env.payload_nbytes(), level, 2
+                )
+                return True
+            except (CollectiveTimeoutError, PeerTimeoutError) as exc:
+                view.excise(
+                    target_rank,
+                    reason=f"no ack at level {level}: {exc}",
+                )
+                _record_level(time.monotonic() - started, 0, level, 2)
+        if target_pos == 0:
+            return False  # every ancestor incl. the root is dead
+        target_pos = (target_pos - 1) // 2
+        tag_kind = "rp"
+
+
+def _poll_orphans(
+    group: CollectiveGroup,
+    view: MembershipView,
+    acc: _Acc,
+    dead_child_pos: int,
+    dst: int,
+    policy: MergePolicy,
+    rid: str,
+    heights: List[int],
+) -> None:
+    """After excising a child, poll re-parent tags for every descendant
+    position in its subtree during the grace window, acking and
+    absorbing whatever orphans climb up."""
+    world = group.world_size
+    rank_of = lambda pos: (dst + pos) % world  # noqa: E731
+    descendants = [p for p in _subtree(dead_child_pos, world) if p != dead_child_pos]
+    if not descendants:
+        return
+    deadline = time.monotonic() + policy.poll_window(
+        heights[dead_child_pos]
+    )
+    pending = set(descendants)
+    while pending and time.monotonic() < deadline:
+        progressed = False
+        for pos in sorted(pending):
+            orphan_rank = rank_of(pos)
+            if not view.is_alive(orphan_rank) or (
+                orphan_rank in acc.contributors
+            ):
+                pending.discard(pos)
+                continue
+            try:
+                env = group.recv_object(
+                    orphan_rank,
+                    f"{rid}/rp/{pos}",
+                    timeout=policy.poll_slice,
+                )
+            except (PeerTimeoutError, CollectiveTimeoutError):
+                continue
+            acc.absorb(env, view)
+            try:
+                group.send_object(
+                    ("ack", group.rank, tuple(view.dead)),
+                    orphan_rank,
+                    f"{rid}/ack/{pos}",
+                )
+            except Exception:  # noqa: BLE001 - ack is best-effort
+                pass
+            # The orphan's envelope covers its whole live subtree.
+            for covered in _subtree(pos, world):
+                pending.discard(covered)
+            progressed = True
+        if not progressed:
+            continue
+
+
+# --------------------------------------------------------- ring protocol
+def _ring_round(
+    group: CollectiveGroup,
+    view: MembershipView,
+    acc: _Acc,
+    dst: int,
+    policy: MergePolicy,
+    rid: str,
+    round_id: int,
+) -> bool:
+    """Chain reduction from position ``world-1`` down to the head at
+    ``dst``.  A sender that gets no ack skips to the next live
+    downstream position; a receiver polls every upstream candidate
+    (the envelope may arrive from any of them after skips)."""
+    me, world = group.rank, group.world_size
+    my_pos = (me - dst) % world
+    rank_of = lambda pos: (dst + pos) % world  # noqa: E731
+
+    if my_pos != world - 1:
+        level = world - 1 - my_pos
+        _fire("recv", me, level, round_id, "ring")
+        budget = policy.level_deadline * level
+        started = time.monotonic()
+        deadline = started + budget
+        candidates = list(range(my_pos + 1, world))
+        env: Optional[Envelope] = None
+        while env is None and time.monotonic() < deadline:
+            for src_pos in candidates:
+                src_rank = rank_of(src_pos)
+                if not view.is_alive(src_rank):
+                    continue
+                try:
+                    env = group.recv_object(
+                        src_rank,
+                        f"{rid}/ring/{my_pos}",
+                        timeout=policy.poll_slice,
+                    )
+                except (PeerTimeoutError, CollectiveTimeoutError):
+                    continue
+                acc.absorb(env, view)
+                try:
+                    group.send_object(
+                        ("ack", me, tuple(view.dead)),
+                        src_rank,
+                        f"{rid}/ring-ack/{src_pos}",
+                    )
+                except Exception:  # noqa: BLE001 - ack is best-effort
+                    pass
+                break
+        _record_level(
+            time.monotonic() - started,
+            env.payload_nbytes() if env is not None else 0,
+            level,
+            1,
+        )
+        # No envelope inside the budget: the upstream chain is gone (or
+        # partitioned); this rank restarts the chain from its own
+        # contribution and the head's contributor set tells the truth.
+
+    if my_pos == 0:
+        return True
+
+    level = world - my_pos
+    _fire("send", me, level, round_id, "ring")
+    env_out = acc.to_envelope(me, level, view)
+    target_pos = my_pos - 1
+    while target_pos >= 0:
+        target_rank = rank_of(target_pos)
+        if view.is_alive(target_rank):
+            started = time.monotonic()
+            try:
+                _send_hop(
+                    group,
+                    view,
+                    env_out,
+                    target_rank,
+                    f"{rid}/ring/{target_pos}",
+                    policy.ack(),
+                    policy.attempts,
+                )
+                _recv_hop(
+                    group,
+                    view,
+                    target_rank,
+                    f"{rid}/ring-ack/{my_pos}",
+                    # The downstream receiver is a round-robin poller;
+                    # its ack lands within one sweep of its candidates.
+                    policy.ack() + policy.poll_slice * world,
+                    policy.attempts,
+                )
+                view.observe(target_rank, level=level)
+                _record_level(
+                    time.monotonic() - started,
+                    env_out.payload_nbytes(),
+                    level,
+                    1,
+                )
+                return True
+            except (CollectiveTimeoutError, PeerTimeoutError) as exc:
+                view.excise(
+                    target_rank,
+                    reason=f"no ring ack at level {level}: {exc}",
+                )
+                _record_level(time.monotonic() - started, 0, level, 1)
+        target_pos -= 1
+    return False
+
+
+# ------------------------------------------------------------ entry point
+def fleet_merge(
+    metric: Any,
+    group: CollectiveGroup,
+    *,
+    topology: str = "tree",
+    sketch: Optional[str] = None,
+    sketch_options: Optional[Dict[str, Any]] = None,
+    dst: int = 0,
+    recipient: Any = None,
+    policy: Optional[MergePolicy] = None,
+    membership: Optional[MembershipView] = None,
+    round_id: Optional[int] = None,
+    compute: bool = True,
+) -> MergeOutcome:
+    """Hierarchically merge ``metric``'s state across ``group``.
+
+    Returns a :class:`MergeOutcome` on **every** rank and never raises
+    past the root: peer failures become excisions and a partial result.
+    ``recipient`` defaults to ``dst`` (only the root computes the
+    value); ``recipient="all"`` has the root distribute the computed
+    value point-to-point to every live rank (a rank that misses the
+    result inside its deadline degrades to a local-only partial outcome
+    with a ``degraded`` telemetry event, because a barrier broadcast
+    would hang on the very host losses this merge survives).
+
+    ``sketch=None`` ships whole prepared per-rank states (lossless,
+    rank-order reassembly at the root → bit-identical to the flat
+    path); a sketch kind ships O(bins) summaries — see
+    :meth:`BinaryAUROC.sketch_state` for kinds, options, and bounds.
+    """
+    if topology not in ("tree", "ring"):
+        raise ValueError(
+            f"topology must be 'tree' or 'ring', got {topology!r}"
+        )
+    if sketch == "exact":
+        sketch = None  # exact rides the rank-keyed parts map
+    policy = policy if policy is not None else MergePolicy()
+    me, world = group.rank, group.world_size
+    recipient = dst if recipient is None else recipient
+    levels = (
+        _heights(world)[0] if topology == "tree" else max(1, world - 1)
+    ) if world >= 1 else 0
+
+    if world <= 1:
+        value = metric.compute() if compute else None
+        return MergeOutcome(
+            value=value,
+            metric=metric,
+            world_size=max(world, 1),
+            world_effective=max(world, 1),
+            lost_ranks=(),
+            partial=False,
+            topology=topology,
+            levels=0,
+            rank=max(me, 0),
+            delivered=True,
+            sketch=sketch,
+        )
+    if not group.supports_p2p:
+        raise ValueError(
+            f"{type(group).__name__} has no point-to-point transport; "
+            "use topology='flat' (toolkit.sync_and_compute) instead"
+        )
+
+    view = resolve_membership(membership, world, me)
+    rnd = _next_round(group) if round_id is None else int(round_id)
+    rid = f"fm{rnd}"
+
+    acc = _Acc("exact" if sketch is None else "sketch")
+    if sketch is None:
+        metric._prepare_for_merge_state()
+        from torcheval_tpu.metrics._sketch import state_nbytes
+
+        acc.add_local(me, part=metric, nbytes=state_nbytes(metric))
+    else:
+        opts = dict(sketch_options or {})
+        if sketch == "reservoir":
+            opts.setdefault("salt", me)
+        acc.add_local(me, sketch=metric.sketch_state(sketch, **opts))
+
+    delivered = True
+    try:
+        _fire("start", me, 0, rnd, topology)
+        if topology == "tree":
+            delivered = _tree_round(group, view, acc, dst, policy, rid, rnd)
+        else:
+            delivered = _ring_round(group, view, acc, dst, policy, rid, rnd)
+    except DroppedRank:
+        # This rank "vanished": no sends, no acks, no result — its
+        # peers excise it and carry on.  Locally we still return a
+        # well-formed (undelivered) outcome so a caller thread joins.
+        return MergeOutcome(
+            value=None,
+            metric=None,
+            world_size=world,
+            world_effective=view.world_effective,
+            lost_ranks=tuple(sorted(view.dead)),
+            partial=True,
+            topology=topology,
+            levels=levels,
+            rank=me,
+            delivered=False,
+            dropped=True,
+            sketch=sketch,
+        )
+
+    my_pos = (me - dst) % world
+    if my_pos == 0:
+        outcome = _root_outcome(
+            acc, view, world, me, topology, levels, sketch, compute
+        )
+        if recipient == "all":
+            import numpy as np
+
+            value = outcome.value
+            if hasattr(value, "shape"):  # device array -> host bytes
+                value = np.asarray(value)
+            wire = (
+                value,
+                outcome.lost_ranks,
+                outcome.payload_bytes_at_root,
+                outcome.overlap_skips,
+            )
+            for peer in sorted(view.alive - {me}):
+                try:
+                    group.send_object(wire, peer, f"{rid}/res/{peer}")
+                except Exception:  # noqa: BLE001 - peer may have died
+                    pass
+        return outcome
+
+    if recipient == "all":
+        try:
+            value, lost, root_bytes, skips = group.recv_object(
+                (dst) % world, f"{rid}/res/{me}", timeout=policy.result(levels)
+            )
+            lost = tuple(lost)
+            return MergeOutcome(
+                value=value,
+                metric=None,
+                world_size=world,
+                world_effective=world - len(lost),
+                lost_ranks=lost,
+                partial=bool(lost),
+                topology=topology,
+                levels=levels,
+                rank=me,
+                delivered=delivered,
+                sketch=sketch,
+                payload_bytes_at_root=root_bytes,
+                overlap_skips=skips,
+            )
+        except (PeerTimeoutError, CollectiveTimeoutError) as exc:
+            if _telemetry.ENABLED:
+                _telemetry.record_degraded(
+                    "fleet_merge",
+                    f"no result from root: {exc}",
+                    "local",
+                    survivors=view.survivors_label(),
+                )
+            local_value = metric.compute() if compute else None
+            return MergeOutcome(
+                value=local_value,
+                metric=None,
+                world_size=world,
+                world_effective=1,
+                lost_ranks=tuple(sorted(set(range(world)) - {me})),
+                partial=True,
+                topology=topology,
+                levels=levels,
+                rank=me,
+                delivered=delivered,
+                sketch=sketch,
+            )
+
+    lost = tuple(sorted(view.dead))
+    return MergeOutcome(
+        value=None,
+        metric=None,
+        world_size=world,
+        world_effective=view.world_effective,
+        lost_ranks=lost,
+        partial=bool(lost) or not delivered,
+        topology=topology,
+        levels=levels,
+        rank=me,
+        delivered=delivered,
+        sketch=sketch,
+    )
+
+
+def _root_outcome(
+    acc: _Acc,
+    view: MembershipView,
+    world: int,
+    rank: int,
+    topology: str,
+    levels: int,
+    sketch: Optional[str],
+    compute: bool,
+) -> MergeOutcome:
+    contributors = sorted(acc.contributors)
+    lost = tuple(sorted(set(range(world)) - acc.contributors))
+    metric = None
+    value = None
+    if acc.mode == "exact":
+        metric = _assemble_exact(acc.parts)
+        if compute and metric is not None:
+            value = metric.compute()
+        root_bytes = sum(acc.part_bytes.values())
+    else:
+        if compute and acc.sketch is not None:
+            value = acc.sketch.compute()
+        root_bytes = int(acc.sketch.nbytes()) if acc.sketch else 0
+    return MergeOutcome(
+        value=value,
+        metric=metric,
+        world_size=world,
+        world_effective=len(contributors),
+        lost_ranks=lost,
+        partial=len(contributors) < world,
+        topology=topology,
+        levels=levels,
+        rank=rank,
+        delivered=True,
+        sketch=sketch,
+        payload_bytes_at_root=root_bytes,
+        overlap_skips=acc.overlap_skips,
+    )
+
+
+def _assemble_exact(parts: Dict[int, Any]) -> Any:
+    """Reassemble per-rank prepared states in rank order — the exact
+    sequence the flat path's ``clone(g[0]).merge_state(g[1:])`` uses,
+    so a clean tree/ring merge is bit-identical to the flat gather."""
+    import copy
+
+    if not parts:
+        return None
+    ranks = sorted(parts)
+    base = copy.deepcopy(parts[ranks[0]])
+    rest = [parts[r] for r in ranks[1:]]
+    if rest:
+        base.merge_state(rest)
+    return base
+
+
+class PendingMerge:
+    """Handle for a fleet merge overlapped with further eval work
+    (``Evaluator.start_fleet_merge``): the merge runs on a daemon
+    thread over a state snapshot; :meth:`result` joins and returns the
+    :class:`MergeOutcome` (or re-raises the thread's error — which the
+    merge itself never produces for *peer* failures, only for
+    programming errors)."""
+
+    def __init__(self, target: Any, args: tuple, kwargs: dict) -> None:
+        self._outcome: Optional[MergeOutcome] = None
+        self._error: Optional[BaseException] = None
+
+        def run() -> None:
+            try:
+                self._outcome = target(*args, **kwargs)
+            except BaseException as exc:  # noqa: BLE001 - relayed in result()
+                self._error = exc
+
+        self._thread = threading.Thread(
+            target=run, name="fleet-merge", daemon=True
+        )
+        self._thread.start()
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def result(self, timeout: Optional[float] = None) -> MergeOutcome:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("fleet merge still running")
+        if self._error is not None:
+            raise self._error
+        assert self._outcome is not None
+        return self._outcome

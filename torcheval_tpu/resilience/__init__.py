@@ -20,14 +20,27 @@ fail-operational layer on top of PRs 2–4's observability:
   site-named fault injection behind the same one-branch zero-cost-off
   guards as the telemetry bus (``scripts/check_hot_path_overhead.py``
   enforces it).
+- :mod:`~torcheval_tpu.resilience.membership` —
+  :class:`MembershipView`: live-rank tracking for the hierarchical
+  fleet merge (``parallel/fleet_merge.py``), with heartbeats piggybacked
+  on merge traffic, excision of unresponsive hosts, and dead-rank
+  gossip; excisions surface as ``degraded`` telemetry events carrying
+  the surviving-rank set.
 
 See ``docs/source/resilience.rst`` for the checkpoint format, retry
-policy guidance, and the fault-plan cookbook.
+policy guidance, and the fault-plan cookbook, and
+``docs/source/fleet.rst`` for the host-loss runbook.
 """
 
-from torcheval_tpu.resilience import checkpoint, faults, retry
+from torcheval_tpu.resilience import checkpoint, faults, membership, retry
 from torcheval_tpu.resilience.checkpoint import Checkpoint, CheckpointManager
-from torcheval_tpu.resilience.faults import FaultPlan, FaultRule, InjectedFault
+from torcheval_tpu.resilience.faults import (
+    DroppedRank,
+    FaultPlan,
+    FaultRule,
+    InjectedFault,
+)
+from torcheval_tpu.resilience.membership import MembershipView
 from torcheval_tpu.resilience.retry import (
     CollectiveTimeoutError,
     ResilientGroup,
@@ -38,12 +51,15 @@ __all__ = [
     "Checkpoint",
     "CheckpointManager",
     "CollectiveTimeoutError",
+    "DroppedRank",
     "FaultPlan",
     "FaultRule",
     "InjectedFault",
+    "MembershipView",
     "ResilientGroup",
     "RetryPolicy",
     "checkpoint",
     "faults",
+    "membership",
     "retry",
 ]

@@ -36,6 +36,13 @@ in action):
 ``sync.dispatch``
     Per synced-update dispatch in ``parallel/sync.py`` (context:
     ``op``).
+``merge.level``
+    Each participation step of the hierarchical fleet merge
+    (``parallel/fleet_merge.py``; context: ``rank``, ``level``,
+    ``round``, ``topology``, ``role``).  ``action="drop_rank"`` makes
+    the matched rank vanish mid-merge (it stops sending/acking from
+    that level on, so peers must excise and re-parent around it);
+    ``action="slow_rank"`` turns it into a ``delay_s`` straggler.
 
 Reproducibility: probabilistic rules (``probability < 1``) draw from a
 ``numpy`` generator seeded by ``FaultPlan(seed=)``; draws are consumed
@@ -64,7 +71,7 @@ ENABLED: bool = False
 _ACTIVE: Optional["FaultPlan"] = None
 _lock = threading.Lock()
 
-_ACTIONS = ("raise", "delay", "tear", "corrupt")
+_ACTIONS = ("raise", "delay", "tear", "corrupt", "drop_rank", "slow_rank")
 
 
 class InjectedFault(RuntimeError):
@@ -76,6 +83,19 @@ class InjectedFault(RuntimeError):
         super().__init__(message or f"injected fault at site {site!r}")
 
 
+class DroppedRank(InjectedFault):
+    """Raised by an ``action="drop_rank"`` rule at a ``merge.level``
+    site: the matched rank "vanishes" — the merge layer catches this at
+    its top level and simply stops participating (no sends, no acks),
+    which is what a preempted host looks like to its peers."""
+
+    def __init__(self, site: str, rank: int, message: str = "") -> None:
+        self.rank = rank
+        super().__init__(
+            site, message or f"rank {rank} dropped at site {site!r}"
+        )
+
+
 @dataclass
 class FaultRule:
     """One injection rule.  A rule matches a :func:`fire` call when the
@@ -85,7 +105,7 @@ class FaultRule:
     (``probability``) lands."""
 
     site: str
-    action: str = "raise"       # "raise" | "delay" | "tear" | "corrupt"
+    action: str = "raise"       # one of _ACTIONS
     after: int = 0              # skip the first `after` matching hits
     count: Optional[int] = 1    # max firings (None = unlimited)
     on_attempt: Optional[int] = None  # only when ctx["attempt"] == this
@@ -211,9 +231,11 @@ def fire(site: str, **ctx: Any) -> Optional[FaultRule]:
     """The hook-site entry point.  Callers MUST branch on :data:`ENABLED`
     first (the zero-cost contract); this function does not re-check.
 
-    ``action="raise"`` raises :class:`InjectedFault`; ``"delay"`` sleeps
-    ``delay_s`` and returns None; ``"tear"``/``"corrupt"`` return the
-    matched rule so the site applies the data transformation itself.
+    ``action="raise"`` raises :class:`InjectedFault`; ``"drop_rank"``
+    raises :class:`DroppedRank` (carrying ``ctx["rank"]``); ``"delay"``
+    and ``"slow_rank"`` sleep ``delay_s`` and return None;
+    ``"tear"``/``"corrupt"`` return the matched rule so the site applies
+    the data transformation itself.
     """
     plan = _ACTIVE
     if plan is None:  # pragma: no cover - uninstall race
@@ -224,7 +246,9 @@ def fire(site: str, **ctx: Any) -> Optional[FaultRule]:
         return None
     if rule.action == "raise":
         raise InjectedFault(site, rule.message)
-    if rule.action == "delay":
+    if rule.action == "drop_rank":
+        raise DroppedRank(site, int(ctx.get("rank", -1)), rule.message)
+    if rule.action in ("delay", "slow_rank"):
         import time
 
         time.sleep(rule.delay_s)

@@ -231,11 +231,20 @@ class ResilientGroup(CollectiveGroup):
     local single-host view instead — ``[obj]`` for all-gather, ``obj``
     for broadcast, ``[obj]``/None for gather — mirroring what
     ``telemetry.fleet_report`` returns for ``world_size <= 1``, and emit
-    a ``degraded`` telemetry event + ``UserWarning``.
+    a ``degraded`` telemetry event + ``UserWarning``.  The degraded
+    event carries the surviving-rank set: the attached ``membership``
+    view's live ranks when one was given (the fleet merge wires its
+    :class:`~torcheval_tpu.resilience.membership.MembershipView` in per
+    level), else this rank alone — so ``fleet_report`` can attribute
+    which hosts were lost, not just that a fallback happened.
 
     Note a *retry* of a real collective is only coherent when every rank
     retries symmetrically (same policy, same failure) — exactly what a
     coordinator hiccup or a deterministic :class:`FaultPlan` produces.
+    Point-to-point sends/receives (:meth:`send_object` /
+    :meth:`recv_object`) have no such symmetry requirement and are
+    retried independently per peer; they never degrade — exhaustion
+    raises, and the merge layer above turns that into an excision.
     """
 
     _DEGRADE_MODES = (None, "local")
@@ -246,6 +255,7 @@ class ResilientGroup(CollectiveGroup):
         policy: Optional[RetryPolicy] = None,
         *,
         degrade: Optional[str] = None,
+        membership: Optional[Any] = None,
     ) -> None:
         if degrade not in self._DEGRADE_MODES:
             raise ValueError(
@@ -254,6 +264,7 @@ class ResilientGroup(CollectiveGroup):
         self.inner = group
         self.policy = policy if policy is not None else RetryPolicy()
         self.degrade = degrade
+        self.membership = membership
         self._rng = random.Random(self.policy.seed)
 
     @property
@@ -278,7 +289,14 @@ class ResilientGroup(CollectiveGroup):
             if self.degrade == "local":
                 reason = repr(cause) if cause is not None else "exhausted"
                 if _telemetry.ENABLED:
-                    _telemetry.record_degraded(op, reason, "local")
+                    survivors = (
+                        self.membership.survivors_label()
+                        if self.membership is not None
+                        else str(self.rank)
+                    )
+                    _telemetry.record_degraded(
+                        op, reason, "local", survivors=survivors
+                    )
                 warnings.warn(
                     f"collective {op!r} exhausted its retry budget "
                     f"({reason}); degrading to the local single-host view",
@@ -313,4 +331,32 @@ class ResilientGroup(CollectiveGroup):
             "gather_object",
             lambda: self.inner.gather_object(obj, dst),
             local,
+        )
+
+    # Point-to-point: retried per peer, never degraded — a peer that
+    # stays silent past the budget raises CollectiveTimeoutError with
+    # its rank attached, and the fleet merge turns that into an excision
+    # rather than a run-wide fallback.
+    @property
+    def supports_p2p(self) -> bool:
+        return self.inner.supports_p2p
+
+    def send_object(self, obj: Any, dst: int, tag: str) -> None:
+        retry_call(
+            "send_object",
+            lambda: self.inner.send_object(obj, dst, tag),
+            self.policy,
+            rng=self._rng,
+            fault_site="collective",
+        )
+
+    def recv_object(
+        self, src: int, tag: str, timeout: Optional[float] = None
+    ) -> Any:
+        return retry_call(
+            "recv_object",
+            lambda: self.inner.recv_object(src, tag, timeout=timeout),
+            self.policy,
+            rng=self._rng,
+            fault_site="collective",
         )

@@ -248,6 +248,26 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         "events_dropped": events.dropped(),
         "ring_capacity": events.capacity(),
     }
+    if agg["merge_levels"]:
+        # Hierarchical-merge depth accounting, structured as a list of
+        # dicts (like quality) so fleet snapshots keep it intact through
+        # aggregate._plain's key stringification.
+        result["merge"] = {
+            "levels": sorted(
+                (
+                    {
+                        "op": op,
+                        "level": level,
+                        "calls": entry["calls"],
+                        "seconds": entry["seconds"],
+                        "payload_bytes": entry["payload_bytes"],
+                        "fanout": entry["fanout"],
+                    }
+                    for (op, level), entry in agg["merge_levels"].items()
+                ),
+                key=lambda item: (item["op"], item["level"]),
+            )
+        }
     if agg["perf"]:
         perf = explain_perf()
         result["perf"] = {

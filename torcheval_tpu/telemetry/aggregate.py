@@ -96,11 +96,27 @@ def _host_rollup(snapshot: Dict[str, Any]) -> Dict[str, Any]:
     engine = report.get("engine", {})
     health = report.get("data_health", {})
     quality = report.get("quality", {})
+    # Degraded-event attribution: which hosts this one still considered
+    # live when a fallback fired (the merge layer stamps survivors onto
+    # every degraded/excised event; see events.DegradedEvent).
+    degraded_survivors = [
+        {
+            "op": e.get("op", ""),
+            "fallback": e.get("fallback", ""),
+            "survivors": e.get("survivors", ""),
+        }
+        for e in snapshot.get("events", [])
+        if e.get("kind") == "degraded" and e.get("survivors")
+    ]
     return {
         # The live model-quality figures (list-of-dict entries survive
         # _plain untouched) and this host's worst slice reading.
         "quality_entries": list(quality.get("entries", [])),
         "quality_worst": quality.get("worst_slice"),
+        "merge_levels": list(
+            report.get("merge", {}).get("levels", [])
+        ),
+        "degraded_survivors": degraded_survivors,
         "host": dict(snapshot.get("host", {})),
         "events_captured": report.get("events_captured", 0),
         "events_dropped": report.get("events_dropped", 0),
@@ -194,6 +210,51 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
         },
     }
 
+    # Merge-depth timing spread: per (op, level) across hosts, the
+    # min/mean/max hop seconds — a straggler at one level of the tree is
+    # the merge-critical-path fingerprint (its slow hop serializes every
+    # ancestor above it).
+    merge_depth: Dict[Any, Dict[str, Any]] = {}
+    for r in rollups:
+        for entry in r.get("merge_levels", []):
+            key = (entry["op"], entry["level"])
+            row = merge_depth.setdefault(
+                key,
+                {
+                    "op": entry["op"],
+                    "level": entry["level"],
+                    "min_seconds": float("inf"),
+                    "max_seconds": 0.0,
+                    "_sum": 0.0,
+                    "calls": 0,
+                    "payload_bytes": 0,
+                    "fanout": 0,
+                    "hosts": 0,
+                },
+            )
+            secs = float(entry["seconds"])
+            row["min_seconds"] = min(row["min_seconds"], secs)
+            row["max_seconds"] = max(row["max_seconds"], secs)
+            row["_sum"] += secs
+            row["calls"] += entry["calls"]
+            row["payload_bytes"] += entry["payload_bytes"]
+            row["fanout"] = max(row["fanout"], entry["fanout"])
+            row["hosts"] += 1
+    merge_rows = []
+    for key in sorted(merge_depth):
+        row = merge_depth[key]
+        row["mean_seconds"] = row.pop("_sum") / row["hosts"]
+        merge_rows.append(row)
+
+    # Host-loss attribution: every degraded event that carried a
+    # surviving-rank set, pinned to the emitting host — the "which hosts
+    # did the fleet lose, as seen from where" answer.
+    lost_reports = [
+        {"host": r["host"], **entry}
+        for r in rollups
+        for entry in r.get("degraded_survivors", [])
+    ]
+
     # Data-health findings pinned to the host that saw them — the "which
     # host is feeding NaNs" answer.
     health_by_host = [
@@ -245,6 +306,8 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
         "totals": totals,
         "skew": skew,
         "data_health_by_host": health_by_host,
+        "merge_depth": merge_rows,
+        "membership": {"degraded_reports": lost_reports},
         "quality": {
             "per_metric": per_metric,
             "worst_slice": worst_slice or None,

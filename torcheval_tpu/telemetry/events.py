@@ -156,12 +156,20 @@ class DonationEvent(Event):
 class SyncEvent(Event):
     """One cross-device/cross-process merge: collective wall-clock
     seconds (dispatch + block_until_ready, or host wire round trip) and
-    the merged payload size in bytes."""
+    the merged payload size in bytes.
+
+    Hierarchical merges (``parallel.fleet_merge``) additionally stamp
+    the tree/ring ``level`` the hop ran at (1 = leaf hop) and the
+    ``fanout`` (children merged at that node); flat collectives leave
+    the defaults (``level=-1``), so existing emitters are unchanged and
+    per-level aggregation only sees real merge hops."""
 
     kind: str = field(init=False, default="sync")
     op: str = ""
     seconds: float = 0.0
     payload_bytes: int = 0
+    level: int = -1
+    fanout: int = 0
 
 
 @dataclass
@@ -224,12 +232,18 @@ class DegradedEvent(Event):
     served the local single-host view instead of the fleet collective
     (``fallback="local"``), or a component shed work to stay live (e.g.
     a prefetch producer thread leaked past its join deadline).  Never
-    silent — every degradation is one of these."""
+    silent — every degradation is one of these.
+
+    ``survivors`` is the comma-joined set of ranks still considered
+    live when the fallback fired (e.g. ``"0,2,3"``) — empty when the
+    emitter has no membership view — so ``fleet_report`` can attribute
+    WHICH hosts were lost, not just that a fallback happened."""
 
     kind: str = field(init=False, default="degraded")
     op: str = ""
     reason: str = ""
     fallback: str = "local"
+    survivors: str = ""
 
 
 @dataclass
@@ -349,6 +363,12 @@ def _zero_aggregates() -> Dict[str, Any]:
         "donation": {"restore": 0, "abort": 0},
         # op -> {"calls", "seconds", "payload_bytes", "hist": [..]}
         "sync": {},
+        # Hierarchical-merge hops only (SyncEvents with level >= 0):
+        # (op, level) -> {"calls", "seconds", "payload_bytes",
+        # "fanout": max observed, "hist": [..]} — the merge-depth
+        # timing spread fleet_report and the merge_level_seconds
+        # Prometheus family read.
+        "merge_levels": {},
         # (name, phase) -> {"calls", "seconds", "state_bytes", "hist": [..]}
         "spans": {},
         # The streaming engine's dispatch accounting: blocks is the host
@@ -473,6 +493,10 @@ def aggregates() -> Dict[str, Any]:
             },
             "donation": dict(_agg["donation"]),
             "sync": {k: _copy_hist_entry(v) for k, v in _agg["sync"].items()},
+            "merge_levels": {
+                k: _copy_hist_entry(v)
+                for k, v in _agg["merge_levels"].items()
+            },
             "spans": {k: _copy_hist_entry(v) for k, v in _agg["spans"].items()},
             "engine": dict(_agg["engine"]),
             "data_health": {
@@ -566,6 +590,22 @@ def _fold(event: Event) -> None:
         entry["seconds"] += event.seconds
         entry["payload_bytes"] += event.payload_bytes
         entry["hist"][_hist_slot(event.seconds)] += 1
+        if event.level >= 0:
+            lvl = _agg["merge_levels"].setdefault(
+                (event.op, event.level),
+                {
+                    "calls": 0,
+                    "seconds": 0.0,
+                    "payload_bytes": 0,
+                    "fanout": 0,
+                    "hist": [0] * (len(DURATION_BUCKETS) + 1),
+                },
+            )
+            lvl["calls"] += 1
+            lvl["seconds"] += event.seconds
+            lvl["payload_bytes"] += event.payload_bytes
+            lvl["fanout"] = max(lvl["fanout"], event.fanout)
+            lvl["hist"][_hist_slot(event.seconds)] += 1
     elif isinstance(event, EngineBlockEvent):
         entry = _agg["engine"]
         entry["blocks"] += 1
@@ -707,10 +747,20 @@ def record_donation(action: str) -> None:
     emit(DonationEvent(action=action))
 
 
-def record_sync(op: str, seconds: float, payload_bytes: int) -> None:
+def record_sync(
+    op: str,
+    seconds: float,
+    payload_bytes: int,
+    level: int = -1,
+    fanout: int = 0,
+) -> None:
     emit(
         SyncEvent(
-            op=op, seconds=float(seconds), payload_bytes=int(payload_bytes)
+            op=op,
+            seconds=float(seconds),
+            payload_bytes=int(payload_bytes),
+            level=int(level),
+            fanout=int(fanout),
         )
     )
 
@@ -756,8 +806,14 @@ def record_retry(op: str, attempt: int, delay_s: float, error: str) -> None:
     )
 
 
-def record_degraded(op: str, reason: str, fallback: str = "local") -> None:
-    emit(DegradedEvent(op=op, reason=reason, fallback=fallback))
+def record_degraded(
+    op: str, reason: str, fallback: str = "local", survivors: str = ""
+) -> None:
+    emit(
+        DegradedEvent(
+            op=op, reason=reason, fallback=fallback, survivors=survivors
+        )
+    )
 
 
 def record_checkpoint(

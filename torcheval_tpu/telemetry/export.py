@@ -123,7 +123,14 @@ def read_jsonl(
 # interval), so ts = (time_s - seconds) and dur = seconds.
 _DURATION_NAME = {
     "span": lambda e: f"{e.name}.{e.phase}",
-    "sync": lambda e: f"sync.{e.op}",
+    # Hierarchical-merge hops render one slice per level
+    # ("sync.merge_tree.L2") so the viewer shows the merge depth as
+    # nested-looking stacks; flat collectives keep the plain name.
+    "sync": lambda e: (
+        f"sync.{e.op}.L{e.level}"
+        if getattr(e, "level", -1) >= 0
+        else f"sync.{e.op}"
+    ),
     "prefetch_stall": lambda e: "prefetch_wait",
     # Checkpoint save/restore are timed I/O phases; quarantines carry
     # seconds=0 and render as zero-width slices at the discovery point.
@@ -482,6 +489,28 @@ def prometheus_text() -> str:
         out.append(
             f"{_PREFIX}_sync_payload_bytes_total{_labels(op=op)} "
             f"{agg['sync'][op]['payload_bytes']}"
+        )
+
+    out.append(
+        f"# HELP {_PREFIX}_merge_level_seconds Hierarchical fleet-merge "
+        "hop wall time by op and tree/ring level (1 = leaf hop)."
+    )
+    out.append(f"# TYPE {_PREFIX}_merge_level_seconds histogram")
+    for op, level in sorted(agg["merge_levels"]):
+        _histogram_lines(
+            out,
+            f"{_PREFIX}_merge_level_seconds",
+            {"op": op, "level": level},
+            agg["merge_levels"][(op, level)],
+        )
+    out.append(
+        f"# TYPE {_PREFIX}_merge_level_payload_bytes_total counter"
+    )
+    for op, level in sorted(agg["merge_levels"]):
+        out.append(
+            f"{_PREFIX}_merge_level_payload_bytes_total"
+            f"{_labels(op=op, level=level)} "
+            f"{agg['merge_levels'][(op, level)]['payload_bytes']}"
         )
 
     out.append(
