@@ -250,6 +250,78 @@ class DropScenarios(unittest.TestCase):
         for lost in root.lost_ranks:
             self.assertIn(lost, (5, 6))
 
+    def test_height3_drop_at_world16_keeps_live_subtree(self):
+        # Rank 3 roots the height-3 subtree {3, 7, 8, 15}.  Its live
+        # parent (rank 1) spends an exponential orphan-poll window
+        # recovering ranks 7 and 8; without the keepalive relay the
+        # root's linear recv deadline would lapse first, falsely
+        # excising rank 1 and dropping its whole live subtree.
+        world = 16
+        outs, _ = _run_merge(world, "tree", _DROP, plan=_drop(3))
+        root = outs[0]
+        self.assertEqual(root.lost_ranks, (3,))
+        self.assertEqual(root.world_effective, world - 1)
+        self.assertTrue(root.partial)
+        survivors = [r for r in range(world) if r != 3]
+        self.assertEqual(float(root.value), _flat_value(survivors))
+
+    def test_slow_child_past_recv_deadline_is_absorbed_not_lost(self):
+        # Rank 1's send is delayed past the root's recv deadline: the
+        # root excises it, but its late envelope (carrying rank 3's
+        # contribution too) must be absorbed during the orphan poll —
+        # which listens on the excised position's own tag — so a
+        # slow-but-alive subtree costs nothing.
+        plan = FaultPlan(
+            rules=(
+                FaultRule(site="merge.level", action="slow_rank",
+                          match={"rank": 1, "role": "send"},
+                          delay_s=0.8),
+            ),
+            seed=0,
+        )
+        outs, _ = _run_merge(4, "tree", _FAST, plan=plan)
+        root = outs[0]
+        self.assertFalse(root.partial)
+        self.assertEqual(root.lost_ranks, ())
+        self.assertEqual(root.world_effective, 4)
+        self.assertEqual(float(root.value), _flat_value(range(4)))
+        self.assertTrue(outs[1].delivered)
+
+    def test_recipient_all_reaches_wrongly_excised_rank(self):
+        # The root absorbed rank 1's late envelope but still has it
+        # excised in its membership view; the result must go to every
+        # initial rank regardless, so rank 1 gets the fleet value
+        # instead of degrading to a local-only outcome.
+        plan = FaultPlan(
+            rules=(
+                FaultRule(site="merge.level", action="slow_rank",
+                          match={"rank": 1, "role": "send"},
+                          delay_s=0.8),
+            ),
+            seed=0,
+        )
+        outs, _ = _run_merge(4, "tree", _FAST, plan=plan, recipient="all")
+        reference = _flat_value(range(4))
+        for rank, out in enumerate(outs):
+            self.assertEqual(float(np.asarray(out.value)), reference, rank)
+            self.assertFalse(out.partial, rank)
+            self.assertEqual(out.world_effective, 4, rank)
+
+    def test_recipient_all_root_loss_blames_only_the_root(self):
+        # A rank that never hears the root's result only knows the
+        # root is unreachable — the fallback outcome must not claim
+        # every peer was lost (world_effective=1).
+        world = 8
+        outs, _ = _run_merge(world, "tree", _DROP, plan=_drop(0, "start"),
+                             recipient="all")
+        self.assertTrue(outs[0].dropped)
+        for rank in range(1, world):
+            out = outs[rank]
+            self.assertTrue(out.partial, rank)
+            self.assertEqual(out.lost_ranks, (0,), rank)
+            self.assertEqual(out.world_effective, world - 1, rank)
+            self.assertIsNotNone(out.value, rank)
+
     def test_slow_rank_straggler_still_completes_clean(self):
         plan = FaultPlan(
             rules=(

@@ -24,8 +24,13 @@ the whole collective.  This module reduces the same state
   protocol routes around it: an orphaned child re-sends its envelope to
   its grandparent (climbing further dead ancestors), and a parent that
   excised a child polls re-parent tags for that child's whole subtree
-  during a grace window, so a mid-tree death loses at most the dead
-  host's own contribution.  The final result is labelled **partial**
+  — and the excised child's own late envelope — during a bounded grace
+  window, so a mid-tree death loses at most the dead host's own
+  contribution and a merely-slow host loses nothing.  A rank mid
+  failure-recovery keepalives its ancestor chain (relayed level by
+  level), extending the linear recv deadlines above it so the
+  exponential recovery window beneath a live node never cascades into
+  false excisions of live subtrees.  The final result is labelled **partial**
   (``world_effective = len(contributors) < world_size``) instead of the
   run dying — no failure propagates past the root as an exception.
 * **O(bins) payloads** — ``sketch="reservoir" | "histogram" | "count"``
@@ -93,7 +98,21 @@ class MergePolicy:
     an excised child; ``result_deadline`` bounds a non-root rank's wait
     for the root's result under ``recipient="all"`` (defaults scale
     from ``level_deadline``).  ``poll_slice`` is the orphan-poll /
-    ring-scan granularity."""
+    ring-scan granularity.
+
+    A rank mid failure-recovery (orphan-polling for an excised child's
+    subtree) sends **keepalives** up its live ancestor chain every
+    :meth:`keepalive_interval`, and each ancestor extends its recv
+    deadline on one — so a live node slowed by recovery beneath it is
+    never excised by a parent whose own (linear) recv deadline is
+    shorter than the (exponential) recovery window.  ``poll_window_max``
+    is the absolute cap on any single orphan-poll window: the computed
+    :meth:`poll_window` is exponential in the dead subtree's height, so
+    without a cap a tall dead subtree whose survivors already delivered
+    through the dead node (and so never re-parent) would be waited on
+    for minutes; the poll also exits early once every pending orphan is
+    accounted for or nothing has arrived for the no-progress bound (see
+    :func:`_poll_orphans`).  ``None`` disables the cap."""
 
     level_deadline: float = 2.0
     attempts: int = 2
@@ -101,6 +120,7 @@ class MergePolicy:
     reparent_grace: Optional[float] = None
     result_deadline: Optional[float] = None
     poll_slice: float = 0.02
+    poll_window_max: Optional[float] = 60.0
 
     def __post_init__(self) -> None:
         if self.level_deadline <= 0:
@@ -150,9 +170,35 @@ class MergePolicy:
         """How long an ancestor polls re-parent tags after excising a
         child of the given subtree height: covers every descendant's
         worst-case chain of dead-ancestor detections
-        (``sum ack_wait(i) for i <= h`` is under ``2 * unit * 2**h``)."""
+        (``sum ack_wait(i) for i <= h`` is under ``2 * unit * 2**h``).
+        Call sites apply :meth:`capped_poll_window`."""
         unit = self.ack() + self.grace()
         return 2.0 * unit * (2 ** dead_child_height)
+
+    def capped_poll_window(self, dead_child_height: int) -> float:
+        window = self.poll_window(dead_child_height)
+        if self.poll_window_max is not None:
+            window = min(window, self.poll_window_max)
+        return window
+
+    def keepalive_interval(self) -> float:
+        """Cadence of the mid-recovery progress signal; well under
+        ``level_deadline`` so a parent's extended recv deadline never
+        lapses between two keepalives from a live child."""
+        return self.level_deadline / 4.0
+
+    def recv_window(self, child_height: int) -> float:
+        """Hard cap on a keepalive-extended child-envelope wait.  The
+        base recv deadline stays ``level_deadline * child_height``
+        (fast detection of a silent child); keepalives extend it while
+        the child is visibly mid-recovery, up to this bound — the
+        child's own recovery work is at most two excise-and-poll
+        passes, so anything beyond is a wedged peer, excised as dead."""
+        return (
+            self.level_deadline * child_height
+            + 4.0 * self.capped_poll_window(child_height)
+            + 2.0 * (self.ack() + self.grace())
+        )
 
 
 @dataclass
@@ -389,7 +435,31 @@ def _tree_round(
     heights = _heights(world)
     rank_of = lambda pos: (dst + pos) % world  # noqa: E731
 
-    # 1. Receive (and ack) each child subtree's merged envelope.
+    parent_pos = (my_pos - 1) // 2
+    ka_last = [float("-inf")]
+
+    def keepalive() -> None:
+        """Mid-recovery progress signal: tell the (static) parent this
+        rank is alive so its recv deadline extends instead of falsely
+        excising a whole live subtree; each ancestor relays it upward,
+        so nested recovery anywhere beneath keeps the chain open."""
+        if my_pos == 0:
+            return
+        now = time.monotonic()
+        if now - ka_last[0] < policy.keepalive_interval():
+            return
+        ka_last[0] = now
+        try:
+            group.send_object(
+                ("ka", me), rank_of(parent_pos), f"{rid}/ka/{my_pos}"
+            )
+        except Exception:  # noqa: BLE001 - keepalive is best-effort
+            pass
+
+    # 1. Receive (and ack) each child subtree's merged envelope.  The
+    # wait is a raw-transport poll (like _poll_orphans) rather than one
+    # ResilientGroup recv: the deadline must be extendable mid-wait by
+    # the child's keepalives, which a fixed-budget recv cannot do.
     for child_pos in (2 * my_pos + 1, 2 * my_pos + 2):
         if child_pos >= world:
             continue
@@ -398,37 +468,59 @@ def _tree_round(
         _fire("recv", me, level, round_id, "tree")
         hop_deadline = policy.level_deadline * level
         started = time.monotonic()
-        try:
-            env = _recv_hop(
-                group,
-                view,
-                child_rank,
-                f"{rid}/up/{child_pos}",
-                hop_deadline,
-                policy.attempts,
-            )
-            acc.absorb(env, view)
-            _send_hop(
-                group,
-                view,
-                ("ack", me, tuple(view.dead)),
-                child_rank,
-                f"{rid}/ack/{child_pos}",
-                policy.ack(),
-                policy.attempts,
-            )
-            _record_level(
-                time.monotonic() - started, env.payload_nbytes(), level, 2
-            )
-        except (CollectiveTimeoutError, PeerTimeoutError) as exc:
-            view.excise(
-                child_rank,
-                reason=f"no envelope at level {level}: {exc}",
-            )
-            _record_level(time.monotonic() - started, 0, level, 2)
-            _poll_orphans(
-                group, view, acc, child_pos, dst, policy, rid, heights
-            )
+        hard_cap = started + policy.recv_window(level)
+        deadline = started + hop_deadline
+        env: Optional[Envelope] = None
+        while True:
+            try:
+                env = group.recv_object(
+                    child_rank,
+                    f"{rid}/up/{child_pos}",
+                    timeout=policy.poll_slice,
+                )
+                break
+            except (PeerTimeoutError, CollectiveTimeoutError):
+                pass
+            try:
+                group.recv_object(
+                    child_rank, f"{rid}/ka/{child_pos}", timeout=0.0
+                )
+            except (PeerTimeoutError, CollectiveTimeoutError):
+                pass
+            else:
+                deadline = time.monotonic() + hop_deadline
+                keepalive()  # relay the liveness up the chain
+            if time.monotonic() >= min(deadline, hard_cap):
+                break
+        if env is not None:
+            try:
+                acc.absorb(env, view)
+                _send_hop(
+                    group,
+                    view,
+                    ("ack", me, tuple(view.dead)),
+                    child_rank,
+                    f"{rid}/ack/{child_pos}",
+                    policy.ack(),
+                    policy.attempts,
+                )
+                _record_level(
+                    time.monotonic() - started,
+                    env.payload_nbytes(),
+                    level,
+                    2,
+                )
+                continue
+            except (CollectiveTimeoutError, PeerTimeoutError) as exc:
+                reason = f"no ack delivery at level {level}: {exc}"
+        else:
+            reason = f"no envelope at level {level} within deadline"
+        view.excise(child_rank, reason=reason)
+        _record_level(time.monotonic() - started, 0, level, 2)
+        _poll_orphans(
+            group, view, acc, child_pos, dst, policy, rid, heights,
+            keepalive=keepalive,
+        )
 
     if my_pos == 0:
         return True
@@ -489,51 +581,102 @@ def _poll_orphans(
     policy: MergePolicy,
     rid: str,
     heights: List[int],
+    keepalive: Optional[Any] = None,
 ) -> None:
-    """After excising a child, poll re-parent tags for every descendant
-    position in its subtree during the grace window, acking and
-    absorbing whatever orphans climb up."""
+    """After excising a child, poll for its subtree during the grace
+    window, acking and absorbing whatever climbs up.
+
+    The excised position itself stays in the poll (on its original
+    ``up`` tag, plus ``rp``): a slow-but-alive child whose envelope
+    missed the recv deadline is absorbed late instead of its whole
+    subtree being lost — it re-sends only toward its *grandparent*,
+    which never polls ``rp`` tags for positions it did not excise.
+
+    The window is bounded three ways.  Hard cap:
+    ``capped_poll_window`` (the exponential bound, clamped at
+    ``poll_window_max``).  No-progress bound: a surviving orphan's
+    worst-case chain of dead-ancestor detections sums geometrically
+    below ``2 * ack_wait(tallest pending)``, so silence that long
+    (plus grace) means nothing can still arrive — and the bound shrinks
+    as orphans resolve.  Corroboration: once the dead child's own
+    children re-parented around it, only its own late envelope could
+    still arrive, and its children's matching excision says it will
+    not."""
     world = group.world_size
+    me = group.rank
     rank_of = lambda pos: (dst + pos) % world  # noqa: E731
-    descendants = [p for p in _subtree(dead_child_pos, world) if p != dead_child_pos]
-    if not descendants:
-        return
-    deadline = time.monotonic() + policy.poll_window(
+    pending = set(_subtree(dead_child_pos, world))
+    started = time.monotonic()
+    hard_deadline = started + policy.capped_poll_window(
         heights[dead_child_pos]
     )
-    pending = set(descendants)
-    while pending and time.monotonic() < deadline:
+
+    def quiet_budget() -> float:
+        tallest = max(heights[p] for p in pending)
+        return 2.0 * policy.ack_wait(tallest) + policy.grace()
+
+    quiet_deadline = started + quiet_budget()
+    reparented = False
+    while pending:
+        now = time.monotonic()
+        if now >= hard_deadline or now >= quiet_deadline:
+            break
+        if reparented and pending == {dead_child_pos}:
+            break
+        if keepalive is not None:
+            keepalive()
         progressed = False
         for pos in sorted(pending):
             orphan_rank = rank_of(pos)
-            if not view.is_alive(orphan_rank) or (
-                orphan_rank in acc.contributors
-            ):
+            dead_by_gossip = (
+                pos != dead_child_pos and not view.is_alive(orphan_rank)
+            )
+            if dead_by_gossip or orphan_rank in acc.contributors:
                 pending.discard(pos)
+                progressed = True
                 continue
-            try:
-                env = group.recv_object(
-                    orphan_rank,
-                    f"{rid}/rp/{pos}",
-                    timeout=policy.poll_slice,
-                )
-            except (PeerTimeoutError, CollectiveTimeoutError):
+            tags = [f"{rid}/rp/{pos}"]
+            if pos == dead_child_pos:
+                tags.insert(0, f"{rid}/up/{pos}")
+            env: Optional[Envelope] = None
+            for tag in tags:
+                try:
+                    env = group.recv_object(
+                        orphan_rank, tag, timeout=policy.poll_slice
+                    )
+                    break
+                except (PeerTimeoutError, CollectiveTimeoutError):
+                    continue
+            if env is None:
+                if pos == dead_child_pos:
+                    # A keepalive from the excised child: still alive,
+                    # mid-recovery — keep its window open.
+                    try:
+                        group.recv_object(
+                            orphan_rank, f"{rid}/ka/{pos}", timeout=0.0
+                        )
+                    except (PeerTimeoutError, CollectiveTimeoutError):
+                        pass
+                    else:
+                        progressed = True
                 continue
             acc.absorb(env, view)
             try:
                 group.send_object(
-                    ("ack", group.rank, tuple(view.dead)),
+                    ("ack", me, tuple(view.dead)),
                     orphan_rank,
                     f"{rid}/ack/{pos}",
                 )
             except Exception:  # noqa: BLE001 - ack is best-effort
                 pass
+            if pos != dead_child_pos:
+                reparented = True
             # The orphan's envelope covers its whole live subtree.
             for covered in _subtree(pos, world):
                 pending.discard(covered)
             progressed = True
-        if not progressed:
-            continue
+        if progressed and pending:
+            quiet_deadline = time.monotonic() + quiet_budget()
 
 
 # --------------------------------------------------------- ring protocol
@@ -768,7 +911,14 @@ def fleet_merge(
                 outcome.payload_bytes_at_root,
                 outcome.overlap_skips,
             )
-            for peer in sorted(view.alive - {me}):
+            # Send to every initial rank, not just the ones this view
+            # thinks are alive: a live rank the root wrongly excised
+            # (its envelope arrived late or via an orphan poll) still
+            # deserves the result, sends are non-blocking, and an
+            # unclaimed message to a truly dead rank is tolerated.
+            for peer in range(world):
+                if peer == me:
+                    continue
                 try:
                     group.send_object(wire, peer, f"{rid}/res/{peer}")
                 except Exception:  # noqa: BLE001 - peer may have died
@@ -804,13 +954,17 @@ def fleet_merge(
                     "local",
                     survivors=view.survivors_label(),
                 )
+            # All this rank knows is that the root's result did not
+            # arrive: report the root (plus already-known deaths) as
+            # lost, not every peer — the rest of the fleet may be fine.
             local_value = metric.compute() if compute else None
+            lost = tuple(sorted(view.dead | {dst % world}))
             return MergeOutcome(
                 value=local_value,
                 metric=None,
                 world_size=world,
-                world_effective=1,
-                lost_ranks=tuple(sorted(set(range(world)) - {me})),
+                world_effective=world - len(lost),
+                lost_ranks=lost,
                 partial=True,
                 topology=topology,
                 levels=levels,
