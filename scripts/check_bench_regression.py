@@ -46,6 +46,12 @@ import subprocess
 import sys
 from typing import Any, Dict, List, Optional
 
+# Direct invocation puts scripts/ (not the repo root) on sys.path; the
+# tpulint stamp imports torcheval_tpu.analysis from the tree.
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 DEFAULT_THRESHOLD = 0.10
 
 # (metric row, extras key, max allowed value) — absolute ceilings on
@@ -173,6 +179,43 @@ def _load(path: str) -> Dict[str, Any]:
         return json.load(fh)
 
 
+def _tpulint_counts() -> Optional[Dict[str, int]]:
+    """Current static-analysis finding counts, or None when the analysis
+    package cannot run here (it is stdlib-only, so that means a broken
+    checkout, not a missing dependency)."""
+    try:
+        from torcheval_tpu.analysis import analyze
+        from torcheval_tpu.analysis._baseline import (
+            load_baseline,
+            split_by_baseline,
+        )
+        from torcheval_tpu.analysis._config import Config
+
+        cfg = Config.with_defaults()
+        result = analyze()
+        baseline = load_baseline(cfg.baseline) if cfg.baseline else {}
+        new, old, _ = split_by_baseline(result.all_findings, baseline)
+        return {
+            "tpulint_findings": len(new),
+            "tpulint_baselined": len(old),
+        }
+    except Exception:
+        return None
+
+
+def stamp_analysis(fresh_doc: Dict[str, Any]) -> Optional[Dict[str, int]]:
+    """Stamp the analyzer finding counts into every fresh bench row, so
+    each archived artifact records the static-contract state of the tree
+    it measured (a perf number from a tree with open findings is
+    annotated as such)."""
+    counts = _tpulint_counts()
+    if counts is None:
+        return None
+    for row in _rows_by_metric(fresh_doc).values():
+        row.update(counts)
+    return counts
+
+
 def _run_bench(baseline_path: str) -> Dict[str, Any]:
     """Run ``bench.py`` and return the refreshed artifact.  The caller
     must have snapshotted the baseline BEFORE this: bench merges into
@@ -216,6 +259,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         parser.error("need --fresh PATH or --run")
         return 2  # unreachable; parser.error exits
+
+    counts = stamp_analysis(fresh_doc)
+    if counts is not None:
+        print(
+            f"tpulint: {counts['tpulint_findings']} new finding(s), "
+            f"{counts['tpulint_baselined']} baselined "
+            "(stamped into fresh rows)"
+        )
+        if args.run:
+            # --run merged into the baseline file in place; persist the
+            # stamp there too so the archived artifact carries it.
+            with open(args.baseline, "w", encoding="utf-8") as fh:
+                json.dump(fresh_doc, fh, indent=1, sort_keys=True)
+                fh.write("\n")
 
     regressions = compare(baseline_doc, fresh_doc, threshold=args.threshold)
     bar_violations = check_extras(fresh_doc)

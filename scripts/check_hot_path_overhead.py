@@ -275,6 +275,38 @@ def check(verbose: bool = True) -> List[str]:
     )
 
 
+def static_coverage_check(verbose: bool = True) -> List[str]:
+    """tpulint cross-check: every hook entry point with a statically
+    visible call site in the library must be covered by a counting
+    wrapper above.  Without this, a new hook kind could land with guarded
+    call sites (so tpulint passes) yet never be wrapped here — and the
+    empirical zero-overhead guard would silently stop testing it.
+    Returns the statically discovered hook-name list."""
+    from torcheval_tpu.analysis import hook_entry_points
+    from torcheval_tpu.telemetry import events as ev
+
+    wrapped = set(_hook_names(ev))
+    wrapped.update(f"health.{n}" for n in _HEALTH_HOOKS)
+    wrapped.update(f"faults.{n}" for n in _FAULT_HOOKS)
+    wrapped.update(f"perfscope.{n}" for n in _PERFSCOPE_HOOKS)
+    wrapped.update(f"monitor.{n}" for n in _MONITOR_HOOKS)
+    discovered = hook_entry_points()
+    missing = sorted(set(discovered) - wrapped)
+    if missing:
+        raise AssertionError(
+            "hook entry points with call sites in the tree are NOT "
+            "covered by this script's counting wrappers (add them to "
+            f"the _*_HOOKS tables): {missing}"
+        )
+    if verbose:
+        print(
+            f"ok: all {len(discovered)} statically discovered hook "
+            "entry points are covered by runtime counting wrappers"
+        )
+    return discovered
+
+
 if __name__ == "__main__":
     check()
+    static_coverage_check()
     sys.exit(0)

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""jax-free tpulint launcher.
+
+``python -m torcheval_tpu.analysis`` pays ``torcheval_tpu/__init__``,
+which imports jax — fine on a dev box, impossible in the pre-commit CI
+job (which installs only ruff).  This launcher loads the stdlib-only
+``torcheval_tpu/analysis`` subpackage under a synthetic package name via
+importlib, bypassing the library ``__init__`` entirely, and forwards
+argv to the same ``main()``.
+
+    python scripts/tpulint.py [paths] [--json] [--baseline FILE]
+
+Exit codes match the module CLI: 0 clean, 1 new findings, 2 unreadable
+path.
+"""
+
+import importlib.util
+import os
+import sys
+
+_PKG = "tpulint_analysis"
+
+
+def load_analysis():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg_dir = os.path.join(root, "torcheval_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        _PKG,
+        os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir],
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_PKG] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    analysis = load_analysis()
+    # The whole point of this launcher: the analyzed library (and jax)
+    # must never be imported by the analyzer.
+    assert "torcheval_tpu" not in sys.modules, "launcher leaked the library import"
+    assert "jax" not in sys.modules, "launcher leaked a jax import"
+    sys.exit(analysis.main())

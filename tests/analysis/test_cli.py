@@ -1,0 +1,287 @@
+"""tpulint CLI and plumbing: suppression comments, baseline round-trip,
+exit codes (0 clean / 1 findings / 2 unreadable path), JSON output, the
+repo-clean gate, the guard-removal mutation check, and the hook-site
+coverage cross-check (torcheval_tpu/analysis/, scripts/tpulint.py)."""
+
+import importlib.util
+import io
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import unittest
+
+import pytest
+
+from torcheval_tpu.analysis import hook_entry_points, hook_site_map, main
+from torcheval_tpu.analysis._baseline import (
+    load_baseline,
+    split_by_baseline,
+    write_baseline,
+)
+from torcheval_tpu.analysis._core import analyze_files
+
+pytestmark = pytest.mark.analysis
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# An unguarded hook call: one TPU001 finding, no jax needed to lint it.
+_BAD_SRC = (
+    "from torcheval_tpu.telemetry import events as _telemetry\n"
+    "def f():\n"
+    "    _telemetry.emit(1)\n"
+)
+
+
+def run_cli(argv):
+    out, err = io.StringIO(), io.StringIO()
+    code = main(argv, stdout=out, stderr=err)
+    return code, out.getvalue(), err.getvalue()
+
+
+class TestSuppressions(unittest.TestCase):
+    def _lint(self, src):
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "mod.py")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(src)
+            return analyze_files(
+                [(p, "torcheval_tpu/somemod.py")]
+            ).all_findings
+
+    def test_same_line_suppression(self):
+        src = _BAD_SRC.replace(
+            "_telemetry.emit(1)",
+            "_telemetry.emit(1)  # tpulint: disable=TPU001 -- test fixture",
+        )
+        self.assertEqual(self._lint(src), [])
+
+    def test_line_above_suppression(self):
+        src = (
+            "from torcheval_tpu.telemetry import events as _telemetry\n"
+            "def f():\n"
+            "    # tpulint: disable=TPU001 -- justified in prose\n"
+            "    _telemetry.emit(1)\n"
+        )
+        self.assertEqual(self._lint(src), [])
+
+    def test_wrong_code_does_not_suppress(self):
+        src = _BAD_SRC.replace(
+            "_telemetry.emit(1)",
+            "_telemetry.emit(1)  # tpulint: disable=TPU004",
+        )
+        self.assertEqual([f.code for f in self._lint(src)], ["TPU001"])
+
+    def test_star_suppresses_everything(self):
+        src = _BAD_SRC.replace(
+            "_telemetry.emit(1)",
+            "_telemetry.emit(1)  # tpulint: disable=*",
+        )
+        self.assertEqual(self._lint(src), [])
+
+
+class TestBaseline(unittest.TestCase):
+    def test_round_trip(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "mod.py")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(_BAD_SRC)
+            findings = analyze_files(
+                [(p, "torcheval_tpu/somemod.py")]
+            ).all_findings
+            self.assertEqual(len(findings), 1)
+
+            bl = os.path.join(td, "tpulint.baseline")
+            write_baseline(bl, findings)
+            loaded = load_baseline(bl)
+            self.assertEqual(set(loaded), {findings[0].fingerprint})
+
+            new, old, stale = split_by_baseline(findings, loaded)
+            self.assertEqual(new, [])
+            self.assertEqual(len(old), 1)
+            self.assertEqual(stale, set())
+
+    def test_stale_entries_are_reported_not_fatal(self):
+        baseline = {"TPU001:gone/file.py:f:emit": "was fixed"}
+        new, old, stale = split_by_baseline([], baseline)
+        self.assertEqual((new, old), ([], []))
+        self.assertEqual(stale, {"TPU001:gone/file.py:f:emit"})
+
+    def test_justifications_survive_rewrite(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "mod.py")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(_BAD_SRC)
+            findings = analyze_files(
+                [(p, "torcheval_tpu/somemod.py")]
+            ).all_findings
+            bl = os.path.join(td, "tpulint.baseline")
+            write_baseline(bl, findings)
+            fp = findings[0].fingerprint
+            existing = {fp: "a human-written reason"}
+            write_baseline(bl, findings, existing)
+            self.assertEqual(load_baseline(bl)[fp], "a human-written reason")
+
+    def test_fingerprints_are_line_independent(self):
+        shifted = "\n\n\n" + _BAD_SRC  # same code, three lines lower
+        fps = []
+        for src in (_BAD_SRC, shifted):
+            with tempfile.TemporaryDirectory() as td:
+                p = os.path.join(td, "mod.py")
+                with open(p, "w", encoding="utf-8") as f:
+                    f.write(src)
+                (finding,) = analyze_files(
+                    [(p, "torcheval_tpu/somemod.py")]
+                ).all_findings
+                fps.append(finding.fingerprint)
+        self.assertEqual(fps[0], fps[1])
+
+
+class TestCliExitCodes(unittest.TestCase):
+    def test_exit_0_on_clean_tree(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "clean.py")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write("x = 1\n")
+            code, out, _ = run_cli([p])
+        self.assertEqual(code, 0)
+        self.assertIn("0 new finding(s)", out)
+
+    def test_exit_1_on_findings(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "bad.py")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(_BAD_SRC)
+            code, out, _ = run_cli([p])
+        self.assertEqual(code, 1)
+        self.assertIn("TPU001", out)
+
+    def test_exit_2_on_unreadable_path(self):
+        code, _, err = run_cli(["/nonexistent/nowhere.py"])
+        self.assertEqual(code, 2)
+        self.assertIn("cannot read", err)
+
+    def test_json_output(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "bad.py")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(_BAD_SRC)
+            code, out, _ = run_cli([p, "--json"])
+        self.assertEqual(code, 1)
+        payload = json.loads(out)
+        self.assertEqual(payload["summary"]["TPU001"], 1)
+        self.assertEqual(len(payload["new"]), 1)
+        self.assertIn("fingerprint", payload["new"][0])
+
+    def test_help_documents_chip_session_scoping(self):
+        with self.assertRaises(SystemExit) as ctx:
+            run_cli(["--help"])
+        self.assertEqual(ctx.exception.code, 0)
+
+
+class TestRepoClean(unittest.TestCase):
+    def test_default_targets_are_clean(self):
+        # THE gate: the shipped tree has no findings beyond the baseline.
+        code, out, err = run_cli([])
+        self.assertEqual(code, 0, f"stdout={out} stderr={err}")
+
+    def test_acceptance_invocation_is_clean(self):
+        # Exactly the invocation the docs advertise.
+        code, out, err = run_cli(
+            [os.path.join(_REPO_ROOT, "torcheval_tpu")]
+        )
+        self.assertEqual(code, 0, f"stdout={out} stderr={err}")
+
+
+class TestGuardRemovalMutation(unittest.TestCase):
+    def test_removing_a_real_enabled_guard_fails_the_analyzer(self):
+        """Acceptance check: strip the ENABLED early-exit from a real
+        hook site (parallel/_compile_cache.py) and the analyzer must
+        produce a NEW TPU001 finding for it."""
+        real = os.path.join(
+            _REPO_ROOT, "torcheval_tpu", "parallel", "_compile_cache.py"
+        )
+        with open(real, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines(keepends=True)
+        guard_at = next(
+            i
+            for i, ln in enumerate(lines)
+            if "if not _telemetry.ENABLED:" in ln
+        )
+        mutated = "".join(
+            lines[:guard_at] + lines[guard_at + 2 :]
+        )  # drop the guard and its return
+        with tempfile.TemporaryDirectory() as td:
+            p = os.path.join(td, "mutated.py")
+            with open(p, "w", encoding="utf-8") as f:
+                f.write(mutated)
+            findings = analyze_files(
+                [(p, "torcheval_tpu/parallel/_compile_cache.py")]
+            ).all_findings
+        tpu001 = [f for f in findings if f.code == "TPU001"]
+        self.assertTrue(tpu001, "guard removal went undetected")
+        # And it is NEW relative to the checked-in baseline.
+        baseline = load_baseline(
+            os.path.join(_REPO_ROOT, "tpulint.baseline")
+        )
+        new, _, _ = split_by_baseline(tpu001, baseline)
+        self.assertTrue(new, "mutated finding was masked by the baseline")
+
+
+class TestHookSiteCoverage(unittest.TestCase):
+    def test_static_sites_covered_by_runtime_wrappers(self):
+        spec = importlib.util.spec_from_file_location(
+            "check_hot_path_overhead",
+            os.path.join(
+                _REPO_ROOT, "scripts", "check_hot_path_overhead.py"
+            ),
+        )
+        guard = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(guard)
+        discovered = guard.static_coverage_check(verbose=False)
+        self.assertGreaterEqual(len(discovered), 15)
+
+    def test_hook_site_map_shape(self):
+        sites = hook_site_map()
+        self.assertIn("faults.fire", sites)
+        self.assertIn("health.inspect", sites)
+        self.assertIn("perfscope.profile_program", sites)
+        self.assertIn("monitor.publish", sites)
+        for name, locs in sites.items():
+            for loc in locs:
+                path, _, line = loc.rpartition(":")
+                self.assertTrue(line.isdigit(), loc)
+                self.assertTrue(
+                    os.path.exists(os.path.join(_REPO_ROOT, path)), loc
+                )
+
+    def test_entry_point_list_is_sorted_names(self):
+        names = hook_entry_points()
+        self.assertEqual(names, sorted(names))
+        self.assertIn("record_sync", names)
+
+
+class TestJaxFreeLauncher(unittest.TestCase):
+    def test_launcher_runs_without_importing_jax_or_the_library(self):
+        # The launcher asserts internally that neither torcheval_tpu nor
+        # jax hit sys.modules; a clean exit proves it.
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_REPO_ROOT, "scripts", "tpulint.py"),
+                "--list-rules",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        self.assertEqual(proc.returncode, 0, proc.stderr)
+        for code in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005"):
+            self.assertIn(code, proc.stdout)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,384 @@
+"""tpulint rules: one true-positive AND one true-negative fixture per
+rule (TPU001..TPU005), exercised through the real engine
+(``analyze_files``) so suppression and fingerprint plumbing are on the
+path too (torcheval_tpu/analysis/)."""
+
+import os
+import tempfile
+import unittest
+
+import pytest
+
+from torcheval_tpu.analysis._core import analyze_files
+
+pytestmark = pytest.mark.analysis
+
+
+def run_lint(files):
+    """``files``: {display_path: source}.  Returns the Finding list."""
+    with tempfile.TemporaryDirectory() as td:
+        entries = []
+        for display, src in files.items():
+            open_path = os.path.join(td, display.replace("/", "__"))
+            with open(open_path, "w", encoding="utf-8") as f:
+                f.write(src)
+            entries.append((open_path, display))
+        return analyze_files(entries).all_findings
+
+
+def codes_of(findings):
+    return [f.code for f in findings]
+
+
+class TestHookGuardTPU001(unittest.TestCase):
+    def test_unguarded_hook_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "from torcheval_tpu.telemetry import events as _telemetry\n"
+                    "def f():\n"
+                    "    _telemetry.emit(1)\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU001"])
+        self.assertEqual(findings[0].line, 3)
+        self.assertIn("emit", findings[0].symbol)
+
+    def test_guard_shapes_pass(self):
+        # Every guard idiom the repo actually uses, in one fixture.
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "from torcheval_tpu.telemetry import events as _telemetry\n"
+                    "from torcheval_tpu.telemetry import health as _health\n"
+                    "def direct():\n"
+                    "    if _telemetry.ENABLED:\n"
+                    "        _telemetry.emit(1)\n"
+                    "def early_exit(x):\n"
+                    "    if not _telemetry.ENABLED:\n"
+                    "        return x\n"
+                    "    _telemetry.record_sync('op', 0.0, 0)\n"
+                    "    return x\n"
+                    "def ternary():\n"
+                    "    return _telemetry.timed_phase(1) if _telemetry.ENABLED else None\n"
+                    "def local_flag():\n"
+                    "    health = _health.ENABLED\n"
+                    "    def inner():\n"
+                    "        if health:\n"
+                    "            _health.inspect(None)\n"
+                    "    return inner\n"
+                    "def conjunction(extra):\n"
+                    "    if _telemetry.ENABLED and extra:\n"
+                    "        _telemetry.emit(2)\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+    def test_record_prefix_discovery(self):
+        # Any record_* name on the events module is a hook entry point,
+        # including ones this rule has never heard of.
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "from torcheval_tpu.telemetry import events as _telemetry\n"
+                    "def f():\n"
+                    "    _telemetry.record_completely_new_kind(1)\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU001"])
+
+    def test_quality_publish_rides_the_event_bus_guard(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "from torcheval_tpu.telemetry import events as _telemetry\n"
+                    "from torcheval_tpu.monitor import quality as _quality\n"
+                    "def ok(c):\n"
+                    "    if _telemetry.ENABLED:\n"
+                    "        _quality.publish(c)\n"
+                    "def bad(c):\n"
+                    "    _quality.publish(c)\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU001"])
+        self.assertEqual(findings[0].scope, "bad")
+
+
+class TestLayerOrderTPU002(unittest.TestCase):
+    def test_upward_module_level_import_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/ops/bad.py": (
+                    "from torcheval_tpu.metrics.collection import MetricCollection\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU002"])
+        self.assertIn("upward import", findings[0].message)
+
+    def test_lazy_function_level_import_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/ops/good.py": (
+                    "def f():\n"
+                    "    from torcheval_tpu.metrics.collection import MetricCollection\n"
+                    "    return MetricCollection\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+    def test_bus_leaf_import_is_foundation_everywhere(self):
+        # telemetry.events is pinned to the foundation layer: even ops
+        # (kernels) may import it at module level.
+        findings = run_lint(
+            {
+                "torcheval_tpu/ops/hooked.py": (
+                    "from torcheval_tpu.telemetry import events as _telemetry\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+    def test_cycle_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/metrics/a.py": "import torcheval_tpu.metrics.b\n",
+                "torcheval_tpu/metrics/b.py": "import torcheval_tpu.metrics.a\n",
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU002"])
+        self.assertIn("cycle", findings[0].message)
+
+
+class TestTracedHostSyncTPU003(unittest.TestCase):
+    def test_item_in_jitted_function_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "@jax.jit\n"
+                    "def f(x):\n"
+                    "    return x.item()\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU003"])
+
+    def test_item_outside_traced_region_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "def host_only(x):\n    return x.item()\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+    def test_static_metadata_coercion_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "import jax.numpy as jnp\n"
+                    "@jax.jit\n"
+                    "def f(x):\n"
+                    "    n = int(x.shape[0])\n"
+                    "    eps = float(jnp.finfo(x.dtype).eps)\n"
+                    "    return n, eps\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+    def test_host_branch_on_traced_param_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "@jax.jit\n"
+                    "def f(x):\n"
+                    "    if x > 0:\n"
+                    "        return x\n"
+                    "    return -x\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU003"])
+        self.assertIn("branch", findings[0].symbol)
+
+    def test_branch_on_static_arg_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import functools\n"
+                    "import jax\n"
+                    "@functools.partial(jax.jit, static_argnames=('mode',))\n"
+                    "def f(x, mode):\n"
+                    "    if mode:\n"
+                    "        return x\n"
+                    "    return -x\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+    def test_reachability_through_helper(self):
+        # The helper is not decorated, but the jitted entry calls it.
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "def helper(x):\n"
+                    "    return x.item()\n"
+                    "@jax.jit\n"
+                    "def f(x):\n"
+                    "    return helper(x)\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU003"])
+
+    def test_scan_body_is_traced(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "from jax import lax\n"
+                    "def body(c, x):\n"
+                    "    return c, x.item()\n"
+                    "def run(xs):\n"
+                    "    return lax.scan(body, 0, xs)\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU003"])
+
+
+class TestDonationSafetyTPU004(unittest.TestCase):
+    def test_read_after_donation_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "def step(s):\n"
+                    "    return s\n"
+                    "apply = jax.jit(step, donate_argnums=(0,))\n"
+                    "def run(state):\n"
+                    "    out = apply(state)\n"
+                    "    return state\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU004"])
+        self.assertEqual(findings[0].symbol, "state")
+
+    def test_rebinding_from_result_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "def step(s):\n"
+                    "    return s\n"
+                    "apply = jax.jit(step, donate_argnums=(0,))\n"
+                    "def run(state):\n"
+                    "    state = apply(state)\n"
+                    "    return state\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+    def test_non_donated_argnum_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "def step(s, x):\n"
+                    "    return s\n"
+                    "apply = jax.jit(step, donate_argnums=(0,))\n"
+                    "def run(state, batch):\n"
+                    "    state = apply(state, batch)\n"
+                    "    return batch\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+    def test_conditional_donation_counts(self):
+        # `(0,) if donate else ()` possibly donates index 0: the read is
+        # unsafe on any path where donation happened.
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "def step(s):\n"
+                    "    return s\n"
+                    "def build(donate):\n"
+                    "    return jax.jit(step, donate_argnums=(0,) if donate else ())\n"
+                    "def run(state, donate):\n"
+                    "    apply = jax.jit(step, donate_argnums=(0,) if donate else ())\n"
+                    "    out = apply(state)\n"
+                    "    return state\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU004"])
+
+
+class TestTracedDeterminismTPU005(unittest.TestCase):
+    def test_wall_clock_in_traced_region_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import time\n"
+                    "import jax\n"
+                    "@jax.jit\n"
+                    "def f(x):\n"
+                    "    return x + time.time()\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU005"])
+        self.assertEqual(findings[0].symbol, "time.time")
+
+    def test_np_random_in_traced_region_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import jax\n"
+                    "import numpy as np\n"
+                    "@jax.jit\n"
+                    "def f(x):\n"
+                    "    return x + np.random.rand()\n"
+                )
+            }
+        )
+        self.assertEqual(codes_of(findings), ["TPU005"])
+
+    def test_wall_clock_on_host_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/somemod.py": (
+                    "import time\n"
+                    "def f():\n"
+                    "    return time.time()\n"
+                )
+            }
+        )
+        self.assertEqual(findings, [])
+
+
+class TestParseErrors(unittest.TestCase):
+    def test_unparsable_source_is_a_tpu000_finding(self):
+        findings = run_lint(
+            {"torcheval_tpu/broken.py": "def f(:\n    pass\n"}
+        )
+        self.assertEqual(codes_of(findings), ["TPU000"])
+
+
+if __name__ == "__main__":
+    unittest.main()

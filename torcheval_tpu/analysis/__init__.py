@@ -1,0 +1,267 @@
+"""tpulint — repo-native static analysis for the TPU metrics stack.
+
+Proves three contracts at parse time, before any chip sees the code:
+
+- **hot-path**: every telemetry/health/faults/perfscope/quality hook
+  call is dominated by its ``ENABLED`` branch (TPU001);
+- **layering**: module-level imports respect the layer DAG and stay
+  acyclic (TPU002);
+- **tracer-safety**: no host syncs (TPU003), no reads of donated
+  buffers (TPU004), no wall-clock/RNG constants baked into traces
+  (TPU005).
+
+Run it::
+
+    python -m torcheval_tpu.analysis [paths] [--json] [--baseline FILE]
+
+or jax-free (CI pre-commit) via ``python scripts/tpulint.py``.  Exit
+codes: 0 clean, 1 new findings, 2 unreadable path argument.
+
+This subpackage is stdlib-only and uses relative imports exclusively —
+it must run where jax is absent and must never import the code it
+analyzes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, TextIO, Tuple
+
+from ._baseline import load_baseline, split_by_baseline, write_baseline
+from ._config import (
+    DEFAULT_BASELINE_NAME,
+    DEFAULT_EXCLUDES,
+    DEFAULT_TARGETS,
+    REPO_ROOT,
+    Config,
+)
+from ._core import (
+    AnalysisResult,
+    Finding,
+    Module,
+    all_rules,
+    analyze_files,
+    iter_python_files,
+    module_name_for,
+)
+from ._report import render_json, render_rule_table, render_text
+from .rules.hook_guard import HOOK_SPECS, discover_hook_sites
+
+__all__ = [
+    "Finding",
+    "AnalysisResult",
+    "analyze",
+    "hook_entry_points",
+    "hook_site_map",
+    "main",
+]
+
+
+def _display_path(path: str) -> str:
+    """Repo-relative display path (fingerprints must not depend on CWD
+    or on how the target argument was spelled)."""
+    ap = os.path.abspath(path)
+    root = REPO_ROOT + os.sep
+    if ap.startswith(root):
+        return os.path.relpath(ap, REPO_ROOT).replace(os.sep, "/")
+    return path.replace(os.sep, "/")
+
+
+def _expand(
+    paths: Sequence[str], excludes: Sequence[str]
+) -> Tuple[List[Tuple[str, str]], List[str]]:
+    files, missing = iter_python_files(paths, excludes)
+    return [(f, _display_path(f)) for f in files], missing
+
+
+def analyze(
+    paths: Optional[Sequence[str]] = None,
+    excludes: Optional[Sequence[str]] = None,
+) -> AnalysisResult:
+    """Programmatic entry point: analyze ``paths`` (default: the repo's
+    configured targets) and return the raw result, pre-baseline."""
+    cfg = Config.with_defaults()
+    entries, _ = _expand(
+        list(paths) if paths else cfg.paths,
+        list(excludes) if excludes is not None else cfg.excludes,
+    )
+    return analyze_files(entries)
+
+
+def _load_modules(
+    paths: Sequence[str], excludes: Sequence[str]
+) -> List[Module]:
+    entries, _ = _expand(paths, excludes)
+    mods: List[Module] = []
+    for open_path, disp in entries:
+        try:
+            mods.append(
+                Module.load(
+                    open_path,
+                    module_name_for(disp, ("torcheval_tpu",)),
+                    display=disp,
+                )
+            )
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+    return mods
+
+
+def hook_site_map(
+    paths: Optional[Sequence[str]] = None,
+) -> Dict[str, List[str]]:
+    """Statically discovered hook call sites keyed by runtime-namespace
+    hook name (``record_sync``, ``health.inspect``, ...), each mapping
+    to its ``path:line`` list.  Default scope: the library package only
+    — the set ``scripts/check_hot_path_overhead.py`` must cover with
+    counting wrappers."""
+    target = list(paths) if paths else [
+        os.path.join(REPO_ROOT, "torcheval_tpu")
+    ]
+    return discover_hook_sites(
+        _load_modules(target, list(DEFAULT_EXCLUDES))
+    )
+
+
+def hook_entry_points(
+    paths: Optional[Sequence[str]] = None,
+) -> List[str]:
+    """Sorted runtime-namespace hook names with at least one call site
+    in the tree — the coverage floor for the overhead harness."""
+    return sorted(hook_site_map(paths))
+
+
+_EPILOG = """\
+exit codes:
+  0  clean (no findings beyond the baseline)
+  1  new findings
+  2  an argument path does not exist or is not analyzable source
+
+scoped-out files (config, see torcheval_tpu/analysis/_config.py):
+  scripts/round4_chip_session.py, scripts/round5_chip_session.py and
+  scripts/r3_chip_runbook.sh are frozen transcripts of interactive
+  chip-debugging rounds, kept for provenance; they are excluded from
+  directory walks.  tests/ is not a default target (tests call hook
+  entry points directly with the bus enabled on purpose); lint it by
+  passing tests/ explicitly.
+
+suppressions:
+  # tpulint: disable=TPU001 -- one-line justification
+  on the finding's line or the line above silences that code there.
+  Grandfathered findings live in tpulint.baseline (fingerprints are
+  line-independent); --write-baseline regenerates it.
+"""
+
+
+def main(
+    argv: Optional[Sequence[str]] = None,
+    stdout: Optional[TextIO] = None,
+    stderr: Optional[TextIO] = None,
+) -> int:
+    out = stdout if stdout is not None else sys.stdout
+    err = stderr if stderr is not None else sys.stderr
+    parser = argparse.ArgumentParser(
+        prog="tpulint",
+        description=(
+            "Static analysis for the torcheval_tpu contracts: hook "
+            "guards (TPU001), layer order (TPU002), traced host syncs "
+            "(TPU003), donation safety (TPU004), traced determinism "
+            "(TPU005)."
+        ),
+        epilog=_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help=(
+            "files or directories to analyze (default: "
+            + ", ".join(DEFAULT_TARGETS)
+            + " under the repo root)"
+        ),
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="FILE",
+        default=None,
+        help=(
+            "baseline file of grandfathered fingerprints (default: "
+            f"{DEFAULT_BASELINE_NAME} at the repo root when present; "
+            "pass an empty string to ignore it)"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help=(
+            "rewrite the baseline file with every current finding and "
+            "exit 0 (then edit in the justifications)"
+        ),
+    )
+    parser.add_argument(
+        "--hook-sites",
+        action="store_true",
+        help=(
+            "print the discovered hook-site map (runtime hook name -> "
+            "call sites) as JSON and exit"
+        ),
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule table"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        render_rule_table(all_rules(), out)
+        return 0
+
+    cfg = Config.with_defaults()
+    paths = list(args.paths) if args.paths else cfg.paths
+    if args.baseline is None:
+        baseline_path = cfg.baseline
+    elif args.baseline == "":
+        baseline_path = ""
+    else:
+        baseline_path = args.baseline
+
+    if args.hook_sites:
+        import json as _json
+
+        scope = list(args.paths) if args.paths else None
+        _json.dump(hook_site_map(scope), out, indent=2)
+        out.write("\n")
+        return 0
+
+    entries, missing = _expand(paths, cfg.excludes)
+    if missing:
+        for m in missing:
+            err.write(f"tpulint: cannot read {m}\n")
+        return 2
+
+    result = analyze_files(entries)
+    baseline = load_baseline(baseline_path) if baseline_path else {}
+    new, grandfathered, stale = split_by_baseline(
+        result.all_findings, baseline
+    )
+
+    if args.write_baseline:
+        target = baseline_path or os.path.join(
+            REPO_ROOT, DEFAULT_BASELINE_NAME
+        )
+        write_baseline(target, result.all_findings, baseline)
+        err.write(
+            f"tpulint: wrote {len(result.all_findings)} fingerprint(s) "
+            f"to {target}\n"
+        )
+        return 0
+
+    if args.json:
+        render_json(new, grandfathered, stale, len(result.files), out)
+    else:
+        render_text(new, grandfathered, stale, len(result.files), out)
+    return 1 if new else 0

@@ -1,0 +1,8 @@
+"""``python -m torcheval_tpu.analysis`` — the tpulint CLI."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

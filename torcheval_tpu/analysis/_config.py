@@ -1,0 +1,59 @@
+"""tpulint run configuration: default targets and scoped-out files.
+
+The defaults are anchored on the repo root derived from this file's
+location, so ``python -m torcheval_tpu.analysis`` (and the jax-free
+``scripts/tpulint.py`` launcher) behave identically from any CWD.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Tuple
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# Analyzed when no paths are given: the library plus its maintained
+# tooling.  tests/ is deliberately NOT a default target — tests call
+# hook entry points directly with the bus enabled (that is their job);
+# pass tests/ explicitly to lint it anyway.
+DEFAULT_TARGETS: Tuple[str, ...] = ("torcheval_tpu", "scripts")
+
+# One-off chip-session transcripts: frozen records of interactive TPU
+# debugging rounds, kept for provenance, not maintained as library code.
+# They are scoped out of the repo-wide run here (config, not a crash) —
+# see the CLI ``--help`` epilog.  ``r3_chip_runbook.sh`` is listed for
+# documentation although non-Python files are skipped in directory
+# walks anyway.
+DEFAULT_EXCLUDES: Tuple[str, ...] = (
+    "scripts/round4_chip_session.py",
+    "scripts/round5_chip_session.py",
+    "scripts/r3_chip_runbook.sh",
+    ".jax_cache_tests",
+)
+
+DEFAULT_BASELINE_NAME = "tpulint.baseline"
+
+
+@dataclass
+class Config:
+    paths: List[str] = field(default_factory=list)
+    excludes: List[str] = field(
+        default_factory=lambda: list(DEFAULT_EXCLUDES)
+    )
+    baseline: str = ""
+
+    @classmethod
+    def with_defaults(cls) -> "Config":
+        cfg = cls()
+        cfg.paths = [
+            os.path.join(REPO_ROOT, t)
+            for t in DEFAULT_TARGETS
+            if os.path.exists(os.path.join(REPO_ROOT, t))
+        ]
+        default_baseline = os.path.join(REPO_ROOT, DEFAULT_BASELINE_NAME)
+        if os.path.exists(default_baseline):
+            cfg.baseline = default_baseline
+        return cfg

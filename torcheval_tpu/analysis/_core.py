@@ -1,0 +1,460 @@
+"""tpulint core: source loading, AST utilities, findings, rule registry.
+
+Everything in this package is **stdlib-only** (``ast`` + friends): the
+linter must run in environments without jax (the pre-commit CI job) and
+must never pay an import of the library it is analyzing.  To that end
+the whole subpackage uses relative imports, so ``scripts/tpulint.py``
+can load it under a synthetic package name without triggering
+``torcheval_tpu/__init__`` (which imports jax).
+
+The central objects:
+
+- :class:`Module` — one parsed source file: path, module name, AST with
+  parent links, source lines, suppression table.
+- :class:`Finding` — one diagnostic, carrying a line for humans and a
+  line-independent *fingerprint* for the baseline file (line numbers
+  drift; ``code:path:scope:symbol#occurrence`` does not).
+- :class:`Rule` — the rule protocol; concrete rules live in
+  ``analysis/rules/`` and register via :func:`register`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# --------------------------------------------------------------------- AST
+
+
+def attach_parents(tree: ast.AST) -> None:
+    """Set ``node.tpulint_parent`` on every node (dominance checks and
+    scope walks need upward navigation, which ``ast`` does not give)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.tpulint_parent = node  # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "tpulint_parent", None)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    """Nearest enclosing FunctionDef/AsyncFunctionDef/Lambda, or None at
+    module level."""
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            return cur
+        cur = parent(cur)
+    return None
+
+
+def scope_qualname(node: ast.AST) -> str:
+    """Dotted path of enclosing class/function defs, ``<module>`` when
+    the node sits at module level.  Used in fingerprints: stable across
+    line drift, specific enough to pin a finding."""
+    names: List[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            names.append(cur.name)
+        cur = parent(cur)
+    return ".".join(reversed(names)) or "<module>"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None (calls,
+    subscripts and other dynamic bases defeat static resolution)."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ------------------------------------------------------------ import model
+
+
+@dataclass
+class ImportedName:
+    """One local binding produced by an import statement.
+
+    ``module_candidates`` are the fully-dotted modules this name may
+    refer to; for ``from a.b import c`` both ``a.b.c`` (c is a module)
+    and ``a.b`` with ``attr='c'`` (c is a function) are possible — the
+    consumer checks both against its own table, so the ambiguity is
+    harmless.
+    """
+
+    local: str
+    module_candidates: Tuple[str, ...]
+    attr: Optional[str] = None  # set for `from M import attr`
+    lineno: int = 0
+    function_level: bool = False  # import nested inside a def
+
+
+def _resolve_relative(module: Optional[str], level: int, pkg: str) -> str:
+    """Absolute module for a ``from ...x import y`` given the importing
+    module's *package* dotted name ``pkg`` (for a package ``__init__``
+    that is the module name itself; for a plain module, its parent)."""
+    if level == 0:
+        return module or ""
+    base = pkg.split(".") if pkg else []
+    drop = level - 1  # level 1 = the package itself
+    base = base[: len(base) - drop] if drop <= len(base) else []
+    if module:
+        base.append(module)
+    return ".".join(base)
+
+
+def collect_imports(mod: "Module") -> List[ImportedName]:
+    """Every import binding in the file, flow-insensitively.  Marks
+    function-level (lazy) imports — the layer rule only constrains
+    module-level edges."""
+    out: List[ImportedName] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            fl = enclosing_function(node) is not None
+            for alias in node.names:
+                if alias.asname:
+                    # `import a.b.c as x`: x IS module a.b.c.
+                    local, target = alias.asname, alias.name
+                else:
+                    # `import a.b.c` binds `a`; the chain walker folds
+                    # trailing attrs back into the dotted module path.
+                    local = target = alias.name.split(".")[0]
+                out.append(
+                    ImportedName(
+                        local=local,
+                        module_candidates=(target,),
+                        lineno=node.lineno,
+                        function_level=fl,
+                    )
+                )
+        elif isinstance(node, ast.ImportFrom):
+            fl = enclosing_function(node) is not None
+            base = _resolve_relative(node.module, node.level, mod.package)
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                out.append(
+                    ImportedName(
+                        local=local,
+                        module_candidates=(
+                            f"{base}.{alias.name}" if base else alias.name,
+                            base,
+                        ),
+                        attr=alias.name,
+                        lineno=node.lineno,
+                        function_level=fl,
+                    )
+                )
+    return out
+
+
+def resolve_chain(
+    mod: "Module", node: ast.AST
+) -> List[Tuple[str, Optional[str]]]:
+    """Resolve a Name/Attribute chain against the module's import
+    bindings.  Returns ``(module, attr)`` candidates: e.g. with
+    ``from torcheval_tpu.telemetry import events as _telemetry``,
+    ``_telemetry.record_sync`` yields
+    ``("torcheval_tpu.telemetry.events", "record_sync")``.
+    """
+    dn = dotted_name(node)
+    if dn is None:
+        return []
+    parts = dn.split(".")
+    head, rest = parts[0], parts[1:]
+    out: List[Tuple[str, Optional[str]]] = []
+    for imp in mod.imports_by_local.get(head, []):
+        for cand in imp.module_candidates:
+            if not cand:
+                continue
+            if imp.attr is not None and cand != imp.module_candidates[0]:
+                # `from M import a` second candidate: name IS M.a
+                chain = [imp.attr] + rest
+            else:
+                chain = list(rest)
+            # Fold leading attrs into the module path, offering every
+            # split point: a.b.c may be module a.b attr c or module
+            # a.b.c attr None...
+            for k in range(len(chain), -1, -1):
+                m = ".".join([cand] + chain[:k])
+                attr = chain[k] if k < len(chain) else None
+                if k + 1 < len(chain):
+                    continue  # only allow one trailing attribute
+                out.append((m, attr))
+    return out
+
+
+# ----------------------------------------------------------------- module
+
+
+@dataclass
+class Module:
+    path: str  # as passed (usually repo-relative)
+    name: str  # dotted module name, e.g. torcheval_tpu.metrics._bucket
+    source: str
+    tree: ast.AST
+    is_package: bool = False  # True for an __init__.py
+    lines: List[str] = field(default_factory=list)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    imports: List[ImportedName] = field(default_factory=list)
+    imports_by_local: Dict[str, List[ImportedName]] = field(
+        default_factory=dict
+    )
+
+    @classmethod
+    def load(
+        cls, path: str, name: str, display: Optional[str] = None
+    ) -> "Module":
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+        attach_parents(tree)
+        mod = cls(
+            path=display or path,
+            name=name,
+            source=source,
+            tree=tree,
+            is_package=os.path.basename(path) == "__init__.py",
+            lines=source.splitlines(),
+        )
+        from ._suppress import collect_suppressions
+
+        mod.suppressions = collect_suppressions(source)
+        mod.imports = collect_imports(mod)
+        for imp in mod.imports:
+            mod.imports_by_local.setdefault(imp.local, []).append(imp)
+        return mod
+
+    @property
+    def package(self) -> str:
+        """The package relative imports resolve against: the module
+        itself for an ``__init__``, its parent otherwise."""
+        return self.name if self.is_package else self.name.rpartition(".")[0]
+
+    def suppressed(self, lineno: int, code: str) -> bool:
+        for ln in (lineno, lineno - 1):
+            codes = self.suppressions.get(ln)
+            if codes and (code in codes or "*" in codes):
+                return True
+        return False
+
+
+def module_name_for(path: str, roots: Sequence[str]) -> str:
+    """Dotted module name for a file path.  Files under a recognized
+    package root get real package names; anything else gets a
+    path-derived pseudo-name (``scripts.bench_foo``) — good enough for
+    fingerprints and for the layer rule's "outside the package" bucket.
+    """
+    norm = path.replace(os.sep, "/")
+    for root in roots:
+        root = root.rstrip("/")
+        marker = root.split("/")[-1]
+        idx = norm.rfind(marker + "/")
+        if idx >= 0 or norm == marker:
+            tail = norm[idx:] if idx >= 0 else norm
+            mod = tail[:-3] if tail.endswith(".py") else tail
+            mod = mod.replace("/", ".")
+            if mod.endswith(".__init__"):
+                mod = mod[: -len(".__init__")]
+            return mod
+    mod = norm[:-3] if norm.endswith(".py") else norm
+    mod = mod.strip("/").replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+# ---------------------------------------------------------------- finding
+
+
+@dataclass
+class Finding:
+    code: str
+    path: str
+    line: int
+    message: str
+    scope: str = "<module>"
+    symbol: str = ""
+    occurrence: int = 0  # disambiguates repeats of the same symbol/scope
+
+    @property
+    def fingerprint(self) -> str:
+        base = f"{self.code}:{_norm(self.path)}:{self.scope}:{self.symbol}"
+        return base if self.occurrence == 0 else f"{base}#{self.occurrence}"
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "code": self.code,
+            "path": _norm(self.path),
+            "line": self.line,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        return f"{_norm(self.path)}:{self.line}: {self.code} {self.message}"
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def assign_occurrences(findings: List[Finding]) -> None:
+    """Number repeated (code, path, scope, symbol) findings so each gets
+    a distinct fingerprint (ordered by line: stable under unrelated
+    edits, adjacent under local ones)."""
+    seen: Dict[str, int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line)):
+        key = f"{f.code}:{_norm(f.path)}:{f.scope}:{f.symbol}"
+        n = seen.get(key, 0)
+        f.occurrence = n
+        seen[key] = n + 1
+
+
+# ------------------------------------------------------------------ rules
+
+
+class Rule:
+    """One lint rule.  Subclasses set ``code``/``name``/``summary`` and
+    implement ``check_module`` (per-file) and/or ``check_program``
+    (whole-run: the layer rule needs the global import graph)."""
+
+    code: str = "TPU000"
+    name: str = "abstract"
+    summary: str = ""
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        return []
+
+    def check_program(self, mods: List[Module]) -> List[Finding]:
+        return []
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.code in _REGISTRY:
+        raise ValueError(f"duplicate rule code {rule.code}")
+    _REGISTRY[rule.code] = rule
+    return rule
+
+
+def all_rules() -> List[Rule]:
+    from . import rules as _rules  # noqa: F401 - triggers registration
+
+    return [r for _, r in sorted(_REGISTRY.items())]
+
+
+# ------------------------------------------------------------- the engine
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding]
+    files: List[str]
+    errors: List[Finding]  # parse failures, reported as TPU000
+
+    @property
+    def all_findings(self) -> List[Finding]:
+        return self.errors + self.findings
+
+
+def iter_python_files(
+    paths: Iterable[str], excludes: Sequence[str]
+) -> Tuple[List[str], List[str]]:
+    """Expand path arguments into .py files.  Returns (files, missing):
+    a nonexistent *argument* is the CLI's exit-2 case; excluded or
+    non-Python files inside a directory walk are silently scoped out.
+    """
+    files: List[str] = []
+    missing: List[str] = []
+
+    def excluded(p: str) -> bool:
+        n = _norm(p)
+        return any(n.endswith(_norm(e)) or f"/{_norm(e)}/" in n for e in excludes)
+
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py") and not excluded(p):
+                files.append(p)
+            elif not os.path.exists(p):  # pragma: no cover - isfile said yes
+                missing.append(p)
+            elif not p.endswith(".py") and not excluded(p):
+                # An explicit non-Python file argument is unreadable as
+                # source — the caller asked for it by name, so fail loud.
+                missing.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d
+                    for d in dirs
+                    if d not in ("__pycache__",)
+                    and not d.startswith(".")
+                    and not excluded(os.path.join(dirpath, d))
+                )
+                for fn in sorted(names):
+                    full = os.path.join(dirpath, fn)
+                    if fn.endswith(".py") and not excluded(full):
+                        files.append(full)
+        else:
+            missing.append(p)
+    return files, missing
+
+
+def analyze_files(
+    files: Sequence, package_roots: Sequence[str] = ("torcheval_tpu",)
+) -> AnalysisResult:
+    """``files``: open paths, or ``(open_path, display_path)`` pairs.
+    Display paths (repo-relative) go into findings and fingerprints so
+    baselines match regardless of CWD or how targets were spelled."""
+    mods: List[Module] = []
+    errors: List[Finding] = []
+    display: List[str] = []
+    for entry in files:
+        open_path, path = (
+            entry if isinstance(entry, tuple) else (entry, entry)
+        )
+        display.append(path)
+        name = module_name_for(path, package_roots)
+        try:
+            mods.append(Module.load(open_path, name, display=path))
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            errors.append(
+                Finding(
+                    code="TPU000",
+                    path=path,
+                    line=getattr(exc, "lineno", 0) or 0,
+                    message=f"unparsable source: {exc.__class__.__name__}: {exc}",
+                    symbol="parse",
+                )
+            )
+    findings: List[Finding] = []
+    for rule in all_rules():
+        for mod in mods:
+            for f in rule.check_module(mod):
+                if not mod.suppressed(f.line, f.code):
+                    findings.append(f)
+        by_path = {m.path: m for m in mods}
+        for f in rule.check_program(mods):
+            m = by_path.get(f.path)
+            if m is None or not m.suppressed(f.line, f.code):
+                findings.append(f)
+    assign_occurrences(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return AnalysisResult(findings=findings, files=display, errors=errors)

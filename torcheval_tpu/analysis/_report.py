@@ -1,0 +1,75 @@
+"""tpulint reporters: human text and machine JSON.
+
+Both consume the same post-baseline split so the CLI's exit code, the
+text summary, and the JSON payload can never disagree about what counts
+as *new*.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, TextIO
+
+from ._core import Finding
+
+
+def render_text(
+    new: Sequence[Finding],
+    grandfathered: Sequence[Finding],
+    stale: Sequence[str],
+    files_checked: int,
+    out: TextIO,
+) -> None:
+    for f in new:
+        out.write(f.render() + "\n")
+    if new:
+        out.write("\n")
+    counts: Dict[str, int] = {}
+    for f in new:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    summary = ", ".join(f"{c} {n}" for c, n in sorted(counts.items()))
+    out.write(
+        f"tpulint: {len(new)} new finding(s)"
+        + (f" ({summary})" if summary else "")
+        + f" in {files_checked} file(s)"
+    )
+    if grandfathered:
+        out.write(f"; {len(grandfathered)} baselined")
+    if stale:
+        out.write(f"; {len(stale)} stale baseline entrie(s)")
+    out.write("\n")
+    for fp in stale:
+        out.write(f"  stale (fixed? prune from baseline): {fp}\n")
+
+
+def render_json(
+    new: Sequence[Finding],
+    grandfathered: Sequence[Finding],
+    stale: Sequence[str],
+    files_checked: int,
+    out: TextIO,
+) -> None:
+    payload = {
+        "version": 1,
+        "files_checked": files_checked,
+        "new": [f.as_dict() for f in new],
+        "grandfathered": [f.as_dict() for f in grandfathered],
+        "stale_baseline": list(stale),
+        "summary": _summary(new),
+    }
+    json.dump(payload, out, indent=2, sort_keys=True)
+    out.write("\n")
+
+
+def _summary(new: Sequence[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in new:
+        counts[f.code] = counts.get(f.code, 0) + 1
+    counts["total"] = len(new)
+    return counts
+
+
+def render_rule_table(rules: List, out: TextIO) -> None:
+    width = max((len(r.code) for r in rules), default=6)
+    for r in rules:
+        out.write(f"{r.code:<{width}}  {r.name}: {r.summary}\n")

@@ -1,0 +1,6 @@
+"""tpulint rule modules.  Importing this package registers every rule
+with the central registry (``_core.all_rules`` does this lazily)."""
+
+from . import donation, hook_guard, layer_order, traced  # noqa: F401
+
+__all__ = ["donation", "hook_guard", "layer_order", "traced"]

@@ -1,0 +1,438 @@
+"""TPU003 / TPU005: contracts inside traced (jit / scan / shard_map)
+regions.
+
+A *traced region* is any function statically reachable from a tracing
+entry point found in the same module:
+
+- ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorated defs,
+- functions passed to ``jax.jit(f, ...)`` calls,
+- bodies handed to ``jax.lax.scan`` / ``lax.scan``,
+- functions wrapped by ``shard_map`` / ``jax.experimental.shard_map``,
+- ``jax.pmap`` / ``jax.vmap`` wrappees.
+
+Reachability uses a conservative same-module call graph: a call to a
+bare name resolves to any def of that name in the module; ``self.m()``
+/ ``cls.m()`` resolve to any method named ``m``.  Cross-module calls
+are not followed (their modules get their own entry points when they
+trace).
+
+**TPU003 traced-host-sync** flags, inside traced regions:
+
+- ``.item()`` / ``.tolist()`` — device->host sync per call;
+- ``float(x)`` / ``int(x)`` / ``bool(x)`` on non-literal arguments —
+  implicit concretization, a ``TracerConversionError`` at best and a
+  silent sync under weak types at worst;
+- ``np.asarray`` / ``np.array`` / ``jax.device_get`` — host round-trip;
+- ``if``/``while`` branching directly on a traced parameter of an
+  entry-point function (static/kwarg-config branching on closure values
+  is fine and common; branching on the traced operand is the bug).
+  Parameters named in ``static_argnums``/``static_argnames`` are
+  exempt.
+
+**TPU005 traced-determinism** flags host-side nondeterminism baked into
+a trace as a constant: ``time.time``/``monotonic``/``perf_counter``/
+``time_ns``, ``random.*``, ``np.random.*``, ``os.urandom``, and argless
+``datetime.now()``/``utcnow()`` — each evaluates once at trace time and
+then lies on every cached execution.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .._core import (
+    Finding,
+    Module,
+    Rule,
+    dotted_name,
+    parent,
+    register,
+    scope_qualname,
+)
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Dotted suffixes that mark a tracing entry point when called.
+_JIT_CHAINS = {"jax.jit", "jit"}
+_SCAN_CHAINS = {"jax.lax.scan", "lax.scan", "scan"}
+_SHARD_CHAINS = {"shard_map", "jax.experimental.shard_map.shard_map"}
+_MAP_CHAINS = {"jax.pmap", "pmap", "jax.vmap", "vmap"}
+
+
+def _is_partial(call: ast.Call) -> bool:
+    dn = dotted_name(call.func)
+    return dn in ("partial", "functools.partial")
+
+
+def _jit_statics(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """static_argnums/static_argnames constants from a jit(...) call."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+    return nums, names
+
+
+class _Entry:
+    def __init__(
+        self,
+        fn: ast.AST,
+        kind: str,
+        static_nums: Set[int],
+        static_names: Set[str],
+    ) -> None:
+        self.fn = fn
+        self.kind = kind
+        self.static_nums = static_nums
+        self.static_names = static_names
+
+    def traced_params(self) -> Set[str]:
+        args = getattr(self.fn, "args", None)
+        if args is None:
+            return set()
+        names = [a.arg for a in args.posonlyargs + args.args]
+        out: Set[str] = set()
+        for i, name in enumerate(names):
+            if name in ("self", "cls"):
+                continue
+            if i in self.static_nums or name in self.static_names:
+                continue
+            out.add(name)
+        out.update(
+            a.arg
+            for a in args.kwonlyargs
+            if a.arg not in self.static_names
+        )
+        return out
+
+
+def _defs_in_module(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    """name -> defs (functions anywhere, methods keyed by bare name)."""
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, _FuncDef):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _find_entries(mod: Module) -> List[_Entry]:
+    defs = _defs_in_module(mod.tree)
+    entries: List[_Entry] = []
+    seen: Set[int] = set()
+
+    def add(fn: Optional[ast.AST], kind: str, nums=(), names=()) -> None:
+        if fn is None or id(fn) in seen:
+            return
+        seen.add(id(fn))
+        entries.append(_Entry(fn, kind, set(nums), set(names)))
+
+    def lookup(node: ast.AST) -> Optional[ast.AST]:
+        if isinstance(node, (ast.Lambda,) + _FuncDef):
+            return node
+        dn = dotted_name(node)
+        if dn is None:
+            return None
+        name = dn.split(".")[-1]
+        cands = defs.get(name, [])
+        return cands[0] if len(cands) >= 1 else None
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, _FuncDef):
+            for dec in node.decorator_list:
+                dn = dotted_name(dec)
+                if dn in _JIT_CHAINS | _MAP_CHAINS:
+                    add(node, dn or "jit")
+                elif isinstance(dec, ast.Call):
+                    dnc = dotted_name(dec.func)
+                    if dnc in _JIT_CHAINS | _MAP_CHAINS:
+                        nums, names = _jit_statics(dec)
+                        add(node, dnc, nums, names)
+                    elif _is_partial(dec) and dec.args:
+                        inner = dotted_name(dec.args[0])
+                        if inner in _JIT_CHAINS | _MAP_CHAINS:
+                            nums, names = _jit_statics(dec)
+                            add(node, inner, nums, names)
+        elif isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            if dn in _JIT_CHAINS | _MAP_CHAINS and node.args:
+                nums, names = _jit_statics(node)
+                add(lookup(node.args[0]), dn, nums, names)
+            elif _is_partial(node) and node.args:
+                inner = dotted_name(node.args[0])
+                if inner in _JIT_CHAINS | _MAP_CHAINS and len(node.args) > 1:
+                    nums, names = _jit_statics(node)
+                    add(lookup(node.args[1]), inner, nums, names)
+            elif dn in _SCAN_CHAINS and node.args:
+                add(lookup(node.args[0]), "scan")
+            elif dn in _SHARD_CHAINS and node.args:
+                add(lookup(node.args[0]), "shard_map")
+    return entries
+
+
+def _reachable(
+    mod: Module, entries: Sequence[_Entry]
+) -> Dict[int, Tuple[ast.AST, _Entry]]:
+    """id(def) -> (def node, originating entry) for every same-module
+    function reachable from a traced entry point."""
+    defs = _defs_in_module(mod.tree)
+    out: Dict[int, Tuple[ast.AST, _Entry]] = {}
+    work: List[Tuple[ast.AST, _Entry]] = [(e.fn, e) for e in entries]
+    while work:
+        fn, origin = work.pop()
+        if id(fn) in out:
+            continue
+        out[id(fn)] = (fn, origin)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            if dn is None:
+                continue
+            parts = dn.split(".")
+            callee: Optional[str] = None
+            if len(parts) == 1:
+                callee = parts[0]
+            elif len(parts) == 2 and parts[0] in ("self", "cls"):
+                callee = parts[1]
+            if callee is None:
+                continue
+            for cand in defs.get(callee, []):
+                work.append((cand, origin))
+    return out
+
+
+def _enclosing_def(node: ast.AST) -> Optional[ast.AST]:
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.Lambda,) + _FuncDef):
+            return cur
+        cur = parent(cur)
+    return None
+
+
+_COERCIONS = {"float", "int", "bool"}
+
+# Metadata that is static under trace: coercing a value derived from
+# shapes, dtypes or finfo/len is trace-time host math, not a sync.
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype", "itemsize", "nbytes"}
+_STATIC_CALLS = {"len", "jnp.finfo", "np.finfo", "jnp.iinfo", "np.iinfo",
+                 "jax.numpy.finfo", "numpy.finfo"}
+
+
+def _is_static_metadata(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call):
+            dn = dotted_name(sub.func)
+            if dn in _STATIC_CALLS:
+                return True
+    return False
+_HOST_CALLS = {
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "jax.device_get",
+}
+_NONDET_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "time.monotonic",
+    "time.perf_counter",
+    "os.urandom",
+}
+_NONDET_NOW = {"datetime.now", "datetime.datetime.now", "datetime.utcnow",
+               "datetime.datetime.utcnow"}
+_NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.", "_random.")
+
+
+class TracedRulesBase(Rule):
+    """Shared traversal for the two traced-region rules."""
+
+    def _traced_functions(self, mod: Module):
+        entries = _find_entries(mod)
+        if not entries:
+            return {}
+        return _reachable(mod, entries)
+
+
+class TracedHostSyncRule(TracedRulesBase):
+    code = "TPU003"
+    name = "traced-host-sync"
+    summary = (
+        "no host syncs (.item/float/np.asarray/host branching) inside "
+        "functions reachable from jit/scan/shard_map entry points"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        reach = self._traced_functions(mod)
+        if not reach:
+            return []
+        findings: List[Finding] = []
+        for fn, origin in reach.values():
+            traced_params = (
+                origin.traced_params() if fn is origin.fn else set()
+            )
+            for node in ast.walk(fn):
+                inner = _enclosing_def(node)
+                if inner is not fn and id(inner) not in reach:
+                    continue  # nested def not itself reachable
+                if isinstance(node, ast.Call):
+                    self._check_call(mod, fn, node, findings)
+                elif isinstance(node, (ast.If, ast.While)) and traced_params:
+                    self._check_branch(
+                        mod, fn, node, traced_params, findings
+                    )
+        return findings
+
+    def _check_call(
+        self,
+        mod: Module,
+        fn: ast.AST,
+        node: ast.Call,
+        findings: List[Finding],
+    ) -> None:
+        dn = dotted_name(node.func)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("item", "tolist")
+            and not node.args
+        ):
+            findings.append(
+                self._finding(
+                    mod,
+                    node,
+                    f"`.{node.func.attr}()` forces a device->host sync "
+                    "inside a traced region",
+                    node.func.attr,
+                )
+            )
+        elif (
+            dn in _COERCIONS
+            and len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)
+            and not _is_static_metadata(node.args[0])
+        ):
+            findings.append(
+                self._finding(
+                    mod,
+                    node,
+                    f"`{dn}(...)` concretizes a traced value "
+                    "(TracerConversionError or silent host sync)",
+                    dn,
+                )
+            )
+        elif dn in _HOST_CALLS:
+            findings.append(
+                self._finding(
+                    mod,
+                    node,
+                    f"`{dn}` pulls a traced value back to host",
+                    dn,
+                )
+            )
+
+    def _check_branch(
+        self,
+        mod: Module,
+        fn: ast.AST,
+        node: ast.AST,
+        traced_params: Set[str],
+        findings: List[Finding],
+    ) -> None:
+        test = node.test
+        name: Optional[str] = None
+        if isinstance(test, ast.Name) and test.id in traced_params:
+            name = test.id
+        elif isinstance(test, ast.Compare) and not any(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+        ):
+            operands = [test.left] + list(test.comparators)
+            for op in operands:
+                if isinstance(op, ast.Name) and op.id in traced_params:
+                    name = op.id
+                    break
+        if name is not None:
+            findings.append(
+                self._finding(
+                    mod,
+                    node,
+                    f"host branch on traced parameter `{name}` inside a "
+                    "traced entry point (use lax.cond/jnp.where, or mark "
+                    "it static)",
+                    f"branch:{name}",
+                )
+            )
+
+    def _finding(
+        self, mod: Module, node: ast.AST, message: str, symbol: str
+    ) -> Finding:
+        return Finding(
+            code=self.code,
+            path=mod.path,
+            line=node.lineno,
+            message=message,
+            scope=scope_qualname(node),
+            symbol=symbol,
+        )
+
+
+class TracedDeterminismRule(TracedRulesBase):
+    code = "TPU005"
+    name = "traced-determinism"
+    summary = (
+        "no wall-clock / RNG host calls inside traced regions "
+        "(they bake into the trace as constants)"
+    )
+
+    def check_module(self, mod: Module) -> List[Finding]:
+        reach = self._traced_functions(mod)
+        if not reach:
+            return []
+        findings: List[Finding] = []
+        for fn, _ in reach.values():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                inner = _enclosing_def(node)
+                if inner is not fn and id(inner) not in reach:
+                    continue
+                dn = dotted_name(node.func)
+                if dn is None:
+                    continue
+                hit = (
+                    dn in _NONDET_CALLS
+                    or (dn in _NONDET_NOW and not node.args)
+                    or any(dn.startswith(p) for p in _NONDET_PREFIXES)
+                )
+                if hit:
+                    findings.append(
+                        Finding(
+                            code=self.code,
+                            path=mod.path,
+                            line=node.lineno,
+                            message=(
+                                f"`{dn}` inside a traced region evaluates "
+                                "once at trace time and becomes a baked-in "
+                                "constant on every cached execution (use "
+                                "jax.random with a threaded key, or hoist "
+                                "to the host side)"
+                            ),
+                            scope=scope_qualname(node),
+                            symbol=dn,
+                        )
+                    )
+        return findings
+
+
+register(TracedHostSyncRule())
+register(TracedDeterminismRule())

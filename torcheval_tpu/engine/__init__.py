@@ -52,7 +52,6 @@ from torcheval_tpu.metrics._bucket import (
     pad_to_bucket,
 )
 from torcheval_tpu.metrics.collection import MetricCollection
-from torcheval_tpu.monitor import quality as _quality
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.resilience.checkpoint import CheckpointManager
 from torcheval_tpu.telemetry import events as _telemetry
@@ -525,6 +524,7 @@ class Evaluator:
             # steps=block.batches: stacked stats are reduced over the
             # REAL scan steps only, so the deliberate fully-masked tail
             # pad steps can never read as zero-weight batches.
+            # tpulint: disable=TPU001 -- health_stats is non-None only when the runner was built with health=_health.ENABLED
             _health.inspect(
                 health_stats,
                 source="engine_block",
@@ -551,7 +551,11 @@ class Evaluator:
                 # The live quality stream: every snapshot's figures
                 # (global + all slices, per window kind) become
                 # QualityEvents — the Prometheus / report() / fleet
-                # feed.  One branch, cold when the bus is off.
+                # feed.  One branch, cold when the bus is off.  Lazy
+                # import: engine (execution layer) must not import the
+                # monitor (observe layer) at module level.
+                from torcheval_tpu.monitor import quality as _quality
+
                 _quality.publish(
                     self._collection,
                     step=self.blocks_dispatched,

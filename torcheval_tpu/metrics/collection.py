@@ -359,6 +359,7 @@ class MetricCollection:
             # state), keeping every state attribute concrete + readable.
             if _telemetry.ENABLED and donate:
                 _telemetry.record_donation("abort")
+            # tpulint: disable=TPU004 -- abort-restore reads `before` with guard_deleted=True: deleted entries fall back to reset defaults
             self._install_states(before, guard_deleted=True)
             raise
         self._fused_seen.add(key)
@@ -376,6 +377,7 @@ class MetricCollection:
             profiled = _perfscope.profile_program(
                 "fused_collection",
                 self._fused_apply,
+                # tpulint: disable=TPU004 -- shadow lowering reads avals only; deleted donated buffers still carry shape/dtype
                 (before, args, kwargs),
                 batch_args=(args, kwargs),
                 donate=donate,
@@ -397,6 +399,7 @@ class MetricCollection:
             # After _install_states: a raise-on-corrupt escalation must
             # not leave tracer/deleted states behind — the batch was
             # applied, the monitor only reports it.
+            # tpulint: disable=TPU001 -- health_stats is non-None only when the program was built with health=_health.ENABLED
             _health.inspect(
                 health_stats,
                 source="fused_update",

@@ -16,10 +16,6 @@ the post-sort scan when available.
 import jax
 import jax.numpy as jnp
 
-from torcheval_tpu.metrics.functional.classification._sort_scan import (
-    sorted_tie_cumsums,
-)
-
 
 def has_fused() -> bool:
     """Availability flag (the analog of the reference's ``has_fbgemm``,
@@ -34,6 +30,12 @@ def fused_auc(input: jax.Array, target: jax.Array) -> jax.Array:
     No tie masking: every sample is its own ROC point (matches
     ``fbgemm_gpu.metrics.auc`` semantics).
     """
+    # Lazy import: ops (kernel layer) must not import metrics at module
+    # level; resolution happens at trace time, which jit caches anyway.
+    from torcheval_tpu.metrics.functional.classification._sort_scan import (
+        sorted_tie_cumsums,
+    )
+
     squeeze = input.ndim == 1
     if squeeze:
         input, target = input[None], target[None]

@@ -78,6 +78,7 @@ def _cap_for(num_classes: int, tile: int) -> int:
     used = max(1, -(-(num_classes + 2) // _FINE))
     q = 1.0 / used
     cap = tile * q + 3.5 * (tile * q * (1.0 - q)) ** 0.5
+    # tpulint: disable=TPU003 -- cap is host float math on static shape params (num_classes/tile are static argnums)
     return min(_round_up(max(int(cap), 32), 16), 256, tile)
 
 
