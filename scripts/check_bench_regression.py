@@ -195,10 +195,16 @@ def _tpulint_counts() -> Optional[Dict[str, int]]:
         result = analyze()
         baseline = load_baseline(cfg.baseline) if cfg.baseline else {}
         new, old, _ = split_by_baseline(result.all_findings, baseline)
-        return {
+        counts = {
             "tpulint_findings": len(new),
             "tpulint_baselined": len(old),
         }
+        # Per-rule breakdown of the *new* findings: a regression artifact
+        # that says "2 findings" should also say which contract slipped.
+        for f in new:
+            key = f"tpulint_{f.code}"
+            counts[key] = counts.get(key, 0) + 1
+        return counts
     except Exception:
         return None
 

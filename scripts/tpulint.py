@@ -8,7 +8,8 @@ job (which installs only ruff).  This launcher loads the stdlib-only
 importlib, bypassing the library ``__init__`` entirely, and forwards
 argv to the same ``main()``.
 
-    python scripts/tpulint.py [paths] [--json] [--baseline FILE]
+    python scripts/tpulint.py [paths] [--json | --sarif]
+        [--select CODES] [--ignore CODES] [--baseline FILE]
 
 Exit codes match the module CLI: 0 clean, 1 new findings, 2 unreadable
 path.

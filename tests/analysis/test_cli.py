@@ -231,6 +231,92 @@ class TestGuardRemovalMutation(unittest.TestCase):
         self.assertTrue(new, "mutated finding was masked by the baseline")
 
 
+class TestSelectIgnoreSarif(unittest.TestCase):
+    def _bad_file(self, td):
+        p = os.path.join(td, "mod.py")
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(_BAD_SRC)
+        return p
+
+    def test_select_runs_only_named_rules(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = self._bad_file(td)
+            # TPU001 fires on the fixture; selecting only the
+            # concurrency tier must skip it.
+            code, out, _ = run_cli([p, "--baseline", "", "--json"])
+            self.assertEqual(code, 1)
+            code, out, _ = run_cli(
+                [
+                    p,
+                    "--baseline",
+                    "",
+                    "--json",
+                    "--select",
+                    "TPU006,TPU007,TPU008,TPU009",
+                ]
+            )
+            self.assertEqual(code, 0, out)
+            self.assertEqual(json.loads(out)["new"], [])
+
+    def test_ignore_drops_named_rules(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = self._bad_file(td)
+            code, out, _ = run_cli(
+                [p, "--baseline", "", "--json", "--ignore", "TPU001"]
+            )
+            self.assertEqual(code, 0, out)
+
+    def test_unknown_code_is_exit_2(self):
+        code, _, err = run_cli(["--select", "TPU999"])
+        self.assertEqual(code, 2)
+        self.assertIn("unknown rule code", err)
+        code, _, err = run_cli(["--ignore", "nope"])
+        self.assertEqual(code, 2)
+
+    def test_json_and_sarif_are_mutually_exclusive(self):
+        code, _, err = run_cli(["--json", "--sarif"])
+        self.assertEqual(code, 2)
+        self.assertIn("mutually exclusive", err)
+
+    def test_sarif_payload_shape(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = self._bad_file(td)
+            code, out, _ = run_cli([p, "--baseline", "", "--sarif"])
+            self.assertEqual(code, 1)
+            doc = json.loads(out)
+            self.assertEqual(doc["version"], "2.1.0")
+            run = doc["runs"][0]
+            self.assertEqual(run["tool"]["driver"]["name"], "tpulint")
+            rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+            self.assertLessEqual(
+                {"TPU001", "TPU006", "TPU007", "TPU008", "TPU009"},
+                rule_ids,
+            )
+            results = run["results"]
+            self.assertEqual(len(results), 1)
+            self.assertEqual(results[0]["ruleId"], "TPU001")
+            self.assertIn("tpulint/v1", results[0]["partialFingerprints"])
+            self.assertNotIn("suppressions", results[0])
+
+    def test_sarif_marks_grandfathered_as_suppressed(self):
+        with tempfile.TemporaryDirectory() as td:
+            p = self._bad_file(td)
+            bl = os.path.join(td, "tpulint.baseline")
+            with open(bl, "w", encoding="utf-8") as f:
+                f.write("# tpulint baseline\n")
+            code, _, _ = run_cli(
+                [p, "--baseline", bl, "--write-baseline"]
+            )
+            self.assertEqual(code, 0)
+            code, out, _ = run_cli([p, "--baseline", bl, "--sarif"])
+            self.assertEqual(code, 0, out)
+            results = json.loads(out)["runs"][0]["results"]
+            self.assertEqual(len(results), 1)
+            self.assertEqual(
+                results[0]["suppressions"][0]["kind"], "external"
+            )
+
+
 class TestHookSiteCoverage(unittest.TestCase):
     def test_static_sites_covered_by_runtime_wrappers(self):
         spec = importlib.util.spec_from_file_location(
@@ -279,7 +365,17 @@ class TestJaxFreeLauncher(unittest.TestCase):
             timeout=120,
         )
         self.assertEqual(proc.returncode, 0, proc.stderr)
-        for code in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005"):
+        for code in (
+            "TPU001",
+            "TPU002",
+            "TPU003",
+            "TPU004",
+            "TPU005",
+            "TPU006",
+            "TPU007",
+            "TPU008",
+            "TPU009",
+        ):
             self.assertIn(code, proc.stdout)
 
 

@@ -1,0 +1,428 @@
+"""tpulint concurrency tier (TPU006-TPU009): true-positive and
+true-negative fixtures per rule, plus one mutation test per rule against
+a *real* repo file — copy the source, re-introduce the race the rule
+exists for, and prove the analyzer reports it as NEW relative to the
+checked-in baseline (torcheval_tpu/analysis/)."""
+
+import os
+import tempfile
+import unittest
+
+import pytest
+
+from torcheval_tpu.analysis._baseline import (
+    load_baseline,
+    split_by_baseline,
+)
+from torcheval_tpu.analysis._core import analyze_files
+
+pytestmark = pytest.mark.analysis
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def run_lint(files):
+    """``files``: {display_path: source}.  Returns the Finding list."""
+    with tempfile.TemporaryDirectory() as td:
+        entries = []
+        for display, src in files.items():
+            open_path = os.path.join(td, display.replace("/", "__"))
+            with open(open_path, "w", encoding="utf-8") as f:
+                f.write(src)
+            entries.append((open_path, display))
+        return analyze_files(entries).all_findings
+
+
+def only(findings, code):
+    return [f for f in findings if f.code == code]
+
+
+class TestLockDisciplineTPU006(unittest.TestCase):
+    def test_unguarded_read_of_guarded_field_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_counter.py": (
+                    "import threading\n"
+                    "\n"
+                    "class Counter:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self._n = 0\n"
+                    "    def bump(self):\n"
+                    "        with self._lock:\n"
+                    "            self._n += 1\n"
+                    "    def peek(self):\n"
+                    "        return self._n\n"
+                )
+            }
+        )
+        hits = only(findings, "TPU006")
+        self.assertEqual(len(hits), 1)
+        self.assertEqual(hits[0].line, 11)
+        self.assertIn("_n", hits[0].message)
+        self.assertIn("_lock", hits[0].message)
+
+    def test_exemptions_pass(self):
+        # Consistent locking, immutable-after-init, and a field never
+        # locked anywhere (lock-free by design): all clean.
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_counter.py": (
+                    "import threading\n"
+                    "\n"
+                    "class Counter:\n"
+                    "    def __init__(self, size):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self._n = 0\n"
+                    "        self.size = size\n"
+                    "        self.flag = False\n"
+                    "    def bump(self):\n"
+                    "        with self._lock:\n"
+                    "            self._n += 1\n"
+                    "    def peek(self):\n"
+                    "        with self._lock:\n"
+                    "            return self._n\n"
+                    "    def info(self):\n"
+                    "        return self.size\n"
+                    "    def toggle(self):\n"
+                    "        self.flag = not self.flag\n"
+                )
+            }
+        )
+        self.assertEqual(only(findings, "TPU006"), [])
+
+    def test_mutation_membership_guard_removal(self):
+        """Delete a ``with self._lock:`` from the real membership view:
+        the TPU006 the tentpole promises, NEW vs the baseline."""
+        real = os.path.join(
+            _REPO_ROOT, "torcheval_tpu", "resilience", "membership.py"
+        )
+        with open(real, "r", encoding="utf-8") as f:
+            src = f.read()
+        self.assertIn("with self._lock:", src)
+        display = "torcheval_tpu/resilience/membership.py"
+        # Control: the unmutated copy is clean for this rule.
+        self.assertEqual(only(run_lint({display: src}), "TPU006"), [])
+        mutated = src.replace("with self._lock:", "if True:", 1)
+        hits = only(run_lint({display: mutated}), "TPU006")
+        self.assertTrue(hits, "guard removal went undetected")
+        baseline = load_baseline(
+            os.path.join(_REPO_ROOT, "tpulint.baseline")
+        )
+        new, _, _ = split_by_baseline(hits, baseline)
+        self.assertTrue(new, "mutated finding was masked by the baseline")
+
+
+class TestLockOrderTPU007(unittest.TestCase):
+    def test_opposite_nesting_orders_are_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_order.py": (
+                    "import threading\n"
+                    "\n"
+                    "_a = threading.Lock()\n"
+                    "_b = threading.Lock()\n"
+                    "\n"
+                    "def fwd():\n"
+                    "    with _a:\n"
+                    "        with _b:\n"
+                    "            pass\n"
+                    "\n"
+                    "def rev():\n"
+                    "    with _b:\n"
+                    "        with _a:\n"
+                    "            pass\n"
+                )
+            }
+        )
+        hits = only(findings, "TPU007")
+        self.assertTrue(hits)
+        self.assertTrue(any("cycle" in f.message for f in hits))
+
+    def test_self_reacquire_of_plain_lock_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_order.py": (
+                    "import threading\n"
+                    "_a = threading.Lock()\n"
+                    "def f():\n"
+                    "    with _a:\n"
+                    "        with _a:\n"
+                    "            pass\n"
+                )
+            }
+        )
+        hits = only(findings, "TPU007")
+        self.assertEqual(len(hits), 1)
+        self.assertIn("self-deadlock", hits[0].message)
+
+    def test_consistent_order_and_rlock_pass(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_order.py": (
+                    "import threading\n"
+                    "_a = threading.Lock()\n"
+                    "_b = threading.Lock()\n"
+                    "_r = threading.RLock()\n"
+                    "def one():\n"
+                    "    with _a:\n"
+                    "        with _b:\n"
+                    "            pass\n"
+                    "def two():\n"
+                    "    with _a:\n"
+                    "        with _b:\n"
+                    "            pass\n"
+                    "def re():\n"
+                    "    with _r:\n"
+                    "        with _r:\n"
+                    "            pass\n"
+                )
+            }
+        )
+        self.assertEqual(only(findings, "TPU007"), [])
+
+    def test_blocking_while_holding_vs_condition_wait(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_block.py": (
+                    "import queue\n"
+                    "import threading\n"
+                    "_lock = threading.Lock()\n"
+                    "_cv = threading.Condition()\n"
+                    "_q = queue.Queue()\n"
+                    "def bad():\n"
+                    "    with _lock:\n"
+                    "        return _q.get()\n"
+                    "def fine():\n"
+                    "    with _cv:\n"
+                    "        _cv.wait()\n"
+                )
+            }
+        )
+        hits = only(findings, "TPU007")
+        self.assertEqual(len(hits), 1)
+        self.assertIn("queue.get", hits[0].message)
+        self.assertEqual(hits[0].scope, "bad")
+
+    def test_mutation_distributed_blocking_under_mailbox_lock(self):
+        """Move the local-world barrier sync inside ``send_object``'s
+        mailbox critical section: a barrier wait while holding the
+        condition every peer needs — TPU007, NEW vs the baseline."""
+        real = os.path.join(_REPO_ROOT, "torcheval_tpu", "distributed.py")
+        with open(real, "r", encoding="utf-8") as f:
+            src = f.read()
+        display = "torcheval_tpu/distributed.py"
+        target = (
+            "        with cv:\n"
+            "            self._world._mail[(dst, self._rank, tag)] = payload\n"
+        )
+        self.assertIn(target, src)
+        self.assertEqual(only(run_lint({display: src}), "TPU007"), [])
+        mutated = src.replace(
+            target,
+            target + "            self._world._barrier.wait()\n",
+            1,
+        )
+        hits = only(run_lint({display: mutated}), "TPU007")
+        self.assertTrue(hits, "blocking-while-holding went undetected")
+        self.assertTrue(any("Barrier.wait" in f.message for f in hits))
+        baseline = load_baseline(
+            os.path.join(_REPO_ROOT, "tpulint.baseline")
+        )
+        new, _, _ = split_by_baseline(hits, baseline)
+        self.assertTrue(new, "mutated finding was masked by the baseline")
+
+
+class TestThreadLifecycleTPU008(unittest.TestCase):
+    def test_undaemonized_unjoined_thread_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_threads.py": (
+                    "import threading\n"
+                    "def work():\n"
+                    "    pass\n"
+                    "def start():\n"
+                    "    t = threading.Thread(target=work)\n"
+                    "    t.start()\n"
+                )
+            }
+        )
+        hits = only(findings, "TPU008")
+        self.assertEqual(len(hits), 1)
+        self.assertEqual(hits[0].line, 5)
+
+    def test_daemon_or_joined_threads_pass(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_threads.py": (
+                    "import threading\n"
+                    "def work():\n"
+                    "    pass\n"
+                    "def daemonized():\n"
+                    "    threading.Thread(target=work, daemon=True).start()\n"
+                    "def scoped():\n"
+                    "    t = threading.Thread(target=work)\n"
+                    "    t.start()\n"
+                    "    t.join()\n"
+                )
+            }
+        )
+        self.assertEqual(only(findings, "TPU008"), [])
+
+    def test_unstoppable_run_loop_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_threads.py": (
+                    "import threading\n"
+                    "def tick():\n"
+                    "    pass\n"
+                    "class Worker:\n"
+                    "    def __init__(self):\n"
+                    "        self._t = threading.Thread(\n"
+                    "            target=self._run, daemon=True\n"
+                    "        )\n"
+                    "    def _run(self):\n"
+                    "        while True:\n"
+                    "            tick()\n"
+                )
+            }
+        )
+        hits = only(findings, "TPU008")
+        self.assertEqual(len(hits), 1)
+        self.assertIn("stop", hits[0].message)
+
+    def test_stop_event_loop_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_threads.py": (
+                    "import threading\n"
+                    "def tick():\n"
+                    "    pass\n"
+                    "class Worker:\n"
+                    "    def __init__(self):\n"
+                    "        self._stop = threading.Event()\n"
+                    "        self._t = threading.Thread(\n"
+                    "            target=self._run, daemon=True\n"
+                    "        )\n"
+                    "    def _run(self):\n"
+                    "        while True:\n"
+                    "            if self._stop.is_set():\n"
+                    "                break\n"
+                    "            tick()\n"
+                )
+            }
+        )
+        self.assertEqual(only(findings, "TPU008"), [])
+
+    def test_mutation_prefetch_drop_daemon_and_join(self):
+        """Un-daemonize the real prefetch producer and delete every
+        join: the leaked-thread TPU008, NEW vs the baseline."""
+        real = os.path.join(
+            _REPO_ROOT, "torcheval_tpu", "engine", "prefetch.py"
+        )
+        with open(real, "r", encoding="utf-8") as f:
+            src = f.read()
+        display = "torcheval_tpu/engine/prefetch.py"
+        self.assertIn("daemon=True", src)
+        self.assertEqual(only(run_lint({display: src}), "TPU008"), [])
+        mutated = "".join(
+            ln
+            for ln in src.replace(
+                "daemon=True", "daemon=False"
+            ).splitlines(keepends=True)
+            if "self._thread.join" not in ln
+        )
+        hits = only(run_lint({display: mutated}), "TPU008")
+        self.assertTrue(hits, "dropped join went undetected")
+        self.assertTrue(any(f.symbol == "_thread" for f in hits))
+        baseline = load_baseline(
+            os.path.join(_REPO_ROOT, "tpulint.baseline")
+        )
+        new, _, _ = split_by_baseline(hits, baseline)
+        self.assertTrue(new, "mutated finding was masked by the baseline")
+
+
+class TestCheckThenActTPU009(unittest.TestCase):
+    def test_hoisted_check_is_flagged(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_registry.py": (
+                    "import threading\n"
+                    "class Registry:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self._items = {}\n"
+                    "    def add(self, k, v):\n"
+                    "        if k in self._items:\n"
+                    "            return False\n"
+                    "        with self._lock:\n"
+                    "            self._items[k] = v\n"
+                    "        return True\n"
+                    "    def get(self, k):\n"
+                    "        with self._lock:\n"
+                    "            return self._items.get(k)\n"
+                )
+            }
+        )
+        hits = only(findings, "TPU009")
+        self.assertEqual(len(hits), 1)
+        self.assertEqual(hits[0].line, 7)
+        self.assertIn("check-then-act", hits[0].message)
+
+    def test_spanned_check_passes(self):
+        findings = run_lint(
+            {
+                "torcheval_tpu/fixture_registry.py": (
+                    "import threading\n"
+                    "class Registry:\n"
+                    "    def __init__(self):\n"
+                    "        self._lock = threading.Lock()\n"
+                    "        self._items = {}\n"
+                    "    def add(self, k, v):\n"
+                    "        with self._lock:\n"
+                    "            if k in self._items:\n"
+                    "                return False\n"
+                    "            self._items[k] = v\n"
+                    "        return True\n"
+                )
+            }
+        )
+        self.assertEqual(only(findings, "TPU009"), [])
+
+    def test_mutation_membership_hoisted_excise_check(self):
+        """Hoist ``excise``'s already-dead test out of its lock in the
+        real membership view: the check-then-act TPU009, NEW vs the
+        baseline."""
+        real = os.path.join(
+            _REPO_ROOT, "torcheval_tpu", "resilience", "membership.py"
+        )
+        with open(real, "r", encoding="utf-8") as f:
+            src = f.read()
+        display = "torcheval_tpu/resilience/membership.py"
+        target = (
+            "        with self._lock:\n"
+            "            if rank in self._dead or rank == self.rank:\n"
+            "                return False\n"
+        )
+        self.assertIn(target, src)
+        self.assertEqual(only(run_lint({display: src}), "TPU009"), [])
+        mutated = src.replace(
+            target,
+            "        if rank in self._dead or rank == self.rank:\n"
+            "            return False\n"
+            "        with self._lock:\n",
+            1,
+        )
+        hits = only(run_lint({display: mutated}), "TPU009")
+        self.assertTrue(hits, "hoisted check went undetected")
+        baseline = load_baseline(
+            os.path.join(_REPO_ROOT, "tpulint.baseline")
+        )
+        new, _, _ = split_by_baseline(hits, baseline)
+        self.assertTrue(new, "mutated finding was masked by the baseline")
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,6 +1,7 @@
 """tpulint — repo-native static analysis for the TPU metrics stack.
 
-Proves three contracts at parse time, before any chip sees the code:
+Proves four contract families at parse time, before any chip sees the
+code:
 
 - **hot-path**: every telemetry/health/faults/perfscope/quality hook
   call is dominated by its ``ENABLED`` branch (TPU001);
@@ -8,11 +9,17 @@ Proves three contracts at parse time, before any chip sees the code:
   acyclic (TPU002);
 - **tracer-safety**: no host syncs (TPU003), no reads of donated
   buffers (TPU004), no wall-clock/RNG constants baked into traces
-  (TPU005).
+  (TPU005);
+- **concurrency**: inferred lock-guard discipline (TPU006), lock-order
+  and blocking-while-holding deadlock potential (TPU007), thread
+  lifecycle (TPU008), and check-then-act races (TPU009), built on an
+  interprocedural call graph with thread-entry reachability and
+  held-lock propagation (see ``_core``).
 
 Run it::
 
-    python -m torcheval_tpu.analysis [paths] [--json] [--baseline FILE]
+    python -m torcheval_tpu.analysis [paths] [--json | --sarif]
+        [--baseline FILE] [--select CODES] [--ignore CODES]
 
 or jax-free (CI pre-commit) via ``python scripts/tpulint.py``.  Exit
 codes: 0 clean, 1 new findings, 2 unreadable path argument.
@@ -46,7 +53,12 @@ from ._core import (
     iter_python_files,
     module_name_for,
 )
-from ._report import render_json, render_rule_table, render_text
+from ._report import (
+    render_json,
+    render_rule_table,
+    render_sarif,
+    render_text,
+)
 from .rules.hook_guard import HOOK_SPECS, discover_hook_sites
 
 __all__ = [
@@ -168,7 +180,8 @@ def main(
             "Static analysis for the torcheval_tpu contracts: hook "
             "guards (TPU001), layer order (TPU002), traced host syncs "
             "(TPU003), donation safety (TPU004), traced determinism "
-            "(TPU005)."
+            "(TPU005), lock discipline (TPU006), lock order (TPU007), "
+            "thread lifecycle (TPU008), check-then-act (TPU009)."
         ),
         epilog=_EPILOG,
         formatter_class=argparse.RawDescriptionHelpFormatter,
@@ -184,6 +197,29 @@ def main(
     )
     parser.add_argument(
         "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--sarif",
+        action="store_true",
+        help=(
+            "SARIF 2.1.0 output for code-scanning upload (grandfathered "
+            "findings carry an external suppression)"
+        ),
+    )
+    parser.add_argument(
+        "--select",
+        metavar="CODES",
+        default=None,
+        help=(
+            "comma-separated rule codes to run exclusively "
+            "(e.g. TPU006,TPU007); unknown codes are an error"
+        ),
+    )
+    parser.add_argument(
+        "--ignore",
+        metavar="CODES",
+        default=None,
+        help="comma-separated rule codes to skip (applied after --select)",
     )
     parser.add_argument(
         "--baseline",
@@ -220,6 +256,32 @@ def main(
         render_rule_table(all_rules(), out)
         return 0
 
+    if args.json and args.sarif:
+        err.write("tpulint: --json and --sarif are mutually exclusive\n")
+        return 2
+
+    rule_codes: Optional[set] = None
+    if args.select is not None or args.ignore is not None:
+        known = {r.code for r in all_rules()}
+        selected = set(known)
+        for flag, raw in (("--select", args.select), ("--ignore", args.ignore)):
+            if raw is None:
+                continue
+            codes = {c.strip().upper() for c in raw.split(",") if c.strip()}
+            unknown = codes - known
+            if unknown:
+                err.write(
+                    f"tpulint: unknown rule code(s) for {flag}: "
+                    + ", ".join(sorted(unknown))
+                    + " (see --list-rules)\n"
+                )
+                return 2
+            if flag == "--select":
+                selected = codes
+            else:
+                selected -= codes
+        rule_codes = selected
+
     cfg = Config.with_defaults()
     paths = list(args.paths) if args.paths else cfg.paths
     if args.baseline is None:
@@ -243,7 +305,7 @@ def main(
             err.write(f"tpulint: cannot read {m}\n")
         return 2
 
-    result = analyze_files(entries)
+    result = analyze_files(entries, rule_codes=rule_codes)
     baseline = load_baseline(baseline_path) if baseline_path else {}
     new, grandfathered, stale = split_by_baseline(
         result.all_findings, baseline
@@ -260,7 +322,14 @@ def main(
         )
         return 0
 
-    if args.json:
+    if args.sarif:
+        rules = [
+            r
+            for r in all_rules()
+            if rule_codes is None or r.code in rule_codes
+        ]
+        render_sarif(new, grandfathered, rules, out)
+    elif args.json:
         render_json(new, grandfathered, stale, len(result.files), out)
     else:
         render_text(new, grandfathered, stale, len(result.files), out)

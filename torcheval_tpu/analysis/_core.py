@@ -23,7 +23,16 @@ from __future__ import annotations
 import ast
 import os
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+from typing import (
+    AbstractSet,
+    Dict,
+    Iterable,
+    List,
+    Optional,
+    Sequence,
+    Set,
+    Tuple,
+)
 
 # --------------------------------------------------------------------- AST
 
@@ -418,11 +427,17 @@ def iter_python_files(
 
 
 def analyze_files(
-    files: Sequence, package_roots: Sequence[str] = ("torcheval_tpu",)
+    files: Sequence,
+    package_roots: Sequence[str] = ("torcheval_tpu",),
+    rule_codes: Optional[AbstractSet[str]] = None,
 ) -> AnalysisResult:
     """``files``: open paths, or ``(open_path, display_path)`` pairs.
     Display paths (repo-relative) go into findings and fingerprints so
-    baselines match regardless of CWD or how targets were spelled."""
+    baselines match regardless of CWD or how targets were spelled.
+    ``rule_codes`` restricts the run to that subset of registered rules
+    (the CLI's ``--select``/``--ignore``); parse errors (TPU000) are
+    reported regardless — an unparsable file silently skipped would
+    mean "clean" claims nothing."""
     mods: List[Module] = []
     errors: List[Finding] = []
     display: List[str] = []
@@ -446,6 +461,8 @@ def analyze_files(
             )
     findings: List[Finding] = []
     for rule in all_rules():
+        if rule_codes is not None and rule.code not in rule_codes:
+            continue
         for mod in mods:
             for f in rule.check_module(mod):
                 if not mod.suppressed(f.line, f.code):
@@ -458,3 +475,1049 @@ def analyze_files(
     assign_occurrences(findings)
     findings.sort(key=lambda f: (f.path, f.line, f.code))
     return AnalysisResult(findings=findings, files=display, errors=errors)
+
+
+# ------------------------------------- interprocedural concurrency model
+#
+# The concurrency tier (TPU006-TPU009) needs whole-program facts the
+# per-module rules above never compute: which functions run on threads,
+# which lock guards which field, and which locks are held at a given
+# statement.  ``build_concurrency_model`` computes all of it in one
+# pass over the module list; the four rules consume the shared model
+# via the memoized :func:`concurrency_model`.
+#
+# Identity conventions (documented in docs/source/analysis.rst):
+#
+# - A *lock id* is ``(module, owner, attr)`` — owner is the declaring
+#   class name, or ``""`` for a module-global lock.  ``self._lock``,
+#   ``obj._lock`` and ``cv = self._world._mail_cv; with cv:`` all
+#   resolve to the declaring class's id, so aliases and cross-object
+#   chains share one identity.
+# - A *field id* has the same shape.  Fields never written outside
+#   ``__init__`` are immutable-after-init and exempt; attributes bound
+#   to sync primitives (locks, events, queues, barriers, threads) are
+#   internally thread-safe and exempt.
+# - "Concurrent" functions are (a) anything reachable from a resolved
+#   ``threading.Thread(target=...)`` / ``Timer`` callback / ``run()``
+#   body of a Thread subclass, plus (b) methods of a lock-owning class
+#   and module-level functions of a lock-owning module — a lock is a
+#   declaration of concurrency intent, and the thread that enters such
+#   code often lives behind a callback indirection no static call graph
+#   can see.
+
+LockId = Tuple[str, str, str]
+FieldId = Tuple[str, str, str]
+
+_SYNC_CTOR_KINDS = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "lock",
+    "BoundedSemaphore": "lock",
+    "Event": "event",
+    "Barrier": "barrier",
+    "Queue": "queue",
+    "LifoQueue": "queue",
+    "PriorityQueue": "queue",
+    "SimpleQueue": "queue",
+    "Thread": "thread",
+    "Timer": "timer",
+}
+_LOCKLIKE = ("lock", "rlock", "condition")
+_MUTATORS = {
+    "add", "append", "appendleft", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "reverse",
+    "setdefault", "sort", "update",
+}
+_BLOCKING_COLLECTIVES = {
+    "all_gather_bytes", "all_gather_object", "broadcast_object",
+    "gather_object", "recv_object", "send_object",
+}
+_INIT_METHODS = {"__init__", "__new__", "__post_init__"}
+
+
+def _sync_ctor_kind(mod: "Module", node: ast.AST) -> Optional[str]:
+    """Primitive kind when ``node`` is a ``threading.*``/``queue.*``
+    constructor call (through any import spelling), else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    dn = dotted_name(node.func)
+    if dn is None:
+        return None
+    kind = _SYNC_CTOR_KINDS.get(dn.split(".")[-1])
+    if kind is None:
+        return None
+    for m, _attr in resolve_chain(mod, node.func):
+        if m in ("threading", "queue") or m.startswith(
+            ("threading.", "queue.")
+        ):
+            return kind
+    if dn.startswith(("threading.", "queue.")):
+        return kind
+    return None
+
+
+@dataclass
+class _ModuleDecls:
+    """Per-module declaration tables feeding identity resolution."""
+
+    classes: Dict[str, ast.ClassDef] = field(default_factory=dict)
+    attr_owners: Dict[str, Set[str]] = field(default_factory=dict)
+    attr_prims: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    global_fields: Set[str] = field(default_factory=set)
+    global_prims: Dict[str, str] = field(default_factory=dict)
+    thread_subclasses: Set[str] = field(default_factory=set)
+
+    def lock_attr_owners(self, attr: str) -> Set[str]:
+        return {
+            c
+            for c in self.attr_owners.get(attr, set())
+            if self.attr_prims.get((c, attr)) in _LOCKLIKE
+        }
+
+
+@dataclass
+class FuncInfo:
+    """One analyzed function (methods and nested defs included)."""
+
+    key: str
+    module: str
+    path: str
+    qualname: str
+    name: str
+    cls: Optional[str]
+    node: Optional[ast.AST]  # None for the module-level pseudo-function
+    locals: Set[str] = field(default_factory=set)
+    global_decls: Set[str] = field(default_factory=set)
+    lock_aliases: Dict[str, LockId] = field(default_factory=dict)
+    prim_locals: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def is_init(self) -> bool:
+        return (self.cls is not None and self.name in _INIT_METHODS) or (
+            self.name == "<module>"
+        )
+
+
+@dataclass
+class Access:
+    """One read/write of a tracked field."""
+
+    field: FieldId
+    path: str
+    line: int
+    scope: str
+    func_key: str
+    write: bool
+    held: FrozenSet[LockId]
+    in_init: bool
+    node: ast.AST
+
+
+@dataclass
+class Acquire:
+    """One lock acquisition (``with`` or ``.acquire()``)."""
+
+    lock: LockId
+    held_before: FrozenSet[LockId]
+    func_key: str
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class BlockingCall:
+    """A potentially-blocking call (join/queue ops/waits/collectives)."""
+
+    label: str
+    exempt: Optional[LockId]  # a Condition waits on itself legally
+    held: FrozenSet[LockId]
+    func_key: str
+    path: str
+    line: int
+    scope: str
+
+
+@dataclass
+class ThreadSite:
+    """One ``threading.Thread``/``Timer`` construction site."""
+
+    kind: str  # "thread" | "timer"
+    module: str
+    path: str
+    line: int
+    scope: str
+    func_key: str
+    daemon: Optional[bool]
+    target_key: Optional[str]
+    target_name: Optional[str]
+    binding: Optional[str]
+    binding_is_attr: bool
+
+
+def _enclosing_class(node: ast.AST) -> Optional[str]:
+    cur = parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur.name
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a class nested in a function still owns its methods, but a
+            # def nested in a method belongs to the method, not the class
+            pass
+        cur = parent(cur)
+    return None
+
+
+def _owned_nodes(root: ast.AST) -> Iterable[ast.AST]:
+    """Descendants of ``root`` excluding nested def/class bodies (their
+    statements belong to their own function scope)."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _collect_decls(mod: "Module") -> _ModuleDecls:
+    decls = _ModuleDecls()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            decls.classes[node.name] = node
+            for base in node.bases:
+                bdn = dotted_name(base)
+                if bdn and bdn.split(".")[-1] == "Thread":
+                    decls.thread_subclasses.add(node.name)
+    for node in ast.walk(mod.tree):
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        kind = _sync_ctor_kind(mod, value) if value is not None else None
+        in_func = enclosing_function(node) is not None
+        cls = _enclosing_class(node)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                if not in_func and cls is None:
+                    # module-level binding
+                    if not t.id.startswith("__"):
+                        decls.global_fields.add(t.id)
+                        if kind:
+                            decls.global_prims[t.id] = kind
+                elif not in_func and cls is not None:
+                    # class-body attribute
+                    decls.attr_owners.setdefault(t.id, set()).add(cls)
+                    if kind:
+                        decls.attr_prims[(cls, t.id)] = kind
+            elif (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id in ("self", "cls")
+                and cls is not None
+            ):
+                decls.attr_owners.setdefault(t.attr, set()).add(cls)
+                if kind:
+                    decls.attr_prims[(cls, t.attr)] = kind
+    return decls
+
+
+class ConcurrencyModel:
+    """Whole-program facts for the concurrency rules (TPU006-TPU009)."""
+
+    def __init__(self) -> None:
+        self.mods: List[Module] = []
+        self.decls: Dict[str, _ModuleDecls] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.by_name: Dict[Tuple[str, str], List[str]] = {}
+        self.by_method: Dict[Tuple[str, str, str], List[str]] = {}
+        self.calls: Dict[str, Set[str]] = {}
+        self.call_sites: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        self.locks: Dict[LockId, str] = {}
+        self.fields: Dict[FieldId, List[Access]] = {}
+        self.guards: Dict[FieldId, FrozenSet[LockId]] = {}
+        self.concurrent: Dict[str, str] = {}  # func key -> reason
+        self.entry_held: Dict[str, FrozenSet[LockId]] = {}
+        self.held_at: Dict[int, FrozenSet[LockId]] = {}
+        self.with_locks: Dict[int, FrozenSet[LockId]] = {}
+        self.acquisitions: List[Acquire] = []
+        self.blocking: List[BlockingCall] = []
+        self.thread_sites: List[ThreadSite] = []
+        self.joins: Dict[str, Set[str]] = {}  # module -> joined terminals
+        self.join_funcs: Set[str] = set()  # funcs containing any join/cancel
+
+    # -------------------------------------------------------- labels
+
+    @staticmethod
+    def _short(module: str) -> str:
+        return module.rsplit(".", 1)[-1]
+
+    def lock_label(self, lock: LockId) -> str:
+        module, owner, attr = lock
+        mid = f"{owner}." if owner else ""
+        return f"{self._short(module)}.{mid}{attr}"
+
+    def field_label(self, fid: FieldId) -> str:
+        return self.lock_label(fid)  # same shape
+
+    # ------------------------------------------------------- queries
+
+    def held(self, func_key: str, node: ast.AST) -> FrozenSet[LockId]:
+        """Locks held at ``node``: lexical context plus the intersection
+        of what every analyzed caller holds around this function."""
+        lex = self.held_at.get(id(node), frozenset())
+        return lex | self.entry_held.get(func_key, frozenset())
+
+    def held_for(self, a: Access) -> FrozenSet[LockId]:
+        return a.held | self.entry_held.get(a.func_key, frozenset())
+
+    def lock_table(self) -> Dict[str, List[str]]:
+        """Inferred guard table: lock label -> sorted field labels it
+        guards (the TPU006 association, exported for docs/tests)."""
+        table: Dict[str, Set[str]] = {}
+        for fid, guards in self.guards.items():
+            for lock in guards:
+                table.setdefault(self.lock_label(lock), set()).add(
+                    self.field_label(fid)
+                )
+        return {k: sorted(v) for k, v in sorted(table.items())}
+
+    # ------------------------------------------------------ resolution
+
+    def _module_key(self, mod: Module) -> str:
+        return f"{mod.name}::<module>"
+
+    def _lock_from_chain(
+        self, mod: Module, fi: FuncInfo, expr: ast.AST
+    ) -> Optional[LockId]:
+        """Resolve an expression to a lock identity: a local alias, a
+        module-global lock, or a (possibly cross-object) attribute chain
+        ending in a lock attribute with a unique declaring class."""
+        dn = dotted_name(expr)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        decls = self.decls[mod.name]
+        if len(parts) == 1:
+            name = parts[0]
+            if name in fi.lock_aliases:
+                return fi.lock_aliases[name]
+            if name in fi.prim_locals and fi.prim_locals[name] in _LOCKLIKE:
+                return (mod.name, fi.qualname, name)
+            if (
+                name not in fi.locals
+                and decls.global_prims.get(name) in _LOCKLIKE
+            ):
+                return (mod.name, "", name)
+            return None
+        tail = parts[-1]
+        owners = decls.lock_attr_owners(tail)
+        if not owners:
+            return None
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            if fi.cls in owners:
+                return (mod.name, fi.cls, tail)  # type: ignore[return-value]
+        if len(owners) == 1:
+            return (mod.name, next(iter(owners)), tail)
+        return None
+
+    def _field_from_parts(
+        self, mod: Module, fi: FuncInfo, parts: List[str]
+    ) -> Optional[FieldId]:
+        """Field identity for an access chain.  ``self``/``cls``/class
+        rooted chains key on the terminal attribute's declaring class;
+        a chain rooted at a module-global name keys on the root."""
+        decls = self.decls[mod.name]
+        root = parts[0]
+        if root in ("self", "cls") or root in decls.classes:
+            if len(parts) < 2:
+                return None
+            tail = parts[-1]
+            owners = decls.attr_owners.get(tail, set())
+            if root in decls.classes and len(parts) == 2:
+                owner = root
+            elif fi.cls in owners and len(parts) == 2:
+                owner = fi.cls  # type: ignore[assignment]
+            elif len(owners) == 1:
+                owner = next(iter(owners))
+            elif len(parts) == 2 and root in ("self", "cls") and fi.cls:
+                owner = fi.cls
+            else:
+                return None
+            if decls.attr_prims.get((owner, tail)):
+                return None  # sync primitives are internally safe
+            return (mod.name, owner, tail)
+        if (
+            root in decls.global_fields
+            and root not in fi.locals
+            and root not in mod.imports_by_local
+        ):
+            if root in decls.global_prims:
+                return None
+            return (mod.name, "", root)
+        return None
+
+    def _resolve_callable(
+        self, mod: Module, fi: FuncInfo, expr: ast.AST
+    ) -> Optional[str]:
+        """Function key for a callable reference (thread targets)."""
+        dn = dotted_name(expr)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        if len(parts) == 1:
+            keys = self.by_name.get((mod.name, parts[0]), [])
+            if keys:
+                return keys[0]
+        if parts[0] in ("self", "cls") and len(parts) == 2 and fi.cls:
+            keys = self.by_method.get((mod.name, fi.cls, parts[1]), [])
+            if keys:
+                return keys[0]
+        for m, attr in resolve_chain(mod, expr):
+            if attr:
+                keys = self.by_name.get((m, attr), [])
+                for k in keys:
+                    if self.functions[k].cls is None:
+                        return k
+        return None
+
+    def _call_targets(
+        self, mod: Module, fi: FuncInfo, call: ast.Call
+    ) -> List[str]:
+        """Call-graph edges for one call, module/class aware: bare names
+        bind in-module, ``self.m()``/``cls.m()`` bind to the enclosing
+        class, imported chains bind cross-module."""
+        dn = dotted_name(call.func)
+        if dn is None:
+            return []
+        parts = dn.split(".")
+        out: List[str] = []
+        if parts[0] in ("self", "cls") and len(parts) == 2 and fi.cls:
+            out.extend(self.by_method.get((mod.name, fi.cls, parts[1]), []))
+        elif len(parts) == 1:
+            out.extend(self.by_name.get((mod.name, parts[0]), []))
+            if not out:
+                for m, attr in resolve_chain(mod, call.func):
+                    if attr:
+                        out.extend(
+                            k
+                            for k in self.by_name.get((m, attr), [])
+                            if self.functions[k].cls is None
+                        )
+        else:
+            for m, attr in resolve_chain(mod, call.func):
+                if attr:
+                    out.extend(
+                        k
+                        for k in self.by_name.get((m, attr), [])
+                        if self.functions[k].cls is None
+                    )
+        return out
+
+    # ----------------------------------------------------- build: scan
+
+    def _collect_functions(self, mod: Module) -> None:
+        decls = self.decls[mod.name]
+        mkey = self._module_key(mod)
+        self.functions[mkey] = FuncInfo(
+            key=mkey,
+            module=mod.name,
+            path=mod.path,
+            qualname="<module>",
+            name="<module>",
+            cls=None,
+            node=None,
+        )
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            qn = scope_qualname(node)
+            key = f"{mod.name}::{qn}"
+            cls = _enclosing_class(node)
+            fi = FuncInfo(
+                key=key,
+                module=mod.name,
+                path=mod.path,
+                qualname=qn,
+                name=node.name,
+                cls=cls,
+                node=node,
+            )
+            self.functions[key] = fi
+            self.by_name.setdefault((mod.name, node.name), []).append(key)
+            if cls:
+                self.by_method.setdefault(
+                    (mod.name, cls, node.name), []
+                ).append(key)
+        # lock table: declared sync attrs + module globals
+        for (cls, attr), kind in decls.attr_prims.items():
+            if kind in _LOCKLIKE:
+                self.locks[(mod.name, cls, attr)] = kind
+        for name, kind in decls.global_prims.items():
+            if kind in _LOCKLIKE:
+                self.locks[(mod.name, "", name)] = kind
+
+    def _prescan_function(self, mod: Module, fi: FuncInfo) -> None:
+        """Locals, ``global`` decls, lock aliases, primitive locals —
+        flow-insensitive, good enough for the alias idioms in use
+        (``cv = self._world._mail_cv``, ``done = threading.Event()``)."""
+        if fi.node is None:
+            root: ast.AST = mod.tree
+        else:
+            root = fi.node
+            args = fi.node.args  # type: ignore[union-attr]
+            for a in (
+                list(args.posonlyargs)
+                + list(args.args)
+                + list(args.kwonlyargs)
+                + ([args.vararg] if args.vararg else [])
+                + ([args.kwarg] if args.kwarg else [])
+            ):
+                fi.locals.add(a.arg)
+        for n in _owned_nodes(root):
+            if isinstance(n, ast.Global):
+                fi.global_decls.update(n.names)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                if n.id not in fi.global_decls:
+                    fi.locals.add(n.id)
+        fi.locals -= fi.global_decls
+        for n in _owned_nodes(root):
+            if (
+                isinstance(n, ast.Assign)
+                and len(n.targets) == 1
+                and isinstance(n.targets[0], ast.Name)
+            ):
+                name = n.targets[0].id
+                kind = _sync_ctor_kind(mod, n.value)
+                if kind:
+                    fi.prim_locals[name] = kind
+                    continue
+                lk = self._lock_from_chain(mod, fi, n.value)
+                if lk:
+                    fi.lock_aliases[name] = lk
+
+    def _scan_held(self, mod: Module, fi: FuncInfo) -> None:
+        """Lexical held-lock stamping over one function body, recording
+        acquisition order edges along the way.  ``with``/``acquire``-
+        ``release`` within one statement list is the supported shape;
+        acquisitions inside a branch do not leak past it."""
+        scope = fi.qualname
+
+        def stamp(node: ast.AST, held: FrozenSet[LockId]) -> None:
+            self.held_at[id(node)] = held
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                     ast.ClassDef),
+                ):
+                    self.held_at[id(child)] = held
+                    continue
+                stamp(child, held)
+
+        def on_acquire(
+            lock: LockId, held: FrozenSet[LockId], node: ast.AST
+        ) -> None:
+            self.acquisitions.append(
+                Acquire(
+                    lock=lock,
+                    held_before=held,
+                    func_key=fi.key,
+                    path=mod.path,
+                    line=getattr(node, "lineno", 0),
+                    scope=scope,
+                )
+            )
+
+        def walk(stmts: Sequence[ast.stmt], held0: FrozenSet[LockId]) -> None:
+            held = set(held0)
+            for stmt in stmts:
+                cur = frozenset(held)
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    acquired: Set[LockId] = set()
+                    for item in stmt.items:
+                        stamp(item.context_expr, cur)
+                        lk = self._lock_from_chain(
+                            mod, fi, item.context_expr
+                        )
+                        if lk is not None:
+                            acquired.add(lk)
+                            on_acquire(lk, cur, item.context_expr)
+                            if isinstance(item.optional_vars, ast.Name):
+                                fi.lock_aliases[item.optional_vars.id] = lk
+                    self.held_at[id(stmt)] = cur
+                    if acquired:
+                        self.with_locks[id(stmt)] = frozenset(acquired)
+                    walk(stmt.body, frozenset(held | acquired))
+                    continue
+                if isinstance(stmt, ast.Expr) and isinstance(
+                    stmt.value, ast.Call
+                ):
+                    call = stmt.value
+                    if (
+                        isinstance(call.func, ast.Attribute)
+                        and call.func.attr in ("acquire", "release")
+                    ):
+                        lk = self._lock_from_chain(mod, fi, call.func.value)
+                        if lk is not None:
+                            stamp(stmt, cur)
+                            if call.func.attr == "acquire":
+                                on_acquire(lk, cur, stmt)
+                                held.add(lk)
+                            else:
+                                held.discard(lk)
+                            continue
+                if isinstance(stmt, (ast.If, ast.While)):
+                    stamp(stmt.test, cur)
+                    self.held_at[id(stmt)] = cur
+                    walk(stmt.body, cur)
+                    walk(stmt.orelse, cur)
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    stamp(stmt.target, cur)
+                    stamp(stmt.iter, cur)
+                    self.held_at[id(stmt)] = cur
+                    walk(stmt.body, cur)
+                    walk(stmt.orelse, cur)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    self.held_at[id(stmt)] = cur
+                    walk(stmt.body, cur)
+                    for h in stmt.handlers:
+                        self.held_at[id(h)] = cur
+                        if h.type is not None:
+                            stamp(h.type, cur)
+                        walk(h.body, cur)
+                    walk(stmt.orelse, cur)
+                    walk(stmt.finalbody, cur)
+                    continue
+                if isinstance(
+                    stmt,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    self.held_at[id(stmt)] = cur
+                    continue
+                stamp(stmt, cur)
+
+        if fi.node is None:
+            body = [
+                s
+                for s in mod.tree.body  # type: ignore[attr-defined]
+                if not isinstance(
+                    s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )
+            ]
+            walk(body, frozenset())
+        else:
+            walk(fi.node.body, frozenset())  # type: ignore[union-attr]
+
+    def _prim_kind_of(
+        self, mod: Module, fi: FuncInfo, expr: ast.AST
+    ) -> Optional[str]:
+        """Sync-primitive kind of a receiver expression, if known:
+        a primitive local, a declared primitive attribute (any chain
+        depth), or a module-global primitive."""
+        dn = dotted_name(expr)
+        if dn is None:
+            return None
+        parts = dn.split(".")
+        decls = self.decls[mod.name]
+        if len(parts) == 1:
+            if parts[0] in fi.prim_locals:
+                return fi.prim_locals[parts[0]]
+            if (
+                parts[0] not in fi.locals
+                and parts[0] in decls.global_prims
+            ):
+                return decls.global_prims[parts[0]]
+            return None
+        tail = parts[-1]
+        owners = decls.attr_owners.get(tail, set())
+        kinds = {
+            decls.attr_prims[(c, tail)]
+            for c in owners
+            if (c, tail) in decls.attr_prims
+        }
+        if len(kinds) == 1:
+            return next(iter(kinds))
+        return None
+
+    @staticmethod
+    def _is_write_ctx(node: ast.Attribute) -> bool:
+        """Store/Del on the attribute itself, or on a subscript chain
+        hanging off it (``self._mail[k] = v`` mutates ``_mail``)."""
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        cur: ast.AST = node
+        p = parent(node)
+        while isinstance(p, ast.Subscript) and p.value is cur:
+            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                return True
+            cur, p = p, parent(p)
+        return False
+
+    def _scan_accesses(self, mod: Module, fi: FuncInfo) -> None:
+        """Field accesses, blocking calls, join sites, and thread
+        construction sites in one owned-node sweep."""
+        root: ast.AST = mod.tree if fi.node is None else fi.node
+        decls = self.decls[mod.name]
+        scope = fi.qualname
+
+        def add_access(
+            fid: FieldId, node: ast.AST, write: bool
+        ) -> None:
+            self.fields.setdefault(fid, []).append(
+                Access(
+                    field=fid,
+                    path=mod.path,
+                    line=getattr(node, "lineno", 0),
+                    scope=scope,
+                    func_key=fi.key,
+                    write=write,
+                    held=self.held_at.get(id(node), frozenset()),
+                    in_init=fi.is_init,
+                    node=node,
+                )
+            )
+
+        for n in _owned_nodes(root):
+            if isinstance(n, ast.Attribute) and not isinstance(
+                parent(n), ast.Attribute
+            ):
+                dn = dotted_name(n)
+                if dn is None:
+                    continue
+                parts = dn.split(".")
+                p = parent(n)
+                is_call = isinstance(p, ast.Call) and p.func is n
+                if is_call:
+                    # method call: the receiver chain is the access
+                    recv = parts[:-1]
+                    if not recv:
+                        continue
+                    fid = self._field_from_parts(mod, fi, recv)
+                    if fid is not None:
+                        add_access(fid, n, parts[-1] in _MUTATORS)
+                else:
+                    fid = self._field_from_parts(mod, fi, parts)
+                    if fid is not None:
+                        add_access(fid, n, self._is_write_ctx(n))
+            elif isinstance(n, ast.Name) and not isinstance(
+                parent(n), ast.Attribute
+            ):
+                if (
+                    n.id in decls.global_fields
+                    and n.id not in decls.global_prims
+                    and n.id not in fi.locals
+                    and n.id not in mod.imports_by_local
+                ):
+                    if isinstance(n.ctx, ast.Load):
+                        write = False
+                        cur: ast.AST = n
+                        p = parent(n)
+                        while isinstance(p, ast.Subscript) and p.value is cur:
+                            if isinstance(p.ctx, (ast.Store, ast.Del)):
+                                write = True
+                                break
+                            cur, p = p, parent(p)
+                        add_access((mod.name, "", n.id), n, write)
+                    elif n.id in fi.global_decls or fi.node is None:
+                        add_access((mod.name, "", n.id), n, True)
+            if not isinstance(n, ast.Call):
+                continue
+            # ---- thread construction sites
+            kind = _sync_ctor_kind(mod, n)
+            if kind in ("thread", "timer"):
+                self._record_thread_site(mod, fi, n, kind)
+                continue
+            if not isinstance(n.func, ast.Attribute):
+                continue
+            attr = n.func.attr
+            recv_expr = n.func.value
+            held = self.held_at.get(id(n), frozenset())
+            if attr in ("join", "cancel"):
+                rdn = dotted_name(recv_expr)
+                rkind = self._prim_kind_of(mod, fi, recv_expr)
+                if rkind in ("thread", "timer"):
+                    if rdn:
+                        self.joins.setdefault(mod.name, set()).add(
+                            rdn.split(".")[-1]
+                        )
+                    if attr == "join":
+                        self.blocking.append(
+                            BlockingCall(
+                                label=f"{rdn or '?'}.join()",
+                                exempt=None,
+                                held=held,
+                                func_key=fi.key,
+                                path=mod.path,
+                                line=n.lineno,
+                                scope=scope,
+                            )
+                        )
+                elif rdn:
+                    # unresolved receiver: still count the join for the
+                    # lifecycle rule (loop vars over thread lists)
+                    self.joins.setdefault(mod.name, set()).add(
+                        rdn.split(".")[-1]
+                    )
+                self.join_funcs.add(fi.key)
+                continue
+            blocked: Optional[str] = None
+            exempt: Optional[LockId] = None
+            if attr == "wait":
+                lk = self._lock_from_chain(mod, fi, recv_expr)
+                rkind = self._prim_kind_of(mod, fi, recv_expr)
+                if lk is not None:
+                    blocked, exempt = "Condition.wait", lk
+                elif rkind in ("event", "barrier"):
+                    blocked = f"{rkind.capitalize()}.wait"
+            elif attr in ("get", "put"):
+                if self._prim_kind_of(mod, fi, recv_expr) == "queue":
+                    blocked = f"queue.{attr}"
+            elif attr in _BLOCKING_COLLECTIVES:
+                blocked = f"{attr}()"
+            elif attr == "sleep":
+                for m, _a in resolve_chain(mod, n.func):
+                    if m == "time":
+                        blocked = "time.sleep"
+                        break
+            if blocked:
+                self.blocking.append(
+                    BlockingCall(
+                        label=blocked,
+                        exempt=exempt,
+                        held=held,
+                        func_key=fi.key,
+                        path=mod.path,
+                        line=n.lineno,
+                        scope=scope,
+                    )
+                )
+
+    def _record_thread_site(
+        self, mod: Module, fi: FuncInfo, call: ast.Call, kind: str
+    ) -> None:
+        daemon: Optional[bool] = None
+        target_expr: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            elif kw.arg in ("target", "function"):
+                target_expr = kw.value
+        if kind == "timer" and target_expr is None and len(call.args) >= 2:
+            target_expr = call.args[1]
+        target_key = (
+            self._resolve_callable(mod, fi, target_expr)
+            if target_expr is not None
+            else None
+        )
+        binding: Optional[str] = None
+        binding_is_attr = False
+        p: Optional[ast.AST] = parent(call)
+        while p is not None and not isinstance(p, ast.stmt):
+            p = parent(p)
+        if isinstance(p, ast.Assign) and len(p.targets) == 1:
+            t = p.targets[0]
+            if isinstance(t, ast.Name):
+                binding = t.id
+            elif isinstance(t, ast.Attribute):
+                binding = t.attr
+                binding_is_attr = True
+        if binding is not None and daemon is None:
+            # `t.daemon = True` after construction, anywhere in the fn
+            root = mod.tree if fi.node is None else fi.node
+            for n in _owned_nodes(root):
+                if (
+                    isinstance(n, ast.Assign)
+                    and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Attribute)
+                    and n.targets[0].attr == "daemon"
+                    and dotted_name(n.targets[0].value) is not None
+                    and dotted_name(n.targets[0].value).split(".")[-1]
+                    == binding
+                    and isinstance(n.value, ast.Constant)
+                ):
+                    daemon = bool(n.value.value)
+        self.thread_sites.append(
+            ThreadSite(
+                kind=kind,
+                module=mod.name,
+                path=mod.path,
+                line=call.lineno,
+                scope=fi.qualname,
+                func_key=fi.key,
+                daemon=daemon,
+                target_key=target_key,
+                target_name=(
+                    dotted_name(target_expr)
+                    if target_expr is not None
+                    else None
+                ),
+                binding=binding,
+                binding_is_attr=binding_is_attr,
+            )
+        )
+
+    # ---------------------------------------------------- build: graph
+
+    def _build_call_graph(self) -> None:
+        for mod in self.mods:
+            for fi in list(self.functions.values()):
+                if fi.module != mod.name:
+                    continue
+                root = mod.tree if fi.node is None else fi.node
+                for n in _owned_nodes(root):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    for tgt in self._call_targets(mod, fi, n):
+                        if tgt == fi.key:
+                            continue
+                        self.calls.setdefault(fi.key, set()).add(tgt)
+                        self.call_sites.setdefault(tgt, []).append(
+                            (fi.key, n)
+                        )
+
+    def _mark_concurrent(self) -> None:
+        """Thread-entry reachability plus the lock-owner heuristic."""
+        entries: Dict[str, str] = {}
+        for site in self.thread_sites:
+            if site.target_key is not None:
+                entries.setdefault(
+                    site.target_key,
+                    f"thread target at {_norm(site.path)}:{site.line}",
+                )
+        for mod in self.mods:
+            decls = self.decls[mod.name]
+            for cls in decls.thread_subclasses:
+                for key in self.by_method.get((mod.name, cls, "run"), []):
+                    entries.setdefault(key, f"{cls}.run (Thread subclass)")
+            lock_classes = {
+                c
+                for (c, _a), kind in decls.attr_prims.items()
+                if kind in _LOCKLIKE
+            }
+            module_locked = any(
+                kind in _LOCKLIKE for kind in decls.global_prims.values()
+            )
+            for fi in self.functions.values():
+                if fi.module != mod.name or fi.node is None:
+                    continue
+                if fi.cls in lock_classes and fi.name not in _INIT_METHODS:
+                    entries.setdefault(
+                        fi.key, f"method of lock-owning class {fi.cls}"
+                    )
+                elif (
+                    module_locked
+                    and fi.cls is None
+                    and "." not in fi.qualname
+                ):
+                    entries.setdefault(
+                        fi.key,
+                        f"function of lock-owning module "
+                        f"{self._short(mod.name)}",
+                    )
+        # BFS over the call graph
+        pending = list(entries)
+        self.concurrent.update(entries)
+        while pending:
+            cur = pending.pop()
+            for nxt in self.calls.get(cur, ()):
+                if nxt not in self.concurrent:
+                    self.concurrent[nxt] = (
+                        f"called from concurrent "
+                        f"`{self.functions[cur].qualname}`"
+                    )
+                    pending.append(nxt)
+        self._thread_entries = set(entries)
+
+    def _propagate_entry_held(self) -> None:
+        """entry_held(f) = intersection over analyzed call sites of the
+        locks held around the call.  Thread targets are forced empty (a
+        thread starts with nothing); functions without analyzed callers
+        default empty (external callers are unknown)."""
+        forced_empty = {
+            s.target_key
+            for s in self.thread_sites
+            if s.target_key is not None
+        }
+        self.entry_held = {k: frozenset() for k in self.functions}
+        for _ in range(4):
+            changed = False
+            for callee, sites in self.call_sites.items():
+                if callee in forced_empty or callee not in self.functions:
+                    continue
+                acc: Optional[FrozenSet[LockId]] = None
+                for caller, node in sites:
+                    site_held = self.held_at.get(
+                        id(node), frozenset()
+                    ) | self.entry_held.get(caller, frozenset())
+                    acc = (
+                        site_held if acc is None else (acc & site_held)
+                    )
+                new = acc or frozenset()
+                if new != self.entry_held.get(callee):
+                    self.entry_held[callee] = new
+                    changed = True
+            if not changed:
+                break
+
+    def _infer_guards(self) -> None:
+        """TPU006's association: a mutable field is guarded by the locks
+        observed held at any of its non-init accesses.  Fields never
+        written outside ``__init__`` (immutable-after-publication) and
+        fields never accessed under any lock (lock-free by design) stay
+        out of the table."""
+        for fid, accesses in self.fields.items():
+            live = [a for a in accesses if not a.in_init]
+            if not any(a.write for a in live):
+                continue
+            guards: Set[LockId] = set()
+            for a in live:
+                guards |= self.held_for(a)
+            if guards:
+                self.guards[fid] = frozenset(guards)
+
+
+_MODEL_CACHE: List[Tuple[Tuple[int, ...], "ConcurrencyModel"]] = []
+
+
+def build_concurrency_model(mods: List[Module]) -> ConcurrencyModel:
+    model = ConcurrencyModel()
+    model.mods = list(mods)
+    for mod in mods:
+        model.decls[mod.name] = _collect_decls(mod)
+    for mod in mods:
+        model._collect_functions(mod)
+    by_module: Dict[str, Module] = {m.name: m for m in mods}
+    for fi in model.functions.values():
+        model._prescan_function(by_module[fi.module], fi)
+    for fi in model.functions.values():
+        model._scan_held(by_module[fi.module], fi)
+    for fi in model.functions.values():
+        model._scan_accesses(by_module[fi.module], fi)
+    model._build_call_graph()
+    model._mark_concurrent()
+    model._propagate_entry_held()
+    model._infer_guards()
+    return model
+
+
+def concurrency_model(mods: List[Module]) -> ConcurrencyModel:
+    """Memoized :func:`build_concurrency_model` so the four concurrency
+    rules share one model per analyzer run."""
+    key = tuple(id(m) for m in mods)
+    for k, m in _MODEL_CACHE:
+        if k == key:
+            return m
+    model = build_concurrency_model(mods)
+    _MODEL_CACHE.append((key, model))
+    del _MODEL_CACHE[:-4]
+    return model

@@ -1,6 +1,24 @@
 """tpulint rule modules.  Importing this package registers every rule
 with the central registry (``_core.all_rules`` does this lazily)."""
 
-from . import donation, hook_guard, layer_order, traced  # noqa: F401
+from . import (  # noqa: F401
+    check_then_act,
+    donation,
+    hook_guard,
+    layer_order,
+    lock_discipline,
+    lock_order,
+    thread_lifecycle,
+    traced,
+)
 
-__all__ = ["donation", "hook_guard", "layer_order", "traced"]
+__all__ = [
+    "check_then_act",
+    "donation",
+    "hook_guard",
+    "layer_order",
+    "lock_discipline",
+    "lock_order",
+    "thread_lifecycle",
+    "traced",
+]

@@ -45,6 +45,11 @@ from torcheval_tpu.telemetry import events as _telemetry
 # deadline policy on top of this per-RPC budget.
 _KV_TIMEOUT_MS_DEFAULT = 600_000
 
+# Guards the KV-collective generation counter: the fleet-merge worker and
+# the main loop can both issue object collectives, and a duplicated
+# generation would alias two gathers onto the same KV keys.
+_GEN_LOCK = threading.Lock()
+
 
 def kv_timeout_ms() -> int:
     """The per-RPC wait budget (ms) for KV-store collectives: the value
@@ -299,8 +304,9 @@ class JaxProcessGroup(CollectiveGroup):
     def _kv_all_gather_bytes(self, client, payload: bytes) -> List[bytes]:
         import base64
 
-        gen = JaxProcessGroup._gather_gen
-        JaxProcessGroup._gather_gen += 1
+        with _GEN_LOCK:
+            gen = JaxProcessGroup._gather_gen
+            JaxProcessGroup._gather_gen += 1
         prefix = f"torcheval_tpu/allgather/{gen}"
         rank, world = self.rank, self.world_size
         timeout_ms = kv_timeout_ms()
@@ -351,7 +357,8 @@ class JaxProcessGroup(CollectiveGroup):
 
     # One KV generation per collective call; every rank calls gather in
     # lockstep, so matching counters address the same generation and no
-    # barrier is needed between calls.
+    # barrier is needed between calls.  Bumped under _GEN_LOCK: the
+    # fleet-merge worker thread and the main loop may both gather.
     _gather_gen: int = 0
     _KV_CHUNK = 1 << 20  # 1 MiB raw per KV value (b64 ≈ 1.33 MiB < gRPC cap)
 
@@ -391,8 +398,9 @@ class JaxProcessGroup(CollectiveGroup):
             return super().gather_object(obj, dst)
         import base64
 
-        gen = JaxProcessGroup._gather_gen
-        JaxProcessGroup._gather_gen += 1
+        with _GEN_LOCK:
+            gen = JaxProcessGroup._gather_gen
+            JaxProcessGroup._gather_gen += 1
         prefix = f"torcheval_tpu/gather/{gen}"
         rank, world = self.rank, self.world_size
         timeout_ms = kv_timeout_ms()

@@ -164,7 +164,10 @@ class Prefetcher:
             item = self._queue.get()
         if item is _DONE:
             self._finished = True
-            self._thread.join()
+            # Bounded: a close()-injected _DONE can arrive while the
+            # producer is still wedged; never trade a get() hang for a
+            # join() hang.
+            self._thread.join(timeout=_JOIN_TIMEOUT_S)
             raise StopIteration
         if isinstance(item, _SourceError):
             self._finished = True
@@ -185,6 +188,24 @@ class Prefetcher:
             except queue.Empty:
                 break
         self._thread.join(timeout=_JOIN_TIMEOUT_S)
+        if not self._thread.is_alive():
+            # The producer may have completed one last put() between the
+            # drain above and observing the stop flag; drain again so no
+            # staged device buffers stay pinned by the dead queue.
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+        try:
+            # Wake a consumer blocked in get() (close() raced __next__
+            # from another thread): _DONE turns its wait into a clean
+            # StopIteration instead of a hang.  Issued even when the
+            # producer is wedged — a wedged producer cannot feed the
+            # consumer either, and the consumer's join is bounded.
+            self._queue.put_nowait(_DONE)
+        except queue.Full:  # pragma: no cover - producer refilled; the
+            pass  # staged item will wake the consumer instead
         if self._thread.is_alive():
             # The producer is wedged (e.g. a device transfer that never
             # returns).  The thread is a daemon so the process can still

@@ -63,8 +63,9 @@ def _compile() -> str:
 
 def _load() -> Optional[ctypes.CDLL]:
     global _LIB, _LOAD_FAILED
-    if _LIB is not None or _LOAD_FAILED:
-        return _LIB
+    # tpulint: disable=TPU006,TPU009 -- double-checked fast path; re-checked
+    if _LIB is not None or _LOAD_FAILED:  # under _LOCK below before any write
+        return _LIB  # tpulint: disable=TPU006 -- double-checked fast path
     with _LOCK:
         if _LIB is not None or _LOAD_FAILED:
             return _LIB
@@ -87,6 +88,7 @@ def _load() -> Optional[ctypes.CDLL]:
                 e,
             )
             _LOAD_FAILED = True
+    # tpulint: disable=TPU006 -- stable once the with-block above completes
     return _LIB
 
 

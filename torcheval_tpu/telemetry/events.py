@@ -437,9 +437,13 @@ def enable(
             if capacity < 1:
                 raise ValueError(f"capacity must be >= 1, got {capacity}")
             _events = deque(_events, maxlen=int(capacity))
-        if annotate is not None:
-            ANNOTATE = bool(annotate)
-        ENABLED = True
+    # Publish the flags only after the ring is resized.  The flags are
+    # deliberately lock-free (hooks read them on every update); keeping
+    # the writes outside the lock documents that contract instead of
+    # implying the lock guards them.
+    if annotate is not None:
+        ANNOTATE = bool(annotate)
+    ENABLED = True
 
 
 def disable() -> None:
@@ -463,12 +467,14 @@ def clear() -> None:
 
 
 def capacity() -> int:
-    return _events.maxlen or 0
+    with _lock:
+        return _events.maxlen or 0
 
 
 def dropped() -> int:
     """Events evicted from the ring since the last :func:`clear`."""
-    return _dropped
+    with _lock:
+        return _dropped
 
 
 def events(kind: Optional[str] = None) -> List[Event]:
