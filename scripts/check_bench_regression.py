@@ -199,8 +199,15 @@ def _tpulint_counts() -> Optional[Dict[str, int]]:
             "tpulint_findings": len(new),
             "tpulint_baselined": len(old),
         }
-        # Per-rule breakdown of the *new* findings: a regression artifact
-        # that says "2 findings" should also say which contract slipped.
+        # Per-rule breakdown of the *new* findings, zero-filled for
+        # every registered rule: a regression artifact that says "2
+        # findings" should also say which contract slipped, and an
+        # explicit tpulint_TPU010: 0 distinguishes "clean under the
+        # rule" from "rule didn't exist when this row was stamped".
+        from torcheval_tpu.analysis._core import all_rules
+
+        for rule in all_rules():
+            counts[f"tpulint_{rule.code}"] = 0
         for f in new:
             key = f"tpulint_{f.code}"
             counts[key] = counts.get(key, 0) + 1

@@ -375,6 +375,10 @@ class TestJaxFreeLauncher(unittest.TestCase):
             "TPU007",
             "TPU008",
             "TPU009",
+            "TPU010",
+            "TPU011",
+            "TPU012",
+            "TPU013",
         ):
             self.assertIn(code, proc.stdout)
 

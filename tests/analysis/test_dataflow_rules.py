@@ -1,0 +1,503 @@
+"""Dataflow-tier rules (TPU010 mask-discipline, TPU011 pad-neutrality,
+TPU012 dtype-stability, TPU013 flag-registry) and the typed flag
+registry they enforce.
+
+Each rule gets true-positive and true-negative fixtures, plus a
+mutation test against the *real* site the rule was built to protect:
+re-introduce the historical bug into a copy of the shipped file and the
+analyzer must produce a NEW finding relative to the checked-in
+baseline, while the unmutated file stays clean."""
+
+import os
+import tempfile
+import unittest
+
+import pytest
+
+from torcheval_tpu.analysis._baseline import load_baseline, split_by_baseline
+from torcheval_tpu.analysis._core import analyze_files
+
+pytestmark = pytest.mark.analysis
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _lint(src, display="torcheval_tpu/somemod.py"):
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "mod.py")
+        with open(p, "w", encoding="utf-8") as f:
+            f.write(src)
+        return analyze_files([(p, display)]).all_findings
+
+
+def _codes(src, display="torcheval_tpu/somemod.py"):
+    return [f.code for f in _lint(src, display)]
+
+
+def _lint_real(path, mutate=None):
+    """Lint a (possibly mutated) copy of a real repo file and split
+    against the checked-in baseline; returns the NEW findings."""
+    real = os.path.join(_REPO_ROOT, path)
+    with open(real, "r", encoding="utf-8") as f:
+        src = f.read()
+    if mutate is not None:
+        src = mutate(src)
+    findings = _lint(src, display=path)
+    baseline = load_baseline(os.path.join(_REPO_ROOT, "tpulint.baseline"))
+    new, _, _ = split_by_baseline(findings, baseline)
+    return new
+
+
+class TestMaskDiscipline(unittest.TestCase):
+    def test_raw_reduction_fires(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def kernel(x, mask):\n"
+            "    return jnp.sum(x)\n"
+        )
+        self.assertIn("TPU010", _codes(src))
+
+    def test_masked_reduction_is_clean(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def kernel(x, mask):\n"
+            "    x = x * mask.astype(x.dtype)\n"
+            "    return jnp.sum(x)\n"
+        )
+        self.assertNotIn("TPU010", _codes(src))
+
+    def test_where_gated_reduction_is_clean(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def kernel(x, mask):\n"
+            "    return jnp.max(jnp.where(mask > 0, x, -jnp.inf))\n"
+        )
+        self.assertNotIn("TPU010", _codes(src))
+
+    def test_method_form_reduction_fires(self):
+        src = (
+            "def kernel(x, mask):\n"
+            "    return x.sum()\n"
+        )
+        self.assertIn("TPU010", _codes(src))
+
+    def test_scatter_add_of_raw_fires(self):
+        src = (
+            "def kernel(hist, x, idx, mask):\n"
+            "    return hist.at[idx].add(x)\n"
+        )
+        self.assertIn("TPU010", _codes(src))
+
+    def test_row_wise_axis_is_exempt(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def kernel(x, mask):\n"
+            "    rows = jnp.all(x, axis=1)\n"
+            "    return jnp.sum(rows * mask)\n"
+        )
+        self.assertNotIn("TPU010", _codes(src))
+
+    def test_mask_is_none_fast_path_is_skipped(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def kernel(x, mask=None):\n"
+            "    if mask is None:\n"
+            "        return jnp.sum(x)\n"
+            "    return jnp.sum(x * mask)\n"
+        )
+        self.assertNotIn("TPU010", _codes(src))
+
+    def test_kwargs_threaded_mask_counts(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def update(x, **kwargs):\n"
+            "    mask = kwargs.get('mask')\n"
+            "    return jnp.sum(x)\n"
+        )
+        self.assertIn("TPU010", _codes(src))
+
+    def test_function_without_mask_is_out_of_scope(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def plain(x):\n"
+            "    return jnp.sum(x)\n"
+        )
+        self.assertNotIn("TPU010", _codes(src))
+
+    def test_opaque_callee_handed_the_mask_is_trusted(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def kernel(x, mask, helper):\n"
+            "    y = helper(x, mask=mask)\n"
+            "    return jnp.sum(y)\n"
+        )
+        self.assertNotIn("TPU010", _codes(src))
+
+    def test_mutation_accuracy_kernel_mask_drop(self):
+        """Drop the mask multiply from the real multiclass accuracy
+        kernel: its sum and scatter-add turn raw -> NEW TPU010."""
+        path = "torcheval_tpu/metrics/functional/classification/accuracy.py"
+        needle = "correct = correct * mask.astype(correct.dtype)"
+        new = _lint_real(
+            path, mutate=lambda s: s.replace(needle, "pass", 1)
+        )
+        self.assertTrue(
+            [f for f in new if f.code == "TPU010"],
+            "mask drop went undetected",
+        )
+
+    def test_control_accuracy_kernel_is_clean(self):
+        path = "torcheval_tpu/metrics/functional/classification/accuracy.py"
+        new = _lint_real(path)
+        self.assertEqual([f.code for f in new], [])
+
+
+_DECAY_WHERE = """factor = jnp.where(
+                jnp.sum(mask) > 0,
+                jnp.float32(self._decay),
+                jnp.float32(1.0),
+            )"""
+
+
+def _delete_init_cast(src):
+    lines = src.splitlines(keepends=True)
+    start = next(
+        i for i, ln in enumerate(lines) if "for name, default in list(" in ln
+    )
+    end = (
+        next(
+            i
+            for i, ln in enumerate(lines)
+            if ".astype(jnp.float32)" in ln and "getattr(metric" in ln
+        )
+        + 2
+    )
+    return "".join(lines[:start] + lines[end:])
+
+
+class TestPadNeutrality(unittest.TestCase):
+    def test_ungated_rescale_fires(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "class M:\n"
+            "    def update(self, x, mask):\n"
+            "        self.total = self.total * jnp.float32(0.9)\n"
+        )
+        self.assertIn("TPU011", _codes(src))
+
+    def test_where_gated_rescale_is_clean(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "class M:\n"
+            "    def update(self, x, mask):\n"
+            "        f = jnp.where(jnp.sum(mask) > 0, jnp.float32(0.9),\n"
+            "                      jnp.float32(1.0))\n"
+            "        self.total = self.total * f\n"
+        )
+        self.assertNotIn("TPU011", _codes(src))
+
+    def test_accumulate_of_masked_delta_is_clean(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "class M:\n"
+            "    def update(self, x, mask):\n"
+            "        self.total = self.total + jnp.sum(x * mask)\n"
+        )
+        self.assertNotIn("TPU011", _codes(src))
+
+    def test_augassign_with_nonneutral_addend_fires(self):
+        src = (
+            "class M:\n"
+            "    def update(self, x, mask):\n"
+            "        self.count += 1\n"
+        )
+        self.assertIn("TPU011", _codes(src))
+
+    def test_setattr_getattr_form_is_recognized(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def update(inner, names, mask):\n"
+            "    for n in names:\n"
+            "        setattr(inner, n, getattr(inner, n) * jnp.float32(0.9))\n"
+        )
+        self.assertIn("TPU011", _codes(src))
+
+    def test_opaque_call_delegates_the_proof(self):
+        src = (
+            "class M:\n"
+            "    def update(self, x, mask, accumulate):\n"
+            "        self.total = accumulate(self.total, x, mask)\n"
+        )
+        self.assertNotIn("TPU011", _codes(src))
+
+    def test_mutation_decayed_loses_its_where_gate(self):
+        """Replace Decayed's pad-step gate with a bare decay factor:
+        the state rescale stops being a no-op on all-masked steps."""
+        path = "torcheval_tpu/monitor/decay.py"
+        new = _lint_real(
+            path,
+            mutate=lambda s: s.replace(
+                _DECAY_WHERE, "factor = jnp.float32(self._decay)", 1
+            ),
+        )
+        self.assertEqual([f.code for f in new], ["TPU011"])
+
+    def test_control_decayed_is_clean(self):
+        new = _lint_real("torcheval_tpu/monitor/decay.py")
+        self.assertEqual([f.code for f in new], [])
+
+
+class TestDtypeStability(unittest.TestCase):
+    def test_float64_cast_in_jitted_function_fires(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def kernel(x):\n"
+            "    return jnp.float64(x)\n"
+        )
+        self.assertIn("TPU012", _codes(src))
+
+    def test_float64_dtype_kw_in_masked_kernel_fires(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def kernel(x, mask):\n"
+            "    return jnp.asarray(x * mask, dtype=jnp.float64)\n"
+        )
+        self.assertIn("TPU012", _codes(src))
+
+    def test_float32_spelling_is_clean(self):
+        src = (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def kernel(x):\n"
+            "    return x.astype(jnp.float32)\n"
+        )
+        self.assertNotIn("TPU012", _codes(src))
+
+    def test_untraced_host_float64_is_out_of_scope(self):
+        src = (
+            "import numpy as np\n"
+            "def host_summary(rows):\n"
+            "    return np.float64(rows)\n"
+        )
+        self.assertNotIn("TPU012", _codes(src))
+
+    def test_float_factor_without_sanctioned_cast_fires(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "class M:\n"
+            "    def update(self, x, mask):\n"
+            "        f = jnp.where(jnp.sum(mask) > 0, jnp.float32(0.9),\n"
+            "                      jnp.float32(1.0))\n"
+            "        self.total = self.total * f\n"
+        )
+        self.assertIn("TPU012", _codes(src))
+
+    def test_sanctioned_cast_in_class_suppresses(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "class M:\n"
+            "    def __init__(self, total):\n"
+            "        self.total = total.astype(jnp.float32)\n"
+            "    def update(self, x, mask):\n"
+            "        f = jnp.where(jnp.sum(mask) > 0, jnp.float32(0.9),\n"
+            "                      jnp.float32(1.0))\n"
+            "        self.total = self.total * f\n"
+        )
+        self.assertNotIn("TPU012", _codes(src))
+
+    def test_mutation_decayed_loses_its_int_state_cast(self):
+        """Delete Decayed.__init__'s integer-state -> float32 patch
+        block: the per-step float multiply loses its dtype invariant."""
+        new = _lint_real(
+            "torcheval_tpu/monitor/decay.py", mutate=_delete_init_cast
+        )
+        self.assertEqual([f.code for f in new], ["TPU012"])
+
+
+class TestFlagRegistry(unittest.TestCase):
+    def test_direct_environ_get_fires(self):
+        src = (
+            "import os\n"
+            "FLAG = os.environ.get('TORCHEVAL_TPU_TELEMETRY')\n"
+        )
+        self.assertIn("TPU013", _codes(src))
+
+    def test_os_getenv_fires(self):
+        src = "import os\nV = os.getenv('TORCHEVAL_TPU_DONATE', '')\n"
+        self.assertIn("TPU013", _codes(src))
+
+    def test_subscript_and_membership_fire(self):
+        src = (
+            "import os\n"
+            "if 'TORCHEVAL_TPU_PERFSCOPE' in os.environ:\n"
+            "    V = os.environ['TORCHEVAL_TPU_PERFSCOPE']\n"
+        )
+        self.assertEqual(_codes(src).count("TPU013"), 2)
+
+    def test_prefix_concatenation_fires(self):
+        src = (
+            "import os\n"
+            "def read(name):\n"
+            "    return os.environ.get('TORCHEVAL_TPU_' + name)\n"
+        )
+        self.assertIn("TPU013", _codes(src))
+
+    def test_foreign_env_vars_are_out_of_scope(self):
+        src = "import os\nV = os.environ.get('JAX_PLATFORMS')\n"
+        self.assertNotIn("TPU013", _codes(src))
+
+    def test_registry_module_is_exempt(self):
+        src = (
+            "import os\n"
+            "V = os.environ.get('TORCHEVAL_TPU_TELEMETRY')\n"
+        )
+        self.assertNotIn(
+            "TPU013", _codes(src, display="torcheval_tpu/_flags.py")
+        )
+
+    def test_docstring_mentions_are_safe(self):
+        src = (
+            '"""Set TORCHEVAL_TPU_TELEMETRY=1 to enable."""\n'
+            "X = 1\n"
+        )
+        self.assertNotIn("TPU013", _codes(src))
+
+    def test_mutation_raw_read_added_to_real_module(self):
+        new = _lint_real(
+            "torcheval_tpu/distributed.py",
+            mutate=lambda s: s
+            + "\nimport os\n"
+            + "_RAW = os.environ.get('TORCHEVAL_TPU_KV_TIMEOUT_MS')\n",
+        )
+        self.assertEqual([f.code for f in new], ["TPU013"])
+
+    def test_control_distributed_is_clean(self):
+        new = _lint_real("torcheval_tpu/distributed.py")
+        self.assertEqual([f.code for f in new], [])
+
+    def test_shipped_tree_has_no_direct_flag_reads(self):
+        """The migration claim itself: linting the whole package yields
+        zero TPU013 findings (the registry is the only reader)."""
+        paths = []
+        pkg = os.path.join(_REPO_ROOT, "torcheval_tpu")
+        for root, _dirs, files in os.walk(pkg):
+            for fname in files:
+                if fname.endswith(".py"):
+                    full = os.path.join(root, fname)
+                    rel = os.path.relpath(full, _REPO_ROOT).replace(
+                        os.sep, "/"
+                    )
+                    paths.append((full, rel))
+        findings = analyze_files(paths, rule_codes={"TPU013"}).all_findings
+        self.assertEqual(
+            [f.render() for f in findings if f.code == "TPU013"], []
+        )
+
+
+class TestFlagRegistrySemantics(unittest.TestCase):
+    """The typed registry itself: parse policies, validation, and the
+    report()/docs derivations."""
+
+    def setUp(self):
+        from torcheval_tpu import _flags
+
+        self.flags = _flags
+        self._saved = {
+            f.env_name: os.environ.get(f.env_name)
+            for f in _flags.FLAGS.values()
+        }
+
+    def tearDown(self):
+        for name, value in self._saved.items():
+            if value is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = value
+
+    def test_bool_truthy_falsy(self):
+        os.environ["TORCHEVAL_TPU_TELEMETRY"] = "yes"
+        self.assertTrue(self.flags.get("TELEMETRY"))
+        os.environ["TORCHEVAL_TPU_TELEMETRY"] = "off"
+        self.assertFalse(self.flags.get("TELEMETRY"))
+
+    def test_kv_timeout_rejects_nonpositive(self):
+        os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = "-1"
+        with self.assertRaises(ValueError):
+            self.flags.get("KV_TIMEOUT_MS")
+        os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = "notanint"
+        with self.assertRaises(ValueError):
+            self.flags.get("KV_TIMEOUT_MS")
+        os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = "5000"
+        self.assertEqual(self.flags.get("KV_TIMEOUT_MS"), 5000)
+
+    def test_kv_timeout_flows_through_distributed(self):
+        from torcheval_tpu import distributed
+
+        os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = "1234"
+        self.assertEqual(distributed.kv_timeout_ms(), 1234)
+        os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = "0"
+        with self.assertRaises(ValueError):
+            distributed.kv_timeout_ms()
+
+    def test_capacity_invalid_falls_back_silently(self):
+        os.environ["TORCHEVAL_TPU_TELEMETRY_CAPACITY"] = "-5"
+        self.assertEqual(
+            self.flags.get("TELEMETRY_CAPACITY"),
+            self.flags.FLAGS["TELEMETRY_CAPACITY"].default,
+        )
+
+    def test_fault_plan_rejects_bad_json(self):
+        os.environ["TORCHEVAL_TPU_FAULT_PLAN"] = "{not json"
+        with self.assertRaises(ValueError):
+            self.flags.get("FAULT_PLAN")
+
+    def test_unset_returns_default(self):
+        os.environ.pop("TORCHEVAL_TPU_PERFSCOPE_SLO_EVERY", None)
+        self.assertEqual(self.flags.get("PERFSCOPE_SLO_EVERY"), 8)
+
+    def test_every_flag_carries_the_prefix_and_a_doc(self):
+        for flag in self.flags.FLAGS.values():
+            self.assertTrue(flag.env_name.startswith("TORCHEVAL_TPU_"))
+            self.assertTrue(flag.doc.strip(), flag.env_name)
+
+    def test_snapshot_non_default(self):
+        os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = "9999"
+        os.environ.pop("TORCHEVAL_TPU_TELEMETRY", None)
+        snap = self.flags.snapshot_non_default()
+        self.assertEqual(snap.get("TORCHEVAL_TPU_KV_TIMEOUT_MS"), 9999)
+        self.assertNotIn("TORCHEVAL_TPU_TELEMETRY", snap)
+
+    def test_snapshot_never_raises_on_invalid(self):
+        os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = "-1"
+        snap = self.flags.snapshot_non_default()
+        self.assertEqual(
+            snap["TORCHEVAL_TPU_KV_TIMEOUT_MS"],
+            {"raw": "-1", "invalid": True},
+        )
+
+    def test_report_carries_the_flags_section(self):
+        from torcheval_tpu import telemetry
+
+        os.environ["TORCHEVAL_TPU_KV_TIMEOUT_MS"] = "7777"
+        report = telemetry.report()
+        self.assertEqual(
+            report["flags"].get("TORCHEVAL_TPU_KV_TIMEOUT_MS"), 7777
+        )
+
+    def test_describe_matches_the_docs_table(self):
+        """Every registered flag appears in docs/source/flags.rst (the
+        page is derived from describe(); drift fails here)."""
+        doc = os.path.join(_REPO_ROOT, "docs", "source", "flags.rst")
+        with open(doc, "r", encoding="utf-8") as f:
+            text = f.read()
+        for row in self.flags.describe():
+            self.assertIn(row["env"], text, f"{row['env']} missing from docs")
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,352 @@
+"""The typed ``TORCHEVAL_TPU_*`` flag registry: every environment
+variable the library reads, declared ONCE with its type, default,
+validation policy, and one-line doc.
+
+Before this module the 15 environment reads were scattered across eight
+modules, each with its own truthy-string tuple, its own silent-fallback
+or raise-on-garbage policy, and no single place to answer "what knobs
+does this process run with?".  Now:
+
+* every read goes through :func:`get` (``tpulint`` rule TPU013 rejects
+  any raw ``os.environ`` read of a ``TORCHEVAL_TPU_*`` name outside
+  this file),
+* invalid-value handling is declared per flag and uniform in mechanism
+  (``on_invalid="default"`` falls back silently — the telemetry-capacity
+  convention; ``on_invalid="raise"`` fails loudly with the flag's own
+  message — the KV-timeout / fault-plan convention),
+* :func:`snapshot_non_default` gives ``telemetry.report()`` its
+  ``flags`` section (never raises: a malformed value is reported as its
+  raw string), and
+* :func:`describe` derives the docs table in ``docs/source/flags.rst``.
+
+Read semantics match the pre-registry behavior exactly: *call-time*
+flags (kill switches, donation, value checks, KV timeout) re-read the
+environment on every :func:`get`, so harnesses may toggle them after
+import; *import-time* flags (telemetry/health/perfscope enables, fault
+plan, ring capacity) are read once by their owning module at import and
+cached there as module attributes — this registry never caches.
+
+This module is layer-0 foundation code: stdlib only, importable with no
+JAX present (the ``TORCHEVAL_TPU_DONATE`` backend-dependent fallback
+for the unset case stays in ``ops/_flags.py`` where JAX is available).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "Flag",
+    "FLAGS",
+    "PREFIX",
+    "TRUTHY",
+    "FALSY",
+    "get",
+    "describe",
+    "snapshot_non_default",
+]
+
+PREFIX = "TORCHEVAL_TPU_"
+
+# The shared truthiness lexicon (the tuple every migrated module used
+# to re-declare locally).
+TRUTHY = ("1", "true", "yes", "on")
+FALSY = ("0", "false", "no", "off")
+
+
+@dataclass(frozen=True)
+class Flag:
+    """One declared environment flag.
+
+    ``kind`` selects the parser: ``bool`` (truthy-string match),
+    ``tribool`` (truthy → True, falsy → False, else the default —
+    ``TORCHEVAL_TPU_DONATE``'s forced/unset distinction), ``int``,
+    ``float``, ``str``, and ``json``.  ``validate`` (parsed value →
+    bool) narrows the domain after parsing; a parse or validation
+    failure follows ``on_invalid``: ``"default"`` returns the default
+    silently, ``"raise"`` raises ``ValueError`` with
+    ``invalid_message`` (``{raw}`` / ``{exc}`` placeholders).
+    ``read_at`` is documentation only (``"call"`` vs ``"import"``) —
+    the registry itself never caches.
+    """
+
+    name: str  # short name; the env var is PREFIX + name
+    kind: str
+    default: Any
+    doc: str
+    on_invalid: str = "default"
+    validate: Optional[Callable[[Any], bool]] = None
+    invalid_message: str = ""
+    read_at: str = "call"
+
+    @property
+    def env_name(self) -> str:
+        return PREFIX + self.name
+
+    def raw(self) -> Optional[str]:
+        """The raw environment string, or None when unset."""
+        return os.environ.get(self.env_name)
+
+    def _invalid(self, raw: str, exc: Optional[BaseException]) -> Any:
+        if self.on_invalid == "raise":
+            message = self.invalid_message.format(raw=raw, exc=exc)
+            raise ValueError(message) from exc
+        return self.default
+
+    def parse(self, raw: Optional[str]) -> Any:
+        """Parse one raw string under this flag's policy (``None`` means
+        unset).  Exposed separately from :meth:`get` so tests and
+        :func:`snapshot_non_default` can parse without touching the
+        environment."""
+        if self.kind == "bool":
+            return (raw or "").lower() in TRUTHY
+        if self.kind == "tribool":
+            lowered = (raw or "").lower()
+            if lowered in TRUTHY:
+                return True
+            if lowered in FALSY:
+                return False
+            return self.default
+        if raw is None or not raw.strip():
+            return self.default
+        if self.kind == "str":
+            return raw
+        if self.kind == "json":
+            try:
+                return json.loads(raw.strip())
+            except json.JSONDecodeError as exc:
+                return self._invalid(raw, exc)
+        try:
+            value = int(raw.strip()) if self.kind == "int" else float(raw.strip())
+        except ValueError as exc:
+            return self._invalid(raw, exc)
+        if self.validate is not None and not self.validate(value):
+            return self._invalid(raw, None)
+        return value
+
+    def get(self) -> Any:
+        """Read the environment now and parse under this flag's policy."""
+        return self.parse(self.raw())
+
+
+def _positive(n: Any) -> bool:
+    return n > 0
+
+
+_DECLARATIONS: Tuple[Flag, ...] = (
+    Flag(
+        name="DISABLE_PALLAS",
+        kind="bool",
+        default=False,
+        doc=(
+            "Kill-switch forcing every kernel dispatch back to the "
+            "pure-XLA formulation (``ops.routing``)."
+        ),
+    ),
+    Flag(
+        name="DISABLE_USTAT",
+        kind="bool",
+        default=False,
+        doc=(
+            "Narrower kill-switch for just the rank-sum (ustat) fast "
+            "paths, leaving the other Pallas kernels live."
+        ),
+    ),
+    Flag(
+        name="DONATE",
+        kind="tribool",
+        default=None,
+        doc=(
+            "Force state-buffer donation on the update hot paths: "
+            "truthy → on, falsy → off, unset → on for accelerator "
+            "backends, off on CPU (``ops._flags.donation_enabled``)."
+        ),
+    ),
+    Flag(
+        name="CACHE_DIR",
+        kind="str",
+        default=None,
+        doc=(
+            "Directory for JAX's persistent compilation cache, enabled "
+            "at package import when set (``ops._flags."
+            "configure_persistent_cache``)."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="CACHE_MIN_COMPILE_SECS",
+        kind="float",
+        default=0.5,
+        doc=(
+            "Minimum compile time (seconds) before a program is written "
+            "to the persistent cache."
+        ),
+        on_invalid="raise",
+        invalid_message=(
+            "TORCHEVAL_TPU_CACHE_MIN_COMPILE_SECS must be a float "
+            "(seconds), got {raw!r}"
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="TELEMETRY",
+        kind="bool",
+        default=False,
+        doc=(
+            "Enable the telemetry event bus at import "
+            "(``telemetry.events.ENABLED``)."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="TELEMETRY_ANNOTATE",
+        kind="bool",
+        default=False,
+        doc=(
+            "Also run update/compute spans under profiler annotations "
+            "so they land in TensorBoard/Perfetto traces."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="TELEMETRY_CAPACITY",
+        kind="int",
+        default=4096,
+        doc=(
+            "Capacity of the bounded telemetry event ring; non-positive "
+            "or unparseable values fall back silently."
+        ),
+        validate=_positive,
+        read_at="import",
+    ),
+    Flag(
+        name="DATA_HEALTH",
+        kind="bool",
+        default=False,
+        doc=(
+            "Enable the streaming data-health monitor at import "
+            "(``telemetry.health.ENABLED``)."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="DATA_HEALTH_RAISE",
+        kind="bool",
+        default=False,
+        doc=(
+            "Escalate corrupt-data findings (NaN/Inf, out-of-range "
+            "labels) to ``DataCorruptionError`` at the dispatch site."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="PERFSCOPE",
+        kind="bool",
+        default=False,
+        doc=(
+            "Enable the performance-attribution scope at import "
+            "(``telemetry.perfscope.ENABLED``)."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="PERFSCOPE_SLO_EVERY",
+        kind="int",
+        default=8,
+        doc=(
+            "Dispatched evaluator blocks between SLO rule evaluations; "
+            "non-positive or unparseable values fall back silently."
+        ),
+        validate=_positive,
+        read_at="import",
+    ),
+    Flag(
+        name="FAULT_PLAN",
+        kind="json",
+        default=None,
+        doc=(
+            "JSON fault-injection plan installed at import "
+            "(``resilience.faults.install_from_env``)."
+        ),
+        on_invalid="raise",
+        invalid_message=(
+            "TORCHEVAL_TPU_FAULT_PLAN is not valid JSON: {exc}"
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="KV_TIMEOUT_MS",
+        kind="int",
+        default=600_000,
+        doc=(
+            "Per-RPC wait budget (milliseconds) for KV-store "
+            "collectives; anything but a positive integer raises so a "
+            "typo'd deployment fails loudly."
+        ),
+        validate=_positive,
+        on_invalid="raise",
+        invalid_message=(
+            "TORCHEVAL_TPU_KV_TIMEOUT_MS must be a positive integer "
+            "(milliseconds), got {raw!r}"
+        ),
+    ),
+    Flag(
+        name="SKIP_VALUE_CHECKS",
+        kind="bool",
+        default=False,
+        doc=(
+            "Disable data-dependent (value) validation of update inputs "
+            "process-wide — the env twin of "
+            "``metrics.functional.skip_value_checks()``."
+        ),
+    ),
+)
+
+FLAGS: Dict[str, Flag] = {f.name: f for f in _DECLARATIONS}
+
+
+def get(name: str) -> Any:
+    """Read flag ``name`` (short name, without the ``TORCHEVAL_TPU_``
+    prefix) from the environment now, parsed and validated under its
+    declared policy."""
+    return FLAGS[name].get()
+
+
+def describe() -> Tuple[Dict[str, Any], ...]:
+    """One row per declared flag (env name, kind, default, read-at,
+    doc), in declaration order — the source the docs flag table is
+    derived from."""
+    return tuple(
+        {
+            "env": f.env_name,
+            "kind": f.kind,
+            "default": f.default,
+            "read_at": f.read_at,
+            "doc": f.doc,
+        }
+        for f in _DECLARATIONS
+    )
+
+
+def snapshot_non_default() -> Dict[str, Any]:
+    """Env name → parsed value for every flag currently set to a
+    non-default value — ``telemetry.report()``'s ``flags`` section.
+    Never raises: a value its flag would reject is reported as
+    ``{"raw": <string>, "invalid": True}`` instead.
+    """
+    out: Dict[str, Any] = {}
+    for flag in _DECLARATIONS:
+        raw = flag.raw()
+        if raw is None:
+            continue
+        try:
+            value = flag.parse(raw)
+        except ValueError:
+            out[flag.env_name] = {"raw": raw, "invalid": True}
+            continue
+        if value != flag.default:
+            out[flag.env_name] = value
+    return out

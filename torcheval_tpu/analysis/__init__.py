@@ -1,6 +1,6 @@
 """tpulint — repo-native static analysis for the TPU metrics stack.
 
-Proves four contract families at parse time, before any chip sees the
+Proves five contract families at parse time, before any chip sees the
 code:
 
 - **hot-path**: every telemetry/health/faults/perfscope/quality hook
@@ -14,7 +14,14 @@ code:
   and blocking-while-holding deadlock potential (TPU007), thread
   lifecycle (TPU008), and check-then-act races (TPU009), built on an
   interprocedural call graph with thread-entry reachability and
-  held-lock propagation (see ``_core``).
+  held-lock propagation (see ``_core``);
+- **dataflow**: an intraprocedural abstract interpreter over
+  mask-accepting update paths proves mask discipline on reductions
+  (TPU010), pad-neutrality of state writes under the all-masked
+  abstraction (TPU011), and dtype stability in traced regions
+  (TPU012); plus the typed-flag-registry boundary — every
+  ``TORCHEVAL_TPU_*`` env read goes through ``torcheval_tpu._flags``
+  (TPU013).
 
 Run it::
 
@@ -181,7 +188,9 @@ def main(
             "guards (TPU001), layer order (TPU002), traced host syncs "
             "(TPU003), donation safety (TPU004), traced determinism "
             "(TPU005), lock discipline (TPU006), lock order (TPU007), "
-            "thread lifecycle (TPU008), check-then-act (TPU009)."
+            "thread lifecycle (TPU008), check-then-act (TPU009), mask "
+            "discipline (TPU010), pad-neutrality (TPU011), dtype "
+            "stability (TPU012), flag registry (TPU013)."
         ),
         epilog=_EPILOG,
         formatter_class=argparse.RawDescriptionHelpFormatter,

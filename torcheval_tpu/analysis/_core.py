@@ -1486,7 +1486,7 @@ class ConcurrencyModel:
                 self.guards[fid] = frozenset(guards)
 
 
-_MODEL_CACHE: List[Tuple[Tuple[int, ...], "ConcurrencyModel"]] = []
+_MODEL_CACHE: List[Tuple[Tuple[Module, ...], "ConcurrencyModel"]] = []
 
 
 def build_concurrency_model(mods: List[Module]) -> ConcurrencyModel:
@@ -1512,12 +1512,1179 @@ def build_concurrency_model(mods: List[Module]) -> ConcurrencyModel:
 
 def concurrency_model(mods: List[Module]) -> ConcurrencyModel:
     """Memoized :func:`build_concurrency_model` so the four concurrency
-    rules share one model per analyzer run."""
-    key = tuple(id(m) for m in mods)
+    rules share one model per analyzer run.  The key holds the Module
+    objects themselves (not their ids): a strong reference pins each
+    object, so a recycled id can never alias a stale model onto a
+    different module list."""
+    key = tuple(mods)
     for k, m in _MODEL_CACHE:
-        if k == key:
+        if len(k) == len(key) and all(a is b for a, b in zip(k, key)):
             return m
     model = build_concurrency_model(mods)
     _MODEL_CACHE.append((key, model))
     del _MODEL_CACHE[:-4]
     return model
+
+
+# ============================================================== dataflow
+# Intraprocedural abstract interpretation for the dataflow tier
+# (TPU010 mask-discipline, TPU011 pad-neutrality, TPU012 dtype-stability).
+#
+# The interpreter runs a forward walk over one function body on a product
+# lattice per value:
+#
+# * **provenance** — which of {"raw", "mask"} the value derives from.
+#   Mask parameters seed {"mask"}; every other parameter seeds {"raw"}
+#   (in a mask-accepting function the data arguments are, by the
+#   bucketing contract, padded batch rows).  A full reduction over a
+#   value whose provenance is raw-without-mask means the validity mask
+#   was dropped on that path (TPU010).
+# * **numeric abstraction** — the all-masked evaluation used for the
+#   pad-neutrality proof (TPU011): the mask is ZERO, ``sum(mask) > 0``
+#   is FALSE, ``where(FALSE, a, b)`` is ``b``, and a read of the state
+#   being written is IDENT.  A read-modify-write whose right-hand side
+#   evaluates to anything but IDENT is not a no-op on a fully-masked
+#   pad step.
+# * **dtype abstraction** — literal casts (``jnp.float32``/``astype``)
+#   and promotion on arithmetic, enough to spot int-state arithmetic
+#   against float factors (TPU012's sanctioned-cast check).
+#
+# Path sensitivity is exactly one bit: the walk is specialized to the
+# *mask-present* world, so ``if mask is None:`` branches (the unmasked
+# fast paths, which owe no mask discipline) are skipped and ``if mask
+# is not None:`` branches are always taken.  Everything the analysis
+# cannot prove joins toward TOP / impure, which silences the checks —
+# the rules only fire on facts the lattice actually proves.
+
+#: Parameter names that make a function "mask-accepting": its data
+#: arguments are padded batch rows and every full reduction must thread
+#: the mask.  Locals derived from ``kwargs.get("mask")`` /
+#: ``kwargs.pop("mask", ...)`` count too (``collection._trace_update``).
+MASK_PARAM_NAMES = frozenset(
+    {
+        "mask",
+        "masks",
+        "row_mask",
+        "valid_mask",
+        "base_mask",
+        "stacked_mask",
+        "step_mask",
+        "smask",
+        "any_valid",
+        "validity",
+    }
+)
+
+_FuncDefT = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# Full-array reducers (module attribute or method form).  Builtin host
+# reducers (bare ``sum``/``max`` over Python lists) are deliberately
+# excluded: the mask contract governs device reductions over padded
+# arrays, not host bookkeeping.
+_REDUCER_NAMES = frozenset(
+    {
+        "sum",
+        "mean",
+        "max",
+        "min",
+        "prod",
+        "any",
+        "all",
+        "count_nonzero",
+        "nansum",
+        "nanmean",
+        "nanmax",
+        "nanmin",
+        "median",
+        "std",
+        "var",
+        "average",
+    }
+)
+_SEGMENT_REDUCERS = frozenset(
+    {"segment_sum", "segment_max", "segment_min", "segment_prod", "bincount"}
+)
+
+_WHERE_CHAINS = frozenset(
+    {"jnp.where", "np.where", "jax.numpy.where", "numpy.where"}
+)
+
+# dtype tags: f64/f32/f16/bf16 (strong floats), i32/i64 (strong ints),
+# b (bool), wf/wi (weak Python float/int scalars), None = unknown.
+_FLOAT_DTS = frozenset({"f64", "f32", "f16", "bf16", "wf"})
+_DTYPE_CHAINS = {
+    "jnp.float64": "f64",
+    "np.float64": "f64",
+    "jax.numpy.float64": "f64",
+    "numpy.float64": "f64",
+    "jnp.float32": "f32",
+    "np.float32": "f32",
+    "jax.numpy.float32": "f32",
+    "numpy.float32": "f32",
+    "jnp.float16": "f16",
+    "jnp.bfloat16": "bf16",
+    "jnp.int32": "i32",
+    "np.int32": "i32",
+    "jnp.int64": "i64",
+    "np.int64": "i64",
+    "jnp.bool_": "b",
+    "np.bool_": "b",
+}
+_DTYPE_STRINGS = {
+    "float64": "f64",
+    "double": "f64",
+    "float32": "f32",
+    "float16": "f16",
+    "bfloat16": "bf16",
+    "int32": "i32",
+    "int64": "i64",
+    "bool": "b",
+}
+_DT_ORDER = ("f64", "f32", "bf16", "f16", "i64", "i32", "b", "wf", "wi")
+
+# Pass-through calls: shape/cast ops whose result keeps the operand's
+# provenance and numeric abstraction (``astype`` additionally retags the
+# dtype; handled at the call site).
+_TRANSPARENT_CALLS = frozenset(
+    {
+        "jnp.asarray",
+        "jnp.array",
+        "np.asarray",
+        "np.array",
+        "jnp.reshape",
+        "jnp.broadcast_to",
+        "jnp.expand_dims",
+        "jnp.squeeze",
+        "jnp.ravel",
+        "jnp.abs",
+        "jnp.negative",
+        "jnp.transpose",
+    }
+)
+_TRANSPARENT_METHODS = frozenset(
+    {"reshape", "broadcast_to", "squeeze", "ravel", "flatten", "transpose"}
+)
+_PURE_BUILTINS = frozenset(
+    {
+        "int",
+        "float",
+        "bool",
+        "str",
+        "len",
+        "tuple",
+        "list",
+        "dict",
+        "set",
+        "abs",
+        "round",
+        "zip",
+        "enumerate",
+        "range",
+        "isinstance",
+        "hasattr",
+        "sorted",
+        "reversed",
+    }
+)
+
+
+@dataclass(frozen=True)
+class AbstractValue:
+    """One point of the product lattice (provenance × numeric × dtype),
+    plus a purity bit: ``pure=False`` marks values routed through an
+    unresolved call, which exempts read-modify-writes from the
+    neutrality verdict (the callee owns the proof)."""
+
+    prov: frozenset = frozenset()
+    num: str = "top"  # zero|one|false|true|const|ident|none|top
+    dt: Optional[str] = None
+    pure: bool = True
+    elts: Optional[Tuple["AbstractValue", ...]] = None
+
+    def with_(self, **kw) -> "AbstractValue":
+        merged = {
+            "prov": self.prov,
+            "num": self.num,
+            "dt": self.dt,
+            "pure": self.pure,
+            "elts": self.elts,
+        }
+        merged.update(kw)
+        return AbstractValue(**merged)
+
+
+_TOP = AbstractValue()
+
+
+def _av_join(a: AbstractValue, b: AbstractValue) -> AbstractValue:
+    return AbstractValue(
+        prov=a.prov | b.prov,
+        num=a.num if a.num == b.num else "top",
+        dt=a.dt if a.dt == b.dt else None,
+        pure=a.pure and b.pure,
+    )
+
+
+def _dt_promote(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    if a is None or b is None:
+        return None
+    for dt in _DT_ORDER:
+        if a == dt or b == dt:
+            return dt
+    return None
+
+
+def _num_mul(a: str, b: str) -> str:
+    if "zero" in (a, b):
+        return "zero"
+    if a == "one":
+        return b
+    if b == "one":
+        return a
+    if "ident" in (a, b):
+        return "top"
+    if a == b == "const":
+        return "const"
+    return "top"
+
+
+def _num_add(a: str, b: str) -> str:
+    if a == "zero":
+        return b
+    if b == "zero":
+        return a
+    if "ident" in (a, b):
+        return "top"
+    if a == b == "const":
+        return "const"
+    return "top"
+
+
+@dataclass
+class RawReduction:
+    """A full reduction whose operand is raw-without-mask (TPU010)."""
+
+    node: ast.AST
+    symbol: str
+    operand: str
+
+
+@dataclass
+class NonNeutralWrite:
+    """A read-modify-write whose all-masked value is not IDENT
+    (TPU011)."""
+
+    node: ast.AST
+    symbol: str
+    detail: str
+
+
+@dataclass
+class FloatStateMult:
+    """A read-modify-write multiplying state by a float-typed factor —
+    TPU012's int-state hazard when the owning class lacks the
+    sanctioned float32 normalization."""
+
+    node: ast.AST
+    symbol: str
+
+
+@dataclass
+class DataflowSummary:
+    """The per-function output of the mask-present abstract walk."""
+
+    func: ast.AST
+    mask_names: Set[str]
+    raw_reductions: List[RawReduction] = field(default_factory=list)
+    nonneutral_writes: List[NonNeutralWrite] = field(default_factory=list)
+    float_state_mults: List[FloatStateMult] = field(default_factory=list)
+
+
+def mask_param_names(func: ast.AST) -> Set[str]:
+    """Parameters of ``func`` whose names mark them as validity masks."""
+    args = func.args
+    every = (
+        list(args.posonlyargs)
+        + list(args.args)
+        + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+    )
+    return {a.arg for a in every if a.arg in MASK_PARAM_NAMES}
+
+
+def kwargs_mask_locals(func: ast.AST) -> Set[str]:
+    """Local names bound from ``<dict>.get("mask")`` / ``<dict>.pop(
+    "mask", ...)`` — the keyword-threading form of mask acceptance."""
+    out: Set[str] = set()
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        call = node.value
+        if (
+            isinstance(call, ast.Call)
+            and isinstance(call.func, ast.Attribute)
+            and call.func.attr in ("get", "pop")
+            and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value in MASK_PARAM_NAMES
+        ):
+            out.add(target.id)
+    return out
+
+
+def is_mask_accepting(func: ast.AST) -> bool:
+    return bool(mask_param_names(func) or kwargs_mask_locals(func))
+
+
+def _param_names(func: ast.AST) -> List[str]:
+    args = func.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def _operand_desc(node: ast.AST) -> str:
+    name = dotted_name(node)
+    if name:
+        return name
+    if isinstance(node, ast.Call):
+        inner = dotted_name(node.func)
+        return f"{inner}(...)" if inner else "<call>"
+    return "<expr>"
+
+
+class _MaskInterp:
+    """The mask-present abstract walk over one function body."""
+
+    def __init__(self, func: ast.AST, mask_names: Set[str]) -> None:
+        self.func = func
+        self.mask_names = set(mask_names)
+        self.summary = DataflowSummary(func=func, mask_names=self.mask_names)
+        self.nested: Dict[str, ast.AST] = {
+            st.name: st
+            for st in ast.walk(func)
+            if isinstance(st, _FuncDefT) and st is not func
+        }
+        # Read-modify-write pattern currently being evaluated: a dotted
+        # attribute chain, and (for setattr/getattr form) the dumped
+        # name expression.
+        self._ident_attr: Optional[str] = None
+        self._ident_pair: Optional[Tuple[str, str]] = None
+        self._seen_reductions: Set[int] = set()
+
+    # ----------------------------------------------------------- driver
+    def run(self) -> DataflowSummary:
+        env: Dict[str, AbstractValue] = {}
+        mask_value = AbstractValue(
+            prov=frozenset({"mask"}), num="zero", dt="i32"
+        )
+        for name in _param_names(self.func):
+            if name in self.mask_names:
+                env[name] = mask_value
+            elif name in ("self", "cls"):
+                env[name] = _TOP
+            else:
+                env[name] = AbstractValue(prov=frozenset({"raw"}))
+        self._walk(self.func.body, env)
+        return self.summary
+
+    # ------------------------------------------------------- statements
+    def _walk(self, stmts: List[ast.stmt], env: Dict[str, AbstractValue]) -> bool:
+        """Walk statements in ``env`` (mutated in place).  Returns True
+        when the block definitely terminates (return/raise)."""
+        for st in stmts:
+            if isinstance(st, (ast.Return,)):
+                if st.value is not None:
+                    self._eval(st.value, env)
+                return True
+            if isinstance(st, ast.Raise):
+                return True
+            if isinstance(st, _FuncDefT + (ast.ClassDef,)):
+                continue
+            if isinstance(st, ast.Assign):
+                self._assign(st, env)
+            elif isinstance(st, ast.AugAssign):
+                self._aug_assign(st, env)
+            elif isinstance(st, ast.AnnAssign):
+                if st.value is not None:
+                    value = self._eval(st.value, env)
+                    if isinstance(st.target, ast.Name):
+                        env[st.target.id] = value
+            elif isinstance(st, ast.Expr):
+                self._eval_stmt_call(st.value, env)
+            elif isinstance(st, ast.If):
+                truth = self._truth(st.test, env)
+                if truth is None:
+                    self._eval(st.test, env)
+                    body_env = dict(env)
+                    else_env = dict(env)
+                    body_done = self._walk(st.body, body_env)
+                    else_done = self._walk(st.orelse, else_env)
+                    if body_done and else_done:
+                        return True
+                    if body_done:
+                        env.clear()
+                        env.update(else_env)
+                    elif else_done:
+                        env.clear()
+                        env.update(body_env)
+                    else:
+                        merged = {
+                            k: _av_join(body_env[k], else_env[k])
+                            if k in else_env
+                            else body_env[k]
+                            for k in body_env
+                        }
+                        for k in else_env:
+                            merged.setdefault(k, else_env[k])
+                        env.clear()
+                        env.update(merged)
+                elif truth:
+                    if self._walk(st.body, env):
+                        return True
+                else:
+                    if self._walk(st.orelse, env):
+                        return True
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                iter_value = self._eval(st.iter, env)
+                self._bind_target(
+                    st.target,
+                    AbstractValue(prov=iter_value.prov, pure=iter_value.pure),
+                    env,
+                )
+                self._walk(st.body, env)
+                self._walk(st.orelse, env)
+            elif isinstance(st, ast.While):
+                self._eval(st.test, env)
+                self._walk(st.body, env)
+                self._walk(st.orelse, env)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    value = self._eval(item.context_expr, env)
+                    if item.optional_vars is not None:
+                        self._bind_target(item.optional_vars, value, env)
+                if self._walk(st.body, env):
+                    return True
+            elif isinstance(st, ast.Try):
+                self._walk(st.body, env)
+                for handler in st.handlers:
+                    self._walk(handler.body, env)
+                self._walk(st.orelse, env)
+                self._walk(st.finalbody, env)
+            elif isinstance(st, ast.Assert):
+                self._eval(st.test, env)
+            elif isinstance(st, (ast.Delete, ast.Global, ast.Nonlocal)):
+                pass
+            elif isinstance(st, (ast.Break, ast.Continue, ast.Pass)):
+                pass
+            else:  # Import, Match, ... — evaluate nothing
+                pass
+        return False
+
+    def _bind_target(
+        self,
+        target: ast.AST,
+        value: AbstractValue,
+        env: Dict[str, AbstractValue],
+    ) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = value
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            elts = value.elts
+            for i, sub in enumerate(target.elts):
+                if elts is not None and i < len(elts):
+                    self._bind_target(sub, elts[i], env)
+                else:
+                    self._bind_target(
+                        sub, AbstractValue(prov=value.prov, pure=value.pure), env
+                    )
+        elif isinstance(target, ast.Starred):
+            self._bind_target(target.value, value, env)
+        # Attribute / Subscript stores don't enter the local env.
+
+    def _assign(self, st: ast.Assign, env: Dict[str, AbstractValue]) -> None:
+        if len(st.targets) == 1 and isinstance(st.targets[0], ast.Attribute):
+            target = st.targets[0]
+            dotted = dotted_name(target)
+            if dotted and self._contains_attr(st.value, dotted):
+                self._check_rmw(
+                    st, dotted, None, st.value, env, symbol=dotted
+                )
+                return
+        value = self._eval(st.value, env)
+        for target in st.targets:
+            self._bind_target(target, value, env)
+
+    def _aug_assign(self, st: ast.AugAssign, env: Dict[str, AbstractValue]) -> None:
+        if isinstance(st.target, ast.Name):
+            old = env.get(st.target.id, _TOP)
+            rhs = self._eval(st.value, env)
+            env[st.target.id] = self._binop_value(st.op, old, rhs)
+            return
+        if isinstance(st.target, ast.Attribute):
+            dotted = dotted_name(st.target)
+            if dotted:
+                prev = self._ident_attr
+                self._ident_attr = dotted
+                try:
+                    rhs = self._eval(st.value, env)
+                    ident = AbstractValue(num="ident")
+                    new = self._binop_value(st.op, ident, rhs)
+                finally:
+                    self._ident_attr = prev
+                self._verdict_rmw(st, dotted, new)
+                return
+        self._eval(st.value, env)
+
+    # ------------------------------------------------ read-modify-write
+    def _contains_attr(self, expr: ast.AST, dotted: str) -> bool:
+        return any(
+            isinstance(n, ast.Attribute)
+            and isinstance(n.ctx, ast.Load)
+            and dotted_name(n) == dotted
+            for n in ast.walk(expr)
+        )
+
+    @staticmethod
+    def _getattr_pattern(call: ast.Call) -> Optional[Tuple[str, str]]:
+        """(dotted obj, dumped name expr) for a 2/3-arg ``getattr``."""
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id == "getattr"
+            and len(call.args) >= 2
+        ):
+            obj = dotted_name(call.args[0])
+            if obj:
+                return obj, ast.dump(call.args[1])
+        return None
+
+    def _check_rmw(
+        self,
+        st: ast.stmt,
+        ident_attr: Optional[str],
+        ident_pair: Optional[Tuple[str, str]],
+        rhs: ast.AST,
+        env: Dict[str, AbstractValue],
+        symbol: str,
+    ) -> None:
+        prev_attr, prev_pair = self._ident_attr, self._ident_pair
+        self._ident_attr, self._ident_pair = ident_attr, ident_pair
+        try:
+            value = self._eval(rhs, env)
+        finally:
+            self._ident_attr, self._ident_pair = prev_attr, prev_pair
+        self._verdict_rmw(st, symbol, value)
+
+    def _verdict_rmw(
+        self, st: ast.stmt, symbol: str, value: AbstractValue
+    ) -> None:
+        if not value.pure:
+            return  # routed through a call — the callee owns the proof
+        if value.num != "ident":
+            self.summary.nonneutral_writes.append(
+                NonNeutralWrite(
+                    node=st,
+                    symbol=symbol,
+                    detail=value.num,
+                )
+            )
+
+    def _eval_stmt_call(
+        self, expr: ast.AST, env: Dict[str, AbstractValue]
+    ) -> None:
+        """A bare expression statement: check the setattr-RMW form, else
+        evaluate normally (reductions inside still get checked)."""
+        if (
+            isinstance(expr, ast.Call)
+            and isinstance(expr.func, ast.Name)
+            and expr.func.id == "setattr"
+            and len(expr.args) == 3
+        ):
+            obj = dotted_name(expr.args[0])
+            name_dump = ast.dump(expr.args[1])
+            rhs = expr.args[2]
+            if obj is not None:
+                matches = any(
+                    isinstance(n, ast.Call)
+                    and self._getattr_pattern(n) == (obj, name_dump)
+                    for n in ast.walk(rhs)
+                )
+                if matches:
+                    label = (
+                        expr.args[1].id
+                        if isinstance(expr.args[1], ast.Name)
+                        else _operand_desc(expr.args[1])
+                    )
+                    self._check_rmw(
+                        expr,
+                        None,
+                        (obj, name_dump),
+                        rhs,
+                        env,
+                        symbol=f"{obj}.<{label}>",
+                    )
+                    return
+        self._eval(expr, env)
+
+    # ------------------------------------------------------ expressions
+    def _truth(
+        self, test: ast.AST, env: Dict[str, AbstractValue]
+    ) -> Optional[bool]:
+        """Resolve a branch condition under the mask-present
+        specialization, or None when unknown."""
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            inner = self._truth(test.operand, env)
+            return None if inner is None else not inner
+        if isinstance(test, ast.Constant):
+            return bool(test.value)
+        if (
+            isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.IsNot))
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None
+            and isinstance(test.left, ast.Name)
+        ):
+            name = test.left.id
+            if name in self.mask_names:
+                is_none = False
+            else:
+                value = env.get(name)
+                if value is None or value.num != "none":
+                    return None
+                is_none = True
+            return is_none if isinstance(test.ops[0], ast.Is) else not is_none
+        return None
+
+    def _eval(self, node: ast.AST, env: Dict[str, AbstractValue]) -> AbstractValue:
+        if isinstance(node, ast.Name):
+            if node.id in self.mask_names:
+                return AbstractValue(
+                    prov=frozenset({"mask"}), num="zero", dt="i32"
+                )
+            return env.get(node.id, _TOP)
+        if isinstance(node, ast.Constant):
+            value = node.value
+            if value is None:
+                return AbstractValue(num="none")
+            if value is True:
+                return AbstractValue(num="true", dt="b")
+            if value is False:
+                return AbstractValue(num="false", dt="b")
+            if isinstance(value, (int, float)):
+                num = (
+                    "zero"
+                    if value == 0
+                    else "one"
+                    if value == 1
+                    else "const"
+                )
+                return AbstractValue(
+                    num=num, dt="wi" if isinstance(value, int) else "wf"
+                )
+            return AbstractValue(num="const")
+        if isinstance(node, ast.Attribute):
+            if self._ident_attr and dotted_name(node) == self._ident_attr:
+                return AbstractValue(num="ident")
+            dotted = dotted_name(node)
+            if dotted in _DTYPE_CHAINS:
+                return AbstractValue(num="const")
+            base = self._eval(node.value, env)
+            if node.attr in ("shape", "size", "ndim", "dtype"):
+                # Array metadata: static under jit, never pad-dependent.
+                return AbstractValue(num="const", pure=base.pure)
+            # Attribute reads (self._decay, obj.field) are trace-time
+            # constants from the neutrality proof's viewpoint.
+            return AbstractValue(prov=base.prov, num="const", pure=base.pure)
+        if isinstance(node, ast.BinOp):
+            left = self._eval(node.left, env)
+            right = self._eval(node.right, env)
+            return self._binop_node(node, left, right)
+        if isinstance(node, ast.UnaryOp):
+            operand = self._eval(node.operand, env)
+            if isinstance(node.op, ast.USub) and operand.num in (
+                "zero",
+                "const",
+            ):
+                return operand.with_(num=operand.num)
+            return AbstractValue(
+                prov=operand.prov, dt=operand.dt, pure=operand.pure
+            )
+        if isinstance(node, ast.BoolOp):
+            values = [self._eval(v, env) for v in node.values]
+            out = values[0]
+            for v in values[1:]:
+                out = _av_join(out, v)
+            return out
+        if isinstance(node, ast.Compare):
+            left = self._eval(node.left, env)
+            rights = [self._eval(c, env) for c in node.comparators]
+            prov = left.prov
+            pure = left.pure
+            for r in rights:
+                prov |= r.prov
+                pure = pure and r.pure
+            num = "top"
+            if (
+                len(node.ops) == 1
+                and left.num == "zero"
+                and isinstance(node.comparators[0], ast.Constant)
+                and node.comparators[0].value == 0
+            ):
+                op = node.ops[0]
+                if isinstance(op, (ast.Gt, ast.NotEq, ast.Lt)):
+                    num = "false"
+                elif isinstance(op, (ast.GtE, ast.LtE, ast.Eq)):
+                    num = "true"
+            return AbstractValue(prov=prov, num=num, dt="b", pure=pure)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, env)
+        if isinstance(node, ast.IfExp):
+            truth = self._truth(node.test, env)
+            if truth is True:
+                return self._eval(node.body, env)
+            if truth is False:
+                return self._eval(node.orelse, env)
+            self._eval(node.test, env)
+            return _av_join(
+                self._eval(node.body, env), self._eval(node.orelse, env)
+            )
+        if isinstance(node, ast.Subscript):
+            base = self._eval(node.value, env)
+            self._eval(node.slice, env)
+            return AbstractValue(prov=base.prov, dt=base.dt, pure=base.pure)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            elts = tuple(self._eval(e, env) for e in node.elts)
+            prov = frozenset().union(*(e.prov for e in elts)) if elts else frozenset()
+            pure = all(e.pure for e in elts)
+            return AbstractValue(prov=prov, pure=pure, elts=elts)
+        if isinstance(node, (ast.Set, ast.Dict)):
+            prov: frozenset = frozenset()
+            pure = True
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    v = self._eval(child, env)
+                    prov |= v.prov
+                    pure = pure and v.pure
+            return AbstractValue(prov=prov, pure=pure)
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            comp_env = dict(env)
+            for gen in node.generators:
+                iter_value = self._eval(gen.iter, comp_env)
+                self._bind_target(
+                    gen.target,
+                    AbstractValue(prov=iter_value.prov, pure=iter_value.pure),
+                    comp_env,
+                )
+                for cond in gen.ifs:
+                    self._eval(cond, comp_env)
+            if isinstance(node, ast.DictComp):
+                key = self._eval(node.key, comp_env)
+                value = self._eval(node.value, comp_env)
+                out = _av_join(key, value)
+            else:
+                out = self._eval(node.elt, comp_env)
+            return AbstractValue(prov=out.prov, pure=out.pure)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self._eval(v.value, env)
+            return AbstractValue(num="const")
+        if isinstance(node, ast.Starred):
+            return self._eval(node.value, env)
+        if isinstance(node, ast.Lambda):
+            return _TOP
+        if isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    self._eval(part, env)
+            return AbstractValue(num="const")
+        return _TOP
+
+    def _binop_node(
+        self, node: ast.BinOp, left: AbstractValue, right: AbstractValue
+    ) -> AbstractValue:
+        prov = left.prov | right.prov
+        pure = left.pure and right.pure
+        dt = _dt_promote(left.dt, right.dt)
+        if isinstance(node.op, ast.Mult):
+            num = _num_mul(left.num, right.num)
+            # The int-state hazard: state (IDENT) scaled by a
+            # float-typed factor.  Whether it matters depends on the
+            # owning class's sanctioned cast — the rule decides.
+            factor = right if left.num == "ident" else left
+            if "ident" in (left.num, right.num) and factor.dt in _FLOAT_DTS:
+                symbol = self._ident_attr or (
+                    self._ident_pair[0] if self._ident_pair else "<state>"
+                )
+                self.summary.float_state_mults.append(
+                    FloatStateMult(node=node, symbol=symbol)
+                )
+        elif isinstance(node.op, ast.Add):
+            num = _num_add(left.num, right.num)
+        elif isinstance(node.op, ast.Sub):
+            if right.num == "zero":
+                num = left.num
+            elif left.num == right.num == "const":
+                num = "const"
+            else:
+                num = "top"
+        elif isinstance(node.op, (ast.Div, ast.FloorDiv)):
+            num = left.num if right.num == "one" else "top"
+            if isinstance(node.op, ast.Div):
+                dt = _dt_promote(dt, "wf")
+        else:
+            num = "top"
+        return AbstractValue(prov=prov, num=num, dt=dt, pure=pure)
+
+    def _binop_value(
+        self, op: ast.operator, left: AbstractValue, right: AbstractValue
+    ) -> AbstractValue:
+        shim = ast.BinOp(left=ast.Constant(0), op=op, right=ast.Constant(0))
+        return self._binop_node(shim, left, right)
+
+    # ------------------------------------------------------------ calls
+    def _axis_exempts(self, call: ast.Call, method: bool) -> bool:
+        """True when the reduction has an explicit constant axis that is
+        not the leading (batch) axis — per-row reductions (``axis=1`` /
+        ``axis=-1``) don't collapse padded rows into live ones."""
+        axis: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg == "axis":
+                axis = kw.value
+        if axis is None:
+            pos = 0 if method else 1
+            if len(call.args) > pos:
+                axis = call.args[pos]
+        if axis is None:
+            return False
+        if isinstance(axis, ast.Constant):
+            return axis.value is not None and axis.value != 0
+        if isinstance(axis, ast.UnaryOp) and isinstance(axis.op, ast.USub):
+            inner = axis.operand
+            return isinstance(inner, ast.Constant)  # axis=-k, k>=1
+        if isinstance(axis, (ast.Tuple, ast.List)):
+            return all(
+                isinstance(e, ast.Constant) and e.value != 0
+                for e in axis.elts
+            )
+        return False
+
+    def _record_reduction(
+        self,
+        call: ast.Call,
+        reducer: str,
+        operand_node: ast.AST,
+        operand: AbstractValue,
+    ) -> None:
+        if id(call) in self._seen_reductions:
+            return
+        if "raw" in operand.prov and "mask" not in operand.prov:
+            self._seen_reductions.add(id(call))
+            self.summary.raw_reductions.append(
+                RawReduction(
+                    node=call,
+                    symbol=f"{reducer}({_operand_desc(operand_node)})",
+                    operand=_operand_desc(operand_node),
+                )
+            )
+
+    def _eval_call(
+        self, call: ast.Call, env: Dict[str, AbstractValue]
+    ) -> AbstractValue:
+        func = call.func
+        dotted = dotted_name(func) or ""
+
+        # getattr-of-the-state (the setattr RMW pattern's read side).
+        if isinstance(func, ast.Name) and func.id == "getattr":
+            pattern = self._getattr_pattern(call)
+            if pattern is not None and pattern == self._ident_pair:
+                return AbstractValue(num="ident")
+            args = [self._eval(a, env) for a in call.args]
+            prov = args[0].prov if args else frozenset()
+            return AbstractValue(prov=prov)
+
+        # kwargs.get("mask") / kwargs.pop("mask", default).
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("get", "pop")
+            and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and call.args[0].value in MASK_PARAM_NAMES
+        ):
+            return AbstractValue(prov=frozenset({"mask"}), num="zero", dt="i32")
+
+        # where: the one gate the neutrality proof resolves exactly.
+        if dotted in _WHERE_CHAINS and len(call.args) == 3:
+            cond = self._eval(call.args[0], env)
+            a = self._eval(call.args[1], env)
+            b = self._eval(call.args[2], env)
+            if cond.num == "false":
+                return b.with_(prov=b.prov | cond.prov)
+            if cond.num == "true":
+                return a.with_(prov=a.prov | cond.prov)
+            joined = _av_join(a, b)
+            return joined.with_(prov=joined.prov | cond.prov)
+
+        # Literal dtype casts: jnp.float32(x) and friends.
+        if dotted in _DTYPE_CHAINS and len(call.args) == 1:
+            arg = self._eval(call.args[0], env)
+            return arg.with_(dt=_DTYPE_CHAINS[dotted])
+
+        # astype: retag dtype, keep provenance/numeric value.
+        if isinstance(func, ast.Attribute) and func.attr == "astype":
+            base = self._eval(func.value, env)
+            dt = None
+            if call.args:
+                dt_node = call.args[0]
+                dt = _DTYPE_CHAINS.get(dotted_name(dt_node) or "")
+                if (
+                    dt is None
+                    and isinstance(dt_node, ast.Constant)
+                    and isinstance(dt_node.value, str)
+                ):
+                    dt = _DTYPE_STRINGS.get(dt_node.value)
+                self._eval(dt_node, env)
+            return base.with_(dt=dt)
+
+        # Transparent shape/array ops.
+        if dotted in _TRANSPARENT_CALLS and call.args:
+            base = self._eval(call.args[0], env)
+            for extra in call.args[1:]:
+                self._eval(extra, env)
+            dt = base.dt
+            for kw in call.keywords:
+                value = self._eval(kw.value, env)
+                if kw.arg == "dtype":
+                    dt = _DTYPE_CHAINS.get(dotted_name(kw.value) or "") or (
+                        _DTYPE_STRINGS.get(kw.value.value)
+                        if isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)
+                        else None
+                    )
+            return base.with_(dt=dt)
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _TRANSPARENT_METHODS
+        ):
+            base = self._eval(func.value, env)
+            for a in call.args:
+                self._eval(a, env)
+            for kw in call.keywords:
+                self._eval(kw.value, env)
+            return base
+
+        # zeros/ones builders.
+        if dotted in ("jnp.zeros", "np.zeros", "jnp.zeros_like", "np.zeros_like"):
+            for a in call.args:
+                self._eval(a, env)
+            return AbstractValue(num="zero")
+        if dotted in ("jnp.ones", "np.ones", "jnp.ones_like", "np.ones_like"):
+            for a in call.args:
+                self._eval(a, env)
+            return AbstractValue(num="one")
+
+        # Full reductions — the TPU010 check sites.
+        if isinstance(func, ast.Attribute) and func.attr in _REDUCER_NAMES:
+            head = func.value
+            head_dotted = dotted_name(head) or ""
+            module_form = head_dotted in (
+                "jnp",
+                "np",
+                "jax.numpy",
+                "numpy",
+                "math",
+                "jax.lax",
+                "lax",
+            )
+            if module_form:
+                if not call.args:
+                    return _TOP
+                operand_node = call.args[0]
+                operand = self._eval(operand_node, env)
+                for extra in call.args[1:]:
+                    self._eval(extra, env)
+                for kw in call.keywords:
+                    self._eval(kw.value, env)
+                if not self._axis_exempts(call, method=False):
+                    self._record_reduction(call, func.attr, operand_node, operand)
+            else:
+                operand_node = head
+                operand = self._eval(head, env)
+                for a in call.args:
+                    self._eval(a, env)
+                for kw in call.keywords:
+                    self._eval(kw.value, env)
+                if not self._axis_exempts(call, method=True):
+                    self._record_reduction(call, func.attr, operand_node, operand)
+            num = operand.num
+            if num == "zero" and func.attr in ("any", "all"):
+                num = "false"
+            elif num not in ("zero",):
+                num = "top"
+            return AbstractValue(
+                prov=operand.prov, num=num, dt=operand.dt, pure=operand.pure
+            )
+
+        # Segment reductions / scatter-adds.
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _SEGMENT_REDUCERS
+            and call.args
+        ):
+            operand_node = call.args[0]
+            operand = self._eval(operand_node, env)
+            for extra in call.args[1:]:
+                self._eval(extra, env)
+            for kw in call.keywords:
+                self._eval(kw.value, env)
+            self._record_reduction(call, func.attr, operand_node, operand)
+            return AbstractValue(
+                prov=operand.prov, num=operand.num, dt=operand.dt,
+                pure=operand.pure,
+            )
+        # x.at[idx].add(v) — scatter-accumulate into state.
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in ("add", "max", "min")
+            and isinstance(func.value, ast.Subscript)
+            and isinstance(func.value.value, ast.Attribute)
+            and func.value.value.attr == "at"
+            and call.args
+        ):
+            base = self._eval(func.value.value.value, env)
+            self._eval(func.value.slice, env)
+            operand_node = call.args[0]
+            operand = self._eval(operand_node, env)
+            self._record_reduction(call, f"at.{func.attr}", operand_node, operand)
+            num = base.num if operand.num == "zero" else "top"
+            return AbstractValue(
+                prov=base.prov | operand.prov, num=num,
+                pure=base.pure and operand.pure,
+            )
+
+        # A call to a function nested in this one: union the arguments
+        # with the free names its body reads (closure capture).
+        if isinstance(func, ast.Name) and func.id in self.nested:
+            prov: frozenset = frozenset()
+            for a in call.args:
+                prov |= self._eval(a, env).prov
+            for kw in call.keywords:
+                prov |= self._eval(kw.value, env).prov
+            nested = self.nested[func.id]
+            for n in ast.walk(nested):
+                if (
+                    isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and (n.id in env or n.id in self.mask_names)
+                ):
+                    prov |= self._eval(
+                        ast.copy_location(ast.Name(id=n.id, ctx=ast.Load()), n),
+                        env,
+                    ).prov
+            return AbstractValue(prov=prov, pure=False)
+
+        # Anything else: opaque.  Union the argument provenances (a
+        # callee handed the mask is presumed to thread it) and drop
+        # purity so RMW verdicts defer to the callee.
+        prov = frozenset()
+        pure = isinstance(func, ast.Name) and func.id in _PURE_BUILTINS
+        for a in call.args:
+            v = self._eval(a, env)
+            prov |= v.prov
+        for kw in call.keywords:
+            v = self._eval(kw.value, env)
+            prov |= v.prov
+        if isinstance(func, (ast.Attribute, ast.Subscript, ast.Call)):
+            v = self._eval(func, env)
+            prov |= v.prov
+        return AbstractValue(prov=prov, pure=pure)
+
+
+def analyze_mask_dataflow(func: ast.AST) -> Optional[DataflowSummary]:
+    """Run the mask-present abstract walk over ``func``; None when the
+    function is not mask-accepting (no mask to drop → no discipline to
+    check)."""
+    names = mask_param_names(func) | kwargs_mask_locals(func)
+    if not names:
+        return None
+    return _MaskInterp(func, names).run()
+
+
+_DATAFLOW_CACHE: List[Tuple[Module, List[DataflowSummary]]] = []
+
+
+def module_dataflow(mod: Module) -> List[DataflowSummary]:
+    """Dataflow summaries for every mask-accepting function in ``mod``,
+    memoized per module object so the three dataflow rules share one
+    walk.  The cache entry holds the Module itself (not its id): a
+    strong reference pins the object, so identity cannot be recycled
+    onto a different module between rule runs."""
+    for k, cached in _DATAFLOW_CACHE:
+        if k is mod:
+            return cached
+    out: List[DataflowSummary] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, _FuncDefT):
+            summary = analyze_mask_dataflow(node)
+            if summary is not None:
+                out.append(summary)
+    _DATAFLOW_CACHE.append((mod, out))
+    del _DATAFLOW_CACHE[:-16]
+    return out
+
+
+# Float64-widening spellings (TPU012's other prong): literal float64
+# casts or dtype arguments inside traced regions.
+_F64_CHAINS = frozenset(
+    {"jnp.float64", "np.float64", "jax.numpy.float64", "numpy.float64"}
+)
+
+
+def find_float64_widening(func: ast.AST) -> List[Tuple[ast.AST, str]]:
+    """(node, spelled) for every literal float64 widening in ``func``:
+    ``jnp.float64(x)`` calls, ``.astype(float64)``, and
+    ``dtype=float64`` keywords (dotted or string spelling)."""
+    out: List[Tuple[ast.AST, str]] = []
+
+    def is_f64(node: ast.AST) -> Optional[str]:
+        spelled = dotted_name(node)
+        if spelled in _F64_CHAINS:
+            return spelled
+        if isinstance(node, ast.Constant) and node.value in (
+            "float64",
+            "double",
+        ):
+            return repr(node.value)
+        return None
+
+    for node in ast.walk(func):
+        if not isinstance(node, ast.Call):
+            continue
+        spelled = dotted_name(node.func)
+        if spelled in _F64_CHAINS:
+            out.append((node, spelled))
+            continue
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"
+            and node.args
+        ):
+            hit = is_f64(node.args[0])
+            if hit:
+                out.append((node, f"astype({hit})"))
+                continue
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                hit = is_f64(kw.value)
+                if hit:
+                    out.append((node, f"dtype={hit}"))
+    return out

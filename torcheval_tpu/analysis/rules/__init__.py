@@ -4,10 +4,14 @@ with the central registry (``_core.all_rules`` does this lazily)."""
 from . import (  # noqa: F401
     check_then_act,
     donation,
+    dtype_stability,
+    flag_registry,
     hook_guard,
     layer_order,
     lock_discipline,
     lock_order,
+    mask_discipline,
+    pad_neutrality,
     thread_lifecycle,
     traced,
 )
@@ -15,10 +19,14 @@ from . import (  # noqa: F401
 __all__ = [
     "check_then_act",
     "donation",
+    "dtype_stability",
+    "flag_registry",
     "hook_guard",
     "layer_order",
     "lock_discipline",
     "lock_order",
+    "mask_discipline",
+    "pad_neutrality",
     "thread_lifecycle",
     "traced",
 ]

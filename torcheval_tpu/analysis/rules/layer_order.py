@@ -48,6 +48,7 @@ LAYER_NAMES = (
 _EXACT: Dict[str, int] = {
     "torcheval_tpu": 6,
     "torcheval_tpu.version": 0,
+    "torcheval_tpu._flags": 0,
     "torcheval_tpu._stats": 0,
     "torcheval_tpu.distributed": 0,
     "torcheval_tpu.routing": 2,

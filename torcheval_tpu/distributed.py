@@ -27,7 +27,6 @@ path (``psum`` inside ``shard_map``) that never touches this byte layer; see
 
 from __future__ import annotations
 
-import os
 import pickle
 import threading
 import time
@@ -36,6 +35,7 @@ from typing import Any, Callable, List, Optional
 
 import numpy as np
 
+from torcheval_tpu import _flags
 from torcheval_tpu.telemetry import events as _telemetry
 
 # Peer-payload wait budget for the KV-store gather (first compiles and big
@@ -43,7 +43,7 @@ from torcheval_tpu.telemetry import events as _telemetry
 # Override per deployment with TORCHEVAL_TPU_KV_TIMEOUT_MS, or wrap the
 # group in torcheval_tpu.resilience.ResilientGroup for per-call retry +
 # deadline policy on top of this per-RPC budget.
-_KV_TIMEOUT_MS_DEFAULT = 600_000
+_KV_TIMEOUT_MS_DEFAULT = _flags.FLAGS["KV_TIMEOUT_MS"].default
 
 # Guards the KV-collective generation counter: the fleet-merge worker and
 # the main loop can both issue object collectives, and a duplicated
@@ -55,23 +55,10 @@ def kv_timeout_ms() -> int:
     """The per-RPC wait budget (ms) for KV-store collectives: the value
     of ``TORCHEVAL_TPU_KV_TIMEOUT_MS`` when set (a positive integer —
     anything else raises so a typo'd deployment fails loudly instead of
-    silently waiting ten minutes), else :data:`_KV_TIMEOUT_MS_DEFAULT`."""
-    raw = os.environ.get("TORCHEVAL_TPU_KV_TIMEOUT_MS", "").strip()
-    if not raw:
-        return _KV_TIMEOUT_MS_DEFAULT
-    try:
-        value = int(raw)
-    except ValueError:
-        raise ValueError(
-            "TORCHEVAL_TPU_KV_TIMEOUT_MS must be a positive integer "
-            f"(milliseconds), got {raw!r}"
-        ) from None
-    if value <= 0:
-        raise ValueError(
-            "TORCHEVAL_TPU_KV_TIMEOUT_MS must be a positive integer "
-            f"(milliseconds), got {raw!r}"
-        )
-    return value
+    silently waiting ten minutes), else :data:`_KV_TIMEOUT_MS_DEFAULT`.
+    Read at call time through the typed registry, which owns the
+    positive-integer rejection policy."""
+    return _flags.get("KV_TIMEOUT_MS")
 
 
 class PeerTimeoutError(TimeoutError):

@@ -15,7 +15,6 @@ one round trip for validation instead of one per bound (the previous
 1000-class confusion-matrix update and dominated the benchmark end-to-end).
 """
 
-import os
 from contextlib import contextmanager
 from contextvars import ContextVar
 from typing import Optional, Sequence, Tuple
@@ -24,12 +23,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torcheval_tpu import _flags
+
 # Even one fused validation round trip costs a full host sync — ~10 µs on
 # a PCIe host, tens of ms through a tunneled backend, where it can
 # dominate µs-scale update kernels.  Both switches put the update path in
 # the same skip-value-checks mode it already runs in under jit tracing.
 _SKIP_CHECKS: ContextVar = ContextVar("torcheval_tpu_skip_value_checks", default=False)
-_TRUTHY = ("1", "true", "yes", "on")
 
 
 @contextmanager
@@ -58,10 +58,7 @@ def value_checks_enabled() -> bool:
     guards key on :func:`all_concrete` alone."""
     if _SKIP_CHECKS.get():
         return False
-    return (
-        os.environ.get("TORCHEVAL_TPU_SKIP_VALUE_CHECKS", "").lower()
-        not in _TRUTHY
-    )
+    return not _flags.get("SKIP_VALUE_CHECKS")
 
 
 def all_concrete(*arrays) -> bool:

@@ -1,10 +1,18 @@
-"""Shared dispatch flags for the native-kernel routes."""
+"""Shared dispatch flags for the native-kernel routes.
 
-import os
+All environment reads go through the typed registry
+(:mod:`torcheval_tpu._flags`); this module keeps the call-time accessors
+the dispatch sites use, plus the one backend-dependent default the
+registry cannot own (``DONATE`` unset consults the JAX backend, and the
+registry is importable without JAX).
+"""
+
 import sys
 
-_TRUTHY = ("1", "true", "yes", "on")
-_FALSY = ("0", "false", "no", "off")
+from torcheval_tpu import _flags
+
+_TRUTHY = _flags.TRUTHY
+_FALSY = _flags.FALSY
 
 
 def pallas_disabled() -> bool:
@@ -12,18 +20,14 @@ def pallas_disabled() -> bool:
     kill-switch forcing every kernel dispatch back to the pure-XLA
     formulation (read at call time, so harnesses may toggle it after
     import)."""
-    return (
-        os.environ.get("TORCHEVAL_TPU_DISABLE_PALLAS", "").lower() in _TRUTHY
-    )
+    return _flags.get("DISABLE_PALLAS")
 
 
 def ustat_disabled() -> bool:
     """True when ``TORCHEVAL_TPU_DISABLE_USTAT`` is set truthy — a
     narrower kill-switch for just the rank-sum (ustat) fast paths, leaving
     the other Pallas kernels live.  Read at call time like the rest."""
-    return (
-        os.environ.get("TORCHEVAL_TPU_DISABLE_USTAT", "").lower() in _TRUTHY
-    )
+    return _flags.get("DISABLE_USTAT")
 
 
 def donation_enabled() -> bool:
@@ -38,11 +42,9 @@ def donation_enabled() -> bool:
     semantically invisible (``metrics/metric.py``) are unconditional, so
     toggling mid-lifecycle is safe.
     """
-    raw = os.environ.get("TORCHEVAL_TPU_DONATE", "").lower()
-    if raw in _TRUTHY:
-        return True
-    if raw in _FALSY:
-        return False
+    forced = _flags.get("DONATE")
+    if forced is not None:
+        return forced
     import jax
 
     try:
@@ -61,7 +63,7 @@ def configure_persistent_cache() -> "str | None":
     user process paid cold compiles (~15 s/program through a remote
     compiler).  ``TORCHEVAL_TPU_CACHE_MIN_COMPILE_SECS`` tunes the
     write threshold (default 0.5 s, matching bench.py)."""
-    path = os.environ.get("TORCHEVAL_TPU_CACHE_DIR")
+    path = _flags.get("CACHE_DIR")
     if not path:
         return None
     try:
@@ -70,7 +72,7 @@ def configure_persistent_cache() -> "str | None":
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs",
-            float(os.environ.get("TORCHEVAL_TPU_CACHE_MIN_COMPILE_SECS", "0.5")),
+            _flags.get("CACHE_MIN_COMPILE_SECS"),
         )
         return path
     except Exception as exc:  # pragma: no cover - cache is best-effort

@@ -57,13 +57,13 @@ list of them; keys mirror the :class:`FaultRule` fields).
 
 from __future__ import annotations
 
-import json
-import os
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
+
+from torcheval_tpu import _flags
 
 # The one-branch guard flag.  True exactly while a plan is installed.
 ENABLED: bool = False
@@ -278,15 +278,9 @@ def install_from_env() -> Optional[FaultPlan]:
     object or a list of them; an object may carry a ``seed`` key when
     wrapped as ``{"seed": n, "rules": [...]}``).  Returns the installed
     plan, or None when the variable is unset/empty."""
-    raw = os.environ.get("TORCHEVAL_TPU_FAULT_PLAN", "").strip()
-    if not raw:
+    spec = _flags.get("FAULT_PLAN")
+    if spec is None:
         return None
-    try:
-        spec = json.loads(raw)
-    except json.JSONDecodeError as exc:
-        raise ValueError(
-            f"TORCHEVAL_TPU_FAULT_PLAN is not valid JSON: {exc}"
-        ) from exc
     seed = 0
     if isinstance(spec, dict) and "rules" in spec:
         seed = int(spec.get("seed", 0))

@@ -220,8 +220,14 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         for (name, phase), entry in agg["spans"].items()
     }
 
+    from torcheval_tpu import _flags as _flag_registry
+
     result: Dict[str, Any] = {
         "enabled": events.ENABLED,
+        # Every TORCHEVAL_TPU_* flag currently set away from its default
+        # (typed-registry snapshot) — a report from a deployment records
+        # which knobs shaped the numbers it carries.
+        "flags": _flag_registry.snapshot_non_default(),
         "trace_counts": trace_counts(),
         "spmd_cache": {
             "hits": info.hits,

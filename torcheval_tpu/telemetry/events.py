@@ -37,16 +37,17 @@ exact even after the ring has wrapped.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field, fields
 from typing import Any, Dict, List, Optional, Tuple
 
-_TRUTHY = ("1", "true", "yes", "on")
+from torcheval_tpu import _flags
 
-DEFAULT_CAPACITY = 4096
+_TRUTHY = _flags.TRUTHY
+
+DEFAULT_CAPACITY = _flags.FLAGS["TELEMETRY_CAPACITY"].default
 
 # Fixed histogram bucket bounds (seconds) for sync / span durations —
 # Prometheus ``le`` convention, +Inf implicit.
@@ -56,25 +57,16 @@ DURATION_BUCKETS: Tuple[float, ...] = (
 
 
 def _env_capacity() -> int:
-    raw = os.environ.get("TORCHEVAL_TPU_TELEMETRY_CAPACITY", "")
-    try:
-        n = int(raw)
-        return n if n > 0 else DEFAULT_CAPACITY
-    except ValueError:
-        return DEFAULT_CAPACITY
+    return _flags.get("TELEMETRY_CAPACITY")
 
 
 # Module-level flags: the hooks read these as plain attributes.  Both are
 # initialized from the environment at import so ``TORCHEVAL_TPU_TELEMETRY=1
 # python eval.py`` needs no code change.
-ENABLED: bool = (
-    os.environ.get("TORCHEVAL_TPU_TELEMETRY", "").lower() in _TRUTHY
-)
+ENABLED: bool = _flags.get("TELEMETRY")
 # When also truthy, update/compute spans run under
 # ``tools.profiling.annotate`` so they land in TensorBoard/Perfetto traces.
-ANNOTATE: bool = (
-    os.environ.get("TORCHEVAL_TPU_TELEMETRY_ANNOTATE", "").lower() in _TRUTHY
-)
+ANNOTATE: bool = _flags.get("TELEMETRY_ANNOTATE")
 
 _lock = threading.Lock()
 _events: "deque[Event]" = deque(maxlen=_env_capacity())

@@ -671,6 +671,12 @@ def format_report(report: Dict[str, Any]) -> str:
     buf = io.StringIO()
     state = "ENABLED" if report.get("enabled") else "disabled"
     buf.write(f"torcheval_tpu telemetry ({state})\n")
+    flags = report.get("flags", {})
+    if flags:
+        rendered = ", ".join(
+            f"{name}={value!r}" for name, value in sorted(flags.items())
+        )
+        buf.write(f"  flags (non-default): {rendered}\n")
     tc = report.get("trace_counts", {})
     buf.write(
         f"  traces built: {sum(tc.values())} "

@@ -57,21 +57,15 @@ Example::
 
 from __future__ import annotations
 
-import os
 from typing import Any, Dict, List, Optional, Tuple
 
+from torcheval_tpu import _flags
 from torcheval_tpu.telemetry import events as _events
-
-_TRUTHY = ("1", "true", "yes", "on")
 
 # Module-level flags: hook sites read these as plain attributes (the
 # one-branch zero-overhead contract, see events.ENABLED).
-ENABLED: bool = (
-    os.environ.get("TORCHEVAL_TPU_DATA_HEALTH", "").lower() in _TRUTHY
-)
-RAISE_ON_CORRUPT: bool = (
-    os.environ.get("TORCHEVAL_TPU_DATA_HEALTH_RAISE", "").lower() in _TRUTHY
-)
+ENABLED: bool = _flags.get("DATA_HEALTH")
+RAISE_ON_CORRUPT: bool = _flags.get("DATA_HEALTH_RAISE")
 
 # Checks that escalate to DataCorruptionError under raise_on_corrupt.
 # "constant" and "zero_weight" are suspicious, not corrupt — a stuck
@@ -173,9 +167,18 @@ def batch_stats(
                 lo = jnp.min(jnp.where(m > 0, a, big))
                 hi = jnp.max(jnp.where(m > 0, a, -big))
             else:
+                # Maskless branch: when no validity mask was threaded,
+                # the health scan deliberately covers every row — a NaN
+                # in a pad row is still a corrupt input buffer.  The
+                # dataflow walk cannot resolve m's Noneness through the
+                # row_mask_for closure, so each raw reduction carries
+                # its justification inline.
+                # tpulint: disable=TPU010 -- intentional raw-batch NaN scan on the maskless path
                 nan_count = jnp.sum(nan.astype(jnp.int32))
+                # tpulint: disable=TPU010 -- intentional raw-batch Inf scan on the maskless path
                 inf_count = jnp.sum(inf.astype(jnp.int32))
                 valid = jnp.asarray(a.size, jnp.int32)
+                # tpulint: disable=TPU010 -- intentional raw-batch range scan on the maskless path
                 lo, hi = jnp.min(a), jnp.max(a)
             # NaN compares unequal, so a NaN-bearing batch is never
             # "constant"; a single-element batch is trivially not.
@@ -197,8 +200,13 @@ def batch_stats(
                     for _name, nc in bounds
                 )
             else:
+                # Maskless branch: same contract as the float scan
+                # above — out-of-range labels are corrupt wherever they
+                # sit, pad rows included.
+                # tpulint: disable=TPU010 -- intentional raw-batch negative-label scan on the maskless path
                 neg = jnp.sum((a < 0).astype(jnp.int32))
                 ge = tuple(
+                    # tpulint: disable=TPU010 -- intentional raw-batch bound scan on the maskless path
                     jnp.sum((a >= nc).astype(jnp.int32))
                     for _name, nc in bounds
                 )

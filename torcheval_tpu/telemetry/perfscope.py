@@ -77,27 +77,19 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from torcheval_tpu import _flags
 from torcheval_tpu.telemetry import events as _events
-
-_TRUTHY = ("1", "true", "yes", "on")
 
 # Module-level flag: hook sites read this as a plain attribute (the
 # one-branch zero-overhead contract, see events.ENABLED).
-ENABLED: bool = (
-    os.environ.get("TORCHEVAL_TPU_PERFSCOPE", "").lower() in _TRUTHY
-)
+ENABLED: bool = _flags.get("PERFSCOPE")
 
 # How many dispatched Evaluator blocks between SLO evaluations.
-DEFAULT_SLO_EVERY_BLOCKS = 8
+DEFAULT_SLO_EVERY_BLOCKS = _flags.FLAGS["PERFSCOPE_SLO_EVERY"].default
 
 
 def _env_slo_every() -> int:
-    raw = os.environ.get("TORCHEVAL_TPU_PERFSCOPE_SLO_EVERY", "")
-    try:
-        n = int(raw)
-        return n if n > 0 else DEFAULT_SLO_EVERY_BLOCKS
-    except ValueError:
-        return DEFAULT_SLO_EVERY_BLOCKS
+    return _flags.get("PERFSCOPE_SLO_EVERY")
 
 
 SLO_EVERY_BLOCKS: int = _env_slo_every()
