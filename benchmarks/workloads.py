@@ -1360,6 +1360,31 @@ def bench_collection_scan_stream() -> Tuple[str, float, Optional[float]]:
         if not perfscope_was_enabled:
             _perfscope.disable()
 
+    # Tracing + flight-recorder pass: bus on (the tracer stamps events,
+    # the recorder tails them), context propagated across the dispatch
+    # loop and prefetch thread, the bounded tail appended per emit.
+    # Same <=5% acceptance bar — causal capture must be cheap enough to
+    # leave armed in production.
+    from torcheval_tpu.telemetry import flightrec as _flightrec
+    from torcheval_tpu.telemetry import trace as _trace
+
+    trace_was_enabled = _trace.enabled()
+    flightrec_was_enabled = _flightrec.enabled()
+    bus_was_enabled = telemetry.enabled()
+    telemetry.enable()
+    _trace.enable()
+    _flightrec.enable()
+    try:
+        sec_flightrec = _time_steps(step)
+    finally:
+        if not flightrec_was_enabled:
+            _flightrec.disable()
+        if not trace_was_enabled:
+            _trace.disable()
+        telemetry.clear()
+        if not bus_was_enabled:
+            telemetry.disable()
+
     extras = {
         "blocks_per_sec": round(eng["blocks"] / sec, 1),
         "dispatches_per_batch": round(eng["dispatches_per_batch"], 4),
@@ -1372,9 +1397,13 @@ def bench_collection_scan_stream() -> Tuple[str, float, Optional[float]]:
         "perfscope_overhead_pct": round(
             100.0 * (sec_perfscope - sec) / sec, 2
         ),
+        "flightrec_overhead_pct": round(
+            100.0 * (sec_flightrec - sec) / sec, 2
+        ),
         "roofline_note": "ref column is the per-batch fused_update loop "
         "on the same ragged stream; acceptance bar is >=1.5x engine "
-        "speedup and <=5% health-monitor and perfscope overhead",
+        "speedup and <=5% health-monitor, perfscope, and "
+        "trace+flightrec overhead",
     }
     return "collection_scan_stream", ours, ref, extras
 

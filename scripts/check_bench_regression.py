@@ -58,6 +58,7 @@ DEFAULT_THRESHOLD = 0.10
 # overhead-style extras, independent of any baseline.
 EXTRA_BARS = (
     ("collection_sliced_stream", "monitor_overhead_pct", 5.0),
+    ("collection_scan_stream", "flightrec_overhead_pct", 5.0),
     ("fleet_merge_scaling", "sketch_auroc_abs_err", 0.02),
 )
 

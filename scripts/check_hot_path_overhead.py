@@ -1,6 +1,7 @@
 """Zero-overhead guard for the disabled telemetry bus, the disabled
-data-health monitor, the disarmed fault-injection hooks, and the
-disabled perfscope accounting layer.
+data-health monitor, the disarmed fault-injection hooks, the disabled
+perfscope accounting layer, the disabled causal tracer, and the
+disabled flight recorder.
 
 The telemetry contract (``torcheval_tpu/telemetry/events.py``) is that a
 DISABLED bus costs the hot path exactly one module-attribute read and one
@@ -59,6 +60,29 @@ _PERFSCOPE_HOOKS = (
     "evaluate_slo",
     "batch_nbytes",
 )
+
+# Causal-tracing entry points (``torcheval_tpu/telemetry/trace.py``):
+# disabled, no context is captured, adopted, or stamped — every
+# propagation site (engine dispatch, prefetch/retry thread handoffs,
+# fleet-merge rounds) pays one branch on ``trace.ENABLED``.
+_TRACE_HOOKS = (
+    "capture",
+    "adopt",
+    "activate",
+    "span",
+    "current",
+    "push",
+    "pop",
+    "root",
+    "child",
+    "derive",
+    "reparent",
+    "new_span_id",
+)
+
+# Flight recorder (``torcheval_tpu/telemetry/flightrec.py``): disabled,
+# the per-emit tail append and every trigger site stay cold.
+_FLIGHTREC_HOOKS = ("observe", "trigger")
 
 # Live quality monitor (``torcheval_tpu/monitor/quality.py``): the
 # engine's snapshot hook gates ``publish`` on ``telemetry.events.ENABLED``
@@ -185,15 +209,21 @@ def check(verbose: bool = True) -> List[str]:
     from torcheval_tpu.monitor import quality as mq
     from torcheval_tpu.resilience import faults as fl
     from torcheval_tpu.telemetry import events as ev
+    from torcheval_tpu.telemetry import flightrec as fr
     from torcheval_tpu.telemetry import health as hm
     from torcheval_tpu.telemetry import perfscope as ps
+    from torcheval_tpu.telemetry import trace as tr
 
     was_enabled = telemetry.enabled()
     health_was_enabled = hm.enabled()
     perfscope_was_enabled = ps.enabled()
+    trace_was_enabled = tr.enabled()
+    flightrec_was_enabled = fr.enabled()
     telemetry.disable()
     hm.disable()
     ps.disable()
+    tr.disable()
+    fr.disable()
     counter: Dict[str, int] = {}
     names = _hook_names(ev)
     try:
@@ -230,6 +260,26 @@ def check(verbose: bool = True) -> List[str]:
                         ),
                     )
                 )
+            for name in _TRACE_HOOKS:
+                stack.enter_context(
+                    mock.patch.object(
+                        tr,
+                        name,
+                        _counting(
+                            getattr(tr, name), counter, f"trace.{name}"
+                        ),
+                    )
+                )
+            for name in _FLIGHTREC_HOOKS:
+                stack.enter_context(
+                    mock.patch.object(
+                        fr,
+                        name,
+                        _counting(
+                            getattr(fr, name), counter, f"flightrec.{name}"
+                        ),
+                    )
+                )
             for name in _MONITOR_HOOKS:
                 stack.enter_context(
                     mock.patch.object(
@@ -248,6 +298,10 @@ def check(verbose: bool = True) -> List[str]:
             hm.enable()
         if perfscope_was_enabled:
             ps.enable()
+        if trace_was_enabled:
+            tr.enable()
+        if flightrec_was_enabled:
+            fr.enable()
     fired = {k: v for k, v in counter.items() if v}
     if fired:
         raise AssertionError(
@@ -260,6 +314,8 @@ def check(verbose: bool = True) -> List[str]:
             + len(_HEALTH_HOOKS)
             + len(_FAULT_HOOKS)
             + len(_PERFSCOPE_HOOKS)
+            + len(_TRACE_HOOKS)
+            + len(_FLIGHTREC_HOOKS)
             + len(_MONITOR_HOOKS)
         )
         print(
@@ -271,6 +327,8 @@ def check(verbose: bool = True) -> List[str]:
         + [f"health.{n}" for n in _HEALTH_HOOKS]
         + [f"faults.{n}" for n in _FAULT_HOOKS]
         + [f"perfscope.{n}" for n in _PERFSCOPE_HOOKS]
+        + [f"trace.{n}" for n in _TRACE_HOOKS]
+        + [f"flightrec.{n}" for n in _FLIGHTREC_HOOKS]
         + [f"monitor.{n}" for n in _MONITOR_HOOKS]
     )
 
@@ -289,6 +347,8 @@ def static_coverage_check(verbose: bool = True) -> List[str]:
     wrapped.update(f"health.{n}" for n in _HEALTH_HOOKS)
     wrapped.update(f"faults.{n}" for n in _FAULT_HOOKS)
     wrapped.update(f"perfscope.{n}" for n in _PERFSCOPE_HOOKS)
+    wrapped.update(f"trace.{n}" for n in _TRACE_HOOKS)
+    wrapped.update(f"flightrec.{n}" for n in _FLIGHTREC_HOOKS)
     wrapped.update(f"monitor.{n}" for n in _MONITOR_HOOKS)
     discovered = hook_entry_points()
     missing = sorted(set(discovered) - wrapped)

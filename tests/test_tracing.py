@@ -1,0 +1,413 @@
+"""Causal tracing (torcheval_tpu/telemetry/trace.py): context stamping,
+explicit thread handoff, offline forest reconstruction, per-kind drop
+accounting, Perfetto flow events, and the CLI ``--trace`` filter — plus
+the bit-identity proof that tracing OFF leaves event payloads unchanged.
+"""
+
+import io
+import json
+import threading
+import unittest
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.telemetry import events as ev
+from torcheval_tpu.telemetry import export
+from torcheval_tpu.telemetry import trace
+from torcheval_tpu.telemetry.__main__ import main as cli_main
+
+pytestmark = pytest.mark.telemetry
+
+
+class TraceIsolation(unittest.TestCase):
+    """Every test starts and ends with tracing off and a cleared,
+    disabled bus at the default capacity."""
+
+    def setUp(self):
+        self._capacity = ev.capacity()
+        trace.disable()
+        telemetry.disable()
+        telemetry.clear()
+
+    def tearDown(self):
+        ev.enable(capacity=self._capacity)
+        trace.disable()
+        telemetry.disable()
+        telemetry.clear()
+
+
+# ------------------------------------------------------------ bit identity
+class TestTracingOffIsInvisible(TraceIsolation):
+    def test_payloads_carry_no_trace_keys(self):
+        telemetry.enable()
+        ev.record_span("phase", "owner", 0.25, 0)
+        (event,) = telemetry.events_snapshot()
+        payload = export.event_to_dict(event)
+        self.assertEqual(
+            set(payload) & {"trace_id", "span_id", "parent_span_id"},
+            set(),
+            "tracing-off payloads must be byte-identical to pre-trace "
+            f"builds, got {sorted(payload)}",
+        )
+
+    def test_jsonl_round_trip_unchanged(self):
+        telemetry.enable()
+        ev.record_retry("recv", 2, 0.1, "boom")
+        buf = io.StringIO()
+        export.export_jsonl(buf)
+        line = json.loads(buf.getvalue())
+        self.assertNotIn("trace_id", line)
+        buf.seek(0)
+        (loaded,) = export.read_jsonl(buf)
+        self.assertEqual(loaded.span_id, "")
+
+    def test_events_not_stamped_while_disabled(self):
+        telemetry.enable()
+        ctx = trace.root()
+        with trace.activate(ctx):
+            ev.record_span("phase", "owner", 0.0, 0)
+        (event,) = telemetry.events_snapshot()
+        self.assertEqual(event.trace_id, "")
+        self.assertEqual(event.span_id, "")
+
+
+# ---------------------------------------------------------------- stamping
+class TestStamping(TraceIsolation):
+    def test_emit_stamps_active_context(self):
+        telemetry.enable()
+        trace.enable()
+        parent = trace.root()
+        child = trace.child(parent)
+        with trace.activate(child):
+            ev.record_span("phase", "owner", 0.0, 0)
+        (event,) = telemetry.events_snapshot()
+        self.assertEqual(event.trace_id, parent.trace_id)
+        self.assertEqual(event.span_id, child.span_id)
+        self.assertEqual(event.parent_span_id, parent.span_id)
+
+    def test_stamped_fields_survive_jsonl(self):
+        telemetry.enable()
+        trace.enable()
+        with trace.activate(trace.root()):
+            ev.record_span("phase", "owner", 0.0, 0)
+        buf = io.StringIO()
+        export.export_jsonl(buf)
+        buf.seek(0)
+        (loaded,) = export.read_jsonl(buf)
+        (original,) = telemetry.events_snapshot()
+        self.assertEqual(loaded.trace_id, original.trace_id)
+        self.assertEqual(loaded.span_id, original.span_id)
+
+    def test_replayed_events_keep_their_stamps(self):
+        # Re-emitting a stamped event (the __main__ replay path) must
+        # keep the saved ids, not restamp from the replaying context.
+        telemetry.enable()
+        trace.enable()
+        with trace.activate(trace.root()):
+            ev.record_span("phase", "owner", 0.0, 0)
+        (original,) = telemetry.events_snapshot()
+        telemetry.clear()
+        with trace.activate(trace.root()):  # different live context
+            ev.emit(original)
+        (replayed,) = telemetry.events_snapshot()
+        self.assertEqual(replayed.span_id, original.span_id)
+
+    def test_thread_handoff_capture_adopt(self):
+        telemetry.enable()
+        trace.enable()
+        ctx = trace.root()
+        with trace.activate(ctx):
+            captured = trace.capture()
+        seen = {}
+
+        def worker():
+            trace.adopt(captured)
+            ev.record_span("worker", "thread", 0.0, 0)
+            seen["ctx"] = trace.current()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        self.assertEqual(seen["ctx"], ctx)
+        (event,) = telemetry.events_snapshot()
+        self.assertEqual(event.span_id, ctx.span_id)
+
+    def test_adopt_none_is_noop(self):
+        trace.enable()
+        trace.adopt(None)
+        self.assertIsNone(trace.current())
+
+
+# --------------------------------------------------------- engine handoff
+class TestEngineThreadPropagation(TraceIsolation):
+    def test_prefetch_producer_events_join_run_trace(self):
+        from torcheval_tpu.engine import Evaluator
+        from torcheval_tpu.metrics import MetricCollection, MulticlassAccuracy
+
+        telemetry.enable()
+        trace.enable()
+        c = 5
+        rng = np.random.default_rng(0)
+        col = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=c, average="macro")},
+            bucket=True,
+        )
+        stream = [
+            (
+                jnp.asarray(rng.random((b, c), dtype=np.float32)),
+                jnp.asarray(rng.integers(0, c, b).astype(np.int32)),
+            )
+            for b in (9, 17, 33)
+        ]
+        Evaluator(col, block_size=2, prefetch=True).run(stream)
+        dicts = [
+            export.event_to_dict(e) for e in telemetry.events_snapshot()
+        ]
+        stamped = [d for d in dicts if d.get("span_id")]
+        self.assertTrue(stamped, "engine emitted no stamped events")
+        trace_ids = {d["trace_id"] for d in stamped if d.get("trace_id")}
+        self.assertEqual(
+            len(trace_ids), 1, f"expected one run trace, got {trace_ids}"
+        )
+        producer = [
+            d
+            for d in stamped
+            if d.get("thread", "").startswith("torcheval-tpu-prefetch")
+        ]
+        self.assertTrue(producer, "no producer-thread events captured")
+        # One tree: every producer event links under the run trace.
+        roots = trace.build_forest(dicts)
+        self.assertEqual(len(roots), 1)
+
+
+# ------------------------------------------------------------------ forest
+def _mkdict(span, parent, trace_id, seconds, name="n", time_s=0.0):
+    return {
+        "kind": "span",
+        "name": name,
+        "span_id": span,
+        "parent_span_id": parent,
+        "trace_id": trace_id,
+        "seconds": seconds,
+        "time_s": time_s,
+        "thread": "MainThread",
+    }
+
+
+class TestForest(TraceIsolation):
+    def test_build_select_and_critical_path(self):
+        dicts = [
+            _mkdict("a", "", "t1", 0.1, name="root", time_s=1.0),
+            _mkdict("b", "a", "t1", 0.5, name="slow", time_s=2.0),
+            _mkdict("c", "a", "t1", 0.2, name="fast", time_s=3.0),
+            _mkdict("d", "b", "t1", 0.1, name="leaf", time_s=4.0),
+        ]
+        roots = trace.build_forest(dicts)
+        self.assertEqual(len(roots), 1)
+        self.assertEqual(roots[0]["span_id"], "a")
+        selected = trace.select_trace(roots, "t1")
+        self.assertEqual(len(selected), 1)
+        self.assertEqual(trace.select_trace(roots, "nope"), [])
+        path = [n["name"] for n in trace.critical_path(roots[0])]
+        self.assertEqual(path, ["root", "slow", "leaf"])
+
+    def test_missing_parent_gets_placeholder(self):
+        roots = trace.build_forest(
+            [_mkdict("b", "gone", "t1", 0.1, name="orphan")]
+        )
+        self.assertEqual(len(roots), 1)
+        self.assertEqual(roots[0]["kind"], "missing")
+        self.assertEqual(roots[0]["children"][0]["span_id"], "b")
+
+    def test_last_nonempty_parent_wins(self):
+        # The fleet-merge ack reparent: a later record under the same
+        # span overrides the provisional local parent link.
+        dicts = [
+            _mkdict("p", "", "t1", 0.0, name="parent", time_s=1.0),
+            _mkdict("q", "", "t1", 0.0, name="adopted", time_s=2.0),
+            _mkdict("q", "p", "t1", 0.0, name="adopted", time_s=3.0),
+        ]
+        roots = trace.build_forest(dicts)
+        self.assertEqual(len(roots), 1)
+        self.assertEqual(roots[0]["children"][0]["span_id"], "q")
+
+    def test_format_forest_renders(self):
+        roots = trace.build_forest(
+            [
+                _mkdict("a", "", "t1", 0.1, name="root"),
+                _mkdict("b", "a", "t1", 0.2, name="kid"),
+            ]
+        )
+        text = trace.format_forest(roots)
+        self.assertIn("trace t1", text)
+        self.assertIn("root", text)
+        self.assertIn("span=b", text)
+
+
+# ------------------------------------------------------- per-kind drops
+class TestPerKindDropAccounting(TraceIsolation):
+    def test_dropped_by_kind_counts_evictions(self):
+        ev.enable(capacity=2)
+        for _ in range(4):
+            ev.record_span("phase", "owner", 0.0, 0)
+        ev.record_retry("op", 1, 0.0, "x")
+        dropped = ev.dropped_by_kind()
+        self.assertEqual(dropped.get("span"), 3)
+        self.assertEqual(ev.dropped(), 3)
+        self.assertEqual(
+            telemetry.report()["events_dropped_by_kind"], dropped
+        )
+
+    def test_prometheus_kind_family(self):
+        ev.enable(capacity=1)
+        ev.record_span("phase", "owner", 0.0, 0)
+        ev.record_span("phase", "owner", 0.0, 0)
+        text = export.prometheus_text()
+        self.assertIn(
+            'torcheval_tpu_events_dropped_total{kind="span"} 1', text
+        )
+
+    def test_report_text_breakdown(self):
+        ev.enable(capacity=1)
+        ev.record_span("phase", "owner", 0.0, 0)
+        ev.record_span("phase", "owner", 0.0, 0)
+        text = telemetry.report(as_text=True)
+        self.assertIn("dropped by kind", text)
+        self.assertIn("span=1", text)
+
+
+# ---------------------------------------------------------------- perfetto
+class TestPerfettoFlows(TraceIsolation):
+    def test_flow_events_link_parent_child(self):
+        telemetry.enable()
+        trace.enable()
+        parent = trace.root()
+        with trace.activate(parent):
+            ev.record_span("parent_phase", "owner", 0.1, 0)
+            with trace.span():
+                ev.record_span("child_phase", "owner", 0.05, 0)
+        doc = export.to_perfetto(telemetry.events_snapshot())
+        starts = [e for e in doc["traceEvents"] if e.get("ph") == "s"]
+        finishes = [e for e in doc["traceEvents"] if e.get("ph") == "f"]
+        self.assertEqual(len(starts), 1)
+        self.assertEqual(len(finishes), 1)
+        self.assertEqual(starts[0]["id"], finishes[0]["id"])
+        self.assertEqual(finishes[0]["bp"], "e")
+
+    def test_no_context_stays_schema_valid(self):
+        telemetry.enable()
+        ev.record_span("phase", "owner", 0.1, 0)
+        doc = export.to_perfetto(telemetry.events_snapshot())
+        self.assertNotIn(
+            "s", {e.get("ph") for e in doc["traceEvents"]}
+        )
+        for entry in doc["traceEvents"]:
+            self.assertIn("ph", entry)
+            self.assertIn("pid", entry)
+        json.dumps(doc)  # serializable
+
+    def test_cross_thread_flow(self):
+        telemetry.enable()
+        trace.enable()
+        ctx = trace.root()
+        with trace.activate(ctx):
+            ev.record_span("main_phase", "owner", 0.1, 0)
+            captured = trace.capture()
+
+        def worker():
+            trace.adopt(captured)
+            with trace.span():
+                ev.record_span("worker_phase", "owner", 0.05, 0)
+
+        t = threading.Thread(target=worker, name="flow-worker")
+        t.start()
+        t.join()
+        doc = export.to_perfetto(telemetry.events_snapshot())
+        starts = [e for e in doc["traceEvents"] if e.get("ph") == "s"]
+        finishes = [e for e in doc["traceEvents"] if e.get("ph") == "f"]
+        self.assertEqual(len(starts), 1)
+        # The arrow crosses threads: distinct tids at both ends.
+        self.assertNotEqual(starts[0]["tid"], finishes[0]["tid"])
+
+
+# --------------------------------------------------------------------- CLI
+class TestTraceCli(TraceIsolation):
+    def _dump(self, tmpdir):
+        telemetry.enable()
+        trace.enable()
+        ctx = trace.root()
+        with trace.activate(ctx):
+            ev.record_span("phase", "owner", 0.1, 0)
+        path = f"{tmpdir}/dump.jsonl"
+        export.export_jsonl(path)
+        return path, ctx.trace_id
+
+    def test_trace_filter_renders(self):
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmpdir:
+            path, trace_id = self._dump(tmpdir)
+            import contextlib
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = cli_main([path, "--trace", trace_id])
+            self.assertEqual(rc, 0)
+            self.assertIn(f"trace {trace_id}", buf.getvalue())
+
+    def test_trace_not_found_exits_1(self):
+        import contextlib
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmpdir:
+            path, _ = self._dump(tmpdir)
+            err = io.StringIO()
+            with contextlib.redirect_stderr(err):
+                rc = cli_main([path, "--trace", "nope"])
+            self.assertEqual(rc, 1)
+            self.assertIn("not found", err.getvalue())
+
+
+# ---------------------------------------------------------- fleet traces
+class TestFleetTraces(TraceIsolation):
+    def test_merge_snapshots_stitches_hosts(self):
+        from torcheval_tpu.telemetry.aggregate import (
+            host_snapshot,
+            merge_snapshots,
+        )
+
+        telemetry.enable()
+        trace.enable()
+        # Host 0's sample: a root span.
+        snap0 = host_snapshot()
+        snap0["host"]["process_index"] = 0
+        snap0["events"] = [
+            _mkdict("p", "", "merge-fm0", 0.2, name="send", time_s=1.0)
+        ]
+        # Host 1's sample: a child re-parented onto host 0's span (the
+        # ack-carried link).
+        snap1 = host_snapshot()
+        snap1["host"]["process_index"] = 1
+        snap1["events"] = [
+            _mkdict("q", "p", "merge-fm0", 0.1, name="send", time_s=2.0)
+        ]
+        fleet = merge_snapshots([snap0, snap1])
+        traces = {t["trace_id"]: t for t in fleet["traces"]}
+        self.assertIn("merge-fm0", traces)
+        entry = traces["merge-fm0"]
+        self.assertEqual(entry["spans"], 2)
+        self.assertEqual(entry["hosts"], 2)
+        hops = entry["critical_path"]
+        self.assertEqual([h["host"] for h in hops], [0, 1])
+        text = export.format_fleet_report(fleet)
+        self.assertIn("trace merge-fm0", text)
+        self.assertIn("critical path", text)
+        self.assertIn("@host1", text)
+
+
+if __name__ == "__main__":
+    unittest.main()

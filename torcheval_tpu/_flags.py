@@ -264,6 +264,53 @@ _DECLARATIONS: Tuple[Flag, ...] = (
         read_at="import",
     ),
     Flag(
+        name="TRACE",
+        kind="bool",
+        default=False,
+        doc=(
+            "Enable causal tracing at import "
+            "(``telemetry.trace.ENABLED``): every event is stamped with "
+            "trace/span ids and context propagates across the "
+            "library's thread and host boundaries."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="FLIGHTREC",
+        kind="bool",
+        default=False,
+        doc=(
+            "Enable the flight recorder at import "
+            "(``telemetry.flightrec.ENABLED``): retain a bounded event "
+            "tail and dump a post-mortem bundle when an alert, "
+            "excision, data-corruption raise, fault firing, or "
+            "unhandled engine exception trips it."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="FLIGHTREC_DIR",
+        kind="str",
+        default=None,
+        doc=(
+            "Directory flight-recorder bundles are written under "
+            "(default: ``./flightrec``)."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="FLIGHTREC_LAST",
+        kind="int",
+        default=256,
+        doc=(
+            "How many most-recent events the flight recorder retains "
+            "for a bundle; non-positive or unparseable values fall "
+            "back silently."
+        ),
+        validate=_positive,
+        read_at="import",
+    ),
+    Flag(
         name="FAULT_PLAN",
         kind="json",
         default=None,

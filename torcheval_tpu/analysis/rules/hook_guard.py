@@ -66,6 +66,8 @@ _HEALTH = "torcheval_tpu.telemetry.health"
 _PERFSCOPE = "torcheval_tpu.telemetry.perfscope"
 _FAULTS = "torcheval_tpu.resilience.faults"
 _QUALITY = "torcheval_tpu.monitor.quality"
+_TRACE = "torcheval_tpu.telemetry.trace"
+_FLIGHTREC = "torcheval_tpu.telemetry.flightrec"
 
 HOOK_SPECS: Tuple[HookSpec, ...] = (
     HookSpec(
@@ -113,6 +115,38 @@ HOOK_SPECS: Tuple[HookSpec, ...] = (
         # publishing on the EVENT BUS flag — quality rides the bus.
         guard_modules=frozenset({_EVENTS, _QUALITY}),
         runtime_ns="monitor.",
+    ),
+    HookSpec(
+        module=_TRACE,
+        # The propagation API — the calls hot paths make.  The offline
+        # reconstruction half (build_forest, select_trace, ...) runs on
+        # saved dumps, never on the hot path, and is deliberately absent.
+        names=frozenset(
+            {
+                "capture",
+                "adopt",
+                "activate",
+                "span",
+                "current",
+                "push",
+                "pop",
+                "root",
+                "child",
+                "derive",
+                "reparent",
+                "new_span_id",
+            }
+        ),
+        record_prefix=False,
+        guard_modules=frozenset({_TRACE}),
+        runtime_ns="trace.",
+    ),
+    HookSpec(
+        module=_FLIGHTREC,
+        names=frozenset({"observe", "trigger"}),
+        record_prefix=False,
+        guard_modules=frozenset({_FLIGHTREC}),
+        runtime_ns="flightrec.",
     ),
 )
 

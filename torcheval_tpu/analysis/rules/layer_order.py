@@ -9,8 +9,8 @@ ones" (PAPER.md §1) — with the package-level order
 
 refined to module granularity where the hook architecture demands it:
 the **bus-leaf** modules (``telemetry.events``, ``telemetry.health``,
-``telemetry.perfscope``, ``resilience.faults``) are foundation-layer by
-design.  Every layer holds their one-branch ``ENABLED`` hook sites, so
+``telemetry.perfscope``, ``telemetry.trace``, ``telemetry.flightrec``,
+``resilience.faults``) are foundation-layer by design.  Every layer holds their one-branch ``ENABLED`` hook sites, so
 they must be importable from everywhere and import nothing back; the
 telemetry *aggregation* side (``telemetry/__init__``, ``export``,
 ``aggregate``) and the quality monitor stay in the high observe layer.
@@ -56,6 +56,8 @@ _EXACT: Dict[str, int] = {
     "torcheval_tpu.telemetry.events": 0,
     "torcheval_tpu.telemetry.health": 0,
     "torcheval_tpu.telemetry.perfscope": 0,
+    "torcheval_tpu.telemetry.trace": 0,
+    "torcheval_tpu.telemetry.flightrec": 0,
     "torcheval_tpu.resilience.faults": 0,
 }
 

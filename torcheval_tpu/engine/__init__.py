@@ -55,8 +55,10 @@ from torcheval_tpu.metrics.collection import MetricCollection
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.resilience.checkpoint import CheckpointManager
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import flightrec as _flightrec
 from torcheval_tpu.telemetry import health as _health
 from torcheval_tpu.telemetry import perfscope as _perfscope
+from torcheval_tpu.telemetry import trace as _trace
 
 __all__ = ["Evaluator", "Prefetcher", "ScanRunner"]
 
@@ -164,6 +166,12 @@ class Evaluator:
         self.batches_seen = 0
         self.snapshots: List[Dict[str, Any]] = []
         self.last_snapshot: Optional[Dict[str, Any]] = None
+        # Causal tracing (telemetry/trace.py): one persistent root trace
+        # per evaluator, a child span per dispatched block, and the last
+        # block's span id so an overlapped fleet merge can parent its
+        # cross-host tree on the engine block that scheduled it.
+        self._trace_ctx: Optional[_trace.TraceContext] = None
+        self._last_block_span = ""
 
         # -- durable checkpoint/resume (torcheval_tpu/resilience) -----
         if checkpoint_every_blocks is not None:
@@ -227,11 +235,56 @@ class Evaluator:
             self._dispatch(block)
         return self
 
+    def _trace_root(self) -> Optional["_trace.TraceContext"]:
+        """The evaluator's persistent root trace context (created on
+        first traced use; None while tracing is off)."""
+        if _trace.ENABLED:
+            if self._trace_ctx is None:
+                self._trace_ctx = _trace.root("evaluator")
+                if _telemetry.ENABLED:
+                    # Name the root node so offline reconstruction does
+                    # not render it as a missing-parent placeholder.
+                    with _trace.activate(self._trace_ctx):
+                        _telemetry.record_span(
+                            "evaluator", "Evaluator", 0.0, 0
+                        )
+        return self._trace_ctx
+
     def run(self, stream: Iterable[Any]) -> "Evaluator":
         """Consume an iterable of batches (tuples of update args, or
         single arrays) through the pipelined block loop.  Batches
         buffered by earlier :meth:`step` calls join the stream's first
-        block, in order."""
+        block, in order.
+
+        With the flight recorder on, an exception escaping the loop
+        dumps a post-mortem bundle before propagating; with tracing on
+        the whole run is one span under the evaluator's root trace.
+        """
+        try:
+            if _trace.ENABLED:
+                with _trace.activate(self._trace_root()):
+                    with _trace.span("evaluator.run"):
+                        t0 = time.monotonic()
+                        try:
+                            return self._run_impl(stream)
+                        finally:
+                            if _telemetry.ENABLED:
+                                _telemetry.record_span(
+                                    "evaluator.run",
+                                    "Evaluator",
+                                    time.monotonic() - t0,
+                                    0,
+                                )
+            return self._run_impl(stream)
+        except BaseException as exc:  # noqa: B036 — rethrown below
+            if _flightrec.ENABLED:
+                _flightrec.trigger(
+                    "unhandled_exception",
+                    f"{type(exc).__name__}: {exc}",
+                )
+            raise
+
+    def _run_impl(self, stream: Iterable[Any]) -> "Evaluator":
         blocks = self._block_stream(iter(stream))
         if self._prefetch:
             prefetcher = Prefetcher(
@@ -290,18 +343,26 @@ class Evaluator:
 
         self.flush()
         snapshot = deepcopy(self._collection)
-        return PendingMerge(
-            fleet_merge,
-            (snapshot, group),
-            {
-                "topology": topology,
-                "sketch": sketch,
-                "sketch_options": sketch_options,
-                "recipient": recipient,
-                "policy": policy,
-                "membership": membership,
-            },
-        )
+        kwargs = {
+            "topology": topology,
+            "sketch": sketch,
+            "sketch_options": sketch_options,
+            "recipient": recipient,
+            "policy": policy,
+            "membership": membership,
+        }
+        if _trace.ENABLED:
+            # Parent the merge's cross-host trace on the engine block
+            # that most recently dispatched — the causal link from "a
+            # merge level degraded" back to "which block scheduled it".
+            base = _trace.current() or self._trace_root()
+            if self._last_block_span:
+                base = _trace.TraceContext(
+                    trace_id=base.trace_id, span_id=self._last_block_span
+                )
+            with _trace.activate(base):
+                return PendingMerge(fleet_merge, (snapshot, group), kwargs)
+        return PendingMerge(fleet_merge, (snapshot, group), kwargs)
 
     def warmup(
         self,
@@ -488,6 +549,19 @@ class Evaluator:
         return self._runner
 
     def _dispatch(self, block: _Block) -> None:
+        if _trace.ENABLED:
+            # One span per dispatched block, under the active context
+            # (evaluator.run) or the evaluator root for bare step()
+            # use.  The block's telemetry events — engine_block counter,
+            # span, health findings, SLO alerts — all stamp its ids.
+            ctx = _trace.child(_trace.current() or self._trace_root())
+            self._last_block_span = ctx.span_id
+            with _trace.activate(ctx):
+                self._dispatch_impl(block)
+            return
+        self._dispatch_impl(block)
+
+    def _dispatch_impl(self, block: _Block) -> None:
         if block.perbatch:
             # The per-batch tail goes through fused_update, which carries
             # its own health side-outputs — every batch stays monitored.

@@ -25,6 +25,7 @@ import jax
 
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import trace as _trace
 
 DEFAULT_DEPTH = 2
 
@@ -93,6 +94,10 @@ class Prefetcher:
         self._queue: "queue.Queue[Any]" = queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._finished = False
+        # contextvars do not flow into Thread targets: hand the caller's
+        # trace context across the boundary explicitly so the producer's
+        # fault/stall events link under the consuming run's span.
+        self._trace_ctx = _trace.capture() if _trace.ENABLED else None
         self._thread = threading.Thread(
             target=self._produce, name="torcheval-tpu-prefetch", daemon=True
         )
@@ -122,6 +127,8 @@ class Prefetcher:
         raise AssertionError("unreachable")  # pragma: no cover
 
     def _produce(self) -> None:
+        if _trace.ENABLED:
+            _trace.adopt(self._trace_ctx)
         produced = 0
         try:
             for item in self._source:

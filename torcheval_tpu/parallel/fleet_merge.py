@@ -79,6 +79,7 @@ from torcheval_tpu.resilience.retry import (
     RetryPolicy,
 )
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import trace as _trace
 
 TOPOLOGIES = ("flat", "tree", "ring")
 _FAULT_SITE = "merge.level"
@@ -230,7 +231,13 @@ class MergeOutcome:
 
 @dataclass
 class Envelope:
-    """One hop's payload: merged state plus the membership piggyback."""
+    """One hop's payload: merged state plus the membership piggyback.
+
+    ``trace_id``/``span_id`` are the sender's causal-trace identity
+    (empty when tracing is off — defaults keep the wire format
+    compatible with untraced peers), riding the same piggyback channel
+    as the dead-rank gossip: no extra round trips for cross-host trace
+    assembly."""
 
     sender: int
     level: int
@@ -240,6 +247,8 @@ class Envelope:
     parts: Dict[int, Any] = field(default_factory=dict)
     part_bytes: Dict[int, int] = field(default_factory=dict)
     sketch: Optional[Any] = None
+    trace_id: str = ""
+    span_id: str = ""
 
     def payload_nbytes(self) -> int:
         if self.mode == "exact":
@@ -299,7 +308,7 @@ class _Acc:
     def to_envelope(
         self, sender: int, level: int, view: MembershipView
     ) -> Envelope:
-        return Envelope(
+        env = Envelope(
             sender=sender,
             level=level,
             contributors=frozenset(self.contributors),
@@ -309,6 +318,12 @@ class _Acc:
             part_bytes=dict(self.part_bytes),
             sketch=self.sketch,
         )
+        if _trace.ENABLED:
+            ctx = _trace.current()
+            if ctx is not None:
+                env.trace_id = ctx.trace_id
+                env.span_id = ctx.span_id
+        return env
 
 
 # ------------------------------------------------------------ tree shape
@@ -418,6 +433,33 @@ def _record_level(
         )
 
 
+def _ack_payload(me: int, view: MembershipView) -> tuple:
+    """The wire ack: ``("ack", rank, dead-gossip[, span_id])`` — the
+    4th element is this rank's merge span id, the downlink that lets the
+    acked child reparent its merge span under the parent's before it
+    emits its own level record (the one field cross-host trace assembly
+    needs).  Omitted when tracing is off: 3-tuples stay on the wire, so
+    traced and untraced builds interoperate."""
+    base = ("ack", me, tuple(view.dead))
+    if _trace.ENABLED:
+        ctx = _trace.current()
+        if ctx is not None:
+            return base + (ctx.span_id,)
+    return base
+
+
+def _adopt_ack_parent(ack: Any) -> None:
+    """Fold the parent span id an ack carried into this rank's active
+    merge span (same span id, newly-learned parent).  Call before the
+    level record is emitted so the event carries the link."""
+    if not _trace.ENABLED:
+        return
+    if isinstance(ack, tuple) and len(ack) >= 4 and ack[3]:
+        ctx = _trace.current()
+        if ctx is not None:
+            _trace.adopt(_trace.reparent(ctx, ack[3]))
+
+
 # --------------------------------------------------------- tree protocol
 def _tree_round(
     group: CollectiveGroup,
@@ -498,7 +540,7 @@ def _tree_round(
                 _send_hop(
                     group,
                     view,
-                    ("ack", me, tuple(view.dead)),
+                    _ack_payload(me, view),
                     child_rank,
                     f"{rid}/ack/{child_pos}",
                     policy.ack(),
@@ -554,8 +596,9 @@ def _tree_round(
                     policy.attempts,
                 )
                 view.observe(target_rank, level=level)
-                if isinstance(ack, tuple) and len(ack) == 3:
+                if isinstance(ack, tuple) and len(ack) >= 3:
                     view.merge_gossip(ack[2], reason="ack gossip")
+                _adopt_ack_parent(ack)
                 _record_level(
                     time.monotonic() - started, env.payload_nbytes(), level, 2
                 )
@@ -663,7 +706,7 @@ def _poll_orphans(
             acc.absorb(env, view)
             try:
                 group.send_object(
-                    ("ack", me, tuple(view.dead)),
+                    _ack_payload(me, view),
                     orphan_rank,
                     f"{rid}/ack/{pos}",
                 )
@@ -721,7 +764,7 @@ def _ring_round(
                 acc.absorb(env, view)
                 try:
                     group.send_object(
-                        ("ack", me, tuple(view.dead)),
+                        _ack_payload(me, view),
                         src_rank,
                         f"{rid}/ring-ack/{src_pos}",
                     )
@@ -759,7 +802,7 @@ def _ring_round(
                     policy.ack(),
                     policy.attempts,
                 )
-                _recv_hop(
+                ack = _recv_hop(
                     group,
                     view,
                     target_rank,
@@ -770,6 +813,7 @@ def _ring_round(
                     policy.attempts,
                 )
                 view.observe(target_rank, level=level)
+                _adopt_ack_parent(ack)
                 _record_level(
                     time.monotonic() - started,
                     env_out.payload_nbytes(),
@@ -869,12 +913,29 @@ def fleet_merge(
         acc.add_local(me, sketch=metric.sketch_state(sketch, **opts))
 
     delivered = True
+    round_fn = _tree_round if topology == "tree" else _ring_round
+    merge_ctx = None
+    if _trace.ENABLED:
+        # Every rank of one round derives the SAME trace id from the
+        # shared round id — cross-host trace identity with zero extra
+        # round trips.  The initial parent link points at whatever
+        # scheduled this rank's merge (the engine block span, via
+        # PendingMerge's handoff); acks later reparent non-root merge
+        # spans under their tree parent's span, and the root keeps the
+        # local link — bridging the whole cross-host tree into the
+        # root's engine trace.
+        local = _trace.current()
+        merge_ctx = _trace.derive(
+            f"merge-{rid}",
+            parent_span_id=local.span_id if local is not None else "",
+        )
     try:
         _fire("start", me, 0, rnd, topology)
-        if topology == "tree":
-            delivered = _tree_round(group, view, acc, dst, policy, rid, rnd)
+        if _trace.ENABLED and merge_ctx is not None:
+            with _trace.activate(merge_ctx):
+                delivered = round_fn(group, view, acc, dst, policy, rid, rnd)
         else:
-            delivered = _ring_round(group, view, acc, dst, policy, rid, rnd)
+            delivered = round_fn(group, view, acc, dst, policy, rid, rnd)
     except DroppedRank:
         # This rank "vanished": no sends, no acks, no result — its
         # peers excise it and carry on.  Locally we still return a
@@ -1056,8 +1117,15 @@ class PendingMerge:
     def __init__(self, target: Any, args: tuple, kwargs: dict) -> None:
         self._outcome: Optional[MergeOutcome] = None
         self._error: Optional[BaseException] = None
+        # Explicit thread handoff of the caller's trace context
+        # (start_fleet_merge activates the scheduling engine-block span
+        # around this constructor) so the merge's spans parent on the
+        # block that scheduled them.
+        self._trace_ctx = _trace.capture() if _trace.ENABLED else None
 
         def run() -> None:
+            if _trace.ENABLED:
+                _trace.adopt(self._trace_ctx)
             try:
                 self._outcome = target(*args, **kwargs)
             except BaseException as exc:  # noqa: BLE001 - relayed in result()

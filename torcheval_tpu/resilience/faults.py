@@ -64,6 +64,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from torcheval_tpu import _flags
+from torcheval_tpu.telemetry import flightrec as _flightrec
 
 # The one-branch guard flag.  True exactly while a plan is installed.
 ENABLED: bool = False
@@ -246,6 +247,16 @@ def fire(site: str, **ctx: Any) -> Optional[FaultRule]:
         rule = plan._match(site, ctx)
     if rule is None:
         return None
+    if _flightrec.ENABLED:
+        # Snapshot BEFORE the action lands: for "raise"/"drop_rank" the
+        # post-fault state is an unwound stack, so the bundle's value is
+        # the ring tail leading up to the injection.
+        _flightrec.trigger(
+            "fault_fired",
+            f"site={site} action={rule.action}",
+            extra={"fault": {"site": site, "action": rule.action,
+                             "context": {k: repr(v) for k, v in ctx.items()}}},
+        )
     if rule.action == "raise":
         raise InjectedFault(site, rule.message)
     if rule.action == "drop_rank":

@@ -30,6 +30,7 @@ import time
 from typing import Any, Dict, Iterable, Optional, Set
 
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import flightrec as _flightrec
 
 
 class MembershipView:
@@ -114,6 +115,12 @@ class MembershipView:
                 reason or f"rank {rank} unresponsive",
                 fallback="excised",
                 survivors=survivors,
+            )
+        if _flightrec.ENABLED:
+            _flightrec.trigger(
+                "excision",
+                reason or f"rank {rank} unresponsive",
+                extra={"membership": self.snapshot()},
             )
         return True
 

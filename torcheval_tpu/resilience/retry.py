@@ -36,6 +36,7 @@ from typing import Any, Callable, List, Optional
 from torcheval_tpu.distributed import CollectiveGroup
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import trace as _trace
 
 
 class CollectiveTimeoutError(RuntimeError):
@@ -156,7 +157,14 @@ def run_with_retry(
                     raise _Exhausted(_peer_of(exc)) from exc
                 delay = min(delay, budget)
             if _telemetry.ENABLED:
-                _telemetry.record_retry(op, attempt, delay, repr(exc))
+                if _trace.ENABLED:
+                    # One child span per failed attempt: the trace tree
+                    # shows a retry storm as distinct siblings under the
+                    # operation that retried, not one flat node.
+                    with _trace.span("retry_attempt"):
+                        _telemetry.record_retry(op, attempt, delay, repr(exc))
+                else:
+                    _telemetry.record_retry(op, attempt, delay, repr(exc))
             time.sleep(delay)
     raise _Exhausted(_peer_of(last_exc)) from last_exc  # pragma: no cover
 
@@ -199,8 +207,13 @@ def _call_with_deadline(
     cannot be cancelled from Python) but the caller returns on time."""
     box: List[Any] = [None, None]  # [result, exception]
     done = threading.Event()
+    # Explicit handoff: anything fn() emits on the reaper thread keeps
+    # the caller's trace context (contextvars don't cross Thread()).
+    ctx = _trace.capture() if _trace.ENABLED else None
 
     def target() -> None:
+        if _trace.ENABLED:
+            _trace.adopt(ctx)
         try:
             box[0] = fn()
         except BaseException as e:  # noqa: BLE001 - relayed to caller

@@ -51,7 +51,15 @@ from __future__ import annotations
 
 from typing import Any, Dict, Union
 
-from torcheval_tpu.telemetry import aggregate, events, export, health, perfscope
+from torcheval_tpu.telemetry import (
+    aggregate,
+    events,
+    export,
+    flightrec,
+    health,
+    perfscope,
+    trace,
+)
 from torcheval_tpu.telemetry.aggregate import (
     fleet_report,
     host_snapshot,
@@ -252,6 +260,7 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         "spans": spans,
         "events_captured": agg["emitted"],
         "events_dropped": events.dropped(),
+        "events_dropped_by_kind": events.dropped_by_kind(),
         "ring_capacity": events.capacity(),
     }
     if agg["merge_levels"]:
@@ -352,6 +361,7 @@ __all__ = [
     "export_jsonl",
     "fleet_report",
     "fleet_to_perfetto",
+    "flightrec",
     "format_explain_perf",
     "format_fleet_report",
     "format_report",
@@ -365,4 +375,5 @@ __all__ = [
     "report",
     "serve_prometheus",
     "to_perfetto",
+    "trace",
 ]

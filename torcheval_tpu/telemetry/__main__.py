@@ -5,11 +5,15 @@ Default output is the human-readable health summary
 (:func:`torcheval_tpu.telemetry.report` text); ``--prometheus`` prints
 the text-format counter snapshot instead, ``--perfetto out.json``
 writes a Chrome/Perfetto trace for ``ui.perfetto.dev``, ``--perf``
-prints the perfscope roofline table, and ``--alerts`` renders the fired
-SLO rules and exits nonzero when any fired (CI gate: pipe an eval run's
-dump through ``--alerts`` to fail the job on an SLO breach).  Dumps
-written by newer library versions load fine — unknown event kinds are
-skipped with a counted warning (``export.read_jsonl``).
+prints the perfscope roofline table, ``--trace <trace_id>`` renders the
+span tree(s) containing that trace id as text (exit 1 when the id is
+not in the dump), ``--flight <bundle_dir>`` validates and renders a
+flight-recorder bundle (no report path needed; exit 2 on a corrupt
+bundle), and ``--alerts`` renders the fired SLO rules and exits nonzero
+when any fired (CI gate: pipe an eval run's dump through ``--alerts``
+to fail the job on an SLO breach).  Dumps written by newer library
+versions load fine — unknown event kinds are skipped with a counted
+warning (``export.read_jsonl``).
 """
 
 from __future__ import annotations
@@ -26,7 +30,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Pretty-print a saved telemetry JSONL report.",
     )
     parser.add_argument(
-        "report", help="path to a JSON-lines dump from telemetry.export_jsonl"
+        "report",
+        nargs="?",
+        default=None,
+        help="path to a JSON-lines dump from telemetry.export_jsonl "
+        "(optional with --flight)",
     )
     parser.add_argument(
         "--prometheus",
@@ -49,7 +57,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="render fired SLO alert rules; exit 1 when any fired "
         "(for CI consumption)",
     )
+    parser.add_argument(
+        "--trace",
+        metavar="TRACE_ID",
+        help="render the causal span tree(s) containing this trace id; "
+        "exit 1 when the id does not appear in the dump",
+    )
+    parser.add_argument(
+        "--flight",
+        metavar="BUNDLE_DIR",
+        help="validate and render a flight-recorder bundle directory; "
+        "exit 2 when the bundle is missing or corrupt",
+    )
     args = parser.parse_args(argv)
+
+    if args.flight:
+        from torcheval_tpu.telemetry import flightrec
+
+        problems = flightrec.validate_bundle(args.flight)
+        if problems:
+            print(
+                f"corrupt flight-recorder bundle {args.flight!r}:",
+                file=sys.stderr,
+            )
+            for problem in problems:
+                print(f"  - {problem}", file=sys.stderr)
+            return 2
+        sys.stdout.write(flightrec.format_bundle(flightrec.read_bundle(args.flight)))
+        return 0
+
+    if args.report is None:
+        parser.error("a report path is required (except with --flight)")
 
     from torcheval_tpu.telemetry import events as ev
     from torcheval_tpu.telemetry import export
@@ -62,6 +100,23 @@ def main(argv: Optional[List[str]] = None) -> int:
             file=sys.stderr,
         )
         return 2
+
+    if args.trace:
+        from torcheval_tpu.telemetry import trace as trace_mod
+
+        roots = trace_mod.build_forest(
+            [export.event_to_dict(e) for e in loaded]
+        )
+        selected = trace_mod.select_trace(roots, args.trace)
+        if not selected:
+            print(
+                f"trace {args.trace!r} not found in {args.report!r} "
+                f"({len(roots)} trace tree(s) in dump)",
+                file=sys.stderr,
+            )
+            return 1
+        print(trace_mod.format_forest(selected))
+        return 0
 
     # Replay into a private bus sized to hold everything: re-emitting
     # rebuilds the exact aggregates (they are pure folds of the events),

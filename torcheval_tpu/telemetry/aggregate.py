@@ -159,6 +159,72 @@ def _variance(values: List[float]) -> float:
     return sum((v - mean) ** 2 for v in values) / len(values)
 
 
+def _count_spans(node: Dict[str, Any]) -> int:
+    return 1 + sum(_count_spans(c) for c in node["children"])
+
+
+def _span_hosts(node: Dict[str, Any], acc: set) -> None:
+    if node.get("host") is not None:
+        acc.add(node["host"])
+    for c in node["children"]:
+        _span_hosts(c, acc)
+
+
+def fleet_traces(snapshots: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Cross-host span reconstruction: stitch every host's event sample
+    into one forest (merge spans re-parent onto the upstream rank's span
+    via the ack-carried link, so a fleet merge renders as ONE tree
+    spanning hosts) and summarize each trace id — span count, hosts
+    touched, and the slowest root-to-leaf critical path with each hop
+    pinned to the host that ran it."""
+    from torcheval_tpu.telemetry import trace as _trace
+
+    stamped: List[Dict[str, Any]] = []
+    for snap in snapshots:
+        host = snap.get("host", {}).get("process_index", 0)
+        for d in snap.get("events", []):
+            if d.get("span_id"):
+                stamped.append({**d, "host": host})
+    if not stamped:
+        return []
+    roots = _trace.build_forest(stamped)
+
+    out: List[Dict[str, Any]] = []
+    all_ids = sorted({d["trace_id"] for d in stamped if d.get("trace_id")})
+    for tid in all_ids:
+        selected = _trace.select_trace(roots, tid)
+        if not selected:
+            continue
+        hosts: set = set()
+        spans = 0
+        best_path: List[Dict[str, Any]] = []
+        best_cost = -1.0
+        for root in selected:
+            spans += _count_spans(root)
+            _span_hosts(root, hosts)
+            path = _trace.critical_path(root)
+            cost = sum(float(n["seconds"]) for n in path)
+            if cost > best_cost:
+                best_cost = cost
+                best_path = path
+        out.append(
+            {
+                "trace_id": tid,
+                "spans": spans,
+                "hosts": len(hosts),
+                "critical_path": [
+                    {
+                        "name": n["name"],
+                        "host": n["host"],
+                        "seconds": float(n["seconds"]),
+                    }
+                    for n in best_path
+                ],
+            }
+        )
+    return out
+
+
 def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold per-host snapshots (any order) into the fleet report dict:
     ``hosts`` count, ``per_host`` rollups sorted by process index, fleet
@@ -312,6 +378,7 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
             "per_metric": per_metric,
             "worst_slice": worst_slice or None,
         },
+        "traces": fleet_traces(snapshots),
     }
 
 

@@ -44,6 +44,8 @@ from dataclasses import dataclass, field, fields
 from typing import Any, Dict, List, Optional, Tuple
 
 from torcheval_tpu import _flags
+from torcheval_tpu.telemetry import flightrec as _flightrec
+from torcheval_tpu.telemetry import trace as _trace
 
 _TRUTHY = _flags.TRUTHY
 
@@ -71,6 +73,9 @@ ANNOTATE: bool = _flags.get("TELEMETRY_ANNOTATE")
 _lock = threading.Lock()
 _events: "deque[Event]" = deque(maxlen=_env_capacity())
 _dropped: int = 0
+# Per-kind eviction counts (the kind of each event the full ring pushed
+# out) — flight-recorder truncation must itself be observable.
+_dropped_by_kind: Dict[str, int] = {}
 
 
 # --------------------------------------------------------------------- events
@@ -79,12 +84,22 @@ class Event:
     """Base event: a kind tag, a monotonic timestamp, the user callsite
     (``"file:line"``) the emission is attributed to, and the emitting
     thread's name (the Perfetto track — the prefetch producer and the
-    dispatch loop emit concurrently)."""
+    dispatch loop emit concurrently).
+
+    ``trace_id`` / ``span_id`` / ``parent_span_id`` are the causal
+    identity stamped by :mod:`torcheval_tpu.telemetry.trace` when
+    tracing is on; they default to ``""`` and are omitted from the
+    serialized form when empty, so dumps written with tracing off are
+    byte-identical to pre-trace dumps and old dumps round-trip through
+    ``export.event_from_dict`` unchanged."""
 
     kind: str = field(init=False, default="event")
     time_s: float = field(default=0.0)
     callsite: str = field(default="<unknown>:0")
     thread: str = field(default="")
+    trace_id: str = field(default="")
+    span_id: str = field(default="")
+    parent_span_id: str = field(default="")
 
 
 @dataclass
@@ -455,6 +470,7 @@ def clear() -> None:
     with _lock:
         _events.clear()
         _dropped = 0
+        _dropped_by_kind.clear()
         _agg = _zero_aggregates()
 
 
@@ -467,6 +483,15 @@ def dropped() -> int:
     """Events evicted from the ring since the last :func:`clear`."""
     with _lock:
         return _dropped
+
+
+def dropped_by_kind() -> Dict[str, int]:
+    """Evictions since the last :func:`clear`, keyed by the evicted
+    event's kind (sums to :func:`dropped`) — the per-kind truncation
+    breakdown ``report()`` and the Prometheus
+    ``events_dropped_total{kind=...}`` family surface."""
+    with _lock:
+        return dict(_dropped_by_kind)
 
 
 def events(kind: Optional[str] = None) -> List[Event]:
@@ -534,8 +559,8 @@ def _callsite() -> str:
 
 def emit(event: Event) -> None:
     """Append ``event`` to the ring and fold it into the aggregates.
-    Timestamp/callsite/thread are stamped here when the caller left
-    defaults."""
+    Timestamp/callsite/thread — and, when tracing is on, the causal
+    trace identity — are stamped here when the caller left defaults."""
     global _dropped
     if event.time_s == 0.0:
         event.time_s = time.monotonic()
@@ -543,9 +568,21 @@ def emit(event: Event) -> None:
         event.callsite = _callsite()
     if not event.thread:
         event.thread = threading.current_thread().name
+    if _trace.ENABLED and not event.span_id:
+        ctx = _trace.current()
+        if ctx is not None:
+            event.trace_id = ctx.trace_id
+            event.span_id = ctx.span_id
+            event.parent_span_id = ctx.parent_span_id
+    if _flightrec.ENABLED:
+        _flightrec.observe(event)
     with _lock:
         if len(_events) == _events.maxlen:
             _dropped += 1
+            evicted = _events[0].kind
+            _dropped_by_kind[evicted] = (
+                _dropped_by_kind.get(evicted, 0) + 1
+            )
         _events.append(event)
         _agg["emitted"] += 1
         _fold(event)

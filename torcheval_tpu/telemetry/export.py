@@ -17,6 +17,7 @@ format, and the report formatter.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import io
 import json
 import math
@@ -27,10 +28,19 @@ from torcheval_tpu.telemetry import events as _events
 
 _PREFIX = "torcheval_tpu"
 
+# Optional causal-identity fields (telemetry/trace.py): omitted from the
+# serialized form when empty so dumps written with tracing off stay
+# byte-identical to pre-trace dumps.
+_TRACE_FIELDS = ("trace_id", "span_id", "parent_span_id")
+
 
 # ------------------------------------------------------------------ JSON-lines
 def event_to_dict(event: "_events.Event") -> Dict[str, Any]:
-    return dataclasses.asdict(event)
+    payload = dataclasses.asdict(event)
+    for key in _TRACE_FIELDS:
+        if not payload.get(key):
+            payload.pop(key, None)
+    return payload
 
 
 def event_from_dict(payload: Dict[str, Any]) -> "_events.Event":
@@ -146,6 +156,143 @@ def _perfetto_args(event: "_events.Event") -> Dict[str, Any]:
     }
 
 
+def _flow_id(span_id: str) -> int:
+    # Stable across processes (CLI merging dumps from many hosts must
+    # agree), unlike the salted builtin ``hash``.
+    digest = hashlib.sha1(span_id.encode("utf-8")).digest()
+    return int.from_bytes(digest[:6], "big")
+
+
+def _convert_events(
+    events: List["_events.Event"],
+    *,
+    pid: int,
+    process_name: str,
+    trace: List[Dict[str, Any]],
+    span_slices: Dict[str, Dict[str, Any]],
+    flow_links: List[Dict[str, Any]],
+) -> None:
+    """Append one host's trace events to ``trace``, registering duration
+    slices by span id into ``span_slices`` and parent links into
+    ``flow_links`` so the caller can resolve flow arrows after every
+    host has been converted (cross-host flows resolve in
+    :func:`fleet_to_perfetto`)."""
+    trace.append(
+        {
+            "ph": "M",
+            "name": "process_name",
+            "pid": pid,
+            "tid": 0,
+            "args": {"name": process_name},
+        }
+    )
+    # Stable tracks: MainThread pins to 0 so the primary dispatch loop
+    # always renders first; other threads take 1..n in sorted-name
+    # order, independent of event arrival order.
+    present = {e.thread or "MainThread" for e in events}
+    names = sorted(present - {"MainThread"})
+    tids = {"MainThread": 0}
+    tids.update({name: i + 1 for i, name in enumerate(names)})
+    for name in (["MainThread"] if "MainThread" in present else []) + names:
+        trace.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid,
+                "tid": tids[name],
+                "args": {"name": name},
+            }
+        )
+
+    for event in events:
+        tid = tids[event.thread or "MainThread"]
+        namer = _DURATION_NAME.get(event.kind)
+        if namer is not None:
+            seconds = float(getattr(event, "seconds", 0.0))
+            ts = (event.time_s - seconds) * 1e6
+            trace.append(
+                {
+                    "ph": "X",
+                    "name": namer(event),
+                    "cat": event.kind,
+                    "ts": ts,
+                    "dur": seconds * 1e6,
+                    "pid": pid,
+                    "tid": tid,
+                    "args": _perfetto_args(event),
+                }
+            )
+            sid = getattr(event, "span_id", "")
+            if sid and sid not in span_slices:
+                span_slices[sid] = {"ts": ts, "pid": pid, "tid": tid}
+            parent = getattr(event, "parent_span_id", "")
+            if sid and parent:
+                flow_links.append(
+                    {
+                        "span_id": sid,
+                        "parent_span_id": parent,
+                        "ts": ts,
+                        "pid": pid,
+                        "tid": tid,
+                    }
+                )
+        else:
+            trace.append(
+                {
+                    "ph": "i",
+                    "name": event.kind,
+                    "cat": event.kind,
+                    "ts": event.time_s * 1e6,
+                    "pid": pid,
+                    "tid": tid,
+                    "s": "t",
+                    "args": _perfetto_args(event),
+                }
+            )
+
+
+def _flow_events(
+    span_slices: Dict[str, Dict[str, Any]],
+    flow_links: List[Dict[str, Any]],
+) -> List[Dict[str, Any]]:
+    """Flow arrows (``ph:"s"`` at the parent slice, ``ph:"f"`` binding
+    to the enclosing child slice) for every parent link whose parent
+    span has a slice in the converted sample.  Dangling links (parent
+    rotated out of the ring, or on an unsampled host) are silently
+    skipped — the output stays schema-valid with or without trace
+    context."""
+    flows: List[Dict[str, Any]] = []
+    for link in flow_links:
+        parent = span_slices.get(link["parent_span_id"])
+        if parent is None:
+            continue
+        fid = _flow_id(link["span_id"])
+        flows.append(
+            {
+                "ph": "s",
+                "id": fid,
+                "name": "causal",
+                "cat": "trace",
+                "ts": parent["ts"],
+                "pid": parent["pid"],
+                "tid": parent["tid"],
+            }
+        )
+        flows.append(
+            {
+                "ph": "f",
+                "bp": "e",
+                "id": fid,
+                "name": "causal",
+                "cat": "trace",
+                "ts": link["ts"],
+                "pid": link["pid"],
+                "tid": link["tid"],
+            }
+        )
+    return flows
+
+
 def to_perfetto(
     events: Optional[List["_events.Event"]] = None,
     *,
@@ -161,71 +308,29 @@ def to_perfetto(
     (``ph:"i"``).  Tracks separate by emitting thread (``tid`` — the
     engine's prefetch producer renders above/below the dispatch loop)
     and by host (``pid``) when merging a fleet
-    (:func:`fleet_to_perfetto`).
+    (:func:`fleet_to_perfetto`).  Events stamped with trace context
+    (:mod:`torcheval_tpu.telemetry.trace`) additionally get flow arrows
+    (``ph:"s"``/``ph:"f"``) from each parent slice to its children, so
+    the viewer draws the causal chain across threads.
 
     ``events=None`` drains the live ring buffer.
     """
     if events is None:
         events = _events.events()
     trace: List[Dict[str, Any]] = []
-    tids: Dict[str, int] = {}
-
+    span_slices: Dict[str, Dict[str, Any]] = {}
+    flow_links: List[Dict[str, Any]] = []
     if process_name is None:
         process_name = f"{_PREFIX} host {pid}"
-    trace.append(
-        {
-            "ph": "M",
-            "name": "process_name",
-            "pid": pid,
-            "tid": 0,
-            "args": {"name": process_name},
-        }
+    _convert_events(
+        events,
+        pid=pid,
+        process_name=process_name,
+        trace=trace,
+        span_slices=span_slices,
+        flow_links=flow_links,
     )
-
-    for event in events:
-        thread = event.thread or "MainThread"
-        if thread not in tids:
-            # MainThread pins to track 0 so the primary dispatch loop
-            # always renders first in the viewer.
-            tids[thread] = 0 if thread == "MainThread" else len(tids) + 1
-            trace.append(
-                {
-                    "ph": "M",
-                    "name": "thread_name",
-                    "pid": pid,
-                    "tid": tids[thread],
-                    "args": {"name": thread},
-                }
-            )
-        tid = tids[thread]
-        namer = _DURATION_NAME.get(event.kind)
-        if namer is not None:
-            seconds = float(getattr(event, "seconds", 0.0))
-            trace.append(
-                {
-                    "ph": "X",
-                    "name": namer(event),
-                    "cat": event.kind,
-                    "ts": (event.time_s - seconds) * 1e6,
-                    "dur": seconds * 1e6,
-                    "pid": pid,
-                    "tid": tid,
-                    "args": _perfetto_args(event),
-                }
-            )
-        else:
-            trace.append(
-                {
-                    "ph": "i",
-                    "name": event.kind,
-                    "cat": event.kind,
-                    "ts": event.time_s * 1e6,
-                    "pid": pid,
-                    "tid": tid,
-                    "s": "t",
-                    "args": _perfetto_args(event),
-                }
-            )
+    trace.extend(_flow_events(span_slices, flow_links))
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
 
 
@@ -233,10 +338,14 @@ def fleet_to_perfetto(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
     """One merged Perfetto trace over per-host snapshots (from
     :func:`torcheval_tpu.telemetry.aggregate.host_snapshot`): each host
     becomes a ``pid`` whose process row is named after it, threads
-    within a host keep their own tracks.  Unknown event kinds in a
-    snapshot's sample are skipped (forward compatibility, as
+    within a host keep their own tracks, and flow arrows resolve ACROSS
+    hosts (a fleet-merge child span on rank 3 draws its arrow from the
+    parent's slice on rank 1).  Unknown event kinds in a snapshot's
+    sample are skipped (forward compatibility, as
     :func:`read_jsonl`)."""
     merged: List[Dict[str, Any]] = []
+    span_slices: Dict[str, Dict[str, Any]] = {}
+    flow_links: List[Dict[str, Any]] = []
     for snapshot in snapshots:
         host = snapshot.get("host", {})
         pid = int(host.get("process_index", 0))
@@ -246,9 +355,15 @@ def fleet_to_perfetto(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
             for payload in snapshot.get("events", [])
             if payload.get("kind") in _events.KIND_TO_CLASS
         ]
-        merged.extend(
-            to_perfetto(events, pid=pid, process_name=name)["traceEvents"]
+        _convert_events(
+            events,
+            pid=pid,
+            process_name=name,
+            trace=merged,
+            span_slices=span_slices,
+            flow_links=flow_links,
         )
+    merged.extend(_flow_events(span_slices, flow_links))
     return {"traceEvents": merged, "displayTimeUnit": "ms"}
 
 
@@ -315,6 +430,16 @@ def prometheus_text() -> str:
     out.append(
         f"{_PREFIX}_telemetry_events_dropped_total {_events.dropped()}"
     )
+    out.append(
+        f"# HELP {_PREFIX}_events_dropped_total Ring evictions by the "
+        "kind of the evicted event (which signal the bounded buffer is "
+        "losing)."
+    )
+    out.append(f"# TYPE {_PREFIX}_events_dropped_total counter")
+    for kind, count in sorted(_events.dropped_by_kind().items()):
+        out.append(
+            f"{_PREFIX}_events_dropped_total{_labels(kind=kind)} {count}"
+        )
 
     out.append(
         f"# HELP {_PREFIX}_retrace_total Update-program traces by program "
@@ -819,6 +944,12 @@ def format_report(report: Dict[str, Any]) -> str:
         f"{report.get('events_dropped', 0)} dropped "
         f"(ring capacity {report.get('ring_capacity', 0)})\n"
     )
+    by_kind = report.get("events_dropped_by_kind") or {}
+    if by_kind:
+        rendered = ", ".join(
+            f"{kind}={count}" for kind, count in sorted(by_kind.items())
+        )
+        buf.write(f"    dropped by kind: {rendered}\n")
     return buf.getvalue()
 
 
@@ -961,4 +1092,23 @@ def format_fleet_report(fleet: Dict[str, Any]) -> str:
             f"{host.get('process_index', '?')} "
             f"({host.get('hostname', '?')})\n"
         )
+    for entry in fleet.get("traces", []):
+        buf.write(
+            f"  trace {entry.get('trace_id', '?')}: "
+            f"{entry.get('spans', 0)} spans across "
+            f"{entry.get('hosts', 0)} host(s)\n"
+        )
+        hops = entry.get("critical_path") or []
+        if hops:
+            chain = " -> ".join(
+                f"{hop['name']}"
+                + (
+                    f"@host{hop['host']}"
+                    if hop.get("host") is not None
+                    else ""
+                )
+                + f" {hop['seconds'] * 1e3:.2f}ms"
+                for hop in hops
+            )
+            buf.write(f"    critical path: {chain}\n")
     return buf.getvalue()

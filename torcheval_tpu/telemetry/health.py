@@ -61,6 +61,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from torcheval_tpu import _flags
 from torcheval_tpu.telemetry import events as _events
+from torcheval_tpu.telemetry import flightrec as _flightrec
 
 # Module-level flags: hook sites read these as plain attributes (the
 # one-branch zero-overhead contract, see events.ENABLED).
@@ -311,5 +312,15 @@ def inspect(
     if RAISE_ON_CORRUPT:
         corrupt = [f for f in findings if f["check"] in CORRUPT_CHECKS]
         if corrupt:
+            if _flightrec.ENABLED:
+                # Dump before the raise unwinds the dispatch loop — the
+                # bundle's tail shows which blocks fed the corrupt batch.
+                _flightrec.trigger(
+                    "data_corruption",
+                    f"source={source} "
+                    + ",".join(sorted({f["check"] for f in corrupt})),
+                    extra={"corruption": {"source": source,
+                                          "findings": corrupt}},
+                )
             raise DataCorruptionError(source, corrupt)
     return findings

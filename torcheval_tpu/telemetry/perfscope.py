@@ -79,6 +79,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from torcheval_tpu import _flags
 from torcheval_tpu.telemetry import events as _events
+from torcheval_tpu.telemetry import flightrec as _flightrec
 
 # Module-level flag: hook sites read this as a plain attribute (the
 # one-branch zero-overhead contract, see events.ENABLED).
@@ -633,6 +634,12 @@ def evaluate_slo(
                     "message": message,
                 }
             )
+    if fired and _flightrec.ENABLED:
+        _flightrec.trigger(
+            "alert_fired",
+            ", ".join(f["rule"] for f in fired),
+            extra={"alerts": fired},
+        )
     return fired
 
 
