@@ -60,6 +60,14 @@ EXTRA_BARS = (
     ("collection_sliced_stream", "monitor_overhead_pct", 5.0),
     ("collection_scan_stream", "flightrec_overhead_pct", 5.0),
     ("fleet_merge_scaling", "sketch_auroc_abs_err", 0.02),
+    # Serve-layer SLOs, absolute: steady-state pump must not shed, p99
+    # admit latency stays under the workload's 2s deadline, and the 64
+    # tenants' 8 groups must share exactly ONE compiled program (the
+    # per-signature cache claim — a second compile means coalescing
+    # broke).
+    ("serve_multitenant_64", "shed_rate", 0.05),
+    ("serve_multitenant_64", "p99_admit_latency_ms", 2000.0),
+    ("serve_multitenant_64", "programs_compiled", 1.0),
 )
 
 # (metric row, extras key, min required value) — absolute floors, for

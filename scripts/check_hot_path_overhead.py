@@ -201,6 +201,37 @@ def _drive_hot_path() -> None:
         list(evaluator3.result().values())[0]
     ).block_until_ready()
 
+    # The multi-tenant serve layer: admission (faults.fire + the
+    # admission/session record hooks), coalesced dispatch, a
+    # spill/resume round trip, and drain — every serve hook site is
+    # ENABLED-gated and must stay cold.
+    import tempfile
+
+    from torcheval_tpu.serve import EvalService
+
+    with tempfile.TemporaryDirectory() as spill_dir:
+        service = EvalService(
+            group_width=2, spill_dir=spill_dir, max_resident=1
+        )
+        for tenant in ("t0", "t1"):
+            service.open(
+                tenant,
+                {"acc": MulticlassAccuracy(num_classes=c, average="macro")},
+            )
+        for b in (33, 70):
+            for tenant in ("t0", "t1"):
+                service.submit(
+                    tenant,
+                    jnp.asarray(rng.random((b, c), dtype=np.float32)),
+                    jnp.asarray(rng.integers(0, c, b).astype(np.int32)),
+                )
+            service.pump()
+        for tenant in ("t0", "t1"):
+            jnp.asarray(
+                service.results(tenant)["acc"]
+            ).block_until_ready()
+        service.drain(deadline_s=30.0)
+
 
 def check(verbose: bool = True) -> List[str]:
     """Assert zero hook calls on the disabled path; returns the guarded

@@ -205,15 +205,13 @@ class TestGuardRemovalMutation(unittest.TestCase):
             _REPO_ROOT, "torcheval_tpu", "parallel", "_compile_cache.py"
         )
         with open(real, "r", encoding="utf-8") as f:
-            lines = f.read().splitlines(keepends=True)
-        guard_at = next(
-            i
-            for i, ln in enumerate(lines)
-            if "if not _telemetry.ENABLED:" in ln
+            source = f.read()
+        self.assertIn("if _telemetry.ENABLED:", source)
+        # Neutralize the guard: the hook call stays, its dominating
+        # ENABLED branch is gone.
+        mutated = source.replace(
+            "if _telemetry.ENABLED:", "if True:", 1
         )
-        mutated = "".join(
-            lines[:guard_at] + lines[guard_at + 2 :]
-        )  # drop the guard and its return
         with tempfile.TemporaryDirectory() as td:
             p = os.path.join(td, "mutated.py")
             with open(p, "w", encoding="utf-8") as f:

@@ -1,0 +1,139 @@
+"""The bounded LRU compile-cache primitive (``parallel/_compile_cache.
+LruCache``): capacity enforcement with LRU order, hit/miss/eviction
+counters, the ``TORCHEVAL_TPU_COMPILE_CACHE_CAP`` flag read at
+construction, and eviction events on the telemetry bus."""
+
+import os
+import threading
+import unittest
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.parallel._compile_cache import LruCache
+from torcheval_tpu.telemetry import events as ev
+
+
+class TestLruCacheBasics(unittest.TestCase):
+    def test_get_or_create_memoizes(self):
+        cache = LruCache(capacity=4)
+        calls = []
+        first = cache.get_or_create("k", lambda: calls.append(1) or "v")
+        second = cache.get_or_create("k", lambda: calls.append(1) or "v2")
+        self.assertEqual((first, second), ("v", "v"))
+        self.assertEqual(len(calls), 1)  # factory ran exactly once
+        info = cache.cache_info()
+        self.assertEqual(
+            (info.hits, info.misses, info.currsize, info.evictions),
+            (1, 1, 1, 0),
+        )
+
+    def test_eviction_drops_oldest_and_counts(self):
+        cache = LruCache(capacity=2)
+        cache.put("a", 1)
+        cache.put("b", 2)
+        cache.put("c", 3)  # over capacity: "a" (oldest) goes
+        self.assertEqual(len(cache), 2)
+        self.assertNotIn("a", cache)
+        self.assertIn("b", cache)
+        self.assertIn("c", cache)
+        self.assertEqual(cache.cache_info().evictions, 1)
+
+    def test_get_refreshes_recency(self):
+        cache = LruCache(capacity=2)
+        cache.put("a", 1)
+        cache.put("b", 2)
+        cache.get("a")  # "a" becomes most-recent; "b" is now oldest
+        cache.put("c", 3)
+        self.assertIn("a", cache)
+        self.assertNotIn("b", cache)
+
+    def test_clear_resets_counters(self):
+        cache = LruCache(capacity=1)
+        cache.put("a", 1)
+        cache.put("b", 2)
+        cache.get("b")
+        cache.get("zzz")
+        cache.clear()
+        info = cache.cache_info()
+        self.assertEqual(
+            (info.hits, info.misses, info.currsize, info.evictions),
+            (0, 0, 0, 0),
+        )
+
+    def test_concurrent_puts_stay_bounded(self):
+        cache = LruCache(capacity=8)
+
+        def writer(base):
+            for i in range(64):
+                cache.put((base, i), i)
+                cache.get((base, i))
+
+        threads = [
+            threading.Thread(target=writer, args=(t,)) for t in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        self.assertLessEqual(len(cache), 8)
+        info = cache.cache_info()
+        self.assertEqual(info.evictions, 4 * 64 - 8)
+
+
+class TestCapacityFlag(unittest.TestCase):
+    def setUp(self):
+        self._saved = os.environ.pop("TORCHEVAL_TPU_COMPILE_CACHE_CAP", None)
+
+    def tearDown(self):
+        if self._saved is not None:
+            os.environ["TORCHEVAL_TPU_COMPILE_CACHE_CAP"] = self._saved
+        else:
+            os.environ.pop("TORCHEVAL_TPU_COMPILE_CACHE_CAP", None)
+
+    def test_default_capacity_without_flag(self):
+        self.assertEqual(LruCache().capacity, 256)
+
+    def test_flag_read_at_construction(self):
+        os.environ["TORCHEVAL_TPU_COMPILE_CACHE_CAP"] = "7"
+        self.assertEqual(LruCache().capacity, 7)
+        # Explicit capacity wins over the flag.
+        self.assertEqual(LruCache(capacity=3).capacity, 3)
+
+    def test_invalid_flag_falls_back_silently(self):
+        for bad in ("0", "-4", "many"):
+            os.environ["TORCHEVAL_TPU_COMPILE_CACHE_CAP"] = bad
+            self.assertEqual(LruCache().capacity, 256, bad)
+
+
+class TestEvictionTelemetry(unittest.TestCase):
+    def setUp(self):
+        self._capacity = ev.capacity()
+        telemetry.disable()
+        telemetry.clear()
+
+    def tearDown(self):
+        ev.enable(capacity=self._capacity)
+        telemetry.disable()
+        telemetry.clear()
+
+    def test_eviction_lands_on_the_bus(self):
+        telemetry.enable()
+        cache = LruCache(capacity=1, name="probe", telemetry_events=True)
+        cache.put("a", 1)
+        cache.put("b", 2)  # evicts "a"
+        cache.get("b")  # hit
+        cache.get("zzz")  # miss
+        kinds = [e.kind for e in ev.events()]
+        self.assertIn("spmd_cache_evict", kinds)
+        self.assertIn("spmd_cache_hit", kinds)
+        self.assertIn("spmd_cache_miss", kinds)
+
+    def test_disabled_bus_records_nothing(self):
+        cache = LruCache(capacity=1, name="probe", telemetry_events=True)
+        cache.put("a", 1)
+        cache.put("b", 2)
+        cache.get("zzz")
+        self.assertEqual(ev.events(), [])
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -358,5 +358,80 @@ class TestEvaluatorResume(unittest.TestCase):
         )
 
 
+class TestNamespaces(unittest.TestCase):
+    """Per-tenant scoping (``namespace()`` / ``delete_all()``) — the
+    serve layer's spill-state contract — and the concurrent-prune
+    tolerance of ``load_latest``."""
+
+    def _tmp(self):
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="ckpt-ns-test-")
+        self.addCleanup(lambda: __import__("shutil").rmtree(d, True))
+        return d
+
+    def test_namespaces_are_isolated(self):
+        root = CheckpointManager(self._tmp(), keep=3)
+        a = root.namespace("tenant-a")
+        b = root.namespace("tenant-b")
+        self.assertEqual(a.keep, 3)  # keep is inherited
+        a.save({"m/s": np.float32(1.0)}, {"batches_seen": 1})
+        b.save({"m/s": np.float32(2.0)}, {"batches_seen": 2})
+        self.assertEqual(float(a.load_latest().state["m/s"]), 1.0)
+        self.assertEqual(float(b.load_latest().state["m/s"]), 2.0)
+        self.assertEqual(root.generations(), [])  # parent dir untouched
+
+    def test_delete_all_spares_siblings(self):
+        root = CheckpointManager(self._tmp())
+        a = root.namespace("tenant-a")
+        b = root.namespace("tenant-b")
+        a.save({"m/s": np.float32(1.0)}, {"batches_seen": 1})
+        b.save({"m/s": np.float32(2.0)}, {"batches_seen": 2})
+        a.delete_all()
+        a.delete_all()  # idempotent
+        self.assertFalse(os.path.exists(a.directory))
+        self.assertEqual(float(b.load_latest().state["m/s"]), 2.0)
+        # A reopened namespace starts fresh at generation 0.
+        a2 = root.namespace("tenant-a")
+        self.assertIsNone(a2.load_latest())
+        a2.save({"m/s": np.float32(9.0)}, {"batches_seen": 9})
+        self.assertEqual(a2.load_latest().generation, 0)
+
+    def test_namespace_names_are_sanitized(self):
+        root = CheckpointManager(self._tmp())
+        weird = root.namespace("ten ant/../x")
+        # Slashes are sanitized away: the namespace is a DIRECT child of
+        # the root directory, never a traversal out of it.
+        self.assertEqual(
+            os.path.dirname(weird.directory), root.directory
+        )
+        self.assertNotIn(os.sep, os.path.basename(weird.directory))
+        weird.save({"m/s": np.float32(1.0)}, {"batches_seen": 1})
+        self.assertIsNotNone(weird.load_latest())
+        with self.assertRaises(ValueError):
+            root.namespace("")
+
+    def test_concurrently_pruned_generation_skipped_not_quarantined(self):
+        mgr = CheckpointManager(self._tmp(), keep=5)
+        for i in range(3):
+            mgr.save({"m/s": np.float32(i)}, {"batches_seen": i})
+        # Newest generation is corrupt (bit flip), so the walk falls
+        # back; the middle generation's files are GONE (a concurrent
+        # writer pruned it) — it must be skipped without quarantine.
+        newest = mgr._data_path(2)
+        blob = bytearray(open(newest, "rb").read())
+        blob[0] ^= 0xFF
+        with open(newest, "wb") as fh:
+            fh.write(bytes(blob))
+        os.remove(mgr._data_path(1))
+        os.remove(mgr._manifest_path(1))
+        loaded = mgr.load_latest()
+        self.assertEqual(loaded.generation, 0)
+        names = os.listdir(mgr.directory)
+        # Gen 2 was quarantined (real corruption); gen 1 left no trace.
+        self.assertTrue(any("00000002" in n and "corrupt" in n for n in names))
+        self.assertFalse(any("00000001" in n for n in names))
+
+
 if __name__ == "__main__":
     unittest.main()

@@ -1,0 +1,422 @@
+"""Overload chaos for the multi-tenant eval service: burst 10x over
+capacity, poison tenants mid-stream (both a NaN batch under the
+data-health monitor and a metric that raises at dispatch), eviction
+under memory pressure, injected admission faults — the process never
+dies, every shed is a typed outcome, quarantine isolates exactly one
+tenant, and **unaffected tenants compute bit-identical results to solo
+runs**.  Ends with the 64-tenant acceptance drill."""
+
+import os
+import tempfile
+import time
+import unittest
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.metrics import MulticlassAccuracy, MulticlassF1Score
+from torcheval_tpu.resilience import FaultPlan, InjectedFault
+from torcheval_tpu.serve import (
+    Admitted,
+    AdmissionController,
+    EvalService,
+    Rejected,
+    Shed,
+)
+from torcheval_tpu.telemetry import events as ev
+from torcheval_tpu.telemetry import flightrec
+from torcheval_tpu.telemetry import health
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+_C = 5
+
+
+def _suite():
+    return {
+        "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+        "f1": MulticlassF1Score(num_classes=_C, average="macro"),
+    }
+
+
+def _batches(n, seed, rows=17):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.random((rows, _C), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, _C, rows).astype(np.int32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _solo(batches):
+    metrics = _suite()
+    for scores, target in batches:
+        for m in metrics.values():
+            m.update(scores, target)
+    return {name: m.compute() for name, m in metrics.items()}
+
+
+def _assert_bitwise(test, got, want):
+    test.assertEqual(set(got), set(want))
+    for name in want:
+        test.assertEqual(
+            np.asarray(got[name]).tobytes(),
+            np.asarray(want[name]).tobytes(),
+            f"{name} differs bitwise",
+        )
+
+
+def _nan_batch(rows=17):
+    scores = np.full((rows, _C), 0.5, dtype=np.float32)
+    scores[3, 1] = np.nan
+    return (
+        jnp.asarray(scores),
+        jnp.asarray(np.zeros(rows, dtype=np.int32)),
+    )
+
+
+class ServeIsolation(unittest.TestCase):
+    """Telemetry/health/flightrec all off before and after each test."""
+
+    def setUp(self):
+        self._capacity = ev.capacity()
+        telemetry.disable()
+        telemetry.clear()
+        health.disable()
+        flightrec.disable()
+        flightrec.reset()
+
+    def tearDown(self):
+        flightrec.disable()
+        flightrec.reset()
+        health.disable()
+        ev.enable(capacity=self._capacity)
+        telemetry.disable()
+        telemetry.clear()
+
+    def _tmp(self):
+        d = tempfile.mkdtemp(prefix="serve-chaos-")
+        self.addCleanup(lambda: __import__("shutil").rmtree(d, True))
+        return d
+
+
+class TestBurstShedding(ServeIsolation):
+    def test_burst_10x_sheds_typed_and_survives(self):
+        svc = EvalService(
+            group_width=2,
+            admission=AdmissionController(
+                global_capacity=8, per_tenant_capacity=8
+            ),
+        )
+        svc.open("a", _suite())
+        batch = _batches(1, seed=0)[0]
+        outcomes = [svc.submit("a", *batch) for _ in range(80)]
+        admitted = [o for o in outcomes if isinstance(o, Admitted)]
+        shed = [o for o in outcomes if isinstance(o, Shed)]
+        self.assertEqual(len(admitted), 8)
+        self.assertEqual(len(shed), 72)
+        for o in shed:  # typed reasons, never exceptions
+            self.assertIn(
+                o.reason, ("global-queue-full", "tenant-queue-full")
+            )
+        # The queue drains and the service still serves results.
+        self.assertEqual(svc.pump(), 8)
+        _assert_bitwise(
+            self, svc.results("a"), _solo([batch] * 8)
+        )
+
+    def test_fair_policy_sheds_only_the_flooder(self):
+        svc = EvalService(
+            group_width=2,
+            admission=AdmissionController(
+                global_capacity=8,
+                per_tenant_capacity=100,
+                policy="fair",
+            ),
+        )
+        svc.open("flood", _suite())
+        svc.open("quiet", _suite())
+        batch = _batches(1, seed=1)[0]
+        # Once "quiet" is a queued tenant the fair quota is
+        # global_capacity // 2 = 4: the flooder is capped there with
+        # room left in the global queue for the quiet tenant.
+        quiet_outcomes = [svc.submit("quiet", *batch)]
+        flood_outcomes = [
+            svc.submit("flood", *batch) for _ in range(20)
+        ]
+        quiet_outcomes += [
+            svc.submit("quiet", *batch) for _ in range(2)
+        ]
+        flood_shed = [o for o in flood_outcomes if isinstance(o, Shed)]
+        self.assertEqual(len(flood_shed), 20 - 4)
+        for o in flood_shed:
+            self.assertEqual(o.reason, "fair-quota")
+        self.assertTrue(
+            all(isinstance(o, Admitted) for o in quiet_outcomes)
+        )
+
+    def test_drop_oldest_evicts_the_stalest_item(self):
+        svc = EvalService(
+            group_width=2,
+            admission=AdmissionController(
+                global_capacity=2,
+                per_tenant_capacity=100,
+                policy="drop-oldest",
+            ),
+        )
+        svc.open("a", _suite())
+        batch = _batches(1, seed=2)[0]
+        for _ in range(5):
+            outcome = svc.submit("a", *batch)
+            self.assertIsInstance(outcome, Admitted)  # newest always in
+        self.assertEqual(svc.stats()["queue_depth"], 2)
+        self.assertEqual(svc.stats()["counts"]["shed"], 3)
+
+    def test_deadline_sheds_stale_items_at_pop(self):
+        svc = EvalService(group_width=2)
+        svc.open("a", _suite())
+        batch = _batches(1, seed=3)[0]
+        svc.submit("a", *batch, deadline_s=0.01)
+        time.sleep(0.05)
+        self.assertEqual(svc.pump(), 0)  # expired, never executed
+        self.assertEqual(svc.stats()["counts"]["shed"], 1)
+        self.assertEqual(svc.stats()["counts"]["dispatched"], 0)
+
+
+class TestQuarantine(ServeIsolation):
+    def test_nan_poison_quarantines_only_that_tenant(self):
+        health.enable(raise_on_corrupt=True)
+        svc = EvalService(group_width=4)
+        streams = {t: _batches(3, seed=i) for i, t in enumerate("abc")}
+        for tenant in streams:
+            svc.open(tenant, _suite())
+        svc.open("poison", _suite())
+        for step in range(2):
+            for tenant, batches in streams.items():
+                svc.submit(tenant, *batches[step])
+        svc.submit("poison", *_nan_batch())
+        svc.pump()  # the poison batch detonates inside the pump
+        for tenant, batches in streams.items():
+            svc.submit(tenant, *batches[2])
+        svc.pump()
+        # The poison tenant is fenced with a typed outcome...
+        outcome = svc.submit("poison", *_batches(1, seed=9)[0])
+        self.assertIsInstance(outcome, Rejected)
+        self.assertEqual(outcome.reason, "quarantined")
+        with self.assertRaises(RuntimeError):
+            svc.results("poison")
+        self.assertEqual(svc.stats()["counts"]["quarantined"], 1)
+        # ...and the co-seated tenants never noticed: bit-identical to
+        # solo runs of their own streams.
+        for tenant, batches in streams.items():
+            _assert_bitwise(self, svc.results(tenant), _solo(batches))
+
+    def test_raising_metric_quarantines_with_rollback(self):
+        """A structurally-broken batch (mismatched row counts) raises at
+        dispatch; the group rolls back to the pre-dispatch snapshot, so
+        the poison tenant's OWN earlier batches also stay intact for
+        forensics — and neighbours are untouched."""
+        svc = EvalService(group_width=4)
+        good = _batches(3, seed=11)
+        svc.open("good", _suite())
+        svc.open("bad", _suite())
+        bad_batches = _batches(2, seed=12)
+        for step in range(2):
+            svc.submit("good", *good[step])
+            svc.submit("bad", *bad_batches[step])
+        svc.pump()
+        scores, _ = _batches(1, seed=13, rows=17)[0]
+        wrong_target = jnp.zeros((5,), dtype=jnp.int32)  # 17 vs 5 rows
+        svc.submit("bad", scores, wrong_target)
+        svc.pump()  # must not raise
+        svc.submit("good", *good[2])
+        svc.pump()
+        self.assertIsInstance(
+            svc.submit("bad", *bad_batches[0]), Rejected
+        )
+        _assert_bitwise(self, svc.results("good"), _solo(good))
+
+    def test_quarantine_purges_the_backlog_and_emits_events(self):
+        telemetry.enable()
+        health.enable(raise_on_corrupt=True)
+        flightrec.enable(dir=self._tmp(), cooldown_s=0.0)
+        svc = EvalService(group_width=2)
+        svc.open("poison", _suite())
+        svc.submit("poison", *_nan_batch())
+        tail = _batches(3, seed=5)
+        for b in tail:
+            svc.submit("poison", *b)  # queued behind the poison
+        svc.pump()
+        # One dispatch ATTEMPT (the poison batch, rolled back); the
+        # queued backlog behind it was purged, never executed.
+        self.assertEqual(svc.stats()["counts"]["dispatched"], 1)
+        self.assertEqual(svc.stats()["queue_depth"], 0)
+        with self.assertRaises(RuntimeError):
+            svc.results("poison")
+        kinds = [e.kind for e in ev.events()]
+        self.assertIn("quarantine", kinds)
+        quarantine = next(
+            e for e in ev.events() if e.kind == "quarantine"
+        )
+        self.assertEqual(quarantine.tenant, "poison")
+        self.assertEqual(quarantine.reason, "data-corruption")
+        self.assertEqual(quarantine.batches_dropped, len(tail))
+        # A post-mortem bundle landed (the health escalation and the
+        # quarantine race the trigger; either bundle is acceptable).
+        self.assertTrue(flightrec.bundles())
+        report = telemetry.report()
+        self.assertEqual(report["serve"]["quarantined"], 1)
+
+
+class TestInjectedAdmissionFaults(ServeIsolation):
+    def test_raise_at_admit_surfaces_to_the_submitter(self):
+        svc = EvalService(group_width=2)
+        svc.open("a", _suite())
+        batch = _batches(1, seed=6)[0]
+        with FaultPlan(
+            [{"site": "serve.admit", "action": "raise", "count": 1}]
+        ):
+            with self.assertRaises(InjectedFault):
+                svc.submit("a", *batch)
+            # One-shot rule: the next submit is clean.
+            self.assertIsInstance(svc.submit("a", *batch), Admitted)
+        svc.pump()
+        _assert_bitwise(self, svc.results("a"), _solo([batch]))
+
+    def test_delay_at_admit_inflates_wait_not_correctness(self):
+        svc = EvalService(group_width=2)
+        svc.open("a", _suite())
+        batch = _batches(1, seed=7)[0]
+        with FaultPlan(
+            [
+                {
+                    "site": "serve.admit",
+                    "action": "delay",
+                    "delay_s": 0.05,
+                    "count": 1,
+                }
+            ]
+        ):
+            t0 = time.monotonic()
+            outcome = svc.submit("a", *batch)
+            elapsed = time.monotonic() - t0
+        self.assertIsInstance(outcome, Admitted)
+        self.assertGreaterEqual(elapsed, 0.05)
+        svc.pump()
+        _assert_bitwise(self, svc.results("a"), _solo([batch]))
+
+
+class TestAcceptance64Tenants(ServeIsolation):
+    def test_overload_with_poison_spill_and_burst(self):
+        """The ISSUE's acceptance drill: 64 tenants on width-8 groups,
+        a 10x burst over queue capacity, one poison tenant mid-stream,
+        residency pressure forcing spill/resume — the process survives,
+        every unaffected tenant is bit-identical to solo, the events
+        are on the bus, a flight-recorder bundle exists, and p99 admit
+        latency stays under the deadline."""
+        telemetry.enable()
+        health.enable(raise_on_corrupt=True)
+        flightrec.enable(dir=self._tmp(), cooldown_s=0.0)
+        deadline_s = 30.0
+        svc = EvalService(
+            group_width=8,
+            admission=AdmissionController(
+                global_capacity=64,
+                per_tenant_capacity=4,
+                deadline_s=deadline_s,
+            ),
+            spill_dir=self._tmp(),
+            max_resident=48,  # 64 tenants -> forced spill/resume churn
+        ).start()
+        self.addCleanup(svc.stop)
+
+        tenants = [f"tenant-{i:02d}" for i in range(64)]
+        streams = {
+            t: _batches(3, seed=i) for i, t in enumerate(tenants)
+        }
+        for tenant in tenants:
+            svc.open(tenant, _suite())
+        poison = tenants[17]
+
+        def submit_with_backoff(tenant, *batch):
+            """A well-behaved client: on a typed Shed, back off and
+            retry; the worker is draining so progress is guaranteed."""
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                outcome = svc.submit(tenant, *batch)
+                if isinstance(outcome, Admitted):
+                    return outcome
+                self.assertIsInstance(outcome, Shed)
+                time.sleep(0.005)
+            self.fail(f"submit for {tenant} never admitted")
+
+        applied = {t: [] for t in tenants}
+        for step in range(3):
+            for tenant in tenants:
+                if tenant == poison and step >= 1:
+                    if step == 1:
+                        submit_with_backoff(tenant, *_nan_batch())
+                    continue  # quarantined from here on
+                if step == 2 and tenant == tenants[0]:
+                    # A rude client: 10x burst, no backoff — sheds are
+                    # typed outcomes, and the per-tenant cap holds.
+                    for _ in range(10):
+                        outcome = svc.submit(tenant, *streams[tenant][2])
+                        if isinstance(outcome, Admitted):
+                            applied[tenant].append(streams[tenant][2])
+                        else:
+                            self.assertIsInstance(outcome, Shed)
+                    continue
+                submit_with_backoff(tenant, *streams[tenant][step])
+                applied[tenant].append(streams[tenant][step])
+
+        wait_deadline = time.monotonic() + 120.0
+        while time.monotonic() < wait_deadline:
+            if svc.stats()["queue_depth"] == 0:
+                break
+            time.sleep(0.02)
+        svc.stop()
+
+        stats = svc.stats()
+        self.assertEqual(stats["queue_depth"], 0)
+        # Coalescing held under churn: one compiled program total.
+        self.assertEqual(stats["programs"]["misses"], 1)
+        # Spill/resume actually happened under the residency cap.
+        self.assertGreater(stats["counts"]["spills"], 0)
+        self.assertGreater(stats["counts"]["resumes"], 0)
+        self.assertEqual(stats["counts"]["quarantined"], 1)
+        # p99 admit latency (queue wait) stayed under the deadline.
+        self.assertLess(stats["admit_wait_p99_s"], deadline_s)
+
+        # Exactly the poison tenant is fenced...
+        self.assertIsInstance(
+            svc.submit(poison, *streams[poison][0]), Rejected
+        )
+        # ...and all 63 others are bit-identical to solo runs over the
+        # batches that were actually admitted for them.
+        for tenant in tenants:
+            if tenant == poison:
+                continue
+            self.assertTrue(applied[tenant], tenant)
+            _assert_bitwise(
+                self, svc.results(tenant), _solo(applied[tenant])
+            )
+
+        report = telemetry.report()
+        self.assertIn("serve", report)
+        self.assertEqual(report["serve"]["quarantined"], 1)
+        self.assertGreater(report["serve"]["dispatched"], 0)
+        self.assertGreater(sum(report["serve"]["shed"].values()), 0)
+        self.assertGreater(
+            report["serve"]["sessions"].get("spill", 0), 0
+        )
+        self.assertTrue(flightrec.bundles())
+
+
+if __name__ == "__main__":
+    unittest.main()

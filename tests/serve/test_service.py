@@ -1,0 +1,318 @@
+"""Multi-tenant eval service (torcheval_tpu/serve/): session lifecycle,
+signature coalescing onto shared compiled programs, spill/resume
+bit-identity, graceful drain, and the background worker.
+
+The headline claim everywhere: a tenant served through the shared
+sliced machinery computes **bit-identical** results to a solo, unsliced
+run of the same metrics over the same batches — across co-tenancy,
+overflow groups, spill/resume (even onto a different seat), and
+neighbours being quarantined (see ``test_overload.py``)."""
+
+import os
+import tempfile
+import time
+import unittest
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import serve
+from torcheval_tpu.metrics import MulticlassAccuracy, MulticlassF1Score
+from torcheval_tpu.serve import (
+    Admitted,
+    AdmissionController,
+    EvalService,
+    Rejected,
+    signature_of,
+)
+
+pytestmark = pytest.mark.serve
+
+_C = 5
+
+
+def _suite():
+    return {
+        "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+        "f1": MulticlassF1Score(num_classes=_C, average="macro"),
+    }
+
+
+def _batches(n, seed, rows=17):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.random((rows, _C), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, _C, rows).astype(np.int32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _solo(batches):
+    """The reference: plain unsliced metrics over the same stream."""
+    metrics = _suite()
+    for scores, target in batches:
+        for m in metrics.values():
+            m.update(scores, target)
+    return {name: m.compute() for name, m in metrics.items()}
+
+
+def _assert_bitwise(test, got, want):
+    test.assertEqual(set(got), set(want))
+    for name in want:
+        test.assertEqual(
+            np.asarray(got[name]).tobytes(),
+            np.asarray(want[name]).tobytes(),
+            f"{name} differs bitwise",
+        )
+
+
+class _SpillDirMixin(unittest.TestCase):
+    def _tmp(self):
+        d = tempfile.mkdtemp(prefix="serve-test-")
+        self.addCleanup(lambda: __import__("shutil").rmtree(d, True))
+        return d
+
+
+class TestServiceBasics(_SpillDirMixin):
+    def test_results_bit_identical_to_solo(self):
+        svc = EvalService(group_width=4)
+        streams = {t: _batches(4, seed=i) for i, t in enumerate("abc")}
+        for tenant in streams:
+            svc.open(tenant, _suite())
+        # Interleave submissions across tenants (round-robin).
+        for step in range(4):
+            for tenant, batches in streams.items():
+                outcome = svc.submit(tenant, *batches[step])
+                self.assertIsInstance(outcome, Admitted)
+        svc.pump()
+        for tenant, batches in streams.items():
+            _assert_bitwise(self, svc.results(tenant), _solo(batches))
+
+    def test_slice_ids_is_service_owned(self):
+        svc = EvalService()
+        svc.open("a", _suite())
+        scores, target = _batches(1, seed=0)[0]
+        with self.assertRaises(TypeError):
+            svc.submit("a", scores, target, slice_ids=jnp.zeros(17))
+
+    def test_unknown_tenant(self):
+        svc = EvalService()
+        outcome = svc.submit("ghost", jnp.zeros((2, _C)), jnp.zeros(2))
+        self.assertIsInstance(outcome, Rejected)
+        self.assertEqual(outcome.reason, "unknown-tenant")
+        with self.assertRaises(KeyError):
+            svc.results("ghost")
+
+    def test_duplicate_open_raises(self):
+        svc = EvalService()
+        svc.open("a", _suite())
+        with self.assertRaises(ValueError):
+            svc.open("a", _suite())
+
+    def test_closed_tenant_rejected(self):
+        svc = EvalService()
+        svc.open("a", _suite())
+        svc.close("a")
+        outcome = svc.submit("a", *_batches(1, seed=0)[0])
+        self.assertIsInstance(outcome, Rejected)
+        self.assertEqual(outcome.reason, "unknown-tenant")
+        with self.assertRaises(RuntimeError):
+            svc.results("a")
+
+    def test_open_adopts_existing_state(self):
+        """Metrics with history fold it into the seat: results include
+        updates applied before open()."""
+        batches = _batches(3, seed=7)
+        metrics = _suite()
+        for m in metrics.values():
+            m.update(*batches[0])
+        svc = EvalService()
+        svc.open("a", metrics)
+        for b in batches[1:]:
+            svc.submit("a", *b)
+        svc.pump()
+        _assert_bitwise(self, svc.results("a"), _solo(batches))
+
+    def test_stats_shape(self):
+        svc = EvalService()
+        svc.open("a", _suite())
+        svc.submit("a", *_batches(1, seed=0)[0])
+        svc.pump()
+        stats = svc.stats()
+        self.assertEqual(stats["queue_depth"], 0)
+        self.assertEqual(stats["tenants"], {"active": 1})
+        self.assertEqual(stats["groups"], 1)
+        self.assertEqual(stats["counts"]["admitted"], 1)
+        self.assertEqual(stats["counts"]["dispatched"], 1)
+        self.assertEqual(
+            set(stats["programs"]),
+            {"currsize", "hits", "misses", "evictions"},
+        )
+
+
+class TestCoalescing(unittest.TestCase):
+    def test_same_signature_shares_group_and_program(self):
+        svc = EvalService(group_width=4)
+        for i, tenant in enumerate("abc"):
+            svc.open(tenant, _suite())
+            svc.submit(tenant, *_batches(1, seed=i)[0])
+        svc.pump()
+        stats = svc.stats()
+        self.assertEqual(stats["groups"], 1)
+        self.assertEqual(stats["programs"]["misses"], 1)
+
+    def test_overflow_groups_share_one_program(self):
+        """5 tenants at width 2 need 3 groups — but exactly ONE compiled
+        program, keyed by (signature, width, health), serves them all."""
+        svc = EvalService(group_width=2)
+        streams = {f"t{i}": _batches(2, seed=i) for i in range(5)}
+        for tenant, batches in streams.items():
+            svc.open(tenant, _suite())
+            for b in batches:
+                svc.submit(tenant, *b)
+        svc.pump()
+        stats = svc.stats()
+        self.assertEqual(stats["groups"], 3)
+        self.assertEqual(stats["programs"]["misses"], 1)
+        self.assertEqual(stats["programs"]["currsize"], 1)
+        for tenant, batches in streams.items():
+            _assert_bitwise(self, svc.results(tenant), _solo(batches))
+
+    def test_different_config_does_not_coalesce(self):
+        svc = EvalService(group_width=4)
+        svc.open("five", {"acc": MulticlassAccuracy(num_classes=5)})
+        svc.open("seven", {"acc": MulticlassAccuracy(num_classes=7)})
+        self.assertEqual(svc.stats()["groups"], 2)
+
+    def test_same_type_different_average_does_not_coalesce(self):
+        self.assertNotEqual(
+            signature_of(
+                {"f1": MulticlassF1Score(num_classes=5, average="macro")}
+            ),
+            signature_of(
+                {"f1": MulticlassF1Score(num_classes=5, average="micro")}
+            ),
+        )
+
+    def test_member_name_is_part_of_the_signature(self):
+        self.assertNotEqual(
+            signature_of({"a": MulticlassAccuracy(num_classes=5)}),
+            signature_of({"b": MulticlassAccuracy(num_classes=5)}),
+        )
+
+    def test_explicit_signature_override_splits(self):
+        svc = EvalService(group_width=4)
+        svc.open("a", _suite())
+        svc.open("b", _suite(), signature=("isolated",))
+        self.assertEqual(svc.stats()["groups"], 2)
+
+
+class TestSpillResume(_SpillDirMixin):
+    def test_spill_resume_bit_identity_on_a_different_seat(self):
+        svc = EvalService(group_width=2, spill_dir=self._tmp())
+        streams = {"a": _batches(3, seed=1), "b": _batches(3, seed=2)}
+        for tenant, batches in streams.items():
+            svc.open(tenant, _suite())
+            for b in batches[:2]:
+                svc.submit(tenant, *b)
+        svc.pump()
+        # Spill both; "b" (originally seat 1) resumes first and lands on
+        # seat 0 — legal because seat state is keyed without the index.
+        svc.spill("a")
+        svc.spill("b")
+        self.assertEqual(svc.stats()["tenants"], {"spilled": 2})
+        for tenant in ("b", "a"):
+            svc.submit(tenant, *streams[tenant][2])
+        svc.pump()
+        for tenant, batches in streams.items():
+            _assert_bitwise(self, svc.results(tenant), _solo(batches))
+        counts = svc.stats()["counts"]
+        self.assertEqual(counts["spills"], 2)
+        self.assertEqual(counts["resumes"], 2)
+
+    def test_max_resident_spills_lru_transparently(self):
+        svc = EvalService(
+            group_width=1, spill_dir=self._tmp(), max_resident=1
+        )
+        streams = {"a": _batches(3, seed=1), "b": _batches(3, seed=2)}
+        for tenant in streams:
+            svc.open(tenant, _suite())
+        for step in range(3):  # alternating tenants force churn
+            for tenant, batches in streams.items():
+                svc.submit(tenant, *batches[step])
+                svc.pump()
+        self.assertGreater(svc.stats()["counts"]["spills"], 0)
+        for tenant, batches in streams.items():
+            _assert_bitwise(self, svc.results(tenant), _solo(batches))
+
+    def test_close_deletes_spill_state_but_spares_siblings(self):
+        spill_dir = self._tmp()
+        svc = EvalService(group_width=1, spill_dir=spill_dir)
+        streams = {"a": _batches(2, seed=1), "b": _batches(2, seed=2)}
+        for tenant, batches in streams.items():
+            svc.open(tenant, _suite())
+            for b in batches:
+                svc.submit(tenant, *b)
+        svc.pump()
+        svc.spill("a")
+        svc.spill("b")
+        svc.close("a")
+        self.assertEqual(os.listdir(os.path.join(spill_dir, "a")) if
+                         os.path.isdir(os.path.join(spill_dir, "a"))
+                         else [], [])
+        _assert_bitwise(self, svc.results("b"), _solo(streams["b"]))
+
+    def test_spill_without_dir_raises(self):
+        svc = EvalService()
+        svc.open("a", _suite())
+        with self.assertRaises(RuntimeError):
+            svc.spill("a")
+
+
+class TestWorkerAndDrain(_SpillDirMixin):
+    def test_background_worker_processes_submissions(self):
+        svc = EvalService(group_width=2).start()
+        self.addCleanup(svc.stop)
+        batches = _batches(4, seed=3)
+        svc.open("a", _suite())
+        for b in batches:
+            self.assertIsInstance(svc.submit("a", *b), Admitted)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            stats = svc.stats()
+            if (
+                stats["queue_depth"] == 0
+                and stats["counts"]["dispatched"] == len(batches)
+            ):
+                break
+            time.sleep(0.01)
+        _assert_bitwise(self, svc.results("a"), _solo(batches))
+
+    def test_drain_flushes_and_closes(self):
+        svc = EvalService(group_width=2, spill_dir=self._tmp())
+        batches = _batches(3, seed=4)
+        svc.open("a", _suite())
+        for b in batches:
+            svc.submit("a", *b)  # queued, never pumped
+        summary = svc.drain(deadline_s=60.0)
+        self.assertEqual(summary["processed"], len(batches))
+        self.assertTrue(summary["flushed"])
+        self.assertEqual(summary["pending"], 0)
+        # Resident state went to durable storage during drain.
+        self.assertEqual(svc.stats()["tenants"], {"spilled": 1})
+        outcome = svc.submit("a", *batches[0])
+        self.assertIsInstance(outcome, Rejected)
+        self.assertEqual(outcome.reason, "closed")
+        with self.assertRaises(RuntimeError):
+            svc.open("b", _suite())
+
+    def test_stop_without_start_is_noop(self):
+        EvalService().stop()
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -92,7 +92,7 @@ class TestDisabledBus(TelemetryIsolation):
         self.assertIsInstance(rep["trace_counts"], dict)
         self.assertEqual(
             set(rep["spmd_cache"]),
-            {"hits", "misses", "maxsize", "currsize", "hit_rate"},
+            {"hits", "misses", "maxsize", "currsize", "hit_rate", "evictions"},
         )
         self.assertEqual(rep["events_captured"], 0)
 
@@ -190,6 +190,21 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
                 ),
             )
         )
+        # spmd_cache_evict — an LruCache overflow (capacity 1).
+        from torcheval_tpu.parallel._compile_cache import LruCache
+
+        lru = LruCache(capacity=1, name="rt-evict", telemetry_events=True)
+        lru.put("a", 1)
+        lru.put("b", 2)
+        # admission / quarantine / session_* — the serve layer's hooks
+        # (the real service paths are covered by tests/serve; recording
+        # directly keeps this round-trip fast and deterministic).
+        ev.record_admission("rt-tenant", "admitted", queue_depth=1)
+        ev.record_quarantine(
+            "rt-tenant", "update-error", error="rt", batches_dropped=2
+        )
+        for action in ("open", "spill", "resume", "close", "drain"):
+            ev.record_session(action, "rt-tenant")
 
     def test_every_kind_round_trips(self):
         self._generate_all_kinds()

@@ -325,6 +325,31 @@ _DECLARATIONS: Tuple[Flag, ...] = (
         read_at="import",
     ),
     Flag(
+        name="COMPILE_CACHE_CAP",
+        kind="int",
+        default=256,
+        doc=(
+            "Capacity (entries) of the bounded LRU compile caches — the "
+            "shared SPMD program memoizer, the engine's per-signature "
+            "scan cache, and the serve layer's program cache; read when "
+            "each cache is constructed.  Non-positive or unparseable "
+            "values fall back silently."
+        ),
+        validate=_positive,
+        read_at="import",
+    ),
+    Flag(
+        name="SERVE_SPILL_DIR",
+        kind="str",
+        default=None,
+        doc=(
+            "Default directory the serve layer spills idle tenant "
+            "sessions into (``serve.EvalService(spill_dir=...)`` "
+            "overrides); unset, spill is disabled unless a directory "
+            "is passed explicitly."
+        ),
+    ),
+    Flag(
         name="KV_TIMEOUT_MS",
         kind="int",
         default=600_000,

@@ -4,7 +4,7 @@ module-level import graph.
 The survey's architectural rule — "Lower layers never import higher
 ones" (PAPER.md §1) — with the package-level order
 
-    ops/native -> metrics -> engine/parallel/resilience ->
+    ops/native -> metrics -> engine/parallel/resilience/serve ->
     monitor/telemetry -> tools -> tests
 
 refined to module granularity where the hook architecture demands it:
@@ -69,6 +69,7 @@ _PREFIX: Tuple[Tuple[str, int], ...] = (
     ("torcheval_tpu.engine", 3),
     ("torcheval_tpu.parallel", 3),
     ("torcheval_tpu.resilience", 3),
+    ("torcheval_tpu.serve", 3),
     ("torcheval_tpu.monitor", 4),
     ("torcheval_tpu.telemetry", 4),
     ("torcheval_tpu.tools", 5),

@@ -27,6 +27,7 @@ import jax
 from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics.collection import MetricCollection, _call_signature
 from torcheval_tpu.ops import _flags
+from torcheval_tpu.parallel import _compile_cache
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
 from torcheval_tpu.telemetry import health as _health
@@ -107,7 +108,10 @@ class ScanRunner:
         )
         # Signatures already executed — same steady-state contract as
         # MetricCollection._fused_seen: a hit means no trace can run.
-        self._seen: set = set()
+        # Bounded (TORCHEVAL_TPU_COMPILE_CACHE_CAP): a resident server
+        # streams unbounded signature variety; evicting just re-runs the
+        # cheap host-side _check_fusable on a revisit.
+        self._seen = _compile_cache.LruCache(name="engine_scan_seen")
 
     @property
     def donate(self) -> bool:
@@ -132,7 +136,7 @@ class ScanRunner:
             # (before any state is read) — the kill the checkpoint/resume
             # suite recovers from.
             _faults.fire("engine.scan", signature=hash(key))
-        if key not in self._seen:
+        if self._seen.get(key) is None:
             col._check_fusable()
         before = col._read_states()
         try:
@@ -142,7 +146,7 @@ class ScanRunner:
                 _telemetry.record_donation("abort")
             col._install_states(before, guard_deleted=True)
             raise
-        self._seen.add(key)
+        self._seen.put(key, True)
         if self._health:
             new_states, stats = out
         else:

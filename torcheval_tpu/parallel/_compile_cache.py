@@ -10,76 +10,204 @@ already-compiled program instead.
 One builder convention for every call site: ``builder(statics, mesh,
 axis) -> jitted fn``, with ``statics`` a hashable tuple.
 
+The memoizer is a :class:`LruCache` — a bounded, eviction-counting LRU
+shared with the engine's per-signature scan cache and the serve layer's
+program cache.  A resident server cannot tolerate unbounded compile
+caches: capacity comes from ``TORCHEVAL_TPU_COMPILE_CACHE_CAP`` (read
+when the cache is constructed; default 256) and the oldest entry is
+dropped past it, counted in ``telemetry.report()``'s ``spmd_cache``
+section and on the bus as ``spmd_cache_evict`` events.
+
 Each lookup is also a telemetry hook (``spmd_cache_hit`` /
-``spmd_cache_miss`` events): with the bus enabled, the miss counter is
-diffed around the memoized call; disabled, the lookup is the bare
-``lru_cache`` hit it always was behind a single branch.
+``spmd_cache_miss`` events): with the bus enabled, the hit/miss outcome
+is recorded around the memoized call; disabled, the lookup is a bare
+dict probe behind a single branch.
 """
 
 from __future__ import annotations
 
-from functools import lru_cache
-from typing import NamedTuple
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Hashable, NamedTuple, Optional
 
 from jax.sharding import Mesh
 
+from torcheval_tpu import _flags
 from torcheval_tpu.telemetry import events as _telemetry
 
 
 class SpmdCacheInfo(NamedTuple):
     """``functools.CacheInfo`` plus the memory footprint of the cached
-    programs: ``peak_bytes`` is the largest ``memory_analysis()`` peak
-    perfscope priced across the ``spmd:*`` programs (0 until perfscope
-    has profiled one — enable with ``TORCHEVAL_TPU_PERFSCOPE=1``)."""
+    programs and the eviction count: ``peak_bytes`` is the largest
+    ``memory_analysis()`` peak perfscope priced across the ``spmd:*``
+    programs (0 until perfscope has profiled one — enable with
+    ``TORCHEVAL_TPU_PERFSCOPE=1``); ``evictions`` counts entries dropped
+    past the LRU capacity."""
 
     hits: int
     misses: int
     maxsize: int
     currsize: int
     peak_bytes: int = 0
+    evictions: int = 0
 
 
-@lru_cache(maxsize=256)
-def _compiled_spmd_cached(builder, statics, mesh: Mesh, axis: str):
-    return builder(statics, mesh, axis)
+def _capacity_from_flag() -> int:
+    value = _flags.get("COMPILE_CACHE_CAP")
+    return value if isinstance(value, int) and value > 0 else 256
+
+
+class LruCache:
+    """Bounded LRU memoizer with hit/miss/eviction counters.
+
+    The shared shape for every compile-adjacent cache in the library:
+    the SPMD program memoizer below, ``engine.scan.ScanRunner``'s
+    per-signature set, and the serve layer's cross-tenant program cache.
+    ``capacity=None`` reads ``TORCHEVAL_TPU_COMPILE_CACHE_CAP`` at
+    construction.  ``telemetry_events=True`` records each lookup (and
+    each eviction) on the bus behind the usual one-branch guard.
+
+    Thread-safe: the serve layer probes its program cache from a worker
+    thread while tests drive ``compiled_spmd`` from the main thread.
+    """
+
+    def __init__(
+        self,
+        capacity: Optional[int] = None,
+        *,
+        name: str = "cache",
+        telemetry_events: bool = False,
+    ) -> None:
+        self.name = name
+        self.capacity = (
+            capacity if capacity and capacity > 0 else _capacity_from_flag()
+        )
+        self._events = telemetry_events
+        self._data: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def __contains__(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._data
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        """Probe without counting a miss-for-insert: refreshes recency
+        and counts a hit when present, counts a miss when absent."""
+        with self._lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+                self.hits += 1
+                hit = True
+                value = self._data[key]
+            else:
+                self.misses += 1
+                hit = False
+                value = default
+        if self._events and _telemetry.ENABLED:
+            _telemetry.record_cache(hit=hit)
+        return value
+
+    def put(self, key: Hashable, value: Any) -> None:
+        evicted = False
+        with self._lock:
+            self._data[key] = value
+            self._data.move_to_end(key)
+            if len(self._data) > self.capacity:
+                self._data.popitem(last=False)
+                self.evictions += 1
+                evicted = True
+        if evicted and self._events and _telemetry.ENABLED:
+            _telemetry.record_cache(hit=False, evicted=True)
+
+    def get_or_create(self, key: Hashable, factory: Callable[[], Any]) -> Any:
+        """The memoizer: counts a hit or runs ``factory`` and inserts
+        (one miss, possibly one eviction).  ``factory`` runs outside the
+        lock — compiles are slow and must not serialize unrelated
+        lookups; a concurrent duplicate insert is harmless (last write
+        wins, both values are equivalent programs)."""
+        sentinel = _MISSING
+        value = self.get(key, sentinel)
+        if value is not sentinel:
+            return value
+        value = factory()
+        self.put(key, value)
+        return value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self.hits = 0
+            self.misses = 0
+            self.evictions = 0
+
+    def cache_info(self) -> SpmdCacheInfo:
+        with self._lock:
+            return SpmdCacheInfo(
+                self.hits,
+                self.misses,
+                self.capacity,
+                len(self._data),
+                0,
+                self.evictions,
+            )
+
+
+_MISSING = object()
+
+_SPMD_CACHE = LruCache(name="spmd", telemetry_events=False)
 
 
 def compiled_spmd(builder, statics, mesh: Mesh, axis: str):
-    if not _telemetry.ENABLED:
-        return _compiled_spmd_cached(builder, statics, mesh, axis)
-    misses_before = _compiled_spmd_cached.cache_info().misses
-    fn = _compiled_spmd_cached(builder, statics, mesh, axis)
-    _telemetry.record_cache(
-        hit=_compiled_spmd_cached.cache_info().misses == misses_before
-    )
+    key = (builder, statics, mesh, axis)
+    fn = _SPMD_CACHE.get(key, _MISSING)
+    hit = fn is not _MISSING
+    if not hit:
+        fn = builder(statics, mesh, axis)
+        _SPMD_CACHE.put(key, fn)
+    if _telemetry.ENABLED:
+        _telemetry.record_cache(hit=hit)
     return fn
 
 
-# ``compiled_spmd`` was the lru_cache object itself before the telemetry
+# ``compiled_spmd`` was an lru_cache object itself before the telemetry
 # wrapper; callers (``parallel/exact.py``, tests) introspect it like one.
-compiled_spmd.cache_info = _compiled_spmd_cached.cache_info
-compiled_spmd.cache_clear = _compiled_spmd_cached.cache_clear
+compiled_spmd.cache_info = _SPMD_CACHE.cache_info
+compiled_spmd.cache_clear = _SPMD_CACHE.clear
 
 
 def spmd_cache_info() -> SpmdCacheInfo:
-    """Hit/miss counters of the shared sharded-program memoizer — a
-    :class:`SpmdCacheInfo` ``(hits, misses, maxsize, currsize,
-    peak_bytes)``.  A steady-state eval loop should show hits climbing
-    and misses flat; climbing misses mean program churn (e.g. rebuilding
-    meshes per step, which keys a fresh entry every call).
-    ``peak_bytes`` reports the largest perfscope-priced memory peak
-    among the cached programs.  Surfaced by
+    """Hit/miss/eviction counters of the shared sharded-program memoizer
+    — a :class:`SpmdCacheInfo` ``(hits, misses, maxsize, currsize,
+    peak_bytes, evictions)``.  A steady-state eval loop should show hits
+    climbing and misses flat; climbing misses mean program churn (e.g.
+    rebuilding meshes per step, which keys a fresh entry every call);
+    climbing evictions mean the working set exceeds
+    ``TORCHEVAL_TPU_COMPILE_CACHE_CAP`` and programs are being recompiled
+    in rotation.  ``peak_bytes`` reports the largest perfscope-priced
+    memory peak among the cached programs.  Surfaced by
     :func:`torcheval_tpu.routing.hot_path_stats`."""
-    info = _compiled_spmd_cached.cache_info()
+    info = _SPMD_CACHE.cache_info()
     peak = 0
     for program, entry in _telemetry.aggregates()["perf"].items():
         if program.startswith("spmd:"):
             peak = max(peak, entry["peak_bytes"])
     return SpmdCacheInfo(
-        info.hits, info.misses, info.maxsize, info.currsize, peak
+        info.hits,
+        info.misses,
+        info.maxsize,
+        info.currsize,
+        peak,
+        info.evictions,
     )
 
 
 def spmd_cache_clear() -> None:
     """Drop every memoized sharded program (test isolation hook)."""
-    _compiled_spmd_cached.cache_clear()
+    _SPMD_CACHE.clear()

@@ -36,6 +36,7 @@ import json
 import os
 import pickle
 import re
+import shutil
 import time
 from dataclasses import dataclass
 from typing import Any, Dict, List, Mapping, Optional
@@ -47,6 +48,12 @@ from torcheval_tpu.telemetry import events as _telemetry
 
 _DATA_RE = re.compile(r"^ckpt-(\d{8})\.bin$")
 _MANIFEST_VERSION = 1
+_NAMESPACE_SAFE_RE = re.compile(r"[^A-Za-z0-9._-]")
+
+# Sentinel returned by _load_one for a generation whose files vanished
+# between the directory listing and the read — a concurrent _prune, not
+# corruption.  Distinct from None (validation failure → quarantine).
+_CONCURRENTLY_PRUNED = object()
 
 
 @dataclass
@@ -84,6 +91,27 @@ class CheckpointManager:
         self.directory = str(directory)
         self.keep = keep
         os.makedirs(self.directory, exist_ok=True)
+
+    # -- scoping ---------------------------------------------------------
+    def namespace(self, name: str) -> "CheckpointManager":
+        """A child manager over the subdirectory ``name`` (sanitized to
+        filename-safe characters), inheriting ``keep``.  Namespaces are
+        how the serve layer keys per-tenant spill state: each tenant's
+        generations live in their own subtree, so one tenant's
+        :meth:`delete_all` on close cannot touch a sibling's."""
+        safe = _NAMESPACE_SAFE_RE.sub("_", name)
+        if not safe:
+            raise ValueError(f"namespace name sanitizes to empty: {name!r}")
+        return CheckpointManager(
+            os.path.join(self.directory, safe), keep=self.keep
+        )
+
+    def delete_all(self) -> None:
+        """Remove this manager's directory tree — generations,
+        quarantined ``.corrupt`` files, and child namespaces.  Siblings
+        of this directory are never touched.  Idempotent; errors from
+        concurrent cleanup are swallowed like :meth:`_prune`'s."""
+        shutil.rmtree(self.directory, ignore_errors=True)
 
     # -- paths -----------------------------------------------------------
     def _data_path(self, generation: int) -> str:
@@ -186,32 +214,62 @@ class CheckpointManager:
     # -- read ------------------------------------------------------------
     def load_latest(self) -> Optional[Checkpoint]:
         """Newest checkpoint that validates; corrupt generations are
-        quarantined and older ones tried.  None when nothing valid."""
-        for generation in reversed(self.generations()):
-            t0 = time.monotonic()
-            loaded = self._load_one(generation)
-            if loaded is None:
-                self._quarantine(generation)
-                continue
-            if _telemetry.ENABLED:
-                _telemetry.record_checkpoint(
-                    "restore",
-                    loaded.path,
-                    generation,
-                    loaded.nbytes,
-                    time.monotonic() - t0,
-                )
-            return loaded
-        return None
+        quarantined and older ones tried.  None when nothing valid.
 
-    def _load_one(self, generation: int) -> Optional[Checkpoint]:
+        Tolerates a concurrent writer pruning while this reader walks:
+        a NON-newest generation whose files are gone by read time was
+        concurrently pruned and is skipped without quarantine (only the
+        newest generation can legitimately be torn — data is written
+        before manifest, and _prune never touches the newest ``keep``).
+        If every listed generation vanished mid-walk the stale listing
+        is refreshed once before giving up."""
+        for attempt in range(2):
+            gens = self.generations()
+            if not gens:
+                return None
+            pruned_under_us = 0
+            for generation in reversed(gens):
+                t0 = time.monotonic()
+                loaded = self._load_one(
+                    generation, newest=(generation == gens[-1])
+                )
+                if loaded is _CONCURRENTLY_PRUNED:
+                    pruned_under_us += 1
+                    continue
+                if loaded is None:
+                    self._quarantine(generation)
+                    continue
+                if _telemetry.ENABLED:
+                    _telemetry.record_checkpoint(
+                        "restore",
+                        loaded.path,
+                        generation,
+                        loaded.nbytes,
+                        time.monotonic() - t0,
+                    )
+                return loaded
+            if pruned_under_us == 0 or attempt == 1:
+                return None
+        return None  # pragma: no cover - loop always returns
+
+    def _load_one(self, generation: int, *, newest: bool = True):
         data_path = self._data_path(generation)
         try:
             with open(self._manifest_path(generation), "rb") as fh:
                 manifest = json.loads(fh.read().decode("utf-8"))
             with open(data_path, "rb") as fh:
                 payload = fh.read()
-        except (OSError, ValueError, UnicodeDecodeError):
+        except OSError:
+            # Missing files on an older generation mean a concurrent
+            # _prune won the race, not corruption; the newest generation
+            # has no such excuse (save order is data-then-manifest).
+            if not newest and not (
+                os.path.exists(data_path)
+                or os.path.exists(self._manifest_path(generation))
+            ):
+                return _CONCURRENTLY_PRUNED
+            return None
+        except (ValueError, UnicodeDecodeError):
             return None
         if (
             len(payload) != manifest.get("nbytes")

@@ -36,6 +36,13 @@ in action):
 ``sync.dispatch``
     Per synced-update dispatch in ``parallel/sync.py`` (context:
     ``op``).
+``serve.admit``
+    Per ``EvalService.submit`` call in the multi-tenant serve layer
+    (context: ``tenant``, ``queue_depth``).  ``action="raise"``
+    propagates an :class:`InjectedFault` to the submitter (the service
+    itself stays consistent — overload chaos drives bursts through a
+    failing admission path); ``action="delay"`` stalls admission to
+    manufacture queue pressure.
 ``merge.level``
     Each participation step of the hierarchical fleet merge
     (``parallel/fleet_merge.py``; context: ``rank``, ``level``,

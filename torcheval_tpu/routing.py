@@ -125,6 +125,7 @@ def hot_path_stats() -> dict:
     rep = telemetry.report()
     cache = dict(rep["spmd_cache"])
     cache.pop("hit_rate", None)
+    cache.pop("evictions", None)  # legacy view predates bounded caches
     return {"trace_counts": rep["trace_counts"], "spmd_cache": cache}
 
 

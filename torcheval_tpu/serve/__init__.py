@@ -1,0 +1,45 @@
+"""Multi-tenant eval serving: coalesced sessions under admission
+control.
+
+The serve layer turns the sliced-collection machinery into a
+long-running, overload-safe service: many tenants' metric suites
+coalesce by signature onto shared fused programs
+(:mod:`~torcheval_tpu.serve.registry`), bursts are absorbed by bounded
+queues with typed shed outcomes
+(:mod:`~torcheval_tpu.serve.admission`), and a poison tenant is
+quarantined — rolled back, purged, reported — without perturbing its
+neighbours (:mod:`~torcheval_tpu.serve.service`).  Idle sessions spill
+to checkpoints and resume transparently.
+
+See ``docs/source/serve.rst`` for the operating model and runbooks.
+"""
+
+from torcheval_tpu.serve.admission import (
+    POLICIES,
+    Admitted,
+    AdmissionController,
+    Rejected,
+    Shed,
+)
+from torcheval_tpu.serve.registry import (
+    DEFAULT_GROUP_WIDTH,
+    Session,
+    SessionRegistry,
+    TenantGroup,
+    signature_of,
+)
+from torcheval_tpu.serve.service import EvalService
+
+__all__ = [
+    "Admitted",
+    "AdmissionController",
+    "DEFAULT_GROUP_WIDTH",
+    "EvalService",
+    "POLICIES",
+    "Rejected",
+    "Session",
+    "SessionRegistry",
+    "Shed",
+    "TenantGroup",
+    "signature_of",
+]

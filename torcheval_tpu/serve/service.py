@@ -1,0 +1,627 @@
+"""EvalService — the overload-safe multi-tenant front door.
+
+One service owns a :class:`~torcheval_tpu.serve.registry.
+SessionRegistry` (tenant seating + shared programs), an
+:class:`~torcheval_tpu.serve.admission.AdmissionController` (bounded
+queues + shed policies), and the spill/quarantine machinery that keeps
+one misbehaving tenant from taking the rest down:
+
+* **Backpressure** — ``submit()`` never blocks and never throws under
+  load; it returns a typed outcome the caller branches on.  A 10×
+  burst degrades into shed events, not an OOM or a dead process.
+* **Poison quarantine** — a tenant whose batch trips the data-health
+  monitor (or whose update raises) is rolled back from the
+  pre-dispatch state snapshot, its queued work purged, and the tenant
+  marked quarantined; a ``QuarantineEvent`` lands on the bus and the
+  flight recorder dumps a post-mortem bundle.  Because tenants only
+  ever touch their own seat's masked slice, every other tenant's
+  results stay bit-identical to a solo run.
+* **Idle spill** — past ``max_resident`` seated tenants, the
+  least-recently-touched sessions are checkpointed through
+  :class:`~torcheval_tpu.resilience.checkpoint.CheckpointManager`
+  (per-tenant namespace) and their seats freed; the next touch
+  transparently resumes them, possibly on a different seat or group.
+* **Graceful drain** — ``drain()`` stops admission, pumps the queue to
+  empty under a deadline, and final-checkpoints every resident tenant.
+
+Processing is pull-based: call :meth:`EvalService.pump` from your own
+loop, or :meth:`start` a background worker thread (stop it with
+:meth:`stop`; :meth:`drain` stops it too).  All hook sites follow the
+one-branch zero-cost-when-off contract.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+import warnings
+from collections import deque
+from typing import Any, Dict, List, Mapping, Optional, Tuple, Union
+
+from torcheval_tpu import _flags
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.resilience import faults as _faults
+from torcheval_tpu.resilience.checkpoint import CheckpointManager
+from torcheval_tpu.telemetry import events as _telemetry
+from torcheval_tpu.telemetry import flightrec as _flightrec
+from torcheval_tpu.telemetry import trace as _trace
+from torcheval_tpu.telemetry.health import DataCorruptionError
+
+from torcheval_tpu.serve.admission import (
+    Admitted,
+    AdmissionController,
+    QueueItem,
+    Rejected,
+    Shed,
+)
+from torcheval_tpu.serve.registry import (
+    ACTIVE,
+    CLOSED,
+    QUARANTINED,
+    SPILLED,
+    DEFAULT_GROUP_WIDTH,
+    Session,
+    SessionRegistry,
+)
+
+# Worker join budget on stop(); a worker alive past it is reported, not
+# silently leaked (mirrors engine/prefetch.py).
+_JOIN_TIMEOUT_S = 5.0
+
+# Worker idle poll period: a submit sets the wake event, so this only
+# bounds shutdown latency when the queue stays empty.
+_IDLE_TICK_S = 0.01
+
+# Host-side admit-wait reservoir for stats()/the bench p99 (the bus
+# histogram is the durable record; this keeps stats() telemetry-free).
+_WAIT_WINDOW = 4096
+
+
+def _p99(waits: List[float]) -> float:
+    if not waits:
+        return 0.0
+    ordered = sorted(waits)
+    rank = max(0, math.ceil(0.99 * len(ordered)) - 1)
+    return ordered[rank]
+
+
+class EvalService:
+    """Multi-tenant metric evaluation with admission control.
+
+    Thread-safety: every public method is safe to call from any thread;
+    registry and session mutations serialize on one reentrant lock, and
+    the admission controller's internal lock is only ever taken under
+    it (fixed lock order: service → admission).
+    """
+
+    def __init__(
+        self,
+        *,
+        group_width: int = DEFAULT_GROUP_WIDTH,
+        bucket: bool = True,
+        admission: Optional[AdmissionController] = None,
+        spill_dir: Optional[str] = None,
+        max_resident: Optional[int] = None,
+        keep: int = 2,
+    ) -> None:
+        self._registry = SessionRegistry(
+            group_width=group_width, bucket=bucket
+        )
+        self._admission = (
+            admission if admission is not None else AdmissionController()
+        )
+        if spill_dir is None:
+            spill_dir = _flags.get("SERVE_SPILL_DIR")
+        self._spill_root = (
+            CheckpointManager(spill_dir, keep=keep)
+            if spill_dir is not None
+            else None
+        )
+        if max_resident is not None and max_resident < 1:
+            raise ValueError(
+                f"max_resident must be >= 1, got {max_resident}"
+            )
+        self._max_resident = max_resident
+        self._lock = threading.RLock()
+        self._draining = False
+        self._closed = False
+        self._waits: deque = deque(maxlen=_WAIT_WINDOW)
+        self._counts: Dict[str, int] = {
+            "admitted": 0,
+            "shed": 0,
+            "rejected": 0,
+            "dispatched": 0,
+            "quarantined": 0,
+            "spills": 0,
+            "resumes": 0,
+        }
+        self._worker: Optional[threading.Thread] = None
+        self._stop_flag = threading.Event()
+        self._wake = threading.Event()
+
+    # ------------------------------------------------------------ sessions
+    def open(
+        self,
+        tenant: str,
+        metrics: Mapping[str, Metric],
+        *,
+        signature: Optional[Tuple[Any, ...]] = None,
+    ) -> Session:
+        """Register ``tenant``; same-signature tenants coalesce onto a
+        shared sliced collection.  The metrics' current states are
+        adopted into the tenant's seat."""
+        with self._lock:
+            if self._closed or self._draining:
+                raise RuntimeError(
+                    "EvalService is draining/closed; no new sessions"
+                )
+            session = self._registry.open(
+                tenant, metrics, signature=signature
+            )
+            if _telemetry.ENABLED:
+                _telemetry.record_session("open", tenant)
+            self._maybe_spill(exclude=session)
+            return session
+
+    def close(self, tenant: str) -> None:
+        """End ``tenant``'s session: purge its queue, free its seat,
+        and delete its spill namespace (siblings untouched)."""
+        with self._lock:
+            session = self._registry.session(tenant)
+            if session is None:
+                raise KeyError(f"unknown tenant {tenant!r}")
+            self._admission.purge(tenant)
+            self._registry.release(session)
+            if self._spill_root is not None:
+                self._spill_root.namespace(tenant).delete_all()
+            session.state = CLOSED
+            if _telemetry.ENABLED:
+                _telemetry.record_session("close", tenant)
+
+    # ----------------------------------------------------------- admission
+    def submit(
+        self,
+        tenant: str,
+        *args: Any,
+        deadline_s: Optional[float] = None,
+        **kwargs: Any,
+    ) -> Union[Admitted, Shed, Rejected]:
+        """Offer one batch.  Non-blocking; returns a typed outcome.
+        Positional arrays and ``mask=``/``weight=`` keywords flow to
+        the metrics' ``update`` unchanged (``slice_ids`` is owned by
+        the service and must not be passed)."""
+        if "slice_ids" in kwargs:
+            raise TypeError(
+                "slice_ids= is assigned by the service (the tenant's seat)"
+            )
+        if _faults.ENABLED:
+            _faults.fire(
+                "serve.admit",
+                tenant=tenant,
+                # tpulint: disable=TPU006 -- depth() locks internally; fire stays outside self._lock so injected delays can't stall the pump
+                queue_depth=self._admission.depth(),
+            )
+        with self._lock:
+            session = self._registry.session(tenant)
+            if session is None or session.state == CLOSED:
+                return self._reject(tenant, "unknown-tenant")
+            if session.state == QUARANTINED:
+                return self._reject(tenant, "quarantined")
+            if self._closed:
+                return self._reject(tenant, "closed")
+            if self._draining:
+                return self._reject(tenant, "draining")
+            ctx = _trace.capture() if _trace.ENABLED else None
+            outcome, dropped = self._admission.offer(
+                tenant,
+                args,
+                kwargs,
+                now=time.monotonic(),
+                deadline_s=deadline_s,
+                trace_ctx=ctx,
+            )
+            for victim in dropped:
+                self._counts["shed"] += 1
+                if _telemetry.ENABLED:
+                    _telemetry.record_admission(
+                        victim.tenant,
+                        "shed",
+                        reason="drop-oldest",
+                        policy=self._admission.policy,
+                        queue_depth=outcome.queue_depth,
+                    )
+            if isinstance(outcome, Admitted):
+                self._counts["admitted"] += 1
+                if _telemetry.ENABLED:
+                    _telemetry.record_admission(
+                        tenant,
+                        "admitted",
+                        policy=self._admission.policy,
+                        queue_depth=outcome.queue_depth,
+                    )
+            else:
+                self._counts["shed"] += 1
+                if _telemetry.ENABLED:
+                    _telemetry.record_admission(
+                        tenant,
+                        "shed",
+                        reason=outcome.reason,
+                        policy=self._admission.policy,
+                        queue_depth=outcome.queue_depth,
+                    )
+        self._wake.set()
+        return outcome
+
+    def _reject(self, tenant: str, reason: str) -> Rejected:
+        self._counts["rejected"] += 1
+        if _telemetry.ENABLED:
+            _telemetry.record_admission(
+                tenant,
+                "rejected",
+                reason=reason,
+                policy=self._admission.policy,
+                queue_depth=self._admission.depth(),
+            )
+        return Rejected(tenant=tenant, reason=reason)
+
+    # ---------------------------------------------------------- processing
+    def pump(self, max_items: Optional[int] = None) -> int:
+        """Process queued batches synchronously; returns how many were
+        dispatched.  Deadline-expired items are shed at pop, never
+        executed."""
+        processed = 0
+        while max_items is None or processed < max_items:
+            # Same lock order as submit (service, then admission's own
+            # lock inside pop) — and the shed accounting must not race
+            # submit's counter updates.
+            with self._lock:
+                item, expired = self._admission.pop(now=time.monotonic())
+                for stale in expired:
+                    self._counts["shed"] += 1
+                    if _telemetry.ENABLED:
+                        _telemetry.record_admission(
+                            stale.tenant,
+                            "shed",
+                            reason="deadline",
+                            policy=self._admission.policy,
+                            queue_depth=self._admission.depth(),
+                        )
+            if item is None:
+                break
+            if self._process(item):
+                processed += 1
+        return processed
+
+    def _process(self, item: QueueItem) -> bool:
+        with self._lock:
+            session = self._registry.session(item.tenant)
+            if session is None or session.state in (QUARANTINED, CLOSED):
+                # Quarantined/closed after this item was queued (purge
+                # raced the pop): drop it, don't execute it.
+                self._counts["shed"] += 1
+                if _telemetry.ENABLED:
+                    _telemetry.record_admission(
+                        item.tenant,
+                        "shed",
+                        reason="tenant-gone",
+                        policy=self._admission.policy,
+                        queue_depth=self._admission.depth(),
+                    )
+                return False
+            wait = time.monotonic() - item.enqueued_at
+            self._waits.append(wait)
+            self._counts["dispatched"] += 1
+            if _telemetry.ENABLED:
+                _telemetry.record_admission(
+                    item.tenant,
+                    "dispatched",
+                    policy=self._admission.policy,
+                    queue_depth=self._admission.depth(),
+                    wait_s=wait,
+                )
+            self._ensure_resident(session)
+            col = session.group.collection
+            # donate=False keeps these refs alive: the free rollback
+            # point the quarantine path restores from (a health
+            # escalation fires AFTER the poisoned states installed).
+            snapshot = col._read_states()
+            t0 = time.monotonic()
+            try:
+                if _trace.ENABLED and item.trace_ctx is not None:
+                    with _trace.activate(item.trace_ctx):
+                        with _trace.span("serve.dispatch"):
+                            self._registry.dispatch(
+                                session, item.args, item.kwargs
+                            )
+                else:
+                    self._registry.dispatch(session, item.args, item.kwargs)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as exc:  # noqa: BLE001 - isolation boundary
+                col._install_states(snapshot, guard_deleted=True)
+                self._quarantine(session, exc)
+                return False
+            session.batches += 1
+            self._registry.touch(session)
+            if _telemetry.ENABLED:
+                _telemetry.record_span(
+                    "update",
+                    "EvalService.dispatch",
+                    time.monotonic() - t0,
+                    0,
+                )
+            self._maybe_spill(exclude=session)
+            return True
+
+    def _quarantine(self, session: Session, exc: BaseException) -> None:
+        # Caller holds the lock and has already rolled the group's
+        # states back to the pre-dispatch snapshot.
+        reason = (
+            "data-corruption"
+            if isinstance(exc, DataCorruptionError)
+            else "update-error"
+        )
+        session.state = QUARANTINED
+        session.quarantine_reason = f"{type(exc).__name__}: {exc}"
+        self._registry.release(session)
+        purged = self._admission.purge(session.tenant)
+        self._counts["quarantined"] += 1
+        self._counts["shed"] += len(purged)
+        if _telemetry.ENABLED:
+            _telemetry.record_quarantine(
+                session.tenant,
+                reason,
+                error=session.quarantine_reason,
+                batches_dropped=len(purged),
+            )
+        if _flightrec.ENABLED:
+            _flightrec.trigger(
+                "tenant_quarantine",
+                f"tenant={session.tenant} {reason}",
+                extra={
+                    "serve": {
+                        "tenant": session.tenant,
+                        "reason": reason,
+                        "error": session.quarantine_reason,
+                        "batches_dropped": len(purged),
+                        "batches_applied": session.batches,
+                    }
+                },
+            )
+
+    # ------------------------------------------------------------- results
+    def results(self, tenant: str) -> Dict[str, Any]:
+        """``compute()`` over the tenant's seat (resuming it first if
+        spilled).  Quarantined tenants raise with their reason."""
+        with self._lock:
+            session = self._registry.session(tenant)
+            if session is None:
+                raise KeyError(f"unknown tenant {tenant!r}")
+            if session.state == QUARANTINED:
+                raise RuntimeError(
+                    f"tenant {tenant!r} is quarantined: "
+                    f"{session.quarantine_reason}"
+                )
+            if session.state == CLOSED:
+                raise RuntimeError(f"tenant {tenant!r} session is closed")
+            self._ensure_resident(session)
+            self._registry.touch(session)
+            out = self._registry.compute(session)
+            if _telemetry.ENABLED:
+                for name, value in out.items():
+                    try:
+                        _telemetry.record_quality(
+                            name,
+                            slice_label=tenant,
+                            window="lifetime",
+                            value=float(value),
+                            step=session.batches,
+                        )
+                    except (TypeError, ValueError):
+                        pass  # non-scalar results don't ride the bus
+            return out
+
+    # --------------------------------------------------------------- spill
+    def spill(self, tenant: str) -> None:
+        """Explicitly checkpoint-and-evict one resident tenant."""
+        with self._lock:
+            session = self._registry.session(tenant)
+            if session is None:
+                raise KeyError(f"unknown tenant {tenant!r}")
+            if session.state != ACTIVE:
+                raise RuntimeError(
+                    f"tenant {tenant!r} is not resident (state="
+                    f"{session.state})"
+                )
+            if self._spill_root is None:
+                raise RuntimeError(
+                    "spill requires spill_dir= (or the "
+                    "TORCHEVAL_TPU_SERVE_SPILL_DIR flag)"
+                )
+            self._spill_one(session)
+
+    def _spill_one(self, session: Session) -> None:
+        t0 = time.monotonic()
+        flat = self._registry.seat_state_dict(session)
+        manager = self._spill_root.namespace(session.tenant)
+        path = manager.save(flat, {"batches_seen": session.batches})
+        self._registry.release(session)
+        session.state = SPILLED
+        self._counts["spills"] += 1
+        if _telemetry.ENABLED:
+            _telemetry.record_session(
+                "spill",
+                session.tenant,
+                generation=manager.generations()[-1],
+                nbytes=os.path.getsize(path),
+                seconds=time.monotonic() - t0,
+            )
+
+    def _maybe_spill(self, exclude: Optional[Session] = None) -> None:
+        if self._spill_root is None or self._max_resident is None:
+            return
+        lru = self._registry.resident_lru()
+        over = len(lru) - self._max_resident
+        for session in lru:
+            if over <= 0:
+                break
+            if session is exclude or session.state != ACTIVE:
+                continue
+            self._spill_one(session)
+            over -= 1
+
+    def _ensure_resident(self, session: Session) -> None:
+        if session.state != SPILLED:
+            return
+        t0 = time.monotonic()
+        self._registry.attach(session)
+        checkpoint = None
+        if self._spill_root is not None:
+            checkpoint = self._spill_root.namespace(
+                session.tenant
+            ).load_latest()
+        if checkpoint is not None:
+            self._registry.load_seat(session, checkpoint.state)
+            session.batches = int(
+                checkpoint.cursor.get("batches_seen", session.batches)
+            )
+        elif _telemetry.ENABLED:
+            # Spilled state unrecoverable (corrupt/missing generations):
+            # the seat restarts from reset — operator-visible data loss.
+            _telemetry.record_degraded(
+                "serve.resume",
+                f"tenant {session.tenant!r}: no valid spill checkpoint; "
+                "seat reset",
+                "data_loss",
+            )
+        self._counts["resumes"] += 1
+        if _telemetry.ENABLED:
+            _telemetry.record_session(
+                "resume",
+                session.tenant,
+                generation=(
+                    checkpoint.generation if checkpoint is not None else 0
+                ),
+                nbytes=checkpoint.nbytes if checkpoint is not None else 0,
+                seconds=time.monotonic() - t0,
+            )
+
+    # --------------------------------------------------------------- drain
+    def drain(self, deadline_s: Optional[float] = None) -> Dict[str, Any]:
+        """Graceful shutdown: stop admission, pump the queue to empty
+        (bounded by ``deadline_s``), final-checkpoint every resident
+        tenant, and close the service.  Idempotent."""
+        t0 = time.monotonic()
+        with self._lock:
+            self._draining = True
+        self.stop()
+        deadline = None if deadline_s is None else t0 + deadline_s
+        processed = 0
+        while deadline is None or time.monotonic() < deadline:
+            if self.pump(1) == 0:
+                break
+            processed += 1
+        flushed = True
+        with self._lock:
+            if self._spill_root is not None:
+                for session in self._registry.resident_lru():
+                    if (
+                        deadline is not None
+                        and time.monotonic() >= deadline
+                    ):
+                        flushed = False
+                        break
+                    if session.state == ACTIVE:
+                        self._spill_one(session)
+            pending = self._admission.depth()
+            self._closed = True
+        if _telemetry.ENABLED:
+            _telemetry.record_session(
+                "drain", "", seconds=time.monotonic() - t0
+            )
+        return {
+            "processed": processed,
+            "flushed": flushed and pending == 0,
+            "pending": pending,
+        }
+
+    # -------------------------------------------------------------- worker
+    def start(self) -> "EvalService":
+        """Start the background pump thread (idempotent)."""
+        with self._lock:
+            if self._worker is not None:
+                return self
+            if self._closed:
+                raise RuntimeError("EvalService is closed")
+            self._stop_flag.clear()
+            # contextvars do not flow into Thread targets; hand the
+            # caller's trace context over explicitly (prefetch idiom).
+            worker_ctx = _trace.capture() if _trace.ENABLED else None
+            self._worker = threading.Thread(
+                target=self._run,
+                args=(worker_ctx,),
+                name="torcheval-tpu-serve",
+                daemon=True,
+            )
+            self._worker.start()
+        return self
+
+    def _run(self, worker_ctx: Any) -> None:
+        if _trace.ENABLED:
+            _trace.adopt(worker_ctx)
+        while not self._stop_flag.is_set():
+            if self.pump(16) == 0:
+                self._wake.wait(timeout=_IDLE_TICK_S)
+                self._wake.clear()
+
+    def stop(self) -> None:
+        """Stop and join the worker thread (idempotent)."""
+        with self._lock:
+            worker = self._worker
+            self._worker = None
+        if worker is None:
+            return
+        self._stop_flag.set()
+        self._wake.set()
+        worker.join(timeout=_JOIN_TIMEOUT_S)
+        if worker.is_alive():
+            # Daemon thread: the process can still exit, but a silent
+            # leak would mask a wedged dispatch — report it.
+            if _telemetry.ENABLED:
+                _telemetry.record_degraded(
+                    "serve.stop",
+                    f"worker thread still alive after {_JOIN_TIMEOUT_S:g}s "
+                    "join",
+                    "leaked_thread",
+                )
+            warnings.warn(
+                "EvalService.stop(): worker thread did not exit within "
+                f"{_JOIN_TIMEOUT_S:g}s and was leaked (daemon). A metric "
+                "dispatch is likely wedged.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+    # --------------------------------------------------------------- stats
+    def stats(self) -> Dict[str, Any]:
+        """Host-side service counters (valid with telemetry off)."""
+        with self._lock:
+            states: Dict[str, int] = {}
+            for session in self._registry.sessions().values():
+                states[session.state] = states.get(session.state, 0) + 1
+            info = self._registry.program_cache_info()
+            return {
+                "queue_depth": self._admission.depth(),
+                "tenants": states,
+                "groups": self._registry.group_count(),
+                "programs": {
+                    "currsize": info.currsize,
+                    "hits": info.hits,
+                    "misses": info.misses,
+                    "evictions": info.evictions,
+                },
+                "admit_wait_p99_s": _p99(list(self._waits)),
+                "counts": dict(self._counts),
+            }

@@ -66,6 +66,7 @@ from torcheval_tpu.telemetry.aggregate import (
     merge_snapshots,
 )
 from torcheval_tpu.telemetry.events import (
+    AdmissionEvent,
     AlertEvent,
     BucketPadEvent,
     CacheEvent,
@@ -78,9 +79,11 @@ from torcheval_tpu.telemetry.events import (
     PrefetchStallEvent,
     ProgramProfileEvent,
     QualityEvent,
+    QuarantineEvent,
     RetraceEvent,
     RetryEvent,
     RouteDowngradeEvent,
+    SessionEvent,
     SpanEvent,
     SyncEvent,
     clear,
@@ -243,6 +246,7 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
             "maxsize": info.maxsize,
             "currsize": info.currsize,
             "hit_rate": info.hits / lookups if lookups else 0.0,
+            "evictions": info.evictions,
         },
         "retrace": {"total": retrace_total, "top_offenders": offenders},
         "route_downgrades": {"total": downgrade_total, "by_kind": by_kind},
@@ -321,12 +325,39 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
                 min(sliced, key=lambda e: e["value"]) if sliced else None
             ),
         }
+    srv = agg["serve"]
+    if (
+        srv["admitted"]
+        or srv["shed"]
+        or srv["rejected"]
+        or srv["quarantined"]
+        or srv["sessions"]
+    ):
+        admitted = srv["admitted"]
+        shed_total = sum(srv["shed"].values())
+        offered = admitted + shed_total
+        dispatched = srv["dispatched"]
+        result["serve"] = {
+            "admitted": admitted,
+            "shed": dict(srv["shed"]),
+            "shed_rate": shed_total / offered if offered else 0.0,
+            "rejected": dict(srv["rejected"]),
+            "dispatched": dispatched["calls"],
+            "mean_admit_wait_s": (
+                dispatched["wait_seconds"] / dispatched["calls"]
+                if dispatched["calls"]
+                else 0.0
+            ),
+            "quarantined": srv["quarantined"],
+            "sessions": dict(srv["sessions"]),
+        }
     if as_text:
         return format_report(result)
     return result
 
 
 __all__ = [
+    "AdmissionEvent",
     "AlertEvent",
     "BucketPadEvent",
     "CacheEvent",
@@ -339,9 +370,11 @@ __all__ = [
     "PrefetchStallEvent",
     "ProgramProfileEvent",
     "QualityEvent",
+    "QuarantineEvent",
     "RetraceEvent",
     "RetryEvent",
     "RouteDowngradeEvent",
+    "SessionEvent",
     "SloRule",
     "SpanEvent",
     "SyncEvent",

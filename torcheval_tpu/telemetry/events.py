@@ -114,13 +114,20 @@ class RetraceEvent(Event):
 @dataclass
 class CacheEvent(Event):
     """One lookup in the shared sharded-program memoizer
-    (``parallel/_compile_cache.compiled_spmd``)."""
+    (``parallel/_compile_cache.compiled_spmd``) — or, with ``evicted``,
+    one entry dropped past an :class:`~torcheval_tpu.parallel.
+    _compile_cache.LruCache`'s capacity (``TORCHEVAL_TPU_
+    COMPILE_CACHE_CAP``): a revisit of the evicted key will recompile."""
 
     kind: str = field(init=False, default="spmd_cache_hit")
     hit: bool = True
+    evicted: bool = False
 
     def __post_init__(self) -> None:
-        self.kind = "spmd_cache_hit" if self.hit else "spmd_cache_miss"
+        if self.evicted:
+            self.kind = "spmd_cache_evict"
+        else:
+            self.kind = "spmd_cache_hit" if self.hit else "spmd_cache_miss"
 
 
 @dataclass
@@ -336,12 +343,68 @@ class SpanEvent(Event):
     state_bytes: int = 0
 
 
+@dataclass
+class AdmissionEvent(Event):
+    """One admission decision of the multi-tenant serve layer
+    (:mod:`torcheval_tpu.serve`): ``outcome`` is ``admitted`` (enqueued),
+    ``shed`` (load-shedding dropped it — ``reason`` names which policy
+    limit: per-tenant/global queue full, deadline expired at pop,
+    drop-oldest victim, quarantine purge), ``rejected`` (never eligible:
+    unknown/quarantined/draining tenant), or ``dispatched`` (an admitted
+    batch reached its collection; ``wait_s`` is its queue wait — the
+    admit-latency histogram the p99 SLO rule reads)."""
+
+    kind: str = field(init=False, default="admission")
+    tenant: str = ""
+    outcome: str = "admitted"  # "admitted" | "shed" | "rejected" | "dispatched"
+    reason: str = ""
+    policy: str = ""
+    queue_depth: int = 0
+    wait_s: float = 0.0
+
+
+@dataclass
+class QuarantineEvent(Event):
+    """A poison tenant was isolated by the serve layer: its batch raised
+    (or tripped ``DataCorruptionError``), its group state was rolled
+    back to the pre-dispatch snapshot, its queued batches were purged
+    (``batches_dropped``), and it now rejects new submissions — every
+    other tenant's results remain bit-identical to a solo run."""
+
+    kind: str = field(init=False, default="quarantine")
+    tenant: str = ""
+    reason: str = ""
+    error: str = ""
+    batches_dropped: int = 0
+
+
+@dataclass
+class SessionEvent(Event):
+    """Tenant-session lifecycle in the serve registry: ``open`` (seat
+    acquired), ``spill`` (idle state checkpointed to disk and the seat's
+    device buffers reset), ``resume`` (spilled state reloaded on next
+    touch), ``close`` (seat released, spill namespace pruned), ``drain``
+    (flushed under the shutdown deadline).  ``generation``/``nbytes``
+    carry the checkpoint identity for spill/resume."""
+
+    kind: str = field(init=False, default="session_open")
+    action: str = "open"  # "open" | "spill" | "resume" | "close" | "drain"
+    tenant: str = ""
+    generation: int = 0
+    nbytes: int = 0
+    seconds: float = 0.0
+
+    def __post_init__(self) -> None:
+        self.kind = f"session_{self.action}"
+
+
 # Every event kind the bus can carry → its dataclass, for the JSON-lines
 # round trip (``export.event_from_dict``).
 KIND_TO_CLASS: Dict[str, type] = {
     "retrace": RetraceEvent,
     "spmd_cache_hit": CacheEvent,
     "spmd_cache_miss": CacheEvent,
+    "spmd_cache_evict": CacheEvent,
     "route_downgrade": RouteDowngradeEvent,
     "bucket_pad": BucketPadEvent,
     "donation_restore": DonationEvent,
@@ -357,6 +420,13 @@ KIND_TO_CLASS: Dict[str, type] = {
     "program_profile": ProgramProfileEvent,
     "alert": AlertEvent,
     "quality": QualityEvent,
+    "admission": AdmissionEvent,
+    "quarantine": QuarantineEvent,
+    "session_open": SessionEvent,
+    "session_spill": SessionEvent,
+    "session_resume": SessionEvent,
+    "session_close": SessionEvent,
+    "session_drain": SessionEvent,
 }
 
 
@@ -364,7 +434,7 @@ KIND_TO_CLASS: Dict[str, type] = {
 def _zero_aggregates() -> Dict[str, Any]:
     return {
         "retrace": {},          # (program, callsite) -> count
-        "cache": {"hits": 0, "misses": 0},
+        "cache": {"hits": 0, "misses": 0, "evictions": 0},
         "route_downgrade": {},  # (route_kind, callsite) -> count
         "bucket_pad": {},       # bucket -> {"rows_valid": n, "rows_padded": n, "calls": n}
         "donation": {"restore": 0, "abort": 0},
@@ -413,6 +483,21 @@ def _zero_aggregates() -> Dict[str, Any]:
         # emissions, "min"/"max": extrema observed since clear, "step":
         # last publisher cursor}.
         "quality": {},
+        # Multi-tenant serve-layer accounting (torcheval_tpu/serve):
+        # shed/rejected key by reason; sessions by lifecycle action;
+        # dispatched carries the queue-wait (admit-latency) histogram.
+        "serve": {
+            "admitted": 0,
+            "shed": {},
+            "rejected": {},
+            "dispatched": {
+                "calls": 0,
+                "wait_seconds": 0.0,
+                "hist": [0] * (len(DURATION_BUCKETS) + 1),
+            },
+            "quarantined": 0,
+            "sessions": {},
+        },
         "emitted": 0,
     }
 
@@ -539,6 +624,14 @@ def aggregates() -> Dict[str, Any]:
             "perf": {k: dict(v) for k, v in _agg["perf"].items()},
             "alerts": {k: dict(v) for k, v in _agg["alerts"].items()},
             "quality": {k: dict(v) for k, v in _agg["quality"].items()},
+            "serve": {
+                "admitted": _agg["serve"]["admitted"],
+                "shed": dict(_agg["serve"]["shed"]),
+                "rejected": dict(_agg["serve"]["rejected"]),
+                "dispatched": _copy_hist_entry(_agg["serve"]["dispatched"]),
+                "quarantined": _agg["serve"]["quarantined"],
+                "sessions": dict(_agg["serve"]["sessions"]),
+            },
             "emitted": _agg["emitted"],
         }
 
@@ -594,7 +687,10 @@ def _fold(event: Event) -> None:
         key = (event.program, event.callsite)
         _agg["retrace"][key] = _agg["retrace"].get(key, 0) + 1
     elif isinstance(event, CacheEvent):
-        _agg["cache"]["hits" if event.hit else "misses"] += 1
+        if event.evicted:
+            _agg["cache"]["evictions"] += 1
+        else:
+            _agg["cache"]["hits" if event.hit else "misses"] += 1
     elif isinstance(event, RouteDowngradeEvent):
         key = (event.route_kind, event.callsite)
         _agg["route_downgrade"][key] = (
@@ -729,6 +825,28 @@ def _fold(event: Event) -> None:
         entry["min"] = min(entry["min"], event.value)
         entry["max"] = max(entry["max"], event.value)
         entry["step"] = event.step
+    elif isinstance(event, AdmissionEvent):
+        serve = _agg["serve"]
+        if event.outcome == "admitted":
+            serve["admitted"] += 1
+        elif event.outcome == "shed":
+            serve["shed"][event.reason] = (
+                serve["shed"].get(event.reason, 0) + 1
+            )
+        elif event.outcome == "rejected":
+            serve["rejected"][event.reason] = (
+                serve["rejected"].get(event.reason, 0) + 1
+            )
+        elif event.outcome == "dispatched":
+            entry = serve["dispatched"]
+            entry["calls"] += 1
+            entry["wait_seconds"] += event.wait_s
+            entry["hist"][_hist_slot(event.wait_s)] += 1
+    elif isinstance(event, QuarantineEvent):
+        _agg["serve"]["quarantined"] += 1
+    elif isinstance(event, SessionEvent):
+        sessions = _agg["serve"]["sessions"]
+        sessions[event.action] = sessions.get(event.action, 0) + 1
     elif isinstance(event, SpanEvent):
         entry = _agg["spans"].setdefault(
             (event.name, event.phase),
@@ -752,8 +870,8 @@ def record_retrace(program: str) -> None:
     emit(RetraceEvent(program=program))
 
 
-def record_cache(hit: bool) -> None:
-    emit(CacheEvent(hit=hit))
+def record_cache(hit: bool, evicted: bool = False) -> None:
+    emit(CacheEvent(hit=hit, evicted=evicted))
 
 
 def record_route_downgrade(
@@ -933,6 +1051,57 @@ def record_span(
             name=name,
             seconds=float(seconds),
             state_bytes=int(state_bytes),
+        )
+    )
+
+
+def record_admission(
+    tenant: str,
+    outcome: str,
+    reason: str = "",
+    policy: str = "",
+    queue_depth: int = 0,
+    wait_s: float = 0.0,
+) -> None:
+    emit(
+        AdmissionEvent(
+            tenant=tenant,
+            outcome=outcome,
+            reason=reason,
+            policy=policy,
+            queue_depth=int(queue_depth),
+            wait_s=float(wait_s),
+        )
+    )
+
+
+def record_quarantine(
+    tenant: str, reason: str, error: str = "", batches_dropped: int = 0
+) -> None:
+    emit(
+        QuarantineEvent(
+            tenant=tenant,
+            reason=reason,
+            error=error,
+            batches_dropped=int(batches_dropped),
+        )
+    )
+
+
+def record_session(
+    action: str,
+    tenant: str,
+    generation: int = 0,
+    nbytes: int = 0,
+    seconds: float = 0.0,
+) -> None:
+    emit(
+        SessionEvent(
+            action=action,
+            tenant=tenant,
+            generation=int(generation),
+            nbytes=int(nbytes),
+            seconds=float(seconds),
         )
     )
 

@@ -467,6 +467,10 @@ def prometheus_text() -> str:
         f"{_PREFIX}_spmd_cache_total{_labels(result='miss')} "
         f"{agg['cache']['misses']}"
     )
+    out.append(
+        f"{_PREFIX}_spmd_cache_total{_labels(result='evict')} "
+        f"{agg['cache']['evictions']}"
+    )
 
     out.append(
         f"# HELP {_PREFIX}_route_downgrade_total Call-time fast-path "
@@ -743,6 +747,73 @@ def prometheus_text() -> str:
             f"{agg['alerts'][rule]['count']}"
         )
 
+    srv = agg["serve"]
+    if (
+        srv["admitted"]
+        or srv["shed"]
+        or srv["rejected"]
+        or srv["quarantined"]
+        or srv["sessions"]
+        or srv["dispatched"]["calls"]
+    ):
+        out.append(
+            f"# HELP {_PREFIX}_serve_admission_total Multi-tenant "
+            "admission decisions by outcome and shed/reject reason."
+        )
+        out.append(f"# TYPE {_PREFIX}_serve_admission_total counter")
+        out.append(
+            f"{_PREFIX}_serve_admission_total"
+            f"{_labels(outcome='admitted', reason='')} "
+            f"{srv['admitted']}"
+        )
+        for reason in sorted(srv["shed"]):
+            out.append(
+                f"{_PREFIX}_serve_admission_total"
+                f"{_labels(outcome='shed', reason=reason)} "
+                f"{srv['shed'][reason]}"
+            )
+        for reason in sorted(srv["rejected"]):
+            out.append(
+                f"{_PREFIX}_serve_admission_total"
+                f"{_labels(outcome='rejected', reason=reason)} "
+                f"{srv['rejected'][reason]}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_serve_admit_wait_seconds Queue wait of "
+            "dispatched batches (admit latency; the p99 SLO rule's "
+            "source)."
+        )
+        out.append(f"# TYPE {_PREFIX}_serve_admit_wait_seconds histogram")
+        dispatched = srv["dispatched"]
+        _histogram_lines(
+            out,
+            f"{_PREFIX}_serve_admit_wait_seconds",
+            {},
+            {
+                "hist": dispatched["hist"],
+                "seconds": dispatched["wait_seconds"],
+                "calls": dispatched["calls"],
+            },
+        )
+        out.append(
+            f"# HELP {_PREFIX}_serve_quarantine_total Poison tenants "
+            "isolated by the serve layer."
+        )
+        out.append(f"# TYPE {_PREFIX}_serve_quarantine_total counter")
+        out.append(
+            f"{_PREFIX}_serve_quarantine_total {srv['quarantined']}"
+        )
+        out.append(
+            f"# HELP {_PREFIX}_serve_sessions_total Tenant-session "
+            "lifecycle steps (open/spill/resume/close/drain)."
+        )
+        out.append(f"# TYPE {_PREFIX}_serve_sessions_total counter")
+        for action in sorted(srv["sessions"]):
+            out.append(
+                f"{_PREFIX}_serve_sessions_total{_labels(action=action)} "
+                f"{srv['sessions'][action]}"
+            )
+
     return "\n".join(out) + "\n"
 
 
@@ -812,7 +883,8 @@ def format_report(report: Dict[str, Any]) -> str:
         f"  spmd cache: {cache.get('hits', 0)} hits / "
         f"{cache.get('misses', 0)} misses "
         f"(hit rate {cache.get('hit_rate', 0.0):.2f}, "
-        f"{cache.get('currsize', 0)} live programs)\n"
+        f"{cache.get('currsize', 0)} live programs, "
+        f"{cache.get('evictions', 0)} evictions)\n"
     )
     offenders = report.get("retrace", {}).get("top_offenders", [])
     if offenders:
@@ -939,6 +1011,32 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"(last value {entry['value']:.4g} vs threshold "
                 f"{entry['threshold']:.4g})\n"
             )
+    srv = report.get("serve", {})
+    if srv:
+        shed = ", ".join(
+            f"{k}={v}" for k, v in sorted(srv.get("shed", {}).items())
+        )
+        buf.write(
+            f"  serve: {srv.get('admitted', 0)} admitted, "
+            f"{sum(srv.get('shed', {}).values())} shed"
+            f"{f' ({shed})' if shed else ''} "
+            f"(shed rate {srv.get('shed_rate', 0.0):.3f}); "
+            f"{srv.get('dispatched', 0)} dispatched "
+            f"(mean wait {srv.get('mean_admit_wait_s', 0.0) * 1e3:.3f} ms); "
+            f"{srv.get('quarantined', 0)} quarantined\n"
+        )
+        sessions = srv.get("sessions", {})
+        if sessions:
+            rendered = ", ".join(
+                f"{k}={v}" for k, v in sorted(sessions.items())
+            )
+            buf.write(f"    sessions: {rendered}\n")
+        rejected = srv.get("rejected", {})
+        if rejected:
+            rendered = ", ".join(
+                f"{k}={v}" for k, v in sorted(rejected.items())
+            )
+            buf.write(f"    rejected: {rendered}\n")
     buf.write(
         f"  events: {report.get('events_captured', 0)} captured, "
         f"{report.get('events_dropped', 0)} dropped "

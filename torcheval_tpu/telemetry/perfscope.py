@@ -490,6 +490,33 @@ def _metric_quality_worst_drop(agg: Dict[str, Any]) -> float:
     return worst
 
 
+def _metric_serve_shed_rate(agg: Dict[str, Any]) -> float:
+    """Shed fraction of the serve layer's admission offers
+    (``shed / (admitted + shed)``); 0.0 before any traffic."""
+    serve = agg["serve"]
+    shed = sum(serve["shed"].values())
+    offered = serve["admitted"] + shed
+    return shed / offered if offered else 0.0
+
+
+def _metric_serve_admit_p99(agg: Dict[str, Any]) -> float:
+    """Approximate p99 queue wait (seconds) of dispatched serve batches:
+    the upper edge of the DURATION_BUCKETS histogram bucket where the
+    cumulative count crosses 99% (overflow bucket reports the last
+    edge doubled).  0.0 before the first dispatch."""
+    entry = agg["serve"]["dispatched"]
+    total = entry["calls"]
+    if not total:
+        return 0.0
+    target = 0.99 * total
+    cumulative = 0
+    for le, count in zip(_events.DURATION_BUCKETS, entry["hist"]):
+        cumulative += count
+        if cumulative >= target:
+            return le
+    return _events.DURATION_BUCKETS[-1] * 2.0
+
+
 SLO_METRICS: Dict[str, Callable[[Dict[str, Any]], float]] = {
     "retrace_total": _metric_retrace_total,
     "prefetch_stall_ratio": _metric_prefetch_stall_ratio,
@@ -499,6 +526,8 @@ SLO_METRICS: Dict[str, Callable[[Dict[str, Any]], float]] = {
     "roofline_hbm_pct": _metric_roofline_pct,
     "quality_min": _metric_quality_min,
     "quality_worst_drop": _metric_quality_worst_drop,
+    "serve_shed_rate": _metric_serve_shed_rate,
+    "serve_admit_p99_s": _metric_serve_admit_p99,
 }
 
 # Floor rules stay quiet until their signal exists at all (a throughput
@@ -518,6 +547,8 @@ def default_rules(
     roofline_floor_pct: float = 0.0,
     quality_floor: float = 0.0,
     quality_drop_max: float = 0.0,
+    serve_shed_rate_max: float = 0.0,
+    serve_admit_p99_max_s: float = 0.0,
 ) -> Tuple[SloRule, ...]:
     """A conservative starter rule set; floors default to 0 (disabled —
     pass your workload's numbers).  See ``docs/source/perfscope.rst``
@@ -600,6 +631,30 @@ def default_rules(
                 "lifetime figure — recent quality regressed (cross-check "
                 "data_corrupt / data-health drift to separate feed issues "
                 "from model issues)",
+            )
+        )
+    if serve_shed_rate_max > 0:
+        out.append(
+            SloRule(
+                "serve_shed_storm",
+                "serve_shed_rate",
+                ">",
+                serve_shed_rate_max,
+                "the serve layer is shedding more than the budgeted "
+                "fraction of offered batches — raise capacity, widen "
+                "queues, or slow the producers",
+            )
+        )
+    if serve_admit_p99_max_s > 0:
+        out.append(
+            SloRule(
+                "serve_admit_latency",
+                "serve_admit_p99_s",
+                ">",
+                serve_admit_p99_max_s,
+                "p99 queue wait of dispatched serve batches exceeds the "
+                "admit-latency budget — the pump is falling behind "
+                "admission",
             )
         )
     return tuple(out)
