@@ -1564,6 +1564,151 @@ def bench_collection_sliced_stream() -> Tuple[str, float, Optional[float]]:
     return "collection_sliced_stream", ours, ref, extras
 
 
+def bench_collection_megakernel_stream() -> Tuple[str, float, Optional[float]]:
+    """The ragged bucketed five-member stream driven through the
+    collection-level Pallas megakernel (``TORCHEVAL_TPU_MEGAKERNEL=1``)
+    versus the SAME stream through the legacy per-member fused path
+    (flag forced off) as the reference column — final states asserted
+    bitwise equal between the two before any figure is reported.
+
+    The gated extra is ``reread_reduction_x``: the HBM batch-pass
+    reduction the route exists for.  It is computed ANALYTICALLY from
+    the state plan — the legacy fused program reads the batch out of
+    HBM once per folded member, the megakernel once total, so the
+    reduction is exactly ``len(plan.members)`` — because it must gate
+    route *coverage* (did the plan fold the members?) deterministically
+    on every backend.  XLA's priced bytes-accessed for the two routes is
+    stamped alongside as informational: meaningful on TPU where the
+    Pallas program is priced as compiled, arbitrary on CPU where only
+    the interpreter emulation is priced (see docs/perfscope).
+
+    Throughput columns are honest but secondary on CPU: interpret-mode
+    Pallas EXECUTES through the interpreter, so ``ours`` only becomes a
+    perf claim on a TPU backend — the row's gate is the plan-derived
+    reduction plus the bitwise-equality assertion, both backend-stable.
+    """
+    import os
+    from unittest import mock
+
+    from torcheval_tpu.metrics import (
+        MetricCollection,
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+    from torcheval_tpu.ops import _mega_plan
+
+    c = 100
+    rng = np.random.default_rng(29)
+    sizes = sorted([160, 96, 224, 130, 313, 200, 256, 77])
+    batches = [
+        (
+            rng.random((b, c), dtype=np.float32),
+            rng.integers(0, c, b).astype(np.int32),
+        )
+        for b in sizes
+    ]
+    n = sum(sizes)
+
+    def make_collection():
+        return MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=c, average="macro"),
+                "f1": MulticlassF1Score(num_classes=c, average="macro"),
+                "cm": MulticlassConfusionMatrix(num_classes=c),
+                "prec": MulticlassPrecision(num_classes=c, average="macro"),
+                "rec": MulticlassRecall(num_classes=c, average="macro"),
+            },
+            bucket=True,
+        )
+
+    def drive(col):
+        col.reset()
+        for args in batches:
+            col.fused_update(*args)
+        _force(col.compute())
+
+    # The flag is call-time: each collection is BUILT and DRIVEN under
+    # its own setting, and the route token in the rebuild condition
+    # keeps the two programs from ever sharing a cache entry.
+    with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_MEGAKERNEL": "1"}):
+        mega_col = make_collection()
+        sec = _time_steps(lambda: drive(mega_col))
+    ours = n / sec
+
+    with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_MEGAKERNEL": "0"}):
+        legacy_col = make_collection()
+        ref_sec = _time_steps(lambda: drive(legacy_col))
+    ref = n / ref_sec
+
+    # Bitwise identity over every member state — the row is meaningless
+    # if the fast route computed something else.
+    for name, m in mega_col._all_members.items():
+        ref_m = legacy_col._all_members[name]
+        for s in m._state_name_to_default:
+            a = np.asarray(getattr(m, s))
+            b = np.asarray(getattr(ref_m, s))
+            assert a.dtype == b.dtype and np.array_equal(a, b), (
+                f"megakernel route diverged from fused path at "
+                f"{name}.{s}"
+            )
+
+    # The plan the driven route used, re-derived from the same probe
+    # shapes: legacy pays one HBM batch pass per folded member, the
+    # megakernel one total.
+    with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_MEGAKERNEL": "1"}):
+        plan = _mega_plan.plan_for(
+            mega_col._metrics, batches[0], {}, None
+        )
+    assert plan is not None, "megakernel plan declined the bench stream"
+
+    extras = {
+        "reread_reduction_x": float(len(plan.members)),
+        "members_folded": len(plan.members),
+        "members_total": len(mega_col._metrics),
+        "mega_vs_fused_throughput": round(ours / ref, 2) if ref else None,
+        "steady_state_ms_per_stream": round(sec * 1e3, 3),
+        "roofline_note": "ref column is the legacy per-member fused "
+        "loop on the same stream, states asserted bitwise equal; "
+        "reread_reduction_x is the plan-derived HBM batch-pass "
+        "reduction (legacy = one pass per folded member, mega = one), "
+        "gated >=3x by check_bench_regression.py",
+    }
+
+    # Informational only: what XLA priced for the two routes in this
+    # process, when perfscope captured both.  On CPU the megakernel
+    # figure prices the interpreter emulation, not the kernel.
+    from torcheval_tpu.telemetry import perfscope as _perfscope
+
+    perfscope_was_enabled = _perfscope.enabled()
+    _perfscope.enable()
+    try:
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_MEGAKERNEL": "1"}
+        ):
+            drive(make_collection())
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_MEGAKERNEL": "0"}
+        ):
+            drive(make_collection())
+        routes = _perfscope.explain_perf()["routes"]
+        for program, key in (
+            ("mega_collection", "priced_reread_mega"),
+            ("fused_collection", "priced_reread_legacy"),
+        ):
+            if program in routes:
+                extras[key] = round(
+                    routes[program]["reread_multiplier"], 2
+                )
+    finally:
+        if not perfscope_was_enabled:
+            _perfscope.disable()
+
+    return "collection_megakernel_stream", ours, ref, extras
+
+
 def bench_fleet_merge_scaling() -> Tuple[str, float, Optional[float]]:
     """Hierarchical fleet merge vs flat gather over threaded LocalWorlds
     (worlds 8/64/256): root-inbox fan-in reduction from the binary tree
@@ -1756,6 +1901,7 @@ ALL_WORKLOADS = [
     bench_ragged_stream_telemetry,
     bench_collection_scan_stream,
     bench_collection_sliced_stream,
+    bench_collection_megakernel_stream,
     bench_perplexity,
     bench_windowed_auroc,
     bench_weighted_histogram,

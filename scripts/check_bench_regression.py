@@ -76,6 +76,13 @@ EXTRA_BARS = (
 EXTRA_FLOORS = (
     ("fleet_merge_scaling", "root_inbox_reduction_x", 8.0),
     ("fleet_merge_scaling", "sketch_bytes_reduction_x", 10.0),
+    # The megakernel row's plan-derived HBM batch-pass reduction: the
+    # legacy fused program reads the batch once per folded member, the
+    # megakernel once total, so this floor fails exactly when the state
+    # plan stops folding members (route-coverage regression) — it is
+    # deterministic on every backend, unlike the priced multipliers,
+    # which on CPU price the interpreter emulation.
+    ("collection_megakernel_stream", "reread_reduction_x", 3.0),
 )
 
 # (metric row, extras key, extras key) — pairs that must be EQUAL, for

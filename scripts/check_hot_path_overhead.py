@@ -201,6 +201,30 @@ def _drive_hot_path() -> None:
         list(evaluator3.result().values())[0]
     ).block_until_ready()
 
+    # The megakernel route (ops/pallas_mega.py) makes the same promise:
+    # forced on, fused updates and engine blocks re-route through the
+    # one-pass Pallas program (interpreter-executed off-TPU), and every
+    # ENABLED gate on the way — collection, plan, kernel dispatch,
+    # perfscope build-site pricing — stays just as cold.
+    col_mega = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=c, average="macro"),
+            "f1": MulticlassF1Score(num_classes=c, average="macro"),
+        },
+        bucket=True,
+    )
+    with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_MEGAKERNEL": "1"}):
+        for b in (33, 70):
+            col_mega.fused_update(
+                jnp.asarray(rng.random((b, c), dtype=np.float32)),
+                jnp.asarray(rng.integers(0, c, b).astype(np.int32)),
+            )
+        evaluator_mega = Evaluator(col_mega, block_size=2)
+        evaluator_mega.run(stream)
+        jnp.asarray(
+            list(evaluator_mega.result().values())[0]
+        ).block_until_ready()
+
     # The multi-tenant serve layer: admission (faults.fire + the
     # admission/session record hooks), coalesced dispatch, a
     # spill/resume round trip, and drain — every serve hook site is

@@ -1,0 +1,414 @@
+"""Collection-level Pallas megakernel — interpreter-mode bit-identity
+with the per-member fused path.
+
+Every test runs the SAME batch stream through a collection twice — once
+with ``TORCHEVAL_TPU_MEGAKERNEL=0`` (the per-member fused path) and once
+forced on (the CPU tier-1 way to exercise the ``interpret=True`` kernel)
+— from identical initial states, then compares every member state
+(slice clones included) exactly: same dtype, same bits.  The compiled
+Mosaic flavor of the kernel is identical arithmetic on real tiles; the
+bit-identity argument (exact f32 integer counts, associative partial
+sums) is laid out in ``ops/_mega_plan.py``.
+"""
+
+import os
+import unittest
+from unittest import mock
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics import MetricCollection
+from torcheval_tpu.metrics.classification.accuracy import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+)
+from torcheval_tpu.metrics.classification.binned_auc import (
+    BinaryBinnedAUPRC,
+    BinaryBinnedAUROC,
+)
+from torcheval_tpu.metrics.classification.confusion_matrix import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+)
+from torcheval_tpu.metrics.classification.f1_score import (
+    BinaryF1Score,
+    MulticlassF1Score,
+)
+from torcheval_tpu.metrics.classification.precision import (
+    BinaryPrecision,
+    MulticlassPrecision,
+)
+from torcheval_tpu.metrics.classification.recall import (
+    BinaryRecall,
+    MulticlassRecall,
+)
+from torcheval_tpu.ops import _mega_plan
+
+_ON = {"TORCHEVAL_TPU_MEGAKERNEL": "1"}
+_OFF = {"TORCHEVAL_TPU_MEGAKERNEL": "0"}
+
+_C = 7
+
+
+def _multiclass_members():
+    return {
+        "acc_micro": MulticlassAccuracy(average="micro"),
+        "acc_macro": MulticlassAccuracy(average="macro", num_classes=_C),
+        "prec_micro": MulticlassPrecision(num_classes=_C, average="micro"),
+        "prec_macro": MulticlassPrecision(num_classes=_C, average="macro"),
+        "rec_none": MulticlassRecall(num_classes=_C, average=None),
+        "f1_macro": MulticlassF1Score(num_classes=_C, average="macro"),
+        "cm": MulticlassConfusionMatrix(num_classes=_C),
+    }
+
+
+def _binary_members():
+    return {
+        "bacc": BinaryAccuracy(threshold=0.4),
+        "bprec": BinaryPrecision(),
+        "brec": BinaryRecall(),
+        "bf1": BinaryF1Score(threshold=0.55),
+        "bcm": BinaryConfusionMatrix(threshold=0.6),
+        "auroc": BinaryBinnedAUROC(threshold=33),
+        "auprc": BinaryBinnedAUPRC(threshold=17),
+    }
+
+
+def _stream(seed, steps, feat, classes=_C, slices=None, ragged=True):
+    """(input, target, slice_ids-or-None) triples with ragged row counts."""
+    r = np.random.default_rng(seed)
+    out = []
+    for i in range(steps):
+        n = int(r.integers(30, 200)) if ragged else 64
+        if feat:
+            inp = jnp.asarray(
+                r.standard_normal((n, feat)), dtype=jnp.float32
+            )
+        else:
+            inp = jnp.asarray(r.random(n), dtype=jnp.float32)
+        tgt = jnp.asarray(r.integers(0, classes, n), dtype=jnp.int32)
+        sid = (
+            jnp.asarray(r.integers(0, slices, n), dtype=jnp.int32)
+            if slices
+            else None
+        )
+        out.append((inp, tgt, sid))
+    return out
+
+
+def _states(col):
+    return {
+        name: {
+            s: np.asarray(getattr(m, s)) for s in m._state_name_to_default
+        }
+        for name, m in col._all_members.items()
+    }
+
+
+def _assert_states_equal(tc, a, b, msg=""):
+    tc.assertEqual(set(a), set(b), msg)
+    for name in a:
+        tc.assertEqual(set(a[name]), set(b[name]), f"{msg} {name}")
+        for s in a[name]:
+            x, y = a[name][s], b[name][s]
+            tc.assertEqual(x.dtype, y.dtype, f"{msg} {name}.{s} dtype")
+            tc.assertTrue(
+                np.array_equal(x, y),
+                f"{msg} {name}.{s}: {x.ravel()[:8]} != {y.ravel()[:8]}",
+            )
+
+
+def _run_fused(members, stream, *, bucket=True, slices=None, donate=False):
+    col = MetricCollection(
+        members, bucket=bucket, slices=slices, donate=donate
+    )
+    for inp, tgt, sid in stream:
+        if sid is None:
+            col.fused_update(inp, tgt)
+        else:
+            col.fused_update(inp, tgt, slice_ids=sid)
+    return col
+
+
+class TestFusedBitIdentity(unittest.TestCase):
+    def _ab(self, members_fn, stream, **kw):
+        with mock.patch.dict(os.environ, _OFF):
+            want = _states(_run_fused(members_fn(), stream, **kw))
+        with mock.patch.dict(os.environ, _ON):
+            got = _states(_run_fused(members_fn(), stream, **kw))
+        _assert_states_equal(self, got, want, f"kw={kw}")
+
+    def test_multiclass_bucketed_ragged(self):
+        self._ab(_multiclass_members, _stream(0, 5, feat=_C))
+
+    def test_multiclass_unbucketed(self):
+        self._ab(
+            _multiclass_members,
+            _stream(1, 4, feat=_C, ragged=False),
+            bucket=False,
+        )
+
+    def test_binary_and_binned_bucketed(self):
+        self._ab(
+            _binary_members, _stream(2, 5, feat=0, classes=2)
+        )
+
+    def test_sliced_16_clones(self):
+        self._ab(
+            _multiclass_members,
+            _stream(3, 4, feat=_C, slices=16),
+            slices=16,
+        )
+        self._ab(
+            _binary_members,
+            _stream(4, 4, feat=0, classes=2, slices=16),
+            slices=16,
+        )
+
+    def test_donate_on_and_off(self):
+        for donate in (False, True):
+            self._ab(
+                _multiclass_members,
+                _stream(5, 4, feat=_C, slices=3),
+                slices=3,
+                donate=donate,
+            )
+
+    def test_mixed_supported_and_unsupported_members(self):
+        # k=2 top-k accuracy is outside the supported accumulation
+        # shapes: it must keep the per-member path INSIDE the same
+        # program while the rest fold into the megakernel.
+        def members():
+            d = _multiclass_members()
+            d["topk"] = MulticlassAccuracy(
+                average="micro", num_classes=_C, k=2
+            )
+            return d
+
+        with mock.patch.dict(os.environ, _ON):
+            plan = _mega_plan.plan_for(
+                members(),
+                (
+                    jax.ShapeDtypeStruct((64, _C), jnp.float32),
+                    jax.ShapeDtypeStruct((64,), jnp.int32),
+                ),
+                {},
+                None,
+            )
+        self.assertIsNotNone(plan)
+        self.assertIn("topk", plan.unsupported)
+        self.assertNotIn("topk", plan.member_names)
+        self._ab(members, _stream(6, 4, feat=_C))
+
+    def test_eager_update_keeps_per_member_path(self):
+        # Plain update() must NOT engage the megakernel (its per-member
+        # value validation runs on concrete arrays) — states still match
+        # because both routes are the same arithmetic.
+        with mock.patch.dict(os.environ, _ON):
+            col = MetricCollection(_multiclass_members())
+            stream = _stream(7, 3, feat=_C)
+            for inp, tgt, _ in stream:
+                col.update(inp, tgt)
+            got = _states(col)
+        with mock.patch.dict(os.environ, _OFF):
+            col = MetricCollection(_multiclass_members())
+            for inp, tgt, _ in stream:
+                col.update(inp, tgt)
+        _assert_states_equal(self, got, _states(col), "eager")
+
+
+class TestEngineScan(unittest.TestCase):
+    def _run_engine(self, stream, block_size=4):
+        from torcheval_tpu.engine import Evaluator
+
+        col = MetricCollection(_multiclass_members(), bucket=True)
+        ev = Evaluator(col, block_size=block_size)
+        for inp, tgt, _ in stream:
+            ev.step(inp, tgt)
+        ev.flush()
+        return _states(col)
+
+    def test_scan_block_matches_per_batch_fused(self):
+        stream = _stream(8, 8, feat=_C)
+        with mock.patch.dict(os.environ, _ON):
+            scan_on = self._run_engine(stream)
+            fused_on = _states(_run_fused(_multiclass_members(), stream))
+        with mock.patch.dict(os.environ, _OFF):
+            scan_off = self._run_engine(stream)
+        _assert_states_equal(self, scan_on, scan_off, "scan on-vs-off")
+        _assert_states_equal(self, scan_on, fused_on, "scan-vs-fused")
+
+    def test_scan_program_name_previews_mega(self):
+        from torcheval_tpu.engine.scan import _program_name
+
+        col = MetricCollection(_multiclass_members(), bucket=True)
+        stacked = (
+            jnp.zeros((4, 128, _C), jnp.float32),
+            jnp.zeros((4, 128), jnp.int32),
+        )
+        mask = jnp.ones((4, 128), jnp.int32)
+        with mock.patch.dict(os.environ, _ON):
+            self.assertEqual(
+                _program_name(col, stacked, mask), "mega_scan"
+            )
+        with mock.patch.dict(os.environ, _OFF):
+            self.assertEqual(
+                _program_name(col, stacked, mask), "engine_scan"
+            )
+
+
+class TestAbortRestore(unittest.TestCase):
+    def test_abort_mid_block_restores_concrete_states(self):
+        from torcheval_tpu.ops import pallas_mega
+
+        # Unbucketed with distinct batch sizes: the second call is
+        # guaranteed to re-trace, so the injected dispatch failure
+        # fires mid-trace (tracers already setattr'd onto members).
+        stream = [
+            (s[0][:n], s[1][:n], None)
+            for s, n in zip(_stream(9, 3, feat=_C, ragged=False), (64, 48, 32))
+        ]
+        with mock.patch.dict(os.environ, _ON):
+            col = MetricCollection(_multiclass_members(), bucket=False)
+            inp, tgt, _ = stream[0]
+            col.fused_update(inp, tgt)
+            before = _states(col)
+
+            def boom(*a, **kw):
+                raise RuntimeError("injected mid-trace abort")
+
+            with mock.patch.object(pallas_mega, "_dispatch", boom):
+                with self.assertRaises(RuntimeError):
+                    # New batch shape forces a re-trace, so the injected
+                    # abort fires mid-trace with tracers on the members.
+                    col.fused_update(*stream[1][:2])
+            # Every state is concrete and exactly the pre-abort value.
+            _assert_states_equal(self, _states(col), before, "restore")
+            for m in col._all_members.values():
+                for s in m._state_name_to_default:
+                    self.assertIsInstance(getattr(m, s), jax.Array)
+            # The collection keeps working after the abort...
+            col.fused_update(*stream[1][:2])
+            col.fused_update(*stream[2][:2])
+            got = _states(col)
+        # ...and still matches the legacy path over the full stream.
+        with mock.patch.dict(os.environ, _OFF):
+            want = _states(_run_fused(_multiclass_members(), stream))
+        _assert_states_equal(self, got, want, "post-abort stream")
+
+
+class TestPlanGating(unittest.TestCase):
+    _ARGS = (
+        jax.ShapeDtypeStruct((64, _C), jnp.float32),
+        jax.ShapeDtypeStruct((64,), jnp.int32),
+    )
+
+    def _plan(self, metrics, args=None, kwargs=None, slices=None):
+        return _mega_plan.plan_for(
+            metrics, args or self._ARGS, kwargs or {}, slices
+        )
+
+    def test_flag_off_declines(self):
+        with mock.patch.dict(os.environ, _OFF):
+            self.assertIsNone(self._plan(_multiclass_members()))
+
+    def test_auto_declines_off_tpu(self):
+        with mock.patch.dict(os.environ, clear=False):
+            os.environ.pop("TORCHEVAL_TPU_MEGAKERNEL", None)
+            if jax.default_backend() != "tpu":
+                self.assertIsNone(self._plan(_multiclass_members()))
+
+    def test_kill_switch_outranks_forced_on(self):
+        env = dict(_ON)
+        env["TORCHEVAL_TPU_DISABLE_PALLAS"] = "1"
+        with mock.patch.dict(os.environ, env):
+            self.assertIsNone(self._plan(_multiclass_members()))
+
+    def test_forced_on_needs_a_supported_member(self):
+        with mock.patch.dict(os.environ, _ON):
+            only_topk = {
+                "topk": MulticlassAccuracy(
+                    average="micro", num_classes=_C, k=2
+                )
+            }
+            self.assertIsNone(self._plan(only_topk))
+            self.assertIsNotNone(self._plan(_multiclass_members()))
+
+    def test_unroutable_call_shapes_decline(self):
+        with mock.patch.dict(os.environ, _ON):
+            members = _multiclass_members()
+            f32_target = (
+                self._ARGS[0],
+                jax.ShapeDtypeStruct((64,), jnp.float32),
+            )
+            self.assertIsNone(self._plan(members, args=f32_target))
+            extra_kw = {"weight": jax.ShapeDtypeStruct((64,), jnp.float32)}
+            self.assertIsNone(self._plan(members, kwargs=extra_kw))
+            wide = (
+                jax.ShapeDtypeStruct((64, 300), jnp.float32),
+                jax.ShapeDtypeStruct((64,), jnp.int32),
+            )
+            self.assertIsNone(self._plan(members, args=wide))
+
+    def test_route_token_folds_flag_and_backend(self):
+        with mock.patch.dict(os.environ, _ON):
+            on = _mega_plan.route_token()
+        with mock.patch.dict(os.environ, _OFF):
+            off = _mega_plan.route_token()
+        self.assertNotEqual(on, off)
+
+    def test_flag_flip_rebuilds_fused_program(self):
+        stream = _stream(10, 1, feat=_C)
+        col = MetricCollection(_multiclass_members(), bucket=True)
+        with mock.patch.dict(os.environ, _OFF):
+            col.fused_update(*stream[0][:2])
+            first = col._fused_apply
+        with mock.patch.dict(os.environ, _ON):
+            col.fused_update(*stream[0][:2])
+            self.assertIsNot(col._fused_apply, first)
+
+
+class TestServeSharedPrograms(unittest.TestCase):
+    def test_service_results_bit_identical_flag_on_vs_off(self):
+        from torcheval_tpu.serve import EvalService
+
+        def suite():
+            return {
+                "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+                "f1": MulticlassF1Score(num_classes=_C, average="macro"),
+                "cm": MulticlassConfusionMatrix(num_classes=_C),
+            }
+
+        rng = np.random.default_rng(11)
+        batches = [
+            (
+                jnp.asarray(rng.random((17, _C), dtype=np.float32)),
+                jnp.asarray(rng.integers(0, _C, 17).astype(np.int32)),
+            )
+            for _ in range(4)
+        ]
+
+        def _flatten(tree):
+            leaves, _ = jax.tree_util.tree_flatten(tree)
+            return [np.asarray(x).tobytes() for x in leaves]
+
+        def run():
+            svc = EvalService(group_width=4)
+            svc.open("t", suite())
+            for scores, target in batches:
+                svc.submit("t", scores, target)
+            svc.pump()
+            return _flatten(svc.results("t"))
+
+        with mock.patch.dict(os.environ, _OFF):
+            want = run()
+        with mock.patch.dict(os.environ, _ON):
+            got = run()
+        self.assertEqual(got, want)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -166,6 +166,19 @@ _DECLARATIONS: Tuple[Flag, ...] = (
         ),
     ),
     Flag(
+        name="MEGAKERNEL",
+        kind="tribool",
+        default=None,
+        doc=(
+            "Route whole-collection updates through the collection-level "
+            "Pallas megakernel (one HBM pass per batch, "
+            "``ops/pallas_mega.py``): truthy → on wherever at least one "
+            "member is supported, falsy → off, unset → on for TPU "
+            "backends with at least two supported members "
+            "(``ops._flags.megakernel_mode``)."
+        ),
+    ),
+    Flag(
         name="CACHE_DIR",
         kind="str",
         default=None,

@@ -52,6 +52,7 @@ from torcheval_tpu.metrics._bucket import (
     pad_to_bucket,
 )
 from torcheval_tpu.metrics.collection import MetricCollection
+from torcheval_tpu.ops import _mega_plan
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.resilience.checkpoint import CheckpointManager
 from torcheval_tpu.telemetry import events as _telemetry
@@ -542,6 +543,7 @@ class Evaluator:
             self._runner is None
             or self._runner.donate != donate
             or self._runner.health != _health.ENABLED
+            or self._runner.token != _mega_plan.route_token()
         ):
             self._runner = ScanRunner(
                 self._collection, donate, health=_health.ENABLED

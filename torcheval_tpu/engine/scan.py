@@ -27,11 +27,41 @@ import jax
 from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics.collection import MetricCollection, _call_signature
 from torcheval_tpu.ops import _flags
+from torcheval_tpu.ops import _mega_plan
 from torcheval_tpu.parallel import _compile_cache
 from torcheval_tpu.resilience import faults as _faults
 from torcheval_tpu.telemetry import events as _telemetry
 from torcheval_tpu.telemetry import health as _health
 from torcheval_tpu.telemetry import perfscope as _perfscope
+
+
+def _program_name(
+    collection: MetricCollection,
+    stacked_args: Tuple[Any, ...],
+    stacked_mask: Optional[Any],
+) -> str:
+    """``"mega_scan"`` when the scan's per-step update will route through
+    the collection megakernel, else ``"engine_scan"``.
+
+    The megakernel decision is previewable from shapes/dtypes alone
+    (:func:`~torcheval_tpu.ops._mega_plan.plan_for`), so stripping the
+    leading block axis off the stacked leaves reproduces exactly the
+    per-step answer — works on live arrays and on tracers, letting the
+    same helper name both the trace counter and the perfscope program."""
+    elems = tuple(
+        jax.ShapeDtypeStruct(a.shape[1:], a.dtype) for a in stacked_args
+    )
+    kw: Dict[str, Any] = {}
+    if collection._slices is not None:
+        elems, kw["slice_ids"] = elems[:-1], elems[-1]
+    if stacked_mask is not None:
+        kw["mask"] = jax.ShapeDtypeStruct(
+            stacked_mask.shape[1:], stacked_mask.dtype
+        )
+    plan = _mega_plan.plan_for(
+        collection._metrics, elems, kw, collection._slices
+    )
+    return "mega_scan" if plan is not None else "engine_scan"
 
 
 def _build_apply(
@@ -56,7 +86,7 @@ def _build_apply(
     sliced = collection._slices is not None
 
     def apply(states, stacked_args, stacked_mask):
-        bump_trace("engine_scan")
+        bump_trace(_program_name(collection, stacked_args, stacked_mask))
 
         def body(carry, xs):
             step_args, step_mask = xs
@@ -100,6 +130,9 @@ class ScanRunner:
         self._collection = collection
         self._donate = bool(donate)
         self._health = bool(health)
+        # Megakernel route inputs at build time; the engine rebuilds the
+        # runner when this drifts (flag/backend flip mid-lifecycle).
+        self._token = _mega_plan.route_token()
         self.bounds: Tuple[Tuple[str, int], ...] = (
             _health.label_bounds(collection._metrics) if health else ()
         )
@@ -120,6 +153,11 @@ class ScanRunner:
     @property
     def health(self) -> bool:
         return self._health
+
+    @property
+    def token(self) -> Tuple[Any, ...]:
+        """Megakernel route token the program was built under."""
+        return self._token
 
     def dispatch(
         self,
@@ -157,12 +195,12 @@ class ScanRunner:
             # tracer attrs on the live members — re-install the concrete
             # states whenever pricing actually ran (once per signature).
             profiled = _perfscope.profile_program(
-                "engine_scan",
+                _program_name(col, stacked_args, stacked_mask),
                 self._apply,
                 (before, stacked_args, stacked_mask),
                 batch_args=(stacked_args, stacked_mask),
                 donate=self._donate,
-                signature=(key, self._donate, self._health),
+                signature=(key, self._donate, self._health, self._token),
             )
             if profiled is not None:
                 col._install_states(new_states)

@@ -62,6 +62,10 @@ class _BinnedCountsBase(Metric):
     arrays over (rows, thresholds).  ``_score_fn`` (set per concrete
     class) maps the counts to the per-row AUROC/AUPRC scores."""
 
+    # Every concrete update() below takes mask= (and _binned_counts_rows
+    # folds it exactly: masked rows contribute zeros), so the binned
+    # family is eligible for bucket=/slices= collections.
+    _supports_mask = True
     _score_fn = None
 
     def __init__(self, num_rows: int, threshold, device=None) -> None:

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics._bucket import DEFAULT_MIN_BUCKET, pad_to_bucket
+from torcheval_tpu.metrics.functional._host_checks import all_concrete
 from torcheval_tpu.metrics.metric import Metric, _move_state
 from torcheval_tpu.ops import _flags
 from torcheval_tpu.telemetry import events as _telemetry
@@ -173,6 +174,7 @@ class MetricCollection:
         self._fused_apply: Optional[Any] = None
         self._fused_apply_donated: Optional[bool] = None
         self._fused_apply_health: Optional[bool] = None
+        self._fused_apply_token: Optional[Any] = None
         self._health_bounds: Tuple[Tuple[str, int], ...] = ()
         # The fused paths read every member state once per step; a
         # precomputed (name, metric, state-names) layout makes that a
@@ -257,8 +259,10 @@ class MetricCollection:
                     "slice_ids= passed to an unsliced MetricCollection; "
                     "construct it with slices=K first."
                 )
-            for m in self._metrics.values():
-                m.update(*args, **kwargs)
+            handled = self._maybe_megakernel(args, kwargs, None)
+            for name, m in self._metrics.items():
+                if name not in handled:
+                    m.update(*args, **kwargs)
             return
         if slice_ids is None:
             raise TypeError(
@@ -270,15 +274,49 @@ class MetricCollection:
         sids = jnp.asarray(slice_ids)
         if base_mask is not None:
             kwargs["mask"] = base_mask
-        for m in self._metrics.values():
-            m.update(*args, **kwargs)
+        handled = self._maybe_megakernel(args, kwargs, sids)
+        for name, m in self._metrics.items():
+            if name not in handled:
+                m.update(*args, **kwargs)
         for k in range(self._slices):
             smask = (sids == k).astype(jnp.int32)
             if base_mask is not None:
                 smask = smask * base_mask
             kwargs["mask"] = smask
             for name in self._metrics:
-                self._slice_members[f"{name}@{k}"].update(*args, **kwargs)
+                if name not in handled:
+                    self._slice_members[f"{name}@{k}"].update(*args, **kwargs)
+
+    def _maybe_megakernel(
+        self, args: Tuple[Any, ...], kwargs: Dict[str, Any], sids
+    ) -> frozenset:
+        """Run every megakernel-supported member's update in ONE Pallas
+        dispatch (one HBM pass over the batch for all of them, slice
+        clones included) and return the handled member names; the caller
+        runs only the rest on the per-member path.
+
+        Engages only under tracing — exactly the three compiled hot
+        paths (``fused_update``, the engine scan block, serve's shared
+        bundles).  The plain eager ``update()`` keeps full per-member
+        value validation, whose host checks could not run at trace time
+        anyway.  ``ops/_mega_plan.plan_for`` owns the flag/backend/shape
+        gating, so this preview-able decision matches the route token
+        the hot paths fold into their program-cache keys."""
+        from torcheval_tpu.ops import _mega_plan
+
+        plan = _mega_plan.plan_for(self._metrics, args, kwargs, self._slices)
+        if plan is None:
+            return frozenset()
+        mask = kwargs.get("mask")
+        probe = [x for x in args + (mask, sids) if x is not None]
+        if all_concrete(*probe):
+            return frozenset()
+        from torcheval_tpu.ops import pallas_mega
+
+        pallas_mega.run_plan(
+            plan, self._metrics, self._slice_members, args, mask, sids
+        )
+        return plan.member_names
 
     def update(self, *args: Any, **kwargs: Any) -> "MetricCollection":
         args, kwargs = self._bucket_args(args, kwargs)
@@ -309,10 +347,25 @@ class MetricCollection:
             else _flags.donation_enabled()
         )
         health = _health.ENABLED
+        from torcheval_tpu.ops import _mega_plan
+
+        # The megakernel decision is previewable from shapes/dtypes
+        # alone, so the same plan_for call that routes inside the trace
+        # also names the program here (for perfscope/trace counters) —
+        # and the route token joins the rebuild condition so flag or
+        # backend flips retrace instead of reusing a stale route.
+        token = _mega_plan.route_token()
+        program = (
+            "mega_collection"
+            if _mega_plan.plan_for(self._metrics, args, kwargs, self._slices)
+            is not None
+            else "fused_collection"
+        )
         if (
             self._fused_apply is None
             or self._fused_apply_donated != donate
             or self._fused_apply_health != health
+            or self._fused_apply_token != token
         ):
             metrics = self._metrics
             # With the monitor off the program is byte-identical to a
@@ -321,7 +374,14 @@ class MetricCollection:
             bounds = _health.label_bounds(metrics) if health else ()
 
             def apply(states, a, kw):
-                bump_trace("fused_collection")
+                bump_trace(
+                    "mega_collection"
+                    if _mega_plan.plan_for(
+                        self._metrics, a, kw, self._slices
+                    )
+                    is not None
+                    else "fused_collection"
+                )
                 for name, m in self._all_members.items():
                     for s, v in states[name].items():
                         setattr(m, s, v)
@@ -338,6 +398,7 @@ class MetricCollection:
             )
             self._fused_apply_donated = donate
             self._fused_apply_health = health
+            self._fused_apply_token = token
             self._health_bounds = bounds
             self._fused_seen = set()
         key = _call_signature(args, kwargs)
@@ -375,13 +436,13 @@ class MetricCollection:
             # re-trace setattrs tracers onto the live members, so the
             # concrete states must be re-installed when pricing ran.
             profiled = _perfscope.profile_program(
-                "fused_collection",
+                program,
                 self._fused_apply,
                 # tpulint: disable=TPU004 -- shadow lowering reads avals only; deleted donated buffers still carry shape/dtype
                 (before, args, kwargs),
                 batch_args=(args, kwargs),
                 donate=donate,
-                signature=(key, donate, health),
+                signature=(key, donate, health, token),
             )
             if profiled is not None:
                 self._install_states(new_states)

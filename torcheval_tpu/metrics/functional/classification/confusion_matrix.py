@@ -150,7 +150,18 @@ def _matmul_cm(
     return _onehot_cm(target, input, num_classes, mask=mask).astype(jnp.int32)
 
 
-def _onehot_cm(
+# Row cap for one one-hot materialization.  Unchunked, the matmul route
+# builds two (n, width) bf16 one-hots — 4·n·width bytes of HBM written
+# and re-read per batch, a ~2·width re-read multiplier over the n-row
+# label vectors themselves (at width=1000 that is the full (C, C)-scale
+# re-read the route table prices).  Chunking bounds the live one-hots to
+# 2·_CM_ROW_CHUNK·width bytes (≤ ~8 MB at the 512-class matmul ceiling),
+# small enough to stay fusion/cache-resident, while the per-chunk partial
+# counts are exact f32 integers so the accumulated slab is bit-identical.
+_CM_ROW_CHUNK = 4096
+
+
+def _onehot_cm_block(
     t: jax.Array, p: jax.Array, width: int, mask: Optional[jax.Array] = None
 ) -> jax.Array:
     """``(width, width)`` f32 counts as one bf16 one-hot dot_general —
@@ -169,6 +180,37 @@ def _onehot_cm(
         oh_p,
         dimension_numbers=(((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+    )
+
+
+def _onehot_cm(
+    t: jax.Array, p: jax.Array, width: int, mask: Optional[jax.Array] = None
+) -> jax.Array:
+    """:func:`_onehot_cm_block` with the one-hot tile capped at
+    ``_CM_ROW_CHUNK`` rows: longer batches fold chunk-partial slabs with
+    exact f32 integer adds (bit-identical, any chunking).  Pad rows carry
+    the label ``width`` — outside ``arange(width)``, so their one-hot row
+    is all zeros and they drop without needing a mask."""
+    n = t.shape[0]
+    if n <= _CM_ROW_CHUNK:
+        return _onehot_cm_block(t, p, width, mask)
+    chunks = -(-n // _CM_ROW_CHUNK)
+    pad = chunks * _CM_ROW_CHUNK - n
+    if pad:
+        t = jnp.concatenate([t, jnp.full(pad, width, t.dtype)])
+        p = jnp.concatenate([p, jnp.full(pad, width, p.dtype)])
+        if mask is not None:
+            mask = jnp.concatenate([mask, jnp.zeros(pad, mask.dtype)])
+    tc = t.reshape(chunks, _CM_ROW_CHUNK)
+    pc = p.reshape(chunks, _CM_ROW_CHUNK)
+    mc = None if mask is None else mask.reshape(chunks, _CM_ROW_CHUNK)
+
+    def body(i, acc):
+        m_i = None if mc is None else mc[i]
+        return acc + _onehot_cm_block(tc[i], pc[i], width, m_i)
+
+    return jax.lax.fori_loop(
+        0, chunks, body, jnp.zeros((width, width), jnp.float32)
     )
 
 

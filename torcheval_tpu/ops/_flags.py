@@ -53,6 +53,24 @@ def donation_enabled() -> bool:
         return False
 
 
+def megakernel_mode() -> "bool | None":
+    """Tri-state read of ``TORCHEVAL_TPU_MEGAKERNEL`` — the
+    collection-level Pallas megakernel route (``ops/pallas_mega.py``).
+
+    ``True`` forces the route on wherever at least one collection member
+    has a supported accumulation shape (this is how CPU tier-1 exercises
+    the ``interpret=True`` path), ``False`` disables it, and ``None``
+    (unset) means *auto*: engage on TPU backends when at least two
+    members are supported, so the one-HBM-pass amortisation actually
+    pays for the extra dispatch.  ``TORCHEVAL_TPU_DISABLE_PALLAS``
+    outranks a forced-on value, exactly as it outranks every per-member
+    Pallas route.  Read at call time; the hot paths fold the value into
+    their program-cache keys so toggling mid-lifecycle retraces instead
+    of reusing a stale route.
+    """
+    return _flags.get("MEGAKERNEL")
+
+
 def configure_persistent_cache() -> "str | None":
     """Enable JAX's persistent compilation cache when
     ``TORCHEVAL_TPU_CACHE_DIR`` names a directory, returning the path (or

@@ -1,0 +1,398 @@
+r"""State plan for the collection-level Pallas megakernel.
+
+``plan_for`` walks a :class:`~torcheval_tpu.metrics.collection
+.MetricCollection`'s members and classifies each one's state update into
+one of four accumulation shapes the megakernel (``pallas_mega.py``) can
+emit from a single HBM pass over the batch:
+
+* **moment-sum** — masked scalar sums (micro accuracy/precision/recall/
+  F1, the binary counter families): one MXU row-dot per batch tile.
+* **count-scatter** — per-class marginal counters (macro accuracy and
+  the macro/weighted precision/recall/F1 trio): a masked one-hot matmul
+  with the same wrap-then-drop out-of-bounds semantics as the members'
+  own ``.at[].add`` / ``_class_counts`` formulations.
+* **confusion-matrix** — the (C, C) slab, rows true class, columns
+  prediction (``_wrap_labels`` semantics preserved).
+* **bin-histogram** — binary binned-AUC threshold counts
+  (``pred = score >= t``), matching ``_binned_counts_rows`` exactly.
+
+Classification is deliberately exact-type (``type(m) is``): the binary
+and multilabel metrics subclass their multiclass flavors, and only the
+combinations proven bit-identical in ``tests/ops/test_pallas_mega.py``
+are claimed.  Anything else — windowed members, weighted updates, topk,
+multilabel, float targets — is listed in ``plan.unsupported`` and runs
+on the existing per-member fused path, so mixed collections split the
+work instead of losing the route.
+
+Bit-identity rests on exact f32 integer arithmetic: every payload the
+kernel reduces is an integer-valued 0/1 product below 2\ :sup:`24`, so
+per-tile partial sums associate exactly and the per-batch delta equals
+the members' own kernels bit-for-bit (see ``pallas_mega.py`` for the
+promotion argument on the ``state + delta`` fold).  Two documented
+value-level assumptions (unverifiable at trace time): label values stay
+below 2\ :sup:`24` in magnitude, and 2-D score rows are NaN-free (XLA's
+``argmax`` selects the first NaN; the megakernel's first-max-wins argmax
+ignores it).
+"""
+
+import dataclasses
+from typing import Any, Dict, FrozenSet, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.ops import _flags as _oflags
+
+# Gating bounds.  _MAX_SAMPLES keeps every count exactly representable in
+# the f32 accumulators; the rest bound the VMEM-resident operands.
+_MAX_SAMPLES = 1 << 24
+_MAX_FEATURES = 256
+_MAX_CLASSES = 256
+_MAX_THRESHOLDS = 512
+
+_VMEM_BUDGET = 10 << 20  # bytes; leaves headroom under the ~16 MB core
+_TILES = (2048, 1024, 512, 256, 128)
+_LANE = 128
+
+# Score dtypes the kernel may read as f32 without changing legacy
+# comparison semantics (bf16/f16 widen exactly; integer scores promote to
+# f32 in the legacy threshold compares too).
+_SCORE_DTYPES = ("float32", "bfloat16", "float16")
+
+
+def _pad_lane(n: int) -> int:
+    return max(_LANE, -(-n // _LANE) * _LANE)
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberPlan:
+    """One supported member's accumulation recipe.
+
+    ``moment_slots`` maps state names to moment-payload ids (see
+    ``pallas_mega._PAYLOADS``); scatter/cm/binned members carry their
+    width parameters instead.  ``threshold`` is the binary decision
+    threshold (``None`` for label-prediction members)."""
+
+    name: str
+    kind: str  # "moment" | "scatter" | "cm" | "binned"
+    spec: str
+    threshold: Optional[float] = None
+    num_classes: int = 0
+    num_thresholds: int = 0
+    moment_slots: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class MegaPlan:
+    """The packed kernel signature for one (collection, batch-shape)
+    pair: supported members in iteration order, batch geometry, and the
+    chosen lane tile."""
+
+    members: Tuple[MemberPlan, ...]
+    member_names: FrozenSet[str]
+    unsupported: Tuple[str, ...]
+    n: int
+    features: int  # input columns for 2-D scores, 0 for 1-D input
+    a: int  # accumulation rows: 1 global (+ one per slice clone)
+    slices: int  # 0 for an unsliced collection
+    tile: int
+    needs_scores: bool
+    needs_pred: bool
+
+
+def route_token() -> Tuple[Any, ...]:
+    """The call-time inputs the megakernel route decision depends on.
+
+    The hot paths fold this into their program-cache keys (fused rebuild
+    condition, the engine's scan-runner check, serve's bundle key) so a
+    flag or backend flip retraces instead of reusing a stale route."""
+    try:
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - backend init failure
+        backend = "unknown"
+    return (_oflags.megakernel_mode(), _oflags.pallas_disabled(), backend)
+
+
+def _shape_of(x) -> Optional[Tuple[int, ...]]:
+    s = getattr(x, "shape", None)
+    return tuple(s) if s is not None else None
+
+
+def _dtype_of(x):
+    d = getattr(x, "dtype", None)
+    return jnp.dtype(d) if d is not None else None
+
+
+def _int_like(dt) -> bool:
+    return dt is not None and (
+        jnp.issubdtype(dt, jnp.integer) or jnp.issubdtype(dt, jnp.bool_)
+    )
+
+
+def _score_like(dt) -> bool:
+    return dt is not None and (str(dt) in _SCORE_DTYPES or _int_like(dt))
+
+
+# Moment-slot tables: (state-name, payload-id) in the members' own
+# _accumulate order; payload semantics live in pallas_mega._PAYLOADS.
+# A state missing here receives a bitwise no-op in the legacy kernel
+# (micro precision adds a literal 0.0 to num_label) and is skipped.
+_MICRO_SLOTS = {
+    "acc_micro": (("num_correct", "eq"), ("num_total", "ones")),
+    "precision_micro": (("num_tp", "eq"), ("num_fp", "neq")),
+    "recall_micro": (
+        ("num_tp", "eq"),
+        ("num_labels", "ones"),
+        ("num_predictions", "ones"),
+    ),
+    "f1_micro": (
+        ("num_tp", "eq"),
+        ("num_label", "ones"),
+        ("num_prediction", "ones"),
+    ),
+    "binary_acc": (("num_correct", "beq"), ("num_total", "ones")),
+    "binary_precision": (("num_tp", "pb_t1"), ("num_fp", "pb_t0")),
+    "binary_recall": (("num_tp", "pb_t1"), ("num_true_labels", "t1")),
+    "binary_f1": (
+        ("num_tp", "pb_traw"),
+        ("num_label", "traw"),
+        ("num_prediction", "pb"),
+    ),
+}
+
+# Specs whose payloads need integer predictions (the pred_i operand for
+# 1-D input, or the in-kernel argmax for 2-D scores).
+_PRED_SPECS = frozenset(
+    {
+        "acc_micro",
+        "precision_micro",
+        "recall_micro",
+        "f1_micro",
+        "acc_macro",
+        "precision",
+        "recall",
+        "f1",
+        "cm",
+    }
+)
+
+
+def _label_input_ok(f: int, idt, num_classes: Optional[int]) -> bool:
+    """1-D integer labels, or a 2-D score block whose width matches the
+    member's class count (mirrors the members' own shape validation — a
+    mismatch declines the member so the legacy path raises its error)."""
+    if f == 0:
+        return _int_like(idt)
+    return num_classes is None or f == num_classes
+
+
+def _classify(name: str, m, f: int, idt, tdt) -> Optional[MemberPlan]:
+    from torcheval_tpu.metrics.classification.accuracy import (
+        BinaryAccuracy,
+        MulticlassAccuracy,
+    )
+    from torcheval_tpu.metrics.classification.binned_auc import (
+        BinaryBinnedAUPRC,
+        BinaryBinnedAUROC,
+    )
+    from torcheval_tpu.metrics.classification.confusion_matrix import (
+        BinaryConfusionMatrix,
+        MulticlassConfusionMatrix,
+    )
+    from torcheval_tpu.metrics.classification.f1_score import (
+        BinaryF1Score,
+        MulticlassF1Score,
+    )
+    from torcheval_tpu.metrics.classification.precision import (
+        BinaryPrecision,
+        MulticlassPrecision,
+    )
+    from torcheval_tpu.metrics.classification.recall import (
+        BinaryRecall,
+        MulticlassRecall,
+    )
+
+    t = type(m)
+    binaryish = f == 0  # binary members need 1-D scores
+
+    if t is MulticlassAccuracy:
+        if m.k != 1 or not _label_input_ok(f, idt, m.num_classes):
+            return None
+        if m.average == "micro":
+            return MemberPlan(
+                name, "moment", "acc_micro",
+                moment_slots=_MICRO_SLOTS["acc_micro"],
+            )
+        c = m.num_classes or 0
+        if 0 < c <= _MAX_CLASSES:
+            return MemberPlan(name, "scatter", "acc_macro", num_classes=c)
+        return None
+    if t is BinaryAccuracy:
+        if not binaryish:
+            return None
+        return MemberPlan(
+            name, "moment", "binary_acc", threshold=float(m.threshold),
+            moment_slots=_MICRO_SLOTS["binary_acc"],
+        )
+    for cls, micro_spec, macro_spec in (
+        (MulticlassPrecision, "precision_micro", "precision"),
+        (MulticlassRecall, "recall_micro", "recall"),
+        (MulticlassF1Score, "f1_micro", "f1"),
+    ):
+        if t is cls:
+            if not _label_input_ok(f, idt, m.num_classes):
+                return None
+            if m.average == "micro":
+                return MemberPlan(
+                    name, "moment", micro_spec,
+                    moment_slots=_MICRO_SLOTS[micro_spec],
+                )
+            c = m.num_classes or 0
+            if 0 < c <= _MAX_CLASSES:
+                return MemberPlan(name, "scatter", macro_spec, num_classes=c)
+            return None
+    for cls, spec in (
+        (BinaryPrecision, "binary_precision"),
+        (BinaryRecall, "binary_recall"),
+        (BinaryF1Score, "binary_f1"),
+    ):
+        if t is cls:
+            if not binaryish:
+                return None
+            return MemberPlan(
+                name, "moment", spec, threshold=float(m.threshold),
+                moment_slots=_MICRO_SLOTS[spec],
+            )
+    if t is MulticlassConfusionMatrix:
+        c = m.num_classes
+        if c <= _MAX_CLASSES and _label_input_ok(f, idt, c):
+            return MemberPlan(name, "cm", "cm", num_classes=c)
+        return None
+    if t is BinaryConfusionMatrix:
+        if not binaryish:
+            return None
+        return MemberPlan(
+            name, "cm", "binary_cm", threshold=float(m.threshold),
+            num_classes=2,
+        )
+    if t in (BinaryBinnedAUROC, BinaryBinnedAUPRC):
+        if not binaryish or m.num_tasks != 1:
+            return None
+        thr_shape = _shape_of(m.threshold)
+        if thr_shape is None or len(thr_shape) != 1:
+            return None
+        nt = thr_shape[0]
+        if not 0 < nt <= _MAX_THRESHOLDS:
+            return None
+        return MemberPlan(
+            name, "binned", "binned", num_thresholds=nt,
+            moment_slots=(("num_pos", "hit1"), ("num_total", "ones")),
+        )
+    return None
+
+
+def _pick_tile(plan_members, f: int, a: int, needs_scores: bool,
+               needs_pred: bool) -> Optional[int]:
+    """Largest lane tile whose VMEM working set fits the budget: the
+    per-tile input blocks and one-hot temporaries scale with the tile;
+    the accumulator outputs persist across the grid."""
+    slots = sum(len(mp.moment_slots) for mp in plan_members)
+    lane_rows = (f if needs_scores else 0) + needs_pred + 1 + a + slots
+    fixed = 4 * a * _pad_lane(max(slots, 1))
+    for mp in plan_members:
+        if mp.kind == "scatter":
+            cp = _pad_lane(mp.num_classes)
+            lane_rows += 2 * cp  # oh_t / oh_p temporaries
+            fixed += 4 * 3 * a * cp
+        elif mp.kind == "cm":
+            cp = _pad_lane(mp.num_classes)
+            lane_rows += 2 * cp
+            fixed += 4 * a * cp * cp
+        elif mp.kind == "binned":
+            tp = _pad_lane(mp.num_thresholds)
+            lane_rows += 2 * tp  # ge / ge·hit temporaries
+            fixed += 4 * (2 * a * tp + tp)
+    for tile in _TILES:
+        if fixed + 4 * lane_rows * tile <= _VMEM_BUDGET:
+            return tile
+    return None
+
+
+def plan_for(
+    metrics: Dict[str, Any],
+    args: Tuple[Any, ...],
+    kwargs: Dict[str, Any],
+    slices: Optional[int],
+) -> Optional[MegaPlan]:
+    """Build the megakernel plan for one update call, or ``None`` when
+    the route must not engage (flag off, unsupported call shape, no
+    quorum of supported members, or VMEM-infeasible packing).
+
+    Operates purely on shapes/dtypes — ``args`` entries may be live
+    arrays, tracers, or ``jax.ShapeDtypeStruct`` stand-ins — so the hot
+    paths can preview the decision outside the trace (program naming,
+    cache keys) and get exactly the in-trace answer."""
+    mode = _oflags.megakernel_mode()
+    if mode is False or _oflags.pallas_disabled():
+        # DISABLE_PALLAS is the global kill-switch: it outranks a forced
+        # MEGAKERNEL=1 just as it outranks every per-member Pallas route.
+        return None
+    if len(args) != 2 or set(kwargs) - {"mask", "slice_ids"}:
+        return None
+    ishape, idt = _shape_of(args[0]), _dtype_of(args[0])
+    tshape, tdt = _shape_of(args[1]), _dtype_of(args[1])
+    if ishape is None or tshape is None or len(tshape) != 1:
+        return None
+    if not _int_like(tdt):
+        return None
+    n = tshape[0]
+    if not 1 <= n < _MAX_SAMPLES:
+        return None
+    if len(ishape) not in (1, 2) or ishape[0] != n:
+        return None
+    if len(ishape) == 2:
+        f = ishape[1]
+        if not 1 <= f <= _MAX_FEATURES or str(idt) not in _SCORE_DTYPES:
+            return None
+    else:
+        f = 0
+        if not _score_like(idt):
+            return None
+    mask = kwargs.get("mask")
+    if mask is not None and _shape_of(mask) != (n,):
+        return None
+
+    supported, unsupported = [], []
+    for name, m in metrics.items():
+        mp = _classify(name, m, f, idt, tdt)
+        if mp is None:
+            unsupported.append(name)
+        else:
+            supported.append(mp)
+    if mode is True:
+        if not supported:
+            return None
+    else:  # auto: TPU with at least two supported members
+        if len(supported) < 2 or jax.default_backend() != "tpu":
+            return None
+
+    a = 1 + (slices or 0)
+    needs_scores = f > 0 or any(
+        mp.threshold is not None or mp.kind == "binned" for mp in supported
+    )
+    needs_pred = f == 0 and any(mp.spec in _PRED_SPECS for mp in supported)
+    tile = _pick_tile(supported, f, a, needs_scores, needs_pred)
+    if tile is None:
+        return None
+    return MegaPlan(
+        members=tuple(supported),
+        member_names=frozenset(mp.name for mp in supported),
+        unsupported=tuple(unsupported),
+        n=n,
+        features=f,
+        a=a,
+        slices=slices or 0,
+        tile=tile,
+        needs_scores=needs_scores,
+        needs_pred=needs_pred,
+    )

@@ -1,0 +1,421 @@
+"""Collection-level Pallas megakernel: one HBM pass per batch.
+
+The per-member fused path (``MetricCollection.fused_update``) fuses at
+the XLA level, but each member's kernels still stream the batch block —
+``(scores, labels, mask, slice_ids)`` — out of HBM separately, so a
+K-member collection pays roughly K re-reads per batch
+(``telemetry.explain_perf()``'s reread multiplier).  This kernel reads
+each batch tile out of HBM **once** and scatters it into every supported
+member's accumulators in VMEM, with the slice clones of a sliced
+collection riding as extra rows of one accumulation-mask operand instead
+of extra passes.
+
+Layout (samples on lanes, one 1-D grid over lane tiles — the
+``pallas_binned.py`` / ``pallas_cm.py`` accumulator discipline):
+
+* ``scores``  ``(F, Np)`` f32 — transposed 2-D score block, or the 1-D
+  score row for threshold/binned members.
+* ``pred``    ``(1, Np)`` int32 — 1-D integer predictions (2-D scores
+  compute a first-max-wins argmax in-kernel instead).
+* ``tgt``     ``(1, Np)`` int32 — labels.
+* ``accm``    ``(A, Np)`` f32 — row 0 the base validity mask (ones when
+  unmasked), row ``k+1`` the slice-``k`` mask; every payload multiplies
+  by its row before any reduction, so pad columns and foreign-slice rows
+  contribute exact zeros.
+* per binned member a ``(Tp, 1)`` f32 threshold column (``+inf`` pads
+  are compare-only — they never enter arithmetic).
+
+Outputs are persistent VMEM accumulators (constant out index maps,
+zero-initialized at grid step 0): one ``(A, Sp)`` moment block, one
+``(3·A, Cp)`` marginal block per count-scatter member, one
+``(A·Cp, Cp)`` slab per confusion-matrix member, and one ``(2·A, Tp)``
+histogram per binned member.
+
+**Bit-identity** with the per-member path is arithmetic, not tested-in
+luck: every reduced payload is a 0/1 (or small-integer) product, partial
+sums stay below 2^24 so f32 accumulation is exact and associative, and
+the extracted integer deltas equal the member kernels' own int32 deltas
+value-for-value.  The ``state + delta`` fold then promotes identically
+(f32 state + f32 integer delta ≡ f32 state + int32 delta; integer states
+get the delta cast to their dtype), so the new state buffers are
+bitwise identical — the property ``tests/ops/test_pallas_mega.py``
+asserts across bucketing, slices, donation, and the engine scan.
+"""
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from torcheval_tpu.ops._mega_plan import MegaPlan, MemberPlan, _pad_lane
+
+_HIGHEST = lax.Precision.HIGHEST
+
+
+def has_pallas() -> bool:
+    """True when the Mosaic TPU compiler is available for the real kernel
+    (interpret mode works everywhere)."""
+    return jax.default_backend() == "tpu"
+
+
+def _dot(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Exact lane-contraction: ``(R, tile) x (S, tile) -> (R, S)`` in
+    full f32 (integer-valued 0/1 payloads make every partial sum exact)."""
+    return lax.dot_general(
+        a,
+        b,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=_HIGHEST,
+    )
+
+
+def _moment_slots(plan: MegaPlan) -> List[Tuple[MemberPlan, str, str]]:
+    return [
+        (mp, state, pid)
+        for mp in plan.members
+        for state, pid in mp.moment_slots
+    ]
+
+
+def _wrap1(v: jax.Array, c: int) -> jax.Array:
+    """numpy-style negative wrap (the ``.at[].add`` index semantics)."""
+    return jnp.where(v < 0, v + c, v)
+
+
+def _wrap_sentinel(v: jax.Array, c: int) -> jax.Array:
+    """``_wrap_labels`` semantics: wrap once, still-negative values park
+    on the dropped sentinel ``c``."""
+    w = _wrap1(v, c)
+    return jnp.where(w < 0, c, w)
+
+
+def _out_structs(plan: MegaPlan) -> List[jax.ShapeDtypeStruct]:
+    outs = []
+    slots = _moment_slots(plan)
+    if slots:
+        outs.append(
+            jax.ShapeDtypeStruct((plan.a, _pad_lane(len(slots))), jnp.float32)
+        )
+    for mp in plan.members:
+        if mp.kind == "scatter":
+            cp = _pad_lane(mp.num_classes)
+            outs.append(jax.ShapeDtypeStruct((3 * plan.a, cp), jnp.float32))
+        elif mp.kind == "cm":
+            cp = _pad_lane(mp.num_classes)
+            outs.append(jax.ShapeDtypeStruct((plan.a * cp, cp), jnp.float32))
+        elif mp.kind == "binned":
+            tp = _pad_lane(mp.num_thresholds)
+            outs.append(jax.ShapeDtypeStruct((2 * plan.a, tp), jnp.float32))
+    return outs
+
+
+def _mega_kernel(plan: MegaPlan, *refs) -> None:
+    n_in = (
+        int(plan.needs_scores)
+        + int(plan.needs_pred)
+        + 2
+        + sum(mp.kind == "binned" for mp in plan.members)
+    )
+    in_refs, out_refs = refs[:n_in], refs[n_in:]
+    idx = 0
+    s = pred = None
+    if plan.needs_scores:
+        s = in_refs[idx][...]
+        idx += 1
+    if plan.needs_pred:
+        pred = in_refs[idx][...]
+        idx += 1
+    tgt = in_refs[idx][...]
+    am = in_refs[idx + 1][...]
+    idx += 2
+    thr_cols = {}
+    for mp in plan.members:
+        if mp.kind == "binned":
+            thr_cols[mp.name] = in_refs[idx][...]
+            idx += 1
+
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        for ref in out_refs:
+            ref[...] = jnp.zeros(ref.shape, jnp.float32)
+
+    f32 = jnp.float32
+    tile = tgt.shape[1]
+    if plan.features:
+        # First-max-wins argmax over the score rows == jnp.argmax on the
+        # (N, F) block for finite scores (ties break to the lowest row).
+        mx = jnp.max(s, axis=0, keepdims=True)
+        ridx = lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        pred = jnp.min(
+            jnp.where(s == mx, ridx, plan.features), axis=0, keepdims=True
+        )
+
+    cache: Dict[Any, jax.Array] = {}
+
+    def pb_of(thr: float) -> jax.Array:
+        key = ("pb_i", thr)
+        if key not in cache:
+            cache[key] = jnp.where(s[0:1, :] < thr, 0, 1).astype(jnp.int32)
+        return cache[key]
+
+    def payload(pid: str, thr: Optional[float]) -> jax.Array:
+        key = (pid, thr)
+        if key in cache:
+            return cache[key]
+        if pid == "ones":
+            out = jnp.ones((1, tile), f32)
+        elif pid == "eq":
+            out = (pred == tgt).astype(f32)
+        elif pid == "neq":
+            out = 1.0 - payload("eq", None)
+        elif pid == "beq":
+            out = (pb_of(thr) == tgt).astype(f32)
+        elif pid == "pb":
+            out = pb_of(thr).astype(f32)
+        elif pid == "t1":
+            out = (tgt != 0).astype(f32)
+        elif pid == "pb_t1":
+            out = payload("pb", thr) * payload("t1", None)
+        elif pid == "pb_t0":
+            out = payload("pb", thr) * (1.0 - payload("t1", None))
+        elif pid == "traw":
+            out = tgt.astype(f32)
+        elif pid == "pb_traw":
+            out = payload("pb", thr) * payload("traw", None)
+        elif pid == "hit1":
+            out = (tgt == 1).astype(f32)
+        else:  # pragma: no cover - specs and payload ids ship together
+            raise AssertionError(f"unknown moment payload {pid!r}")
+        cache[key] = out
+        return out
+
+    def onehot(vals: jax.Array, cp: int) -> jax.Array:
+        lanes = lax.broadcasted_iota(jnp.int32, (cp, tile), 0)
+        return (vals == lanes).astype(f32)
+
+    oi = 0
+    slots = _moment_slots(plan)
+    if slots:
+        sp = _pad_lane(len(slots))
+        rows = [payload(pid, mp.threshold) for mp, _, pid in slots]
+        if sp > len(rows):
+            rows.append(jnp.zeros((sp - len(rows), tile), f32))
+        out_refs[oi][...] += _dot(am, jnp.concatenate(rows, axis=0))
+        oi += 1
+
+    for mp in plan.members:
+        if mp.kind == "scatter":
+            c = mp.num_classes
+            cp = _pad_lane(c)
+            if mp.spec == "acc_macro":
+                # Raw-index scatter semantics of .at[target].add: wrap
+                # negatives once, drop the rest (never matches a lane).
+                oh_t = onehot(_wrap1(tgt, c), cp)
+                correct = payload("eq", None)
+                oh_p = oh_t
+            else:  # precision / recall / f1 marginals (_class_counts)
+                tw = _wrap_sentinel(tgt, c)
+                pw = _wrap_sentinel(pred, c)
+                correct = ((tw == pw) & (tw < c)).astype(f32)
+                oh_t = onehot(tw, cp)
+                oh_p = onehot(pw, cp)
+            out_refs[oi][...] += jnp.concatenate(
+                [_dot(am * correct, oh_t), _dot(am, oh_t), _dot(am, oh_p)],
+                axis=0,
+            )
+            oi += 1
+        elif mp.kind == "cm":
+            c = mp.num_classes
+            cp = _pad_lane(c)
+            pv = pred if mp.threshold is None else pb_of(mp.threshold)
+            oh_t = onehot(_wrap_sentinel(tgt, c), cp)
+            oh_p = onehot(_wrap_sentinel(pv, c), cp)
+            for a in range(plan.a):
+                out_refs[oi][a * cp : (a + 1) * cp, :] += _dot(
+                    oh_t * am[a : a + 1, :], oh_p
+                )
+            oi += 1
+        elif mp.kind == "binned":
+            ge = (thr_cols[mp.name] <= s[0:1, :]).astype(f32)  # (Tp, tile)
+            hit = payload("hit1", None)
+            out_refs[oi][...] += jnp.concatenate(
+                [_dot(am, ge), _dot(am, ge * hit)], axis=0
+            )
+            oi += 1
+
+
+def _dispatch(
+    plan: MegaPlan,
+    inp: jax.Array,
+    target: jax.Array,
+    mask: Optional[jax.Array],
+    sids: Optional[jax.Array],
+    thresholds: List[jax.Array],
+    interpret: bool,
+) -> Tuple[jax.Array, ...]:
+    n, tile = plan.n, plan.tile
+    np_ = -(-n // tile) * tile
+    pad = np_ - n
+
+    def pad_cols(x):
+        return jnp.pad(x, ((0, 0), (0, pad))) if pad else x
+
+    f32 = jnp.float32
+    ones = jnp.ones((n,), f32) if mask is None else mask.astype(f32)
+    rows = [ones]
+    for k in range(plan.slices):
+        sm = (sids == k).astype(f32)
+        rows.append(sm if mask is None else sm * ones)
+    accm = pad_cols(jnp.stack(rows, axis=0))
+
+    operands, in_specs = [], []
+    if plan.needs_scores:
+        s = inp.astype(f32)
+        s = s.T if plan.features else s[None, :]
+        operands.append(pad_cols(s))
+        in_specs.append(
+            pl.BlockSpec((max(plan.features, 1), tile), lambda j: (0, j))
+        )
+    if plan.needs_pred:
+        operands.append(pad_cols(inp.astype(jnp.int32)[None, :]))
+        in_specs.append(pl.BlockSpec((1, tile), lambda j: (0, j)))
+    operands.append(pad_cols(target.astype(jnp.int32)[None, :]))
+    in_specs.append(pl.BlockSpec((1, tile), lambda j: (0, j)))
+    operands.append(accm)
+    in_specs.append(pl.BlockSpec((plan.a, tile), lambda j: (0, j)))
+    for mp, thr in zip(
+        [mp for mp in plan.members if mp.kind == "binned"], thresholds
+    ):
+        tp = _pad_lane(mp.num_thresholds)
+        col = jnp.full((tp,), jnp.inf, f32).at[: mp.num_thresholds].set(
+            thr.astype(f32)
+        )
+        operands.append(col[:, None])
+        in_specs.append(pl.BlockSpec((tp, 1), lambda j: (0, 0)))
+
+    out_shape = _out_structs(plan)
+    out_specs = [
+        pl.BlockSpec(st.shape, lambda j, _r=len(st.shape): (0,) * _r)
+        for st in out_shape
+    ]
+    outs = pl.pallas_call(
+        partial(_mega_kernel, plan),
+        grid=(np_ // tile,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*operands)
+    return outs if isinstance(outs, (tuple, list)) else (outs,)
+
+
+def _fold(member, state: str, delta: jax.Array) -> None:
+    """``state + delta`` with the member kernels' own promotion.
+
+    Every megakernel delta is an integer-valued count (0/1 payload
+    products, exact below 2^24), so it is cast to int32 before the add:
+    integer states get the same int arithmetic as their own kernels, and
+    float states promote ``f32 + int32 -> f32`` — which, unlike adding
+    the raw f32 delta, PRESERVES the state's weak_type (weak + strong-f32
+    flips weak off; weak + int does not).  Keeping avals identical to the
+    per-member path means no hidden one-time retrace when the fused
+    program sees the post-first-batch states."""
+    old = getattr(member, state)
+    delta = delta.astype(
+        old.dtype
+        if jnp.issubdtype(jnp.dtype(old.dtype), jnp.integer)
+        else jnp.int32
+    )
+    setattr(member, state, old + delta)
+
+
+def run_plan(
+    plan: MegaPlan,
+    metrics: Dict[str, Any],
+    slice_members: Dict[str, Any],
+    args: Tuple[jax.Array, jax.Array],
+    mask: Optional[jax.Array],
+    sids: Optional[jax.Array],
+    interpret: Optional[bool] = None,
+) -> None:
+    """Dispatch the megakernel for one batch and fold the deltas onto
+    every supported member — the global row 0 and slice clone ``k`` from
+    accumulation row ``k+1``.  Unsupported members are untouched (the
+    caller runs them on the legacy path)."""
+    if interpret is None:
+        interpret = not has_pallas()
+    inp = jnp.asarray(args[0])
+    target = jnp.asarray(args[1])
+    thresholds = [
+        metrics[mp.name].threshold
+        for mp in plan.members
+        if mp.kind == "binned"
+    ]
+    outs = _dispatch(plan, inp, target, mask, sids, thresholds, interpret)
+
+    def targets(name):
+        yield 0, metrics[name]
+        for k in range(plan.slices):
+            yield k + 1, slice_members[f"{name}@{k}"]
+
+    oi = 0
+    slots = _moment_slots(plan)
+    slot_of = {
+        (mp.name, state): i for i, (mp, state, _) in enumerate(slots)
+    }
+    mom = None
+    if slots:
+        mom = outs[oi]
+        oi += 1
+    for mp in plan.members:
+        if mp.kind in ("moment", "binned"):
+            for state, _pid in mp.moment_slots:
+                col = slot_of[(mp.name, state)]
+                for a, m in targets(mp.name):
+                    _fold(m, state, mom[a, col])
+        if mp.kind == "scatter":
+            c = mp.num_classes
+            out = outs[oi]
+            oi += 1
+            for a, m in targets(mp.name):
+                tp = out[a, :c]
+                label = out[plan.a + a, :c]
+                pred_sum = out[2 * plan.a + a, :c]
+                if mp.spec == "acc_macro":
+                    _fold(m, "num_correct", tp)
+                    _fold(m, "num_total", label)
+                elif mp.spec == "precision":
+                    _fold(m, "num_tp", tp)
+                    _fold(m, "num_fp", pred_sum - tp)
+                    _fold(m, "num_label", label)
+                elif mp.spec == "recall":
+                    _fold(m, "num_tp", tp)
+                    _fold(m, "num_labels", label)
+                    _fold(m, "num_predictions", pred_sum)
+                else:  # f1
+                    _fold(m, "num_tp", tp)
+                    _fold(m, "num_label", label)
+                    _fold(m, "num_prediction", pred_sum)
+        elif mp.kind == "cm":
+            c = mp.num_classes
+            cp = _pad_lane(c)
+            out = outs[oi]
+            oi += 1
+            for a, m in targets(mp.name):
+                slab = out[a * cp : a * cp + c, :c]
+                _fold(m, "confusion_matrix", slab)
+        elif mp.kind == "binned":
+            t = mp.num_thresholds
+            out = outs[oi]
+            oi += 1
+            for a, m in targets(mp.name):
+                ge = out[a, :t]
+                tp = out[plan.a + a, :t]
+                _fold(m, "num_tp", tp[None, :])
+                _fold(m, "num_fp", (ge - tp)[None, :])

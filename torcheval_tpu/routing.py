@@ -248,10 +248,21 @@ def explain_route(fn, *args, **kwargs) -> str:
                 f"(num_classes is required, got {num_classes!r})."
             )
         route = _cm_route(num_classes, inp.shape[0])
+        from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
+            _CM_ROW_CHUNK,
+        )
+
+        crossover = (
+            f" One-hot tiles are capped at {_CM_ROW_CHUNK} rows, so the "
+            f"matmul's 2·C re-read multiplier applies to a bounded "
+            f"working set, not the whole batch; past C=512 (n·C² MACs "
+            f"overtaking the ~7 ms flat scatter, measured C=1000 at "
+            f"0.64x) the route crosses back to the scatter."
+        )
         return (
             f"{name}: confusion-matrix slab via {_route_detail[route]} — "
             f"decided from shapes/backend only, so it is identical under "
-            f"a caller's jit."
+            f"a caller's jit." + crossover
         )
 
     if fn in (
@@ -393,6 +404,61 @@ def _explain_parallel_route(fn, name, args, kwargs):
             "(hot_path_stats() for the full counters)."
         )
 
+    def _megakernel_verdict(owner, args, kwargs) -> str:
+        from torcheval_tpu.ops import _flags as _oflags
+        from torcheval_tpu.ops import _mega_plan
+
+        mode = _oflags.megakernel_mode()
+        if mode is False:
+            return (
+                "Megakernel route OFF (TORCHEVAL_TPU_MEGAKERNEL is "
+                "falsy); every member runs its own fused update."
+            )
+        if _oflags.pallas_disabled():
+            return (
+                "Megakernel route OFF — the TORCHEVAL_TPU_DISABLE_PALLAS "
+                "kill-switch outranks even a forced-on flag."
+            )
+        if len(args) < 2:
+            flagged = (
+                "FORCED ON (TORCHEVAL_TPU_MEGAKERNEL truthy)"
+                if mode
+                else "AUTO (engages on TPU with >=2 supported members)"
+            )
+            return (
+                f"Megakernel route {flagged}; pass sample (input, target) "
+                "args for the per-shape verdict."
+            )
+        plan = _mega_plan.plan_for(
+            owner._metrics, tuple(args), dict(kwargs), owner._slices
+        )
+        if plan is not None:
+            sup = ", ".join(mp.name for mp in plan.members)
+            un = (
+                f"; unsupported member(s) "
+                f"{', '.join(plan.unsupported)} keep the per-member "
+                f"path inside the same program"
+                if plan.unsupported
+                else ""
+            )
+            return (
+                f"Megakernel route ENGAGED: one Pallas HBM pass (lane "
+                f"tile {plan.tile}) scatters into {len(plan.members)} "
+                f"member state group(s) [{sup}]{un}."
+            )
+        if mode is None and jax.default_backend() != "tpu":
+            return (
+                "Megakernel route off: auto mode engages only on TPU "
+                "backends (TORCHEVAL_TPU_MEGAKERNEL=1 forces the "
+                "interpret path elsewhere)."
+            )
+        return (
+            "Megakernel route off for this call: unsupported call shape "
+            "or not enough supported members (auto needs >=2, forced "
+            "needs >=1; ops/_mega_plan.py lists the supported "
+            "accumulation shapes)."
+        )
+
     # --- MetricCollection.fused_update (bound method) --------------------
     if isinstance(owner, MetricCollection) and name == "fused_update":
         try:
@@ -432,8 +498,10 @@ def _explain_parallel_route(fn, name, args, kwargs):
             "unless pinned via the member's static kwargs (e.g. "
             "ustat_cap); shape-static routes (confusion slab, binned "
             f"counts) are unaffected.  {ragged}  This process has built "
-            f"{trace_count('fused_collection')} fused program(s) so far "
-            f"(hot_path_stats() for the full counters), and {donation}."
+            f"{trace_count('fused_collection')} fused + "
+            f"{trace_count('mega_collection')} megakernel program(s) so "
+            f"far (hot_path_stats() for the full counters), and "
+            f"{donation}.  {_megakernel_verdict(owner, args, kwargs)}"
         )
 
     def call_arg(pos, kw, default=None):

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics import MetricCollection
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.ops import _mega_plan
 from torcheval_tpu.parallel._compile_cache import LruCache
 from torcheval_tpu.telemetry import health as _health
 
@@ -366,7 +367,9 @@ class SessionRegistry:
         """The shared program for ``group``'s signature (and the
         current health flag), built on first use and LRU-bounded."""
         health = _health.ENABLED
-        key = (group.signature, group.width, health)
+        # The megakernel route token joins the key so a flag/backend flip
+        # rebuilds the shared program instead of reusing a stale route.
+        key = (group.signature, group.width, health, _mega_plan.route_token())
 
         def factory() -> _ApplyBundle:
             template = MetricCollection(

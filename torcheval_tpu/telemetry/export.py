@@ -1060,6 +1060,13 @@ def _format_perf_route(program: str, route: Dict[str, Any]) -> str:
     ]
     if route.get("reread_multiplier"):
         parts.append(f"reread x{route['reread_multiplier']:.2f}")
+    if route.get("legacy_reread_multiplier"):
+        # Present only when the legacy route for the same collection
+        # signature was priced in this process: the megakernel's delta.
+        delta = f"legacy reread x{route['legacy_reread_multiplier']:.2f}"
+        if route.get("reread_reduction_x"):
+            delta += f" -> {route['reread_reduction_x']:.1f}x lower"
+        parts.append(delta)
     if "achieved_gbps" in route:
         parts.append(
             f"{route['achieved_gbps']:.2f} GB/s "

@@ -272,6 +272,21 @@ def profile_program(
 _PROGRAM_TO_SPAN = {
     "fused_collection": ("MetricCollection.fused", "update"),
     "engine_scan": ("Evaluator", "engine_block"),
+    # Megakernel-routed builds of the same two hot paths: the dispatch
+    # sites time them under the same spans, only the program name (and
+    # so the perf ledger row) differs.
+    "mega_collection": ("MetricCollection.fused", "update"),
+    "mega_scan": ("Evaluator", "engine_block"),
+}
+
+# Megakernel program -> the legacy program computing the same collection
+# update.  When both were priced in one process (e.g. an A/B with the
+# flag toggled), explain_perf annotates the megakernel row with the
+# legacy reread multiplier and the reduction factor — the figure the
+# collection_megakernel_stream bench gates on.
+_MEGA_TO_LEGACY = {
+    "mega_collection": "fused_collection",
+    "mega_scan": "engine_scan",
 }
 
 
@@ -337,6 +352,13 @@ def explain_perf(
             if roof["hbm_pct"] < 1.0 and roof["flops_pct"] < 1.0:
                 route["bound"] = "dispatch"
         routes[program] = route
+    for mega, legacy in _MEGA_TO_LEGACY.items():
+        if mega in routes and legacy in routes:
+            lm = routes[legacy]["reread_multiplier"]
+            mm = routes[mega]["reread_multiplier"]
+            routes[mega]["legacy_reread_multiplier"] = lm
+            if mm > 0:
+                routes[mega]["reread_reduction_x"] = lm / mm
     result = {
         "device_kind": peaks["device_kind"],
         "peaks": peaks,
