@@ -83,6 +83,12 @@ EXTRA_FLOORS = (
     # deterministic on every backend, unlike the priced multipliers,
     # which on CPU price the interpreter emulation.
     ("collection_megakernel_stream", "reread_reduction_x", 3.0),
+    # The wavefront WER row's device-vs-host-DP speedup.  The extra is
+    # emitted only on a TPU backend (where the Pallas kernel executes
+    # as compiled); on CPU the key is absent and this floor is skipped
+    # — the row's correctness gate there is the in-bench exact-parity
+    # assertion against the native C++ DP.
+    ("wer_wavefront_stream", "wavefront_speedup_x", 10.0),
 )
 
 # (metric row, extras key, extras key) — pairs that must be EQUAL, for

@@ -225,6 +225,33 @@ def _drive_hot_path() -> None:
             list(evaluator_mega.result().values())[0]
         ).block_until_ready()
 
+    # The wavefront text route (ops/pallas_wavefront.py) makes the same
+    # promise: forced on, the tokenized WER update and the fused
+    # WER+Perplexity engine scan re-route the edit distance through the
+    # anti-diagonal Pallas kernel (interpreter-executed off-TPU), and
+    # every ENABLED gate crossed on the way stays cold.
+    from torcheval_tpu.metrics import Perplexity, WordErrorRate
+
+    col_text = MetricCollection(
+        {"wer": WordErrorRate(), "ppl": Perplexity(ignore_index=-1)},
+        bucket=True,
+    )
+    seq, vocab = 8, 9
+    text_stream = []
+    for b in (12, 20, 12, 20):
+        logits = jnp.asarray(rng.random((b, seq, vocab), dtype=np.float32))
+        lens = rng.integers(1, seq + 1, b)
+        ids = rng.integers(0, vocab, (b, seq)).astype(np.int32)
+        ids[np.arange(seq)[None, :] >= lens[:, None]] = -1
+        text_stream.append((logits, jnp.asarray(ids)))
+    with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_WAVEFRONT": "1"}):
+        col_text.fused_update(*text_stream[0])
+        evaluator_text = Evaluator(col_text, block_size=2)
+        evaluator_text.run(text_stream[1:])
+        jnp.asarray(
+            list(evaluator_text.result().values())[0]
+        ).block_until_ready()
+
     # The multi-tenant serve layer: admission (faults.fire + the
     # admission/session record hooks), coalesced dispatch, a
     # spill/resume round trip, and drain — every serve hook site is

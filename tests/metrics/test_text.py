@@ -1,11 +1,16 @@
 """Text metrics: WER/WIP/WIL vs an independent python oracle, perplexity
 vs a numpy oracle, BLEU vs hand-checked values and an independent
-implementation, class lifecycle/merge, and the native kernel's fallback
-equivalence."""
+implementation, class lifecycle/merge, the native kernel's fallback
+equivalence, the tokenized device flavor (wavefront routes) vs the host
+string path, BLEU weight validation, and the one-engine-scan-program
+property of the fused text family."""
 
 import math
+import os
 import unittest
+import warnings
 from collections import Counter
+from unittest import mock
 
 import jax.numpy as jnp
 import numpy as np
@@ -272,6 +277,311 @@ class TestBLEUScore(unittest.TestCase):
         a.merge_state([b])
         self.assertAlmostEqual(float(a.compute()), want, places=5)
         self.assertEqual(float(BLEUScore().compute()), 0.0)
+
+
+class TestTokenizedTextFamily(unittest.TestCase):
+    """The device-resident token flavor: ``tokenize_pairs`` interning,
+    exact parity with the host string path on every route, bucket-row
+    masks as exact no-ops, and the greedy-logits flavor."""
+
+    def _hyps_refs(self):
+        return [p[0] for p in PAIRS], [p[1] for p in PAIRS]
+
+    def test_tokenize_pairs_layout_and_interning(self):
+        from torcheval_tpu.metrics.text._tokens import (
+            PAD_ID,
+            WordInterner,
+            tokenize_pairs,
+        )
+
+        hyps, refs = self._hyps_refs()
+        it = WordInterner()
+        hyp_ids, ref_ids = tokenize_pairs(hyps, refs, interner=it)
+        # All sentences are < 16 words: both widths hit the bucket floor.
+        self.assertEqual(hyp_ids.shape, (len(PAIRS), 16))
+        self.assertEqual(ref_ids.shape, (len(PAIRS), 16))
+        self.assertEqual(hyp_ids.dtype, np.int32)
+        for row, sent in zip(hyp_ids, hyps):
+            n = len(sent.split())
+            self.assertTrue((row[:n] >= 0).all())
+            self.assertTrue((row[n:] == PAD_ID).all())
+        # A shared interner keeps ids stable across calls and across the
+        # hyp/ref sides ("hello" leads both sentences of PAIRS[0]).
+        again, _ = tokenize_pairs(hyps, refs, interner=it)
+        np.testing.assert_array_equal(hyp_ids, again)
+        self.assertEqual(hyp_ids[0, 0], ref_ids[0, 0])
+
+    def test_functional_token_path_matches_host_strings(self):
+        from torcheval_tpu.metrics.text._tokens import tokenize_pairs
+
+        hyps, refs = self._hyps_refs()
+        hyp_ids, ref_ids = tokenize_pairs(hyps, refs)
+        for fn in (
+            word_error_rate,
+            word_information_preserved,
+            word_information_lost,
+        ):
+            self.assertAlmostEqual(
+                float(fn(hyp_ids, ref_ids)),
+                float(fn(hyps, refs)),
+                places=6,
+                msg=fn.__name__,
+            )
+
+    def test_class_token_updates_match_host(self):
+        from torcheval_tpu.metrics.text._tokens import tokenize_pairs
+
+        hyps, refs = self._hyps_refs()
+        hyp_ids, ref_ids = tokenize_pairs(hyps, refs)
+        for cls in (WordErrorRate, WordInformationPreserved, WordInformationLost):
+            host, dev = cls(), cls()
+            host.update(hyps, refs)
+            dev.update(hyp_ids, ref_ids)
+            for name in ("errors", "target_total", "input_total"):
+                self.assertEqual(
+                    float(getattr(dev, name)),
+                    float(getattr(host, name)),
+                    f"{cls.__name__}.{name}",
+                )
+            self.assertAlmostEqual(
+                float(dev.compute()), float(host.compute()), places=6
+            )
+
+    def test_forced_wavefront_parity(self):
+        from torcheval_tpu.metrics.text._tokens import tokenize_pairs
+
+        hyps, refs = self._hyps_refs()
+        hyp_ids, ref_ids = tokenize_pairs(hyps, refs)
+        want = float(word_error_rate(hyps, refs))
+        with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_WAVEFRONT": "1"}):
+            got = float(word_error_rate(hyp_ids, ref_ids))
+        self.assertAlmostEqual(got, want, places=6)
+
+    def test_mask_rows_are_exact_noops(self):
+        from torcheval_tpu.metrics.text._tokens import tokenize_pairs
+
+        hyps, refs = self._hyps_refs()
+        hyp_ids, ref_ids = tokenize_pairs(hyps, refs)
+        live = WordErrorRate().update(hyp_ids[:2], ref_ids[:2])
+        mask = np.asarray([1, 1, 0, 0], np.int32)
+        masked = WordErrorRate().update(hyp_ids, ref_ids, mask=mask)
+        for name in ("errors", "target_total", "input_total"):
+            self.assertEqual(
+                float(getattr(masked, name)), float(getattr(live, name)), name
+            )
+
+    def test_string_path_rejects_mask(self):
+        with self.assertRaisesRegex(ValueError, "tokenized array inputs"):
+            WordErrorRate().update("a b", "a c", mask=np.asarray([1]))
+
+    def test_logits_flavor_is_greedy_token_error_rate(self):
+        # Teacher-forced greedy decode: hyp token = argmax at each live
+        # reference position, so hyp and ref lengths agree and the value
+        # is the token error rate of the decoded stream.
+        target = np.asarray([[1, 2, 3, -1], [0, 4, -1, -1]], np.int32)
+        decoded = np.asarray([[1, 0, 3, 2], [0, 4, 1, 1]], np.int32)
+        logits = np.full((2, 4, 5), -10.0, np.float32)
+        for i in range(2):
+            for j in range(4):
+                logits[i, j, decoded[i, j]] = 10.0
+        # errors = edit([1,0,3],[1,2,3]) + edit([0,4],[0,4]) = 1 + 0
+        got = word_error_rate(jnp.asarray(logits), jnp.asarray(target))
+        self.assertAlmostEqual(float(got), 1 / 5, places=6)
+
+    def test_token_input_checks(self):
+        ids = np.zeros((2, 4), np.int32)
+        with self.assertRaisesRegex(ValueError, "integer token"):
+            word_error_rate(ids, np.zeros((2, 4), np.float32))
+        with self.assertRaisesRegex(ValueError, "same number"):
+            word_error_rate(np.zeros((3, 4), np.int32), ids)
+        with self.assertRaisesRegex(ValueError, "leading dimensions"):
+            word_error_rate(np.zeros((2, 5, 7), np.float32), ids)
+        with self.assertRaisesRegex(ValueError, "float logits"):
+            word_error_rate(np.zeros((2, 4, 7), np.int32), ids)
+        with self.assertRaisesRegex(ValueError, "token ids or"):
+            word_error_rate(np.zeros(4, np.int32), ids)
+
+
+class TestBLEUWeights(unittest.TestCase):
+    """`_bleu_param_check` hardening: negative weights rejected,
+    un-normalized weights warn and normalize, normalized pass silently."""
+
+    CAND = "the cat is on the mat"
+    REF = "the cat sat on the mat"
+
+    def test_negative_weights_rejected(self):
+        with self.assertRaisesRegex(ValueError, "non-negative"):
+            bleu_score(self.CAND, self.REF, n_gram=2, weights=[1.5, -0.5])
+        with self.assertRaisesRegex(ValueError, "non-negative"):
+            BLEUScore(n_gram=2, weights=[-1.0, 2.0])
+
+    def test_zero_sum_rejected(self):
+        with self.assertRaisesRegex(ValueError, "positive sum"):
+            bleu_score(self.CAND, self.REF, n_gram=2, weights=[0.0, 0.0])
+
+    def test_unnormalized_warns_and_normalizes(self):
+        want = float(
+            bleu_score(self.CAND, self.REF, n_gram=2, weights=[0.5, 0.5])
+        )
+        with self.assertWarnsRegex(UserWarning, "normalizing"):
+            got = float(
+                bleu_score(self.CAND, self.REF, n_gram=2, weights=[2.0, 2.0])
+            )
+        self.assertAlmostEqual(got, want, places=6)
+        with self.assertWarnsRegex(UserWarning, "normalizing"):
+            m = BLEUScore(n_gram=2, weights=[3.0, 1.0])
+        m.update(self.CAND, self.REF)
+        self.assertAlmostEqual(
+            float(m.compute()),
+            float(
+                bleu_score(self.CAND, self.REF, n_gram=2, weights=[0.75, 0.25])
+            ),
+            places=6,
+        )
+
+    def test_normalized_weights_stay_silent(self):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            bleu_score(self.CAND, self.REF, n_gram=2, weights=[0.3, 0.7])
+            BLEUScore(n_gram=4)
+
+
+class TestTextFusionOneProgram(unittest.TestCase):
+    """ISSUE acceptance: WER/WIP/WIL + Perplexity ride ONE engine-scan
+    program — `_check_fusable` passes, the trace counter shows a single
+    compiled scan program, telemetry shows the same dispatch count as a
+    perplexity-only run, and the engine-scan WER counters are
+    bit-identical to the per-batch host string path."""
+
+    SEQ, VOCAB = 12, 9
+
+    def setUp(self):
+        from torcheval_tpu import telemetry
+
+        telemetry.disable()
+        telemetry.clear()
+
+    tearDown = setUp
+
+    def _logits_stream(self, sizes, seed=0):
+        rng = np.random.default_rng(seed)
+        out = []
+        for b in sizes:
+            logits = rng.normal(size=(b, self.SEQ, self.VOCAB)).astype(
+                np.float32
+            )
+            lens = rng.integers(1, self.SEQ + 1, b)
+            target = rng.integers(0, self.VOCAB, (b, self.SEQ)).astype(np.int32)
+            target[np.arange(self.SEQ)[None, :] >= lens[:, None]] = -1
+            out.append((jnp.asarray(logits), jnp.asarray(target)))
+        return out
+
+    def _family(self):
+        from torcheval_tpu.metrics import MetricCollection
+
+        return MetricCollection(
+            {
+                "wer": WordErrorRate(),
+                "wil": WordInformationLost(),
+                "ppl": Perplexity(ignore_index=-1),
+            },
+            bucket=True,
+        )
+
+    def test_collection_is_fusable_and_matches_plain(self):
+        col = self._family()
+        self.assertIsNone(col._check_fusable())
+        batches = self._logits_stream((5, 9, 3), seed=1)
+        for args in batches:
+            col.fused_update(*args)
+        wer, wil, ppl = WordErrorRate(), WordInformationLost(), Perplexity(
+            ignore_index=-1
+        )
+        for args in batches:
+            wer.update(*args)
+            wil.update(*args)
+            ppl.update(*args)
+        out = col.compute()
+        self.assertAlmostEqual(float(out["wer"]), float(wer.compute()), places=6)
+        self.assertAlmostEqual(float(out["wil"]), float(wil.compute()), places=6)
+        self.assertAlmostEqual(float(out["ppl"]), float(ppl.compute()), places=3)
+
+    def test_one_engine_scan_program_and_dispatch_parity(self):
+        from torcheval_tpu import _stats, telemetry
+        from torcheval_tpu.engine import Evaluator
+        from torcheval_tpu.metrics import MetricCollection
+
+        batches = self._logits_stream((16, 16, 16, 16, 16, 16, 16, 16), seed=2)
+        telemetry.enable()
+        base = _stats.trace_count("engine_scan")
+        Evaluator(self._family(), block_size=4).run(batches)
+        # The whole family — WER + WIL + Perplexity — compiled exactly
+        # one scan program for the single (batch, seq) bucket shape.
+        self.assertEqual(_stats.trace_count("engine_scan") - base, 1)
+        family_rep = telemetry.report()
+        self.assertEqual(family_rep["engine"]["blocks"], 2)
+        self.assertEqual(
+            family_rep["spans"]["Evaluator.engine_block"]["calls"], 2
+        )
+        # Dispatch parity: adding the word stats costs zero extra
+        # dispatches over a perplexity-only run of the same stream.
+        telemetry.clear()
+        ppl_only = MetricCollection(
+            {"ppl": Perplexity(ignore_index=-1)}, bucket=True
+        )
+        Evaluator(ppl_only, block_size=4).run(batches)
+        ppl_rep = telemetry.report()
+        self.assertEqual(
+            family_rep["engine"]["blocks"], ppl_rep["engine"]["blocks"]
+        )
+        self.assertEqual(
+            family_rep["engine"]["dispatches_per_batch"],
+            ppl_rep["engine"]["dispatches_per_batch"],
+        )
+
+    def test_engine_scan_bit_identity_vs_host_strings(self):
+        from torcheval_tpu.engine import Evaluator
+        from torcheval_tpu.metrics import MetricCollection
+        from torcheval_tpu.metrics.text._tokens import WordInterner, tokenize_pairs
+
+        rng = np.random.default_rng(3)
+        words = [f"w{k}" for k in range(11)]
+
+        def sentence():
+            return " ".join(rng.choice(words, rng.integers(0, 9)))
+
+        string_batches = [
+            (
+                [sentence() for _ in range(b)],
+                [sentence() for _ in range(b)],
+            )
+            for b in (6, 11, 4, 9, 7, 3)
+        ]
+        # One interner across the stream keeps ids comparable batch to
+        # batch — the pre-tokenized feed the engine scans.
+        it = WordInterner()
+        token_batches = [
+            tuple(map(jnp.asarray, tokenize_pairs(h, r, interner=it)))
+            for h, r in string_batches
+        ]
+        col = MetricCollection(
+            {
+                "wer": WordErrorRate(),
+                "wip": WordInformationPreserved(),
+                "wil": WordInformationLost(),
+            },
+            bucket=True,
+        )
+        Evaluator(col, block_size=3).run(token_batches)
+        host = WordErrorRate()
+        for h, r in string_batches:
+            host.update(h, r)
+        for name in ("errors", "target_total", "input_total"):
+            got = np.asarray(getattr(col["wer"], name))
+            want = np.asarray(getattr(host, name))
+            self.assertEqual(
+                got.tobytes(), want.tobytes(), f"{name} not bit-identical"
+            )
 
 
 class TestNativeFallback(unittest.TestCase):

@@ -1,0 +1,226 @@
+"""Anti-diagonal wavefront edit distance — four-route integer exactness.
+
+The property at the center: on randomized ragged token batches
+(including empty, equal, and degenerate pairs) the wavefront Pallas
+kernel (interpreter mode — the CPU tier-1 way to exercise it), the
+``lax.scan`` diagonal sweep, the native C++ batch DP, and the
+pure-Python two-row DP all return the SAME int distances.  Plus the
+route decision table: ``TORCHEVAL_TPU_WAVEFRONT`` tribool semantics,
+``DISABLE_PALLAS`` precedence, eager-vs-traced fallback selection, and
+the route token hot paths key their program caches on.
+"""
+
+import os
+import unittest
+from unittest import mock
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.native.edit_distance import _edit_distance_py
+from torcheval_tpu.ops import _mega_plan
+from torcheval_tpu.ops.pallas_wavefront import (
+    _edit_distance_native,
+    _edit_distance_pallas,
+    _edit_distance_xla,
+    edit_distance_tokens,
+    lens_from_ids,
+    wavefront_plan,
+    wavefront_route,
+)
+
+_ON = {"TORCHEVAL_TPU_WAVEFRONT": "1"}
+_OFF = {"TORCHEVAL_TPU_WAVEFRONT": "0"}
+_KILL = {"TORCHEVAL_TPU_DISABLE_PALLAS": "1"}
+
+
+def _pad(seqs, width, fill=-1):
+    out = np.full((len(seqs), width), fill, np.int32)
+    for row, s in enumerate(seqs):
+        out[row, : len(s)] = s
+    return out
+
+
+def _random_pairs(rng, n, max_a, max_b, vocab):
+    pairs = []
+    for _ in range(n):
+        la = int(rng.integers(0, max_a + 1))
+        lb = int(rng.integers(0, max_b + 1))
+        pairs.append(
+            (
+                rng.integers(0, vocab, la).tolist(),
+                rng.integers(0, vocab, lb).tolist(),
+            )
+        )
+    return pairs
+
+
+def _arrays(pairs, max_a, max_b):
+    a = _pad([p[0] for p in pairs], max_a)
+    b = _pad([p[1] for p in pairs], max_b)
+    al = np.asarray([len(p[0]) for p in pairs], np.int32)
+    bl = np.asarray([len(p[1]) for p in pairs], np.int32)
+    return a, b, al, bl
+
+
+class TestFourRouteExactness(unittest.TestCase):
+    def _assert_all_routes(self, pairs, max_a, max_b):
+        a, b, al, bl = _arrays(pairs, max_a, max_b)
+        oracle = np.asarray(
+            [_edit_distance_py(p[0], p[1]) for p in pairs], np.int64
+        )
+        ja, jb = jnp.asarray(a), jnp.asarray(b)
+        jal, jbl = jnp.asarray(al), jnp.asarray(bl)
+        routes = {
+            "pallas": np.asarray(_edit_distance_pallas(ja, jb, jal, jbl)),
+            "xla": np.asarray(_edit_distance_xla(ja, jb, jal, jbl)),
+            "native": np.asarray(_edit_distance_native(a, b, al, bl)),
+        }
+        for route, got in routes.items():
+            np.testing.assert_array_equal(
+                got, oracle, err_msg=f"route {route!r} diverged"
+            )
+
+    def test_randomized_ragged_batches(self):
+        # Small vocab forces collisions; lens 0..24 cross the lane pad.
+        for seed in range(5):
+            rng = np.random.default_rng(seed)
+            pairs = _random_pairs(rng, 40, 24, 24, 6)
+            self._assert_all_routes(pairs, 24, 24)
+
+    def test_asymmetric_widths(self):
+        rng = np.random.default_rng(11)
+        pairs = _random_pairs(rng, 17, 3, 19, 4)
+        self._assert_all_routes(pairs, 3, 19)
+
+    def test_degenerate_pairs(self):
+        pairs = [
+            ([], []),
+            ([], [1, 2, 3]),
+            ([4, 4, 4], []),
+            ([1, 2, 3], [1, 2, 3]),  # equal → 0
+            ([5], [5]),
+            ([5], [6]),
+            ([0, 0, 0, 0], [0]),
+            ([1, 2, 3, 4], [4, 3, 2, 1]),
+        ]
+        self._assert_all_routes(pairs, 4, 4)
+
+    def test_zero_width_reference(self):
+        # (n, 0) id arrays: distance must equal the hypothesis length.
+        a = _pad([[1, 2], [3], []], 2)
+        b = np.zeros((3, 0), np.int32)
+        al = np.asarray([2, 1, 0], np.int32)
+        bl = np.zeros(3, np.int32)
+        for fn in (_edit_distance_pallas, _edit_distance_xla):
+            np.testing.assert_array_equal(
+                np.asarray(
+                    fn(jnp.asarray(a), jnp.asarray(b), jnp.asarray(al), jnp.asarray(bl))
+                ),
+                al,
+            )
+
+    def test_pad_values_never_leak(self):
+        # Same lengths, different garbage past them → same distances.
+        pairs = [([1, 2], [1, 3]), ([2], [2, 2, 2])]
+        a, b, al, bl = _arrays(pairs, 6, 6)
+        noisy_a = a.copy()
+        noisy_b = b.copy()
+        for row in range(2):
+            noisy_a[row, al[row] :] = -7 - row
+            noisy_b[row, bl[row] :] = -91
+        for fn in (_edit_distance_pallas, _edit_distance_xla):
+            np.testing.assert_array_equal(
+                np.asarray(fn(jnp.asarray(a), jnp.asarray(b), jnp.asarray(al), jnp.asarray(bl))),
+                np.asarray(
+                    fn(
+                        jnp.asarray(noisy_a),
+                        jnp.asarray(noisy_b),
+                        jnp.asarray(al),
+                        jnp.asarray(bl),
+                    )
+                ),
+            )
+
+
+class TestEntryPoint(unittest.TestCase):
+    def test_lens_from_ids_and_mask(self):
+        a = _pad([[1, 2, 3], [2, 2], []], 5)
+        b = _pad([[1, 3], [2], [9]], 4)
+        np.testing.assert_array_equal(
+            np.asarray(lens_from_ids(jnp.asarray(a))), [3, 2, 0]
+        )
+        oracle = np.asarray(
+            [
+                _edit_distance_py([1, 2, 3], [1, 3]),
+                _edit_distance_py([2, 2], [2]),
+                _edit_distance_py([], [9]),
+            ]
+        )
+        got = np.asarray(edit_distance_tokens(a, b))
+        np.testing.assert_array_equal(got, oracle)
+        # A masked-off pair is an exact no-op (zero contribution).
+        masked = np.asarray(
+            edit_distance_tokens(a, b, mask=np.asarray([1, 0, 1]))
+        )
+        np.testing.assert_array_equal(masked, oracle * np.asarray([1, 0, 1]))
+
+    def test_jit_matches_eager(self):
+        a = _pad([[1, 2, 3], [2, 2]], 4)
+        b = _pad([[1, 3], [2]], 3)
+        eager = np.asarray(edit_distance_tokens(a, b))
+        jitted = np.asarray(
+            jax.jit(lambda x, y: edit_distance_tokens(x, y))(a, b)
+        )
+        np.testing.assert_array_equal(jitted, eager)
+        with mock.patch.dict(os.environ, _ON):
+            forced = np.asarray(
+                jax.jit(lambda x, y: edit_distance_tokens(x, y))(a, b)
+            )
+        np.testing.assert_array_equal(forced, eager)
+
+    def test_shape_validation(self):
+        with self.assertRaisesRegex(ValueError, "id arrays"):
+            edit_distance_tokens(np.zeros(3, np.int32), np.zeros((3, 2), np.int32))
+        with self.assertRaisesRegex(ValueError, "same number of sequences"):
+            edit_distance_tokens(
+                np.zeros((3, 2), np.int32), np.zeros((4, 2), np.int32)
+            )
+
+
+class TestRouteDecision(unittest.TestCase):
+    def test_auto_off_tpu_falls_back(self):
+        if jax.default_backend() == "tpu":
+            self.skipTest("auto mode engages on TPU")
+        self.assertEqual(wavefront_route(True), "native")
+        self.assertEqual(wavefront_route(False), "xla")
+
+    def test_forced_on_engages_everywhere(self):
+        with mock.patch.dict(os.environ, _ON):
+            self.assertEqual(wavefront_route(True), "pallas")
+            self.assertEqual(wavefront_route(False), "pallas")
+
+    def test_forced_off(self):
+        with mock.patch.dict(os.environ, _OFF):
+            self.assertEqual(wavefront_route(True), "native")
+            self.assertEqual(wavefront_route(False), "xla")
+
+    def test_kill_switch_outranks_forced_on(self):
+        with mock.patch.dict(os.environ, {**_ON, **_KILL}):
+            self.assertEqual(wavefront_route(True), "native")
+            self.assertEqual(wavefront_route(False), "xla")
+
+    def test_route_token_keys_on_wavefront_mode(self):
+        base = _mega_plan.route_token()
+        with mock.patch.dict(os.environ, _ON):
+            forced = _mega_plan.route_token()
+        self.assertNotEqual(base, forced)
+
+    def test_plan_geometry(self):
+        plan = wavefront_plan(13, 24, 17)
+        self.assertEqual(plan["pairs"], 16)  # sublane multiple of 8
+        self.assertEqual(plan["lanes"], 128)  # lane multiple of 128
+        self.assertEqual(plan["grid"], 24 + 17 + 1)
+        self.assertGreater(plan["vmem_bytes"], 0)

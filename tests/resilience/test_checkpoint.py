@@ -358,6 +358,77 @@ class TestEvaluatorResume(unittest.TestCase):
         )
 
 
+class TestTextFamilyResume(unittest.TestCase):
+    """Kill-and-resume for the tokenized text state: the WER counters
+    and perplexity sums checkpoint and resume bit-identically when the
+    fused text family (wavefront WER + perplexity, one scan program)
+    dies mid-stream."""
+
+    SEQ, VOCAB = 10, 8
+    SIZES = (9, 17, 5, 12, 30, 8, 21, 6)
+
+    def _tmp(self):
+        import shutil
+        import tempfile
+
+        d = tempfile.mkdtemp(prefix="ckpt-text-")
+        self.addCleanup(lambda: shutil.rmtree(d, True))
+        return d
+
+    def _text_collection(self):
+        from torcheval_tpu.metrics import Perplexity, WordErrorRate
+
+        return MetricCollection(
+            {"wer": WordErrorRate(), "ppl": Perplexity(ignore_index=-1)},
+            bucket=True,
+        )
+
+    def _text_stream(self, seed=21):
+        # Logits + negative-padded id targets: the shared signature both
+        # members consume (greedy token error rate + perplexity).
+        rng = np.random.default_rng(seed)
+        out = []
+        for b in self.SIZES:
+            logits = rng.normal(size=(b, self.SEQ, self.VOCAB)).astype(
+                np.float32
+            )
+            lens = rng.integers(1, self.SEQ + 1, b)
+            target = rng.integers(0, self.VOCAB, (b, self.SEQ)).astype(
+                np.int32
+            )
+            target[np.arange(self.SEQ)[None, :] >= lens[:, None]] = -1
+            out.append((jnp.asarray(logits), jnp.asarray(target)))
+        return out
+
+    def test_kill_and_resume_bit_identity_text(self):
+        directory = self._tmp()
+        reference = (
+            Evaluator(self._text_collection(), block_size=2)
+            .run(self._text_stream())
+            .result()
+        )
+        first = Evaluator(
+            self._text_collection(),
+            block_size=2,
+            checkpoint_dir=directory,
+            checkpoint_every_blocks=1,
+        )
+        with FaultPlan([{"site": "engine.scan", "after": 2, "count": 1}]):
+            with self.assertRaises(InjectedFault):
+                first.run(self._text_stream())
+        second = Evaluator(
+            self._text_collection(),
+            block_size=2,
+            checkpoint_dir=directory,
+            checkpoint_every_blocks=1,
+        )
+        self.assertIsNotNone(second.resumed_from)
+        self.assertGreater(second.batches_seen, 0)
+        resumed = second.run(self._text_stream()).result()
+        self.assertEqual(second.batches_seen, len(self.SIZES))
+        self.assertEqual(_bytes_of(resumed), _bytes_of(reference))
+
+
 class TestNamespaces(unittest.TestCase):
     """Per-tenant scoping (``namespace()`` / ``delete_all()``) — the
     serve layer's spill-state contract — and the concurrent-prune

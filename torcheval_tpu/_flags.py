@@ -179,6 +179,19 @@ _DECLARATIONS: Tuple[Flag, ...] = (
         ),
     ),
     Flag(
+        name="WAVEFRONT",
+        kind="tribool",
+        default=None,
+        doc=(
+            "Route batched token edit distance through the anti-diagonal "
+            "wavefront Pallas kernel (``ops/pallas_wavefront.py``): "
+            "truthy → on everywhere (interpreter off-TPU), falsy → off "
+            "(XLA diagonal scan under a trace, native C++ DP eagerly), "
+            "unset → auto on TPU backends "
+            "(``ops._flags.wavefront_mode``)."
+        ),
+    ),
+    Flag(
         name="CACHE_DIR",
         kind="str",
         default=None,

@@ -6,6 +6,7 @@ sufficient statistics are four add-mergeable counters — candidate/
 reference lengths and per-order clipped/possible n-gram match counts —
 so the class metric merges and syncs like every counter metric."""
 
+import warnings
 from collections import Counter
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -50,6 +51,25 @@ def _bleu_param_check(
             f"the length of `weights` should equal `n_gram`, got "
             f"{len(weights)} and {n_gram}."
         )
+    if any(w < 0 for w in weights):
+        raise ValueError(
+            f"`weights` should be non-negative, got {list(weights)}."
+        )
+    total = float(sum(weights))
+    if total <= 0:
+        raise ValueError(
+            f"`weights` should have a positive sum, got {list(weights)}."
+        )
+    if abs(total - 1.0) > 1e-6:
+        # Un-normalized weights silently rescale log-BLEU by their sum;
+        # normalize to what the caller almost certainly meant, loudly.
+        warnings.warn(
+            f"`weights` sum to {total:g}, not 1; normalizing them. Pass "
+            "weights summing to 1 to silence this.",
+            UserWarning,
+            stacklevel=3,
+        )
+        weights = [w / total for w in weights]
     return jnp.asarray(weights, dtype=jnp.float32)
 
 

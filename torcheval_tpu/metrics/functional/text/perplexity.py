@@ -29,14 +29,30 @@ def perplexity(
 
 @partial(jax.jit, static_argnames=("ignore_index",))
 def _perplexity_update_kernel(
-    input: jax.Array, target: jax.Array, ignore_index: Optional[int]
+    input: jax.Array,
+    target: jax.Array,
+    ignore_index: Optional[int],
+    mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
-    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=-1)
-    token_ll = jnp.take_along_axis(logp, target[..., None], axis=-1)[..., 0]
+    # Each token's log-prob is its gathered logit minus the vocab-axis
+    # logsumexp — the full (n, seq, vocab) log-prob tensor is never
+    # formed (a log_softmax-then-gather writes and re-reads the whole
+    # cube, tripling HBM traffic at LLM vocab sizes).  Negative target
+    # ids (the tokenized-text pad convention) gather through a clipped
+    # index; ``valid`` zeroes their contribution.
+    logits = input.astype(jnp.float32)
+    token_logit = jnp.take_along_axis(
+        logits, jnp.clip(target, 0)[..., None], axis=-1
+    )[..., 0]
+    token_ll = token_logit - jax.scipy.special.logsumexp(logits, axis=-1)
     if ignore_index is None:
-        return -token_ll.sum(), jnp.asarray(token_ll.size, jnp.float32)
-    mask = target != ignore_index
-    return -(token_ll * mask).sum(), mask.sum().astype(jnp.float32)
+        valid = jnp.ones(target.shape, jnp.float32)
+    else:
+        valid = (target != ignore_index).astype(jnp.float32)
+    if mask is not None:
+        # Padded bucket rows contribute exact zeros to both counters.
+        valid = valid * mask.astype(jnp.float32)[:, None]
+    return -(token_ll * valid).sum(), valid.sum()
 
 
 @jax.jit

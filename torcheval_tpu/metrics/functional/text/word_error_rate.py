@@ -1,11 +1,19 @@
 """Word error rate and word information preserved/lost.
 
 Beyond the v0.0.4 snapshot (upstream torcheval added the text metrics
-later).  These are host-side string metrics — no device tensor exists
-until the sufficient statistics are formed — so the hot kernel is the
-native batched Levenshtein in ``torcheval_tpu/native`` (C++ via ctypes,
-pure-Python fallback).  Sufficient statistics are scalar counters,
-add-mergeable like every counter metric here.
+later).  Two input flavors share the same sufficient statistics (edit
+errors, target words, input words — scalar counters, add-mergeable like
+every counter metric here):
+
+* **strings** — host-side: per-batch word→id interning feeds the native
+  batched Levenshtein in ``torcheval_tpu/native`` (C++ via ctypes,
+  pure-Python fallback).
+* **token-id arrays** — device-resident: padded ``(n, len)`` int32 ids
+  under the negative-trailing-pad convention (``metrics/text/_tokens``),
+  or ``(n, seq, vocab)`` float logits whose greedy-argmax hypothesis is
+  derived in-kernel; the distances come from the anti-diagonal wavefront
+  routes in ``ops/pallas_wavefront.py`` and the whole update is one
+  fusable device program.
 
 WER  = edit_errors / target_words
 WIP  = (target_words − errors)/target_words · (target_words − errors)/input_words
@@ -13,7 +21,8 @@ WIP  = (target_words − errors)/target_words · (target_words − errors)/input
 WIL  = 1 − WIP
 """
 
-from typing import List, Sequence, Tuple, Union
+from functools import partial
+from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -24,14 +33,25 @@ from torcheval_tpu.native import edit_distance_batch
 TText = Union[str, Sequence[str]]
 
 
-def word_error_rate(input: TText, target: TText) -> jax.Array:
-    """WER over one or more (hypothesis, reference) string pairs."""
+def word_error_rate(input, target) -> jax.Array:
+    """WER over (hypothesis, reference) pairs — strings, token-id
+    arrays, or logits (see module docstring for the array contract)."""
+    if _is_tokens(input):
+        errors, target_total, _ = _word_stats_tokens(input, target)
+        return errors / target_total
     errors, target_total, _ = _word_stats_update(input, target)
     return jnp.asarray(errors / target_total if target_total else float("nan"))
 
 
-def word_information_preserved(input: TText, target: TText) -> jax.Array:
+def word_information_preserved(input, target) -> jax.Array:
     """Word information preserved over (hypothesis, reference) pairs."""
+    if _is_tokens(input):
+        errors, target_total, input_total = _word_stats_tokens(input, target)
+        return _wip_compute(
+            errors.astype(jnp.float32),
+            target_total.astype(jnp.float32),
+            input_total.astype(jnp.float32),
+        )
     errors, target_total, input_total = _word_stats_update(input, target)
     return _wip_compute(
         jnp.asarray(float(errors)),
@@ -40,7 +60,7 @@ def word_information_preserved(input: TText, target: TText) -> jax.Array:
     )
 
 
-def word_information_lost(input: TText, target: TText) -> jax.Array:
+def word_information_lost(input, target) -> jax.Array:
     """Word information lost: ``1 − WIP``."""
     return 1.0 - word_information_preserved(input, target)
 
@@ -60,6 +80,106 @@ def _as_list(text: TText, name: str) -> List[str]:
         return list(text)
     raise ValueError(
         f"`{name}` should be a string or a sequence of strings, got {type(text)}."
+    )
+
+
+def _is_tokens(x) -> bool:
+    """Array-flavored input (token ids or logits) vs the host string
+    path: anything with an ``ndim`` is an array, including tracers."""
+    return hasattr(x, "ndim") and not isinstance(x, (str, bytes))
+
+
+def _word_stats_tokens_check(input: jax.Array, target: jax.Array) -> None:
+    if target.ndim != 2 or not jnp.issubdtype(target.dtype, jnp.integer):
+        raise ValueError(
+            "target should be (num_sequences, num_tokens) integer token "
+            f"ids, got shape {target.shape} dtype {target.dtype}."
+        )
+    if input.ndim == 3:
+        if not jnp.issubdtype(input.dtype, jnp.inexact):
+            raise ValueError(
+                "3-D input should be (num_sequences, num_tokens, "
+                f"vocab_size) float logits, got dtype {input.dtype}."
+            )
+        if input.shape[:2] != target.shape:
+            raise ValueError(
+                "The leading dimensions of input and target should "
+                f"match, got {input.shape} and {target.shape}."
+            )
+    elif input.ndim == 2:
+        if not jnp.issubdtype(input.dtype, jnp.integer):
+            raise ValueError(
+                "2-D input should be (num_sequences, num_tokens) integer "
+                f"token ids, got dtype {input.dtype}."
+            )
+        if input.shape[0] != target.shape[0]:
+            raise ValueError(
+                "`input` and `target` should have the same number of "
+                f"sequences, got {input.shape[0]} and {target.shape[0]}."
+            )
+    else:
+        raise ValueError(
+            "input should be (n, len) token ids or (n, seq, vocab) "
+            f"logits, got shape {input.shape}."
+        )
+
+
+@partial(jax.jit, static_argnames=("route",))
+def _word_stats_device_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    route: str,
+    mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Device-resident sibling of :func:`_word_stats_update`: the three
+    counter deltas from padded token-id arrays (negative trailing pads).
+
+    A 3-D float ``input`` contributes its greedy-argmax hypothesis at
+    the reference's live positions (token error rate of the decoded
+    stream) — derived in-kernel so the whole update stays one program.
+    ``route`` ("pallas" | "xla") is :func:`~torcheval_tpu.ops.
+    pallas_wavefront.wavefront_route`'s eager decision, riding the jit
+    cache key; the native host DP cannot run under a trace, so it never
+    appears here.
+    """
+    from torcheval_tpu.ops.pallas_wavefront import (
+        _edit_distance_pallas,
+        _edit_distance_xla,
+        lens_from_ids,
+    )
+
+    target = target.astype(jnp.int32)
+    if input.ndim == 3:
+        hyp = jnp.where(
+            target >= 0, jnp.argmax(input, axis=-1).astype(jnp.int32), -1
+        )
+    else:
+        hyp = input.astype(jnp.int32)
+    a_lens = lens_from_ids(hyp)
+    b_lens = lens_from_ids(target)
+    dist_fn = _edit_distance_pallas if route == "pallas" else _edit_distance_xla
+    dist = dist_fn(hyp, target, a_lens, b_lens)
+    if mask is not None:
+        # Padded bucket rows contribute exact zeros to all three counters.
+        live = mask.astype(jnp.int32)
+        dist = dist * live
+        a_lens = a_lens * live
+        b_lens = b_lens * live
+    return dist.sum(), b_lens.sum(), a_lens.sum()
+
+
+def _word_stats_tokens(
+    input, target, mask: Optional[jax.Array] = None
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Validate + route one tokenized batch through the device kernel."""
+    from torcheval_tpu.ops.pallas_wavefront import wavefront_route
+
+    input, target = jnp.asarray(input), jnp.asarray(target)
+    _word_stats_tokens_check(input, target)
+    # concrete=False: the kernel is jitted, so the eager-only native DP
+    # is never a candidate here (strings keep it as their engine).
+    return _word_stats_device_kernel(
+        input, target, wavefront_route(False), mask=mask
     )
 
 

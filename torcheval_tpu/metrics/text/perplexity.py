@@ -25,6 +25,10 @@ from torcheval_tpu.metrics.metric import Metric
 class Perplexity(Metric[jax.Array]):
     """``exp(mean NLL)`` over all tokens seen, excluding ``ignore_index``."""
 
+    # Accepts update(..., mask=) for bucketed ragged batches (_bucket.py):
+    # a zero mask row zeroes every token of that sequence.
+    _supports_mask = True
+
     def __init__(self, *, ignore_index: Optional[int] = None, device=None) -> None:
         super().__init__(device=device)
         self.ignore_index = ignore_index
@@ -34,7 +38,7 @@ class Perplexity(Metric[jax.Array]):
         self._add_state("sum_log_probs", jnp.asarray(0.0, dtype=dtype))
         self._add_state("num_total", jnp.asarray(0.0, dtype=dtype))
 
-    def update(self, input, target) -> "Perplexity":
+    def update(self, input, target, *, mask=None) -> "Perplexity":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _perplexity_input_check(input, target)
         # Kernel + both state adds fused into one dispatch (_fuse.py).
@@ -44,6 +48,7 @@ class Perplexity(Metric[jax.Array]):
             input,
             target,
             statics=(self.ignore_index,),
+            mask=mask,
         )
         return self
 

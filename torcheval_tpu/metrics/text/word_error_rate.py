@@ -1,5 +1,6 @@
 """Word error rate / word information class metrics — scalar counter
-states fed by the native batched edit-distance kernel.
+states fed by the native batched edit-distance kernel (string inputs)
+or the anti-diagonal wavefront routes (tokenized device inputs).
 
 Beyond the v0.0.4 snapshot (upstream torcheval added the text metrics
 later)."""
@@ -9,13 +10,17 @@ from typing import Iterable
 import jax
 import jax.numpy as jnp
 
+from torcheval_tpu.metrics._fuse import accumulate
 from torcheval_tpu.metrics._merge import merge_add
 from torcheval_tpu.metrics.functional.classification.binary_normalized_entropy import (
     _accum_dtype,
 )
 from torcheval_tpu.metrics.functional.text.word_error_rate import (
     TText,
+    _is_tokens,
     _wip_compute,
+    _word_stats_device_kernel,
+    _word_stats_tokens_check,
     _word_stats_update,
 )
 from torcheval_tpu.metrics.metric import Metric
@@ -24,19 +29,59 @@ _STATES = ("errors", "target_total", "input_total")
 
 
 class _WordStatsMetric(Metric[jax.Array]):
-    """Shared state machine: the three word-alignment counters."""
+    """Shared state machine: the three word-alignment counters.
+
+    ``update`` is polymorphic over the input flavor:
+
+    * strings → the host path (interning + native C++ DP, scalar folds);
+    * ``(n, len)`` int token ids (``metrics/text/_tokens.tokenize_pairs``
+      pads, negative and trailing) → one fused device dispatch through
+      the wavefront edit-distance routes — ``_check_fusable``-clean, so
+      the family rides collection/engine-scan programs;
+    * ``(n, seq, vocab)`` float logits + id targets → greedy-argmax
+      token error rate, same device dispatch — the shared signature that
+      lets WER/WIP/WIL and ``Perplexity`` share ONE engine-scan program.
+    """
+
+    # The tokenized device path accepts update(..., mask=) for bucketed
+    # ragged batches (_bucket.py); the string path predates masks.
+    _supports_mask = True
 
     def __init__(self, *, device=None) -> None:
         super().__init__(device=device)
         for name in _STATES:
             self._add_state(name, jnp.asarray(0.0, dtype=_accum_dtype()))
 
-    def update(self, input: TText, target: TText):
-        errors, target_total, input_total = _word_stats_update(input, target)
-        # Host-computed scalars fold into the states in one tiny dispatch.
-        self.errors = self.errors + errors
-        self.target_total = self.target_total + target_total
-        self.input_total = self.input_total + input_total
+    def update(self, input, target, *, mask=None):
+        if not _is_tokens(input):
+            if mask is not None:
+                raise ValueError(
+                    "mask= requires tokenized array inputs; string "
+                    "batches are never padded."
+                )
+            errors, target_total, input_total = _word_stats_update(
+                input, target
+            )
+            # Host-computed scalars fold into the states in one tiny
+            # dispatch.
+            self.errors = self.errors + errors
+            self.target_total = self.target_total + target_total
+            self.input_total = self.input_total + input_total
+            return self
+        from torcheval_tpu.ops.pallas_wavefront import wavefront_route
+
+        input, target = jnp.asarray(input), jnp.asarray(target)
+        _word_stats_tokens_check(input, target)
+        # Kernel + all three state adds fused into one dispatch
+        # (_fuse.py); the route string rides the jit cache key.
+        self.errors, self.target_total, self.input_total = accumulate(
+            _word_stats_device_kernel,
+            (self.errors, self.target_total, self.input_total),
+            input,
+            target,
+            statics=(wavefront_route(False),),
+            mask=mask,
+        )
         return self
 
     def merge_state(self, metrics: Iterable["_WordStatsMetric"]):

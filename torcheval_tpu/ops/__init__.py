@@ -11,14 +11,17 @@ from typing import Any
 
 __all__ = [
     "auc_from_sorted",
+    "edit_distance_tokens",
     "fused_auc",
     "has_fused",
     "has_pallas",
     "pallas_binary_auroc",
+    "wavefront_route",
 ]
 
 _FUSED = {"fused_auc", "has_fused"}
 _PALLAS = {"auc_from_sorted", "has_pallas", "pallas_binary_auroc"}
+_WAVEFRONT = {"edit_distance_tokens", "wavefront_route"}
 
 
 def __getattr__(name: str) -> Any:
@@ -28,6 +31,10 @@ def __getattr__(name: str) -> Any:
         return getattr(_m, name)
     if name in _PALLAS:
         from torcheval_tpu.ops import pallas_auc as _m
+
+        return getattr(_m, name)
+    if name in _WAVEFRONT:
+        from torcheval_tpu.ops import pallas_wavefront as _m
 
         return getattr(_m, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -71,6 +71,24 @@ def megakernel_mode() -> "bool | None":
     return _flags.get("MEGAKERNEL")
 
 
+def wavefront_mode() -> "bool | None":
+    """Tri-state read of ``TORCHEVAL_TPU_WAVEFRONT`` — the anti-diagonal
+    wavefront Levenshtein route (``ops/pallas_wavefront.py``).
+
+    ``True`` forces the Pallas wavefront kernel on every backend (this
+    is how CPU tier-1 exercises the ``interpret=True`` path), ``False``
+    disables it (traced callers fall back to the ``lax.scan`` diagonal
+    sweep, eager callers to the native C++ DP), and ``None`` (unset)
+    means *auto*: wavefront on TPU backends, fallbacks elsewhere.
+    ``TORCHEVAL_TPU_DISABLE_PALLAS`` outranks a forced-on value, exactly
+    as it outranks every other Pallas route.  Read at call time; the hot
+    paths fold the value into their program-cache keys
+    (``ops._mega_plan.route_token``) so toggling mid-lifecycle retraces
+    instead of reusing a stale route.
+    """
+    return _flags.get("WAVEFRONT")
+
+
 def configure_persistent_cache() -> "str | None":
     """Enable JAX's persistent compilation cache when
     ``TORCHEVAL_TPU_CACHE_DIR`` names a directory, returning the path (or

@@ -101,7 +101,8 @@ class MegaPlan:
 
 
 def route_token() -> Tuple[Any, ...]:
-    """The call-time inputs the megakernel route decision depends on.
+    """The call-time inputs the Pallas route decisions (megakernel and
+    wavefront) depend on.
 
     The hot paths fold this into their program-cache keys (fused rebuild
     condition, the engine's scan-runner check, serve's bundle key) so a
@@ -110,7 +111,12 @@ def route_token() -> Tuple[Any, ...]:
         backend = jax.default_backend()
     except Exception:  # pragma: no cover - backend init failure
         backend = "unknown"
-    return (_oflags.megakernel_mode(), _oflags.pallas_disabled(), backend)
+    return (
+        _oflags.megakernel_mode(),
+        _oflags.wavefront_mode(),
+        _oflags.pallas_disabled(),
+        backend,
+    )
 
 
 def _shape_of(x) -> Optional[Tuple[int, ...]]:

@@ -346,6 +346,94 @@ def explain_route(fn, *args, **kwargs) -> str:
             f"shapes and flags only, identical under a caller's jit."
         )
 
+    # --- text wavefront family ------------------------------------------
+    from torcheval_tpu.ops.pallas_wavefront import (
+        edit_distance_tokens as _edt,
+    )
+
+    if fn in (
+        F.word_error_rate,
+        F.word_information_preserved,
+        F.word_information_lost,
+        _edt,
+    ):
+        from torcheval_tpu.metrics.functional.text.word_error_rate import (
+            _is_tokens,
+        )
+        from torcheval_tpu.ops import _flags as _oflags
+        from torcheval_tpu.ops.pallas_wavefront import (
+            has_pallas,
+            wavefront_plan,
+            wavefront_route,
+        )
+
+        if fn is not _edt and args and not _is_tokens(args[0]):
+            return (
+                f"{name}: host string path — per-batch word→id interning "
+                "feeds the native C++ batched DP (ctypes, pure-Python "
+                "fallback).  Tokenize with metrics/text/_tokens."
+                "tokenize_pairs to ride the device wavefront routes."
+            )
+        mode = _oflags.wavefront_mode()
+        # The metric/functional kernels are jitted, so the eager-only
+        # native DP is a candidate only for a concrete
+        # edit_distance_tokens call.
+        concrete = fn is _edt and all_concrete(
+            *[a for a in args if a is not None]
+        )
+        route = wavefront_route(concrete)
+        if route != "pallas":
+            reason = (
+                "the TORCHEVAL_TPU_DISABLE_PALLAS kill-switch outranks "
+                "even a forced-on TORCHEVAL_TPU_WAVEFRONT"
+                if pallas_disabled()
+                else "TORCHEVAL_TPU_WAVEFRONT is falsy"
+                if mode is False
+                else f"auto mode engages only on TPU (backend is "
+                f"{backend!r}); TORCHEVAL_TPU_WAVEFRONT=1 forces the "
+                "interpreter elsewhere"
+            )
+            detail = (
+                "the native C++ batch DP (eager concrete call)"
+                if route == "native"
+                else "the lax.scan anti-diagonal sweep (same integer "
+                "arithmetic, any backend)"
+            )
+            return (
+                f"{name}: wavefront Pallas route OFF ({reason}); edit "
+                f"distances come from {detail} — integer-exact against "
+                "the kernel."
+            )
+        flagged = (
+            "FORCED ON (TORCHEVAL_TPU_WAVEFRONT truthy; the interpreter "
+            "emulates off-TPU)"
+            if mode
+            else "AUTO on this TPU backend"
+        )
+        shapes = [getattr(a, "shape", None) for a in args[:2]]
+        if all(s is not None and len(s) >= 2 for s in shapes):
+            n = shapes[0][0]
+            len_a = shapes[0][1] if len(shapes[0]) == 2 else shapes[1][1]
+            len_b = shapes[1][1]
+            plan = wavefront_plan(int(n), int(len_a), int(len_b))
+            geometry = (
+                f"  Engaged bucket: ({plan['pairs']}, {plan['lanes']}) "
+                f"int32 block, one grid sweep of {plan['grid']} "
+                f"anti-diagonals, ~{plan['vmem_bytes'] // 1024} KiB VMEM "
+                "high water (three rolling diagonal buffers — O(max_len) "
+                "memory, never the O(len²) DP matrix)."
+            )
+        else:
+            geometry = (
+                "  Pass sample (n, len) id arrays for the engaged bucket "
+                "geometry."
+            )
+        return (
+            f"{name}: wavefront Pallas route {flagged} — each DP "
+            "anti-diagonal is data-parallel across the whole pair bucket "
+            f"(ops/pallas_wavefront.py).{geometry}"
+        )
+
     parallel_answer = _explain_parallel_route(fn, name, args, kwargs)
     if parallel_answer is not None:
         # Sharded entry points share one jit(shard_map) memoizer; its
