@@ -252,6 +252,31 @@ def _drive_hot_path() -> None:
             list(evaluator_text.result().values())[0]
         ).block_until_ready()
 
+    # The rank-sketch tier (ops/rank_sketch.py) makes the same promise:
+    # forced on, the curve metrics carry compactor count states, their
+    # masked single-pass updates ride the fused/megakernel dispatch, and
+    # every ENABLED gate crossed on the way — construction census,
+    # route selection, accumulate, merge — stays cold.
+    from torcheval_tpu.metrics import BinaryAUPRC, BinaryAUROC
+
+    with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_RANK_SKETCH": "1"}):
+        col_rank = MetricCollection(
+            {"roc": BinaryAUROC(), "prc": BinaryAUPRC()}
+        )
+        for b in (33, 70):
+            col_rank.fused_update(
+                jnp.asarray(rng.random(b, dtype=np.float32)),
+                jnp.asarray((rng.random(b) > 0.5).astype(np.float32)),
+            )
+        shard = BinaryAUROC()
+        shard.update(
+            jnp.asarray(rng.random(40, dtype=np.float32)),
+            jnp.asarray((rng.random(40) > 0.5).astype(np.float32)),
+            mask=jnp.asarray(np.arange(40) % 2 == 0),
+        )
+        col_rank._metrics["roc"].merge_state([shard])
+        jnp.asarray(col_rank.compute()["roc"]).block_until_ready()
+
     # The multi-tenant serve layer: admission (faults.fire + the
     # admission/session record hooks), coalesced dispatch, a
     # spill/resume round trip, and drain — every serve hook site is

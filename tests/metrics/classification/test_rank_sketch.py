@@ -1,0 +1,316 @@
+"""Rank-sketch tier (``sketch=True`` curve metrics): measured rank error
+stays inside the documented ``rank_error_bound`` ceiling, the compactor
+merge is associative/commutative/bit-deterministic across split orders,
+masks fold exactly, checkpoints kill-and-resume bit-identically, the
+``"rank"`` sketch kind round-trips the device counts, and sketch-mode
+members fold bit-identically under the collection megakernel."""
+
+import itertools
+import os
+import unittest
+from unittest import mock
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import (
+    BinaryAUPRC,
+    BinaryAUROC,
+    MetricCollection,
+    MulticlassAUROC,
+)
+from torcheval_tpu.metrics._rank_state import RANK_COUNTS
+from torcheval_tpu.metrics._sketch import RankSketch
+from torcheval_tpu.ops._mega_plan import route_token
+from torcheval_tpu.ops.rank_sketch import DEFAULT_BINS, rank_error_bound
+
+
+def _stream(seed=0, n=4096):
+    """Scores correlated with targets so the curves are informative."""
+    rng = np.random.default_rng(seed)
+    scores = rng.random(n).astype(np.float32)
+    targets = (rng.random(n) < scores).astype(np.float32)
+    return jnp.asarray(scores), jnp.asarray(targets)
+
+
+def _mc_stream(seed=0, n=2048, c=4):
+    rng = np.random.default_rng(seed)
+    logits = rng.random((n, c)).astype(np.float32)
+    scores = logits / logits.sum(axis=1, keepdims=True)
+    targets = rng.integers(0, c, n).astype(np.int32)
+    return jnp.asarray(scores), jnp.asarray(targets)
+
+
+def _counts(metric):
+    return tuple(np.asarray(getattr(metric, name)) for name in RANK_COUNTS)
+
+
+def _assert_same_counts(test, a, b):
+    for name, x, y in zip(RANK_COUNTS, _counts(a), _counts(b)):
+        np.testing.assert_array_equal(x, y, err_msg=name)
+
+
+class TestRankErrorBound(unittest.TestCase):
+    """Measured |sketch - exact| <= documented eps, at capacity."""
+
+    def test_binary_auroc_within_eps(self):
+        for bins in (128, DEFAULT_BINS):
+            scores, targets = _stream(seed=1, n=20000)
+            sketch = BinaryAUROC(sketch=True, sketch_bins=bins)
+            exact = BinaryAUROC()
+            sketch.update(scores, targets)
+            exact.update(scores, targets)
+            err = abs(float(sketch.compute()) - float(exact.compute()))
+            self.assertLessEqual(err, rank_error_bound(bins))
+
+    def test_binary_auprc_within_eps(self):
+        scores, targets = _stream(seed=2, n=20000)
+        sketch = BinaryAUPRC(sketch=True)
+        exact = BinaryAUPRC()
+        sketch.update(scores, targets)
+        exact.update(scores, targets)
+        err = abs(float(sketch.compute()) - float(exact.compute()))
+        self.assertLessEqual(err, rank_error_bound(DEFAULT_BINS))
+
+    def test_multiclass_auroc_within_eps(self):
+        scores, targets = _mc_stream(seed=3, n=8000)
+        sketch = MulticlassAUROC(num_classes=4, sketch=True)
+        exact = MulticlassAUROC(num_classes=4)
+        sketch.update(scores, targets)
+        exact.update(scores, targets)
+        err = abs(float(sketch.compute()) - float(exact.compute()))
+        self.assertLessEqual(err, rank_error_bound(DEFAULT_BINS))
+
+    def test_streamed_equals_one_shot(self):
+        # The sketch is a stream summary: chunked updates must land on
+        # exactly the same counts as one batched update.
+        scores, targets = _stream(seed=4, n=4096)
+        one = BinaryAUROC(sketch=True)
+        one.update(scores, targets)
+        chunked = BinaryAUROC(sketch=True)
+        for lo in range(0, 4096, 512):
+            chunked.update(scores[lo : lo + 512], targets[lo : lo + 512])
+        _assert_same_counts(self, one, chunked)
+        self.assertEqual(float(one.compute()), float(chunked.compute()))
+
+
+class TestMergeAlgebra(unittest.TestCase):
+    """Compactor merge = integer add: associative, commutative, and
+    bit-deterministic over every split order."""
+
+    def _shards(self, k=4, cls=BinaryAUROC):
+        shards = []
+        for i in range(k):
+            m = cls(sketch=True)
+            m.update(*_stream(seed=10 + i, n=777))
+            shards.append(m)
+        return shards
+
+    def _fold(self, order):
+        shards = self._shards()
+        root = BinaryAUROC(sketch=True)
+        root.update(*_stream(seed=99, n=333))
+        for i in order:
+            root.merge_state([shards[i]])
+        return root
+
+    def test_all_merge_orders_bit_identical(self):
+        reference = self._fold((0, 1, 2, 3))
+        for order in itertools.permutations(range(4)):
+            folded = self._fold(order)
+            _assert_same_counts(self, reference, folded)
+            self.assertEqual(
+                float(reference.compute()), float(folded.compute())
+            )
+
+    def test_tree_vs_flat_split(self):
+        # ((a+b) + (c+d)) must equal (a+b+c+d) folded flat.
+        flat = self._shards()
+        flat[0].merge_state(flat[1:])
+        tree = self._shards()
+        tree[0].merge_state([tree[1]])
+        tree[2].merge_state([tree[3]])
+        tree[0].merge_state([tree[2]])
+        _assert_same_counts(self, flat[0], tree[0])
+
+    def test_merge_rejects_buffer_operand(self):
+        sketch = BinaryAUROC(sketch=True)
+        buffer = BinaryAUROC()
+        buffer.update(*_stream(seed=0, n=32))
+        with self.assertRaisesRegex(ValueError, "sample-buffer"):
+            sketch.merge_state([buffer])
+
+    def test_merge_rejects_bins_mismatch(self):
+        a = BinaryAUROC(sketch=True, sketch_bins=128)
+        b = BinaryAUROC(sketch=True, sketch_bins=256)
+        with self.assertRaisesRegex(ValueError, "edge geometry"):
+            a.merge_state([b])
+
+
+class TestMaskSemantics(unittest.TestCase):
+    def test_mask_equals_dropping_samples(self):
+        scores, targets = _stream(seed=5, n=1024)
+        mask = jnp.asarray(np.arange(1024) % 3 != 0)
+        masked = BinaryAUROC(sketch=True)
+        masked.update(scores, targets, mask=mask)
+        dense = BinaryAUROC(sketch=True)
+        keep = np.asarray(mask)
+        dense.update(scores[keep], targets[keep])
+        _assert_same_counts(self, masked, dense)
+
+    def test_buffer_mode_mask_raises(self):
+        scores, targets = _stream(seed=0, n=16)
+        with self.assertRaisesRegex(ValueError, "sketch=True"):
+            BinaryAUROC().update(
+                scores, targets, mask=jnp.ones(16, bool)
+            )
+
+    def test_sketch_plus_fused_raises(self):
+        with self.assertRaises(ValueError):
+            BinaryAUROC(sketch=True, use_fused=True)
+
+
+class TestRankSketchKind(unittest.TestCase):
+    """``sketch_state(kind="rank")`` wraps the device counts directly."""
+
+    def test_rank_kind_round_trips_counts(self):
+        m = BinaryAUROC(sketch=True)
+        m.update(*_stream(seed=6, n=2048))
+        sk = m.sketch_state("rank")
+        self.assertIsInstance(sk, RankSketch)
+        np.testing.assert_array_equal(sk.num_tp, np.asarray(m.num_tp))
+        self.assertEqual(float(sk.compute()), float(m.compute()))
+
+    def test_payload_is_o_compactors(self):
+        # Payload must not grow with the stream length.
+        small = BinaryAUROC(sketch=True)
+        small.update(*_stream(seed=7, n=128))
+        big = BinaryAUROC(sketch=True)
+        big.update(*_stream(seed=7, n=32768))
+        self.assertEqual(
+            small.sketch_state("rank").nbytes(),
+            big.sketch_state("rank").nbytes(),
+        )
+
+    def test_sample_kinds_rejected_on_sketch_mode(self):
+        m = BinaryAUROC(sketch=True)
+        for kind in ("reservoir", "histogram", "count"):
+            with self.assertRaises(ValueError):
+                m.sketch_state(kind)
+        with self.assertRaisesRegex(ValueError, "no options"):
+            m.sketch_state("rank", bins=64)
+
+    def test_host_built_sketch_is_bit_parity_with_device(self):
+        # RankSketch.from_samples on the raw stream must reproduce the
+        # device counts exactly (same edges, same searchsorted side).
+        scores, targets = _stream(seed=8, n=4096)
+        m = BinaryAUROC(sketch=True)
+        m.update(scores, targets)
+        host = RankSketch.from_samples(
+            "binary_auroc",
+            np.asarray(scores),
+            np.asarray(targets),
+            bins=DEFAULT_BINS,
+        )
+        np.testing.assert_array_equal(host.num_tp, np.asarray(m.num_tp))
+        np.testing.assert_array_equal(host.num_fp, np.asarray(m.num_fp))
+        self.assertEqual(float(host.compute()), float(m.compute()))
+
+    def test_merged_sketches_match_merged_metrics(self):
+        a = BinaryAUROC(sketch=True)
+        a.update(*_stream(seed=20, n=1500))
+        b = BinaryAUROC(sketch=True)
+        b.update(*_stream(seed=21, n=1500))
+        merged_sketch = a.sketch_state("rank").merge(b.sketch_state("rank"))
+        a.merge_state([b])
+        self.assertEqual(
+            float(merged_sketch.compute()), float(a.compute())
+        )
+
+
+class TestCheckpointResume(unittest.TestCase):
+    def test_kill_and_resume_bit_identity(self):
+        scores, targets = _stream(seed=9, n=4096)
+        straight = BinaryAUROC(sketch=True)
+        straight.update(scores[:2048], targets[:2048])
+        straight.update(scores[2048:], targets[2048:])
+
+        killed = BinaryAUROC(sketch=True)
+        killed.update(scores[:2048], targets[:2048])
+        # "Kill": serialize to host numpy, drop the instance, restore.
+        snapshot = {
+            k: np.asarray(v) for k, v in killed.state_dict().items()
+        }
+        del killed
+        resumed = BinaryAUROC(sketch=True)
+        resumed.load_state_dict(
+            {k: jnp.asarray(v) for k, v in snapshot.items()}
+        )
+        resumed.update(scores[2048:], targets[2048:])
+
+        _assert_same_counts(self, straight, resumed)
+        self.assertEqual(
+            float(straight.compute()), float(resumed.compute())
+        )
+
+
+class TestMegakernelParity(unittest.TestCase):
+    """Sketch-mode members classify as ``"binned"`` megakernel members
+    and fold bit-identically to their per-member updates."""
+
+    def test_collection_fold_bit_identical(self):
+        scores, targets = _stream(seed=11, n=2048)
+        solo_roc = BinaryAUROC(sketch=True)
+        solo_prc = BinaryAUPRC(sketch=True)
+        solo_roc.update(scores, targets)
+        solo_prc.update(scores, targets)
+
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_MEGAKERNEL": "1"}
+        ):
+            col = MetricCollection(
+                {
+                    "roc": BinaryAUROC(sketch=True),
+                    "prc": BinaryAUPRC(sketch=True),
+                }
+            )
+            col.update(scores, targets)
+
+        _assert_same_counts(self, solo_roc, col._metrics["roc"])
+        _assert_same_counts(self, solo_prc, col._metrics["prc"])
+        out = col.compute()
+        self.assertEqual(float(out["roc"]), float(solo_roc.compute()))
+        self.assertEqual(float(out["prc"]), float(solo_prc.compute()))
+
+
+class TestRouteToken(unittest.TestCase):
+    def test_rank_sketch_mode_in_token(self):
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_RANK_SKETCH": "1"}
+        ):
+            on = route_token()
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_RANK_SKETCH": "0"}
+        ):
+            off = route_token()
+        self.assertEqual(len(on), 5)
+        self.assertTrue(on[2])
+        self.assertFalse(off[2])
+        self.assertNotEqual(on, off)
+
+    def test_env_flag_engages_sketch_mode(self):
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_RANK_SKETCH": "1"}
+        ):
+            m = BinaryAUROC()
+        self.assertTrue(m._sketch_mode)
+        # Explicit construction wins over the env default.
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_RANK_SKETCH": "1"}
+        ):
+            off = BinaryAUROC(sketch=False)
+        self.assertFalse(off._sketch_mode)
+
+
+if __name__ == "__main__":
+    unittest.main()

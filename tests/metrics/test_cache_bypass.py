@@ -1,0 +1,145 @@
+"""Persistent-cache opt-out for donated jit programs (ROADMAP item 6):
+the donated hot paths must bypass the JAX persistent compilation cache
+on the first call at each signature — and only then — leaving the config
+restored and the donation-off twin untouched.  This is the regression
+fence for the ``test_donate_on_and_off`` warm-cache flake."""
+
+import os
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.metrics import MetricCollection, MulticlassAccuracy
+from torcheval_tpu.ops._flags import cache_bypass, cache_bypass_count
+
+
+def _data(seed=0, n=67, c=3):
+    # Deliberately odd shapes: fresh signatures for this test module, so
+    # the per-signature seen-sets in _fuse/collection don't swallow the
+    # first-call bypass when other test modules ran first.
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.random((n, c)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, c, n).astype(np.int32)),
+    )
+
+
+class _DonateEnv(unittest.TestCase):
+    def _force_donate(self, value):
+        self._prev = os.environ.get("TORCHEVAL_TPU_DONATE")
+        os.environ["TORCHEVAL_TPU_DONATE"] = value
+        self.addCleanup(self._restore)
+
+    def _restore(self):
+        if self._prev is None:
+            os.environ.pop("TORCHEVAL_TPU_DONATE", None)
+        else:
+            os.environ["TORCHEVAL_TPU_DONATE"] = self._prev
+
+
+class TestBypassContext(unittest.TestCase):
+    def test_toggles_and_restores_config(self):
+        prior = bool(jax.config.jax_enable_compilation_cache)
+        before = cache_bypass_count()
+        with cache_bypass():
+            self.assertFalse(jax.config.jax_enable_compilation_cache)
+        self.assertEqual(
+            bool(jax.config.jax_enable_compilation_cache), prior
+        )
+        self.assertEqual(cache_bypass_count(), before + 1)
+
+    def test_restores_on_exception(self):
+        prior = bool(jax.config.jax_enable_compilation_cache)
+        with self.assertRaises(RuntimeError):
+            with cache_bypass():
+                raise RuntimeError("compile blew up")
+        self.assertEqual(
+            bool(jax.config.jax_enable_compilation_cache), prior
+        )
+
+    def test_nested_restores_outermost_prior(self):
+        prior = bool(jax.config.jax_enable_compilation_cache)
+        with cache_bypass():
+            with cache_bypass():
+                self.assertFalse(
+                    jax.config.jax_enable_compilation_cache
+                )
+        self.assertEqual(
+            bool(jax.config.jax_enable_compilation_cache), prior
+        )
+
+
+class TestDonatedFirstCallBypasses(_DonateEnv):
+    def test_per_metric_update_bypasses_once(self):
+        self._force_donate("1")
+        m = MulticlassAccuracy(num_classes=3)
+        before = cache_bypass_count()
+        m.update(*_data(0))
+        first = cache_bypass_count()
+        # First call at this signature compiled under the bypass (the
+        # wrapping contexts may nest, so assert at-least-one).
+        self.assertGreaterEqual(first, before + 1)
+        m.update(*_data(1))
+        # Steady state: same signature, no further bypass.
+        self.assertEqual(cache_bypass_count(), first)
+        self.assertTrue(jax.config.jax_enable_compilation_cache)
+
+    def test_fused_collection_bypasses_once(self):
+        self._force_donate("1")
+        col = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3)}
+        )
+        before = cache_bypass_count()
+        col.update(*_data(2, n=71))
+        first = cache_bypass_count()
+        self.assertGreaterEqual(first, before + 1)
+        col.update(*_data(3, n=71))
+        self.assertEqual(cache_bypass_count(), first)
+        self.assertTrue(jax.config.jax_enable_compilation_cache)
+
+    def test_windowed_pair_bypasses_once_then_runs_warm(self):
+        # The windowed-pair donated site (_buffer.py) has its own
+        # seen-set; the warm path must be a clean no-op context, not
+        # just "no extra bypass".
+        from torcheval_tpu.metrics.window import WindowedClickThroughRate
+
+        self._force_donate("1")
+        m = WindowedClickThroughRate(max_num_updates=3)
+        rng = np.random.default_rng(11)
+        batch = jnp.asarray((rng.random(97) > 0.5).astype(np.float32))
+        before = cache_bypass_count()
+        m.update(batch)
+        first = cache_bypass_count()
+        self.assertGreaterEqual(first, before + 1)
+        for _ in range(4):  # warm calls, including a window rotation
+            m.update(batch)
+        self.assertEqual(cache_bypass_count(), first)
+        self.assertTrue(jax.config.jax_enable_compilation_cache)
+
+    def test_new_signature_bypasses_again(self):
+        self._force_donate("1")
+        m = MulticlassAccuracy(num_classes=3)
+        m.update(*_data(4, n=73))
+        mark = cache_bypass_count()
+        m.update(*_data(5, n=79))  # different batch shape: new program
+        self.assertGreaterEqual(cache_bypass_count(), mark + 1)
+
+
+class TestDonationOffNeverBypasses(_DonateEnv):
+    def test_no_bypass_without_donation(self):
+        self._force_donate("0")
+        m = MulticlassAccuracy(num_classes=3)
+        col = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3)}
+        )
+        before = cache_bypass_count()
+        m.update(*_data(6, n=83))
+        m.update(*_data(7, n=83))
+        col.update(*_data(8, n=89))
+        self.assertEqual(cache_bypass_count(), before)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,117 @@
+"""StreamDigest: dyadic-ladder quantiles stay inside the documented
+relative-error bound, merges are bit-deterministic integer adds, masks
+fold exactly, and the geometry check rejects mismatched ladders."""
+
+import itertools
+import unittest
+
+import jax.numpy as jnp
+import numpy as np
+
+from torcheval_tpu.monitor import StreamDigest
+
+
+def _latencies(seed=0, n=20000):
+    """Lognormal 'latency seconds' spanning several decades."""
+    rng = np.random.default_rng(seed)
+    return rng.lognormal(mean=-4.0, sigma=1.5, size=n).astype(np.float32)
+
+
+class TestQuantileAccuracy(unittest.TestCase):
+    def test_relative_error_within_bound(self):
+        values = _latencies(seed=1)
+        digest = StreamDigest()
+        digest.update(jnp.asarray(values))
+        for q, got in zip(digest.quantiles, np.asarray(digest.compute())):
+            want = np.quantile(values, q)
+            # Left-edge reads under-report by at most one bin: the
+            # documented ceiling is 2/bins relative above the base floor.
+            self.assertLessEqual(
+                abs(got - want) / want,
+                2.0 / digest.bins,
+                f"q={q}: got {got}, want {want}",
+            )
+
+    def test_empty_sentinel(self):
+        self.assertEqual(StreamDigest().compute().shape, (0,))
+
+    def test_streamed_equals_one_shot(self):
+        values = _latencies(seed=2, n=4096)
+        one = StreamDigest()
+        one.update(jnp.asarray(values))
+        chunked = StreamDigest()
+        for lo in range(0, 4096, 512):
+            chunked.update(jnp.asarray(values[lo : lo + 512]))
+        np.testing.assert_array_equal(
+            np.asarray(one.counts), np.asarray(chunked.counts)
+        )
+
+    def test_bad_quantile_rejected(self):
+        with self.assertRaises(ValueError):
+            StreamDigest(quantiles=(0.5, 1.5))
+        with self.assertRaises(ValueError):
+            StreamDigest(quantiles=(0.0,))
+
+
+class TestMergeDeterminism(unittest.TestCase):
+    def _shards(self, k=3):
+        shards = []
+        for i in range(k):
+            d = StreamDigest()
+            d.update(jnp.asarray(_latencies(seed=10 + i, n=700)))
+            shards.append(d)
+        return shards
+
+    def test_all_merge_orders_identical(self):
+        reference = None
+        for order in itertools.permutations(range(3)):
+            shards = self._shards()
+            root = StreamDigest()
+            root.update(jnp.asarray(_latencies(seed=9, n=300)))
+            for i in order:
+                root.merge_state([shards[i]])
+            counts = np.asarray(root.counts)
+            if reference is None:
+                reference = counts
+            else:
+                np.testing.assert_array_equal(reference, counts)
+
+    def test_geometry_mismatch_rejected(self):
+        with self.assertRaisesRegex(ValueError, "ladder geometry"):
+            StreamDigest(bins=64).merge_state([StreamDigest(bins=32)])
+
+
+class TestMaskAndLifecycle(unittest.TestCase):
+    def test_mask_equals_dropping_samples(self):
+        values = _latencies(seed=3, n=1024)
+        mask = np.arange(1024) % 4 != 0
+        masked = StreamDigest()
+        masked.update(jnp.asarray(values), mask=jnp.asarray(mask))
+        dense = StreamDigest()
+        dense.update(jnp.asarray(values[mask]))
+        np.testing.assert_array_equal(
+            np.asarray(masked.counts), np.asarray(dense.counts)
+        )
+
+    def test_reset_and_checkpoint_round_trip(self):
+        d = StreamDigest()
+        d.update(jnp.asarray(_latencies(seed=4, n=512)))
+        snapshot = {k: np.asarray(v) for k, v in d.state_dict().items()}
+        fresh = StreamDigest()
+        fresh.load_state_dict(
+            {k: jnp.asarray(v) for k, v in snapshot.items()}
+        )
+        np.testing.assert_array_equal(
+            np.asarray(d.counts), np.asarray(fresh.counts)
+        )
+        d.reset()
+        self.assertEqual(int(d.counts.sum()), 0)
+
+    def test_fill_accounts_for_every_sample(self):
+        d = StreamDigest()
+        d.update(jnp.asarray(_latencies(seed=5, n=2048)))
+        self.assertEqual(int(np.asarray(d.fill()).sum()), 2048)
+
+
+if __name__ == "__main__":
+    unittest.main()

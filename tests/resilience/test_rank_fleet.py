@@ -1,0 +1,142 @@
+"""Rank-sketch fleet merges: ``fleet_merge(..., sketch="rank")`` on
+sketch-mode curve metrics is bit-identical to the flat merge at every
+world size (integer-add compactors — no merge-order sensitivity to
+forgive), and the root payload is O(compactors), >=10x smaller than the
+buffer gather at world=8."""
+
+import threading
+import unittest
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu.distributed import LocalWorld
+from torcheval_tpu.metrics import BinaryAUROC
+from torcheval_tpu.parallel.fleet_merge import MergePolicy, fleet_merge
+
+pytestmark = pytest.mark.chaos
+
+_FAST = MergePolicy(level_deadline=0.25, poll_slice=0.01)
+
+# Per-rank stream length for the payload comparison: long enough that
+# the buffer gather (O(samples)) dwarfs the sketch (O(compactors)).
+_N = 8192
+
+
+def _data(rank, n=_N):
+    rng = np.random.default_rng(300 + rank)
+    scores = rng.random(n).astype(np.float32)
+    targets = (rng.random(n) < scores).astype(np.float32)
+    return jnp.asarray(scores), jnp.asarray(targets)
+
+
+def _sketch_metric(rank):
+    m = BinaryAUROC(sketch=True)
+    m.update(*_data(rank))
+    return m
+
+
+def _buffer_metric(rank):
+    m = BinaryAUROC()
+    m.update(*_data(rank))
+    return m
+
+
+def _flat_sketch_value(world):
+    metrics = [_sketch_metric(r) for r in range(world)]
+    metrics[0].merge_state(metrics[1:])
+    return float(metrics[0].compute())
+
+
+def _run_merge(world, *, sketch, make, topology="tree"):
+    w = LocalWorld(world)
+    outs = [None] * world
+    errors = []
+
+    def worker(rank):
+        try:
+            outs[rank] = fleet_merge(
+                make(rank),
+                w.group(rank),
+                topology=topology,
+                sketch=sketch,
+                policy=_FAST,
+            )
+        except BaseException as exc:  # noqa: BLE001 - asserted below
+            errors.append((rank, exc))
+
+    threads = [
+        threading.Thread(target=worker, args=(r,)) for r in range(world)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90.0)
+    assert not any(t.is_alive() for t in threads), "merge hung"
+    assert not errors, errors
+    return outs
+
+
+class RankFleetParity(unittest.TestCase):
+    def test_tree_matches_flat_at_every_world(self):
+        for world in (2, 3, 5, 8):
+            reference = _flat_sketch_value(world)
+            outs = _run_merge(world, sketch="rank", make=_sketch_metric)
+            root = outs[0]
+            self.assertFalse(root.partial)
+            self.assertEqual(root.sketch, "rank")
+            self.assertEqual(
+                float(root.value),
+                reference,
+                f"world={world}: sketch merge drifted from flat fold",
+            )
+
+    def test_ring_matches_tree(self):
+        tree = _run_merge(5, sketch="rank", make=_sketch_metric)
+        ring = _run_merge(
+            5, sketch="rank", make=_sketch_metric, topology="ring"
+        )
+        self.assertEqual(float(tree[0].value), float(ring[0].value))
+
+    def test_exact_gather_of_sketch_states_matches_rank(self):
+        # sketch=None ships the whole sketch-mode state (still only the
+        # count arrays) — both roads must land on the same bits.
+        exact = _run_merge(3, sketch=None, make=_sketch_metric)
+        rank = _run_merge(3, sketch="rank", make=_sketch_metric)
+        self.assertEqual(float(exact[0].value), float(rank[0].value))
+
+
+class RankFleetPayload(unittest.TestCase):
+    def test_rank_payload_10x_under_buffer_gather_at_world_8(self):
+        buffered = _run_merge(8, sketch=None, make=_buffer_metric)
+        ranked = _run_merge(8, sketch="rank", make=_sketch_metric)
+        buffer_bytes = buffered[0].payload_bytes_at_root
+        rank_bytes = ranked[0].payload_bytes_at_root
+        self.assertGreater(buffer_bytes, 0)
+        self.assertGreater(rank_bytes, 0)
+        self.assertGreaterEqual(
+            buffer_bytes / rank_bytes,
+            10.0,
+            f"buffer gather {buffer_bytes}B vs rank sketch {rank_bytes}B",
+        )
+
+    def test_rank_payload_independent_of_stream_length(self):
+        short = _run_merge(
+            2,
+            sketch="rank",
+            make=lambda r: (
+                m := BinaryAUROC(sketch=True),
+                m.update(*_data(r, n=256)),
+            )[0],
+        )
+        long = _run_merge(2, sketch="rank", make=_sketch_metric)
+        self.assertEqual(
+            short[0].payload_bytes_at_root,
+            long[0].payload_bytes_at_root,
+        )
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -192,6 +192,22 @@ _DECLARATIONS: Tuple[Flag, ...] = (
         ),
     ),
     Flag(
+        name="RANK_SKETCH",
+        kind="tribool",
+        default=None,
+        doc=(
+            "Default the exact-rank curve metrics (BinaryAUROC / "
+            "BinaryAUPRC / MulticlassAUROC) to their mergeable rank-"
+            "sketch states (``ops/rank_sketch.py``): truthy → sketch "
+            "states for metrics constructed without an explicit "
+            "``sketch=``, falsy or unset → the exact sample-buffer "
+            "states.  ``TORCHEVAL_TPU_DISABLE_PALLAS`` outranks a "
+            "forced-on value for the kernel route (sketch updates fall "
+            "back to the scatter-free XLA formulation) "
+            "(``ops._flags.rank_sketch_mode``)."
+        ),
+    ),
+    Flag(
         name="CACHE_DIR",
         kind="str",
         default=None,

@@ -20,6 +20,7 @@ mid-trace or mid-flight (donation included) leaves every member state
 concrete and readable.
 """
 
+from contextlib import nullcontext as _nullcontext
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -174,11 +175,21 @@ class ScanRunner:
             # (before any state is read) — the kill the checkpoint/resume
             # suite recovers from.
             _faults.fire("engine.scan", signature=hash(key))
-        if self._seen.get(key) is None:
+        first_at_signature = self._seen.get(key) is None
+        if first_at_signature:
             col._check_fusable()
         before = col._read_states()
+        # First donated call at a signature may compile; keep donated
+        # executables out of the persistent compilation cache (ROADMAP
+        # item 6).  Steady state never enters the context.
+        bypass = (
+            _flags.cache_bypass()
+            if self._donate and first_at_signature
+            else _nullcontext()
+        )
         try:
-            out = self._apply(before, stacked_args, stacked_mask)
+            with bypass:
+                out = self._apply(before, stacked_args, stacked_mask)
         except BaseException:
             if _telemetry.ENABLED and self._donate:
                 _telemetry.record_donation("abort")

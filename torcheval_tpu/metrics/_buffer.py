@@ -7,6 +7,7 @@ concatenates; ``_prepare_for_merge_state`` pre-concatenates each buffer so
 the sync wire ships a single array per state (reference
 ``classification/auroc.py:130-134``)."""
 
+from contextlib import nullcontext as _nullcontext
 from typing import Iterable
 
 import jax
@@ -175,6 +176,29 @@ _windowed_pair_update_fused_donated = jax.jit(
     donate_argnums=(0, 1, 2, 3),
 )
 
+# Donated signatures already compiled in this process: the first donated
+# call per signature runs under ops._flags.cache_bypass so the donated
+# executable stays out of the JAX persistent compilation cache (ROADMAP
+# item 6); later calls hit the in-memory jit cache.
+_donated_seen = set()
+
+
+def _windowed_donated_bypass(kernel, lifetime, operands):
+    from torcheval_tpu.ops._flags import cache_bypass
+
+    key = (
+        kernel,
+        lifetime,
+        tuple(
+            (getattr(x, "shape", None), str(getattr(x, "dtype", "")))
+            for x in operands
+        ),
+    )
+    if key in _donated_seen:
+        return _nullcontext()
+    _donated_seen.add(key)
+    return cache_bypass()
+
 
 class WindowedLifetimeMixin(RingWindowMixin):
     """RingWindowMixin plus the shared lifecycle of every windowed metric
@@ -254,15 +278,22 @@ class WindowedLifetimeMixin(RingWindowMixin):
             lifetime_in = (jnp.zeros(0, jnp.float32), jnp.zeros(0, jnp.float32))
         else:
             lifetime_in = (_EMPTY, _EMPTY)
-        new_wa, new_wb, a, b = fn(
+        operands = (
             getattr(self, wa),
             getattr(self, wb),
             *lifetime_in,
             self.next_inserted,
-            kernel,
-            self.enable_lifetime,
             *args,
         )
+        bypass = (
+            _windowed_donated_bypass(kernel, self.enable_lifetime, operands)
+            if donate
+            else _nullcontext()
+        )
+        with bypass:
+            new_wa, new_wb, a, b = fn(
+                *operands[:5], kernel, self.enable_lifetime, *operands[5:]
+            )
         setattr(self, wa, new_wa)
         setattr(self, wb, new_wb)
         if self.enable_lifetime:

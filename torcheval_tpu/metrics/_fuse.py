@@ -26,6 +26,7 @@ Hot-path extensions (see ``_bucket.py`` / ``ops/_flags.py``):
 """
 
 import time
+from contextlib import nullcontext
 from functools import partial
 from typing import Tuple
 
@@ -33,6 +34,38 @@ import jax
 
 from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.telemetry import events as _telemetry
+
+# Donated-program signatures whose first compile has already happened in
+# this process.  The first donated compile per signature runs under
+# ops._flags.cache_bypass (donated executables must not enter the JAX
+# persistent compilation cache — ROADMAP item 6); steady-state calls hit
+# the in-memory jit cache and never re-enter the bypass.
+_donated_seen = set()
+
+
+def _arr_sig(x):
+    return (getattr(x, "shape", None), str(getattr(x, "dtype", "")))
+
+
+def _maybe_bypass(kernel, states, args, statics, grow, fold, mask):
+    """The persistent-cache bypass context for one donated call: active
+    only the first time this process sees the (kernel, statics, shapes)
+    signature — i.e. exactly around the compile."""
+    from torcheval_tpu.ops._flags import cache_bypass
+
+    key = (
+        kernel,
+        statics,
+        grow,
+        fold,
+        tuple(_arr_sig(s) for s in states),
+        tuple(_arr_sig(a) for a in args),
+        _arr_sig(mask) if mask is not None else None,
+    )
+    if key in _donated_seen:
+        return nullcontext()
+    _donated_seen.add(key)
+    return cache_bypass()
 
 
 def _accumulate_impl(states, args, kernel, statics, grow, fold, mask=None):
@@ -97,18 +130,23 @@ def accumulate(
     """
     from torcheval_tpu.ops._flags import donation_enabled
 
-    fn = _accumulate_jit_donated if donation_enabled() else _accumulate_jit
+    states, args, statics = tuple(states), tuple(args), tuple(statics)
+    if donation_enabled():
+        fn = _accumulate_jit_donated
+        ctx = _maybe_bypass(kernel, states, args, statics, grow, fold, mask)
+    else:
+        fn = _accumulate_jit
+        ctx = nullcontext()
     if not _telemetry.ENABLED:
-        return fn(
-            tuple(states), tuple(args), kernel, tuple(statics), grow, fold, mask
-        )
+        with ctx:
+            out = fn(states, args, kernel, statics, grow, fold, mask)
+        return out
     # Telemetry on: the fused dispatch becomes a "dispatch" span named
     # after the kernel (dispatch wall time, NOT device time — steady
     # state it measures the jit cache hit + launch).
     t0 = time.monotonic()
-    out = fn(
-        tuple(states), tuple(args), kernel, tuple(statics), grow, fold, mask
-    )
+    with ctx:
+        out = fn(states, args, kernel, statics, grow, fold, mask)
     _telemetry.record_span(
         "dispatch",
         getattr(kernel, "__name__", str(kernel)),

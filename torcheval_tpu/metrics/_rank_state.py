@@ -1,0 +1,190 @@
+"""Rank-sketch state machine for the exact-rank curve metrics.
+
+``BinaryAUROC`` / ``BinaryAUPRC`` / ``MulticlassAUROC`` constructed with
+``sketch=True`` (or under ``TORCHEVAL_TPU_RANK_SKETCH=1``) swap their
+unbounded sample buffers for the fixed-size rank sketch of
+:mod:`torcheval_tpu.ops.rank_sketch`: a ``threshold`` edge vector plus
+four int32 count arrays over ``(rows, bins)`` — deliberately the *same*
+state names and shapes as the binned-AUC family, because those are the
+sufficient statistics the collection megakernel already knows how to
+fold in one HBM pass (``ops/pallas_mega.py`` kind ``"binned"``).  The
+update is a single fused :func:`~torcheval_tpu.metrics._fuse.accumulate`
+dispatch; the merge is integer addition (associative, commutative,
+bit-deterministic across merge orders — see ``docs/source/sketch.rst``);
+the compute reuses the binned trapezoid / step-sum estimators with the
+documented ε = ``rank_error_bound(bins)`` rank error.
+
+This module holds the pieces both metric files share: the module-level
+kernels (module-level so their identity is stable in the jit cache key),
+state installation, the fused accumulate, and the geometry-checked
+merge.  The metric classes stay in their reference-parity files and
+branch on ``self._sketch_mode``.
+"""
+
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.functional.classification._sort_scan import class_hits
+from torcheval_tpu.ops.rank_sketch import (
+    DEFAULT_BINS,
+    _select_rank_route,
+    rank_counts_rows,
+    rank_error_bound,
+    uniform_edges,
+)
+
+RANK_COUNTS = ("num_tp", "num_fp", "num_pos", "num_total")
+
+# Process-level census of sketch-mode constructions — the sketch-vs-sort
+# crossover stamp telemetry.explain_perf()/report() render next to the
+# megakernel verdict (bins histogram + the worst predicted ε among live
+# configurations).
+_CENSUS: dict = {"constructed": 0, "bins": {}}
+
+
+def sketch_census() -> dict:
+    """What the rank-sketch tier looks like in this process: how many
+    sketch-mode members were constructed, at which bin capacities, and
+    the worst documented ε among them.  Empty dict when the tier never
+    engaged (so report sections can be gated on truthiness)."""
+    if not _CENSUS["constructed"]:
+        return {}
+    return {
+        "members_constructed": _CENSUS["constructed"],
+        "bins": dict(sorted(_CENSUS["bins"].items())),
+        "predicted_eps_max": max(
+            rank_error_bound(b) for b in _CENSUS["bins"]
+        ),
+    }
+
+
+def _rank_binary_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    route: str,
+    mask=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    # Traced inside the fused accumulate; ``route`` is a call-time static
+    # so the formulation (and the kill-switch) is re-evaluated per update.
+    if input.ndim == 1:
+        input, target = input[None], target[None]
+    return rank_counts_rows(input, target == 1, threshold, route=route, mask=mask)
+
+
+def _rank_multiclass_kernel(
+    input: jax.Array,
+    target: jax.Array,
+    threshold: jax.Array,
+    num_classes: int,
+    route: str,
+    mask=None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    # One-vs-rest rows: scores (N, C) -> (C, N), hits from the label column.
+    return rank_counts_rows(
+        input.T,
+        class_hits(target, num_classes),
+        threshold,
+        route=route,
+        mask=mask,
+    )
+
+
+def install_rank_states(metric, num_rows: int, bins: Optional[int]) -> None:
+    """Install the sketch-mode state layout on ``metric``: ``threshold``
+    (the ``bins`` uniform edges) plus the four zeroed count arrays, and
+    flip the instance to masked-update eligibility (sketch updates fold
+    ``mask=`` exactly, so sketch-mode members join ``bucket=``/``slices=``
+    collections the buffer states cannot)."""
+    bins = DEFAULT_BINS if bins is None else int(bins)
+    threshold = uniform_edges(bins)
+    _CENSUS["constructed"] += 1
+    _CENSUS["bins"][bins] = _CENSUS["bins"].get(bins, 0) + 1
+    metric._sketch_bins = bins
+    metric._supports_mask = True
+    metric._add_state("threshold", threshold)
+    metric._add_state("num_tp", jnp.zeros((num_rows, bins), jnp.int32))
+    metric._add_state("num_fp", jnp.zeros((num_rows, bins), jnp.int32))
+    metric._add_state("num_pos", jnp.zeros(num_rows, jnp.int32))
+    metric._add_state("num_total", jnp.zeros(num_rows, jnp.int32))
+
+
+def rank_accumulate(metric, kernel, input, target, statics=(), mask=None) -> None:
+    """One fused dispatch: kernel + all four count adds (``_fuse.py``)."""
+    metric.num_tp, metric.num_fp, metric.num_pos, metric.num_total = accumulate(
+        kernel,
+        (metric.num_tp, metric.num_fp, metric.num_pos, metric.num_total),
+        input,
+        target,
+        metric.threshold,
+        statics=statics,
+        mask=mask,
+    )
+
+
+def rank_route(metric, num_samples: int) -> str:
+    """Call-time (outside-jit) formulation choice for one update."""
+    return _select_rank_route(
+        metric.num_tp.shape[0], num_samples, metric.threshold
+    )
+
+
+def rank_merge_state(metric, metrics: Iterable) -> None:
+    """Geometry-checked integer-add merge: every operand must be a
+    sketch-mode metric over the same edge vector.  Addition is
+    associative and bit-deterministic, so any merge order (fleet tree,
+    flat gather, checkpoint resume) yields identical counts."""
+    metrics = list(metrics)
+    for m in metrics:
+        if not getattr(m, "_sketch_mode", False):
+            raise ValueError(
+                "cannot merge a sketch-mode metric with a sample-buffer "
+                f"metric ({type(m).__name__} constructed without sketch=True)"
+            )
+        if m.threshold.shape != metric.threshold.shape:
+            raise ValueError(
+                "sketch merge requires identical edge geometry: "
+                f"{m.threshold.shape[0]} bins vs {metric.threshold.shape[0]}"
+            )
+    merge_add(metric, metrics, *RANK_COUNTS)
+
+
+def rank_sketch_state(metric, metric_kind: str, kind: str, **options):
+    """``Metric.sketch_state`` for a sketch-mode metric: the count
+    arrays *are* the O(compactors) mergeable summary, so ``"rank"`` (and
+    ``"exact"``, which is lossless here — no sample buffer exists to be
+    more exact than the counts) wrap them directly in a
+    :class:`~torcheval_tpu.metrics._sketch.RankSketch`; no other kind
+    applies to a bufferless state."""
+    from torcheval_tpu.metrics._sketch import RankSketch
+
+    if kind not in ("rank", "exact"):
+        raise ValueError(
+            f"sketch-mode {type(metric).__name__} supports only "
+            f"kind='rank' (its state is already a rank sketch); got {kind!r}"
+        )
+    if options:
+        raise ValueError(
+            f"kind='rank' on a sketch-mode metric takes no options; "
+            f"got {sorted(options)} (bins are fixed at construction)"
+        )
+    import numpy as np
+
+    return RankSketch(
+        metric_kind=metric_kind,
+        edges=np.asarray(metric.threshold),
+        num_tp=np.asarray(metric.num_tp),
+        num_fp=np.asarray(metric.num_fp),
+        num_pos=np.asarray(metric.num_pos),
+        num_total=np.asarray(metric.num_total),
+        average=getattr(metric, "average", None),
+    )
+
+
+def predicted_epsilon(metric) -> float:
+    """Documented rank-error bound for one sketch-mode metric."""
+    return rank_error_bound(metric._sketch_bins)

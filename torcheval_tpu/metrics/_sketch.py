@@ -10,7 +10,7 @@ a commutative, associative operation** (so tree order doesn't matter)
 and its compute error is **bounded as a function of the sketch size
 only** (so the accuracy/bytes trade is explicit).
 
-Four kinds, selected by ``Metric.sketch_state(kind=...)``:
+Five kinds, selected by ``Metric.sketch_state(kind=...)``:
 
 ``"exact"`` — :class:`ExactSketch`
     The whole prepared metric; lossless, payload O(samples).  The
@@ -35,6 +35,17 @@ Four kinds, selected by ``Metric.sketch_state(kind=...)``:
     distribution is heavy-hitter dominated and width << bins.  Curve
     metrics inherit the per-bin count error on top of the histogram's
     O(1/bins) discretization.
+``"rank"`` — :class:`RankSketch`
+    The rank-sketch sufficient statistics (``ops/rank_sketch.py``):
+    per-edge ``score >= edge`` counts over ``(rows, bins)``, per-row
+    positives/totals, merge by integer addition — associative,
+    commutative, and **bit-deterministic across merge orders**.  Rank
+    error ≤ **1/(bins-1)**; supports multi-row metrics (multi-task
+    binary, one-vs-rest multiclass) where the other compressed kinds
+    are binary-only.  The *native* payload of a ``sketch=True`` metric
+    — its device state ships as-is, O(compactors) — and buildable from
+    sample buffers too (same ``searchsorted`` binning as the device
+    kernel, so both sides of a fleet agree bit-for-bit).
 
 Sketches travel pickled (numpy arrays only — no device state), merge in
 place via :meth:`Sketch.merge`, report their wire size via
@@ -440,6 +451,127 @@ class CountSketchState(Sketch):
         )
 
 
+class RankSketch(Sketch):
+    """Mergeable rank-sketch counts — the binned sufficient statistics
+    of the curve family as a wire payload.
+
+    ``num_tp``/``num_fp`` are ``(rows, bins)`` per-edge ge-counts,
+    ``num_pos``/``num_total`` per-row scalars, over the shared ``edges``
+    vector.  Merge is elementwise integer addition after a geometry
+    check: exactly associative/commutative, so every merge order (fleet
+    tree, flat gather, checkpoint resume) produces bit-identical counts
+    and therefore a bit-identical compute.  The estimate itself carries
+    the rank-sketch bound: error ≤ 1/(bins-1)
+    (:func:`torcheval_tpu.ops.rank_sketch.rank_error_bound`)."""
+
+    kind = "rank"
+
+    def __init__(
+        self,
+        metric_kind: str,
+        edges: np.ndarray,
+        num_tp: np.ndarray,
+        num_fp: np.ndarray,
+        num_pos: np.ndarray,
+        num_total: np.ndarray,
+        average: Optional[str] = None,
+    ) -> None:
+        self.metric_kind = metric_kind
+        self.edges = np.asarray(edges, dtype=np.float32)
+        self.num_tp = np.asarray(num_tp, dtype=np.int64)
+        self.num_fp = np.asarray(num_fp, dtype=np.int64)
+        self.num_pos = np.asarray(num_pos, dtype=np.int64)
+        self.num_total = np.asarray(num_total, dtype=np.int64)
+        self.average = average
+
+    @classmethod
+    def from_samples(
+        cls,
+        metric_kind: str,
+        scores: np.ndarray,
+        targets: np.ndarray,
+        *,
+        bins: int = 512,
+    ) -> "RankSketch":
+        """Bin a flat sample buffer into rank counts with the *same*
+        arithmetic as the device kernel (``searchsorted`` over the f32
+        uniform edges, suffix sums), so a buffer-mode rank sketch and a
+        ``sketch=True`` metric over the same stream agree bit-for-bit."""
+        from torcheval_tpu.ops.rank_sketch import uniform_edges
+
+        # The device edge constructor, so the f32 edge values (and hence
+        # every boundary comparison) match a sketch=True metric exactly.
+        edges = np.asarray(uniform_edges(bins))
+        scores = np.asarray(scores, dtype=np.float32).reshape(-1)
+        targets = np.asarray(targets).reshape(-1)
+        idx = np.searchsorted(edges, scores, side="right")
+        is_pos = targets > 0.5
+        cells = np.bincount(idx, minlength=bins + 1).astype(np.int64)
+        tp_cells = np.bincount(idx[is_pos], minlength=bins + 1).astype(np.int64)
+        num_ge = np.cumsum(cells[::-1])[::-1][1:]
+        num_tp = np.cumsum(tp_cells[::-1])[::-1][1:]
+        return cls(
+            metric_kind,
+            edges,
+            num_tp[None, :],
+            (num_ge - num_tp)[None, :],
+            np.asarray([int(is_pos.sum())]),
+            np.asarray([scores.shape[0]]),
+        )
+
+    def merge(self, other: "Sketch") -> "RankSketch":
+        self._check_mergeable(other)
+        if (
+            other.edges.shape != self.edges.shape
+            or other.num_tp.shape != self.num_tp.shape
+        ):
+            raise ValueError(
+                "rank-sketch geometry mismatch: both sides must share the "
+                f"edge vector and row count ({self.num_tp.shape} vs "
+                f"{other.num_tp.shape})"
+            )
+        self.num_tp = self.num_tp + other.num_tp
+        self.num_fp = self.num_fp + other.num_fp
+        self.num_pos = self.num_pos + other.num_pos
+        self.num_total = self.num_total + other.num_total
+        return self
+
+    def nbytes(self) -> int:
+        return int(
+            self.edges.nbytes
+            + self.num_tp.nbytes
+            + self.num_fp.nbytes
+            + self.num_pos.nbytes
+            + self.num_total.nbytes
+        )
+
+    def compute(self) -> Any:
+        import jax.numpy as jnp
+
+        from torcheval_tpu.metrics.functional.classification.binned_auc import (
+            _binned_auprc_from_counts,
+            _binned_auroc_from_counts,
+        )
+
+        args = (
+            jnp.asarray(self.num_tp, jnp.int32),
+            jnp.asarray(self.num_fp, jnp.int32),
+            jnp.asarray(self.num_pos, jnp.int32),
+            jnp.asarray(self.num_total, jnp.int32),
+        )
+        if self.metric_kind in ("binary_auroc", "multiclass_auroc"):
+            score = _binned_auroc_from_counts(*args)
+        elif self.metric_kind == "binary_auprc":
+            score = _binned_auprc_from_counts(*args)
+        else:
+            raise ValueError(
+                f"unknown rank-sketched metric kind {self.metric_kind!r}"
+            )
+        if self.metric_kind == "multiclass_auroc":
+            return score.mean() if self.average == "macro" else score
+        return score[0] if score.shape[0] == 1 else score
+
+
 def state_nbytes(metric: Any) -> int:
     """Wire-size proxy for a metric: total bytes of its state arrays."""
     total = 0
@@ -453,7 +585,7 @@ def state_nbytes(metric: Any) -> int:
     return total
 
 
-_SAMPLE_KINDS = ("exact", "reservoir", "histogram", "count")
+_SAMPLE_KINDS = ("exact", "reservoir", "histogram", "count", "rank")
 
 
 def sketch_from_buffers(
@@ -501,6 +633,11 @@ def sketch_from_buffers(
         return HistogramSketch.from_samples(
             metric_kind, scores, targets, bins=bins
         )
+    if kind == "rank":
+        # bins defaults to the shared 1024 here; pass bins=512 (the
+        # sketch=True construction default) for bit-parity with a
+        # device rank-sketch metric.
+        return RankSketch.from_samples(metric_kind, scores, targets, bins=bins)
     return CountSketchState.from_samples(
         metric_kind, scores, targets,
         width=width, depth=depth, seed=seed,

@@ -10,6 +10,14 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
+from torcheval_tpu.metrics._rank_state import (
+    _rank_binary_kernel,
+    install_rank_states,
+    rank_accumulate,
+    rank_merge_state,
+    rank_route,
+    rank_sketch_state,
+)
 from torcheval_tpu.metrics.functional.classification.auprc import (
     _binary_auprc_compute,
     _multiclass_auprc_compute,
@@ -22,13 +30,28 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_update_input_check,
     _multiclass_auroc_update_input_check,
 )
+from torcheval_tpu.metrics.functional.classification.binned_auc import (
+    _binned_auprc_from_counts,
+)
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.ops._flags import rank_sketch_enabled
 
 
 class BinaryAUPRC(Metric[jax.Array]):
-    """Binary average precision with multi-task support (buffered, exact)."""
+    """Binary average precision with multi-task support (buffered, exact).
 
-    def __init__(self, *, num_tasks: int = 1, device=None) -> None:
+    ``sketch=True`` (default: ``TORCHEVAL_TPU_RANK_SKETCH``, else off)
+    replaces the exact sample buffers with the mergeable rank-sketch
+    counts — see :doc:`/sketch` for the state layout and error bounds."""
+
+    def __init__(
+        self,
+        *,
+        num_tasks: int = 1,
+        device=None,
+        sketch: Optional[bool] = None,
+        sketch_bins: Optional[int] = None,
+    ) -> None:
         super().__init__(device=device)
         if num_tasks < 1:
             raise ValueError(
@@ -36,18 +59,41 @@ class BinaryAUPRC(Metric[jax.Array]):
                 f"but received {num_tasks}. "
             )
         self.num_tasks = num_tasks
-        self._add_state("inputs", [])
-        self._add_state("targets", [])
+        self._sketch_mode = rank_sketch_enabled() if sketch is None else bool(sketch)
+        if self._sketch_mode:
+            install_rank_states(self, num_tasks, sketch_bins)
+        else:
+            self._add_state("inputs", [])
+            self._add_state("targets", [])
 
-    def update(self, input, target) -> "BinaryAUPRC":
+    def update(self, input, target, *, mask=None) -> "BinaryAUPRC":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_auroc_update_input_check(input, target, self.num_tasks)
+        if self._sketch_mode:
+            route = rank_route(self, input.shape[-1])
+            rank_accumulate(
+                self, _rank_binary_kernel, input, target, statics=(route,),
+                mask=mask,
+            )
+            return self
+        if mask is not None:
+            raise ValueError(
+                "mask= requires the rank-sketch state (sketch=True); the "
+                "exact sample buffers do not fold masked updates."
+            )
         self.inputs.append(jax.device_put(input, self.device))
         self.targets.append(jax.device_put(target, self.device))
         return self
 
     def compute(self) -> jax.Array:
         """Average precision per task; empty array before any update."""
+        if self._sketch_mode:
+            if int(self.num_total.sum()) == 0:
+                return jnp.zeros(0)
+            score = _binned_auprc_from_counts(
+                self.num_tp, self.num_fp, self.num_pos, self.num_total
+            )
+            return score[0] if self.num_tasks == 1 else score
         if not self.inputs:
             return jnp.zeros(0)
         input = jnp.concatenate(self.inputs, axis=-1)
@@ -58,10 +104,15 @@ class BinaryAUPRC(Metric[jax.Array]):
         )
 
     def merge_state(self, metrics: Iterable["BinaryAUPRC"]) -> "BinaryAUPRC":
+        if self._sketch_mode:
+            rank_merge_state(self, metrics)
+            return self
         merge_concat_buffers(self, metrics, "inputs", "targets", dim=-1)
         return self
 
     def _prepare_for_merge_state(self) -> None:
+        if self._sketch_mode:
+            return  # counts are already flat arrays on the sync wire
         prepare_concat_buffers(self, "inputs", "targets", dim=-1)
 
     def sketch_state(self, kind: str = "exact", **options):
@@ -69,6 +120,8 @@ class BinaryAUPRC(Metric[jax.Array]):
         hierarchical fleet merge — same kinds and bounds as
         :meth:`BinaryAUROC.sketch_state`
         (:mod:`torcheval_tpu.metrics._sketch`)."""
+        if self._sketch_mode:
+            return rank_sketch_state(self, "binary_auprc", kind, **options)
         from torcheval_tpu.metrics._sketch import sketch_from_buffers
 
         return sketch_from_buffers(self, "binary_auprc", kind, **options)

@@ -3,7 +3,12 @@
 
 Sample-buffer states (``inputs``/``targets`` lists); merge concatenates;
 ``_prepare_for_merge_state`` pre-concats to one array per state for the
-sync wire (reference ``auroc.py:89-92,130-134``)."""
+sync wire (reference ``auroc.py:89-92,130-134``).
+
+Beyond the reference: ``sketch=True`` (or ``TORCHEVAL_TPU_RANK_SKETCH``)
+swaps the unbounded buffers for the fixed-size mergeable rank sketch
+(:mod:`torcheval_tpu.metrics._rank_state`): single-pass sort-free
+updates, O(bins) merge payloads, AUROC within ε = 1/(bins-1)."""
 
 from typing import Iterable, Optional
 
@@ -11,6 +16,15 @@ import jax
 import jax.numpy as jnp
 
 from torcheval_tpu.metrics._buffer import merge_concat_buffers, prepare_concat_buffers
+from torcheval_tpu.metrics._rank_state import (
+    _rank_binary_kernel,
+    _rank_multiclass_kernel,
+    install_rank_states,
+    rank_accumulate,
+    rank_merge_state,
+    rank_route,
+    rank_sketch_state,
+)
 from torcheval_tpu.metrics.functional.classification.auroc import (
     _binary_auroc_compute,
     _binary_auroc_update_input_check,
@@ -18,14 +32,22 @@ from torcheval_tpu.metrics.functional.classification.auroc import (
     _multiclass_auroc_param_check,
     _multiclass_auroc_update_input_check,
 )
+from torcheval_tpu.metrics.functional.classification.binned_auc import (
+    _binned_auroc_from_counts,
+)
 from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.ops._flags import rank_sketch_enabled
 from torcheval_tpu.ops.fused_auc import has_fused
 
 
 class BinaryAUROC(Metric[jax.Array]):
     """Binary AUROC with multi-task support and the ``use_fused``
     approximate-kernel opt-in (the reference's ``use_fbgemm`` analog,
-    reference ``auroc.py:27-48``)."""
+    reference ``auroc.py:27-48``).
+
+    ``sketch=True`` (default: ``TORCHEVAL_TPU_RANK_SKETCH``, else off)
+    replaces the exact sample buffers with the mergeable rank-sketch
+    counts — see :doc:`/sketch` for the state layout and error bounds."""
 
     def __init__(
         self,
@@ -33,6 +55,8 @@ class BinaryAUROC(Metric[jax.Array]):
         num_tasks: int = 1,
         device=None,
         use_fused: Optional[bool] = False,
+        sketch: Optional[bool] = None,
+        sketch_bins: Optional[int] = None,
     ) -> None:
         super().__init__(device=device)
         if num_tasks < 1:
@@ -40,24 +64,53 @@ class BinaryAUROC(Metric[jax.Array]):
                 "`num_tasks` value should be greater than and equal to 1, "
                 f"but received {num_tasks}. "
             )
+        self._sketch_mode = rank_sketch_enabled() if sketch is None else bool(sketch)
+        if self._sketch_mode and use_fused:
+            raise ValueError(
+                "`use_fused` applies to the exact buffered compute; it "
+                "cannot be combined with the rank-sketch state "
+                "(sketch=True)."
+            )
         if use_fused and not has_fused():
             raise ValueError(
                 "`use_fused` requires the fused AUC kernel to be available."
             )
         self.num_tasks = num_tasks
         self.use_fused = use_fused
-        self._add_state("inputs", [])
-        self._add_state("targets", [])
+        if self._sketch_mode:
+            install_rank_states(self, num_tasks, sketch_bins)
+        else:
+            self._add_state("inputs", [])
+            self._add_state("targets", [])
 
-    def update(self, input, target) -> "BinaryAUROC":
+    def update(self, input, target, *, mask=None) -> "BinaryAUROC":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _binary_auroc_update_input_check(input, target, self.num_tasks)
+        if self._sketch_mode:
+            route = rank_route(self, input.shape[-1])
+            rank_accumulate(
+                self, _rank_binary_kernel, input, target, statics=(route,),
+                mask=mask,
+            )
+            return self
+        if mask is not None:
+            raise ValueError(
+                "mask= requires the rank-sketch state (sketch=True); the "
+                "exact sample buffers do not fold masked updates."
+            )
         self.inputs.append(jax.device_put(input, self.device))
         self.targets.append(jax.device_put(target, self.device))
         return self
 
     def compute(self) -> jax.Array:
         """AUROC per task; empty array before any update."""
+        if self._sketch_mode:
+            if int(self.num_total.sum()) == 0:
+                return jnp.zeros(0)
+            score = _binned_auroc_from_counts(
+                self.num_tp, self.num_fp, self.num_pos, self.num_total
+            )
+            return score[0] if self.num_tasks == 1 else score
         if not self.inputs:
             return jnp.zeros(0)
         return _binary_auroc_compute(
@@ -67,26 +120,40 @@ class BinaryAUROC(Metric[jax.Array]):
         )
 
     def merge_state(self, metrics: Iterable["BinaryAUROC"]) -> "BinaryAUROC":
+        if self._sketch_mode:
+            rank_merge_state(self, metrics)
+            return self
         merge_concat_buffers(self, metrics, "inputs", "targets", dim=-1)
         return self
 
     def _prepare_for_merge_state(self) -> None:
+        if self._sketch_mode:
+            return  # counts are already flat arrays on the sync wire
         prepare_concat_buffers(self, "inputs", "targets", dim=-1)
 
     def sketch_state(self, kind: str = "exact", **options):
-        """O(bins) mergeable summaries of the sample buffers for the
-        hierarchical fleet merge: ``"reservoir"`` (``capacity=``, error
-        O(1/sqrt(capacity))), ``"histogram"`` (``bins=``, error
-        O(1/bins)), ``"count"`` (``width=``/``depth=``, per-bin count
-        error n/sqrt(width)), or lossless ``"exact"``.  See
-        :mod:`torcheval_tpu.metrics._sketch`."""
+        """O(bins) mergeable summaries for the hierarchical fleet merge:
+        ``"reservoir"`` (``capacity=``, error O(1/sqrt(capacity))),
+        ``"histogram"`` (``bins=``, error O(1/bins)), ``"count"``
+        (``width=``/``depth=``, per-bin count error n/sqrt(width)),
+        ``"rank"`` (``bins=``, rank error ≤ 1/(bins-1), bit-deterministic
+        add-merge — and the native payload of a ``sketch=True`` metric),
+        or lossless ``"exact"``.  See
+        :mod:`torcheval_tpu.metrics._sketch` and :doc:`/sketch`."""
+        if self._sketch_mode:
+            return rank_sketch_state(self, "binary_auroc", kind, **options)
         from torcheval_tpu.metrics._sketch import sketch_from_buffers
 
         return sketch_from_buffers(self, "binary_auroc", kind, **options)
 
 
 class MulticlassAUROC(Metric[jax.Array]):
-    """One-vs-rest multiclass AUROC (reference ``auroc.py:93-229``)."""
+    """One-vs-rest multiclass AUROC (reference ``auroc.py:93-229``).
+
+    ``sketch=True`` (default: ``TORCHEVAL_TPU_RANK_SKETCH``, else off)
+    replaces the sample buffers with per-class rank-sketch counts; the
+    one-vs-rest scores then come from the binned trapezoid estimator
+    within ε = 1/(bins-1) per class."""
 
     def __init__(
         self,
@@ -94,23 +161,49 @@ class MulticlassAUROC(Metric[jax.Array]):
         num_classes: int,
         average: Optional[str] = "macro",
         device=None,
+        sketch: Optional[bool] = None,
+        sketch_bins: Optional[int] = None,
     ) -> None:
         super().__init__(device=device)
         _multiclass_auroc_param_check(num_classes, average)
         self.num_classes = num_classes
         self.average = average
-        self._add_state("inputs", [])
-        self._add_state("targets", [])
+        self._sketch_mode = rank_sketch_enabled() if sketch is None else bool(sketch)
+        if self._sketch_mode:
+            install_rank_states(self, num_classes, sketch_bins)
+        else:
+            self._add_state("inputs", [])
+            self._add_state("targets", [])
 
-    def update(self, input, target) -> "MulticlassAUROC":
+    def update(self, input, target, *, mask=None) -> "MulticlassAUROC":
         input, target = jnp.asarray(input), jnp.asarray(target)
         _multiclass_auroc_update_input_check(input, target, self.num_classes)
+        if self._sketch_mode:
+            route = rank_route(self, input.shape[0])
+            rank_accumulate(
+                self, _rank_multiclass_kernel, input, target,
+                statics=(self.num_classes, route),
+                mask=mask,
+            )
+            return self
+        if mask is not None:
+            raise ValueError(
+                "mask= requires the rank-sketch state (sketch=True); the "
+                "exact sample buffers do not fold masked updates."
+            )
         self.inputs.append(jax.device_put(input, self.device))
         self.targets.append(jax.device_put(target, self.device))
         return self
 
     def compute(self) -> jax.Array:
         """AUROC (macro scalar or per-class); empty array before any update."""
+        if self._sketch_mode:
+            if int(self.num_total.sum()) == 0:
+                return jnp.zeros(0)
+            score = _binned_auroc_from_counts(
+                self.num_tp, self.num_fp, self.num_pos, self.num_total
+            )
+            return score.mean() if self.average == "macro" else score
         if not self.inputs:
             return jnp.zeros(0)
         return _multiclass_auroc_compute(
@@ -121,8 +214,22 @@ class MulticlassAUROC(Metric[jax.Array]):
         )
 
     def merge_state(self, metrics: Iterable["MulticlassAUROC"]) -> "MulticlassAUROC":
+        if self._sketch_mode:
+            rank_merge_state(self, metrics)
+            return self
         merge_concat_buffers(self, metrics, "inputs", "targets", dim=0)
         return self
 
     def _prepare_for_merge_state(self) -> None:
+        if self._sketch_mode:
+            return
         prepare_concat_buffers(self, "inputs", "targets", dim=0)
+
+    def sketch_state(self, kind: str = "exact", **options):
+        """Mergeable summary for the fleet merge.  A ``sketch=True``
+        metric ships its O(classes × bins) rank counts (``"rank"``);
+        buffer-mode supports only the lossless ``"exact"`` gather (the
+        compressed sample kinds are binary-only)."""
+        if self._sketch_mode:
+            return rank_sketch_state(self, "multiclass_auroc", kind, **options)
+        return super().sketch_state(kind, **options)

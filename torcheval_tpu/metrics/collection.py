@@ -14,6 +14,7 @@ checkpoints like any single metric (orbax-compatible flat mapping).
 
 import copy
 import time
+from contextlib import nullcontext as _nullcontext
 from typing import (
     Any,
     Dict,
@@ -402,15 +403,26 @@ class MetricCollection:
             self._health_bounds = bounds
             self._fused_seen = set()
         key = _call_signature(args, kwargs)
-        if key not in self._fused_seen:
+        first_at_signature = key not in self._fused_seen
+        if first_at_signature:
             # Only a first-at-this-signature call can trace; the steady
             # state (compiled-cache hit) skips the O(members x states)
             # fusability sweep.
             self._check_fusable()
         before = self._read_states()
         t0 = time.monotonic() if _telemetry.ENABLED else 0.0
+        # A first donated call may compile; donated executables must not
+        # enter the persistent compilation cache (ROADMAP item 6), so the
+        # compile runs under the scoped bypass.  Steady state never
+        # enters the context.
+        bypass = (
+            _flags.cache_bypass()
+            if donate and first_at_signature
+            else _nullcontext()
+        )
         try:
-            out = self._fused_apply(before, args, kwargs)
+            with bypass:
+                out = self._fused_apply(before, args, kwargs)
         except BaseException:
             # An aborted trace (including KeyboardInterrupt mid-compile)
             # leaves tracer attrs on members; restore the concrete states.

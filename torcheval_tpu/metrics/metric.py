@@ -269,9 +269,12 @@ class Metric(Generic[TComputeReturn], ABC):
         prepared metric, lossless, payload O(samples).  Buffer metrics
         with compressible state (BinaryAUROC, BinaryAUPRC) override this
         to also offer ``"reservoir"`` / ``"histogram"`` / ``"count"``
-        with documented error bounds; see the ``_sketch`` module
-        docstring for the bounds and ``docs/source/fleet.rst`` for
-        selection guidance.
+        with documented error bounds; curve metrics constructed with
+        ``sketch=True`` additionally offer ``"rank"`` — their state is
+        already a mergeable rank sketch, payload O(compactors); see the
+        ``_sketch`` module docstring for the bounds,
+        ``docs/source/sketch.rst`` for the rank tier, and
+        ``docs/source/fleet.rst`` for selection guidance.
         """
         from torcheval_tpu.metrics._sketch import ExactSketch
 
@@ -285,8 +288,9 @@ class Metric(Generic[TComputeReturn], ABC):
     def merge_sketch(self: TSelf, sketch: Any) -> TSelf:
         """Absorb a (merged) sketch back into this metric so a following
         ``compute()`` reflects the fleet.  Sample-domain sketches (exact,
-        reservoir) restore; bin-domain sketches (histogram, count) are
-        terminal and raise — read their value from ``sketch.compute()``.
+        reservoir) restore; bin-domain sketches (histogram, count, rank)
+        are terminal and raise — read their value from
+        ``sketch.compute()``.
         """
         sketch.merge_into(self)
         return self

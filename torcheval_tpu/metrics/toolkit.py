@@ -86,8 +86,10 @@ def sync_and_compute(
     tree/ring value is bit-identical to the flat one.  A group without
     point-to-point transport falls back to flat with a warning.
 
-    ``sketch`` (``"reservoir"`` / ``"histogram"`` / ``"count"``) ships
-    O(bins) mergeable summaries instead of raw sample buffers — see
+    ``sketch`` (``"reservoir"`` / ``"histogram"`` / ``"count"``, or
+    ``"rank"`` for sketch-mode curve metrics whose state already *is* a
+    rank sketch) ships O(bins) mergeable summaries instead of raw
+    sample buffers — see
     :meth:`BinaryAUROC.sketch_state` for kinds and error bounds; with
     ``topology="flat"`` the sketches ride the ordinary gather and the
     recipient returns the merged sketch's value directly.

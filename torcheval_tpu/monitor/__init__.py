@@ -14,13 +14,18 @@ The three pieces (see ``docs/source/monitor.rst`` for the cookbook):
 * :func:`~torcheval_tpu.monitor.quality.publish` — streams every figure
   into the telemetry ring as :class:`QualityEvent`s (Prometheus gauges,
   ``report()``, fleet rollups, quality SLOs).
+* :class:`StreamDigest` — a fixed-size mergeable quantile digest
+  (dyadic rank-sketch ladder, ``ops/rank_sketch.py``) for latency /
+  score / loss *distributions*: p50/p90/p99 in 8 KB of add-mergeable
+  counters, bit-deterministic across fleet merge orders.
 
 All of it composes: a sliced collection of ``Decayed``/``SlidingWindow``
 members still runs ONE dispatch per batch/block.
 """
 
 from torcheval_tpu.monitor.decay import Decayed
+from torcheval_tpu.monitor.digest import StreamDigest
 from torcheval_tpu.monitor.window import SlidingWindow
 from torcheval_tpu.monitor.quality import publish, window_kind
 
-__all__ = ["Decayed", "SlidingWindow", "publish", "window_kind"]
+__all__ = ["Decayed", "SlidingWindow", "StreamDigest", "publish", "window_kind"]

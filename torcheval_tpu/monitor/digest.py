@@ -1,0 +1,112 @@
+"""Streaming distribution digest for the monitor family.
+
+:class:`StreamDigest` tracks a latency / score / loss *distribution* —
+not just a mean — in a fixed-size mergeable state: the dyadic compactor
+ladder of :mod:`torcheval_tpu.ops.rank_sketch` (``levels`` levels of
+``bins`` sub-bins, per-level bin width doubling, so 32 levels × 64 bins
+= 8 KB of int32 counters span nine decades of latency at ≤ 1/64
+relative value error).  One fused dispatch per batch (the same
+:func:`~torcheval_tpu.metrics._fuse.accumulate` path as every counter
+metric), integer-add merge (associative and bit-deterministic across
+merge orders — fleet rollups of per-host latency digests are exact
+arithmetic), and deterministic quantile reads (each quantile returns
+its bin's left edge, never an interpolation, so every merge order
+reports the identical p50/p90/p99).
+
+It is a regular :class:`~torcheval_tpu.metrics.Metric`: it joins
+collections, checkpoints bit-exactly, folds ``mask=`` (so it is
+``bucket=``/``slices=`` eligible), and ships whole-state through
+``fleet_merge`` at O(levels × bins) bytes.  See :doc:`/sketch` for the
+ladder layout and error table.
+"""
+
+from typing import Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torcheval_tpu.metrics._fuse import accumulate
+from torcheval_tpu.metrics._merge import merge_add
+from torcheval_tpu.metrics.metric import Metric
+from torcheval_tpu.ops.rank_sketch import (
+    ladder_counts,
+    ladder_edges,
+    ladder_fill,
+    ladder_quantiles,
+)
+
+__all__ = ["StreamDigest"]
+
+
+def _digest_kernel(values, edges, mask=None):
+    # Module-level: its identity is part of the fused-dispatch cache key.
+    return ladder_counts(values, edges, mask=mask)
+
+
+class StreamDigest(Metric[jax.Array]):
+    """Mergeable quantile digest over a non-negative value stream.
+
+    ``base`` is the resolution floor (values below it land in level 0's
+    uniform bins with absolute error ≤ ``base/bins``); above it the
+    relative error is ≤ ``1/bins``.  ``compute()`` returns the
+    configured ``quantiles`` (default p50/p90/p99) as one array, or the
+    empty sentinel before any update."""
+
+    _supports_mask = True
+
+    def __init__(
+        self,
+        *,
+        base: float = 1e-4,
+        levels: int = 32,
+        bins: int = 64,
+        quantiles: Tuple[float, ...] = (0.5, 0.9, 0.99),
+        device=None,
+    ) -> None:
+        super().__init__(device=device)
+        self.base = float(base)
+        self.levels = int(levels)
+        self.bins = int(bins)
+        self.quantiles = tuple(float(q) for q in quantiles)
+        for q in self.quantiles:
+            if not 0.0 < q <= 1.0:
+                raise ValueError(f"quantiles must lie in (0, 1], got {q}")
+        self._add_state("edges", ladder_edges(self.base, self.levels, self.bins))
+        self._add_state(
+            "counts", jnp.zeros(self.levels * self.bins, jnp.int32)
+        )
+
+    def update(self, values, *, mask=None) -> "StreamDigest":
+        values = jnp.asarray(values)
+        (self.counts,) = accumulate(
+            _digest_kernel, (self.counts,), values, self.edges, mask=mask
+        )
+        return self
+
+    def compute(self) -> jax.Array:
+        """The configured quantile values; empty array before any
+        update."""
+        if int(self.counts.sum()) == 0:
+            return jnp.zeros(0)
+        return ladder_quantiles(self.counts, self.edges, self.quantiles)
+
+    def quantile(self, q: float) -> jax.Array:
+        """One ad-hoc quantile read (deterministic left-edge value)."""
+        return ladder_quantiles(self.counts, self.edges, (float(q),))[0]
+
+    def fill(self) -> jax.Array:
+        """Per-level fill counters — how much mass each rung of the
+        weight ladder holds (diagnostic for choosing ``base``/``levels``)."""
+        return ladder_fill(self.counts, self.levels)
+
+    def merge_state(self, metrics: Iterable["StreamDigest"]) -> "StreamDigest":
+        metrics = list(metrics)
+        for m in metrics:
+            if (m.base, m.levels, m.bins) != (self.base, self.levels, self.bins):
+                raise ValueError(
+                    "digest merge requires identical ladder geometry: "
+                    f"(base={m.base}, levels={m.levels}, bins={m.bins}) vs "
+                    f"(base={self.base}, levels={self.levels}, bins={self.bins})"
+                )
+        merge_add(self, metrics, "counts")
+        return self

@@ -89,6 +89,92 @@ def wavefront_mode() -> "bool | None":
     return _flags.get("WAVEFRONT")
 
 
+def rank_sketch_mode() -> "bool | None":
+    """Tri-state read of ``TORCHEVAL_TPU_RANK_SKETCH`` — the mergeable
+    rank-sketch state tier for the exact-rank curve family
+    (``ops/rank_sketch.py``).
+
+    ``True`` makes :class:`~torcheval_tpu.metrics.BinaryAUROC` /
+    ``BinaryAUPRC`` / ``MulticlassAUROC`` constructed without an
+    explicit ``sketch=`` carry fixed-size rank-sketch count states
+    (single-pass updates, O(bins) merge payloads, documented ε rank
+    error) instead of unbounded sample buffers; ``False`` or ``None``
+    (unset) keeps the exact sort path — the default-off fallback.
+    Resolved at metric *construction* time (the state layout is fixed
+    for a metric's lifetime); the hot paths still fold the value into
+    their program-cache keys (``ops._mega_plan.route_token``) so a flip
+    rebuilds collection/engine/serve programs for newly constructed
+    members instead of reusing a stale route.
+    ``TORCHEVAL_TPU_DISABLE_PALLAS`` outranks the *kernel* route as
+    everywhere: sketch updates then use the scatter-free XLA
+    formulation, never a Pallas dispatch.
+    """
+    return _flags.get("RANK_SKETCH")
+
+
+def rank_sketch_enabled() -> bool:
+    """Construction-time resolution of :func:`rank_sketch_mode` for a
+    metric built with ``sketch=None``: only an explicit truthy flag
+    engages the sketch states (auto means off — the exact sort path is
+    the default)."""
+    return rank_sketch_mode() is True
+
+
+# Count of persistent-cache bypasses taken (test / introspection hook:
+# the donated-jit first-call sites increment it via cache_bypass()).
+_CACHE_BYPASS_COUNT = 0
+
+
+def cache_bypass_count() -> int:
+    """How many compile-time persistent-cache bypasses this process has
+    taken (see :func:`cache_bypass`)."""
+    return _CACHE_BYPASS_COUNT
+
+
+class cache_bypass:
+    """Context manager: disable JAX's *persistent* compilation cache for
+    the duration of one first-call-per-signature compile of a
+    **donated** jit program.
+
+    Donated programs interact badly with the persistent cache on some
+    jax versions (jax 0.4.x): a warm-cache process can deserialize a
+    donated executable whose aliasing metadata drops a batch's
+    contribution nondeterministically (ROADMAP item 6, the
+    ``test_donate_on_and_off`` flake).  Scoping the opt-out to the
+    compile itself — callers wrap only the first call at a given
+    signature, and only when donation is actually enabled — keeps every
+    other program (including the donation-off twin) eligible for the
+    persistent cache, so warm-start time is unaffected except for the
+    donated programs that were unsafe to persist in the first place.
+
+    The in-memory jit cache is untouched: steady-state calls never
+    enter this context.  Config toggling is trace-safe here because
+    ``jax_enable_compilation_cache`` only gates the persistence layer,
+    not trace/lowering cache keys.
+    """
+
+    def __enter__(self) -> "cache_bypass":
+        global _CACHE_BYPASS_COUNT
+        self._prior = None
+        try:
+            import jax
+
+            self._prior = bool(jax.config.jax_enable_compilation_cache)
+            jax.config.update("jax_enable_compilation_cache", False)
+            _CACHE_BYPASS_COUNT += 1
+        except Exception:  # pragma: no cover - config shape drift
+            self._prior = None
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        if self._prior is not None:
+            import jax
+
+            jax.config.update(
+                "jax_enable_compilation_cache", self._prior
+            )
+
+
 def configure_persistent_cache() -> "str | None":
     """Enable JAX's persistent compilation cache when
     ``TORCHEVAL_TPU_CACHE_DIR`` names a directory, returning the path (or

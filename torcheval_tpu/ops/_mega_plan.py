@@ -114,6 +114,7 @@ def route_token() -> Tuple[Any, ...]:
     return (
         _oflags.megakernel_mode(),
         _oflags.wavefront_mode(),
+        _oflags.rank_sketch_mode(),
         _oflags.pallas_disabled(),
         backend,
     )
@@ -197,6 +198,8 @@ def _classify(name: str, m, f: int, idt, tdt) -> Optional[MemberPlan]:
         BinaryAccuracy,
         MulticlassAccuracy,
     )
+    from torcheval_tpu.metrics.classification.auprc import BinaryAUPRC
+    from torcheval_tpu.metrics.classification.auroc import BinaryAUROC
     from torcheval_tpu.metrics.classification.binned_auc import (
         BinaryBinnedAUPRC,
         BinaryBinnedAUROC,
@@ -281,7 +284,12 @@ def _classify(name: str, m, f: int, idt, tdt) -> Optional[MemberPlan]:
             name, "cm", "binary_cm", threshold=float(m.threshold),
             num_classes=2,
         )
-    if t in (BinaryBinnedAUROC, BinaryBinnedAUPRC):
+    if t in (BinaryBinnedAUROC, BinaryBinnedAUPRC) or (
+        t in (BinaryAUROC, BinaryAUPRC) and getattr(m, "_sketch_mode", False)
+    ):
+        # Sketch-mode exact-rank members carry the binned family's exact
+        # state layout (threshold edges + the four ge-count arrays), so
+        # the one binned accumulation shape covers both.
         if not binaryish or m.num_tasks != 1:
             return None
         thr_shape = _shape_of(m.threshold)

@@ -1,0 +1,274 @@
+r"""Mergeable rank-sketch kernels — the sort-free exact-rank tier.
+
+The exact AUROC/AUPRC family buffers every sample and re-sorts the whole
+buffer per compute (and the sharded ustat paths sort per update); the
+``BENCH_ALL.json`` sort rows sit at a ~0.1% HBM-utilization lower bound
+because a device sort is dispatch-bound, unmergeable without replaying
+buffers, and exactly the accumulation shape the collection megakernel
+cannot scatter.  This module provides the replacement state: a
+**fixed-size rank sketch** updated in a single bandwidth-bound pass,
+mergeable by integer addition, with documented ε rank-error bounds.
+
+Two sketch geometries share one update kernel:
+
+* **Uniform-edge sketch** (scores in [0, 1] — the probability-scale
+  curve metrics): ``bins`` uniform edges from
+  :func:`uniform_edges`; the state is the cumulative "``score >= edge``"
+  count per edge — *bit-identical* to the binned-AUC sufficient
+  statistics (``num_tp``/``num_fp``/``num_pos``/``num_total``), so
+  sketch-backed members ride the existing collection megakernel route
+  (``ops/pallas_mega.py`` kind ``"binned"``) unchanged.
+* **Dyadic ladder** (unbounded non-negative domains — the ``monitor/``
+  latency digests): ``levels`` compactor levels of ``bins`` sub-bins
+  each.  Level 0 covers ``[0, base)``; level ℓ ≥ 1 covers
+  ``[base·2^{ℓ-1}, base·2^ℓ)`` — the *weight ladder*: each level's bin
+  width doubles, so L levels span a ``2^{L-1}`` dynamic range in
+  ``L × bins`` integer counters with relative value error ≤ ``1/bins``
+  above ``base``.  Per-level fill counters are the level sums
+  (:func:`ladder_fill`).
+
+**Why deterministic value-sliced compaction instead of randomized KLL.**
+A textbook KLL compactor discards every other element of a full level
+*at random*; two merges of the same data in different orders then keep
+different survivors, so the sketch is only mergeable in distribution.
+The acceptance bar here is stronger: merge must be **associative,
+commutative, and bit-deterministic across merge orders** (fleet trees
+deliver envelopes in nondeterministic order).  Slicing the value domain
+into fixed edges makes the compactor state a vector of integer counts
+whose merge is elementwise addition — exactly associative and
+bit-deterministic — at the cost of a data-independent (rather than
+data-adaptive) ε.  The estimate stays approximate; the *arithmetic* is
+exact.
+
+**Error bounds.**  Rank queries *at the edges* are exact — the state
+literally stores ``#{x : x >= edge}``.  An arbitrary value's rank errs
+by at most the mass of the bin containing it; for the uniform-edge
+sketch over a Lipschitz score CDF that is ε = ``1/(bins-1)`` of the
+stream (:func:`rank_error_bound`), and the derived AUROC/AUPRC estimate
+(trapezoid / step-sum over the exact edge counts) inherits the same
+within-bin-tie bound.  For the ladder, a quantile's *value* errs by at
+most one bin width: relative error ≤ ``1/bins`` for values above
+``base``, absolute ≤ ``base/bins`` below.
+
+**Formulations.**  ``rank_counts_rows`` returns bit-identical int32
+counts on every route: on TPU it delegates to the measured binned-AUC
+formulations (VPU broadcast-compare, or the Pallas MXU one-hot
+histogram); on CPU / under ``TORCHEVAL_TPU_DISABLE_PALLAS`` it uses a
+one-pass ``searchsorted`` + bincount + suffix-cumsum (the masked
+scatter) instead of the binned family's per-update sort — this is what
+makes the streaming update bandwidth-bound rather than sort-bound on
+every backend.  Bit-identity across formulations is integer arithmetic:
+``searchsorted(edges, s, side="right")`` counts ``#{j : edges_j <= s}``
+with the same IEEE compares as the broadcast ``s >= edge``, so the
+suffix sums equal the compare-and-sum counts exactly (NaN-free scores
+assumed, as documented for the megakernel).
+"""
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# The binned-AUC helpers (_binned_counts_rows, _select_binned_route,
+# _create_threshold_tensor) are imported lazily inside the functions
+# that use them: ops is a lower layer than metrics.functional, and the
+# layering lint (TPU002) is right that the dependency points upward —
+# the sketch deliberately shares the binned family's exact edge
+# constructor and TPU routes for bit-parity with the megakernel.
+
+# Default uniform-edge resolution: the largest edge count that still
+# classifies for the collection megakernel (_mega_plan._MAX_THRESHOLDS),
+# giving ε = 1/511 ≈ 0.2% rank error.
+DEFAULT_BINS = 512
+
+
+def uniform_edges(bins: int) -> jax.Array:
+    """``bins`` ascending uniform edges over [0, 1] (f32) — the sketch's
+    value slicing for probability-scale scores.  Shares the binned-AUC
+    threshold constructor so edge j equals threshold j bit-for-bit and
+    the megakernel's compare columns line up."""
+    from torcheval_tpu.metrics.functional.classification.binned_precision_recall_curve import (  # noqa: E501
+        _create_threshold_tensor,
+    )
+
+    if bins < 2:
+        raise ValueError(f"sketch bins must be >= 2, got {bins}")
+    return _create_threshold_tensor(bins)
+
+
+def rank_error_bound(bins: int) -> float:
+    """Documented ε for the uniform-edge sketch: rank queries at the
+    edges are exact; an arbitrary value's rank (and the derived
+    AUROC/AUPRC estimate) errs by at most the within-bin mass, bounded
+    by the bin width ``1/(bins-1)`` for Lipschitz score CDFs."""
+    return 1.0 / (bins - 1)
+
+
+def _select_rank_route(
+    num_rows: int, num_samples: int, edges: jax.Array
+) -> str:
+    """Call-time formulation choice (static under jit, like
+    ``_select_binned_route``): TPU keeps the measured binned routes
+    (broadcast / Pallas MXU histogram); everywhere the binned family
+    would fall back to its per-update *sort* (CPU, kill-switch,
+    out-of-bounds), the sketch instead uses the one-pass ``"bincount"``
+    masked scatter — that downgrade is exactly the sort-per-update cost
+    this tier exists to remove."""
+    from torcheval_tpu.metrics.functional.classification.binned_auc import (
+        _select_binned_route,
+    )
+    from torcheval_tpu.ops._flags import pallas_disabled
+
+    if pallas_disabled() or jax.default_backend() != "tpu":
+        return "bincount"
+    route = _select_binned_route(num_rows, num_samples, edges)
+    return "bincount" if route == "sort" else route
+
+
+def rank_counts_rows(
+    scores: jax.Array,
+    hits: jax.Array,
+    edges: jax.Array,
+    route: Optional[str] = None,
+    mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Per-edge ``score >= edge`` counts over ``(R, N)`` score/hit rows
+    — the rank sketch's masked-scatter update, returning the binned-AUC
+    sufficient statistics ``(num_tp (R,B), num_fp (R,B), num_pos (R,),
+    num_total (R,))`` as bit-identical int32 on every route.
+
+    ``mask`` (shape ``(N,)``) excludes padded samples exactly: masked
+    scores contribute to no edge count, masked hits are zeroed, and
+    ``num_total`` becomes ``mask.sum()`` — the ``_binned_counts_rows``
+    mask contract.  Pass ``route`` when calling from inside jit."""
+    if route is None:
+        route = _select_rank_route(scores.shape[0], scores.shape[-1], edges)
+    if route != "bincount":
+        from torcheval_tpu.metrics.functional.classification.binned_auc import (  # noqa: E501
+            _binned_counts_rows,
+        )
+
+        return _binned_counts_rows(
+            scores, hits, edges, route=route, mask=mask
+        )
+    return _rank_counts_bincount(scores, hits, edges, mask=mask)
+
+
+@jax.jit
+def _rank_counts_bincount(
+    scores: jax.Array,
+    hits: jax.Array,
+    edges: jax.Array,
+    mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """One-pass bincount formulation: ``idx_i = #{j : edges_j <= s_i}``
+    (a ``searchsorted`` binary search — O(log bins) register compares
+    per element, one HBM read of the batch), a per-row masked
+    scatter-add into ``bins+1`` cells, and a suffix cumsum:
+    ``#{i : s_i >= edges_j} = Σ_{k > j} cell_k``.  Integer-exact, so
+    bit-identical to the compare formulations."""
+    num_rows, n = scores.shape
+    bins = edges.shape[0]
+    hits_b = hits.astype(jnp.bool_)
+    idx = jax.vmap(
+        lambda row: jnp.searchsorted(edges, row, side="right")
+    )(scores)
+    if mask is not None:
+        valid = mask.astype(jnp.bool_)
+        # Masked samples land in cell 0, below every edge — the same
+        # "score := -inf" exclusion the binned formulations apply.
+        idx = jnp.where(valid[None, :], idx, 0)
+        hits_b = hits_b & valid[None, :]
+    ones = jnp.ones((num_rows, n), jnp.int32)
+    tp_w = hits_b.astype(jnp.int32)
+
+    def scatter(weights):
+        return jax.vmap(
+            lambda row_idx, row_w: jnp.zeros(bins + 1, jnp.int32)
+            .at[row_idx]
+            .add(row_w, mode="drop")
+        )(idx, weights)
+
+    cells = scatter(ones)
+    tp_cells = scatter(tp_w)
+    # suffix[k] = Σ_{k' >= k} cells_k' ; count at edge j is suffix[j+1].
+    num_ge = jnp.cumsum(cells[:, ::-1], axis=-1)[:, ::-1][:, 1:]
+    num_tp = jnp.cumsum(tp_cells[:, ::-1], axis=-1)[:, ::-1][:, 1:]
+    num_pos = hits_b.sum(axis=-1, dtype=jnp.int32)
+    if mask is None:
+        num_total = jnp.full((num_rows,), n, jnp.int32)
+    else:
+        num_total = jnp.zeros((num_rows,), jnp.int32) + valid.sum(
+            dtype=jnp.int32
+        )
+    return num_tp, num_ge - num_tp, num_pos, num_total
+
+
+# --------------------------------------------------------------- ladder
+def ladder_edges(base: float, levels: int, bins: int) -> jax.Array:
+    """Flattened ascending left-edge array of the dyadic compactor
+    ladder: ``levels × bins`` edges, level 0 slicing ``[0, base)``
+    uniformly and level ℓ ≥ 1 slicing ``[base·2^{ℓ-1}, base·2^ℓ)`` —
+    each level's bin width doubles (the weight ladder), so the span is
+    ``base·2^{levels-1}`` with relative value error ≤ ``1/bins`` above
+    ``base``."""
+    if levels < 1:
+        raise ValueError(f"ladder levels must be >= 1, got {levels}")
+    if bins < 2:
+        raise ValueError(f"ladder bins must be >= 2, got {bins}")
+    if base <= 0.0:
+        raise ValueError(f"ladder base must be positive, got {base}")
+    sub = jnp.arange(bins, dtype=jnp.float32) / bins
+    rows = [base * sub]
+    for lvl in range(1, levels):
+        lo = base * (2.0 ** (lvl - 1))
+        rows.append(lo * (1.0 + sub))
+    return jnp.concatenate(rows).astype(jnp.float32)
+
+
+def ladder_fill(counts: jax.Array, levels: int) -> jax.Array:
+    """Per-level fill counters — the ``(levels,)`` sums of the
+    flattened ``(levels*bins,)`` per-bin counts."""
+    return counts.reshape(levels, -1).sum(axis=-1, dtype=counts.dtype)
+
+
+@jax.jit
+def ladder_counts(
+    values: jax.Array,
+    edges: jax.Array,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Per-bin occupancy delta for one batch of non-negative values —
+    the ladder's masked scatter (same ``searchsorted`` + scatter-add
+    pass as the uniform-edge kernel; values at or above the top edge
+    clip into the last bin)."""
+    values = values.reshape(-1).astype(jnp.float32)
+    k = edges.shape[0]
+    idx = jnp.clip(
+        jnp.searchsorted(edges, values, side="right") - 1, 0, k - 1
+    )
+    weights = jnp.ones_like(values, jnp.int32)
+    if mask is not None:
+        weights = mask.reshape(-1).astype(jnp.int32)
+    return jnp.zeros(k, jnp.int32).at[idx].add(weights, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("qs",))
+def ladder_quantiles(
+    counts: jax.Array, edges: jax.Array, qs: Tuple[float, ...]
+) -> jax.Array:
+    """Deterministic quantile reads off the ladder: global value order
+    across the flattened levels means an inclusive cumsum is the CDF;
+    each quantile returns its bin's left edge (never interpolated, so
+    any merge order yields the identical value).  The CDF stays int32
+    (exact for any total the int32 counters can hold); only the target
+    rank is computed in f32 — a sub-ulp rank perturbation moves a read
+    by at most one bin, identically on every host."""
+    cdf = jnp.cumsum(counts.astype(jnp.int32))
+    total = jnp.maximum(cdf[-1], 1).astype(jnp.float32)
+    q = jnp.asarray(qs, jnp.float32)
+    target = jnp.ceil(q * total).astype(jnp.int32)
+    pos = jnp.searchsorted(cdf, target, side="left")
+    pos = jnp.clip(pos, 0, edges.shape[0] - 1)
+    return edges[pos]

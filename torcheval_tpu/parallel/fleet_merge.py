@@ -33,11 +33,13 @@ the whole collective.  This module reduces the same state
   false excisions of live subtrees.  The final result is labelled **partial**
   (``world_effective = len(contributors) < world_size``) instead of the
   run dying — no failure propagates past the root as an exception.
-* **O(bins) payloads** — ``sketch="reservoir" | "histogram" | "count"``
-  ships :mod:`torcheval_tpu.metrics._sketch` summaries instead of raw
-  sample buffers; their merges are commutative/associative so tree
-  order cannot change the result, and their error bounds are documented
-  per kind.  ``sketch=None`` ships whole per-rank prepared states keyed
+* **O(bins) payloads** — ``sketch="reservoir" | "histogram" | "count"
+  | "rank"`` ships :mod:`torcheval_tpu.metrics._sketch` summaries
+  instead of raw sample buffers (``"rank"`` wraps a sketch-mode curve
+  metric's device-resident compactor counts directly — integer-add
+  merges, bit-identical at every world size and topology); their
+  merges are commutative/associative so tree order cannot change the
+  result, and their error bounds are documented per kind.  ``sketch=None`` ships whole per-rank prepared states keyed
   by rank, reassembled in rank order at the root — bit-identical to the
   flat gather-and-merge on a clean run.
 

@@ -547,14 +547,60 @@ def _explain_parallel_route(fn, name, args, kwargs):
             "accumulation shapes)."
         )
 
+    def _rank_sketch_verdict(owner) -> str:
+        from torcheval_tpu.metrics._rank_state import predicted_epsilon
+        from torcheval_tpu.ops import _flags as _oflags
+
+        engaged, declined = [], []
+        for mname, m in owner._metrics.items():
+            if getattr(m, "_sketch_mode", False):
+                engaged.append((mname, m))
+            elif type(m).__name__ in (
+                "BinaryAUROC", "BinaryAUPRC", "MulticlassAUROC"
+            ):
+                declined.append(mname)
+        if not engaged and not declined:
+            return ""
+        parts = []
+        if engaged:
+            detail = ", ".join(
+                f"{mname} ({m._sketch_bins} bins, "
+                f"eps<={predicted_epsilon(m):.2e})"
+                for mname, m in engaged
+            )
+            parts.append(
+                f"Rank-sketch tier ENGAGED for {len(engaged)} member(s) "
+                f"[{detail}]: single-pass sort-free updates on fixed "
+                "O(bins) count states, add-mergeable payloads "
+                "(ops/rank_sketch.py; see docs/source/sketch.rst for the "
+                "sketch-vs-sort crossover)."
+            )
+        if declined:
+            hint = (
+                "TORCHEVAL_TPU_RANK_SKETCH is truthy but these members "
+                "predate the flip — the state layout is fixed at "
+                "construction"
+                if _oflags.rank_sketch_enabled()
+                else "construct with sketch=True or set "
+                "TORCHEVAL_TPU_RANK_SKETCH=1 to trade exact sorting for "
+                "a bounded-error single pass"
+            )
+            parts.append(
+                f"Exact sample-buffer member(s) [{', '.join(declined)}] "
+                f"keep the sort-per-compute path ({hint})."
+            )
+        return "  ".join(parts)
+
     # --- MetricCollection.fused_update (bound method) --------------------
     if isinstance(owner, MetricCollection) and name == "fused_update":
         try:
             owner._check_fusable()
         except ValueError as exc:
+            sketch_verdict = _rank_sketch_verdict(owner)
             return (
                 f"fused_update: not fusable — the call itself would "
                 f"raise ({exc})"
+                + (f"  {sketch_verdict}" if sketch_verdict else "")
             )
         from torcheval_tpu._stats import trace_count
 
@@ -569,6 +615,7 @@ def _explain_parallel_route(fn, name, args, kwargs):
                 "Bucketing is OFF (bucket=False): every distinct batch "
                 "size traces + compiles its own program."
             )
+        sketch_verdict = _rank_sketch_verdict(owner)
         donated = owner._fused_apply_donated
         donation = (
             "state buffers are donated to XLA (in-place accumulate)"
@@ -590,6 +637,7 @@ def _explain_parallel_route(fn, name, args, kwargs):
             f"{trace_count('mega_collection')} megakernel program(s) so "
             f"far (hot_path_stats() for the full counters), and "
             f"{donation}.  {_megakernel_verdict(owner, args, kwargs)}"
+            + (f"  {sketch_verdict}" if sketch_verdict else "")
         )
 
     def call_arg(pos, kw, default=None):

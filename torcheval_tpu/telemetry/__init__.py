@@ -293,6 +293,8 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
             "device_kind": perf["device_kind"],
             "routes": perf["routes"],
         }
+        if "rank_sketch" in perf:
+            result["perf"]["rank_sketch"] = perf["rank_sketch"]
     if agg["alerts"]:
         result["alerts"] = {
             rule: dict(entry) for rule, entry in agg["alerts"].items()

@@ -1107,6 +1107,17 @@ def format_explain_perf(result: Dict[str, Any]) -> str:
         )
     for program, route in sorted(routes.items()):
         buf.write(f"  {_format_perf_route(program, route)}\n")
+    sketch = result.get("rank_sketch")
+    if sketch:
+        bins = ", ".join(
+            f"{b}x{n}" for b, n in sketch.get("bins", {}).items()
+        )
+        buf.write(
+            f"  rank-sketch tier: {sketch.get('members_constructed', 0)} "
+            f"member(s) on sort-free sketch states (bins {bins}, "
+            f"predicted eps <= {sketch.get('predicted_eps_max', 0.0):.2e})"
+            " — exact-buffer curve members would pay a sort per compute\n"
+        )
     alerts = result.get("alerts", {})
     for rule, entry in sorted(alerts.items()):
         buf.write(

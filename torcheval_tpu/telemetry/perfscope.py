@@ -365,6 +365,14 @@ def explain_perf(
         "routes": routes,
         "alerts": {rule: dict(e) for rule, e in agg["alerts"].items()},
     }
+    # Sketch-vs-sort crossover stamp: which members run on the rank-
+    # sketch tier, at what capacity, and the worst documented ε — the
+    # companion figure to the megakernel reread annotation above.
+    from torcheval_tpu.metrics._rank_state import sketch_census
+
+    census = sketch_census()
+    if census:
+        result["rank_sketch"] = census
     if as_text:
         from torcheval_tpu.telemetry.export import format_explain_perf
 
