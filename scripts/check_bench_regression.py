@@ -106,6 +106,16 @@ EXTRA_FLOORS = (
     # eight O(compactors) sketches, >=10x under eight sample buffers.
     ("binary_auroc_sketch_stream", "hbm_util_pct_lower_bound", 1.0),
     ("binary_auroc_sketch_stream", "sketch_payload_reduction_x", 10.0),
+    # The autotuned-routing never-slower gate, deterministic on every
+    # backend: the bench replays the same stream under the measured-
+    # cost layer's picks and under the static heuristics, asserts the
+    # states bitwise equal, and emits 1.0 only when every raced
+    # decision's pick is the measured argmin of its store rows AND the
+    # pick's measured seconds do not exceed the static choice's on the
+    # same real shapes.  0.0 here means a measured row steered routing
+    # onto a slower-or-wrong route — the one regression the store must
+    # make impossible.
+    ("autotune_route_race", "autotune_never_slower", 1.0),
 )
 
 # (metric row, extras key, extras key) — pairs that must be EQUAL, for

@@ -1,7 +1,7 @@
 """Zero-overhead guard for the disabled telemetry bus, the disabled
 data-health monitor, the disarmed fault-injection hooks, the disabled
-perfscope accounting layer, the disabled causal tracer, and the
-disabled flight recorder.
+perfscope accounting layer, the disabled causal tracer, the disabled
+flight recorder, and the disabled measured-cost routing layer.
 
 The telemetry contract (``torcheval_tpu/telemetry/events.py``) is that a
 DISABLED bus costs the hot path exactly one module-attribute read and one
@@ -83,6 +83,13 @@ _TRACE_HOOKS = (
 # Flight recorder (``torcheval_tpu/telemetry/flightrec.py``): disabled,
 # the per-emit tail append and every trigger site stay cold.
 _FLIGHTREC_HOOKS = ("observe", "trigger")
+
+# Measured-cost routing layer (``torcheval_tpu/routing_autotune.py``):
+# disabled, no route decision consults the cost store, no profile is
+# priced into it, and the route token keeps its static arity — each
+# hook site (plan_for, wavefront_route, the perfscope feed, the CM
+# chunk resolution) pays one branch on ``routing_autotune.ENABLED``.
+_AUTOTUNE_HOOKS = ("observe_profile", "decide", "record_measurement")
 
 # Live quality monitor (``torcheval_tpu/monitor/quality.py``): the
 # engine's snapshot hook gates ``publish`` on ``telemetry.events.ENABLED``
@@ -312,6 +319,7 @@ def _drive_hot_path() -> None:
 def check(verbose: bool = True) -> List[str]:
     """Assert zero hook calls on the disabled path; returns the guarded
     hook names (so the test tier can sanity-check coverage)."""
+    from torcheval_tpu import routing_autotune as at
     from torcheval_tpu import telemetry
     from torcheval_tpu.monitor import quality as mq
     from torcheval_tpu.resilience import faults as fl
@@ -326,11 +334,13 @@ def check(verbose: bool = True) -> List[str]:
     perfscope_was_enabled = ps.enabled()
     trace_was_enabled = tr.enabled()
     flightrec_was_enabled = fr.enabled()
+    autotune_was_enabled = at.enabled()
     telemetry.disable()
     hm.disable()
     ps.disable()
     tr.disable()
     fr.disable()
+    at.disable()
     counter: Dict[str, int] = {}
     names = _hook_names(ev)
     try:
@@ -397,6 +407,16 @@ def check(verbose: bool = True) -> List[str]:
                         ),
                     )
                 )
+            for name in _AUTOTUNE_HOOKS:
+                stack.enter_context(
+                    mock.patch.object(
+                        at,
+                        name,
+                        _counting(
+                            getattr(at, name), counter, f"autotune.{name}"
+                        ),
+                    )
+                )
             _drive_hot_path()
     finally:
         if was_enabled:
@@ -409,6 +429,8 @@ def check(verbose: bool = True) -> List[str]:
             tr.enable()
         if flightrec_was_enabled:
             fr.enable()
+        if autotune_was_enabled:
+            at.enable()
     fired = {k: v for k, v in counter.items() if v}
     if fired:
         raise AssertionError(
@@ -424,6 +446,7 @@ def check(verbose: bool = True) -> List[str]:
             + len(_TRACE_HOOKS)
             + len(_FLIGHTREC_HOOKS)
             + len(_MONITOR_HOOKS)
+            + len(_AUTOTUNE_HOOKS)
         )
         print(
             f"ok: {total} "
@@ -437,6 +460,7 @@ def check(verbose: bool = True) -> List[str]:
         + [f"trace.{n}" for n in _TRACE_HOOKS]
         + [f"flightrec.{n}" for n in _FLIGHTREC_HOOKS]
         + [f"monitor.{n}" for n in _MONITOR_HOOKS]
+        + [f"autotune.{n}" for n in _AUTOTUNE_HOOKS]
     )
 
 
@@ -457,6 +481,7 @@ def static_coverage_check(verbose: bool = True) -> List[str]:
     wrapped.update(f"trace.{n}" for n in _TRACE_HOOKS)
     wrapped.update(f"flightrec.{n}" for n in _FLIGHTREC_HOOKS)
     wrapped.update(f"monitor.{n}" for n in _MONITOR_HOOKS)
+    wrapped.update(f"autotune.{n}" for n in _AUTOTUNE_HOOKS)
     discovered = hook_entry_points()
     missing = sorted(set(discovered) - wrapped)
     if missing:

@@ -293,7 +293,9 @@ class TestRouteToken(unittest.TestCase):
             os.environ, {"TORCHEVAL_TPU_RANK_SKETCH": "0"}
         ):
             off = route_token()
-        self.assertEqual(len(on), 5)
+        # (megakernel, wavefront, rank_sketch, pallas_disabled,
+        # cm_row_chunk, backend) — rank_sketch rides at index 2.
+        self.assertEqual(len(on), 6)
         self.assertTrue(on[2])
         self.assertFalse(off[2])
         self.assertNotEqual(on, off)

@@ -1,0 +1,690 @@
+"""Measured-cost routing layer (``torcheval_tpu/routing_autotune.py``):
+store round-trips and torn-write quarantine, staleness invalidation
+(version / route-token context / device kind), preference ranking and
+decide() semantics, the ``aot.warmup(autotune=True)`` race (determinism,
+probe budget, drift re-probe), telemetry surfaces, and the
+zero-cost-off identity contract."""
+
+import contextlib
+import hashlib
+import io
+import json
+import os
+import tempfile
+import unittest
+from unittest import mock
+
+import numpy as np
+
+from torcheval_tpu import _flags
+from torcheval_tpu import routing_autotune as ra
+
+
+@contextlib.contextmanager
+def _layer(tmp=None, **env):
+    """The measured-cost layer enabled against a private store: patches
+    the cache dir (when given) plus any extra TORCHEVAL_TPU_* env vars,
+    clears both the in-memory store and the decision cache on entry AND
+    exit so no rows leak between tests."""
+    overrides = dict(env)
+    if tmp is not None:
+        overrides["TORCHEVAL_TPU_CACHE_DIR"] = tmp
+    with mock.patch.dict(os.environ, overrides):
+        ra.clear()
+        ra.enable()
+        try:
+            yield
+        finally:
+            ra.disable()
+            ra.clear()
+
+
+def _seed_pair(decision="megakernel", signature="sigA", fast="mega",
+               slow="fused", fast_s=1e-3, slow_s=3e-3, site="race"):
+    ra.record_measurement(decision, fast, signature, fast_s, site=site)
+    ra.record_measurement(decision, slow, signature, slow_s, site=site)
+
+
+class TestStoreRoundTrip(unittest.TestCase):
+    def test_flush_reload_round_trip_with_sidecar(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair()
+                path = ra.flush()
+                self.assertIsNotNone(path)
+                self.assertTrue(os.path.exists(path))
+                self.assertTrue(os.path.exists(path + ".sha256"))
+                # Drop the in-memory store; the next read reloads disk.
+                ra.clear()
+                rows = ra.store_rows()
+                self.assertEqual(len(rows), 2)
+                from torcheval_tpu.version import __version__
+
+                for row in rows:
+                    self.assertEqual(row["version"], __version__)
+                    self.assertEqual(row["site"], "race")
+                    self.assertEqual(row["kind"], "measured")
+                    self.assertEqual(len(row["token"]), 6)
+                    # The decided element is masked in the stamp.
+                    self.assertEqual(row["token"][0], "*")
+                    self.assertIn(row["choice"], ("mega", "fused"))
+
+    def test_flush_without_cache_dir_is_noop(self):
+        with mock.patch.dict(os.environ, {"TORCHEVAL_TPU_AUTOTUNE": "1"}):
+            os.environ.pop("TORCHEVAL_TPU_CACHE_DIR", None)
+            ra.clear()
+            ra.enable()
+            try:
+                _seed_pair()
+                self.assertIsNone(ra.flush())
+                self.assertIsNone(ra.store_path())
+                # The store still works in memory.
+                self.assertIsNotNone(ra.preference("megakernel", "sigA"))
+            finally:
+                ra.disable()
+                ra.clear()
+
+    def test_torn_write_quarantined(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair()
+                path = ra.flush()
+                with open(path, "ab") as fh:
+                    fh.write(b"torn")
+                ra.clear()
+                self.assertEqual(ra.store_rows(), [])
+                self.assertTrue(os.path.exists(path + ".corrupt"))
+                self.assertTrue(
+                    os.path.exists(path + ".sha256" + ".corrupt")
+                )
+                self.assertFalse(os.path.exists(path))
+
+    def test_sidecar_mismatch_quarantined(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair()
+                path = ra.flush()
+                with open(path + ".sha256", "w", encoding="utf-8") as fh:
+                    fh.write("0" * 64 + "\n")
+                ra.clear()
+                self.assertEqual(ra.store_rows(), [])
+                self.assertTrue(os.path.exists(path + ".corrupt"))
+
+    def test_unparseable_payload_quarantined(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                path = os.path.join(
+                    tmp, "torcheval_tpu_route_costs.json"
+                )
+                payload = b"{not json"
+                with open(path, "wb") as fh:
+                    fh.write(payload)
+                with open(path + ".sha256", "w", encoding="utf-8") as fh:
+                    fh.write(hashlib.sha256(payload).hexdigest() + "\n")
+                self.assertEqual(ra.store_rows(), [])
+                self.assertTrue(os.path.exists(path + ".corrupt"))
+
+    def test_rows_from_other_library_version_dropped(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair()
+                path = ra.flush()
+                with open(path, "rb") as fh:
+                    doc = json.loads(fh.read())
+                for row in doc["rows"].values():
+                    row["version"] = "0.0.0"
+                payload = json.dumps(doc, sort_keys=True).encode("utf-8")
+                with open(path, "wb") as fh:
+                    fh.write(payload)
+                with open(path + ".sha256", "w", encoding="utf-8") as fh:
+                    fh.write(hashlib.sha256(payload).hexdigest() + "\n")
+                ra.clear()
+                self.assertEqual(ra.store_rows(), [])
+                # A valid-but-stale store is NOT quarantined.
+                self.assertTrue(os.path.exists(path))
+
+    def test_unknown_decision_raises(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                with self.assertRaises(ValueError):
+                    ra.record_measurement("warp_drive", "on", "sigA", 1e-3)
+
+
+class TestPreferenceAndDecide(unittest.TestCase):
+    def test_unmeasured_decision_returns_static_default(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                self.assertIsNone(ra.preference("megakernel", "sigA"))
+                self.assertEqual(
+                    ra.decide("megakernel", "sigA", "fused"), "fused"
+                )
+
+    def test_measured_pick_wins_and_names_the_runner_up(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair(fast="mega", slow="fused")
+                pref = ra.preference("megakernel", "sigA")
+                self.assertEqual(pref["choice"], "mega")
+                self.assertEqual(pref["alt_choice"], "fused")
+                self.assertLess(pref["seconds"], pref["alt_seconds"])
+                self.assertEqual(pref["site"], "race")
+                self.assertEqual(pref["kind"], "measured")
+                self.assertEqual(
+                    ra.decide("megakernel", "sigA", "fused"), "mega"
+                )
+
+    def test_single_sided_measurements_cannot_rank(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                ra.record_measurement("megakernel", "mega", "sigA", 1e-3)
+                self.assertIsNone(ra.preference("megakernel", "sigA"))
+                self.assertEqual(
+                    ra.decide("megakernel", "sigA", "fused"), "fused"
+                )
+
+    def test_sites_never_mixed_in_one_comparison(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                ra.record_measurement(
+                    "megakernel", "mega", "sigA", 1e-3, site="race"
+                )
+                ra.record_measurement(
+                    "megakernel", "fused", "sigA", 2e-3, site="collection"
+                )
+                # One choice per site -> no site can rank the decision.
+                self.assertIsNone(ra.preference("megakernel", "sigA"))
+
+    def test_race_site_outranks_priced_sites(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                # Priced rows say mega, the race says fused: the race
+                # (wall clock on the real entry) must win.
+                ra.record_measurement(
+                    "megakernel", "mega", "sigA", 1e-4, site="collection"
+                )
+                ra.record_measurement(
+                    "megakernel", "fused", "sigA", 2e-4, site="collection"
+                )
+                ra.record_measurement(
+                    "megakernel", "fused", "sigA", 1e-3, site="race"
+                )
+                ra.record_measurement(
+                    "megakernel", "mega", "sigA", 2e-3, site="race"
+                )
+                pref = ra.preference("megakernel", "sigA")
+                self.assertEqual(pref["site"], "race")
+                self.assertEqual(pref["choice"], "fused")
+
+    def test_device_kind_mismatch_never_binds(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair()
+                self.assertIsNotNone(ra.preference("megakernel", "sigA"))
+                with mock.patch.object(
+                    ra, "_device_kind", return_value="TPU v9"
+                ):
+                    self.assertIsNone(ra.preference("megakernel", "sigA"))
+
+    def test_route_token_context_drift_never_binds(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair()
+                self.assertIsNotNone(ra.preference("megakernel", "sigA"))
+                # Flip a context flag the megakernel stamp does NOT
+                # mask: the rows were measured under a different
+                # wavefront mode, so they no longer bind.
+                with mock.patch.dict(
+                    os.environ, {"TORCHEVAL_TPU_WAVEFRONT": "1"}
+                ):
+                    self.assertIsNone(ra.preference("megakernel", "sigA"))
+                # Back to the recorded context: binds again.
+                self.assertIsNotNone(ra.preference("megakernel", "sigA"))
+
+    def test_own_flag_is_masked_out_of_the_stamp(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair("cm_row_chunk", "*", "2048", "4096")
+                # Forcing the DECIDED flag itself must not unbind the
+                # rows (the race forces it while measuring).
+                with mock.patch.dict(
+                    os.environ, {"TORCHEVAL_TPU_CM_ROW_CHUNK": "8192"}
+                ):
+                    pref = ra.preference("cm_row_chunk", "*")
+                self.assertIsNotNone(pref)
+                self.assertEqual(pref["choice"], "2048")
+
+    def test_new_measurement_invalidates_decision_cache(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair(fast="mega", slow="fused")
+                self.assertEqual(
+                    ra.decide("megakernel", "sigA", "fused"), "mega"
+                )
+                # A faster fused row lands: the cached pick must flip.
+                ra.record_measurement(
+                    "megakernel", "fused", "sigA", 1e-4, site="race"
+                )
+                self.assertEqual(
+                    ra.decide("megakernel", "sigA", "fused"), "fused"
+                )
+
+    def test_never_slower_on_synthetic_cost_tables(self):
+        # The unit-level "autotuned never slower than static" gate: for
+        # every synthetic cost table, decide()'s pick must be the
+        # measured argmin, so its cost is <= the static default's cost.
+        tables = [
+            {"mega": 1e-3, "fused": 2e-3},
+            {"mega": 5e-3, "fused": 2e-3},
+            {"mega": 1e-3, "fused": 1e-3},
+        ]
+        for costs in tables:
+            with tempfile.TemporaryDirectory() as tmp:
+                with _layer(tmp):
+                    for choice, seconds in costs.items():
+                        ra.record_measurement(
+                            "megakernel", choice, "sigA", seconds
+                        )
+                    for static_default in ("mega", "fused"):
+                        pick = ra.decide(
+                            "megakernel", "sigA", static_default
+                        )
+                        self.assertEqual(
+                            costs[pick], min(costs.values())
+                        )
+                        self.assertLessEqual(
+                            costs[pick], costs[static_default]
+                        )
+
+    def test_measured_crossover_picks_largest_margin_bucket(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair(signature="small", fast_s=1e-3, slow_s=2e-3)
+                _seed_pair(signature="wide", fast_s=1e-3, slow_s=9e-3)
+                cross = ra.measured_crossover("megakernel")
+                self.assertEqual(cross["signature"], "wide")
+                self.assertEqual(cross["choice"], "mega")
+                self.assertIsNone(ra.measured_crossover("wavefront"))
+
+    def test_batch_signature_is_shape_and_dtype_keyed(self):
+        a32 = np.zeros((8, 4), np.float32)
+        b32 = np.ones((8, 4), np.float32)  # values must not matter
+        a64 = np.zeros((8, 4), np.float64)
+        self.assertEqual(
+            ra.batch_signature((a32,)), ra.batch_signature((b32,))
+        )
+        self.assertNotEqual(
+            ra.batch_signature((a32,)), ra.batch_signature((a64,))
+        )
+        self.assertNotEqual(
+            ra.batch_signature((a32,)),
+            ra.batch_signature((a32[:4],)),
+        )
+
+
+def _small_collection(c=8):
+    from torcheval_tpu.metrics import (
+        MetricCollection,
+        MulticlassAccuracy,
+        MulticlassConfusionMatrix,
+    )
+
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(num_classes=c),
+            "cm": MulticlassConfusionMatrix(num_classes=c),
+        }
+    )
+
+
+def _small_batch(c=8, n=16, seed=7):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.random((n, c), dtype=np.float32),
+        rng.integers(0, c, n).astype(np.int32),
+    )
+
+
+class TestWarmupRace(unittest.TestCase):
+    def test_race_records_rows_and_second_warmup_skips(self):
+        from torcheval_tpu import aot
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                col = _small_collection()
+                batch = _small_batch()
+                aot.warmup(col, batch, autotune=True)
+                rows = [
+                    r for r in ra.store_rows() if r["site"] == "race"
+                ]
+                decisions = {r["decision"] for r in rows}
+                self.assertIn("cm_row_chunk", decisions)
+                # Two candidates per raced decision.
+                for decision in decisions:
+                    self.assertEqual(
+                        len([r for r in rows
+                             if r["decision"] == decision]),
+                        2,
+                    )
+                # The store persisted without an explicit flush call.
+                self.assertTrue(os.path.exists(ra.store_path()))
+                stamps = {
+                    (r["decision"], r["choice"]): r["updated"]
+                    for r in rows
+                }
+                # Same shapes again: every race is a store hit, no row
+                # is re-measured (warmup determinism).
+                aot.warmup(_small_collection(), batch, autotune=True)
+                stamps2 = {
+                    (r["decision"], r["choice"]): r["updated"]
+                    for r in ra.store_rows()
+                    if r["site"] == "race"
+                }
+                self.assertEqual(stamps, stamps2)
+
+    def test_probe_budget_zero_races_nothing(self):
+        from torcheval_tpu import aot
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp, TORCHEVAL_TPU_AUTOTUNE_PROBE_BUDGET="1"):
+                # Budget 1 cannot fit any 2-candidate race.
+                aot.warmup(
+                    _small_collection(), _small_batch(), autotune=True
+                )
+                self.assertEqual(ra.store_rows(), [])
+
+    def test_explicit_kill_switch_outranks_the_argument(self):
+        from torcheval_tpu import aot
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp, TORCHEVAL_TPU_AUTOTUNE="0"):
+                aot.warmup(
+                    _small_collection(), _small_batch(), autotune=True
+                )
+                self.assertEqual(ra.store_rows(), [])
+
+    def test_context_drift_reprobes_within_budget(self):
+        from torcheval_tpu import aot
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                batch = _small_batch()
+                aot.warmup(_small_collection(), batch, autotune=True)
+                stamps = {
+                    (r["decision"], r["choice"]): r["updated"]
+                    for r in ra.store_rows()
+                    if r["decision"] == "cm_row_chunk"
+                }
+                self.assertTrue(stamps)
+                # A context flag flips: the old rows no longer bind, so
+                # the next warmup re-races and overwrites the stamps.
+                with mock.patch.dict(
+                    os.environ, {"TORCHEVAL_TPU_WAVEFRONT": "1"}
+                ):
+                    aot.warmup(
+                        _small_collection(), batch, autotune=True
+                    )
+                    stamps2 = {
+                        (r["decision"], r["choice"]): r["updated"]
+                        for r in ra.store_rows()
+                        if r["decision"] == "cm_row_chunk"
+                    }
+                    for key, updated in stamps2.items():
+                        self.assertGreater(updated, stamps[key])
+
+    def test_warmup_race_restores_metric_state(self):
+        from torcheval_tpu import aot
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                col = _small_collection()
+                before = {
+                    k: np.asarray(v)
+                    for k, v in col.state_dict().items()
+                }
+                aot.warmup(col, _small_batch(), autotune=True)
+                after = col.state_dict()
+                for key, val in before.items():
+                    np.testing.assert_array_equal(
+                        val, np.asarray(after[key])
+                    )
+
+
+class TestExplainSurfaces(unittest.TestCase):
+    def test_explain_route_names_the_measured_numbers(self):
+        from torcheval_tpu.metrics import functional as F
+        from torcheval_tpu.routing import explain_route
+
+        p, t = _small_batch(c=4, n=8)
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                text = explain_route(
+                    F.multiclass_confusion_matrix, p, t, num_classes=4
+                )
+                self.assertIn("Measured verdict", text)
+                self.assertIn("no binding cost-store rows", text)
+                _seed_pair("cm_row_chunk", "*", "2048", "4096")
+                text = explain_route(
+                    F.multiclass_confusion_matrix, p, t, num_classes=4
+                )
+                self.assertIn("Measured verdict: 2048", text)
+                self.assertIn("these numbers decided the route", text)
+
+    def test_explain_route_off_mode_has_no_measured_verdict(self):
+        from torcheval_tpu.metrics import functional as F
+        from torcheval_tpu.routing import explain_route
+
+        p, t = _small_batch(c=4, n=8)
+        self.assertFalse(ra.enabled())
+        text = explain_route(
+            F.multiclass_confusion_matrix, p, t, num_classes=4
+        )
+        self.assertNotIn("Measured verdict", text)
+
+    def test_explain_route_reads_live_cm_row_chunk(self):
+        from torcheval_tpu.metrics import functional as F
+        from torcheval_tpu.routing import explain_route
+
+        p, t = _small_batch(c=4, n=8)
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_CM_ROW_CHUNK": "8192"}
+        ):
+            text = explain_route(
+                F.multiclass_confusion_matrix, p, t, num_classes=4
+            )
+        self.assertIn("8192", text)
+
+    def test_explain_perf_prefers_measured_crossovers(self):
+        from torcheval_tpu.telemetry import perfscope
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair("cm_row_chunk", "*", "2048", "4096")
+                result = perfscope.explain_perf()
+                stamp = result["measured_crossovers"]["cm_row_chunk"]
+                self.assertEqual(stamp["measured_choice"], "2048")
+                self.assertEqual(stamp["alt_choice"], "4096")
+                self.assertEqual(stamp["site"], "race")
+            result = perfscope.explain_perf()
+            self.assertNotIn("measured_crossovers", result)
+
+
+class TestTelemetrySurfaces(unittest.TestCase):
+    def setUp(self):
+        from torcheval_tpu.telemetry import events as ev
+
+        self._ev = ev
+        ev.enable()
+        self.addCleanup(ev.disable)
+        self.addCleanup(ev.clear)
+
+    def test_decide_emits_route_decision_event_once_per_epoch(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair(fast="mega", slow="fused")
+                for _ in range(3):
+                    ra.decide("megakernel", "sigA", "fused")
+                agg = self._ev.aggregates()["route_decisions"]
+                entry = agg[("megakernel", "mega", "measured")]
+                self.assertEqual(entry["count"], 1)
+                self.assertEqual(entry["signature"], "sigA")
+                self.assertEqual(entry["source"], "measured-race")
+                self.assertLess(entry["seconds"], entry["alt_seconds"])
+
+    def test_unmeasured_decide_emits_static_verdict(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                ra.decide("wavefront", "*", "scan")
+                agg = self._ev.aggregates()["route_decisions"]
+                entry = agg[("wavefront", "scan", "unmeasured")]
+                self.assertEqual(entry["source"], "static")
+
+    def test_prometheus_and_report_carry_route_decisions(self):
+        from torcheval_tpu import telemetry
+        from torcheval_tpu.telemetry import export
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair(fast="mega", slow="fused")
+                ra.decide("megakernel", "sigA", "fused")
+                text = export.prometheus_text()
+                self.assertIn("route_decisions_total", text)
+                self.assertIn('route="megakernel:mega"', text)
+                self.assertIn('verdict="measured"', text)
+                rep = telemetry.report()
+                self.assertTrue(rep["route_decisions"])
+
+    def test_routes_cli_renders_the_decision_table(self):
+        from torcheval_tpu.telemetry import export
+        from torcheval_tpu.telemetry.__main__ import main
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair(fast="mega", slow="fused")
+                ra.decide("megakernel", "sigA", "fused")
+                dump = os.path.join(tmp, "report.jsonl")
+                export.export_jsonl(dump)
+                buf = io.StringIO()
+                with contextlib.redirect_stdout(buf):
+                    rc = main([dump, "--routes"])
+                self.assertEqual(rc, 0)
+                out = buf.getvalue()
+                self.assertIn("route decision row(s)", out)
+                self.assertIn("megakernel", out)
+                self.assertIn("measured", out)
+                self.assertIn("sigA", out)
+
+    def test_routes_cli_empty_dump_exits_zero(self):
+        from torcheval_tpu.telemetry import export
+        from torcheval_tpu.telemetry.__main__ import main
+
+        self._ev.clear()
+        with tempfile.TemporaryDirectory() as tmp:
+            dump = os.path.join(tmp, "report.jsonl")
+            export.export_jsonl(dump)
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = main([dump, "--routes"])
+            self.assertEqual(rc, 0)
+            self.assertIn("no route decisions recorded", buf.getvalue())
+
+
+class TestZeroCostOff(unittest.TestCase):
+    def test_route_token_carries_epoch_only_while_enabled(self):
+        from torcheval_tpu.ops import _mega_plan
+
+        self.assertFalse(ra.enabled())
+        self.assertEqual(len(_mega_plan.route_token()), 6)
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                token = _mega_plan.route_token()
+                self.assertEqual(len(token), 7)
+                self.assertEqual(token[-1], ra.EPOCH)
+        self.assertEqual(len(_mega_plan.route_token()), 6)
+
+    def test_off_mode_is_bit_and_dispatch_identical(self):
+        from torcheval_tpu import _stats
+
+        batches = [_small_batch(seed=s) for s in (1, 2, 3)]
+
+        def drive():
+            col = _small_collection()
+            before = dict(_stats.trace_counts())
+            for args in batches:
+                col.fused_update(*args)
+            after = dict(_stats.trace_counts())
+            traced = {
+                k: after.get(k, 0) - before.get(k, 0)
+                for k in after
+                if after.get(k, 0) != before.get(k, 0)
+            }
+            return {
+                k: np.asarray(v) for k, v in col.compute().items()
+            }, traced
+
+        self.assertFalse(ra.enabled())
+        ref_out, ref_traced = drive()
+        # Enable/disable cycles bump the store epoch while OFF: the
+        # token must not carry it, so the second run traces exactly as
+        # much as the first (the fresh collection's own builds) and
+        # results are bitwise identical.
+        ra.enable()
+        ra.disable()
+        ra.clear()
+        out, traced = drive()
+        self.assertEqual(
+            traced, ref_traced, "off-mode toggling changed trace counts"
+        )
+        self.assertEqual(set(ref_out), set(out))
+        for key, val in ref_out.items():
+            np.testing.assert_array_equal(val, out[key])
+
+
+class TestCmRowChunkFlag(unittest.TestCase):
+    def test_power_of_two_validation_falls_back_silently(self):
+        from torcheval_tpu.ops import _flags as _oflags
+
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_CM_ROW_CHUNK": "1000"}
+        ):
+            self.assertEqual(_oflags.cm_row_chunk(), 4096)
+        with mock.patch.dict(
+            os.environ, {"TORCHEVAL_TPU_CM_ROW_CHUNK": "512"}
+        ):
+            self.assertEqual(_oflags.cm_row_chunk(), 512)
+
+    def test_explicit_flag_outranks_the_measured_pick(self):
+        from torcheval_tpu.metrics.functional.classification import (
+            confusion_matrix as cm,
+        )
+
+        with tempfile.TemporaryDirectory() as tmp:
+            with _layer(tmp):
+                _seed_pair("cm_row_chunk", "*", "2048", "4096")
+                self.assertEqual(cm._cm_row_chunk(), 2048)
+                with mock.patch.dict(
+                    os.environ, {"TORCHEVAL_TPU_CM_ROW_CHUNK": "8192"}
+                ):
+                    self.assertEqual(cm._cm_row_chunk(), 8192)
+
+    def test_chunking_is_bit_identical_across_chunk_sizes(self):
+        from torcheval_tpu.metrics import functional as F
+
+        p, t = _small_batch(c=4, n=64, seed=11)
+        results = []
+        for chunk in ("16", "64", "4096"):
+            with mock.patch.dict(
+                os.environ, {"TORCHEVAL_TPU_CM_ROW_CHUNK": chunk}
+            ):
+                results.append(
+                    np.asarray(
+                        F.multiclass_confusion_matrix(
+                            p, t, num_classes=4
+                        )
+                    )
+                )
+        for other in results[1:]:
+            np.testing.assert_array_equal(results[0], other)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -205,6 +205,20 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         )
         for action in ("open", "spill", "resume", "close", "drain"):
             ev.record_session(action, "rt-tenant")
+        # route_decision — the measured-cost routing layer's decide()
+        # hook (in-memory store: no cache dir is touched, and the layer
+        # is restored to off before returning).
+        from torcheval_tpu import routing_autotune as ra
+
+        ra.clear()
+        ra.enable()
+        try:
+            ra.record_measurement("megakernel", "mega", "rt-sig", 1e-3)
+            ra.record_measurement("megakernel", "fused", "rt-sig", 2e-3)
+            ra.decide("megakernel", "rt-sig", "fused")
+        finally:
+            ra.disable()
+            ra.clear()
 
     def test_every_kind_round_trips(self):
         self._generate_all_kinds()

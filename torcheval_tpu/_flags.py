@@ -33,10 +33,11 @@ for the unset case stays in ``ops/_flags.py`` where JAX is available).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 __all__ = [
     "Flag",
@@ -46,6 +47,7 @@ __all__ = [
     "FALSY",
     "get",
     "describe",
+    "overridden",
     "snapshot_non_default",
 ]
 
@@ -136,6 +138,10 @@ def _positive(n: Any) -> bool:
     return n > 0
 
 
+def _power_of_two(n: Any) -> bool:
+    return n > 0 and (n & (n - 1)) == 0
+
+
 _DECLARATIONS: Tuple[Flag, ...] = (
     Flag(
         name="DISABLE_PALLAS",
@@ -206,6 +212,48 @@ _DECLARATIONS: Tuple[Flag, ...] = (
             "back to the scatter-free XLA formulation) "
             "(``ops._flags.rank_sketch_mode``)."
         ),
+    ),
+    Flag(
+        name="AUTOTUNE",
+        kind="tribool",
+        default=None,
+        doc=(
+            "Pick ambiguous routes (megakernel on/off, wavefront "
+            "pallas/xla, sketch/sort, CM row-chunk) by MEASURED cost "
+            "from the persisted route-cost store "
+            "(``routing_autotune``): truthy → on, falsy → off, unset → "
+            "on exactly when ``TORCHEVAL_TPU_CACHE_DIR`` is configured "
+            "(the store lives next to the compile cache).  An explicit "
+            "route flag (``MEGAKERNEL``/``WAVEFRONT``/...) always "
+            "outranks the measured pick."
+        ),
+        read_at="import",
+    ),
+    Flag(
+        name="AUTOTUNE_PROBE_BUDGET",
+        kind="int",
+        default=8,
+        doc=(
+            "Maximum candidate-route races ``aot.warmup(autotune=True)`` "
+            "runs per warmup call (each race compiles and times the "
+            "top-2 routes of one ambiguous decision on real shapes); "
+            "non-positive or unparseable values fall back silently."
+        ),
+        validate=_positive,
+    ),
+    Flag(
+        name="CM_ROW_CHUNK",
+        kind="int",
+        default=4096,
+        doc=(
+            "Row-tile height for the one-hot matmul confusion-matrix "
+            "formulation (``metrics.functional.classification."
+            "confusion_matrix``): chunking bounds the live one-hot slab "
+            "at ``chunk x (num_classes + 1)`` while keeping results "
+            "bit-identical for every chunking.  Must be a power of two; "
+            "anything else falls back silently to 4096."
+        ),
+        validate=_power_of_two,
     ),
     Flag(
         name="CACHE_DIR",
@@ -443,6 +491,35 @@ def describe() -> Tuple[Dict[str, Any], ...]:
         }
         for f in _DECLARATIONS
     )
+
+
+@contextlib.contextmanager
+def overridden(name: str, raw: Optional[str]) -> Iterator[None]:
+    """Temporarily force flag ``name`` (short name) to the raw string
+    ``raw`` in the process environment (``None`` unsets it), restoring
+    the prior state on exit.
+
+    This is the ONE sanctioned way to pin a flag around a scoped
+    computation — ``aot.warmup(autotune=True)`` races candidate routes
+    under it — and it lives here because TPU013 rejects
+    ``TORCHEVAL_TPU_*`` environment writes anywhere else.  Only
+    meaningful for ``read_at="call"`` flags: import-time flags were
+    already consumed by their owning module.
+    """
+    flag = FLAGS[name]
+    env_name = flag.env_name
+    prior = os.environ.get(env_name)
+    try:
+        if raw is None:
+            os.environ.pop(env_name, None)
+        else:
+            os.environ[env_name] = raw
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop(env_name, None)
+        else:
+            os.environ[env_name] = prior
 
 
 def snapshot_non_default() -> Dict[str, Any]:

@@ -68,6 +68,7 @@ _FAULTS = "torcheval_tpu.resilience.faults"
 _QUALITY = "torcheval_tpu.monitor.quality"
 _TRACE = "torcheval_tpu.telemetry.trace"
 _FLIGHTREC = "torcheval_tpu.telemetry.flightrec"
+_AUTOTUNE = "torcheval_tpu.routing_autotune"
 
 HOOK_SPECS: Tuple[HookSpec, ...] = (
     HookSpec(
@@ -147,6 +148,17 @@ HOOK_SPECS: Tuple[HookSpec, ...] = (
         record_prefix=False,
         guard_modules=frozenset({_FLIGHTREC}),
         runtime_ns="flightrec.",
+    ),
+    HookSpec(
+        module=_AUTOTUNE,
+        # The hot-path surface of the measured-cost routing layer: the
+        # profile observer, the decision lookup, and the measurement
+        # recorder.  The cold store/race machinery (flush, preference,
+        # warmup racing) runs off the update path and is absent here.
+        names=frozenset({"observe_profile", "decide", "record_measurement"}),
+        record_prefix=False,
+        guard_modules=frozenset({_AUTOTUNE}),
+        runtime_ns="autotune.",
     ),
 )
 

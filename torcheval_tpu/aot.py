@@ -56,6 +56,7 @@ def warmup(
     max_batch: Optional[int] = None,
     sizes: Optional[Iterable[int]] = None,
     fused: Optional[bool] = None,
+    autotune: bool = False,
 ) -> Tuple[int, ...]:
     """Pre-compile every update program a ragged evaluation stream can
     reach, so the stream itself runs trace-free.
@@ -79,6 +80,18 @@ def warmup(
     :meth:`~torcheval_tpu.engine.Evaluator.warmup` — the swept shapes
     become stacked scan-block programs instead of per-batch programs
     (``fused`` does not apply there).
+
+    ``autotune=True`` additionally RACES the top-2 candidate routes for
+    each ambiguous routing decision on the real warmed shapes —
+    megakernel on/off, wavefront pallas/xla, CM row-chunk size,
+    sketch-vs-sort — and records the wall-clock winners in the persisted
+    route-cost store (:mod:`torcheval_tpu.routing_autotune`), so later
+    ``routing`` decisions pick by measured cost instead of the static
+    heuristics.  The race compiles at most ``TORCHEVAL_TPU_AUTOTUNE_
+    PROBE_BUDGET`` extra candidate programs (default 8) and skips
+    decisions the store already measured for this shape/flag/device
+    context; an explicit ``TORCHEVAL_TPU_AUTOTUNE=0`` kill-switch
+    outranks the argument and skips racing entirely.
     """
     from torcheval_tpu.engine import Evaluator
     from torcheval_tpu.metrics.collection import MetricCollection
@@ -120,6 +133,164 @@ def warmup(
     try:
         for b in sweep:
             entry(*(_tile_to(a, b) for a in arrays))
+        if autotune:
+            _race_routes(obj, entry, arrays, max(sweep), is_collection)
     finally:
         obj.load_state_dict(snapshot)
     return tuple(sweep)
+
+
+def _race_routes(obj, entry, arrays, top, is_collection) -> int:
+    """Race the top-2 candidates of each ambiguous routing decision on
+    ``obj``'s real warmed shape and persist the wall-clock outcomes as
+    ``site="race"`` rows in the route-cost store.  Returns the number of
+    candidate timings spent (0 when the store layer is explicitly off).
+
+    Candidates are forced through the public flag overrides
+    (``_flags.overridden``), so each one compiles and dispatches exactly
+    the program a user pinning that flag would get; the decided flag is
+    masked out of the stored route-token context
+    (``routing_autotune._context_token``), so the forced value never
+    makes the row unbindable at pick time.  State mutation from the race
+    calls is erased by :func:`warmup`'s snapshot restore."""
+    import time
+
+    import jax
+
+    from torcheval_tpu import _flags
+    from torcheval_tpu import routing_autotune as _autotune
+    from torcheval_tpu.ops import _flags as _oflags
+
+    if _oflags.autotune_mode() is False:
+        return 0  # the explicit kill-switch outranks the argument
+    if not _autotune.ENABLED:
+        _autotune.enable()
+
+    batch = tuple(_tile_to(a, top) for a in arrays)
+    signature = _autotune.batch_signature(batch)
+    budget = _autotune.probe_budget()
+    spent = 0
+
+    def _timed(call, stateful) -> float:
+        call()  # untimed: pays the trace + compile
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = call()
+            jax.block_until_ready((out, stateful.state_dict()))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def _race(decision, sig, candidates):
+        """candidates: [(choice, thunk, stateful), ...]."""
+        nonlocal spent
+        if spent + len(candidates) > budget:
+            return
+        pref = _autotune.preference(decision, sig)
+        if pref is not None and pref["kind"] == "measured":
+            return  # already raced for this shape/flag/device context
+        for choice, call, stateful in candidates:
+            try:
+                seconds = _timed(call, stateful)
+            except Exception:  # pragma: no cover - candidate unsupported
+                continue  # a route that cannot run never wins a race
+            if _autotune.ENABLED:
+                _autotune.record_measurement(
+                    decision, choice, sig, seconds, site="race"
+                )
+            spent += 1
+
+    def _under(flag, raw):
+        def call():
+            with _flags.overridden(flag, raw):
+                entry(*batch)
+            return None
+
+        return call
+
+    members = list(obj._metrics.values()) if is_collection else [obj]
+
+    # Megakernel on/off — only when the forced-on plan actually covers
+    # this collection (otherwise there is nothing ambiguous to race).
+    if is_collection and getattr(entry, "__name__", "") == "fused_update":
+        from torcheval_tpu.ops import _mega_plan
+
+        with _flags.overridden("MEGAKERNEL", "1"):
+            plan = _mega_plan.plan_for(
+                obj._metrics, batch, {}, obj._slices
+            )
+        if plan is not None:
+            _race(
+                "megakernel",
+                signature,
+                [
+                    ("mega", _under("MEGAKERNEL", "1"), obj),
+                    ("fused", _under("MEGAKERNEL", "0"), obj),
+                ],
+            )
+
+    # CM row-chunk size: flag default vs 2x, for the matmul slab family.
+    _CM_CLASSES = {
+        "MulticlassConfusionMatrix",
+        "BinaryConfusionMatrix",
+        "MulticlassF1Score",
+        "MulticlassPrecision",
+        "MulticlassRecall",
+    }
+    if any(type(m).__name__ in _CM_CLASSES for m in members):
+        base = _oflags.cm_row_chunk()
+        _race(
+            "cm_row_chunk",
+            "*",
+            [
+                (str(base), _under("CM_ROW_CHUNK", str(base)), obj),
+                (str(base * 2), _under("CM_ROW_CHUNK", str(base * 2)), obj),
+            ],
+        )
+
+    # Wavefront pallas vs lax.scan for the device text family.
+    if any(
+        type(m).__module__.startswith("torcheval_tpu.metrics.text")
+        for m in members
+    ):
+        _race(
+            "wavefront",
+            "*",
+            [
+                ("pallas", _under("WAVEFRONT", "1"), obj),
+                ("scan", _under("WAVEFRONT", "0"), obj),
+            ],
+        )
+
+    # Sketch vs sort: construction-time state layout, so the race runs on
+    # fresh twins (runtime picks stay advice-only — see routing_autotune).
+    if not is_collection and type(obj).__name__ in (
+        "BinaryAUROC",
+        "BinaryAUPRC",
+    ):
+        try:
+            twins = [
+                ("sketch", type(obj)(sketch=True)),
+                ("sort", type(obj)(sketch=False)),
+            ]
+        except Exception:  # pragma: no cover - exotic subclass ctor
+            twins = []
+        if twins:
+            # The sort path defers its cost to compute(), so the raced
+            # step is one update AND one compute — the real per-batch
+            # cost of a stream that reads the metric out each step.
+            def _step(t):
+                t.update(*batch)
+                return t.compute()
+
+            _race(
+                "rank_sketch",
+                signature,
+                [
+                    (choice, lambda t=twin: _step(t), twin)
+                    for choice, twin in twins
+                ],
+            )
+
+    _autotune.flush()
+    return spent

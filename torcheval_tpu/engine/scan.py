@@ -132,7 +132,8 @@ class ScanRunner:
         self._donate = bool(donate)
         self._health = bool(health)
         # Megakernel route inputs at build time; the engine rebuilds the
-        # runner when this drifts (flag/backend flip mid-lifecycle).
+        # runner when this drifts (flag/backend flip mid-lifecycle, or a
+        # new measurement bumping the routing_autotune epoch).
         self._token = _mega_plan.route_token()
         self.bounds: Tuple[Tuple[str, int], ...] = (
             _health.label_bounds(collection._metrics) if health else ()

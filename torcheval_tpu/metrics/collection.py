@@ -354,7 +354,8 @@ class MetricCollection:
         # alone, so the same plan_for call that routes inside the trace
         # also names the program here (for perfscope/trace counters) —
         # and the route token joins the rebuild condition so flag or
-        # backend flips retrace instead of reusing a stale route.
+        # backend flips — or a routing_autotune epoch bump — retrace
+        # instead of reusing a stale route.
         token = _mega_plan.route_token()
         program = (
             "mega_collection"

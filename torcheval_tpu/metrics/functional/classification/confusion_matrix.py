@@ -64,7 +64,13 @@ def _confusion_matrix_update(
 ) -> jax.Array:
     _confusion_matrix_update_input_check(input, target, num_classes)
     route = _cm_route(num_classes, input.shape[0])
-    return _confusion_matrix_update_kernel(input, target, num_classes, route)
+    return _confusion_matrix_update_kernel(
+        input,
+        target,
+        num_classes,
+        route,
+        row_chunk=_cm_row_chunk() if route == "matmul" else 0,
+    )
 
 
 def _cm_route(num_classes: int, num_samples: int) -> str:
@@ -141,24 +147,48 @@ def _matmul_cm(
     target: jax.Array,
     num_classes: int,
     mask: Optional[jax.Array] = None,
+    chunk: Optional[int] = None,
 ) -> jax.Array:
     """(C, C) counts as ONE MXU matmul of one-hot encodings: cm =
     onehot(target)ᵀ @ onehot(pred).  0/1 one-hots are exact in bf16 and
     the f32 accumulation is exact below 2^24 per cell, so the result is
     bit-identical to the scatter formulation within the dispatch
     bounds."""
-    return _onehot_cm(target, input, num_classes, mask=mask).astype(jnp.int32)
+    return _onehot_cm(
+        target, input, num_classes, mask=mask, chunk=chunk
+    ).astype(jnp.int32)
 
 
-# Row cap for one one-hot materialization.  Unchunked, the matmul route
-# builds two (n, width) bf16 one-hots — 4·n·width bytes of HBM written
-# and re-read per batch, a ~2·width re-read multiplier over the n-row
-# label vectors themselves (at width=1000 that is the full (C, C)-scale
-# re-read the route table prices).  Chunking bounds the live one-hots to
-# 2·_CM_ROW_CHUNK·width bytes (≤ ~8 MB at the 512-class matmul ceiling),
-# small enough to stay fusion/cache-resident, while the per-chunk partial
-# counts are exact f32 integers so the accumulated slab is bit-identical.
-_CM_ROW_CHUNK = 4096
+def _cm_row_chunk() -> int:
+    """Row cap for one one-hot materialization, resolved at call time.
+
+    Unchunked, the matmul route builds two (n, width) bf16 one-hots —
+    4·n·width bytes of HBM written and re-read per batch, a ~2·width
+    re-read multiplier over the n-row label vectors themselves (at
+    width=1000 that is the full (C, C)-scale re-read the route table
+    prices).  Chunking bounds the live one-hots to 2·chunk·width bytes
+    (≤ ~8 MB at the 512-class matmul ceiling at the default), small
+    enough to stay fusion/cache-resident, while the per-chunk partial
+    counts are exact f32 integers so the accumulated slab is
+    bit-identical at ANY chunking — which is what makes the knob safe
+    for the autotuner to probe.
+
+    Resolution order: the typed ``TORCHEVAL_TPU_CM_ROW_CHUNK`` flag
+    when explicitly set (an explicit flag always outranks a
+    measurement), else the measured-cost layer's pick when it is on
+    and has raced chunk sizes, else the flag default (4096)."""
+    from torcheval_tpu import _flags
+    from torcheval_tpu import routing_autotune as _autotune
+    from torcheval_tpu.ops import _flags as _oflags
+
+    chunk = _oflags.cm_row_chunk()
+    if _autotune.ENABLED:
+        if _flags.FLAGS["CM_ROW_CHUNK"].raw() is None:
+            try:
+                chunk = int(_autotune.decide("cm_row_chunk", "*", str(chunk)))
+            except ValueError:  # pragma: no cover - corrupt store row
+                pass
+    return chunk
 
 
 def _onehot_cm_block(
@@ -184,26 +214,42 @@ def _onehot_cm_block(
 
 
 def _onehot_cm(
-    t: jax.Array, p: jax.Array, width: int, mask: Optional[jax.Array] = None
+    t: jax.Array,
+    p: jax.Array,
+    width: int,
+    mask: Optional[jax.Array] = None,
+    chunk: Optional[int] = None,
 ) -> jax.Array:
-    """:func:`_onehot_cm_block` with the one-hot tile capped at
-    ``_CM_ROW_CHUNK`` rows: longer batches fold chunk-partial slabs with
-    exact f32 integer adds (bit-identical, any chunking).  Pad rows carry
-    the label ``width`` — outside ``arange(width)``, so their one-hot row
-    is all zeros and they drop without needing a mask."""
+    """:func:`_onehot_cm_block` with the one-hot tile capped at ``chunk``
+    rows: longer batches fold chunk-partial slabs with exact f32 integer
+    adds (bit-identical, any chunking).  Pad rows carry the label
+    ``width`` — outside ``arange(width)``, so their one-hot row is all
+    zeros and they drop without needing a mask.
+
+    When no ``chunk`` is threaded in, the trace-time fallback reads the
+    typed flag ONLY (never the measured-cost store — no host store
+    access from inside a trace); entry points that want the autotuned
+    pick resolve :func:`_cm_row_chunk` outside jit and pass it down as
+    a static argument."""
+    if chunk:
+        row_chunk = chunk
+    else:
+        from torcheval_tpu.ops import _flags as _oflags
+
+        row_chunk = _oflags.cm_row_chunk()
     n = t.shape[0]
-    if n <= _CM_ROW_CHUNK:
+    if n <= row_chunk:
         return _onehot_cm_block(t, p, width, mask)
-    chunks = -(-n // _CM_ROW_CHUNK)
-    pad = chunks * _CM_ROW_CHUNK - n
+    chunks = -(-n // row_chunk)
+    pad = chunks * row_chunk - n
     if pad:
         t = jnp.concatenate([t, jnp.full(pad, width, t.dtype)])
         p = jnp.concatenate([p, jnp.full(pad, width, p.dtype)])
         if mask is not None:
             mask = jnp.concatenate([mask, jnp.zeros(pad, mask.dtype)])
-    tc = t.reshape(chunks, _CM_ROW_CHUNK)
-    pc = p.reshape(chunks, _CM_ROW_CHUNK)
-    mc = None if mask is None else mask.reshape(chunks, _CM_ROW_CHUNK)
+    tc = t.reshape(chunks, row_chunk)
+    pc = p.reshape(chunks, row_chunk)
+    mc = None if mask is None else mask.reshape(chunks, row_chunk)
 
     def body(i, acc):
         m_i = None if mc is None else mc[i]
@@ -225,13 +271,14 @@ def _wrap_labels(x: jax.Array, num_classes: int) -> jax.Array:
     return jnp.where(x < 0, num_classes, x)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "route"))
+@partial(jax.jit, static_argnames=("num_classes", "route", "row_chunk"))
 def _confusion_matrix_update_kernel(
     input: jax.Array,
     target: jax.Array,
     num_classes: int,
     route: str = "scatter",
     mask: Optional[jax.Array] = None,
+    row_chunk: int = 0,
 ) -> jax.Array:
     if input.ndim == 2:
         input = jnp.argmax(input, axis=1)
@@ -242,7 +289,11 @@ def _confusion_matrix_update_kernel(
         # bit-identical and adding a 0 is a no-op, so downgrade in-trace.
         route = "scatter"
     if route == "matmul":
-        return _matmul_cm(input, target, num_classes, mask=mask)
+        # row_chunk static (0 = read the flag at trace time) so a flag
+        # flip retraces this program instead of reusing a stale chunk.
+        return _matmul_cm(
+            input, target, num_classes, mask=mask, chunk=row_chunk or None
+        )
     if route == "pallas":
         from torcheval_tpu.ops.pallas_cm import confusion_slab
 
@@ -280,6 +331,7 @@ def _class_counts(
     route: str,
     interpret: bool = False,
     mask: Optional[jax.Array] = None,
+    row_chunk: int = 0,
 ):
     """The per-class ``(num_tp, num_label, num_prediction)`` trio shared
     by F1 / precision / recall, through the same three-way route as the
@@ -319,7 +371,7 @@ def _class_counts(
             t, p, num_classes=num_classes, interpret=interpret
         )
     else:  # matmul over the (C+1)-wide sentinel window
-        slab = _onehot_cm(t, p, num_classes + 1, mask=mask)
+        slab = _onehot_cm(t, p, num_classes + 1, mask=mask, chunk=row_chunk or None)
     num_label = jnp.sum(slab[:c, :], axis=1).astype(jnp.int32)
     num_prediction = jnp.sum(slab[:, :c], axis=0).astype(jnp.int32)
     num_tp = jnp.diagonal(slab[:c, :c]).astype(jnp.int32)
@@ -341,13 +393,14 @@ def _binary_confusion_matrix_validate(input: jax.Array, target: jax.Array) -> No
             )
 
 
-@partial(jax.jit, static_argnames=("threshold", "use_matmul"))
+@partial(jax.jit, static_argnames=("threshold", "use_matmul", "row_chunk"))
 def _binary_confusion_matrix_update_kernel(
     input: jax.Array,
     target: jax.Array,
     threshold: float,
     use_matmul: bool = False,
     mask: Optional[jax.Array] = None,
+    row_chunk: int = 0,
 ) -> jax.Array:
     pred = jnp.where(input < threshold, 0, 1)
     return _confusion_matrix_update_kernel(
@@ -356,6 +409,7 @@ def _binary_confusion_matrix_update_kernel(
         2,
         "matmul" if use_matmul else "scatter",
         mask=mask,
+        row_chunk=row_chunk,
     )
 
 
@@ -365,7 +419,11 @@ def _binary_confusion_matrix_update(
     _binary_confusion_matrix_validate(input, target)
     use_matmul = _use_matmul_cm(2, input.shape[0])
     return _binary_confusion_matrix_update_kernel(
-        input, target, threshold, use_matmul
+        input,
+        target,
+        threshold,
+        use_matmul,
+        row_chunk=_cm_row_chunk() if use_matmul else 0,
     )
 
 

@@ -112,6 +112,35 @@ def rank_sketch_mode() -> "bool | None":
     return _flags.get("RANK_SKETCH")
 
 
+def autotune_mode() -> "bool | None":
+    """Tri-state read of ``TORCHEVAL_TPU_AUTOTUNE`` — the measured-cost
+    routing layer (:mod:`torcheval_tpu.routing_autotune`).
+
+    ``True`` forces the layer on (decisions consult the persisted
+    route-cost store), ``False`` disables it entirely (the static
+    heuristics decide, exactly as before the layer existed), and
+    ``None`` (unset) means *auto*: on exactly when
+    ``TORCHEVAL_TPU_CACHE_DIR`` is configured, because the store lives
+    next to the persistent compile cache and is useless without a
+    directory to persist into.  Resolved once at
+    ``routing_autotune`` import (the module caches ``ENABLED``); use
+    its ``enable()``/``disable()`` to flip later.
+    """
+    return _flags.get("AUTOTUNE")
+
+
+def cm_row_chunk() -> int:
+    """Call-time read of ``TORCHEVAL_TPU_CM_ROW_CHUNK`` — the row-tile
+    height for the one-hot matmul confusion-matrix formulation
+    (validated power-of-two, default 4096; invalid values fall back
+    silently).  Chunking never changes results — the row fold is exact
+    in f32 for counts — so this knob is purely a working-set/perf
+    trade the autotuner may probe.  The hot paths fold the value into
+    their program-cache keys (``ops._mega_plan.route_token``) so a
+    change retraces instead of reusing a stale-chunk program."""
+    return _flags.get("CM_ROW_CHUNK")
+
+
 def rank_sketch_enabled() -> bool:
     """Construction-time resolution of :func:`rank_sketch_mode` for a
     metric built with ``sketch=None``: only an explicit truthy flag

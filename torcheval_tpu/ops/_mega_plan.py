@@ -102,22 +102,33 @@ class MegaPlan:
 
 def route_token() -> Tuple[Any, ...]:
     """The call-time inputs the Pallas route decisions (megakernel and
-    wavefront) depend on.
+    wavefront) depend on, plus the confusion-matrix row-chunk knob.
 
     The hot paths fold this into their program-cache keys (fused rebuild
     condition, the engine's scan-runner check, serve's bundle key) so a
-    flag or backend flip retraces instead of reusing a stale route."""
+    flag or backend flip retraces instead of reusing a stale route.
+    When the measured-cost layer is on, the store epoch rides along:
+    a new measurement bumps it, so a changed verdict rebuilds programs
+    through these SAME keys — the autotuner needs no rebuild fork of
+    its own.  Off, the token is exactly the static tuple (the
+    dispatch-count-identity contract)."""
     try:
         backend = jax.default_backend()
     except Exception:  # pragma: no cover - backend init failure
         backend = "unknown"
-    return (
+    token = (
         _oflags.megakernel_mode(),
         _oflags.wavefront_mode(),
         _oflags.rank_sketch_mode(),
         _oflags.pallas_disabled(),
+        _oflags.cm_row_chunk(),
         backend,
     )
+    from torcheval_tpu import routing_autotune as _autotune
+
+    if _autotune.ENABLED:
+        return token + (_autotune.EPOCH,)
+    return token
 
 
 def _shape_of(x) -> Optional[Tuple[int, ...]]:
@@ -387,7 +398,26 @@ def plan_for(
         if not supported:
             return None
     else:  # auto: TPU with at least two supported members
-        if len(supported) < 2 or jax.default_backend() != "tpu":
+        heuristic_declines = (
+            len(supported) < 2 or jax.default_backend() != "tpu"
+        )
+        from torcheval_tpu import routing_autotune as _autotune
+
+        if _autotune.ENABLED:
+            # The measured-cost layer may overrule the static auto
+            # heuristic in EITHER direction — but only with a ranked
+            # measurement for this exact shape bucket (decide() falls
+            # back to the heuristic's pick otherwise), and never past
+            # feasibility (no supported members still means no plan).
+            if not supported:
+                return None
+            default = "fused" if heuristic_declines else "mega"
+            picked = _autotune.decide(
+                "megakernel", _autotune.batch_signature(args), default
+            )
+            if picked != "mega":
+                return None
+        elif heuristic_declines:
             return None
 
     a = 1 + (slices or 0)

@@ -113,8 +113,18 @@ def wavefront_route(concrete: bool) -> str:
     mode = _oflags.wavefront_mode()
     if mode is False:
         return fallback
-    if mode is None and not has_pallas():
-        return fallback
+    if mode is None:
+        from torcheval_tpu import routing_autotune as _autotune
+
+        static = "pallas" if has_pallas() else fallback
+        if _autotune.ENABLED:
+            # Auto mode consults the measured-cost store (the decision
+            # is shape-less: one verdict per device/flag context).  A
+            # race that measured the fallback faster overrules the
+            # static on-TPU default; unmeasured keeps it.
+            picked = _autotune.decide("wavefront", "*", static)
+            return picked if picked in ("pallas", fallback) else static
+        return static
     return "pallas"
 
 

@@ -102,6 +102,33 @@ def reset_route_warnings() -> None:
     _warned_callsites.clear()
 
 
+def _measured_note(decision: str, sig_args=None) -> str:
+    """The ``measured`` verdict sentence for :func:`explain_route`: names
+    the cost-store numbers that decided ``decision`` (empty when the
+    measured-cost layer is off).  ``sig_args`` are the positional batch
+    args for shape-keyed decisions; ``None`` for shape-less ones."""
+    from torcheval_tpu import routing_autotune as _autotune
+
+    if not _autotune.ENABLED:
+        return ""
+    signature = (
+        "*" if sig_args is None else _autotune.batch_signature(sig_args)
+    )
+    pref = _autotune.preference(decision, signature)
+    if pref is None:
+        return (
+            "  Measured verdict: no binding cost-store rows for this "
+            "shape/device yet — the static heuristic above decided "
+            "(aot.warmup(autotune=True) races the candidates)."
+        )
+    return (
+        f"  Measured verdict: {pref['choice']} at "
+        f"{pref['seconds'] * 1e3:.3f} ms vs {pref['alt_choice']} at "
+        f"{pref['alt_seconds'] * 1e3:.3f} ms ({pref['kind']}, "
+        f"{pref['site']} site) — these numbers decided the route."
+    )
+
+
 def hot_path_stats() -> dict:
     """Process-wide update hot-path instrumentation in one dict:
 
@@ -249,11 +276,11 @@ def explain_route(fn, *args, **kwargs) -> str:
             )
         route = _cm_route(num_classes, inp.shape[0])
         from torcheval_tpu.metrics.functional.classification.confusion_matrix import (
-            _CM_ROW_CHUNK,
+            _cm_row_chunk,
         )
 
         crossover = (
-            f" One-hot tiles are capped at {_CM_ROW_CHUNK} rows, so the "
+            f" One-hot tiles are capped at {_cm_row_chunk()} rows, so the "
             f"matmul's 2·C re-read multiplier applies to a bounded "
             f"working set, not the whole batch; past C=512 (n·C² MACs "
             f"overtaking the ~7 ms flat scatter, measured C=1000 at "
@@ -262,7 +289,7 @@ def explain_route(fn, *args, **kwargs) -> str:
         return (
             f"{name}: confusion-matrix slab via {_route_detail[route]} — "
             f"decided from shapes/backend only, so it is identical under "
-            f"a caller's jit." + crossover
+            f"a caller's jit." + crossover + _measured_note("cm_row_chunk")
         )
 
     if fn in (
@@ -402,7 +429,7 @@ def explain_route(fn, *args, **kwargs) -> str:
             return (
                 f"{name}: wavefront Pallas route OFF ({reason}); edit "
                 f"distances come from {detail} — integer-exact against "
-                "the kernel."
+                "the kernel." + _measured_note("wavefront")
             )
         flagged = (
             "FORCED ON (TORCHEVAL_TPU_WAVEFRONT truthy; the interpreter "
@@ -432,6 +459,7 @@ def explain_route(fn, *args, **kwargs) -> str:
             f"{name}: wavefront Pallas route {flagged} — each DP "
             "anti-diagonal is data-parallel across the whole pair bucket "
             f"(ops/pallas_wavefront.py).{geometry}"
+            + _measured_note("wavefront")
         )
 
     parallel_answer = _explain_parallel_route(fn, name, args, kwargs)
@@ -533,18 +561,21 @@ def _explain_parallel_route(fn, name, args, kwargs):
                 f"Megakernel route ENGAGED: one Pallas HBM pass (lane "
                 f"tile {plan.tile}) scatters into {len(plan.members)} "
                 f"member state group(s) [{sup}]{un}."
+                + _measured_note("megakernel", tuple(args))
             )
         if mode is None and jax.default_backend() != "tpu":
             return (
                 "Megakernel route off: auto mode engages only on TPU "
                 "backends (TORCHEVAL_TPU_MEGAKERNEL=1 forces the "
                 "interpret path elsewhere)."
+                + _measured_note("megakernel", tuple(args))
             )
         return (
             "Megakernel route off for this call: unsupported call shape "
             "or not enough supported members (auto needs >=2, forced "
             "needs >=1; ops/_mega_plan.py lists the supported "
             "accumulation shapes)."
+            + _measured_note("megakernel", tuple(args))
         )
 
     def _rank_sketch_verdict(owner) -> str:

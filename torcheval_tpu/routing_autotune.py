@@ -1,0 +1,610 @@
+"""Self-tuning routing: the persisted measured-cost store that closes
+the perfscope→routing loop (ROADMAP item 5).
+
+PRs 14-16 tripled the route space — megakernel vs per-member fused,
+wavefront pallas/xla, rank-sketch vs exact sort, each crossed with
+bucketing and donation — but ``routing.py`` still ranked routes with
+static heuristics and hand-tuned constants while perfscope was already
+measuring the ground truth per compiled program.  This module is the
+missing feedback edge:
+
+* a **route-cost store** — one JSON file under
+  ``TORCHEVAL_TPU_CACHE_DIR`` next to JAX's persistent compile cache,
+  written with the ``resilience/checkpoint.py`` discipline (tmp + flush
+  + fsync + atomic rename, a SHA-256 sidecar validating the payload,
+  corrupt files quarantined with a ``.corrupt`` suffix instead of
+  poisoning startup);
+* two **feeds**: :func:`observe_profile` turns the
+  ``ProgramProfileEvent`` figures perfscope emits at its pricing sites
+  into roofline-priced cost rows, and :func:`record_measurement`
+  stores the wall-clock numbers ``aot.warmup(autotune=True)`` measures
+  when it races the top-2 candidate routes of an ambiguous decision on
+  real shapes;
+* one **consumer**: :func:`decide`, called from the static deciders'
+  auto branches (``ops._mega_plan.plan_for``,
+  ``ops.pallas_wavefront.wavefront_route``, the confusion-matrix
+  row-chunk resolution) — a dict lookup on the hot path, a full store
+  scan only when the decision cache is cold for the current epoch.
+
+Staleness can never bind: every row is stamped with the library
+version, the process ``device_kind``, and the full
+:func:`~torcheval_tpu.ops._mega_plan.route_token` *context* (with the
+decided element itself masked, since a race forces that element while
+measuring it).  A row from another version is dropped at load; a row
+whose context or device no longer matches simply never wins a lookup,
+and ``aot.warmup(autotune=True)`` re-probes the drifted decision inside
+its ``TORCHEVAL_TPU_AUTOTUNE_PROBE_BUDGET``.
+
+The whole layer is one-branch zero-cost-off: every call site guards on
+``if _autotune.ENABLED:`` (the tpulint TPU001 hook contract), and with
+``TORCHEVAL_TPU_AUTOTUNE`` falsy the static heuristics decide exactly
+as before this module existed — bit-identical results, identical
+dispatch counts.  Unset means *auto*: on exactly when a cache dir is
+configured, because the store is useless without somewhere to persist.
+
+Decisions are advisory where flipping them would change state layout:
+the ``rank_sketch`` rows feed ``explain_route``/``explain_perf``
+verdicts and the warmup race, but construction-time sketch selection
+still requires the explicit flag — a measured pick must never change
+what a fleet of workers can merge.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+from torcheval_tpu import _flags
+from torcheval_tpu.ops import _flags as _oflags
+
+__all__ = [
+    "ENABLED",
+    "EPOCH",
+    "enable",
+    "disable",
+    "enabled",
+    "batch_signature",
+    "observe_profile",
+    "record_measurement",
+    "preference",
+    "decide",
+    "measured_crossover",
+    "store_path",
+    "store_rows",
+    "flush",
+    "clear",
+    "probe_budget",
+]
+
+# Decisions this layer knows how to rank, with their candidate choices.
+# ``cm_row_chunk`` choices are stringified powers of two; the rest are
+# route names matching what the static deciders would pick.
+DECISIONS: Tuple[str, ...] = (
+    "megakernel",
+    "wavefront",
+    "rank_sketch",
+    "cm_row_chunk",
+)
+
+# Which element of the route-token *context* each decision controls —
+# masked in the row stamp, because a race forces that element while
+# measuring it (an unmasked stamp would never bind under auto mode).
+_TOKEN_INDEX = {
+    "megakernel": 0,
+    "wavefront": 1,
+    "rank_sketch": 2,
+    "cm_row_chunk": 4,
+}
+_MASK = "*"
+
+# Pricing sites, most trustworthy first: a race is wall clock on the
+# real entry, the collection site prices one whole-batch program, the
+# scan site prices a per-block program.  preference() only ever
+# compares two choices measured at the SAME site — race seconds and
+# roofline-priced seconds are different magnitudes.
+_SITE_RANK = ("race", "collection", "scan")
+
+_STORE_BASENAME = "torcheval_tpu_route_costs.json"
+_LOCK = threading.RLock()
+
+# name -> row dict; None until the first load.  The epoch counts store
+# mutations: route_token() folds it into the hot paths' program-cache
+# keys while ENABLED, so a new measurement rebuilds programs through
+# the existing rebuild conditions — no fourth fork.
+_STORE: Optional[Dict[str, Dict[str, Any]]] = None
+_DIRTY = False
+EPOCH = 0
+
+# (decision, signature) -> (epoch, choice, row-or-None): the hot-path
+# decision cache.  Entries from an older epoch are recomputed; the
+# RouteDecisionEvent for a decision is emitted once per recompute.
+_DECISION_CACHE: Dict[Tuple[str, str], Tuple[int, Optional[str], Any]] = {}
+
+
+def _resolve_enabled() -> bool:
+    mode = _oflags.autotune_mode()
+    if mode is not None:
+        return bool(mode)
+    return bool(_flags.get("CACHE_DIR"))
+
+
+ENABLED = _resolve_enabled()
+
+
+def enable() -> None:
+    """Turn the measured-cost layer on for this process (the runtime
+    twin of ``TORCHEVAL_TPU_AUTOTUNE=1``)."""
+    global ENABLED, EPOCH
+    with _LOCK:
+        ENABLED = True
+        EPOCH += 1
+        _DECISION_CACHE.clear()
+
+
+def disable() -> None:
+    """Turn the layer off: the static heuristics decide again, and the
+    route token stops carrying the store epoch."""
+    global ENABLED
+    with _LOCK:
+        ENABLED = False
+        _DECISION_CACHE.clear()
+
+
+def enabled() -> bool:
+    with _LOCK:
+        return ENABLED
+
+
+def probe_budget() -> int:
+    """How many candidate races one ``aot.warmup(autotune=True)`` call
+    may run (``TORCHEVAL_TPU_AUTOTUNE_PROBE_BUDGET``, default 8)."""
+    return _flags.get("AUTOTUNE_PROBE_BUDGET")
+
+
+def _library_version() -> str:
+    from torcheval_tpu.version import __version__
+
+    return __version__
+
+
+def _device_kind() -> str:
+    from torcheval_tpu.tools import roofline
+
+    return roofline.current_device_kind()
+
+
+# ---------------------------------------------------------------- store I/O
+def store_path() -> Optional[str]:
+    """Where the cost store persists: ``<TORCHEVAL_TPU_CACHE_DIR>/
+    torcheval_tpu_route_costs.json`` (next to the compile cache), or
+    ``None`` when no cache dir is configured — the store then lives in
+    memory only and dies with the process."""
+    cache_dir = _flags.get("CACHE_DIR")
+    if not cache_dir:
+        return None
+    return os.path.join(cache_dir, _STORE_BASENAME)
+
+
+def _fsync_write(path: str, data: bytes) -> None:
+    """tmp-file + flush + fsync + atomic rename into ``path`` — the
+    ``resilience/checkpoint.py`` discipline."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.rename(tmp, path)
+
+
+def _quarantine(path: str) -> None:
+    for p in (path, path + ".sha256"):
+        if os.path.exists(p):
+            try:
+                os.rename(p, p + ".corrupt")
+            except OSError:  # pragma: no cover - concurrent cleanup
+                pass
+
+
+def _load_store() -> Dict[str, Dict[str, Any]]:
+    """Read the persisted store, validating the SHA-256 sidecar before
+    parsing; a torn or tampered file is quarantined (``*.corrupt``)
+    and an empty store returned — a bad write costs measurements, never
+    startup.  Rows stamped by another library version are dropped here
+    so stale measurements cannot bind after an upgrade."""
+    path = store_path()
+    if path is None or not os.path.exists(path):
+        return {}
+    try:
+        with open(path, "rb") as fh:
+            payload = fh.read()
+        with open(path + ".sha256", "r", encoding="utf-8") as fh:
+            expected = fh.read().strip()
+        if hashlib.sha256(payload).hexdigest() != expected:
+            _quarantine(path)
+            return {}
+        doc = json.loads(payload.decode("utf-8"))
+        rows = doc.get("rows", {})
+        if not isinstance(rows, dict):
+            _quarantine(path)
+            return {}
+    except (OSError, ValueError, UnicodeDecodeError):
+        _quarantine(path)
+        return {}
+    version = _library_version()
+    return {
+        key: row
+        for key, row in rows.items()
+        if isinstance(row, dict) and row.get("version") == version
+    }
+
+
+def _store() -> Dict[str, Dict[str, Any]]:
+    global _STORE
+    if _STORE is None:
+        _STORE = _load_store()
+    return _STORE
+
+
+def flush() -> Optional[str]:
+    """Persist the store now (atomic write + sidecar), returning the
+    path written or ``None`` when nothing to do (no cache dir, or no
+    mutation since the last flush)."""
+    global _DIRTY
+    with _LOCK:
+        path = store_path()
+        if path is None or not _DIRTY or _STORE is None:
+            return None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        payload = json.dumps(
+            {"version": _library_version(), "rows": _STORE},
+            sort_keys=True,
+            indent=1,
+        ).encode("utf-8")
+        _fsync_write(path, payload)
+        _fsync_write(
+            path + ".sha256",
+            (hashlib.sha256(payload).hexdigest() + "\n").encode("utf-8"),
+        )
+        _DIRTY = False
+        return path
+
+
+def clear() -> None:
+    """Drop the in-memory store and decision cache (tests; does not
+    touch the persisted file)."""
+    global _STORE, _DIRTY, EPOCH
+    with _LOCK:
+        _STORE = None
+        _DIRTY = False
+        EPOCH += 1
+        _DECISION_CACHE.clear()
+
+
+def store_rows() -> List[Dict[str, Any]]:
+    """A copy of every live row (loaded + recorded this process)."""
+    with _LOCK:
+        return [dict(row) for row in _store().values()]
+
+
+# ------------------------------------------------------------- row stamping
+def _context_token(decision: str) -> List[str]:
+    """The route-token context a measurement is valid under, with the
+    decided element masked (a race forces that element while measuring
+    it) and the trailing autotune epoch dropped (the epoch counts store
+    mutations — stamping it would invalidate every row on every
+    write)."""
+    from torcheval_tpu.ops import _mega_plan
+
+    token = list(_mega_plan.route_token())[:6]
+    idx = _TOKEN_INDEX.get(decision)
+    if idx is not None and idx < len(token):
+        token[idx] = _MASK
+    return [str(t) for t in token]
+
+
+def batch_signature(args: Any) -> str:
+    """A stable 16-hex digest of the positional batch's array shapes
+    and dtypes — the store's shape-bucket key.  Pure attribute walk
+    (no JAX import) over nested tuples/lists/dicts; non-array leaves
+    contribute their type name."""
+    leaves: List[str] = []
+
+    def _walk(x: Any) -> None:
+        if isinstance(x, (tuple, list)):
+            for item in x:
+                _walk(item)
+            return
+        if isinstance(x, Mapping):
+            for key in sorted(x, key=str):
+                leaves.append(str(key))
+                _walk(x[key])
+            return
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None:
+            leaves.append(f"{tuple(shape)}:{dtype}")
+        else:
+            leaves.append(type(x).__name__)
+
+    _walk(args)
+    digest = hashlib.sha256("|".join(leaves).encode("utf-8")).hexdigest()
+    return digest[:16]
+
+
+def _row_key(
+    decision: str, signature: str, site: str, choice: str, device: str
+) -> str:
+    return f"{decision}|{signature}|{site}|{choice}|{device}"
+
+
+def _put_row(
+    *,
+    decision: str,
+    choice: str,
+    signature: str,
+    site: str,
+    kind: str,
+    seconds: float,
+    nbytes: float = 0.0,
+    flops: float = 0.0,
+) -> None:
+    global _DIRTY, EPOCH
+    device = _device_kind()
+    row = {
+        "decision": decision,
+        "choice": choice,
+        "signature": signature,
+        "site": site,
+        "kind": kind,
+        "seconds": float(seconds),
+        "bytes": float(nbytes),
+        "flops": float(flops),
+        "device_kind": device,
+        "token": _context_token(decision),
+        "version": _library_version(),
+        "updated": time.time(),
+    }
+    with _LOCK:
+        _store()[_row_key(decision, signature, site, choice, device)] = row
+        _DIRTY = True
+        EPOCH += 1
+        _DECISION_CACHE.clear()
+
+
+def record_measurement(
+    decision: str,
+    choice: str,
+    signature: str,
+    seconds: float,
+    *,
+    site: str = "race",
+    nbytes: float = 0.0,
+    flops: float = 0.0,
+) -> None:
+    """Store one measured cost row — the ``aot.warmup(autotune=True)``
+    feed (``site="race"``, wall-clock seconds for one steady-state
+    entry call under the forced candidate route)."""
+    if decision not in DECISIONS:
+        raise ValueError(
+            f"unknown decision {decision!r}; expected one of {DECISIONS}"
+        )
+    _put_row(
+        decision=decision,
+        choice=choice,
+        signature=signature,
+        site=site,
+        kind="measured",
+        seconds=seconds,
+        nbytes=nbytes,
+        flops=flops,
+    )
+
+
+# The program names perfscope prices, mapped onto (decision, choice,
+# site).  The scan-site programs are per-block: their batch_args carry
+# a leading block axis that observe_profile strips so scan rows share
+# the per-step signature ``plan_for`` computes.
+_PROGRAM_ROWS = {
+    "mega_collection": ("megakernel", "mega", "collection"),
+    "fused_collection": ("megakernel", "fused", "collection"),
+    "mega_scan": ("megakernel", "mega", "scan"),
+    "engine_scan": ("megakernel", "fused", "scan"),
+}
+
+
+def _strip_leading_axis(args: Any) -> Any:
+    if isinstance(args, (tuple, list)):
+        return tuple(_strip_leading_axis(x) for x in args)
+    if isinstance(args, Mapping):
+        return {k: _strip_leading_axis(v) for k, v in args.items()}
+    shape = getattr(args, "shape", None)
+    if shape is not None and len(shape) >= 1:
+
+        class _Aval:
+            __slots__ = ("shape", "dtype")
+
+            def __init__(self, shape, dtype):
+                self.shape = shape
+                self.dtype = dtype
+
+        return _Aval(tuple(shape)[1:], getattr(args, "dtype", None))
+    return args
+
+
+def observe_profile(
+    program: str, batch_args: Any, profile: Mapping[str, Any]
+) -> None:
+    """The perfscope feed: turn one ``ProgramProfileEvent``'s priced
+    figures into a cost row, with seconds estimated from the roofline
+    (``max(bytes/HBM-peak, flops/FLOP-peak)`` for this device).  Only
+    programs whose name maps onto a known decision contribute; the
+    rest are ignored for free."""
+    mapped = _PROGRAM_ROWS.get(program)
+    if mapped is None:
+        return
+    decision, choice, site = mapped
+    args = batch_args[0] if isinstance(batch_args, tuple) and batch_args else batch_args
+    if site == "scan":
+        args = _strip_leading_axis(args)
+    signature = batch_signature(args)
+    from torcheval_tpu.tools import roofline
+
+    peaks = roofline.device_peaks()
+    nbytes = float(profile.get("bytes_accessed", 0.0) or 0.0)
+    flops = float(profile.get("flops", 0.0) or 0.0)
+    seconds = max(
+        nbytes / (peaks["hbm_gbps"] * 1e9),
+        flops / peaks["flops"],
+    )
+    if seconds <= 0.0:
+        return
+    _put_row(
+        decision=decision,
+        choice=choice,
+        signature=signature,
+        site=site,
+        kind="priced",
+        seconds=seconds,
+        nbytes=nbytes,
+        flops=flops,
+    )
+
+
+# ---------------------------------------------------------------- decisions
+def _binding_rows(decision: str, signature: str) -> List[Dict[str, Any]]:
+    """Rows that may decide (decision, signature) in THIS process:
+    same device kind, same masked route-token context, same library
+    version (version is enforced at load; re-checked here for rows
+    recorded before a runtime flag flip)."""
+    device = _device_kind()
+    context = _context_token(decision)
+    out = []
+    for row in _store().values():
+        if row.get("decision") != decision:
+            continue
+        if row.get("signature") != signature:
+            continue
+        if row.get("device_kind") != device:
+            continue
+        if row.get("token") != context:
+            continue
+        out.append(row)
+    return out
+
+
+def preference(decision: str, signature: str) -> Optional[Dict[str, Any]]:
+    """The measured verdict for one (decision, shape-bucket): the
+    cheapest choice at the most trustworthy site where AT LEAST TWO
+    choices have rows, or ``None`` when the store cannot rank the
+    decision (unmeasured, single-sided, or context drift).
+
+    The returned dict carries ``choice``, ``seconds``, ``alt_choice``,
+    ``alt_seconds``, ``site``, and ``kind`` — the numbers
+    ``explain_route`` names in its ``measured`` verdict."""
+    with _LOCK:
+        rows = _binding_rows(decision, signature)
+    if not rows:
+        return None
+    for site in _SITE_RANK:
+        by_choice: Dict[str, Dict[str, Any]] = {}
+        for row in rows:
+            if row.get("site") != site:
+                continue
+            prior = by_choice.get(row["choice"])
+            if prior is None or row["seconds"] < prior["seconds"]:
+                by_choice[row["choice"]] = row
+        if len(by_choice) < 2:
+            continue
+        ranked = sorted(by_choice.values(), key=lambda r: r["seconds"])
+        best, runner_up = ranked[0], ranked[1]
+        return {
+            "choice": best["choice"],
+            "seconds": best["seconds"],
+            "alt_choice": runner_up["choice"],
+            "alt_seconds": runner_up["seconds"],
+            "site": site,
+            "kind": best["kind"],
+        }
+    return None
+
+
+def decide(decision: str, signature: str, default: str) -> str:
+    """The hot-path consumer: the measured pick for (decision,
+    signature), or ``default`` (the static heuristic's choice) when the
+    store cannot rank it.  A dict lookup when the decision cache is
+    warm for the current epoch; the full preference scan runs once per
+    (decision, signature, epoch), and the ``RouteDecisionEvent``
+    telemetry is emitted on exactly those recomputes."""
+    key = (decision, signature)
+    with _LOCK:
+        cached = _DECISION_CACHE.get(key)
+        if cached is not None and cached[0] == EPOCH:
+            return cached[1] if cached[1] is not None else default
+        pref = preference(decision, signature)
+        choice = pref["choice"] if pref is not None else None
+        _DECISION_CACHE[key] = (EPOCH, choice, pref)
+    _emit_decision(decision, signature, pref, default)
+    return choice if choice is not None else default
+
+
+def _emit_decision(
+    decision: str,
+    signature: str,
+    pref: Optional[Dict[str, Any]],
+    default: str,
+) -> None:
+    from torcheval_tpu.telemetry import events as _events
+
+    if not _events.ENABLED:
+        return
+    if pref is None:
+        _events.record_route_decision(
+            decision=decision,
+            route=default,
+            verdict="unmeasured",
+            signature=signature,
+            seconds=0.0,
+            alt_seconds=0.0,
+            source="static",
+        )
+        return
+    _events.record_route_decision(
+        decision=decision,
+        route=pref["choice"],
+        verdict="measured",
+        signature=signature,
+        seconds=pref["seconds"],
+        alt_seconds=pref["alt_seconds"],
+        source=f"{pref['kind']}-{pref['site']}",
+    )
+
+
+def measured_crossover(decision: str) -> Optional[Dict[str, Any]]:
+    """The best measured comparison for ``decision`` across ALL shape
+    buckets — ``explain_perf()``'s hook for preferring measured
+    crossover numbers over the static estimate (the item-4 sketch-vs-
+    sort follow-up).  Returns the preference dict of the bucket with
+    the largest measured margin, plus its ``signature``, or ``None``
+    when fewer than two choices have binding rows anywhere."""
+    with _LOCK:
+        signatures = {
+            row["signature"]
+            for row in _store().values()
+            if row.get("decision") == decision
+        }
+        best: Optional[Dict[str, Any]] = None
+        for signature in sorted(signatures):
+            pref = preference(decision, signature)
+            if pref is None:
+                continue
+            pref = dict(pref, signature=signature)
+            if best is None or (
+                pref["alt_seconds"] - pref["seconds"]
+                > best["alt_seconds"] - best["seconds"]
+            ):
+                best = pref
+        return best

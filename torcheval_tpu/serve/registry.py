@@ -368,6 +368,7 @@ class SessionRegistry:
         current health flag), built on first use and LRU-bounded."""
         health = _health.ENABLED
         # The megakernel route token joins the key so a flag/backend flip
+        # — or a routing_autotune epoch bump after a new measurement —
         # rebuilds the shared program instead of reusing a stale route.
         key = (group.signature, group.width, health, _mega_plan.route_token())
 

@@ -299,6 +299,23 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
         result["alerts"] = {
             rule: dict(entry) for rule, entry in agg["alerts"].items()
         }
+    if agg["route_decisions"]:
+        # List of dicts (NOT tuple-keyed), like quality below, so the
+        # section survives fleet-snapshot key stringification.
+        result["route_decisions"] = sorted(
+            (
+                {
+                    "decision": decision,
+                    "route": route,
+                    "verdict": verdict,
+                    **entry,
+                }
+                for (decision, route, verdict), entry in agg[
+                    "route_decisions"
+                ].items()
+            ),
+            key=lambda e: (e["decision"], e["route"], e["verdict"]),
+        )
     if agg["quality"]:
         # Structured as a list of dicts (NOT tuple-keyed) so the section
         # survives aggregate._plain's key stringification in fleet

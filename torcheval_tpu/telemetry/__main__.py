@@ -9,9 +9,11 @@ prints the perfscope roofline table, ``--trace <trace_id>`` renders the
 span tree(s) containing that trace id as text (exit 1 when the id is
 not in the dump), ``--flight <bundle_dir>`` validates and renders a
 flight-recorder bundle (no report path needed; exit 2 on a corrupt
-bundle), and ``--alerts`` renders the fired SLO rules and exits nonzero
+bundle), ``--alerts`` renders the fired SLO rules and exits nonzero
 when any fired (CI gate: pipe an eval run's dump through ``--alerts``
-to fail the job on an SLO breach).  Dumps written by newer library
+to fail the job on an SLO breach), and ``--routes`` renders the
+measured-cost routing decision table (route, measured cost, verdict,
+source) the autotune layer emitted (:doc:`autotune <../autotune>`).  Dumps written by newer library
 versions load fine — unknown event kinds are skipped with a counted
 warning (``export.read_jsonl``).
 """
@@ -56,6 +58,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="render fired SLO alert rules; exit 1 when any fired "
         "(for CI consumption)",
+    )
+    parser.add_argument(
+        "--routes",
+        action="store_true",
+        help="render the measured-cost routing decision table "
+        "(route, measured cost, verdict, source) from the dump",
     )
     parser.add_argument(
         "--trace",
@@ -127,6 +135,37 @@ def main(argv: Optional[List[str]] = None) -> int:
         ev.enable(capacity=len(loaded))
     for event in loaded:
         ev.emit(event)
+
+    if args.routes:
+        decisions = ev.aggregates()["route_decisions"]
+        if not decisions:
+            print("no route decisions recorded")
+            return 0
+        print(f"{len(decisions)} route decision row(s):")
+        header = (
+            f"  {'decision':<14} {'route':<10} {'verdict':<11} "
+            f"{'signature':<17} {'count':>5} {'cost_ms':>10} "
+            f"{'alt_ms':>10}  source"
+        )
+        print(header)
+        for (decision, route, verdict) in sorted(decisions):
+            entry = decisions[(decision, route, verdict)]
+            cost = (
+                f"{entry['seconds'] * 1e3:.4f}"
+                if verdict == "measured"
+                else "-"
+            )
+            alt = (
+                f"{entry['alt_seconds'] * 1e3:.4f}"
+                if verdict == "measured"
+                else "-"
+            )
+            print(
+                f"  {decision:<14} {route:<10} {verdict:<11} "
+                f"{entry['signature'] or '-':<17} {entry['count']:>5} "
+                f"{cost:>10} {alt:>10}  {entry['source']}"
+            )
+        return 0
 
     if args.alerts:
         alerts = ev.aggregates()["alerts"]

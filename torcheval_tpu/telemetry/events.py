@@ -315,6 +315,31 @@ class AlertEvent(Event):
 
 
 @dataclass
+class RouteDecisionEvent(Event):
+    """One routing decision resolved by the measured-cost layer
+    (:mod:`torcheval_tpu.routing_autotune`): ``decision`` names the
+    ambiguous choice (``megakernel`` / ``wavefront`` / ``rank_sketch``
+    / ``cm_row_chunk``), ``route`` what was picked for the
+    ``signature`` shape bucket, and ``verdict`` whether the pick was
+    ``measured`` (the cost store ranked both candidates — ``seconds``
+    vs ``alt_seconds`` are the numbers that decided it) or
+    ``unmeasured`` (the static heuristic's default stood).  ``source``
+    names the winning row's provenance (``measured-race``,
+    ``priced-collection``, ``priced-scan``, or ``static``).  Emitted
+    once per (decision, signature, store-epoch) — re-lookups hit the
+    decision cache silently."""
+
+    kind: str = field(init=False, default="route_decision")
+    decision: str = ""
+    route: str = ""
+    verdict: str = "unmeasured"  # "measured" | "unmeasured"
+    signature: str = ""
+    seconds: float = 0.0
+    alt_seconds: float = 0.0
+    source: str = "static"
+
+
+@dataclass
 class QualityEvent(Event):
     """One model-quality reading from the live monitor
     (:mod:`torcheval_tpu.monitor`): member ``metric``'s computed value
@@ -419,6 +444,7 @@ KIND_TO_CLASS: Dict[str, type] = {
     "checkpoint": CheckpointEvent,
     "program_profile": ProgramProfileEvent,
     "alert": AlertEvent,
+    "route_decision": RouteDecisionEvent,
     "quality": QualityEvent,
     "admission": AdmissionEvent,
     "quarantine": QuarantineEvent,
@@ -478,6 +504,12 @@ def _zero_aggregates() -> Dict[str, Any]:
         # SLO alerting: rule -> {"count": fires, "value": last observed,
         # "threshold": rule bound, "message": last rendered text}.
         "alerts": {},
+        # Measured-cost routing (torcheval_tpu/routing_autotune):
+        # (decision, route, verdict) -> {"count": resolutions,
+        # "seconds": winner cost last observed, "alt_seconds": runner-up
+        # cost, "source": winning row provenance, "signature": last
+        # shape bucket resolved}.
+        "route_decisions": {},
         # Live model-quality readings (torcheval_tpu/monitor):
         # (metric, slice_label, window) -> {"value": last, "count":
         # emissions, "min"/"max": extrema observed since clear, "step":
@@ -623,6 +655,9 @@ def aggregates() -> Dict[str, Any]:
             },
             "perf": {k: dict(v) for k, v in _agg["perf"].items()},
             "alerts": {k: dict(v) for k, v in _agg["alerts"].items()},
+            "route_decisions": {
+                k: dict(v) for k, v in _agg["route_decisions"].items()
+            },
             "quality": {k: dict(v) for k, v in _agg["quality"].items()},
             "serve": {
                 "admitted": _agg["serve"]["admitted"],
@@ -809,6 +844,22 @@ def _fold(event: Event) -> None:
         entry["value"] = event.value
         entry["threshold"] = event.threshold
         entry["message"] = event.message
+    elif isinstance(event, RouteDecisionEvent):
+        entry = _agg["route_decisions"].setdefault(
+            (event.decision, event.route, event.verdict),
+            {
+                "count": 0,
+                "seconds": 0.0,
+                "alt_seconds": 0.0,
+                "source": "static",
+                "signature": "",
+            },
+        )
+        entry["count"] += 1
+        entry["seconds"] = event.seconds
+        entry["alt_seconds"] = event.alt_seconds
+        entry["source"] = event.source
+        entry["signature"] = event.signature
     elif isinstance(event, QualityEvent):
         entry = _agg["quality"].setdefault(
             (event.metric, event.slice_label, event.window),
@@ -1020,6 +1071,28 @@ def record_alert(
             value=float(value),
             threshold=float(threshold),
             message=message,
+        )
+    )
+
+
+def record_route_decision(
+    decision: str,
+    route: str,
+    verdict: str,
+    signature: str = "",
+    seconds: float = 0.0,
+    alt_seconds: float = 0.0,
+    source: str = "static",
+) -> None:
+    emit(
+        RouteDecisionEvent(
+            decision=decision,
+            route=route,
+            verdict=verdict,
+            signature=signature,
+            seconds=float(seconds),
+            alt_seconds=float(alt_seconds),
+            source=source,
         )
     )
 

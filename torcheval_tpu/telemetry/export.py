@@ -747,6 +747,21 @@ def prometheus_text() -> str:
             f"{agg['alerts'][rule]['count']}"
         )
 
+    if agg["route_decisions"]:
+        out.append(
+            f"# HELP {_PREFIX}_route_decisions_total Routing decisions "
+            "resolved by the measured-cost layer (routing_autotune), by "
+            "picked route and verdict."
+        )
+        out.append(f"# TYPE {_PREFIX}_route_decisions_total counter")
+        for decision, route, verdict in sorted(agg["route_decisions"]):
+            entry = agg["route_decisions"][(decision, route, verdict)]
+            out.append(
+                f"{_PREFIX}_route_decisions_total"
+                f"{_labels(route=f'{decision}:{route}', verdict=verdict)} "
+                f"{entry['count']}"
+            )
+
     srv = agg["serve"]
     if (
         srv["admitted"]
@@ -1010,6 +1025,22 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"    {rule}: fired {entry['count']}x "
                 f"(last value {entry['value']:.4g} vs threshold "
                 f"{entry['threshold']:.4g})\n"
+            )
+    route_decisions = report.get("route_decisions", [])
+    if route_decisions:
+        buf.write("  route decisions (measured-cost layer):\n")
+        for entry in route_decisions:
+            numbers = ""
+            if entry["verdict"] == "measured":
+                numbers = (
+                    f" ({entry['seconds'] * 1e3:.3f} ms vs "
+                    f"{entry['alt_seconds'] * 1e3:.3f} ms, "
+                    f"{entry['source']})"
+                )
+            buf.write(
+                f"    {entry['decision']}→{entry['route']} "
+                f"[{entry['verdict']}] sig {entry['signature'] or '-'} "
+                f"x{entry['count']}{numbers}\n"
             )
     srv = report.get("serve", {})
     if srv:

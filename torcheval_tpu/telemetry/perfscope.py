@@ -249,6 +249,13 @@ def profile_program(
     except Exception:
         return None
     _events.record_program_profile(**profile)
+    from torcheval_tpu import routing_autotune as _autotune
+
+    if _autotune.ENABLED:
+        # Feed the measured-cost store: the priced figures become
+        # roofline-estimated cost rows the routing layer ranks routes
+        # by (see routing_autotune.observe_profile).
+        _autotune.observe_profile(program, batch_args, profile)
     if donate and not aliased:
         # Donation was requested but the compiled program carries no
         # input-output aliasing — the state-HBM-traffic halving the
@@ -373,6 +380,31 @@ def explain_perf(
     census = sketch_census()
     if census:
         result["rank_sketch"] = census
+    from torcheval_tpu import routing_autotune as _autotune
+
+    if _autotune.ENABLED:
+        # Measured crossover numbers trump the static estimates: when
+        # the cost store has priced/raced BOTH choices of a decision
+        # on this device, the stamp names the winner and the actual
+        # seconds instead of the documented model figures.
+        for decision in ("rank_sketch", "megakernel", "cm_row_chunk"):
+            crossover = _autotune.measured_crossover(decision)
+            if crossover is None:
+                continue
+            stamp = {
+                "measured_choice": crossover["choice"],
+                "measured_seconds": crossover["seconds"],
+                "alt_choice": crossover["alt_choice"],
+                "alt_seconds": crossover["alt_seconds"],
+                "site": crossover["site"],
+                "signature": crossover["signature"],
+            }
+            if decision == "rank_sketch" and census:
+                result["rank_sketch"]["measured_crossover"] = stamp
+            else:
+                result.setdefault("measured_crossovers", {})[
+                    decision
+                ] = stamp
     if as_text:
         from torcheval_tpu.telemetry.export import format_explain_perf
 
