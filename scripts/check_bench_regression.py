@@ -74,6 +74,14 @@ EXTRA_BARS = (
     ("serve_multitenant_64", "shed_rate", 0.05),
     ("serve_multitenant_64", "p99_admit_latency_ms", 2000.0),
     ("serve_multitenant_64", "programs_compiled", 1.0),
+    # Tenant-metering claims, absolute: the ledger's hook sites cost
+    # <=5% over the cold-hook leg on the identical skewed schedule,
+    # and the per-tenant device-seconds split conserves the shared
+    # programs' banked totals to 1e-6 relative (the attribution is a
+    # row-weighted partition, so anything above float noise means the
+    # split lost or invented time).
+    ("serve_tenant_metering_64", "metering_overhead_pct", 5.0),
+    ("serve_tenant_metering_64", "attribution_conservation_err", 1e-6),
 )
 
 # (metric row, extras key, min required value) — absolute floors, for

@@ -91,6 +91,25 @@ _FLIGHTREC_HOOKS = ("observe", "trigger")
 # chunk resolution) pays one branch on ``routing_autotune.ENABLED``.
 _AUTOTUNE_HOOKS = ("observe_profile", "decide", "record_measurement")
 
+# Per-tenant serve metering (``torcheval_tpu/serve/metering.py``):
+# disabled, no ledger write, no payload sizing, and no program-id
+# interning may run — every serve hook site (submit/shed/reject, the
+# dispatch charge, quarantine, spill/resume, the perfscope price feed)
+# pays one branch on ``metering.ENABLED``.  The serve drive below
+# constructs an EvalService, whose auto-on resolver would flip the
+# unset tribool to on — the explicit ``disable()`` in check() outranks
+# it (that is the point of the forced mode).
+_METERING_HOOKS = (
+    "record_submit",
+    "record_dispatch",
+    "record_quarantine",
+    "record_session",
+    "record_program_price",
+    "payload_nbytes",
+    "batch_rows",
+    "program_id",
+)
+
 # Live quality monitor (``torcheval_tpu/monitor/quality.py``): the
 # engine's snapshot hook gates ``publish`` on ``telemetry.events.ENABLED``
 # — with the bus off, no quality event is built and no per-slice
@@ -323,6 +342,7 @@ def check(verbose: bool = True) -> List[str]:
     from torcheval_tpu import telemetry
     from torcheval_tpu.monitor import quality as mq
     from torcheval_tpu.resilience import faults as fl
+    from torcheval_tpu.serve import metering as mt
     from torcheval_tpu.telemetry import events as ev
     from torcheval_tpu.telemetry import flightrec as fr
     from torcheval_tpu.telemetry import health as hm
@@ -335,12 +355,16 @@ def check(verbose: bool = True) -> List[str]:
     trace_was_enabled = tr.enabled()
     flightrec_was_enabled = fr.enabled()
     autotune_was_enabled = at.enabled()
+    # (enabled, forced) pair: restoring _forced puts the auto-on
+    # resolver back exactly as found (None = auto), not pinned off.
+    metering_state = (mt.enabled(), mt._forced)
     telemetry.disable()
     hm.disable()
     ps.disable()
     tr.disable()
     fr.disable()
     at.disable()
+    mt.disable()
     counter: Dict[str, int] = {}
     names = _hook_names(ev)
     try:
@@ -417,6 +441,16 @@ def check(verbose: bool = True) -> List[str]:
                         ),
                     )
                 )
+            for name in _METERING_HOOKS:
+                stack.enter_context(
+                    mock.patch.object(
+                        mt,
+                        name,
+                        _counting(
+                            getattr(mt, name), counter, f"metering.{name}"
+                        ),
+                    )
+                )
             _drive_hot_path()
     finally:
         if was_enabled:
@@ -431,6 +465,8 @@ def check(verbose: bool = True) -> List[str]:
             fr.enable()
         if autotune_was_enabled:
             at.enable()
+        with mt._LOCK:
+            mt.ENABLED, mt._forced = metering_state
     fired = {k: v for k, v in counter.items() if v}
     if fired:
         raise AssertionError(
@@ -447,6 +483,7 @@ def check(verbose: bool = True) -> List[str]:
             + len(_FLIGHTREC_HOOKS)
             + len(_MONITOR_HOOKS)
             + len(_AUTOTUNE_HOOKS)
+            + len(_METERING_HOOKS)
         )
         print(
             f"ok: {total} "
@@ -461,6 +498,7 @@ def check(verbose: bool = True) -> List[str]:
         + [f"flightrec.{n}" for n in _FLIGHTREC_HOOKS]
         + [f"monitor.{n}" for n in _MONITOR_HOOKS]
         + [f"autotune.{n}" for n in _AUTOTUNE_HOOKS]
+        + [f"metering.{n}" for n in _METERING_HOOKS]
     )
 
 
@@ -482,6 +520,7 @@ def static_coverage_check(verbose: bool = True) -> List[str]:
     wrapped.update(f"flightrec.{n}" for n in _FLIGHTREC_HOOKS)
     wrapped.update(f"monitor.{n}" for n in _MONITOR_HOOKS)
     wrapped.update(f"autotune.{n}" for n in _AUTOTUNE_HOOKS)
+    wrapped.update(f"metering.{n}" for n in _METERING_HOOKS)
     discovered = hook_entry_points()
     missing = sorted(set(discovered) - wrapped)
     if missing:

@@ -1,0 +1,277 @@
+"""Tenant-scope metering (torcheval_tpu/serve/metering.py): the
+always-on per-tenant ledger behind the serve plane's hook sites.
+
+The claims under test: the flag-off path is bit-identical to an
+unmetered run with a cold ledger; the tribool auto-enables exactly when
+an ``EvalService`` is constructed (and a forced override outranks it);
+per-tenant device-seconds attribution **conserves** the shared
+programs' banked totals to 1e-6 relative — across mixed-signature
+groups, overflow groups, and tenants quarantined mid-stream (whose
+pre-quarantine ledger survives); and every surface (``report()``, the
+``--tenants`` CLI table, Prometheus, ``rebalance_hints``) renders the
+same ledger rows."""
+
+import time
+import unittest
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu import telemetry
+from torcheval_tpu.metrics import MulticlassAccuracy, MulticlassF1Score
+from torcheval_tpu.serve import (
+    AdmissionController,
+    EvalService,
+    Rejected,
+    rebalance_hints,
+)
+import torcheval_tpu.serve.metering as metering
+from torcheval_tpu.telemetry import events as ev
+from torcheval_tpu.telemetry import export, tenants
+
+pytestmark = pytest.mark.serve
+
+_C = 5
+
+
+def _suite():
+    return {
+        "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+        "f1": MulticlassF1Score(num_classes=_C, average="macro"),
+    }
+
+
+def _small_suite():
+    # A different metric set => different group signature => a second
+    # compiled program with its own attribution row.
+    return {"acc": MulticlassAccuracy(num_classes=_C, average="macro")}
+
+
+def _batches(n, seed, rows=17):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.random((rows, _C), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, _C, rows).astype(np.int32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _solo(batches):
+    metrics = _suite()
+    for scores, target in batches:
+        for m in metrics.values():
+            m.update(scores, target)
+    return {name: m.compute() for name, m in metrics.items()}
+
+
+def _assert_bitwise(test, got, want):
+    test.assertEqual(set(got), set(want))
+    for name in want:
+        test.assertEqual(
+            np.asarray(got[name]).tobytes(),
+            np.asarray(want[name]).tobytes(),
+            f"{name} differs bitwise",
+        )
+
+
+def _conservation_err():
+    tenant_total = sum(
+        r["device_seconds"] for r in metering.ledger_rows()
+    )
+    program_total = sum(p["seconds"] for p in metering.program_rows())
+    return abs(tenant_total - program_total) / max(program_total, 1e-12)
+
+
+class MeteringIsolation(unittest.TestCase):
+    """A pristine, auto-mode ledger before and after each test."""
+
+    def setUp(self):
+        metering.reset()
+        telemetry.disable()
+        telemetry.clear()
+
+    def tearDown(self):
+        metering.reset()
+        telemetry.disable()
+        telemetry.clear()
+
+
+class TestEnablement(MeteringIsolation):
+    def test_auto_on_when_service_constructed(self):
+        # The unset tribool stays off until the serve plane is in use.
+        self.assertFalse(metering.enabled())
+        EvalService(group_width=2)
+        self.assertTrue(metering.enabled())
+
+    def test_forced_off_outranks_the_auto_on(self):
+        metering.disable()
+        EvalService(group_width=2)
+        self.assertFalse(metering.enabled())
+
+    def test_flag_off_is_bit_identical_with_a_cold_ledger(self):
+        metering.disable()
+        svc = EvalService(group_width=4)
+        streams = {t: _batches(4, seed=i) for i, t in enumerate("abc")}
+        for tenant in streams:
+            svc.open(tenant, _suite())
+        for step in range(4):
+            for tenant, batches in streams.items():
+                svc.submit(tenant, *batches[step])
+        svc.pump()
+        for tenant, batches in streams.items():
+            _assert_bitwise(self, svc.results(tenant), _solo(batches))
+        # Every hook site took the one cold branch: nothing banked.
+        self.assertFalse(metering.has_data())
+        self.assertEqual(metering.ledger_rows(), [])
+        self.assertEqual(metering.program_rows(), [])
+
+
+class TestAttributionConservation(MeteringIsolation):
+    def test_conserves_across_mixed_and_overflow_groups(self):
+        # group_width=2 with three same-signature tenants forces an
+        # overflow group (two groups share ONE program id), and the
+        # small-suite tenants add a second program entirely.
+        svc = EvalService(group_width=2)
+        big = {t: _batches(3, seed=i) for i, t in enumerate("abc")}
+        small = {
+            t: _batches(2, seed=10 + i, rows=9)
+            for i, t in enumerate(("x", "y"))
+        }
+        for tenant in big:
+            svc.open(tenant, _suite())
+        for tenant in small:
+            svc.open(tenant, _small_suite())
+        for step in range(3):
+            for tenant, batches in big.items():
+                svc.submit(tenant, *batches[step])
+            for tenant, batches in small.items():
+                if step < len(batches):
+                    svc.submit(tenant, *batches[step])
+            svc.pump()
+        programs = metering.program_rows()
+        self.assertGreaterEqual(len(programs), 2)  # mixed signatures
+        self.assertGreaterEqual(svc.stats()["groups"], 3)  # overflow
+        self.assertLessEqual(_conservation_err(), 1e-6)
+        # The split itself: each program's by_tenant rows sum to the
+        # rows it banked, so no time is orphaned or double-counted.
+        for entry in programs:
+            self.assertEqual(
+                sum(entry["by_tenant"].values()), entry["rows"]
+            )
+        rows = {r["tenant"]: r for r in metering.ledger_rows()}
+        self.assertEqual(set(rows), set(big) | set(small))
+        for tenant, batches in big.items():
+            self.assertEqual(rows[tenant]["dispatched"], len(batches))
+            self.assertEqual(
+                rows[tenant]["rows"], sum(len(b[1]) for b in batches)
+            )
+
+    def test_quarantined_tenant_keeps_pre_quarantine_ledger(self):
+        svc = EvalService(group_width=4)
+        good = _batches(3, seed=21)
+        bad = _batches(2, seed=22)
+        svc.open("good", _suite())
+        svc.open("bad", _suite())
+        for step in range(2):
+            svc.submit("good", *good[step])
+            svc.submit("bad", *bad[step])
+        svc.pump()
+        # A structurally-broken batch raises at dispatch => quarantine.
+        scores, _ = _batches(1, seed=23, rows=17)[0]
+        svc.submit("bad", scores, jnp.zeros((5,), dtype=jnp.int32))
+        svc.pump()
+        svc.submit("good", *good[2])
+        svc.pump()
+        self.assertIsInstance(svc.submit("bad", *bad[0]), Rejected)
+        rows = {r["tenant"]: r for r in metering.ledger_rows()}
+        # The poison tenant's pre-quarantine dispatches survive for the
+        # bill, alongside the quarantine mark.
+        self.assertEqual(rows["bad"]["dispatched"], 2)
+        self.assertEqual(rows["bad"]["quarantined"], 1)
+        self.assertGreater(rows["bad"]["device_seconds"], 0.0)
+        self.assertEqual(rows["good"]["dispatched"], 3)
+        self.assertLessEqual(_conservation_err(), 1e-6)
+
+    def test_shed_and_rejected_are_billed_to_the_right_tenant(self):
+        svc = EvalService(
+            group_width=2,
+            admission=AdmissionController(
+                global_capacity=8, per_tenant_capacity=1
+            ),
+        )
+        svc.open("a", _suite())
+        batch = _batches(1, seed=30)[0]
+        svc.submit("a", *batch)
+        svc.submit("a", *batch)  # over per-tenant capacity
+        svc.submit("ghost", *batch)  # unknown tenant => rejected
+        svc.pump()
+        rows = {r["tenant"]: r for r in metering.ledger_rows()}
+        self.assertEqual(rows["a"]["shed"], 1)
+        self.assertEqual(rows["a"]["dispatched"], 1)
+        self.assertEqual(rows["ghost"]["rejected"], 1)
+        self.assertEqual(rows["ghost"]["dispatched"], 0)
+
+
+class TestSurfacesAgreement(MeteringIsolation):
+    def test_every_surface_renders_the_same_ledger(self):
+        telemetry.enable()
+        svc = EvalService(group_width=2)
+        streams = {t: _batches(3, seed=i) for i, t in enumerate("ab")}
+        for tenant in streams:
+            svc.open(tenant, _suite())
+        for step in range(3):
+            for tenant, batches in streams.items():
+                svc.submit(tenant, *batches[step])
+            svc.pump()
+        live = metering.ledger_rows()
+        self.assertEqual(len(live), 2)
+
+        report = telemetry.report()
+        self.assertIn("tenants", report)
+        self.assertEqual(report["tenants"]["rows"], live)
+
+        table = tenants.format_table(tenants.collect_rows(ev.aggregates()))
+        for row in live:
+            self.assertIn(row["tenant"], table)
+
+        text = export.prometheus_text()
+        for row in live:
+            self.assertIn(
+                "torcheval_tpu_tenant_dispatched_total"
+                f'{{tenant="{row["tenant"]}"}} {row["dispatched"]}',
+                text,
+            )
+
+        hints = rebalance_hints()
+        self.assertEqual(
+            {s.tenant for s in hints.tenants},
+            {r["tenant"] for r in live},
+        )
+        self.assertAlmostEqual(
+            hints.device_seconds_total,
+            sum(r["device_seconds"] for r in live),
+            places=12,
+        )
+
+    def test_wait_is_stamped_on_dispatched_and_shed_admissions(self):
+        telemetry.enable()
+        svc = EvalService(group_width=2)
+        svc.open("a", _suite())
+        batch = _batches(1, seed=40)[0]
+        svc.submit("a", *batch)
+        svc.pump()
+        svc.submit("a", *batch, deadline_s=0.001)
+        time.sleep(0.01)
+        svc.pump()  # expires at pop
+        admissions = [e for e in ev.events() if e.kind == "admission"]
+        outcomes = {e.outcome: e for e in admissions}
+        self.assertIn("dispatched", outcomes)
+        self.assertIn("shed", outcomes)
+        self.assertGreaterEqual(outcomes["dispatched"].wait_s, 0.0)
+        # The shed item waited out its whole deadline before the pop
+        # dropped it — a zero here means the stamp is missing.
+        self.assertGreater(outcomes["shed"].wait_s, 0.0)

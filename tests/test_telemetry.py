@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from torcheval_tpu import telemetry
 from torcheval_tpu.telemetry import events as ev
+from torcheval_tpu.telemetry import export
 
 pytestmark = pytest.mark.telemetry
 
@@ -205,6 +206,27 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         )
         for action in ("open", "spill", "resume", "close", "drain"):
             ev.record_session(action, "rt-tenant")
+        # tenant_sample — the serve metering ledger's publish hook.
+        import torcheval_tpu.serve.metering as metering
+
+        metering.reset()
+        metering.enable()
+        try:
+            metering.record_submit(
+                "rt-tenant", "admitted", rows=4, nbytes=64, queue_depth=1
+            )
+            metering.record_dispatch(
+                "rt-tenant",
+                "serve_group#0",
+                rows=4,
+                seconds=1e-3,
+                wait_s=1e-4,
+                e2e_s=2e-3,
+                queue_depth=0,
+            )
+            metering.publish()
+        finally:
+            metering.reset()
         # route_decision — the measured-cost routing layer's decide()
         # hook (in-memory store: no cache dir is touched, and the layer
         # is restored to off before returning).
@@ -426,6 +448,140 @@ class TestQualityStream(TelemetryIsolation):
         ev.record_quality("acc", "", "decayed", float("nan"))
         text = telemetry.prometheus_text()  # must not raise on NaN
         self.assertIn("nan", text)
+
+
+class TestTenantStream(TelemetryIsolation):
+    """The tenant observability satellites: Prometheus label hygiene
+    for tenant ids (which are user-chosen strings, not code), the
+    cardinality cap's ``__other__`` fold, and the forward-compatible
+    JSONL round trip for TenantSampleEvent (replace-latest fold)."""
+
+    def setUp(self):
+        super().setUp()
+        import torcheval_tpu.serve.metering as metering
+
+        # A cold, auto-mode ledger so collect_rows takes the folded
+        # TenantSampleEvent path under test, not the live ledger.
+        metering.reset()
+        self._metering = metering
+
+    def tearDown(self):
+        self._metering.reset()
+        super().tearDown()
+
+    def test_tenant_label_hygiene_and_escaping(self):
+        telemetry.enable()
+        # Control characters collapse to _ (tenant_label) BEFORE the
+        # exporter's escaping; quote and backslash survive, escaped.
+        nasty = 'te"na\\nt\x07nl\n'
+        ev.record_tenant_sample(
+            nasty, dispatched=3, rows=51, device_seconds=0.25
+        )
+        text = telemetry.prometheus_text()
+        self.assertIn(
+            "torcheval_tpu_tenant_dispatched_total"
+            '{tenant="te\\"na\\\\nt_nl_"} 3',
+            text,
+        )
+        from torcheval_tpu.telemetry import tenants
+
+        self.assertEqual(tenants.tenant_label("\x00\x01"), "__")
+        self.assertEqual(tenants.tenant_label(""), "_")
+
+    def test_cardinality_cap_folds_the_tail_into_other(self):
+        telemetry.enable()
+        from torcheval_tpu.telemetry import tenants
+
+        extra = 8
+        n = tenants.TENANT_SERIES_CAP + extra
+        for i in range(n):
+            ev.record_tenant_sample(
+                f"t{i:03d}",
+                admitted=2,
+                shed=1,
+                dispatched=1,
+                rows=10,
+                device_seconds=float(n - i),  # strict hot->cold order
+                wait_p99_s=0.001 * (i + 1),
+            )
+        text = telemetry.prometheus_text()
+        lines = [
+            l
+            for l in text.splitlines()
+            if l.startswith("torcheval_tpu_tenant_dispatched_total{")
+        ]
+        # Cardinality is cap + 1 no matter how many tenants arrived.
+        self.assertEqual(len(lines), tenants.TENANT_SERIES_CAP + 1)
+        # The tail folds into one __other__ row: counters summed,
+        # quantile gauges keep the max (the coldest tenants here carry
+        # the LARGEST p99s, so the fold must not average them away).
+        self.assertIn(
+            'torcheval_tpu_tenant_dispatched_total{tenant="__other__"}'
+            f" {extra}",
+            text,
+        )
+        self.assertIn(
+            "torcheval_tpu_tenant_wait_seconds"
+            '{tenant="__other__",quantile="0.99"} '
+            + export._fmt(0.001 * n),
+            text,
+        )
+        self.assertIn(
+            f"torcheval_tpu_tenant_series_folded {extra}", text
+        )
+        # The hottest tenant kept its own series.
+        self.assertIn(
+            'torcheval_tpu_tenant_dispatched_total{tenant="t000"} 1',
+            text,
+        )
+
+    def test_tenant_sample_jsonl_round_trip_replace_latest(self):
+        telemetry.enable()
+        ev.record_tenant_sample(
+            "acme",
+            submits=5,
+            admitted=4,
+            shed=1,
+            dispatched=4,
+            rows=68,
+            payload_bytes=1024,
+            queue_depth=2,
+            shed_rate=0.2,
+            wait_p50_s=0.001,
+            wait_p99_s=0.004,
+            e2e_p50_s=0.01,
+            e2e_p99_s=0.02,
+            device_seconds=0.5,
+            dominant_program="serve_group#0",
+            dominant_share=0.75,
+        )
+        # A later cumulative sample for the same tenant supersedes it.
+        ev.record_tenant_sample(
+            "acme", submits=9, admitted=8, dispatched=8, rows=136,
+            device_seconds=1.25,
+        )
+        buf = io.StringIO()
+        telemetry.export_jsonl(buf)
+        buf.seek(0)
+        back = telemetry.read_jsonl(buf)
+        self.assertEqual(back, ev.events())
+        samples = [e for e in back if e.kind == "tenant_sample"]
+        self.assertEqual(len(samples), 2)
+        self.assertEqual(samples[0].dominant_program, "serve_group#0")
+        self.assertEqual(samples[0].wait_p99_s, 0.004)
+        # Replay the dump into a cleared bus (the CLI path): the fold
+        # keeps only the LATEST sample per tenant, so the rebuilt
+        # ledger is the final one, not a double-counted sum.
+        ev.clear()
+        for event in back:
+            ev.emit(event)
+        from torcheval_tpu.telemetry import tenants
+
+        rows = tenants.collect_rows(ev.aggregates())
+        self.assertEqual(len(rows), 1)
+        self.assertEqual(rows[0]["tenant"], "acme")
+        self.assertEqual(rows[0]["dispatched"], 8)
+        self.assertEqual(rows[0]["device_seconds"], 1.25)
 
 
 class TestRingBuffer(TelemetryIsolation):

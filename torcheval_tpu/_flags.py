@@ -440,6 +440,20 @@ _DECLARATIONS: Tuple[Flag, ...] = (
         ),
     ),
     Flag(
+        name="TENANT_METERING",
+        kind="tribool",
+        default=None,
+        doc=(
+            "Per-tenant serve-plane metering: the device-time cost "
+            "ledger behind ``report()['tenants']``, the "
+            "``torcheval_tpu_tenant_*`` Prometheus families, and "
+            "``serve.rebalance_hints()`` (``serve/metering.py``): "
+            "truthy → on, falsy → off, unset → auto-on when an "
+            "``EvalService`` is constructed "
+            "(``serve.metering.activate_for_serve``)."
+        ),
+    ),
+    Flag(
         name="KV_TIMEOUT_MS",
         kind="int",
         default=600_000,

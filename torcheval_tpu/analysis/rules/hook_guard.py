@@ -69,6 +69,7 @@ _QUALITY = "torcheval_tpu.monitor.quality"
 _TRACE = "torcheval_tpu.telemetry.trace"
 _FLIGHTREC = "torcheval_tpu.telemetry.flightrec"
 _AUTOTUNE = "torcheval_tpu.routing_autotune"
+_METERING = "torcheval_tpu.serve.metering"
 
 HOOK_SPECS: Tuple[HookSpec, ...] = (
     HookSpec(
@@ -159,6 +160,23 @@ HOOK_SPECS: Tuple[HookSpec, ...] = (
         record_prefix=False,
         guard_modules=frozenset({_AUTOTUNE}),
         runtime_ns="autotune.",
+    ),
+    HookSpec(
+        module=_METERING,
+        # The per-tenant serve ledger's hot-path surface: the record_*
+        # hooks plus the payload/row sizers the hook sites call to build
+        # their arguments.  The snapshot half (ledger_rows, publish,
+        # rebalance_hints) runs at report time, off the hot path.
+        names=frozenset(
+            {
+                "payload_nbytes",
+                "batch_rows",
+                "program_id",
+            }
+        ),
+        record_prefix=True,
+        guard_modules=frozenset({_METERING}),
+        runtime_ns="metering.",
     ),
 )
 

@@ -9,17 +9,27 @@ queues with typed shed outcomes
 (:mod:`~torcheval_tpu.serve.admission`), and a poison tenant is
 quarantined — rolled back, purged, reported — without perturbing its
 neighbours (:mod:`~torcheval_tpu.serve.service`).  Idle sessions spill
-to checkpoints and resume transparently.
+to checkpoints and resume transparently.  When the per-tenant ledger is
+on (:mod:`~torcheval_tpu.serve.metering`), :func:`rebalance_hints`
+reads it back as typed placement signals — queue depth, shed rate,
+spill churn, attributed device-seconds — plus a noisy-neighbour
+verdict.
 
 See ``docs/source/serve.rst`` for the operating model and runbooks.
 """
 
+from torcheval_tpu.serve import metering
 from torcheval_tpu.serve.admission import (
     POLICIES,
     Admitted,
     AdmissionController,
     Rejected,
     Shed,
+)
+from torcheval_tpu.serve.metering import (
+    RebalanceHints,
+    TenantSignal,
+    rebalance_hints,
 )
 from torcheval_tpu.serve.registry import (
     DEFAULT_GROUP_WIDTH,
@@ -36,10 +46,14 @@ __all__ = [
     "DEFAULT_GROUP_WIDTH",
     "EvalService",
     "POLICIES",
+    "RebalanceHints",
     "Rejected",
     "Session",
     "SessionRegistry",
     "Shed",
     "TenantGroup",
+    "TenantSignal",
+    "metering",
+    "rebalance_hints",
     "signature_of",
 ]

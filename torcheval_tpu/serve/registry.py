@@ -42,8 +42,10 @@ from torcheval_tpu._stats import bump_trace
 from torcheval_tpu.metrics import MetricCollection
 from torcheval_tpu.metrics.metric import Metric
 from torcheval_tpu.ops import _mega_plan
+import torcheval_tpu.serve.metering as _metering
 from torcheval_tpu.parallel._compile_cache import LruCache
 from torcheval_tpu.telemetry import health as _health
+from torcheval_tpu.telemetry import perfscope as _perfscope
 
 DEFAULT_GROUP_WIDTH = 8
 
@@ -429,6 +431,26 @@ class SessionRegistry:
                 source="serve_group",
                 bounds=bundle.bounds,
             )
+        if _perfscope.ENABLED:
+            # Price the shared program once per (signature, width,
+            # health) — a shadow lowering over avals, no execution.  Any
+            # tracers the re-trace leaves land on the bundle's template,
+            # never on the group's states (same invariant as the apply
+            # itself).
+            profiled = _perfscope.profile_program(
+                "serve_group",
+                bundle.apply,
+                (col._read_states(), args, kwargs),
+                batch_args=(args, kwargs),
+                signature=(group.signature, group.width, bundle.health),
+            )
+            if profiled is not None and _metering.ENABLED:
+                # The roofline price becomes the metering ledger's
+                # per-call device-time charge for this shared program.
+                _metering.record_program_price(
+                    _metering.program_id((group.signature, group.width)),
+                    profiled,
+                )
 
     # -- seat state -------------------------------------------------------
     def seat_state_dict(self, session: Session) -> Dict[str, Any]:

@@ -49,6 +49,7 @@ from torcheval_tpu.telemetry import flightrec as _flightrec
 from torcheval_tpu.telemetry import trace as _trace
 from torcheval_tpu.telemetry.health import DataCorruptionError
 
+import torcheval_tpu.serve.metering as _metering
 from torcheval_tpu.serve.admission import (
     Admitted,
     AdmissionController,
@@ -140,6 +141,9 @@ class EvalService:
         self._worker: Optional[threading.Thread] = None
         self._stop_flag = threading.Event()
         self._wake = threading.Event()
+        # Cold resolver: the unset TENANT_METERING tribool auto-enables
+        # the per-tenant ledger exactly when serve is in use.
+        _metering.activate_for_serve()
 
     # ------------------------------------------------------------ sessions
     def open(
@@ -214,11 +218,12 @@ class EvalService:
             if self._draining:
                 return self._reject(tenant, "draining")
             ctx = _trace.capture() if _trace.ENABLED else None
+            now = time.monotonic()
             outcome, dropped = self._admission.offer(
                 tenant,
                 args,
                 kwargs,
-                now=time.monotonic(),
+                now=now,
                 deadline_s=deadline_s,
                 trace_ctx=ctx,
             )
@@ -231,6 +236,13 @@ class EvalService:
                         reason="drop-oldest",
                         policy=self._admission.policy,
                         queue_depth=outcome.queue_depth,
+                        wait_s=now - victim.enqueued_at,
+                    )
+                if _metering.ENABLED:
+                    _metering.record_submit(
+                        victim.tenant,
+                        "shed",
+                        queue_depth=self._admission.depth(victim.tenant),
                     )
             if isinstance(outcome, Admitted):
                 self._counts["admitted"] += 1
@@ -241,6 +253,13 @@ class EvalService:
                         policy=self._admission.policy,
                         queue_depth=outcome.queue_depth,
                     )
+                if _metering.ENABLED:
+                    _metering.record_submit(
+                        tenant,
+                        "admitted",
+                        nbytes=_metering.payload_nbytes(args, kwargs),
+                        queue_depth=self._admission.depth(tenant),
+                    )
             else:
                 self._counts["shed"] += 1
                 if _telemetry.ENABLED:
@@ -250,6 +269,12 @@ class EvalService:
                         reason=outcome.reason,
                         policy=self._admission.policy,
                         queue_depth=outcome.queue_depth,
+                    )
+                if _metering.ENABLED:
+                    _metering.record_submit(
+                        tenant,
+                        "shed",
+                        queue_depth=self._admission.depth(tenant),
                     )
         self._wake.set()
         return outcome
@@ -264,6 +289,12 @@ class EvalService:
                 policy=self._admission.policy,
                 queue_depth=self._admission.depth(),
             )
+        if _metering.ENABLED:
+            _metering.record_submit(
+                tenant,
+                "rejected",
+                queue_depth=self._admission.depth(tenant),
+            )
         return Rejected(tenant=tenant, reason=reason)
 
     # ---------------------------------------------------------- processing
@@ -277,7 +308,8 @@ class EvalService:
             # lock inside pop) — and the shed accounting must not race
             # submit's counter updates.
             with self._lock:
-                item, expired = self._admission.pop(now=time.monotonic())
+                now = time.monotonic()
+                item, expired = self._admission.pop(now=now)
                 for stale in expired:
                     self._counts["shed"] += 1
                     if _telemetry.ENABLED:
@@ -287,6 +319,16 @@ class EvalService:
                             reason="deadline",
                             policy=self._admission.policy,
                             queue_depth=self._admission.depth(),
+                            # The wait the expired batch actually paid —
+                            # exactly the batches that waited longest
+                            # must not vanish from the latency record.
+                            wait_s=now - stale.enqueued_at,
+                        )
+                    if _metering.ENABLED:
+                        _metering.record_submit(
+                            stale.tenant,
+                            "shed",
+                            queue_depth=self._admission.depth(stale.tenant),
                         )
             if item is None:
                 break
@@ -308,6 +350,13 @@ class EvalService:
                         reason="tenant-gone",
                         policy=self._admission.policy,
                         queue_depth=self._admission.depth(),
+                        wait_s=time.monotonic() - item.enqueued_at,
+                    )
+                if _metering.ENABLED:
+                    _metering.record_submit(
+                        item.tenant,
+                        "shed",
+                        queue_depth=self._admission.depth(item.tenant),
                     )
                 return False
             wait = time.monotonic() - item.enqueued_at
@@ -345,12 +394,25 @@ class EvalService:
                 return False
             session.batches += 1
             self._registry.touch(session)
+            done = time.monotonic()
             if _telemetry.ENABLED:
                 _telemetry.record_span(
                     "update",
                     "EvalService.dispatch",
-                    time.monotonic() - t0,
+                    done - t0,
                     0,
+                )
+            if _metering.ENABLED:
+                _metering.record_dispatch(
+                    item.tenant,
+                    _metering.program_id(
+                        (session.signature, session.group.width)
+                    ),
+                    rows=_metering.batch_rows(item.args),
+                    seconds=done - t0,
+                    wait_s=wait,
+                    e2e_s=done - item.enqueued_at,
+                    queue_depth=self._admission.depth(item.tenant),
                 )
             self._maybe_spill(exclude=session)
             return True
@@ -376,19 +438,27 @@ class EvalService:
                 error=session.quarantine_reason,
                 batches_dropped=len(purged),
             )
+        if _metering.ENABLED:
+            # The ledger survives quarantine: the tenant's pre-quarantine
+            # device-time and shed history is exactly what a postmortem
+            # needs.
+            _metering.record_quarantine(session.tenant)
         if _flightrec.ENABLED:
+            extra: Dict[str, Any] = {
+                "serve": {
+                    "tenant": session.tenant,
+                    "reason": reason,
+                    "error": session.quarantine_reason,
+                    "batches_dropped": len(purged),
+                    "batches_applied": session.batches,
+                }
+            }
+            if _metering.ENABLED:
+                extra["tenants"] = _metering.ledger_rows()
             _flightrec.trigger(
                 "tenant_quarantine",
                 f"tenant={session.tenant} {reason}",
-                extra={
-                    "serve": {
-                        "tenant": session.tenant,
-                        "reason": reason,
-                        "error": session.quarantine_reason,
-                        "batches_dropped": len(purged),
-                        "batches_applied": session.batches,
-                    }
-                },
+                extra=extra,
             )
 
     # ------------------------------------------------------------- results
@@ -458,6 +528,8 @@ class EvalService:
                 nbytes=os.path.getsize(path),
                 seconds=time.monotonic() - t0,
             )
+        if _metering.ENABLED:
+            _metering.record_session("spill", session.tenant)
 
     def _maybe_spill(self, exclude: Optional[Session] = None) -> None:
         if self._spill_root is None or self._max_resident is None:
@@ -507,6 +579,8 @@ class EvalService:
                 nbytes=checkpoint.nbytes if checkpoint is not None else 0,
                 seconds=time.monotonic() - t0,
             )
+        if _metering.ENABLED:
+            _metering.record_session("resume", session.tenant)
 
     # --------------------------------------------------------------- drain
     def drain(self, deadline_s: Optional[float] = None) -> Dict[str, Any]:

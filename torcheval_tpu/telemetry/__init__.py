@@ -58,6 +58,7 @@ from torcheval_tpu.telemetry import (
     flightrec,
     health,
     perfscope,
+    tenants,
     trace,
 )
 from torcheval_tpu.telemetry.aggregate import (
@@ -86,6 +87,7 @@ from torcheval_tpu.telemetry.events import (
     SessionEvent,
     SpanEvent,
     SyncEvent,
+    TenantSampleEvent,
     clear,
     disable,
     emit,
@@ -370,6 +372,12 @@ def report(as_text: bool = False) -> Union[Dict[str, Any], str]:
             "quarantined": srv["quarantined"],
             "sessions": dict(srv["sessions"]),
         }
+    tenant_rows = tenants.collect_rows(agg)
+    if tenant_rows:
+        # Top-K by attributed device-seconds with the worst-shed and
+        # worst-p99 tenants pinned in; rows are plain list-of-dicts so
+        # fleet snapshots carry them losslessly.
+        result["tenants"] = tenants.report_section(tenant_rows)
     if as_text:
         return format_report(result)
     return result
@@ -397,6 +405,7 @@ __all__ = [
     "SloRule",
     "SpanEvent",
     "SyncEvent",
+    "TenantSampleEvent",
     "aggregate",
     "clear",
     "default_rules",
@@ -426,6 +435,7 @@ __all__ = [
     "read_jsonl",
     "report",
     "serve_prometheus",
+    "tenants",
     "to_perfetto",
     "trace",
 ]

@@ -11,9 +11,12 @@ not in the dump), ``--flight <bundle_dir>`` validates and renders a
 flight-recorder bundle (no report path needed; exit 2 on a corrupt
 bundle), ``--alerts`` renders the fired SLO rules and exits nonzero
 when any fired (CI gate: pipe an eval run's dump through ``--alerts``
-to fail the job on an SLO breach), and ``--routes`` renders the
+to fail the job on an SLO breach), ``--routes`` renders the
 measured-cost routing decision table (route, measured cost, verdict,
-source) the autotune layer emitted (:doc:`autotune <../autotune>`).  Dumps written by newer library
+source) the autotune layer emitted (:doc:`autotune <../autotune>`), and
+``--tenants`` renders the per-tenant serve metering table (attributed
+device-seconds, shed rate, latency quantiles, noisy-neighbour verdict)
+rebuilt from the dump's ``TenantSampleEvent`` stream.  Dumps written by newer library
 versions load fine — unknown event kinds are skipped with a counted
 warning (``export.read_jsonl``).
 """
@@ -64,6 +67,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         action="store_true",
         help="render the measured-cost routing decision table "
         "(route, measured cost, verdict, source) from the dump",
+    )
+    parser.add_argument(
+        "--tenants",
+        action="store_true",
+        help="render the per-tenant serve metering table (device-time "
+        "attribution, shed rate, latency quantiles) from the dump",
     )
     parser.add_argument(
         "--trace",
@@ -165,6 +174,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"{entry['signature'] or '-':<17} {entry['count']:>5} "
                 f"{cost:>10} {alt:>10}  {entry['source']}"
             )
+        return 0
+
+    if args.tenants:
+        from torcheval_tpu.telemetry import tenants as tenants_mod
+
+        print(
+            tenants_mod.format_table(
+                tenants_mod.collect_rows(ev.aggregates())
+            )
+        )
         return 0
 
     if args.alerts:

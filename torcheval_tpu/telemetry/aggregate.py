@@ -113,6 +113,9 @@ def _host_rollup(snapshot: Dict[str, Any]) -> Dict[str, Any]:
         # _plain untouched) and this host's worst slice reading.
         "quality_entries": list(quality.get("entries", [])),
         "quality_worst": quality.get("worst_slice"),
+        # Per-tenant metering rows (list-of-dicts, same property) for
+        # the tenant×host rollup below.
+        "tenant_rows": list(report.get("tenants", {}).get("rows", [])),
         "merge_levels": list(
             report.get("merge", {}).get("levels", [])
         ),
@@ -366,6 +369,16 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
         row["mean"] = row.pop("_sum") / row["hosts"]
         per_metric.append(row)
 
+    # Tenant×host rollup: a tenant served from several hosts sums its
+    # counters/device-seconds fleet-wide, and the worst shed-rate /
+    # worst p99-wait readings are pinned to the host that produced them
+    # (tenants.merge_rollups).
+    from torcheval_tpu.telemetry import tenants as _tenants
+
+    tenant_rollup = _tenants.merge_rollups(
+        [(r["host"], r.get("tenant_rows", [])) for r in rollups]
+    )
+
     return {
         "hosts": len(rollups),
         "per_host": rollups,
@@ -378,6 +391,7 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
             "per_metric": per_metric,
             "worst_slice": worst_slice or None,
         },
+        "tenants": tenant_rollup,
         "traces": fleet_traces(snapshots),
     }
 

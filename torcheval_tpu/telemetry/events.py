@@ -423,6 +423,40 @@ class SessionEvent(Event):
         self.kind = f"session_{self.action}"
 
 
+@dataclass
+class TenantSampleEvent(Event):
+    """One cumulative per-tenant metering sample from the serve plane's
+    ledger (:mod:`torcheval_tpu.serve.metering`): traffic counters,
+    latency quantiles from the queue-wait / end-to-end StreamDigest
+    ladders, attributed device-seconds, and the noisy-neighbor verdict
+    (``dominant_program`` non-empty when this tenant holds more than
+    the configured share of a shared program's rows).  Samples are
+    cumulative snapshots, so folding keeps only the LATEST per tenant —
+    replaying a dump reconstructs the ledger exactly."""
+
+    kind: str = field(init=False, default="tenant_sample")
+    tenant: str = ""
+    submits: int = 0
+    admitted: int = 0
+    shed: int = 0
+    rejected: int = 0
+    dispatched: int = 0
+    quarantined: int = 0
+    spills: int = 0
+    resumes: int = 0
+    rows: int = 0
+    payload_bytes: int = 0
+    queue_depth: int = 0
+    shed_rate: float = 0.0
+    wait_p50_s: float = 0.0
+    wait_p99_s: float = 0.0
+    e2e_p50_s: float = 0.0
+    e2e_p99_s: float = 0.0
+    device_seconds: float = 0.0
+    dominant_program: str = ""
+    dominant_share: float = 0.0
+
+
 # Every event kind the bus can carry → its dataclass, for the JSON-lines
 # round trip (``export.event_from_dict``).
 KIND_TO_CLASS: Dict[str, type] = {
@@ -453,6 +487,7 @@ KIND_TO_CLASS: Dict[str, type] = {
     "session_resume": SessionEvent,
     "session_close": SessionEvent,
     "session_drain": SessionEvent,
+    "tenant_sample": TenantSampleEvent,
 }
 
 
@@ -530,6 +565,10 @@ def _zero_aggregates() -> Dict[str, Any]:
             "quarantined": 0,
             "sessions": {},
         },
+        # Per-tenant serve metering: tenant -> the LATEST cumulative
+        # TenantSampleEvent row (samples are snapshots of the metering
+        # ledger, so last-wins replay reconstructs it exactly).
+        "tenants": {},
         "emitted": 0,
     }
 
@@ -666,6 +705,9 @@ def aggregates() -> Dict[str, Any]:
                 "dispatched": _copy_hist_entry(_agg["serve"]["dispatched"]),
                 "quarantined": _agg["serve"]["quarantined"],
                 "sessions": dict(_agg["serve"]["sessions"]),
+            },
+            "tenants": {
+                k: dict(v) for k, v in _agg["tenants"].items()
             },
             "emitted": _agg["emitted"],
         }
@@ -893,6 +935,30 @@ def _fold(event: Event) -> None:
             entry["calls"] += 1
             entry["wait_seconds"] += event.wait_s
             entry["hist"][_hist_slot(event.wait_s)] += 1
+    elif isinstance(event, TenantSampleEvent):
+        # Cumulative snapshot: replace, never add (see TenantSampleEvent).
+        _agg["tenants"][event.tenant] = {
+            "tenant": event.tenant,
+            "submits": event.submits,
+            "admitted": event.admitted,
+            "shed": event.shed,
+            "rejected": event.rejected,
+            "dispatched": event.dispatched,
+            "quarantined": event.quarantined,
+            "spills": event.spills,
+            "resumes": event.resumes,
+            "rows": event.rows,
+            "payload_bytes": event.payload_bytes,
+            "queue_depth": event.queue_depth,
+            "shed_rate": event.shed_rate,
+            "wait_p50_s": event.wait_p50_s,
+            "wait_p99_s": event.wait_p99_s,
+            "e2e_p50_s": event.e2e_p50_s,
+            "e2e_p99_s": event.e2e_p99_s,
+            "device_seconds": event.device_seconds,
+            "dominant_program": event.dominant_program,
+            "dominant_share": event.dominant_share,
+        }
     elif isinstance(event, QuarantineEvent):
         _agg["serve"]["quarantined"] += 1
     elif isinstance(event, SessionEvent):
@@ -1175,6 +1241,54 @@ def record_session(
             generation=int(generation),
             nbytes=int(nbytes),
             seconds=float(seconds),
+        )
+    )
+
+
+def record_tenant_sample(
+    tenant: str,
+    submits: int = 0,
+    admitted: int = 0,
+    shed: int = 0,
+    rejected: int = 0,
+    dispatched: int = 0,
+    quarantined: int = 0,
+    spills: int = 0,
+    resumes: int = 0,
+    rows: int = 0,
+    payload_bytes: int = 0,
+    queue_depth: int = 0,
+    shed_rate: float = 0.0,
+    wait_p50_s: float = 0.0,
+    wait_p99_s: float = 0.0,
+    e2e_p50_s: float = 0.0,
+    e2e_p99_s: float = 0.0,
+    device_seconds: float = 0.0,
+    dominant_program: str = "",
+    dominant_share: float = 0.0,
+) -> None:
+    emit(
+        TenantSampleEvent(
+            tenant=tenant,
+            submits=int(submits),
+            admitted=int(admitted),
+            shed=int(shed),
+            rejected=int(rejected),
+            dispatched=int(dispatched),
+            quarantined=int(quarantined),
+            spills=int(spills),
+            resumes=int(resumes),
+            rows=int(rows),
+            payload_bytes=int(payload_bytes),
+            queue_depth=int(queue_depth),
+            shed_rate=float(shed_rate),
+            wait_p50_s=float(wait_p50_s),
+            wait_p99_s=float(wait_p99_s),
+            e2e_p50_s=float(e2e_p50_s),
+            e2e_p99_s=float(e2e_p99_s),
+            device_seconds=float(device_seconds),
+            dominant_program=dominant_program,
+            dominant_share=float(dominant_share),
         )
     )
 

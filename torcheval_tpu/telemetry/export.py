@@ -829,6 +829,156 @@ def prometheus_text() -> str:
                 f"{srv['sessions'][action]}"
             )
 
+    from torcheval_tpu.telemetry import tenants as _tenants
+
+    tenant_rows = _tenants.capped_rows(_tenants.collect_rows(agg))
+    if tenant_rows:
+        # Tenant-labeled families off the metering ledger.  Cardinality
+        # is bounded by design: past TENANT_SERIES_CAP tenants the tail
+        # folds into one __other__ series, and tenant ids pass through
+        # tenant_label (printable) + _label_escape (quoting).
+        rows = sorted(tenant_rows, key=lambda r: r["tenant"])
+        out.append(
+            f"# HELP {_PREFIX}_tenant_admission_total Per-tenant "
+            "admission outcomes from the serve metering ledger."
+        )
+        out.append(f"# TYPE {_PREFIX}_tenant_admission_total counter")
+        for row in rows:
+            label = _tenants.tenant_label(row["tenant"])
+            for outcome in ("admitted", "shed", "rejected"):
+                out.append(
+                    f"{_PREFIX}_tenant_admission_total"
+                    f"{_labels(tenant=label, outcome=outcome)} "
+                    f"{row.get(outcome, 0)}"
+                )
+        out.append(
+            f"# HELP {_PREFIX}_tenant_dispatched_total Batches executed "
+            "per tenant through the shared group programs."
+        )
+        out.append(f"# TYPE {_PREFIX}_tenant_dispatched_total counter")
+        for row in rows:
+            out.append(
+                f"{_PREFIX}_tenant_dispatched_total"
+                f"{_labels(tenant=_tenants.tenant_label(row['tenant']))} "
+                f"{row.get('dispatched', 0)}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_tenant_rows_total Valid batch rows "
+            "dispatched per tenant (the attribution weight)."
+        )
+        out.append(f"# TYPE {_PREFIX}_tenant_rows_total counter")
+        for row in rows:
+            out.append(
+                f"{_PREFIX}_tenant_rows_total"
+                f"{_labels(tenant=_tenants.tenant_label(row['tenant']))} "
+                f"{row.get('rows', 0)}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_tenant_payload_bytes_total Admitted batch "
+            "payload bytes per tenant."
+        )
+        out.append(f"# TYPE {_PREFIX}_tenant_payload_bytes_total counter")
+        for row in rows:
+            out.append(
+                f"{_PREFIX}_tenant_payload_bytes_total"
+                f"{_labels(tenant=_tenants.tenant_label(row['tenant']))} "
+                f"{row.get('payload_bytes', 0)}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_tenant_device_seconds_total Attributed "
+            "device time per tenant: each shared program's priced "
+            "seconds split by valid-row share."
+        )
+        out.append(
+            f"# TYPE {_PREFIX}_tenant_device_seconds_total counter"
+        )
+        for row in rows:
+            out.append(
+                f"{_PREFIX}_tenant_device_seconds_total"
+                f"{_labels(tenant=_tenants.tenant_label(row['tenant']))} "
+                f"{_fmt(row.get('device_seconds', 0.0))}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_tenant_queue_depth Queued batches per "
+            "tenant at the last metering observation."
+        )
+        out.append(f"# TYPE {_PREFIX}_tenant_queue_depth gauge")
+        for row in rows:
+            out.append(
+                f"{_PREFIX}_tenant_queue_depth"
+                f"{_labels(tenant=_tenants.tenant_label(row['tenant']))} "
+                f"{row.get('queue_depth', 0)}"
+            )
+        out.append(
+            f"# HELP {_PREFIX}_tenant_wait_seconds Per-tenant queue-wait "
+            "quantiles (StreamDigest ladder)."
+        )
+        out.append(f"# TYPE {_PREFIX}_tenant_wait_seconds gauge")
+        for row in rows:
+            label = _tenants.tenant_label(row["tenant"])
+            for q, field in (("0.5", "wait_p50_s"), ("0.99", "wait_p99_s")):
+                out.append(
+                    f"{_PREFIX}_tenant_wait_seconds"
+                    f"{_labels(tenant=label, quantile=q)} "
+                    f"{_fmt(row.get(field, 0.0))}"
+                )
+        out.append(
+            f"# HELP {_PREFIX}_tenant_e2e_seconds Per-tenant "
+            "submit-to-result latency quantiles."
+        )
+        out.append(f"# TYPE {_PREFIX}_tenant_e2e_seconds gauge")
+        for row in rows:
+            label = _tenants.tenant_label(row["tenant"])
+            for q, field in (("0.5", "e2e_p50_s"), ("0.99", "e2e_p99_s")):
+                out.append(
+                    f"{_PREFIX}_tenant_e2e_seconds"
+                    f"{_labels(tenant=label, quantile=q)} "
+                    f"{_fmt(row.get(field, 0.0))}"
+                )
+        out.append(
+            f"# HELP {_PREFIX}_tenant_session_churn_total Spill/resume "
+            "steps per tenant (placement churn)."
+        )
+        out.append(f"# TYPE {_PREFIX}_tenant_session_churn_total counter")
+        for row in rows:
+            label = _tenants.tenant_label(row["tenant"])
+            for action in ("spills", "resumes"):
+                out.append(
+                    f"{_PREFIX}_tenant_session_churn_total"
+                    f"{_labels(tenant=label, action=action)} "
+                    f"{row.get(action, 0)}"
+                )
+        dominant = [r for r in rows if r.get("dominant_program")]
+        if dominant:
+            out.append(
+                f"# HELP {_PREFIX}_tenant_dominant_share Device-time "
+                "share of a shared program held by its dominant tenant "
+                "(the noisy-neighbour verdict)."
+            )
+            out.append(f"# TYPE {_PREFIX}_tenant_dominant_share gauge")
+            for row in dominant:
+                out.append(
+                    f"{_PREFIX}_tenant_dominant_share"
+                    f"{_labels(tenant=_tenants.tenant_label(row['tenant']), program=row['dominant_program'])} "
+                    f"{_fmt(row.get('dominant_share', 0.0))}"
+                )
+        folded = next(
+            (
+                r["folded_tenants"]
+                for r in rows
+                if r["tenant"] == _tenants.OTHER_LABEL
+                and "folded_tenants" in r
+            ),
+            0,
+        )
+        if folded:
+            out.append(
+                f"# HELP {_PREFIX}_tenant_series_folded Tenants folded "
+                "into the __other__ series by the cardinality cap."
+            )
+            out.append(f"# TYPE {_PREFIX}_tenant_series_folded gauge")
+            out.append(f"{_PREFIX}_tenant_series_folded {folded}")
+
     return "\n".join(out) + "\n"
 
 
@@ -1068,6 +1218,41 @@ def format_report(report: Dict[str, Any]) -> str:
                 f"{k}={v}" for k, v in sorted(rejected.items())
             )
             buf.write(f"    rejected: {rendered}\n")
+    tenants_section = report.get("tenants", {})
+    if tenants_section:
+        buf.write(
+            f"  tenants: {tenants_section.get('tenants_total', 0)} metered, "
+            f"{tenants_section.get('device_seconds_total', 0.0):.6f} "
+            "device-seconds attributed\n"
+        )
+        for row in tenants_section.get("rows", []):
+            noisy = (
+                f" NOISY {row.get('dominant_program')}"
+                f"@{row.get('dominant_share', 0.0):.0%}"
+                if row.get("dominant_program")
+                else ""
+            )
+            buf.write(
+                f"    {row['tenant']}: "
+                f"{row.get('device_seconds', 0.0):.6f} dev-s, "
+                f"{row.get('rows', 0)} rows, "
+                f"{row.get('dispatched', 0)} dispatched, "
+                f"shed rate {row.get('shed_rate', 0.0):.3f}, "
+                f"p99 wait {row.get('wait_p99_s', 0.0) * 1e3:.3f} ms"
+                f"{noisy}\n"
+            )
+        worst = tenants_section.get("worst_shed")
+        if worst:
+            buf.write(
+                f"    worst shed: {worst['tenant']} "
+                f"({worst.get('shed_rate', 0.0):.3f})\n"
+            )
+        worst = tenants_section.get("worst_p99")
+        if worst:
+            buf.write(
+                f"    worst p99 wait: {worst['tenant']} "
+                f"({worst.get('wait_p99_s', 0.0) * 1e3:.3f} ms)\n"
+            )
     buf.write(
         f"  events: {report.get('events_captured', 0)} captured, "
         f"{report.get('events_dropped', 0)} dropped "
@@ -1238,6 +1423,31 @@ def format_fleet_report(fleet: Dict[str, Any]) -> str:
             f"({worst['window']}) = {worst['value']:.6g} on host "
             f"{host.get('process_index', '?')} "
             f"({host.get('hostname', '?')})\n"
+        )
+    tenant_fleet = fleet.get("tenants", {})
+    for entry in tenant_fleet.get("per_tenant", []):
+        buf.write(
+            f"  tenant {entry['tenant']}: "
+            f"{entry.get('device_seconds', 0.0):.6f} dev-s, "
+            f"{entry.get('rows', 0)} rows, "
+            f"shed rate {entry.get('shed_rate', 0.0):.3f} over "
+            f"{entry.get('hosts', 0)} host(s)\n"
+        )
+    worst_tenant = tenant_fleet.get("worst_shed") or {}
+    if worst_tenant.get("tenant"):
+        host = worst_tenant.get("host", {})
+        buf.write(
+            f"  WORST TENANT SHED: {worst_tenant['tenant']} "
+            f"({worst_tenant.get('shed_rate', 0.0):.3f}) on host "
+            f"{host.get('process_index', '?')}\n"
+        )
+    worst_tenant = tenant_fleet.get("worst_p99") or {}
+    if worst_tenant.get("tenant"):
+        host = worst_tenant.get("host", {})
+        buf.write(
+            f"  WORST TENANT P99 WAIT: {worst_tenant['tenant']} "
+            f"({worst_tenant.get('wait_p99_s', 0.0) * 1e3:.3f} ms) on "
+            f"host {host.get('process_index', '?')}\n"
         )
     for entry in fleet.get("traces", []):
         buf.write(

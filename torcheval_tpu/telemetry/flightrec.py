@@ -288,6 +288,16 @@ def _write_bundle(
     except Exception:  # noqa: BLE001 - jax-free context; state optional
         health_state = {"enabled": None}
 
+    # A "tenants" key in the trigger extra (the serve quarantine path
+    # passes the metering ledger rows) becomes its own declared bundle
+    # file — the postmortem's who-was-running-what record.
+    tenants_blob: bytes = b""
+    tenant_rows = extra.pop("tenants", None)
+    if tenant_rows is not None:
+        tenants_blob = json.dumps(
+            _jsonable(tenant_rows), indent=1, sort_keys=True
+        ).encode("utf-8")
+
     manifest: Dict[str, Any] = {
         "format": BUNDLE_FORMAT,
         "seq": seq,
@@ -319,6 +329,11 @@ def _write_bundle(
             },
         },
     }
+    if tenants_blob:
+        manifest["files"]["tenants.json"] = {
+            "sha256": _sha256(tenants_blob),
+            "bytes": len(tenants_blob),
+        }
 
     # tpulint: disable=TPU006 -- str rebinds are atomic; enable() is rare
     base = _dir
@@ -330,6 +345,8 @@ def _write_bundle(
     os.makedirs(tmp, exist_ok=True)
     _fsync_write(os.path.join(tmp, "events.jsonl"), events_blob)
     _fsync_write(os.path.join(tmp, "trace.perfetto.json"), perfetto_blob)
+    if tenants_blob:
+        _fsync_write(os.path.join(tmp, "tenants.json"), tenants_blob)
     # Manifest LAST: a bundle without one is by definition incomplete.
     _fsync_write(
         os.path.join(tmp, MANIFEST_NAME),

@@ -279,6 +279,9 @@ def profile_program(
 _PROGRAM_TO_SPAN = {
     "fused_collection": ("MetricCollection.fused", "update"),
     "engine_scan": ("Evaluator", "engine_block"),
+    # The serve plane's shared group program: dispatch wall clock lands
+    # under the EvalService.dispatch span.
+    "serve_group": ("EvalService.dispatch", "update"),
     # Megakernel-routed builds of the same two hot paths: the dispatch
     # sites time them under the same spans, only the program name (and
     # so the perf ledger row) differs.
@@ -579,6 +582,34 @@ def _metric_serve_admit_p99(agg: Dict[str, Any]) -> float:
     return _events.DURATION_BUCKETS[-1] * 2.0
 
 
+def _tenant_slo_rows(agg: Dict[str, Any]) -> List[Dict[str, Any]]:
+    # The live metering ledger when this process meters serve traffic,
+    # else the folded TenantSampleEvent rows — the same selection every
+    # tenant surface uses, so an alert names the tenant the report and
+    # the CLI table show.
+    from torcheval_tpu.telemetry import tenants as _tenants
+
+    return _tenants.collect_rows(agg)
+
+
+def _metric_tenant_wait_p99(agg: Dict[str, Any]) -> float:
+    """Worst per-tenant p99 queue wait (seconds) over the tenant
+    metering ledger; 0.0 before any metered dispatch."""
+    return max(
+        (r.get("wait_p99_s", 0.0) for r in _tenant_slo_rows(agg)),
+        default=0.0,
+    )
+
+
+def _metric_tenant_shed_rate(agg: Dict[str, Any]) -> float:
+    """Worst per-tenant shed fraction (``shed / (admitted + shed)``)
+    over the tenant metering ledger; 0.0 before any metered offer."""
+    return max(
+        (r.get("shed_rate", 0.0) for r in _tenant_slo_rows(agg)),
+        default=0.0,
+    )
+
+
 SLO_METRICS: Dict[str, Callable[[Dict[str, Any]], float]] = {
     "retrace_total": _metric_retrace_total,
     "prefetch_stall_ratio": _metric_prefetch_stall_ratio,
@@ -590,6 +621,15 @@ SLO_METRICS: Dict[str, Callable[[Dict[str, Any]], float]] = {
     "quality_worst_drop": _metric_quality_worst_drop,
     "serve_shed_rate": _metric_serve_shed_rate,
     "serve_admit_p99_s": _metric_serve_admit_p99,
+    "tenant_wait_p99_s": _metric_tenant_wait_p99,
+    "tenant_shed_rate": _metric_tenant_shed_rate,
+}
+
+# Tenant-scope metrics are per-tenant maxima; fired alerts name the
+# argmax tenant by appending it to the message (ledger row field here).
+_TENANT_METRIC_FIELD = {
+    "tenant_wait_p99_s": "wait_p99_s",
+    "tenant_shed_rate": "shed_rate",
 }
 
 # Floor rules stay quiet until their signal exists at all (a throughput
@@ -611,6 +651,8 @@ def default_rules(
     quality_drop_max: float = 0.0,
     serve_shed_rate_max: float = 0.0,
     serve_admit_p99_max_s: float = 0.0,
+    tenant_p99_max_s: float = 0.0,
+    tenant_shed_rate_max: float = 0.0,
 ) -> Tuple[SloRule, ...]:
     """A conservative starter rule set; floors default to 0 (disabled —
     pass your workload's numbers).  See ``docs/source/perfscope.rst``
@@ -719,6 +761,29 @@ def default_rules(
                 "admission",
             )
         )
+    if tenant_p99_max_s > 0:
+        out.append(
+            SloRule(
+                "tenant_p99_max",
+                "tenant_wait_p99_s",
+                ">",
+                tenant_p99_max_s,
+                "a tenant's p99 queue wait exceeds its latency budget — "
+                "check rebalance_hints() / report()['tenants'] for the "
+                "noisy neighbour starving it",
+            )
+        )
+    if tenant_shed_rate_max > 0:
+        out.append(
+            SloRule(
+                "tenant_shed_rate_max",
+                "tenant_shed_rate",
+                ">",
+                tenant_shed_rate_max,
+                "a tenant is shedding more than its budgeted fraction of "
+                "offered batches — rebalance it or widen its queue",
+            )
+        )
     return tuple(out)
 
 
@@ -742,6 +807,14 @@ def evaluate_slo(
                 f"{rule.message or rule.name}: {rule.metric}={value:.4g} "
                 f"{rule.op} {rule.threshold:.4g}"
             )
+            field = _TENANT_METRIC_FIELD.get(rule.metric)
+            if field is not None:
+                rows = _tenant_slo_rows(agg)
+                worst = max(
+                    rows, key=lambda r: r.get(field, 0.0), default=None
+                )
+                if worst is not None:
+                    message += f" (tenant {worst['tenant']!r})"
             _events.record_alert(rule.name, value, rule.threshold, message)
             fired.append(
                 {
