@@ -82,6 +82,11 @@ EXTRA_BARS = (
     # split lost or invented time).
     ("serve_tenant_metering_64", "metering_overhead_pct", 5.0),
     ("serve_tenant_metering_64", "attribution_conservation_err", 1e-6),
+    # Distributed serve plane: a live migration (spill -> stream blob
+    # p2p -> target resume+ack -> epoch bump) must complete in under
+    # 2 s at p99 — the handoff is built from warm, proven primitives,
+    # so anything slower means a phase started blocking.
+    ("serve_cluster_migration", "migration_p99_s", 2.0),
 )
 
 # (metric row, extras key, min required value) — absolute floors, for
@@ -133,6 +138,15 @@ EXTRA_PARITY = (
         "collection_sliced_stream",
         "dispatches_per_batch",
         "dispatches_per_batch_unsliced",
+    ),
+    # Host-failover loss accounting: the tenants reported ``lost``
+    # after killing one host mid-migration must be EXACTLY the dead
+    # host's never-spilled sessions — one fewer is a phantom recovery,
+    # one more means durably spilled state was dropped on repair.
+    (
+        "serve_cluster_migration",
+        "lost_tenants",
+        "dead_host_unspilled",
     ),
 )
 
@@ -226,7 +240,7 @@ def check_extras(fresh_doc: Dict[str, Any]) -> List[str]:
         if float(a) != float(b):
             violations.append(
                 f"{metric}: {key_a}={float(a):g} != {key_b}={float(b):g} "
-                "(dispatch parity broken)"
+                "(parity broken)"
             )
     return violations
 

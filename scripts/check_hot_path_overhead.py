@@ -334,6 +334,70 @@ def _drive_hot_path() -> None:
             ).block_until_ready()
         service.drain(deadline_s=30.0)
 
+    # The distributed serve plane (serve/cluster.py): a routed submit,
+    # a live migration (spill -> stream -> resume -> epoch bump), and a
+    # post-migration re-route — the ``serve.route``/``serve.migrate``
+    # fault sites and the placement/migration telemetry hooks crossed
+    # on the way are all ENABLED-gated and must stay cold.  Driven
+    # single-threaded (both clusters stepped round-robin) so the drive
+    # is deterministic.
+    from torcheval_tpu.distributed import LocalWorld
+    from torcheval_tpu.serve import ServeCluster
+
+    def cluster_suite():
+        return {"acc": MulticlassAccuracy(num_classes=c, average="macro")}
+
+    with tempfile.TemporaryDirectory() as spill_dir:
+        world = LocalWorld(2)
+        clusters = [
+            ServeCluster(world.group(r), spill_dir=spill_dir, group_width=2)
+            for r in range(2)
+        ]
+
+        def step_until(pred, what, rounds=50_000):
+            for _ in range(rounds):
+                if pred():
+                    return
+                for cl in clusters:
+                    cl.step()
+            raise AssertionError(f"serve-cluster drive stalled: {what}")
+
+        tenants = [f"ct{i}" for i in range(4)]
+        for tenant in tenants:
+            for cl in clusters:
+                cl.open(tenant, cluster_suite)
+        remote = next(
+            t for t in tenants if clusters[0].placement.owner_of(t) == 1
+        )
+        batch = (
+            jnp.asarray(rng.random((33, c), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, c, 33).astype(np.int32)),
+        )
+        assert clusters[0].submit(remote, *batch).action == "routed"
+        step_until(
+            lambda: clusters[1].service.session(remote) is not None
+            and clusters[1].service.session(remote).batches >= 1,
+            "routed frame applied",
+        )
+        out = clusters[1].migrate(remote, 0, wait=False)
+        assert out.action == "routed", out
+        step_until(
+            lambda: all(
+                cl.placement.owner_of(remote) == 0 for cl in clusters
+            ),
+            "migration committed",
+        )
+        # One logical submitter per tenant: rank 0 keeps the stream,
+        # which is now local to it after the handoff.
+        assert clusters[0].submit(remote, *batch).action == "local"
+        step_until(
+            lambda: clusters[0].service.session(remote).batches >= 2,
+            "post-migration frame applied",
+        )
+        result = clusters[0].results(remote)
+        assert result.action == "local", result
+        jnp.asarray(result.value["acc"]).block_until_ready()
+
 
 def check(verbose: bool = True) -> List[str]:
     """Assert zero hook calls on the disabled path; returns the guarded

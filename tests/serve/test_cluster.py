@@ -1,0 +1,741 @@
+"""Distributed serve plane (torcheval_tpu/serve/cluster.py): chaos
+suite for consistent-hash placement, p2p routing, live migration, and
+host failover.
+
+The headline claims under test, for worlds {2, 4, 8}:
+
+* A tenant routed across hosts computes **bit-identical** results to a
+  solo, unsliced run of the same metrics over the same stream — across
+  routing, backpressure sheds, live migration, and host death.
+* Killing any single host mid-dispatch, mid-spill, mid-stream, or
+  mid-resume leaves every surviving tenant's ``compute()`` bit-exact;
+  only the dead host's never-spilled sessions are reported ``lost``
+  (a typed :class:`PlacementOutcome`, never an exception).
+* After any membership change, placement converges to one consistent
+  ring epoch and fingerprint on all survivors, and the tenants owned
+  by survivors never move (the consistent-hash guarantee).
+
+The harness is deterministic: every cluster is stepped round-robin
+from the test thread (``LocalGroup`` p2p is a mailbox; ``step()``
+never blocks), so kills land at exact protocol points via
+``FaultPlan`` rules on the ``serve.route`` / ``serve.migrate`` sites.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torcheval_tpu.distributed import (
+    SERVE_TAG_NAMESPACE,
+    LocalWorld,
+    pack_frames,
+    serve_tag,
+    unpack_frames,
+)
+from torcheval_tpu.metrics import (
+    BinaryAUROC,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+)
+from torcheval_tpu.parallel.fleet_merge import MergePolicy, fleet_merge
+from torcheval_tpu.resilience import FaultPlan
+from torcheval_tpu.serve import EvalService, ServeCluster
+from torcheval_tpu.serve import metering as _metering
+
+pytestmark = pytest.mark.distserve
+
+_C = 5
+_ROWS = 17
+_GROUP_WIDTH = 4
+
+
+def _suite():
+    return {
+        "acc": MulticlassAccuracy(num_classes=_C, average="macro"),
+        "f1": MulticlassF1Score(num_classes=_C, average="macro"),
+    }
+
+
+def _batches(n, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        (
+            jnp.asarray(rng.random((_ROWS, _C), dtype=np.float32)),
+            jnp.asarray(rng.integers(0, _C, _ROWS).astype(np.int32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _solo(batches):
+    """The reference: plain unsliced metrics over the same stream."""
+    metrics = _suite()
+    for scores, target in batches:
+        for m in metrics.values():
+            m.update(scores, target)
+    return {name: m.compute() for name, m in metrics.items()}
+
+
+def _assert_bitwise(got, want):
+    assert set(got) == set(want)
+    for name in want:
+        got_b = np.asarray(got[name]).tobytes()
+        want_b = np.asarray(want[name]).tobytes()
+        assert got_b == want_b, f"{name} differs bitwise"
+
+
+_warmed = False
+
+
+def _warm():
+    """Compile the suite's dispatch + compute programs once so chaos
+    timers (heartbeats vs death timeouts) never race a cold compile."""
+    global _warmed
+    if _warmed:
+        return
+    svc = EvalService(group_width=_GROUP_WIDTH)
+    svc.open("warm", _suite())
+    for b in _batches(2, seed=999):
+        svc.submit("warm", *b)
+    svc.pump()
+    svc.results("warm")
+    _warmed = True
+
+
+# ----------------------------------------------------------------- harness
+def _make(world_size, spill_dir, **kw):
+    """One ServeCluster per rank over a shared LocalWorld + shared
+    durable spill store (what a fleet-shared checkpoint volume is)."""
+    _warm()
+    kw.setdefault("heartbeat_s", 0.02)
+    kw.setdefault("death_timeout_s", 10.0)
+    kw.setdefault("group_width", _GROUP_WIDTH)
+    w = LocalWorld(world_size)
+    clusters = [
+        ServeCluster(w.group(r), spill_dir=str(spill_dir), **kw)
+        for r in range(world_size)
+    ]
+    return w, clusters
+
+
+def _step_all(clusters, rounds=1):
+    for _ in range(rounds):
+        for c in clusters:
+            if not c.is_dead:
+                c.step()
+
+
+def _until(predicate, clusters, timeout=60.0, msg=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        _step_all(clusters)
+        if predicate():
+            return
+        time.sleep(0.001)
+    raise AssertionError(f"condition not reached in {timeout}s: {msg}")
+
+
+def _drive_call(call, clusters, timeout=60.0):
+    """Run a blocking cluster call (migrate/results drive their own
+    host's step loop) while round-robin stepping every other host."""
+    box = {}
+    thread = threading.Thread(
+        target=lambda: box.setdefault("out", call()), daemon=True
+    )
+    thread.start()
+    deadline = time.monotonic() + timeout
+    while thread.is_alive() and time.monotonic() < deadline:
+        _step_all(clusters)
+        time.sleep(0.001)
+    thread.join(timeout=1.0)
+    assert "out" in box, "blocking cluster call hung"
+    return box["out"]
+
+
+def _tenants_per_rank(cluster, per_rank):
+    """Deterministic tenant names such that every alive rank owns
+    exactly ``per_rank`` of them on the current ring."""
+    owned = {r: [] for r in cluster.placement.alive}
+    i = 0
+    while any(len(v) < per_rank for v in owned.values()):
+        name = f"t{i}"
+        i += 1
+        owner = cluster.placement.owner_of(name)
+        if len(owned[owner]) < per_rank:
+            owned[owner].append(name)
+        assert i < 100_000, "ring never produced the requested spread"
+    return owned
+
+
+def _open_everywhere(clusters, tenants):
+    for t in tenants:
+        for c in clusters:
+            out = c.open(t, _suite)
+            assert out.action in ("local", "routed"), out
+            assert out.owner == clusters[0].placement.owner_of(t)
+
+
+def _wait_applied(clusters, tenant, nbatches, timeout=60.0):
+    def ok():
+        for c in clusters:
+            if c.is_dead:
+                continue
+            if c.placement.owner_of(tenant) == c.rank:
+                s = c.service.session(tenant)
+                return s is not None and s.batches >= nbatches
+        return False
+
+    _until(ok, clusters, timeout, f"{tenant} applied through {nbatches}")
+
+
+def _wait_dead(clusters, victim, timeout=60.0):
+    survivors = [c for c in clusters if c.rank != victim and not c.is_dead]
+
+    def ok():
+        return all(victim in c.stats()["dead"] for c in survivors)
+
+    _until(ok, clusters, timeout, f"rank {victim} excised everywhere")
+
+
+def _wait_converged(clusters, timeout=60.0):
+    def ok():
+        stats = [c.stats() for c in clusters if not c.is_dead]
+        return (
+            len({s["fingerprint"] for s in stats}) == 1
+            and len({s["epoch"] for s in stats}) == 1
+        )
+
+    _until(ok, clusters, timeout, "epoch/fingerprint convergence")
+    return [c.stats() for c in clusters if not c.is_dead]
+
+
+def _owner_cluster(clusters, tenant):
+    for c in clusters:
+        if not c.is_dead and c.placement.owner_of(tenant) == c.rank:
+            return c
+    raise AssertionError(f"no live owner for {tenant}")
+
+
+# ---------------------------------------------------------------- framing
+class TestFraming:
+    def test_pack_unpack_round_trip_bitwise(self):
+        rng = np.random.default_rng(0)
+        args = (
+            rng.random((7, 3), dtype=np.float32),
+            rng.integers(0, 9, 11).astype(np.int64),
+        )
+        kwargs = {"weight": rng.random(11), "mask": rng.random(4) > 0.5}
+        payload = pack_frames(args, kwargs)
+        out_args, out_kwargs = unpack_frames(payload)
+        assert len(out_args) == len(args)
+        for got, want in zip(out_args, args):
+            assert got.dtype == want.dtype and got.shape == want.shape
+            assert got.tobytes() == want.tobytes()
+        assert set(out_kwargs) == set(kwargs)
+        for name, want in kwargs.items():
+            got = out_kwargs[name]
+            assert got.dtype == np.asarray(want).dtype
+            assert got.tobytes() == np.asarray(want).tobytes()
+
+    def test_unpack_is_zero_copy(self):
+        payload = pack_frames((np.arange(8, dtype=np.float64),), {})
+        (arr,), _ = unpack_frames(payload)
+        # np.frombuffer views over the wire buffer: no copy on unpack.
+        assert arr.base is not None
+
+    def test_bad_magic_raises(self):
+        with pytest.raises(ValueError):
+            unpack_frames(b"NOPE" + b"\x00" * 16)
+
+    def test_device_arrays_pull_to_host(self):
+        batch = _batches(1, seed=3)[0]
+        args, kwargs = unpack_frames(pack_frames(batch, {}))
+        assert args[0].tobytes() == np.asarray(batch[0]).tobytes()
+        assert args[1].tobytes() == np.asarray(batch[1]).tobytes()
+        assert not kwargs
+
+
+# ---------------------------------------------------------- tag namespace
+class TestTagNamespace:
+    def test_serve_tag_prefixes_and_is_idempotent(self):
+        assert serve_tag("m/0/1/0") == SERVE_TAG_NAMESPACE + "m/0/1/0"
+        assert serve_tag(serve_tag("x")) == SERVE_TAG_NAMESPACE + "x"
+
+    def test_serve_wire_traffic_confined_to_namespace(self, tmp_path):
+        """Every undelivered serve-plane mailbox entry lives under the
+        ``serve/`` tag prefix — the invariant that makes cross-delivery
+        with another protocol's tags impossible."""
+        w, clusters = _make(2, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenant = owned[1][0]
+        _open_everywhere(clusters, [tenant])
+        clusters[0].submit(tenant, *_batches(1, seed=5)[0])
+        serve_keys = [k for k in w._mail if k[2].startswith("serve/")]
+        assert serve_keys, "routed submit left no serve-plane mail"
+        assert all(
+            k[2].startswith(SERVE_TAG_NAMESPACE) for k in w._mail
+        ), f"serve traffic leaked outside the namespace: {list(w._mail)}"
+
+    def test_concurrent_fleet_merge_and_routing_no_cross_delivery(
+        self, tmp_path
+    ):
+        """Regression for the tag-collision hazard: a fleet_merge round
+        and serve routing share one group's p2p transport; both must
+        land bit-exact with neither protocol stealing the other's
+        envelopes."""
+        w, clusters = _make(2, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenant = owned[1][0]
+        _open_everywhere(clusters, [tenant])
+        batches = _batches(3, seed=7)
+
+        def merge_metric(rank):
+            m = BinaryAUROC()
+            rng = np.random.default_rng(100 + rank)
+            scores = rng.random(200)
+            targets = (rng.random(200) < scores).astype(np.float64)
+            m.update(jnp.asarray(scores), jnp.asarray(targets))
+            return m
+
+        reference_metrics = [merge_metric(r) for r in range(2)]
+        for m in reference_metrics:
+            m._prepare_for_merge_state()
+        reference_metrics[0].merge_state(reference_metrics[1:])
+        merge_reference = float(reference_metrics[0].compute())
+
+        merge_outs = [None, None]
+        policy = MergePolicy(level_deadline=5.0, poll_slice=0.01)
+
+        def merge_worker(rank):
+            merge_outs[rank] = fleet_merge(
+                merge_metric(rank),
+                w.group(rank),
+                topology="tree",
+                policy=policy,
+            )
+
+        threads = [
+            threading.Thread(target=merge_worker, args=(r,), daemon=True)
+            for r in range(2)
+        ]
+        for t in threads:
+            t.start()
+        for b in batches:
+            out = clusters[0].submit(tenant, *b)
+            assert out.action == "routed", out
+            _step_all(clusters, rounds=2)
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "merge hung"
+        assert float(merge_outs[0].value) == merge_reference
+        assert not merge_outs[0].partial
+        _wait_applied(clusters, tenant, len(batches))
+        result = clusters[1].results(tenant)
+        assert result.action == "local"
+        _assert_bitwise(result.value, _solo(batches))
+
+
+# ------------------------------------------------------ placement/routing
+class TestPlacementRouting:
+    @pytest.mark.parametrize("world", [2, 4, 8])
+    def test_placement_deterministic_across_hosts(self, world, tmp_path):
+        _, clusters = _make(world, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        for tenants in owned.values():
+            for t in tenants:
+                owners = {c.placement.owner_of(t) for c in clusters}
+                assert len(owners) == 1
+        fingerprints = {c.stats()["fingerprint"] for c in clusters}
+        assert len(fingerprints) == 1
+
+    @pytest.mark.parametrize("world", [2, 4, 8])
+    def test_routed_submits_bit_identical(self, world, tmp_path):
+        _, clusters = _make(world, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenants = [ts[0] for ts in owned.values()]
+        _open_everywhere(clusters, tenants)
+        streams = {t: _batches(3, seed=10 + i) for i, t in enumerate(tenants)}
+        for t in tenants:
+            for b in streams[t]:
+                out = clusters[0].submit(t, *b)
+                assert out.action in ("local", "routed"), out
+        for t in tenants:
+            _wait_applied(clusters, t, 3)
+        for t in tenants:
+            result = _owner_cluster(clusters, t).results(t)
+            assert result.action == "local", result
+            _assert_bitwise(result.value, _solo(streams[t]))
+        # The remote results wire path: rank 0 queries a tenant it does
+        # not own while the owner is stepped concurrently.
+        remote = next(
+            t for t in tenants if clusters[0].placement.owner_of(t) != 0
+        )
+        result = _drive_call(
+            lambda: clusters[0].results(remote, timeout_s=30.0), clusters
+        )
+        assert result.action == "local", result
+        _assert_bitwise(result.value, _solo(streams[remote]))
+        stats = _wait_converged(clusters)
+        assert all(not s["dead"] and not s["lost"] for s in stats)
+        counts = clusters[0].stats()["counts"]
+        assert counts["routed"] == (len(tenants) - 1) * 3
+        assert counts["local"] == 3
+
+    def test_route_window_backpressure_sheds_typed(self, tmp_path):
+        _, clusters = _make(2, tmp_path, route_window=2)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenant = owned[1][0]
+        _open_everywhere(clusters, [tenant])
+        batches = _batches(3, seed=21)
+        # The owner never steps: two in flight fill the window, the
+        # third sheds at the sender — typed, no exception, no wire.
+        assert clusters[0].submit(tenant, *batches[0]).action == "routed"
+        assert clusters[0].submit(tenant, *batches[1]).action == "routed"
+        shed = clusters[0].submit(tenant, *batches[2])
+        assert shed.action == "shed" and shed.detail == "route-window"
+        assert clusters[0].stats()["counts"]["shed_window"] == 1
+        # Let the owner apply and the acks drain the sender's window.
+        _until(
+            lambda: clusters[0]._streams[tenant].applied >= 1,
+            clusters,
+            msg="applied cursor acked back",
+        )
+        retried = clusters[0].submit(tenant, *batches[2])
+        assert retried.action == "routed", retried
+        _wait_applied(clusters, tenant, 3)
+        _assert_bitwise(clusters[1].results(tenant).value, _solo(batches))
+
+    def test_remote_shed_signal_propagates_to_sender(self, tmp_path):
+        _, clusters = _make(2, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenant = owned[1][0]
+        _open_everywhere(clusters, [tenant])
+        batches = _batches(2, seed=22)
+        plan = FaultPlan(
+            [
+                {
+                    "site": "serve.admit",
+                    "action": "raise",
+                    "match": {"tenant": tenant},
+                    "count": None,
+                }
+            ]
+        )
+        with plan:
+            assert clusters[0].submit(tenant, *batches[0]).action == "routed"
+            # The owner parks the frame (admission refused it), flags
+            # shedding, and the signal rides the next ack back.
+            _until(
+                lambda: clusters[0]._streams[tenant].remote_shedding,
+                clusters,
+                msg="owner shed signal reached the sender",
+            )
+            shed = clusters[0].submit(tenant, *batches[1])
+            assert shed.action == "shed" and shed.detail == "remote-shed"
+            assert clusters[0].stats()["counts"]["shed_remote"] == 1
+        # Fault lifted: the retry sweep applies the parked frame and
+        # the all-clear (sh=False) rides the next ack back.
+        _wait_applied(clusters, tenant, 1)
+        _until(
+            lambda: not clusters[0]._streams[tenant].remote_shedding,
+            clusters,
+            msg="owner shed signal cleared at the sender",
+        )
+        assert clusters[0].submit(tenant, *batches[1]).action == "routed"
+        _wait_applied(clusters, tenant, 2)
+        _assert_bitwise(clusters[1].results(tenant).value, _solo(batches))
+
+
+# ------------------------------------------------------------- migration
+class TestLiveMigration:
+    def test_migration_hands_off_bit_exact(self, tmp_path):
+        _, clusters = _make(4, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenants = [ts[0] for ts in owned.values()]
+        _open_everywhere(clusters, tenants)
+        source = next(r for r in owned if r != 0)
+        tenant = owned[source][0]
+        target = next(r for r in range(4) if r not in (0, source))
+        batches = _batches(3, seed=30)
+        for b in batches[:2]:
+            assert clusters[0].submit(tenant, *b).action == "routed"
+        _wait_applied(clusters, tenant, 2)
+        epoch_before = clusters[0].stats()["epoch"]
+
+        out = _drive_call(
+            lambda: clusters[source].migrate(tenant, target, timeout_s=30.0),
+            clusters,
+        )
+        assert out.action == "migrated" and out.owner == target, out
+        stats = _wait_converged(clusters)
+        assert all(s["epoch"] == epoch_before + 1 for s in stats)
+        assert all(c.placement.owner_of(tenant) == target for c in clusters)
+        # The source evicted its copy; exactly one resident owner.
+        assert clusters[source].service.session(tenant) is None
+        assert clusters[source].stats()["migration_count"] == 1
+        assert clusters[source].stats()["counts"]["migrations"] == 1
+        # Post-handoff traffic reaches the new owner and the full
+        # stream computes bit-exact — nothing lost, nothing doubled.
+        assert clusters[0].submit(tenant, *batches[2]).action == "routed"
+        _wait_applied(clusters, tenant, 3)
+        result = clusters[target].results(tenant)
+        assert result.action == "local"
+        _assert_bitwise(result.value, _solo(batches))
+
+    def test_rebalancer_moves_hot_tenant_to_cold_host(self, tmp_path):
+        _metering.enable()
+        try:
+            _, clusters = _make(2, tmp_path)
+            owned = _tenants_per_rank(clusters[0], 3)
+            tenants = owned[0] + owned[1][:1]
+            _open_everywhere(clusters, tenants)
+            for i, t in enumerate(owned[0]):
+                for b in _batches(2, seed=40 + i):
+                    assert clusters[0].submit(t, *b).action == "local"
+            _until(
+                lambda: all(
+                    clusters[0].service.session(t).batches >= 2
+                    for t in owned[0]
+                ),
+                clusters,
+                msg="local tenants pumped",
+            )
+            outs = _drive_call(
+                lambda: clusters[0].rebalance_once(min_gap=2), clusters
+            )
+            assert len(outs) == 1 and outs[0].action == "migrated", outs
+            moved = outs[0].tenant
+            assert moved in owned[0]
+            stats = _wait_converged(clusters)
+            assert all(c.placement.owner_of(moved) == 1 for c in clusters)
+            assert all(moved not in s["lost"] for s in stats)
+            # Census is balanced now: another pass finds no gap.
+            assert clusters[0].rebalance_once(min_gap=2) == []
+        finally:
+            _metering.reset()
+
+
+# ----------------------------------------------------------------- chaos
+def _seed_cluster(world, tmp_path, per_rank=1, death_timeout_s=1.5):
+    """Common chaos preamble: clusters up, tenants spread, two batches
+    per tenant applied.  Returns (clusters, owned, streams) where each
+    stream holds three batches — the third is dealt by the scenario."""
+    _, clusters = _make(world, tmp_path, death_timeout_s=death_timeout_s)
+    owned = _tenants_per_rank(clusters[0], per_rank)
+    tenants = [t for ts in owned.values() for t in ts]
+    _open_everywhere(clusters, tenants)
+    streams = {t: _batches(3, seed=50 + i) for i, t in enumerate(tenants)}
+    for t in tenants:
+        for b in streams[t][:2]:
+            out = clusters[0].submit(t, *b)
+            assert out.action in ("local", "routed"), out
+    for t in tenants:
+        _wait_applied(clusters, t, 2)
+    return clusters, owned, streams
+
+
+def _assert_survivors_intact(
+    clusters, owned, streams, victim, expect_lost, submit_final=()
+):
+    """Post-failover invariants shared by every kill scenario: one
+    consistent ring on the survivors, no surviving tenant moved or
+    lost, every surviving tenant bit-identical to its solo reference
+    over the full three-batch stream, every expected loss typed."""
+    survivors = [c for c in clusters if not c.is_dead]
+    assert all(c.rank != victim for c in survivors)
+    stats = _wait_converged(clusters)
+    assert all(victim in s["dead"] for s in stats)
+    # Only the dead host's never-spilled sessions may be lost.
+    all_lost = set().union(*(set(s["lost"]) for s in stats))
+    assert all_lost <= set(expect_lost), (all_lost, expect_lost)
+    # Consistent hashing: survivors' tenants never moved.
+    for rank, tenants in owned.items():
+        if rank == victim:
+            continue
+        for t in tenants:
+            assert all(
+                c.placement.owner_of(t) == rank for c in survivors
+            ), f"surviving tenant {t} moved off rank {rank}"
+    for t in submit_final:
+        out = clusters[0].submit(t, *streams[t][2])
+        assert out.action in ("local", "routed"), (t, out)
+    for tenants in owned.values():
+        for t in tenants:
+            if t in expect_lost:
+                continue
+            _wait_applied(clusters, t, 3)
+            result = _owner_cluster(clusters, t).results(t)
+            assert result.action == "local", (t, result)
+            _assert_bitwise(result.value, _solo(streams[t]))
+    for t in expect_lost:
+        oc = _owner_cluster(clusters, t)
+        _until(
+            lambda t=t, oc=oc: t in oc.stats()["lost"],
+            clusters,
+            msg=f"{t} reported lost on its repair owner",
+        )
+        assert oc.results(t).action == "lost"
+
+
+class TestChaosHostFailover:
+    @pytest.mark.parametrize("world", [2, 4, 8])
+    def test_host_death_mid_dispatch(self, world, tmp_path):
+        """A host dies inside ``serve.route``/apply with routed frames
+        in its inbox: survivors excise it, the ring repairs, the
+        spilled tenant resumes bit-exact (including the in-flight
+        batch, re-driven from the sender's retained frames), and the
+        never-spilled tenant is reported lost — typed."""
+        clusters, owned, streams = _seed_cluster(world, tmp_path, per_rank=2)
+        victim = next(r for r in owned if r != 0)
+        t_spill, t_fresh = owned[victim]
+        # Durable state for exactly one of the victim's tenants.
+        clusters[victim].service.pump()
+        clusters[victim].service.spill(t_spill)
+        plan = FaultPlan(
+            [
+                {
+                    "site": "serve.route",
+                    "action": "drop_rank",
+                    "match": {"rank": victim, "role": "apply"},
+                }
+            ]
+        )
+        with plan:
+            for tenants in owned.values():
+                for t in tenants:
+                    out = clusters[0].submit(t, *streams[t][2])
+                    assert out.action in ("local", "routed"), out
+            _until(
+                lambda: clusters[victim].is_dead,
+                clusters,
+                msg="victim killed mid-dispatch",
+            )
+            assert plan.fired and plan.fired[0].context["role"] == "apply"
+        _wait_dead(clusters, victim)
+        _assert_survivors_intact(
+            clusters, owned, streams, victim, expect_lost=[t_fresh]
+        )
+        # The sender sees the loss typed on both paths.
+        _until(
+            lambda: t_fresh in clusters[0].stats()["lost"],
+            clusters,
+            msg="loss propagated to the sender",
+        )
+        assert clusters[0].submit(t_fresh, *streams[t_fresh][0]).action == (
+            "lost"
+        )
+        assert clusters[0].results(t_fresh).action == "lost"
+
+    @pytest.mark.parametrize("world", [2, 4, 8])
+    @pytest.mark.parametrize("phase", ["spill", "stream"])
+    def test_source_death_mid_migration(self, world, phase, tmp_path):
+        """The migration source dies at the spill or stream phase: the
+        handoff never commits, survivors repair, and the tenant resumes
+        bit-exact from its last durable spill — the in-flight batches
+        re-driven from the sender's retained frames."""
+        clusters, owned, streams = _seed_cluster(world, tmp_path, per_rank=2)
+        source = next(r for r in owned if r != 0)
+        tenant, t_fresh = owned[source]
+        target = (
+            next(r for r in range(world) if r not in (0, source))
+            if world > 2
+            else 0
+        )
+        if phase == "spill":
+            # The kill lands before migrate()'s own spill: durability
+            # must come from an earlier spill.
+            clusters[source].service.pump()
+            clusters[source].service.spill(tenant)
+        plan = FaultPlan(
+            [
+                {
+                    "site": "serve.migrate",
+                    "action": "drop_rank",
+                    "match": {"phase": phase, "rank": source},
+                }
+            ]
+        )
+        with plan:
+            out = clusters[source].migrate(tenant, target, timeout_s=30.0)
+        assert out.action == "dead", out
+        assert clusters[source].is_dead
+        _wait_dead(clusters, source)
+        survivors_tenants = [
+            t
+            for r, ts in owned.items()
+            if r != source
+            for t in ts
+        ] + [tenant]
+        _assert_survivors_intact(
+            clusters,
+            owned,
+            streams,
+            source,
+            expect_lost=[t_fresh],
+            submit_final=survivors_tenants,
+        )
+        new_owner = _owner_cluster(clusters, tenant)
+        assert new_owner.stats()["counts"]["recovered"] >= 1
+
+    @pytest.mark.parametrize("world", [2, 4, 8])
+    def test_target_death_mid_resume(self, world, tmp_path):
+        """The migration target dies after the blob arrived but before
+        resuming: the source aborts typed, keeps serving the tenant
+        bit-exact, and the fleet converges around the dead target."""
+        clusters, owned, streams = _seed_cluster(world, tmp_path)
+        source = 0
+        tenant = owned[source][0]
+        target = next(r for r in owned if r != source)
+        plan = FaultPlan(
+            [
+                {
+                    "site": "serve.migrate",
+                    "action": "drop_rank",
+                    "match": {"phase": "resume", "rank": target},
+                }
+            ]
+        )
+        with plan:
+            out = _drive_call(
+                lambda: clusters[source].migrate(
+                    tenant, target, timeout_s=30.0
+                ),
+                clusters,
+            )
+        assert out.action == "aborted", out
+        assert clusters[target].is_dead
+        _wait_dead(clusters, target)
+        survivors_tenants = [
+            t for r, ts in owned.items() if r != target for t in ts
+        ]
+        _assert_survivors_intact(
+            clusters,
+            owned,
+            streams,
+            target,
+            expect_lost=owned[target],
+            submit_final=survivors_tenants,
+        )
+        # The aborted handoff never moved the tenant or lost a batch.
+        assert clusters[source].placement.owner_of(tenant) == source
+        assert clusters[source].service.session(tenant) is not None
+        assert clusters[source].stats()["counts"]["migrations_aborted"] >= 1
+
+    def test_killed_host_goes_typed_dead(self, tmp_path):
+        """A killed host answers every API call with a typed ``dead``
+        outcome — never an exception — and survivors excise it."""
+        clusters, owned, streams = _seed_cluster(2, tmp_path)
+        victim = 1
+        clusters[victim].kill()
+        _wait_dead(clusters, victim)
+        assert victim in clusters[0].stats()["dead"]
+        tenant = owned[victim][0]
+        assert clusters[victim].submit(tenant, *streams[tenant][2]).action == (
+            "dead"
+        )
+        assert clusters[victim].results(tenant).action == "dead"
+        assert clusters[victim].migrate(tenant, 0).action == "dead"

@@ -313,6 +313,50 @@ class TestWorkerAndDrain(_SpillDirMixin):
     def test_stop_without_start_is_noop(self):
         EvalService().stop()
 
+    def test_drain_deadline_expiry_spills_partial(self):
+        """An expired drain deadline returns a typed partial result —
+        never hangs — and rescues undispatched sessions through the
+        checkpoint path so their state survives the shutdown."""
+        svc = EvalService(group_width=2, spill_dir=self._tmp())
+        batches = _batches(32, seed=7)
+        svc.open("a", _suite())
+        for b in batches:
+            svc.submit("a", *b)  # queued, never pumped
+        result = svc.drain(deadline_s=0.0)
+        self.assertIsInstance(result, serve.DrainResult)
+        self.assertTrue(result.expired)
+        self.assertFalse(result.flushed)
+        self.assertEqual(result.spilled, 1)
+        self.assertEqual(result.unspilled, ())
+        self.assertGreater(result.pending, 0)
+        self.assertLessEqual(
+            result.pending + result.processed, len(batches)
+        )
+        # The rescued state is durable: a fresh service resumes it and
+        # nothing queued-but-undispatched was silently double-counted.
+        self.assertEqual(svc.stats()["tenants"], {"spilled": 1})
+
+    def test_drain_deadline_without_spill_path_names_unspilled(self):
+        svc = EvalService(group_width=2)  # no spill_dir
+        svc.open("a", _suite())
+        for b in _batches(3, seed=8):
+            svc.submit("a", *b)
+        result = svc.drain(deadline_s=0.0)
+        self.assertTrue(result.expired)
+        self.assertEqual(result.spilled, 0)
+        self.assertEqual(result.unspilled, ("a",))
+
+    def test_drain_result_keeps_dict_compat(self):
+        svc = EvalService(group_width=2, spill_dir=self._tmp())
+        svc.open("a", _suite())
+        svc.submit("a", *_batches(1, seed=9)[0])
+        result = svc.drain(deadline_s=60.0)
+        # Callers of the old dict-shaped summary keep working.
+        for key in ("processed", "flushed", "pending", "expired"):
+            self.assertEqual(result[key], getattr(result, key))
+        self.assertEqual(result["processed"], 1)
+        self.assertFalse(result["expired"])
+
 
 if __name__ == "__main__":
     unittest.main()

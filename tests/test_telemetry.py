@@ -206,6 +206,12 @@ class TestAllKindsRoundTrip(TelemetryIsolation):
         )
         for action in ("open", "spill", "resume", "close", "drain"):
             ev.record_session(action, "rt-tenant")
+        # placement — the serve cluster's routing/migration hook (the
+        # real ring/migration paths are covered by tests/serve/
+        # test_cluster; recording directly keeps this deterministic).
+        ev.record_placement(
+            "migrate", "rt-tenant", src=0, dst=1, epoch=1, generation=3
+        )
         # tenant_sample — the serve metering ledger's publish hook.
         import torcheval_tpu.serve.metering as metering
 

@@ -440,6 +440,43 @@ _DECLARATIONS: Tuple[Flag, ...] = (
         ),
     ),
     Flag(
+        name="SERVE_VNODES",
+        kind="int",
+        default=64,
+        doc=(
+            "Virtual nodes per host on the serve cluster's consistent-"
+            "hash placement ring (``serve/placement.py``); more vnodes "
+            "smooth the per-host tenant load at O(hosts x vnodes) ring-"
+            "build cost.  Read when a ``ServeCluster`` is constructed."
+        ),
+        validate=_positive,
+    ),
+    Flag(
+        name="SERVE_ROUTE_WINDOW",
+        kind="int",
+        default=64,
+        doc=(
+            "Per-tenant in-flight window for cross-host routed batches "
+            "(``serve/cluster.py``): a sender with this many unacked "
+            "batches outstanding sheds locally instead of piling more "
+            "onto a backlogged owner — the backpressure half of the "
+            "remote AdmissionController's shed/queue-depth signals."
+        ),
+        validate=_positive,
+    ),
+    Flag(
+        name="SERVE_HEARTBEAT_MS",
+        kind="int",
+        default=1000,
+        doc=(
+            "Serve-cluster heartbeat/gossip period (milliseconds); "
+            "host death is declared after 5 missed heartbeats and "
+            "triggers ring repair.  Read when a ``ServeCluster`` is "
+            "constructed."
+        ),
+        validate=_positive,
+    ),
+    Flag(
         name="TENANT_METERING",
         kind="tribool",
         default=None,

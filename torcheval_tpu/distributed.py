@@ -28,6 +28,7 @@ path (``psum`` inside ``shard_map``) that never touches this byte layer; see
 from __future__ import annotations
 
 import pickle
+import struct
 import threading
 import time
 from abc import ABC, abstractmethod
@@ -662,6 +663,111 @@ class LocalGroup(CollectiveGroup):
                     raise PeerTimeoutError(src, tag, timeout)
             payload = self._world._mail.pop(key)
         return pickle.loads(payload)
+
+
+# --------------------------------------------------------------------------
+# Serve-plane tag namespace.
+#
+# Both p2p transports key undelivered messages by (dst, src, tag) — the
+# LocalWorld mailbox dict and the JaxProcessGroup KV store alike — so two
+# protocols sharing a group MUST NOT mint the same tag.  The hierarchical
+# merge derives its tags from a round id (``fm{round}/...``,
+# ``parallel/fleet_merge.py``); the serve cluster's traffic is long-lived
+# and round-free, so every serve-plane tag goes through ``serve_tag()``
+# and lives under this prefix.  A concurrent fleet_merge round and a
+# migration on the same group can then never cross-deliver envelopes
+# (regression: ``tests/serve/test_cluster.py::TagNamespaceTest``).
+SERVE_TAG_NAMESPACE = "serve/"
+
+
+def serve_tag(tag: str) -> str:
+    """Namespace a serve-plane p2p tag under :data:`SERVE_TAG_NAMESPACE`.
+    Idempotent; the cluster routes every send/recv through here so no
+    raw serve tag can collide with another protocol's."""
+    if tag.startswith(SERVE_TAG_NAMESPACE):
+        return tag
+    return SERVE_TAG_NAMESPACE + tag
+
+
+# --------------------------------------------------------------------------
+# Length-prefixed array framing for the serve plane's cross-host batches.
+#
+# A routed submit must not become Python object soup on the hot path: the
+# sender flattens the batch (positional arrays + array keywords) into ONE
+# contiguous bytes payload of length-prefixed frames, and the receiver
+# reassembles numpy views with ``np.frombuffer`` — zero copies on unpack,
+# feeding the service's block assembly directly.
+
+_FRAME_MAGIC = b"TEF1"
+
+
+def _frame_array(name: str, value: Any) -> bytes:
+    arr = np.ascontiguousarray(np.asarray(value))
+    name_b = name.encode("utf-8")
+    dtype_b = arr.dtype.str.encode("ascii")
+    head = struct.pack(
+        f"<H{len(name_b)}sH{len(dtype_b)}sB",
+        len(name_b),
+        name_b,
+        len(dtype_b),
+        dtype_b,
+        arr.ndim,
+    )
+    shape = struct.pack(f"<{arr.ndim}Q", *arr.shape)
+    body = arr.tobytes()
+    return head + shape + struct.pack("<Q", len(body)) + body
+
+
+def pack_frames(
+    args: Any = (), kwargs: Optional[dict] = None
+) -> bytes:
+    """Serialize positional arrays and array keywords into one framed
+    bytes payload (device arrays are pulled to host first)."""
+    args = tuple(args)
+    kwargs = dict(kwargs or {})
+    out = [
+        _FRAME_MAGIC,
+        struct.pack("<HH", len(args), len(kwargs)),
+    ]
+    for i, value in enumerate(args):
+        out.append(_frame_array(str(i), value))
+    for name in sorted(kwargs):
+        out.append(_frame_array(name, kwargs[name]))
+    return b"".join(out)
+
+
+def unpack_frames(payload: bytes) -> tuple:
+    """Inverse of :func:`pack_frames`: ``(args, kwargs)`` of numpy
+    arrays built as zero-copy views over the payload buffer."""
+    view = memoryview(payload)
+    if bytes(view[:4]) != _FRAME_MAGIC:
+        raise ValueError("not a framed batch payload (bad magic)")
+    npos, nkw = struct.unpack_from("<HH", view, 4)
+    off = 8
+    frames = []
+    for _ in range(npos + nkw):
+        (name_len,) = struct.unpack_from("<H", view, off)
+        off += 2
+        name = bytes(view[off : off + name_len]).decode("utf-8")
+        off += name_len
+        (dtype_len,) = struct.unpack_from("<H", view, off)
+        off += 2
+        dtype = np.dtype(bytes(view[off : off + dtype_len]).decode("ascii"))
+        off += dtype_len
+        (ndim,) = struct.unpack_from("<B", view, off)
+        off += 1
+        shape = struct.unpack_from(f"<{ndim}Q", view, off)
+        off += 8 * ndim
+        (nbytes,) = struct.unpack_from("<Q", view, off)
+        off += 8
+        arr = np.frombuffer(view[off : off + nbytes], dtype=dtype).reshape(
+            shape
+        )
+        off += nbytes
+        frames.append((name, arr))
+    args = tuple(arr for _, arr in frames[:npos])
+    kwargs = {name: arr for name, arr in frames[npos:]}
+    return args, kwargs
 
 
 def default_group() -> CollectiveGroup:

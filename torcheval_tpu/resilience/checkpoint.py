@@ -67,6 +67,20 @@ class Checkpoint:
     nbytes: int
 
 
+@dataclass
+class CheckpointBlob:
+    """One generation as wire-ready bytes: the raw pickled payload plus
+    its manifest (sha256/nbytes/cursor).  This is what the serve
+    cluster streams p2p during a live migration — the manifest travels
+    WITH the bytes so the importer can prove the transfer intact before
+    any state is trusted, and the generation number fences stale
+    owners (a replayed older blob can never shadow a newer one)."""
+
+    generation: int
+    manifest: Dict[str, Any]
+    payload: bytes
+
+
 def _fsync_write(path: str, data: bytes) -> None:
     """tmp-file + flush + fsync + atomic rename into ``path``."""
     tmp = path + ".tmp"
@@ -287,6 +301,96 @@ class CheckpointManager:
             cursor=dict(record["cursor"]),
             nbytes=len(payload),
         )
+
+    # -- p2p streaming ---------------------------------------------------
+    def export_latest(self) -> Optional[CheckpointBlob]:
+        """The newest *valid* generation as a wire-ready
+        :class:`CheckpointBlob` (payload bytes + manifest), for
+        streaming over a p2p transport during a live migration.  Walks
+        newest-first like :meth:`load_latest` but leaves quarantine
+        policy to the readers; returns None when nothing validates."""
+        for generation in reversed(self.generations()):
+            try:
+                with open(self._manifest_path(generation), "rb") as fh:
+                    manifest = json.loads(fh.read().decode("utf-8"))
+                with open(self._data_path(generation), "rb") as fh:
+                    payload = fh.read()
+            except (OSError, ValueError, UnicodeDecodeError):
+                continue
+            if (
+                len(payload) != manifest.get("nbytes")
+                or hashlib.sha256(payload).hexdigest()
+                != manifest.get("sha256")
+            ):
+                continue
+            return CheckpointBlob(
+                generation=generation,
+                manifest=manifest,
+                payload=payload,
+            )
+        return None
+
+    def import_blob(self, blob: CheckpointBlob) -> bool:
+        """Install a streamed generation received over the wire.
+
+        The payload is validated against the manifest it traveled with
+        (sha256 + byte length) BEFORE anything durable is trusted:
+
+        * valid → written with the same data-then-manifest fsync dance
+          as :meth:`save`, so :meth:`load_latest` resumes from it.
+          Idempotent: re-importing a generation whose local files
+          already validate is a no-op (shared-store deployments see the
+          owner's own save under the same name).
+        * torn/corrupt transfer → the bytes are preserved under
+          ``.corrupt`` paths for the post-mortem (never touching any
+          resident generation's files), a ``checkpoint``/``quarantine``
+          telemetry event fires, and False is returned — the importer
+          must not resume from it.
+        """
+        manifest = dict(blob.manifest)
+        generation = int(manifest.get("generation", blob.generation))
+        payload = blob.payload
+        valid = (
+            generation >= 0
+            and len(payload) == manifest.get("nbytes")
+            and hashlib.sha256(payload).hexdigest()
+            == manifest.get("sha256")
+        )
+        if not valid:
+            quarantine_path = (
+                self._data_path(max(generation, 0)) + ".corrupt"
+            )
+            try:
+                with open(quarantine_path, "wb") as fh:
+                    fh.write(payload)
+            except OSError:  # pragma: no cover - disk gone mid-import
+                pass
+            if _telemetry.ENABLED:
+                _telemetry.record_checkpoint(
+                    "quarantine", quarantine_path, max(generation, 0), 0, 0.0
+                )
+            return False
+        if self._load_one(generation, newest=False) not in (
+            None,
+            _CONCURRENTLY_PRUNED,
+        ):
+            return True
+        t0 = time.monotonic()
+        _fsync_write(self._data_path(generation), payload)
+        _fsync_write(
+            self._manifest_path(generation),
+            json.dumps(manifest, sort_keys=True).encode("utf-8"),
+        )
+        self._prune()
+        if _telemetry.ENABLED:
+            _telemetry.record_checkpoint(
+                "save",
+                self._data_path(generation),
+                generation,
+                len(payload),
+                time.monotonic() - t0,
+            )
+        return True
 
     def _quarantine(self, generation: int) -> None:
         data_path = self._data_path(generation)

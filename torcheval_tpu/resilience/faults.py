@@ -50,6 +50,22 @@ in action):
     the matched rank vanish mid-merge (it stops sending/acking from
     that level on, so peers must excise and re-parent around it);
     ``action="slow_rank"`` turns it into a ``delay_s`` straggler.
+``serve.route``
+    Per placement decision in the distributed serve plane
+    (``serve/cluster.py``; context: ``tenant``, ``rank``, ``role`` —
+    ``"submit"`` on the sender, ``"apply"`` on the owner applying a
+    routed frame).  ``action="drop_rank"`` kills the host typed (the
+    cluster catches it and goes silent — a mid-dispatch death with
+    batches still in its inbox); ``action="raise"`` sheds the batch
+    (sender side) or parks the frame for the retry sweep (owner side).
+``serve.migrate``
+    Per phase of a live tenant migration (``serve/cluster.py``;
+    context: ``tenant``, ``phase`` ∈ ``spill``/``stream``/``resume``,
+    ``rank``, ``target``).  ``action="drop_rank"`` at ``spill`` or
+    ``stream`` kills the source mid-handoff; at ``resume`` it kills
+    the target after the blob arrived — the source aborts and the
+    tenant stays bit-exact where it last spilled.  ``action="raise"``
+    aborts the handoff typed (``PlacementOutcome(action="aborted")``).
 
 Reproducibility: probabilistic rules (``probability < 1``) draw from a
 ``numpy`` generator seeded by ``FaultPlan(seed=)``; draws are consumed

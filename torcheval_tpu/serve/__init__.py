@@ -15,6 +15,12 @@ reads it back as typed placement signals — queue depth, shed rate,
 spill churn, attributed device-seconds — plus a noisy-neighbour
 verdict.
 
+The distributed tier (:mod:`~torcheval_tpu.serve.cluster` +
+:mod:`~torcheval_tpu.serve.placement`) shards tenants across hosts on
+a consistent-hash ring, routes batches p2p with backpressure, migrates
+sessions live through the checkpoint path, and repairs the ring around
+dead hosts — every action a typed :class:`PlacementOutcome`.
+
 See ``docs/source/serve.rst`` for the operating model and runbooks.
 """
 
@@ -26,10 +32,16 @@ from torcheval_tpu.serve.admission import (
     Rejected,
     Shed,
 )
+from torcheval_tpu.serve.cluster import ServeCluster
 from torcheval_tpu.serve.metering import (
     RebalanceHints,
     TenantSignal,
     rebalance_hints,
+)
+from torcheval_tpu.serve.placement import (
+    HashRing,
+    Placement,
+    PlacementOutcome,
 )
 from torcheval_tpu.serve.registry import (
     DEFAULT_GROUP_WIDTH,
@@ -38,16 +50,21 @@ from torcheval_tpu.serve.registry import (
     TenantGroup,
     signature_of,
 )
-from torcheval_tpu.serve.service import EvalService
+from torcheval_tpu.serve.service import DrainResult, EvalService
 
 __all__ = [
     "Admitted",
     "AdmissionController",
     "DEFAULT_GROUP_WIDTH",
+    "DrainResult",
     "EvalService",
+    "HashRing",
     "POLICIES",
+    "Placement",
+    "PlacementOutcome",
     "RebalanceHints",
     "Rejected",
+    "ServeCluster",
     "Session",
     "SessionRegistry",
     "Shed",

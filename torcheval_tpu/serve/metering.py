@@ -409,6 +409,14 @@ def has_data() -> bool:
         return bool(_tenants)
 
 
+def _owner_of(tenant: str) -> str:
+    """The tenant's owning host per the cluster placement tier (lazy:
+    the ledger stays importable with telemetry stripped)."""
+    from torcheval_tpu.telemetry import tenants as _tenants_mod
+
+    return _tenants_mod.owner_of(tenant)
+
+
 def ledger_rows(
     dominance_share: float = DEFAULT_DOMINANCE_SHARE,
 ) -> List[Dict[str, Any]]:
@@ -449,6 +457,10 @@ def ledger_rows(
                     "device_seconds": _device_seconds(tenant),
                     "dominant_program": pid,
                     "dominant_share": frac,
+                    # Owning host per the serve cluster's placement
+                    # tier; "" when no cluster is running.  Lazy import
+                    # keeps the ledger importable without telemetry.
+                    "owner": _owner_of(tenant),
                 }
             )
     out.sort(key=lambda r: (-r["device_seconds"], r["tenant"]))

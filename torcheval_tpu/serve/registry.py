@@ -357,6 +357,15 @@ class SessionRegistry:
         self._clock += 1
         session.last_touch = self._clock
 
+    def forget(self, session: Session) -> None:
+        """Drop a session from the registry entirely (seat freed, map
+        entry removed) WITHOUT touching its spill namespace — the
+        cluster's migration commit path: the tenant's durable state now
+        belongs to another host, so ``close()``'s namespace deletion
+        must not run here."""
+        self.release(session)
+        self._sessions.pop(session.tenant, None)
+
     def resident_lru(self) -> List[Session]:
         """Resident sessions, least-recently-touched first."""
         return sorted(

@@ -38,6 +38,7 @@ import threading
 import time
 import warnings
 from collections import deque
+from dataclasses import dataclass
 from typing import Any, Dict, List, Mapping, Optional, Tuple, Union
 
 from torcheval_tpu import _flags
@@ -86,6 +87,33 @@ def _p99(waits: List[float]) -> float:
     ordered = sorted(waits)
     rank = max(0, math.ceil(0.99 * len(ordered)) - 1)
     return ordered[rank]
+
+
+@dataclass(frozen=True)
+class DrainResult:
+    """Typed outcome of :meth:`EvalService.drain`.
+
+    ``expired`` means the deadline fired before the queue emptied; the
+    service then spilled every undispatched resident session it could
+    reach (``spilled``) so their state survives the shutdown, and
+    ``unspilled`` names the ones it could not.  ``stuck`` flags the
+    pathological case: a dispatch wedged inside the pump still holds
+    the service lock at expiry, so the rescue spill could not run at
+    all (the pump helper is leaked as a daemon, mirroring ``stop()``'s
+    contract).  Indexing (``result["processed"]``) is kept for callers
+    of the old dict-shaped summary.
+    """
+
+    processed: int
+    flushed: bool
+    pending: int
+    expired: bool = False
+    spilled: int = 0
+    unspilled: Tuple[str, ...] = ()
+    stuck: bool = False
+
+    def __getitem__(self, key: str) -> Any:
+        return getattr(self, key)
 
 
 class EvalService:
@@ -512,6 +540,70 @@ class EvalService:
                 )
             self._spill_one(session)
 
+    def adopt_spilled(
+        self,
+        tenant: str,
+        metrics: Mapping[str, Metric],
+        *,
+        signature: Optional[Tuple[Any, ...]] = None,
+    ) -> Session:
+        """Register a tenant whose state already lives in this
+        service's spill namespace (cluster failover / migration
+        landing): the session is created directly in the SPILLED state
+        and the next touch resumes it through the normal checkpoint
+        path — bit-exact, via the same ``load_latest`` validation as
+        any other resume."""
+        with self._lock:
+            if self._closed or self._draining:
+                raise RuntimeError(
+                    "EvalService is draining/closed; no new sessions"
+                )
+            if self._spill_root is None:
+                raise RuntimeError(
+                    "adopt_spilled requires spill_dir= (or the "
+                    "TORCHEVAL_TPU_SERVE_SPILL_DIR flag)"
+                )
+            session = self._registry.open(
+                tenant, metrics, signature=signature
+            )
+            self._registry.release(session)
+            session.state = SPILLED
+            if _telemetry.ENABLED:
+                _telemetry.record_session("open", tenant)
+            return session
+
+    def resume(self, tenant: str) -> Session:
+        """Force a spilled tenant resident now (the cluster needs the
+        resumed batch cursor before applying routed batches).  No-op on
+        an already-resident tenant."""
+        with self._lock:
+            session = self._registry.session(tenant)
+            if session is None:
+                raise KeyError(f"unknown tenant {tenant!r}")
+            if session.state in (QUARANTINED, CLOSED):
+                raise RuntimeError(
+                    f"tenant {tenant!r} cannot resume (state="
+                    f"{session.state})"
+                )
+            self._ensure_resident(session)
+            self._registry.touch(session)
+            return session
+
+    def evict(self, tenant: str) -> None:
+        """Forget a tenant WITHOUT deleting its spill namespace — the
+        migration commit: the durable state now belongs to another
+        host, so only the local seat and queue are torn down (contrast
+        :meth:`close`, which prunes the namespace)."""
+        with self._lock:
+            session = self._registry.session(tenant)
+            if session is None:
+                raise KeyError(f"unknown tenant {tenant!r}")
+            self._admission.purge(tenant)
+            self._registry.forget(session)
+            session.state = CLOSED
+            if _telemetry.ENABLED:
+                _telemetry.record_session("close", tenant)
+
     def _spill_one(self, session: Session) -> None:
         t0 = time.monotonic()
         flat = self._registry.seat_state_dict(session)
@@ -519,6 +611,7 @@ class EvalService:
         path = manager.save(flat, {"batches_seen": session.batches})
         self._registry.release(session)
         session.state = SPILLED
+        # tpulint: disable=TPU006 -- caller holds _lock: _spill_one is only reached from locked paths (spill/drain/evict)
         self._counts["spills"] += 1
         if _telemetry.ENABLED:
             _telemetry.record_session(
@@ -583,43 +676,114 @@ class EvalService:
             _metering.record_session("resume", session.tenant)
 
     # --------------------------------------------------------------- drain
-    def drain(self, deadline_s: Optional[float] = None) -> Dict[str, Any]:
-        """Graceful shutdown: stop admission, pump the queue to empty
-        (bounded by ``deadline_s``), final-checkpoint every resident
-        tenant, and close the service.  Idempotent."""
+    def drain(self, deadline_s: Optional[float] = None) -> DrainResult:
+        """Graceful shutdown: stop admission, pump the queue to empty,
+        final-checkpoint every resident tenant, and close the service.
+        Idempotent.
+
+        ``deadline_s`` is a hard bound against a *stuck pump*: the
+        queue is pumped on a helper thread joined against the budget,
+        so a wedged dispatch cannot hang the caller.  On expiry the
+        undispatched sessions are spilled through the checkpoint path
+        (best effort — a dispatch still holding the lock blocks the
+        rescue and is reported as ``stuck``) and a typed partial
+        :class:`DrainResult` is returned instead of hanging."""
         t0 = time.monotonic()
         with self._lock:
             self._draining = True
         self.stop()
         deadline = None if deadline_s is None else t0 + deadline_s
-        processed = 0
-        while deadline is None or time.monotonic() < deadline:
-            if self.pump(1) == 0:
-                break
-            processed += 1
+        expired = False
+        stuck = False
+        if deadline is None:
+            processed = 0
+            while self.pump(1):
+                processed += 1
+        else:
+            drained = threading.Event()
+            abort = threading.Event()
+            counter = {"n": 0}
+
+            def _pump_to_empty() -> None:
+                try:
+                    while not abort.is_set() and self.pump(1):
+                        counter["n"] += 1
+                finally:
+                    drained.set()
+
+            helper = threading.Thread(
+                target=_pump_to_empty,
+                name="torcheval-tpu-drain",
+                daemon=True,
+            )
+            helper.start()
+            if not drained.wait(
+                timeout=max(0.0, deadline - time.monotonic())
+            ):
+                expired = True
+                abort.set()
+                # One grace join: a helper BETWEEN items exits at the
+                # abort check; one wedged INSIDE a dispatch stays stuck
+                # and is leaked as a daemon (stop()'s contract).
+                helper.join(timeout=_IDLE_TICK_S)
+                stuck = helper.is_alive()
+            processed = counter["n"]
         flushed = True
-        with self._lock:
-            if self._spill_root is not None:
+        spilled = 0
+        unspilled: List[str] = []
+        # The rescue spill must not hang either: a stuck dispatch holds
+        # self._lock, so the acquire is bounded and failure is typed.
+        locked = self._lock.acquire(timeout=_JOIN_TIMEOUT_S)
+        if locked:
+            try:
                 for session in self._registry.resident_lru():
-                    if (
-                        deadline is not None
-                        and time.monotonic() >= deadline
-                    ):
-                        flushed = False
-                        break
-                    if session.state == ACTIVE:
+                    if session.state != ACTIVE:
+                        continue
+                    if self._spill_root is not None:
                         self._spill_one(session)
+                        spilled += 1
+                    elif expired:
+                        # No checkpoint path configured: the expired
+                        # drain can only NAME what it left behind.
+                        unspilled.append(session.tenant)
+                # tpulint: disable=TPU006 -- lock IS held: acquired via acquire(timeout=) above, released in the finally
+                pending = self._admission.depth()
+                # tpulint: disable=TPU006 -- lock IS held: acquired via acquire(timeout=) above, released in the finally
+                self._closed = True
+            finally:
+                self._lock.release()
+        else:
+            stuck = True
+            flushed = False
+            # tpulint: disable=TPU006 -- gave-up path: the lock is wedged; depth() locks internally
             pending = self._admission.depth()
+            # tpulint: disable=TPU006 -- gave-up path: the lock is wedged; a bool store is atomic and monotonic
             self._closed = True
+            unspilled = [
+                s.tenant
+                for s in self._registry.sessions().values()
+                if s.state == ACTIVE
+            ]
+        if stuck and _telemetry.ENABLED:
+            _telemetry.record_degraded(
+                "serve.drain",
+                "drain deadline expired with a dispatch still wedged; "
+                "pump helper leaked (daemon)",
+                "leaked_thread",
+            )
         if _telemetry.ENABLED:
             _telemetry.record_session(
                 "drain", "", seconds=time.monotonic() - t0
             )
-        return {
-            "processed": processed,
-            "flushed": flushed and pending == 0,
-            "pending": pending,
-        }
+        return DrainResult(
+            processed=processed,
+            flushed=flushed and pending == 0 and not expired,
+            pending=pending,
+            expired=expired,
+            spilled=spilled,
+            unspilled=tuple(unspilled),
+            stuck=stuck,
+        )
 
     # -------------------------------------------------------------- worker
     def start(self) -> "EvalService":
@@ -677,6 +841,13 @@ class EvalService:
                 RuntimeWarning,
                 stacklevel=2,
             )
+
+    def session(self, tenant: str) -> Optional[Session]:
+        """The tenant's session record, or None (cluster placement and
+        tests peek at lifecycle state without reaching into the
+        registry)."""
+        with self._lock:
+            return self._registry.session(tenant)
 
     # --------------------------------------------------------------- stats
     def stats(self) -> Dict[str, Any]:

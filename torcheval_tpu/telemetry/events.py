@@ -424,6 +424,28 @@ class SessionEvent(Event):
 
 
 @dataclass
+class PlacementEvent(Event):
+    """Serve-cluster placement lifecycle (``serve/cluster.py``).
+    ``action`` is the branch: ``route`` (a batch crossed hosts to its
+    owner), ``migrate`` (a two-phase live handoff landed on ``dst``),
+    ``repair`` (the ring was rebuilt around dead host ``src``),
+    ``recovered`` (a dead host's tenant resumed from its durable
+    spill), ``lost`` (a dead host's unspilled session — state
+    unrecoverable).  ``epoch`` is the placement epoch the action was
+    taken under; ``generation`` carries the checkpoint identity for
+    migrate/recovered."""
+
+    kind: str = field(init=False, default="placement")
+    action: str = "route"
+    tenant: str = ""
+    src: int = -1
+    dst: int = -1
+    epoch: int = 0
+    generation: int = 0
+    seconds: float = 0.0
+
+
+@dataclass
 class TenantSampleEvent(Event):
     """One cumulative per-tenant metering sample from the serve plane's
     ledger (:mod:`torcheval_tpu.serve.metering`): traffic counters,
@@ -455,6 +477,7 @@ class TenantSampleEvent(Event):
     device_seconds: float = 0.0
     dominant_program: str = ""
     dominant_share: float = 0.0
+    owner: str = ""
 
 
 # Every event kind the bus can carry → its dataclass, for the JSON-lines
@@ -488,6 +511,7 @@ KIND_TO_CLASS: Dict[str, type] = {
     "session_close": SessionEvent,
     "session_drain": SessionEvent,
     "tenant_sample": TenantSampleEvent,
+    "placement": PlacementEvent,
 }
 
 
@@ -958,6 +982,7 @@ def _fold(event: Event) -> None:
             "device_seconds": event.device_seconds,
             "dominant_program": event.dominant_program,
             "dominant_share": event.dominant_share,
+            "owner": event.owner,
         }
     elif isinstance(event, QuarantineEvent):
         _agg["serve"]["quarantined"] += 1
@@ -1245,6 +1270,28 @@ def record_session(
     )
 
 
+def record_placement(
+    action: str,
+    tenant: str,
+    src: int = -1,
+    dst: int = -1,
+    epoch: int = 0,
+    generation: int = 0,
+    seconds: float = 0.0,
+) -> None:
+    emit(
+        PlacementEvent(
+            action=action,
+            tenant=tenant,
+            src=int(src),
+            dst=int(dst),
+            epoch=int(epoch),
+            generation=int(generation),
+            seconds=float(seconds),
+        )
+    )
+
+
 def record_tenant_sample(
     tenant: str,
     submits: int = 0,
@@ -1266,6 +1313,7 @@ def record_tenant_sample(
     device_seconds: float = 0.0,
     dominant_program: str = "",
     dominant_share: float = 0.0,
+    owner: str = "",
 ) -> None:
     emit(
         TenantSampleEvent(
@@ -1289,6 +1337,7 @@ def record_tenant_sample(
             device_seconds=float(device_seconds),
             dominant_program=dominant_program,
             dominant_share=float(dominant_share),
+            owner=str(owner),
         )
     )
 

@@ -64,6 +64,7 @@ ROW_FIELDS: Tuple[str, ...] = (
     "device_seconds",
     "dominant_program",
     "dominant_share",
+    "owner",
 )
 
 _SUM_FIELDS = (
@@ -82,6 +83,28 @@ _SUM_FIELDS = (
 )
 _MAX_FIELDS = ("wait_p50_s", "wait_p99_s", "e2e_p50_s", "e2e_p99_s")
 
+# tenant -> owning host label, fed by the serve cluster's placement
+# tier (open / migrate-commit / ring-repair).  Cold-path writes only;
+# empty when no cluster is running, and every row then carries "".
+_OWNERS: Dict[str, str] = {}
+
+
+def note_owner(tenant: str, owner: str) -> None:
+    """Record which host owns ``tenant`` — the serve cluster calls this
+    whenever placement changes so tenant rows and the tenant×host
+    rollup can carry an ``owner`` column."""
+    _OWNERS[tenant] = str(owner)
+
+
+def owner_of(tenant: str) -> str:
+    return _OWNERS.get(tenant, "")
+
+
+def _attach_owner(rows: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    for row in rows:
+        row.setdefault("owner", _OWNERS.get(row.get("tenant", ""), ""))
+    return rows
+
 
 def collect_rows(
     agg: Optional[Dict[str, Any]] = None,
@@ -93,14 +116,14 @@ def collect_rows(
     from torcheval_tpu.serve import metering as _metering
 
     if _metering.ENABLED and _metering.has_data():
-        return _metering.ledger_rows()
+        return _attach_owner(_metering.ledger_rows())
     if agg is None:
         from torcheval_tpu.telemetry import events as _events
 
         agg = _events.aggregates()
     rows = [dict(entry) for entry in agg.get("tenants", {}).values()]
     rows.sort(key=lambda r: (-r.get("device_seconds", 0.0), r["tenant"]))
-    return rows
+    return _attach_owner(rows)
 
 
 def worst_shed(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -242,10 +265,16 @@ def merge_rollups(
                 agg = by_tenant[tenant] = {
                     "tenant": tenant,
                     "hosts": 0,
+                    "owner": "",
                     **{field: 0 for field in _SUM_FIELDS},
                     **{field: 0.0 for field in _MAX_FIELDS},
                 }
             agg["hosts"] += 1
+            # The owner column: any host that knows the tenant's
+            # current placement stamps it; last non-empty wins (the
+            # cluster gossips placement, so survivors agree).
+            if row.get("owner"):
+                agg["owner"] = row["owner"]
             for field in _SUM_FIELDS:
                 agg[field] += row.get(field, 0)
             for field in _MAX_FIELDS:
