@@ -2487,6 +2487,19 @@ def bench_serve_cluster_migration() -> Tuple[str, float, Optional[float]]:
         target=_drive, name="torcheval-tpu-serve-bench-driver", daemon=True
     )
     try:
+        # Warm every host's per-service dispatch + compute programs
+        # BEFORE the driver starts stepping: the death clock only
+        # ticks inside step(), and a per-host cold compile (seconds,
+        # once per service instance) inside the first dispatch would
+        # stretch one driver round past the death timeout — the whole
+        # fleet then excises itself mid-warmup.
+        for cl in clusters:
+            svc = cl.service
+            svc.open("__bench_warm__", suite())
+            svc.submit("__bench_warm__", *batch)
+            svc.pump()
+            np.asarray(svc.results("__bench_warm__")["acc"])
+            svc.close("__bench_warm__")
         driver.start()
         for name in names:
             for cl in clusters:
@@ -2496,13 +2509,15 @@ def bench_serve_cluster_migration() -> Tuple[str, float, Optional[float]]:
         owned = {
             r: [n for n in names if owner_of(n) == r] for r in range(world)
         }
-        # Warm the shared per-signature program on every host so the
-        # timed phase and the chaos timers never race a cold compile.
+        # One routed batch per host also warms the p2p framing path
+        # end to end before the timed phase.
+        base = dispatched_total()
         for r in range(world):
             if owned[r]:
                 clusters[0].submit(owned[r][0], *batch)
         wait_for(
-            lambda: dispatched_total() >= sum(1 for r in owned if owned[r]),
+            lambda: dispatched_total()
+            >= base + sum(1 for r in owned if owned[r]),
             "warm dispatch",
         )
         warm = dispatched_total()

@@ -45,6 +45,7 @@ from torcheval_tpu.parallel.fleet_merge import MergePolicy, fleet_merge
 from torcheval_tpu.resilience import FaultPlan
 from torcheval_tpu.serve import EvalService, ServeCluster
 from torcheval_tpu.serve import metering as _metering
+from torcheval_tpu.serve.placement import Placement
 
 pytestmark = pytest.mark.distserve
 
@@ -739,3 +740,164 @@ class TestChaosHostFailover:
         )
         assert clusters[victim].results(tenant).action == "dead"
         assert clusters[victim].migrate(tenant, 0).action == "dead"
+
+
+# -------------------------------------------------------- review fences
+class TestReviewRegressions:
+    """Regression fences for the serve-plane review findings: local
+    submits racing a migration, sender-side frame retention, stale
+    migack handling, results-reply reclamation, and the all-dead
+    placement edge."""
+
+    def test_local_submit_during_migration_sheds_typed_no_loss(
+        self, tmp_path
+    ):
+        """A local submit landing between migrate()'s spill and the
+        commit must shed typed: the commit evicts the source seat
+        WITHOUT re-spilling, so an admitted batch would silently
+        vanish (local submits have no client-side frame retention)."""
+        _, clusters = _make(2, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenant = owned[0][0]
+        _open_everywhere(clusters, [tenant])
+        batches = _batches(3, seed=60)
+        for b in batches[:2]:
+            assert clusters[0].submit(tenant, *b).action == "local"
+        _wait_applied(clusters, tenant, 2)
+        out = clusters[0].migrate(tenant, 1, wait=False)
+        assert out.action == "routed", out
+        assert tenant in clusters[0]._migrating
+        shed = clusters[0].submit(tenant, *batches[2])
+        assert shed.action == "shed" and shed.detail == "migrating", shed
+        assert clusters[0].stats()["counts"]["shed_migrating"] == 1
+        _until(
+            lambda: tenant not in clusters[0]._migrating
+            and all(
+                c.placement.owner_of(tenant) == 1 for c in clusters
+            ),
+            clusters,
+            msg="handoff committed",
+        )
+        # The retry routes to the new owner; the full stream computes
+        # bit-exact — nothing lost, nothing doubled.
+        retried = clusters[0].submit(tenant, *batches[2])
+        assert retried.action == "routed" and retried.owner == 1, retried
+        _wait_applied(clusters, tenant, 3)
+        _assert_bitwise(clusters[1].results(tenant).value, _solo(batches))
+
+    def test_routed_retention_bounded_by_owner_checkpoint(self, tmp_path):
+        """Senders must not retain every frame forever: once a route
+        window's worth of applied-but-unspilled batches accumulates,
+        the owner checkpoints the tenant, the durable cursor rides the
+        next ack, and the sender releases the covered frames."""
+        _, clusters = _make(2, tmp_path, route_window=4)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenant = owned[1][0]
+        _open_everywhere(clusters, [tenant])
+        batches = _batches(6, seed=61)
+        for i, b in enumerate(batches):
+            assert clusters[0].submit(tenant, *b).action == "routed"
+            _wait_applied(clusters, tenant, i + 1)
+        stream = clusters[0]._streams[tenant]
+        _until(
+            lambda: stream.durable >= 3,
+            clusters,
+            msg="owner checkpoint advanced the durable cursor",
+        )
+        assert len(stream.frames) <= 2, sorted(stream.frames)
+        assert clusters[1].service.stats()["counts"]["spills"] >= 1
+        got = _drive_call(lambda: clusters[0].results(tenant), clusters)
+        _assert_bitwise(got.value, _solo(batches))
+
+    def test_stale_migack_cannot_destroy_inflight_migration(
+        self, tmp_path
+    ):
+        """A migack from the wrong peer, or with a stale version, must
+        not pop the in-flight migration's bookkeeping."""
+        _, clusters = _make(3, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        source, target, other = 0, 1, 2
+        tenant = owned[source][0]
+        _open_everywhere(clusters, [tenant])
+        batches = _batches(2, seed=62)
+        for b in batches:
+            assert clusters[source].submit(tenant, *b).action == "local"
+        _wait_applied(clusters, tenant, 2)
+        out = clusters[source].migrate(tenant, target, wait=False)
+        assert out.action == "routed", out
+        version = clusters[source]._migrating[tenant]["version"]
+        # Wrong peer, right version — ignored.
+        clusters[source]._handle_migrate_ack(
+            {"type": "migack", "t": tenant, "v": version, "ok": False},
+            src=other,
+        )
+        # Right peer, stale version — ignored.
+        clusters[source]._handle_migrate_ack(
+            {
+                "type": "migack",
+                "t": tenant,
+                "v": version - 1,
+                "ok": False,
+            },
+            src=target,
+        )
+        assert tenant in clusters[source]._migrating
+        counts = clusters[source].stats()["counts"]
+        assert counts["migrations_aborted"] == 0
+        # The genuine ack still commits the handoff.
+        _until(
+            lambda: tenant not in clusters[source]._migrating,
+            clusters,
+            msg="genuine migack committed",
+        )
+        assert all(
+            c.placement.owner_of(tenant) == target
+            for c in clusters
+        )
+        assert clusters[source].stats()["counts"]["migrations"] == 1
+        _wait_applied(clusters, tenant, 2)
+        _assert_bitwise(
+            clusters[target].results(tenant).value, _solo(batches)
+        )
+
+    def test_results_replies_reclaimed_on_timeout(self, tmp_path):
+        """A timed-out results() waiter retires its rid; the late
+        reply is dropped at the door instead of leaking forever."""
+        _, clusters = _make(2, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenant = owned[1][0]
+        _open_everywhere(clusters, [tenant])
+        b = _batches(1, seed=63)[0]
+        assert clusters[0].submit(tenant, *b).action == "routed"
+        _wait_applied(clusters, tenant, 1)
+        # The owner never steps during the wait: the query times out.
+        out = clusters[0].results(tenant, timeout_s=0.05)
+        assert out.action == "timeout", out
+        assert clusters[0]._results_waiting == set()
+        assert clusters[0]._results_replies == {}
+        # The owner's late reply arrives on the next steps — dropped.
+        _step_all(clusters, rounds=3)
+        assert clusters[0]._results_replies == {}
+        # A fresh query still round-trips.
+        got = _drive_call(lambda: clusters[0].results(tenant), clusters)
+        assert got.action == "local", got
+        _assert_bitwise(got.value, _solo([b]))
+
+    def test_placement_all_dead_returns_typed_dead(self, tmp_path):
+        """Excluding every rank must not leave a stale pre-death ring
+        answering owner_of(); the cluster reports typed ``dead``."""
+        p = Placement(2)
+        assert p.exclude(0) and p.exclude(1)
+        assert p.owner_of("t") == -1
+        assert p.ring_owner_of("t") == -1
+        assert p.alive == ()
+        _, clusters = _make(2, tmp_path)
+        owned = _tenants_per_rank(clusters[0], 1)
+        tenant = owned[1][0]
+        _open_everywhere(clusters, [tenant])
+        clusters[0].placement.merge([0, 1])
+        b = _batches(1, seed=64)[0]
+        assert clusters[0].submit(tenant, *b).action == "dead"
+        assert clusters[0].results(tenant).action == "dead"
+        assert clusters[0].open("t-new", _suite).action == "dead"
+        assert clusters[0].close(tenant).action == "dead"

@@ -85,7 +85,12 @@ from torcheval_tpu.telemetry import events as _telemetry
 import torcheval_tpu.serve.metering as _metering
 from torcheval_tpu.serve.admission import Admitted, Shed
 from torcheval_tpu.serve.placement import Placement, PlacementOutcome
-from torcheval_tpu.serve.registry import CLOSED, QUARANTINED, SPILLED
+from torcheval_tpu.serve.registry import (
+    ACTIVE,
+    CLOSED,
+    QUARANTINED,
+    SPILLED,
+)
 from torcheval_tpu.serve.service import EvalService
 
 MetricFactory = Callable[[], Mapping[str, Metric]]
@@ -231,12 +236,17 @@ class ServeCluster:
         self._migrating: Dict[str, Dict[str, Any]] = {}
         self._migration_s: List[float] = []
         self._results_replies: Dict[int, Dict[str, Any]] = {}
+        # rids with a live waiter; replies for any other rid (waiter
+        # timed out / redirected away) are dropped on arrival so the
+        # reply dict cannot grow without bound.
+        self._results_waiting: set = set()
         self._next_rid = 0
         self._counts: Dict[str, int] = {
             "routed": 0,
             "local": 0,
             "shed_window": 0,
             "shed_remote": 0,
+            "shed_migrating": 0,
             "migrations": 0,
             "migrations_aborted": 0,
             "repairs": 0,
@@ -328,6 +338,10 @@ class ServeCluster:
         with self._lock:
             self._factories[tenant] = factory
             owner = self._placement.owner_of(tenant)
+            if owner < 0:
+                return self._outcome(
+                    tenant, "dead", detail="no live hosts"
+                )
             _note_owner(tenant, owner)
             if owner == self._rank:
                 try:
@@ -349,6 +363,10 @@ class ServeCluster:
         with self._lock:
             self._factories.pop(tenant, None)
             owner = self._placement.owner_of(tenant)
+            if owner < 0:
+                return self._outcome(
+                    tenant, "dead", detail="no live hosts"
+                )
             if owner == self._rank:
                 try:
                     self._service.close(tenant)
@@ -391,7 +409,23 @@ class ServeCluster:
                     tenant, "lost", detail="unspilled on dead host"
                 )
             owner = self._placement.owner_of(tenant)
+            if owner < 0:
+                return self._outcome(
+                    tenant, "dead", detail="no live hosts"
+                )
             if owner == self._rank:
+                if tenant in self._migrating:
+                    # A two-phase handoff is in flight: the spill
+                    # cursor already streamed to the target, and the
+                    # commit evicts this seat WITHOUT re-spilling — a
+                    # locally admitted batch would vanish.  Routed
+                    # submits survive via client-side frame retention;
+                    # local ones have no retention, so shed typed
+                    # until the handoff commits or aborts.
+                    self._counts["shed_migrating"] += 1
+                    return self._outcome(
+                        tenant, "shed", owner, detail="migrating"
+                    )
                 return self._submit_local(tenant, args, kwargs)
             stream = self._streams.get(tenant)
             if stream is None:
@@ -473,42 +507,55 @@ class ServeCluster:
                     tenant, "lost", detail="unspilled on dead host"
                 )
             owner = self._placement.owner_of(tenant)
+            if owner < 0:
+                return self._outcome(
+                    tenant, "dead", detail="no live hosts"
+                )
             if owner == self._rank:
                 return self._local_results(tenant, owner)
             rid = self._next_rid
             self._next_rid += 1
+            self._results_waiting.add(rid)
             self._send(owner, {"type": "res", "t": tenant, "rid": rid})
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
-            self.step()
-            with self._lock:
-                reply = self._results_replies.pop(rid, None)
-                if reply is not None:
-                    if reply.get("ok"):
+        try:
+            while time.monotonic() < deadline:
+                self.step()
+                with self._lock:
+                    reply = self._results_replies.pop(rid, None)
+                    if reply is not None:
+                        if reply.get("ok"):
+                            return self._outcome(
+                                tenant, "local", owner, value=reply["val"]
+                            )
                         return self._outcome(
-                            tenant, "local", owner, value=reply["val"]
+                            tenant,
+                            reply.get("action", "rejected"),
+                            owner,
+                            detail=reply.get("detail", ""),
                         )
-                    return self._outcome(
+                    if tenant in self._lost:
+                        return self._outcome(
+                            tenant, "lost", detail="owner died"
+                        )
+                    new_owner = self._placement.owner_of(tenant)
+                # tpulint: disable=TPU006 -- _dead_self is a monotonic kill flag: the lock-free read is the zombie fence on the no-lock fast path
+                if self._dead_self:
+                    return self._outcome(tenant, "dead")
+                if new_owner != owner:
+                    return self.results(
                         tenant,
-                        reply.get("action", "rejected"),
-                        owner,
-                        detail=reply.get("detail", ""),
+                        timeout_s=max(0.0, deadline - time.monotonic()),
                     )
-                if tenant in self._lost:
-                    return self._outcome(
-                        tenant, "lost", detail="owner died"
-                    )
-                new_owner = self._placement.owner_of(tenant)
-            # tpulint: disable=TPU006 -- _dead_self is a monotonic kill flag: the lock-free read is the zombie fence on the no-lock fast path
-            if self._dead_self:
-                return self._outcome(tenant, "dead")
-            if new_owner != owner:
-                return self.results(
-                    tenant,
-                    timeout_s=max(0.0, deadline - time.monotonic()),
-                )
-            time.sleep(0.001)
-        return self._outcome(tenant, "timeout", owner)
+                time.sleep(0.001)
+            return self._outcome(tenant, "timeout", owner)
+        finally:
+            # Every exit path (reply consumed, timeout, redirect
+            # recursion, host death) retires the rid so a late reply
+            # is dropped at the door instead of leaking.
+            with self._lock:
+                self._results_waiting.discard(rid)
+                self._results_replies.pop(rid, None)
 
     def _local_results(self, tenant: str, owner: int) -> PlacementOutcome:
         try:
@@ -623,6 +670,12 @@ class ServeCluster:
                 "version": version,
                 "t0": t0,
                 "deadline": t0 + timeout_s,
+                # The handoff cursor: the target resumes exactly here,
+                # so the commit can seed this host's client stream at
+                # a sequence the target's duplicate fence accepts.
+                "cursor": int(
+                    blob.manifest["cursor"].get("batches_seen", 0)
+                ),
             }
         if not wait:
             return self._outcome(
@@ -734,6 +787,7 @@ class ServeCluster:
                     for tenant, stream in self._apply.items():
                         for client in stream.clients:
                             self._queue_ack(client, tenant)
+                self._checkpoint_routed()
                 self._flush_acks()
                 self._resend_marked()
                 now = time.monotonic()
@@ -791,7 +845,9 @@ class ServeCluster:
         elif kind == "res":
             self._handle_results_request(msg, src)
         elif kind == "resr":
-            self._results_replies[int(msg["rid"])] = msg
+            rid = int(msg["rid"])
+            if rid in self._results_waiting:
+                self._results_replies[rid] = msg
         elif kind == "cls":
             tenant = msg.get("t", "")
             if self._service.session(tenant) is not None:
@@ -896,6 +952,32 @@ class ServeCluster:
             stream.shedding = False
         return "ok"
 
+    def _checkpoint_routed(self) -> None:
+        # Caller holds the lock.  Senders retain every routed frame
+        # until the durable cursor passes it, and the service only
+        # spills on idle pressure or drain — a long-lived routed
+        # tenant would pin the sender's memory forever.  Bound the
+        # retention: once a route window's worth of applied-but-
+        # unspilled batches accumulates, checkpoint the tenant so the
+        # next ack carries an advanced durable cursor and clients
+        # release their frames.  (The next routed frame transparently
+        # resumes the session through the normal spill path.)
+        for tenant, stream in self._apply.items():
+            if not stream.clients or tenant in self._migrating:
+                continue
+            session = self._service.session(tenant)
+            if session is None or session.state != ACTIVE:
+                continue
+            if session.batches - 1 - stream.durable < self._route_window:
+                continue
+            try:
+                self._service.spill(tenant)
+            except (KeyError, RuntimeError):
+                continue
+            stream.durable = max(stream.durable, session.batches - 1)
+            for client in stream.clients:
+                self._queue_ack(client, tenant)
+
     def _retry_buffered(self) -> None:
         # Frames parked by backpressure or injected routing faults get
         # re-driven once per step.
@@ -924,6 +1006,14 @@ class ServeCluster:
             entry["a"] = session.batches - 1
         stream = self._apply.get(tenant)
         if stream is not None:
+            if session is not None and session.state == SPILLED:
+                # The service checkpointed this tenant (idle spill,
+                # drain, explicit spill): the manifest cursor covers
+                # every dispatched batch, so the durable cursor
+                # advances and senders can release retained frames.
+                stream.durable = max(
+                    stream.durable, session.batches - 1
+                )
             entry["d"] = stream.durable
             # The owner's AdmissionController backpressure signals ride
             # every ack back to the sender.
@@ -1011,7 +1101,7 @@ class ServeCluster:
             owner = self._placement.owner_of(tenant)
             if owner == self._rank:
                 self._adopt_local_stream(tenant, stream)
-            elif owner != stream.owner:
+            elif owner >= 0 and owner != stream.owner:
                 self._redirect_stream(tenant, stream, owner)
 
     def _resend_marked(self) -> None:
@@ -1107,9 +1197,17 @@ class ServeCluster:
 
     def _handle_migrate_ack(self, msg: Dict[str, Any], src: int) -> None:
         tenant = msg["t"]
-        entry = self._migrating.pop(tenant, None)
-        if entry is None or src != entry["target"]:
+        entry = self._migrating.get(tenant)
+        if (
+            entry is None
+            or src != entry["target"]
+            or int(msg.get("v", -1)) != entry["version"]
+        ):
+            # A stale ack (an earlier timed-out attempt, or a peer
+            # that was never this migration's target) must not touch
+            # the in-flight handoff's bookkeeping.
             return
+        self._migrating.pop(tenant, None)
         if not msg.get("ok"):
             self._abort_migration(tenant, msg.get("why", "nack"))
             return
@@ -1122,6 +1220,19 @@ class ServeCluster:
         except KeyError:
             pass
         self._apply.pop(tenant, None)
+        if tenant not in self._streams:
+            # This host's own submits now route to the target.  The
+            # sequence numbers must line up with the target's resumed
+            # batch cursor (its duplicate fence drops anything below
+            # it), and the source knows that cursor exactly — it is
+            # the spill cursor it streamed in phase one.
+            stream = self._streams[tenant] = _ClientStream(
+                entry["target"]
+            )
+            cursor = int(entry.get("cursor", 0))
+            stream.next_seq = cursor
+            stream.applied = cursor - 1
+            stream.durable = cursor - 1
         self._counts["migrations"] += 1
         self._migration_s.append(time.monotonic() - entry["t0"])
 

@@ -152,7 +152,12 @@ class Placement:
         self._lock = threading.Lock()
         self._dead: set = set()
         self._overrides: Dict[str, Override] = {}
-        self._ring = HashRing(range(world_size), vnodes=vnodes)
+        # None once every rank is dead (gossip can legitimately fold
+        # in a full dead set): owner_of() then answers -1 instead of
+        # consulting a stale pre-death ring.
+        self._ring: Optional[HashRing] = HashRing(
+            range(world_size), vnodes=vnodes
+        )
 
     # ------------------------------------------------------------ queries
     @property
@@ -177,8 +182,12 @@ class Placement:
     def owner_of(self, tenant: str) -> int:
         """Current owner: a live override wins; otherwise the ring over
         the survivors.  An override pointing at a dead rank is ignored
-        (not deleted — late gossip must not resurrect it)."""
+        (not deleted — late gossip must not resurrect it).  Returns
+        ``-1`` when every rank is dead (no stale pre-death answer; the
+        cluster turns it into a typed ``dead`` outcome)."""
         with self._lock:
+            if self._ring is None:
+                return -1
             ovr = self._overrides.get(tenant)
             if ovr is not None and ovr.owner not in self._dead:
                 return ovr.owner
@@ -186,8 +195,11 @@ class Placement:
 
     def ring_owner_of(self, tenant: str) -> int:
         """The ring's answer, ignoring overrides (used by ring-repair
-        to find which of a dead host's tenants fall to this host)."""
+        to find which of a dead host's tenants fall to this host);
+        ``-1`` when every rank is dead."""
         with self._lock:
+            if self._ring is None:
+                return -1
             return self._ring.owner_of(tenant)
 
     def override_version(self, tenant: str) -> int:
@@ -229,6 +241,8 @@ class Placement:
             ]
             if survivors:
                 self._ring = HashRing(survivors, vnodes=self._vnodes)
+            else:
+                self._ring = None
             return True
 
     def note_migration(self, tenant: str, owner: int, version: int) -> bool:
